@@ -1,0 +1,31 @@
+"""apus_tpu — a TPU-native replicated-state-machine (RSM) framework.
+
+A from-scratch framework with the capabilities of hku-systems/apus (APUS:
+"fast and scalable paxos on RDMA"): it makes unmodified server applications
+fault-tolerant by interposing on their socket syscalls and committing every
+client request through a DARE-style quorum-replicated log.  Where APUS
+replicates with one-sided RDMA verbs over InfiniBand
+(reference: src/dare/dare_ibv_rc.c), this framework executes the replication
+data plane on TPUs with JAX/XLA:
+
+- replica log tails are HBM-resident fixed-width slot arrays sharded over a
+  ``replica`` mesh axis (`apus_tpu.ops.logplane`),
+- the leader's one-sided log scatter is an ICI collective inside a single
+  jitted commit step, and the quorum-ACK spin-poll of the reference
+  (dare_ibv_rc.c:1650-1758) becomes a ``psum`` over a replica-axis vote mask
+  (`apus_tpu.ops.commit`),
+- membership, election, recovery and elastic reconfiguration run on a
+  host-side control plane (`apus_tpu.core`, `apus_tpu.proxy`), with the
+  native syscall interposer/proxy in C++ under ``native/``.
+
+Layout (mirrors SURVEY.md §7):
+    core/      pure, deterministic protocol logic (log, SID/term, CID
+               membership, election, commit/pruning rules)
+    ops/       jitted JAX device steps (commit, vote, heartbeat) + pallas
+    parallel/  transport abstraction, mesh helpers, in-process simulator
+    models/    replicated state machines (KVS, app-replay)
+    proxy/     host runtime: request capture/replay bridge to native proxy
+    utils/     config, timing, logging
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+"""Pure protocol core: deterministic, transport-agnostic consensus logic.
+
+This is the layer the reference never separated out: in APUS the protocol
+rules live inline in src/dare/dare_server.c (election, commit, apply,
+pruning) entangled with RDMA posting code.  Here they are pure functions
+and small state classes so they can be (a) property-tested without hardware,
+(b) lowered onto the JAX device plane, and (c) driven by the host control
+plane.
+"""
+
+from apus_tpu.core.types import EntryType, Role, ServerType
+from apus_tpu.core.sid import Sid
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.core.log import LogEntry, SlotLog
+from apus_tpu.core.quorum import quorum_size, have_majority, commit_index
+
+__all__ = [
+    "EntryType", "Role", "ServerType", "Sid", "Cid", "CidState",
+    "LogEntry", "SlotLog", "quorum_size", "have_majority", "commit_index",
+    "Node", "NodeConfig",
+]
+
+
+def __getattr__(name):
+    # Node imports the transport abstraction, which imports core.log —
+    # resolve lazily to keep `from apus_tpu.core import Node` working.
+    if name in ("Node", "NodeConfig"):
+        from apus_tpu.core import node
+        return getattr(node, name)
+    raise AttributeError(name)

@@ -1,0 +1,122 @@
+"""Configuration identifier (CID): group membership + joint-consensus resize.
+
+Parity with the reference's membership model (dare_config.h:17-45):
+a configuration is ``{epoch, size[2], state, bitmask}`` where ``state``
+implements a joint-consensus-style resize:
+
+- STABLE:   one group of ``size[0]`` servers; single majority.
+- EXTENDED: the group grew to ``size[1]`` slots, but agreement is still
+  against the *old* majority only (new slots don't vote yet).
+- TRANSIT:  both the old-size and new-size majorities must agree
+  (dual-majority; cf. wait_for_majority j-loop dare_ibv_rc.c:2799-2957).
+
+Transitions (dare_ibv_ud.c:1024-1037, dare_server.c:1888-1930):
+add server into empty slot: bitmask bit set (no resize needed);
+add server when full: STABLE -> EXTENDED (epoch+1) -> on commit TRANSIT
+-> on commit STABLE with size=new size.  Remove: bit cleared, and if it
+was the highest slot the group can later shrink.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from apus_tpu.core.types import MAX_SERVER_COUNT
+
+
+class CidState(enum.IntEnum):
+    STABLE = 0
+    EXTENDED = 1
+    TRANSIT = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Cid:
+    epoch: int = 0
+    state: CidState = CidState.STABLE
+    size: int = 0          # current agreed group size
+    new_size: int = 0      # target size during EXTENDED/TRANSIT resize
+    bitmask: int = 0       # bit i set => slot i is an active member
+
+    # -- queries ----------------------------------------------------------
+
+    def contains(self, idx: int) -> bool:
+        return bool(self.bitmask >> idx & 1)
+
+    def members(self) -> list[int]:
+        return [i for i in range(MAX_SERVER_COUNT) if self.contains(i)]
+
+    @property
+    def group_size(self) -> int:
+        return self.size
+
+    @property
+    def extended_group_size(self) -> int:
+        """Size including not-yet-voting slots (EXTENDED/TRANSIT resize)."""
+        return self.new_size if self.state != CidState.STABLE else self.size
+
+    def majorities(self) -> tuple[int, ...]:
+        """Quorum thresholds that must *all* be met to agree.
+
+        STABLE/EXTENDED: single majority of ``size`` (EXTENDED agreement is
+        against the old majority only, dare_config.h:19-21).  TRANSIT: both
+        old-size and new-size majorities (dual-majority).
+        """
+        first = self.size // 2 + 1
+        if self.state == CidState.TRANSIT:
+            return (first, self.new_size // 2 + 1)
+        return (first,)
+
+    def empty_slot(self) -> int | None:
+        """Lowest inactive slot below the extended size, if any."""
+        for i in range(self.extended_group_size):
+            if not self.contains(i):
+                return i
+        return None
+
+    # -- transitions ------------------------------------------------------
+
+    def with_server(self, idx: int) -> "Cid":
+        return dataclasses.replace(self, bitmask=self.bitmask | (1 << idx))
+
+    def without_server(self, idx: int) -> "Cid":
+        return dataclasses.replace(self, bitmask=self.bitmask & ~(1 << idx))
+
+    def extend(self, new_size: int) -> "Cid":
+        """STABLE -> EXTENDED with a larger slot count (epoch bump)."""
+        if self.state != CidState.STABLE:
+            raise ValueError("can only extend a STABLE configuration")
+        if not self.size < new_size <= MAX_SERVER_COUNT:
+            raise ValueError(f"bad new size {new_size}")
+        return dataclasses.replace(self, epoch=self.epoch + 1,
+                                   state=CidState.EXTENDED, new_size=new_size)
+
+    def to_transit(self) -> "Cid":
+        if self.state != CidState.EXTENDED:
+            raise ValueError("TRANSIT requires EXTENDED")
+        return dataclasses.replace(self, epoch=self.epoch + 1,
+                                   state=CidState.TRANSIT)
+
+    def stabilize(self) -> "Cid":
+        """TRANSIT -> STABLE at the new size."""
+        if self.state != CidState.TRANSIT:
+            raise ValueError("stabilize requires TRANSIT")
+        return dataclasses.replace(self, epoch=self.epoch + 1,
+                                   state=CidState.STABLE,
+                                   size=self.new_size, new_size=0)
+
+    @staticmethod
+    def initial(size: int) -> "Cid":
+        return Cid(epoch=0, state=CidState.STABLE, size=size,
+                   bitmask=(1 << size) - 1)
+
+    def __repr__(self) -> str:
+        return (f"Cid(e{self.epoch} {self.state.name} n={self.size}"
+                f"{'->' + str(self.new_size) if self.new_size else ''}"
+                f" mask={self.bitmask:b})")
+
+
+def equal_membership(a: Cid, b: Cid) -> bool:
+    return (a.epoch, a.state, a.size, a.new_size, a.bitmask) == \
+           (b.epoch, b.state, b.size, b.new_size, b.bitmask)

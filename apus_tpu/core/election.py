@@ -1,0 +1,107 @@
+"""Pure election rules (vote granting, up-to-date checks, timeouts).
+
+Parity targets in the reference: start_election (dare_server.c:1264-1322),
+poll_vote_requests' up-to-date log check (dare_server.c:1591-1652),
+poll_vote_count (dare_server.c:1327-1518), randomized election timeout
+(random_election_timeout, dare_server.c:1237) and the adaptive heartbeat
+timeout (to_adjust_cb, dare_server.c:763-817).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from apus_tpu.core.sid import Sid
+
+
+@dataclasses.dataclass(frozen=True)
+class VoteRequest:
+    """Candidate's vote request (the vote_req[] ctrl slot payload,
+    ctrl_data_t dare_server.h:123-140)."""
+
+    sid_word: int          # candidate SID [term|0|idx]
+    last_idx: int          # determinant of candidate's last log entry
+    last_term: int
+    cid_epoch: int
+
+    @property
+    def sid(self) -> Sid:
+        return Sid.unpack(self.sid_word)
+
+
+def log_up_to_date(cand_last_idx: int, cand_last_term: int,
+                   own_last_idx: int, own_last_term: int) -> bool:
+    """Raft/DARE up-to-date rule: candidate's log must not be behind ours
+    (term first, then index; dare_server.c:1591-1652)."""
+    if cand_last_term != own_last_term:
+        return cand_last_term > own_last_term
+    return cand_last_idx >= own_last_idx
+
+
+def should_grant(req: VoteRequest, own_sid: Sid,
+                 own_last_idx: int, own_last_term: int,
+                 known_leader: bool) -> bool:
+    """Whether a voter grants ``req``.
+
+    - never vote backwards in term;
+    - within our current term, never switch votes (own_sid.idx records whom
+      we adopted; a same-term request from a different candidate is refused);
+    - ignore candidates while we believe a leader is alive
+      (dare_server.c:1535 — mitigates disruptive servers);
+    - candidate log must be up-to-date.
+    """
+    cand = req.sid
+    if cand.term < own_sid.term:
+        return False
+    if cand.term == own_sid.term and (known_leader or cand.idx != own_sid.idx):
+        return False
+    if known_leader and cand.term <= own_sid.term:
+        return False
+    return log_up_to_date(req.last_idx, req.last_term,
+                          own_last_idx, own_last_term)
+
+
+def best_vote_request(requests: list[VoteRequest]) -> VoteRequest | None:
+    """Among simultaneous requests pick the highest (term, idx) SID
+    (best-SID scan, dare_server.c:1558-1575)."""
+    if not requests:
+        return None
+    return max(requests, key=lambda r: (r.sid.term, r.last_term, r.last_idx,
+                                        -r.sid.idx))
+
+
+def random_election_timeout(rng, low: float, high: float) -> float:
+    """Uniform in [low, high) (dare_server.c:1237)."""
+    return low + (high - low) * rng.random()
+
+
+class AdaptiveTimeout:
+    """Adaptive heartbeat-timeout estimator (to_adjust_cb analog,
+    dare_server.c:763-817).
+
+    Starts from a base timeout and grows it whenever a false positive is
+    observed (a heartbeat arrived, but later than the current timeout
+    would have tolerated), until the false-positive rate drops below
+    ``fp_target``; then freezes.
+    """
+
+    def __init__(self, base: float, growth: float = 1.2,
+                 fp_target: float = 1e-4, min_samples: int = 100):
+        self.timeout = base
+        self.growth = growth
+        self.fp_target = fp_target
+        self.min_samples = min_samples
+        self.samples = 0
+        self.false_positives = 0
+        self.frozen = False
+
+    def observe(self, hb_gap: float) -> None:
+        if self.frozen:
+            return
+        self.samples += 1
+        if hb_gap > self.timeout:
+            self.false_positives += 1
+            self.timeout *= self.growth
+        if (self.samples >= self.min_samples and
+                self.false_positives / self.samples < self.fp_target):
+            self.frozen = True

@@ -1,0 +1,232 @@
+"""The replicated log, redesigned TPU-first as fixed-width slots.
+
+The reference log is a 64 MB byte-addressed circular buffer of
+variable-length entries with wrap-around splitting and offset arithmetic
+(dare_log.h:76-103, entry splitting dare_ibv_rc.c:1532-1545, tail scans
+dare_log.h:402-457).  None of that survives contact with XLA: dynamic
+byte offsets mean dynamic shapes.
+
+Redesign: the log is ``n_slots`` fixed-width slots and a log *index* is a
+monotonically increasing integer; entry ``idx`` lives in slot
+``idx % n_slots``.  Offsets head/apply/commit/tail/end collapse into four
+absolute indices (``tail`` is just ``end - 1``), every "offset comparison"
+helper of the reference (log_offset_end_distance, log_is_offset_larger,
+dare_log.h:249-282) becomes integer comparison, and the device mirror of
+this structure is a pair of dense arrays ``[n_slots, slot_bytes] u8`` +
+``[n_slots, META] i32`` with O(1) static-shape addressing
+(see apus_tpu.ops.logplane).
+
+Oversized requests (up to MAX_REQUEST_BYTES, message.h:7) are segmented
+across consecutive slots by the proxy layer and reassembled on apply
+(see apus_tpu.proxy.segment).
+
+Invariants (checked by ``check()``)::
+
+    head <= apply <= commit <= end          (index order)
+    end - head <= n_slots                   (capacity)
+    terms are non-decreasing in [head, end)
+    idx stored in slot equals the absolute index
+
+Pruning keeps the reference's P1-P3 properties (dare_server.c:2004-2023):
+the head only advances to an index that every live replica has applied,
+via HEAD entries that are themselves committed through the log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.types import DEFAULT_LOG_SLOTS, EntryType
+
+
+@dataclasses.dataclass
+class LogEntry:
+    """One log record (parity with dare_log_entry_t, dare_log.h:33-47).
+
+    ``data`` is opaque bytes for CSM entries; CONFIG entries carry a Cid in
+    ``cid``; HEAD entries carry the new head index in ``head``.  The
+    reference's in-entry ``reply[13]`` ack bytes (remotely written by
+    followers, dare_ibv_rc.c:1828-1863) become ``ack_mask`` — on the device
+    plane this is the psum'd vote bitmask, not remotely-poked memory.
+    """
+
+    idx: int
+    term: int
+    req_id: int = 0
+    clt_id: int = 0
+    type: EntryType = EntryType.CSM
+    data: bytes = b""
+    cid: Optional[Cid] = None
+    head: int = 0
+    ack_mask: int = 0
+
+    def determinant(self) -> tuple[int, int]:
+        """(idx, term) — uniquely identifies the entry for log adjustment
+        (parity with dare_log_entry_det_t, dare_log.h:51-56)."""
+        return (self.idx, self.term)
+
+
+class LogFullError(RuntimeError):
+    pass
+
+
+class SlotLog:
+    """Fixed-slot replicated log with absolute-index offsets."""
+
+    def __init__(self, n_slots: int = DEFAULT_LOG_SLOTS, first_idx: int = 1):
+        if n_slots <= 0:
+            raise ValueError("n_slots must be positive")
+        self.n_slots = n_slots
+        # Absolute indices.  Entry indices start at 1 (reference:
+        # log_append_entry assigns idx = last+1 starting from 1,
+        # dare_log.h:488), so a fresh log has head=apply=commit=end=1.
+        self.head = first_idx
+        self.apply = first_idx
+        self.commit = first_idx
+        self.end = first_idx
+        self._slots: list[Optional[LogEntry]] = [None] * n_slots
+
+    # -- basic queries ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.end - self.head
+
+    @property
+    def is_empty(self) -> bool:
+        return self.end == self.head
+
+    @property
+    def is_full(self) -> bool:
+        return self.end - self.head >= self.n_slots
+
+    @property
+    def tail(self) -> int:
+        """Index of the last entry (or head-1 if empty)."""
+        return self.end - 1
+
+    def slot_of(self, idx: int) -> int:
+        return idx % self.n_slots
+
+    def get(self, idx: int) -> Optional[LogEntry]:
+        if not self.head <= idx < self.end:
+            return None
+        e = self._slots[self.slot_of(idx)]
+        assert e is None or e.idx == idx, f"slot holds {e and e.idx}, want {idx}"
+        return e
+
+    def last_entry(self) -> Optional[LogEntry]:
+        return self.get(self.end - 1)
+
+    def last_determinant(self) -> tuple[int, int]:
+        e = self.last_entry()
+        return e.determinant() if e else (self.end - 1, 0)
+
+    def entries(self, start: int, stop: Optional[int] = None) -> Iterable[LogEntry]:
+        stop = self.end if stop is None else min(stop, self.end)
+        for i in range(max(start, self.head), stop):
+            e = self.get(i)
+            if e is not None:
+                yield e
+
+    # -- append / write ---------------------------------------------------
+
+    def append(self, term: int, req_id: int = 0, clt_id: int = 0,
+               type: EntryType = EntryType.CSM, data: bytes = b"",
+               cid: Optional[Cid] = None, head: int = 0) -> int:
+        """Leader-side append (parity with log_append_entry,
+        dare_log.h:466-558).  Returns the new entry's index."""
+        if self.is_full:
+            raise LogFullError(f"log full: head={self.head} end={self.end}")
+        idx = self.end
+        entry = LogEntry(idx=idx, term=term, req_id=req_id, clt_id=clt_id,
+                         type=type, data=data, cid=cid, head=head)
+        self._slots[self.slot_of(idx)] = entry
+        self.end = idx + 1
+        return idx
+
+    def write(self, entry: LogEntry) -> None:
+        """Follower-side placement of a replicated entry at its index
+        (the receive side of the leader's one-sided log write,
+        update_remote_logs dare_ibv_rc.c:1460-1644).  The caller is
+        responsible for having adjusted ``end`` to ``entry.idx`` first."""
+        if entry.idx != self.end:
+            raise ValueError(f"non-contiguous write: idx={entry.idx} end={self.end}")
+        if self.is_full:
+            raise LogFullError("follower log full")
+        self._slots[self.slot_of(entry.idx)] = entry
+        self.end = entry.idx + 1
+
+    def truncate(self, new_end: int) -> None:
+        """Discard entries >= new_end (log adjustment SET_END step,
+        dare_ibv_rc.c:1292-1451).  Committed entries are never discarded."""
+        if new_end < self.commit:
+            raise ValueError(f"cannot truncate committed entries "
+                             f"(new_end={new_end} < commit={self.commit})")
+        if new_end < self.end:
+            for i in range(new_end, self.end):
+                self._slots[self.slot_of(i)] = None
+            self.end = new_end
+
+    # -- offset advancement ----------------------------------------------
+
+    def advance_commit(self, new_commit: int) -> int:
+        """Monotonic commit advance; clamped to end."""
+        self.commit = min(max(self.commit, new_commit), self.end)
+        return self.commit
+
+    def advance_apply(self, new_apply: int) -> int:
+        self.apply = min(max(self.apply, new_apply), self.commit)
+        return self.apply
+
+    def advance_head(self, new_head: int) -> None:
+        """Prune entries below new_head (log_pruning, dare_server.c:1996-2067).
+
+        P1: only applied entries are pruned (new_head <= apply).
+        P2/P3 (every live replica has applied them; HEAD entry committed
+        first) are enforced by the caller (Node.maybe_prune)."""
+        if new_head > self.apply:
+            raise ValueError(f"pruning unapplied entries: {new_head} > {self.apply}")
+        for i in range(self.head, new_head):
+            self._slots[self.slot_of(i)] = None
+        self.head = max(self.head, new_head)
+
+    # -- log adjustment (NC-buffer algorithm) -----------------------------
+
+    def nc_determinants(self) -> list[tuple[int, int]]:
+        """Determinants of all not-committed entries (the NC-buffer the
+        leader reads during adjustment, log_entries_to_nc_buf
+        dare_log.h:339-359)."""
+        return [e.determinant() for e in self.entries(self.commit)]
+
+    def find_divergence(self, remote_nc: list[tuple[int, int]],
+                        remote_commit: int) -> int:
+        """Leader-side: first index at which the remote log diverges from
+        ours (log_find_remote_end_offset, dare_log.h:367-394).  The remote
+        should truncate to the returned index and we replicate from there."""
+        expect = remote_commit
+        for (idx, term) in remote_nc:
+            assert idx == expect, "NC determinants must be contiguous"
+            local = self.get(idx)
+            if local is None or local.term != term:
+                return idx
+            expect = idx + 1
+        return expect
+
+    # -- invariant check (for property tests) ------------------------------
+
+    def check(self) -> None:
+        assert self.head <= self.apply <= self.commit <= self.end, \
+            (self.head, self.apply, self.commit, self.end)
+        assert self.end - self.head <= self.n_slots
+        prev_term = 0
+        for i in range(self.head, self.end):
+            e = self._slots[self.slot_of(i)]
+            assert e is not None and e.idx == i, f"hole/mismatch at {i}"
+            assert e.term >= prev_term, "terms must be non-decreasing"
+            prev_term = e.term
+
+    def __repr__(self) -> str:
+        return (f"SlotLog(h={self.head} a={self.apply} c={self.commit} "
+                f"e={self.end}/{self.n_slots})")

@@ -1,0 +1,519 @@
+"""The replica state machine: roles, election, replication, commit, apply.
+
+This is the pure-logic re-expression of the reference's event loop server
+(dare_server.c — election :1264-1743, commit :1751-1790, apply :1815-1974,
+pruning :1996-2122, heartbeats :822-993, failure counting :1189-1227).
+It owns no I/O: all remote effects go through a one-sided
+``Transport`` and all timing comes from the caller-supplied clock, so the
+same class runs under the deterministic simulator, the host control plane,
+and (for the data plane) delegates the commit math to the jitted device
+step.
+
+Differences from the reference, by design (TPU-first):
+- the log is fixed-width slots addressed by absolute index
+  (apus_tpu.core.log), so "log adjustment" degenerates to an integer
+  divergence search instead of a 4-step offset FSM
+  (cf. dare_ibv_rc.c:1292-1451);
+- fencing is explicit ``(granted_to, fence_term)`` on the log region
+  instead of QP resets (cf. dare_ibv_rc.c:2156-2255) — the same predicate
+  the jitted commit step evaluates as a term mask;
+- commit is computed from per-replica ack *indices* (match-index form),
+  which is exactly the psum-able quantity of the device plane, rather
+  than per-entry remotely-poked reply bytes (cf. dare_ibv_rc.c:1650-1758).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.core.election import (VoteRequest, best_vote_request,
+                                    random_election_timeout, should_grant)
+from apus_tpu.core.log import LogEntry, SlotLog
+from apus_tpu.core.quorum import have_majority
+from apus_tpu.core.sid import AtomicSid, Sid
+from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, PERMANENT_FAILURE,
+                                 EntryType, Role)
+from apus_tpu.models.sm import StateMachine
+from apus_tpu.parallel.transport import (Region, Regions, Transport,
+                                         WriteResult)
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    """Timing + sizing knobs (nodes.local.cfg analog, config-dare.c:5-44)."""
+
+    idx: int
+    n_slots: int = DEFAULT_LOG_SLOTS
+    hb_period: float = 0.010          # leader heartbeat period (10 ms DEBUG)
+    hb_timeout: float = 0.050         # follower: declare leader dead after
+    elect_low: float = 0.100          # election timeout range (100-300 ms)
+    elect_high: float = 0.300
+    prune_period: float = 0.500       # leader pruning cadence
+    apply_report_period: float = 0.050
+    max_batch: int = 64               # entries per replication write
+    seed: int = 0
+    # Failure detector: a dead peer is removed after PERMANENT_FAILURE
+    # failures counted at most once per fail_window (the reference's
+    # CTRL-QP errors surface only after RDMA retry exhaustion, so its
+    # 2-strike rule is implicitly time-throttled too).
+    auto_remove: bool = True
+    fail_window: float = 0.100
+
+
+@dataclasses.dataclass
+class PendingRequest:
+    """A client request waiting for commit (tailq element analog,
+    message.h:5-23)."""
+
+    req_id: int
+    clt_id: int
+    data: bytes
+    idx: Optional[int] = None         # log index once appended
+
+
+class Node:
+    """One replica.  Drive with ``tick(now)``; submit requests with
+    ``submit``; read committed results from the state machine."""
+
+    def __init__(self, cfg: NodeConfig, cid: Cid, sm: StateMachine,
+                 transport: Transport):
+        self.cfg = cfg
+        self.idx = cfg.idx
+        self.cid = cid
+        self.sm = sm
+        self.t = transport
+        self.log = SlotLog(cfg.n_slots)
+        self.regions = Regions()          # our remotely-writable memory
+        self.sid = AtomicSid(Sid.pack(0, False, cfg.idx))
+        self.role = Role.FOLLOWER
+        self.rng = random.Random(cfg.seed * 1000003 + cfg.idx)
+
+        # timers
+        self._last_hb_seen = 0.0
+        self._hb_timeout = cfg.hb_timeout
+        self._next_hb_send = 0.0
+        self._election_deadline: Optional[float] = None
+        self._next_prune = 0.0
+        self._next_apply_report = 0.0
+
+        # leader state
+        self._next_idx: dict[int, int] = {}       # per-follower next entry
+        self._commit_sent: dict[int, int] = {}    # lazy remote-commit writes
+        self._adjusted: dict[int, bool] = {}      # log adjustment done?
+        self._fail_count: dict[int, int] = {}     # CTRL failure counter
+        self._fail_last: dict[int, float] = {}    # last counted failure time
+        self._pending_head: Optional[int] = None  # HEAD entry in flight
+
+        # client requests
+        self._pending: list[PendingRequest] = []
+        self.committed_upcalls: list[LogEntry] = []   # drained by runtime
+        self._known_leader: Optional[int] = None
+
+        # stats (observability, §5.5)
+        self.stats = {"elections": 0, "commits": 0, "applied": 0,
+                      "votes_granted": 0, "hb_sent": 0, "entries_replicated": 0}
+
+    # ------------------------------------------------------------------
+    # public api
+    # ------------------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        s = self.sid.sid
+        return self.role == Role.LEADER and s.leader and s.idx == self.idx
+
+    @property
+    def current_term(self) -> int:
+        return self.sid.sid.term
+
+    @property
+    def leader_hint(self) -> Optional[int]:
+        return self._known_leader
+
+    def submit(self, req_id: int, clt_id: int, data: bytes) -> Optional[PendingRequest]:
+        """Enqueue a client request (leader only).  Returns a handle whose
+        ``idx`` is set once appended; committed when log.commit > idx."""
+        if not self.is_leader:
+            return None
+        pr = PendingRequest(req_id, clt_id, data)
+        self._pending.append(pr)
+        return pr
+
+    def tick(self, now: float) -> None:
+        """One poll-loop iteration (polling(), dare_server.c:1013-1152)."""
+        self._poll_vote_requests(now)
+        if self.role == Role.LEADER:
+            self._leader_tick(now)
+        elif self.role == Role.CANDIDATE:
+            self._candidate_tick(now)
+        else:
+            self._follower_tick(now)
+        self._apply_committed(now)
+
+    # ------------------------------------------------------------------
+    # role transitions
+    # ------------------------------------------------------------------
+
+    def start_election(self, now: float) -> None:
+        """start_election analog (dare_server.c:1264-1322)."""
+        my = self.sid.sid
+        new = Sid(my.term + 1, False, self.idx)
+        self.sid.update(new.word)
+        self.role = Role.CANDIDATE
+        self._known_leader = None
+        self.stats["elections"] += 1
+        # Fence: revoke everyone's access to our log during the vote
+        # (dare_server.c:1290), then vote for ourselves durably.
+        self.regions.grant_log_access(None, new.term)
+        self.regions.ctrl[Region.VOTE_ACK] = [None] * len(self.regions.ctrl[Region.VOTE_ACK])
+        self._replicate_vote(new)
+        last_idx, last_term = self.log.last_determinant()
+        req = VoteRequest(new.word, last_idx, last_term, self.cid.epoch)
+        for peer in self.cid.members():
+            if peer != self.idx:
+                self.t.ctrl_write(peer, Region.VOTE_REQ, self.idx, req)
+        self._election_deadline = now + random_election_timeout(
+            self.rng, self.cfg.elect_low, self.cfg.elect_high)
+
+    def become_leader(self, now: float) -> None:
+        """become_leader analog (dare_server.c:1493-1517)."""
+        my = self.sid.sid
+        self.sid.update(my.with_leader(True).word)
+        self.role = Role.LEADER
+        self._known_leader = self.idx
+        self._election_deadline = None
+        self._next_hb_send = now           # heartbeat immediately
+        self._next_idx = {}
+        self._commit_sent = {}
+        self._adjusted = {}
+        self._fail_count = {}
+        self._fail_last = {}
+        self._pending_head = None
+        self.regions.grant_log_access(self.idx, my.term)
+        # A fresh leader may not know its own tail if it recovered; our
+        # absolute-index log always does.  Append a blank entry so commit
+        # can advance in the new term (NOOP/CONFIG append on win,
+        # dare_server.c:1412-1491): if a resize is mid-flight, continue it.
+        if self.cid.state == CidState.EXTENDED:
+            self.log.append(my.term, type=EntryType.CONFIG,
+                            cid=self.cid.to_transit())
+        elif self.cid.state == CidState.TRANSIT:
+            self.log.append(my.term, type=EntryType.CONFIG,
+                            cid=self.cid.stabilize())
+        else:
+            self.log.append(my.term, type=EntryType.NOOP)
+
+    def become_follower(self, leader_sid: Sid, now: float) -> None:
+        """server_to_follower analog (dare_server.h:200)."""
+        self.role = Role.FOLLOWER
+        self._known_leader = leader_sid.idx if leader_sid.leader else None
+        self._election_deadline = None
+        self._last_hb_seen = now
+        self._pending.clear()
+
+    # ------------------------------------------------------------------
+    # voting
+    # ------------------------------------------------------------------
+
+    def _poll_vote_requests(self, now: float) -> None:
+        """poll_vote_requests analog (dare_server.c:1526-1743)."""
+        slots = self.regions.ctrl[Region.VOTE_REQ]
+        reqs = [r for r in slots if r is not None]
+        if not reqs:
+            return
+        for i in range(len(slots)):
+            slots[i] = None
+        best = best_vote_request(reqs)
+        my = self.sid.sid
+        last_idx, last_term = self.log.last_determinant()
+        leader_alive = (self._known_leader is not None and
+                        now - self._last_hb_seen < self._hb_timeout)
+        if not should_grant(best, my, last_idx, last_term, leader_alive):
+            # A stale candidate: our term may still need to advance so it
+            # can retry (higher term observed).
+            if best.sid.term > my.term:
+                self.sid.update(Sid(best.sid.term, False, my.idx).word)
+            return
+        cand = best.sid
+        # Adopt the candidate's SID (vote = our SID equals their [term|idx]).
+        self.sid.update(Sid(cand.term, False, cand.idx).word)
+        self.role = Role.FOLLOWER
+        self._known_leader = None
+        self._last_hb_seen = now          # give the candidate time to win
+        self.stats["votes_granted"] += 1
+        # Durable vote: replicate to a majority (rc_replicate_vote,
+        # dare_ibv_rc.c:1049-1109).
+        self._replicate_vote(Sid(cand.term, False, cand.idx))
+        # Fence our log for the candidate (restore_log_access grants the
+        # candidate's QP only, dare_ibv_rc.c:2195-2255).
+        self.regions.grant_log_access(cand.idx, cand.term)
+        # Ack: write our commit index into the candidate's vote_ack slot.
+        self.t.ctrl_write(cand.idx, Region.VOTE_ACK, self.idx, self.log.commit)
+
+    def _replicate_vote(self, vote: Sid) -> None:
+        self.regions.ctrl[Region.PRV][self.idx] = vote.word
+        for peer in self.cid.members():
+            if peer != self.idx:
+                self.t.ctrl_write(peer, Region.PRV, self.idx, vote.word)
+
+    def _candidate_tick(self, now: float) -> None:
+        """poll_vote_count analog (dare_server.c:1327-1518)."""
+        my = self.sid.sid
+        if my.idx != self.idx or my.leader:
+            # Someone moved our SID — we granted a vote or saw a leader.
+            self.role = Role.FOLLOWER
+            return
+        acks = self.regions.ctrl[Region.VOTE_ACK]
+        mask = 0
+        for peer, ack in enumerate(acks):
+            if ack is not None:
+                mask |= 1 << peer
+        if have_majority(mask, self.cid, include_self=self.idx):
+            # Followers' commit indices tell us the cluster commit floor.
+            floor = max([a for a in acks if a is not None], default=0)
+            self.log.advance_commit(min(floor, self.log.end))
+            self.become_leader(now)
+            return
+        if self._election_deadline is not None and now >= self._election_deadline:
+            self.start_election(now)
+
+    # ------------------------------------------------------------------
+    # follower
+    # ------------------------------------------------------------------
+
+    def _follower_tick(self, now: float) -> None:
+        """hb_receive_cb + replication-ack + apply reporting
+        (dare_server.c:822-922, persist_new_entries :1792-1810)."""
+        self._scan_heartbeats(now)
+        if now - self._last_hb_seen > self._hb_timeout:
+            self.start_election(now)
+            return
+        leader = self._known_leader
+        if leader is None or leader == self.idx:
+            return
+        # Ack replication: tell the leader how far our log extends
+        # (rc_send_entries_reply analog, dare_ibv_rc.c:1828-1863).
+        r = self.t.ctrl_write(leader, Region.REP_ACK, self.idx, self.log.end)
+        # Report apply progress for pruning (apply_offsets slot).
+        if now >= self._next_apply_report and r == WriteResult.OK:
+            self.t.ctrl_write(leader, Region.APPLY_IDX, self.idx, self.log.apply)
+            self._next_apply_report = now + self.cfg.apply_report_period
+
+    def _scan_heartbeats(self, now: float) -> None:
+        hb = self.regions.ctrl[Region.HB]
+        my = self.sid.sid
+        best: Optional[Sid] = None
+        for peer, word in enumerate(hb):
+            if word is None:
+                continue
+            hb[peer] = None  # read-and-zero (__sync_fetch_and_and analog,
+                             # dare_server.c:782)
+            s = Sid.unpack(word)
+            if not s.leader or s.idx != peer:
+                continue
+            if s.term < my.term:
+                # Outdated leader: nudge it to step down by heartbeating
+                # back our SID (rc_send_hb_reply, dare_ibv_rc.c:928-958).
+                self.t.ctrl_write(peer, Region.HB, self.idx, my.word)
+                continue
+            if best is None or s.term > best.term:
+                best = s
+        if best is not None:
+            if best.term > my.term or self._known_leader != best.idx:
+                self.sid.update(Sid(best.term, False, best.idx).word)
+                self.regions.grant_log_access(best.idx, best.term)
+                self.become_follower(best.with_leader(True), now)
+            self._last_hb_seen = now
+
+    # ------------------------------------------------------------------
+    # leader
+    # ------------------------------------------------------------------
+
+    def _leader_tick(self, now: float) -> None:
+        my = self.sid.sid
+        # Step down if a higher term appeared (hb_send_cb step-down check,
+        # dare_server.c:927-993).
+        hb = self.regions.ctrl[Region.HB]
+        for peer, word in enumerate(hb):
+            if word is None:
+                continue
+            hb[peer] = None
+            s = Sid.unpack(word)
+            if s.term > my.term:
+                self.become_follower(s, now)
+                return
+        self._drain_pending(my)
+        self._replicate(my, now)
+        self._advance_commit(my)
+        if now >= self._next_hb_send:
+            self._send_heartbeats(my, now)
+            self._next_hb_send = now + self.cfg.hb_period
+        if now >= self._next_prune:
+            self._maybe_prune(my)
+            self._next_prune = now + self.cfg.prune_period
+
+    def _drain_pending(self, my: Sid) -> None:
+        """tailq drain -> log append (get_tailq_message,
+        dare_ibv_ud.c:780-790)."""
+        for pr in self._pending:
+            if pr.idx is None and not self.log.is_full:
+                pr.idx = self.log.append(my.term, req_id=pr.req_id,
+                                         clt_id=pr.clt_id, data=pr.data)
+        self._pending = [p for p in self._pending
+                         if p.idx is None or p.idx >= self.log.commit]
+
+    def _replicate(self, my: Sid, now: float) -> None:
+        """rc_write_remote_logs analog (dare_ibv_rc.c:1870-1948): adjust
+        diverged followers, then write entry ranges."""
+        for peer in self._replication_targets():
+            if not self._adjusted.get(peer, False):
+                state = self.t.log_read_state(peer)
+                if state is None:
+                    self._note_failure(peer, now)
+                    continue
+                div = self.log.find_divergence(state.nc_determinants,
+                                               state.commit)
+                if div < state.end:
+                    if self.t.log_set_end(peer, my, div) != WriteResult.OK:
+                        self._note_failure(peer, now)
+                        continue
+                self._next_idx[peer] = div
+                self._adjusted[peer] = True
+            nxt = self._next_idx.get(peer, self.log.commit)
+            if nxt < self.log.head:
+                # Peer is behind our pruned head — needs a snapshot
+                # (recovery path, phase 6); skip replication for now.
+                continue
+            batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
+            if not batch and self._commit_sent.get(peer, 0) >= self.log.commit:
+                continue   # nothing new and remote commit is current
+            res = self.t.log_write(peer, my, batch, self.log.commit)
+            if res == WriteResult.OK:
+                if batch:
+                    self._next_idx[peer] = batch[-1].idx + 1
+                    self.stats["entries_replicated"] += len(batch)
+                self._commit_sent[peer] = self.log.commit
+                self._fail_count[peer] = 0
+            elif res == WriteResult.FENCED:
+                self._adjusted[peer] = False   # lost access: re-adjust later
+            else:
+                self._note_failure(peer, now)
+
+    def _replication_targets(self) -> list[int]:
+        members = set(self.cid.members())
+        if self.cid.state != CidState.STABLE:
+            members.update(range(self.cid.extended_group_size))
+            members &= {i for i in range(self.cid.extended_group_size)
+                        if self.cid.contains(i)}
+        return sorted(m for m in members if m != self.idx)
+
+    def _advance_commit(self, my: Sid) -> None:
+        """Commit rule from ack indices (the host mirror of the device
+        psum; cf. dare_ibv_rc.c:1725-1758)."""
+        acks = self.regions.ctrl[Region.REP_ACK]
+        candidates = sorted({a for a in acks if a is not None} | {self.log.end},
+                            reverse=True)
+        for c in candidates:
+            if c <= self.log.commit:
+                break
+            mask = 1 << self.idx
+            for peer, a in enumerate(acks):
+                if a is not None and a >= c:
+                    mask |= 1 << peer
+            if have_majority(mask, self.cid):
+                # Raft safety: only commit prefixes ending in our own term
+                # (the blank entry from become_leader guarantees progress).
+                last = self.log.get(c - 1)
+                if last is not None and last.term == my.term:
+                    if self.log.advance_commit(c) == c:
+                        self.stats["commits"] += 1
+                break
+
+    def _send_heartbeats(self, my: Sid, now: float) -> None:
+        """rc_send_hb analog (dare_ibv_rc.c:868-926)."""
+        for peer in self._replication_targets():
+            if self.t.ctrl_write(peer, Region.HB, self.idx, my.word) \
+                    != WriteResult.OK:
+                self._note_failure(peer, now)
+        self.stats["hb_sent"] += 1
+
+    def _note_failure(self, peer: int, now: float) -> None:
+        """check_failure_count analog (dare_server.c:1189-1227): after
+        PERMANENT_FAILURE failures — counted at most once per fail_window —
+        the leader removes the peer via a CONFIG entry."""
+        if not self.cfg.auto_remove:
+            return
+        if now - self._fail_last.get(peer, -1e9) < self.cfg.fail_window:
+            return
+        self._fail_last[peer] = now
+        n = self._fail_count.get(peer, 0) + 1
+        self._fail_count[peer] = n
+        if n >= PERMANENT_FAILURE and self.cid.contains(peer):
+            in_flight = any(e.type == EntryType.CONFIG
+                            for e in self.log.entries(self.log.commit))
+            if not in_flight:
+                self.log.append(self.sid.sid.term, type=EntryType.CONFIG,
+                                cid=self.cid.without_server(peer))
+
+    def _maybe_prune(self, my: Sid) -> None:
+        """log_pruning analog (dare_server.c:1996-2067).  P1: only applied
+        entries; P2: every live member has applied them; P3: head advance
+        is itself committed (HEAD entry) before the leader prunes."""
+        if self._pending_head is not None:
+            return  # HEAD in flight; applied in _apply_committed
+        floor = self.log.apply
+        for peer in self.cid.members():
+            if peer == self.idx:
+                continue
+            a = self.regions.ctrl[Region.APPLY_IDX][peer]
+            if a is None:
+                return
+            floor = min(floor, a)
+        if floor > self.log.head and not self.log.is_empty:
+            self.log.append(my.term, type=EntryType.HEAD, head=floor)
+            self._pending_head = floor
+
+    # ------------------------------------------------------------------
+    # apply
+    # ------------------------------------------------------------------
+
+    def _apply_committed(self, now: float) -> None:
+        """apply_committed_entries analog (dare_server.c:1815-1974)."""
+        while self.log.apply < self.log.commit:
+            e = self.log.get(self.log.apply)
+            assert e is not None
+            if e.type == EntryType.CSM:
+                self.sm.apply(e.idx, e.data)
+                self.committed_upcalls.append(e)
+            elif e.type == EntryType.CONFIG:
+                self._apply_config(e, now)
+            elif e.type == EntryType.HEAD:
+                self.log.advance_apply(e.idx + 1)
+                self.log.advance_head(min(e.head, self.log.apply))
+                if self.is_leader:
+                    self._pending_head = None
+                continue
+            self.log.advance_apply(e.idx + 1)
+            self.stats["applied"] += 1
+
+    def _apply_config(self, e: LogEntry, now: float) -> None:
+        """CONFIG application incl. resize progression
+        (dare_server.c:1888-1930)."""
+        assert e.cid is not None
+        new_cid = e.cid
+        if new_cid.epoch < self.cid.epoch:
+            return
+        self.cid = new_cid
+        if self.is_leader:
+            # Drive the joint-consensus ladder forward.
+            if new_cid.state == CidState.EXTENDED:
+                pass  # wait: new servers must catch up before TRANSIT
+            elif new_cid.state == CidState.TRANSIT:
+                self.log.append(self.sid.sid.term, type=EntryType.CONFIG,
+                                cid=new_cid.stabilize())
+        # Suicide path: removed from the configuration (DIE_AF_COMMIT
+        # analog, dare_server.c:1870-1874) — handled by the runtime
+        # observing cid.contains(self.idx) == False.

@@ -1,0 +1,58 @@
+"""Quorum / commit rules, including dual-majority transitional configs.
+
+The reference computes commit as "quorum of per-entry reply[] acks"
+scattered into the leader's log by followers (dare_ibv_rc.c:1650-1758),
+with the dual-majority j-loop for TRANSIT configurations
+(wait_for_majority, dare_ibv_rc.c:2799-2957).  Here the same rule is a
+pure function over ack bitmasks — the exact computation the device plane
+runs as a psum over a replica-axis vote mask (apus_tpu.ops.commit).
+"""
+
+from __future__ import annotations
+
+from apus_tpu.core.cid import Cid, CidState
+
+
+def quorum_size(n: int) -> int:
+    return n // 2 + 1
+
+
+def popcount_masked(ack_mask: int, member_mask: int) -> int:
+    return bin(ack_mask & member_mask).count("1")
+
+
+def have_majority(ack_mask: int, cid: Cid, include_self: int | None = None) -> bool:
+    """True iff ``ack_mask`` satisfies *every* majority the configuration
+    requires.  ``include_self`` adds the caller's own implicit ack (the
+    leader/candidate counts itself: cf. vote counting dare_server.c:1340-1373).
+
+    STABLE/EXTENDED: majority of the old ``size`` voting slots only.
+    TRANSIT: majority of both the old-size and the new-size slot sets.
+    """
+    if include_self is not None:
+        ack_mask |= 1 << include_self
+    old_mask = cid.bitmask & ((1 << cid.size) - 1)
+    if popcount_masked(ack_mask, old_mask) < quorum_size(cid.size):
+        return False
+    if cid.state == CidState.TRANSIT:
+        new_mask = cid.bitmask & ((1 << cid.new_size) - 1)
+        if popcount_masked(ack_mask, new_mask) < quorum_size(cid.new_size):
+            return False
+    return True
+
+
+def commit_index(acks_by_idx: dict[int, int], commit: int, end: int,
+                 cid: Cid, leader_idx: int) -> int:
+    """New commit index given per-entry ack bitmasks.
+
+    Commit advances over the longest *prefix* of [commit, end) whose every
+    entry has majority acks (the reference advances commit entry-by-entry
+    in order, dare_ibv_rc.c:1725-1758).  The leader's own ack is implicit.
+    """
+    new_commit = commit
+    for idx in range(commit, end):
+        if have_majority(acks_by_idx.get(idx, 0), cid, include_self=leader_idx):
+            new_commit = idx + 1
+        else:
+            break
+    return new_commit

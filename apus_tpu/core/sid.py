@@ -1,0 +1,95 @@
+"""Server identifier (SID): packed ``[term | leader-bit | server-idx]``.
+
+The reference packs the protocol's entire "who leads, what term" state into
+one 64-bit word updated with compare-and-swap (dare_server.h:46-72,
+server_update_sid dare_server.c:2288-2297) so that remote one-sided writes
+can race safely with local updates.  We keep the same packed representation:
+it is exactly what the device plane wants too — a single uint64 scalar that
+can live in a control array, be compared inside a jitted step for term
+fencing, and be updated atomically host-side.
+
+Layout (64 bits)::
+
+    [ term : 55 bits ][ L : 1 bit ][ idx : 8 bits ]
+
+``L`` set means "the server ``idx`` claims leadership of ``term``".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+_IDX_BITS = 8
+_L_SHIFT = _IDX_BITS
+_TERM_SHIFT = _IDX_BITS + 1
+_IDX_MASK = (1 << _IDX_BITS) - 1
+_L_MASK = 1 << _L_SHIFT
+
+
+@dataclasses.dataclass(frozen=True)
+class Sid:
+    """Immutable unpacked view of a packed SID word."""
+
+    term: int
+    leader: bool
+    idx: int
+
+    @staticmethod
+    def pack(term: int, leader: bool, idx: int) -> int:
+        if not 0 <= idx <= _IDX_MASK:
+            raise ValueError(f"server idx {idx} out of range")
+        return (term << _TERM_SHIFT) | (int(leader) << _L_SHIFT) | idx
+
+    @staticmethod
+    def unpack(word: int) -> "Sid":
+        return Sid(term=word >> _TERM_SHIFT,
+                   leader=bool(word & _L_MASK),
+                   idx=word & _IDX_MASK)
+
+    @property
+    def word(self) -> int:
+        return Sid.pack(self.term, self.leader, self.idx)
+
+    def with_leader(self, leader: bool = True) -> "Sid":
+        return Sid(self.term, leader, self.idx)
+
+    def __repr__(self) -> str:  # debug banner parity: "[T<t>] LEADER"
+        return f"Sid(T{self.term}{'|L' if self.leader else ''}|p{self.idx})"
+
+
+class AtomicSid:
+    """CAS-updated SID cell.
+
+    Local updates race with "remote" control-plane writes (delivered on a
+    different thread by the transport), mirroring the reference's
+    ``__sync_bool_compare_and_swap`` update (dare_server.c:2288-2297).
+    """
+
+    def __init__(self, word: int = 0):
+        self._word = word
+        self._lock = threading.Lock()
+
+    @property
+    def word(self) -> int:
+        return self._word
+
+    @property
+    def sid(self) -> Sid:
+        return Sid.unpack(self._word)
+
+    def cas(self, expect: int, new: int) -> bool:
+        with self._lock:
+            if self._word != expect:
+                return False
+            self._word = new
+            return True
+
+    def update(self, new: int) -> bool:
+        """CAS loop: install ``new`` unless someone already moved past it."""
+        while True:
+            cur = self._word
+            if cur == new:
+                return False
+            if self.cas(cur, new):
+                return True

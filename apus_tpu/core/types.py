@@ -1,0 +1,66 @@
+"""Protocol-wide constants and enums.
+
+Reference points (cited for parity, not copied):
+- entry types NOOP/CSM/CONFIG/HEAD: dare_log.h:22-25
+- capacity envelope (13 servers, 64 clients): dare.h:25-26
+- server start modes start|join|loggp: dare_server.h:22-28
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Capacity envelope — matches the reference protocol envelope (dare.h:25-26).
+MAX_SERVER_COUNT = 13
+MAX_CLIENT_COUNT = 64
+
+# Fixed-width slot geometry (TPU-first redesign of the reference's 64 MB
+# byte-addressed circular buffer, dare_log.h:76).  A log *index* is a
+# monotonically increasing uint64; its slot is ``idx % n_slots``.  Static
+# shapes let XLA keep the whole log HBM-resident with O(1) addressing and
+# no wrap-around entry splitting (cf. dare_ibv_rc.c:1532-1545).
+DEFAULT_LOG_SLOTS = 4096
+DEFAULT_SLOT_BYTES = 4096  # payload bytes per slot; large requests segment
+
+# Max raw request record size accepted from the interposer, matching the
+# reference's TCP-rcvbuf-sized command buffer (message.h:7).
+MAX_REQUEST_BYTES = 87380
+
+
+class EntryType(enum.IntEnum):
+    """Log entry types (parity with dare_log.h:22-25)."""
+
+    NOOP = 0     # blank entry appended by a fresh leader
+    CSM = 1      # client state-machine command (opaque bytes)
+    CONFIG = 2   # membership change (carries a Cid)
+    HEAD = 3     # log-pruning head advance (carries a log index)
+
+
+class Role(enum.IntEnum):
+    """Server roles (parity with the SID role macros, dare_server.c:42-53)."""
+
+    FOLLOWER = 0
+    CANDIDATE = 1
+    LEADER = 2
+
+
+class ServerType(enum.IntEnum):
+    """Start modes (parity with dare_server.h:22-28)."""
+
+    START = 0   # founding member of a fresh group
+    JOIN = 1    # joins an existing group (recovery path)
+    LOGGP = 2   # microbenchmark mode (ICI step-parameter estimation)
+
+
+class ProxyAction(enum.IntEnum):
+    """Replicated request record kinds captured by the proxy
+    (parity with the CONNECT/SEND/CLOSE actions, proxy.h / proxy.c:341-439)."""
+
+    CONNECT = 0
+    SEND = 1
+    CLOSE = 2
+
+
+# Failure detector: consecutive control-plane failures before the leader
+# removes a server (parity with PERMANENT_FAILURE, dare_server.h:74-76).
+PERMANENT_FAILURE = 2

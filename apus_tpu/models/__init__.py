@@ -1,0 +1,14 @@
+"""Replicated state machines ("models" of this framework).
+
+The reference abstracts the replicated application behind a tiny vtable
+(dare_sm_t, dare_sm.h:49-60) plus proxy callbacks; commands are opaque
+bytes (dare_sm.h:23-27).  Same here: anything implementing
+``StateMachine`` can be replicated — the built-in KVS
+(dare_kvs_sm.c analog), the app-replay SM driven by the native proxy,
+or test doubles.
+"""
+
+from apus_tpu.models.sm import StateMachine, Snapshot
+from apus_tpu.models.kvs import KvsStateMachine
+
+__all__ = ["StateMachine", "Snapshot", "KvsStateMachine"]

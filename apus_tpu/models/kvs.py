@@ -1,0 +1,63 @@
+"""Built-in key-value state machine (dare_kvs_sm.c analog).
+
+The reference ships a chained-hash KVS with PUT/GET/RM
+(dare_kvs_sm.c:157-202) used by DARE's native client path.  Ours speaks a
+trivial length-prefixed command encoding and doubles as the demo app for
+end-to-end tests when no real server binary (Redis etc.) is present.
+
+Command wire format (ascii-ish, newline-free):
+    b"P<klen>:<key><value>"  put
+    b"G<klen>:<key>"         get (reply = value or empty)
+    b"D<klen>:<key>"         delete
+"""
+
+from __future__ import annotations
+
+from apus_tpu.models.sm import Snapshot, StateMachine
+
+
+def encode_put(key: bytes, value: bytes) -> bytes:
+    return b"P%d:%s%s" % (len(key), key, value)
+
+
+def encode_get(key: bytes) -> bytes:
+    return b"G%d:%s" % (len(key), key)
+
+
+def encode_delete(key: bytes) -> bytes:
+    return b"D%d:%s" % (len(key), key)
+
+
+class KvsStateMachine(StateMachine):
+    def __init__(self) -> None:
+        self.store: dict[bytes, bytes] = {}
+
+    def apply(self, idx: int, cmd: bytes) -> bytes | None:
+        op = cmd[:1]
+        klen_s, rest = cmd[1:].split(b":", 1)
+        klen = int(klen_s)
+        key, payload = rest[:klen], rest[klen:]
+        if op == b"P":
+            self.store[key] = payload
+            return b"OK"
+        if op == b"G":
+            return self.store.get(key, b"")
+        if op == b"D":
+            self.store.pop(key, None)
+            return b"OK"
+        raise ValueError(f"bad kvs op {op!r}")
+
+    def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
+        items = b"".join(b"%d:%s%d:%s" % (len(k), k, len(v), v)
+                         for k, v in sorted(self.store.items()))
+        return Snapshot(last_idx, last_term, items)
+
+    def apply_snapshot(self, snap: Snapshot) -> None:
+        self.store = {}
+        buf = snap.data
+        while buf:
+            klen_s, buf = buf.split(b":", 1)
+            k, buf = buf[:int(klen_s)], buf[int(klen_s):]
+            vlen_s, buf = buf.split(b":", 1)
+            v, buf = buf[:int(vlen_s)], buf[int(vlen_s):]
+            self.store[k] = v

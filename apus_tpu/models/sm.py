@@ -1,0 +1,50 @@
+"""StateMachine interface (dare_sm_t vtable analog, dare_sm.h:49-60)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """SM snapshot (snapshot_t analog, dare_log.h:107-112): the state
+    blob plus the determinant of the last applied entry."""
+
+    last_idx: int
+    last_term: int
+    data: bytes
+
+
+class StateMachine:
+    """Commands are opaque bytes; ``apply`` may return a reply blob."""
+
+    def apply(self, idx: int, cmd: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
+        raise NotImplementedError
+
+    def apply_snapshot(self, snap: Snapshot) -> None:
+        raise NotImplementedError
+
+
+class RecordingStateMachine(StateMachine):
+    """Test double: records applied (idx, cmd) pairs verbatim."""
+
+    def __init__(self) -> None:
+        self.applied: list[tuple[int, bytes]] = []
+
+    def apply(self, idx: int, cmd: bytes) -> bytes | None:
+        self.applied.append((idx, cmd))
+        return None
+
+    def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
+        blob = b"\n".join(b"%d:%s" % (i, c) for i, c in self.applied)
+        return Snapshot(last_idx, last_term, blob)
+
+    def apply_snapshot(self, snap: Snapshot) -> None:
+        self.applied = []
+        if snap.data:
+            for line in snap.data.split(b"\n"):
+                i, c = line.split(b":", 1)
+                self.applied.append((int(i), c))

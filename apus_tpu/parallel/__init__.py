@@ -1,0 +1,6 @@
+"""Transport abstraction, device-mesh helpers, and the in-process simulator."""
+
+from apus_tpu.parallel.transport import Transport, Regions, WriteResult
+from apus_tpu.parallel.sim import Cluster, SimTransport
+
+__all__ = ["Transport", "Regions", "WriteResult", "Cluster", "SimTransport"]

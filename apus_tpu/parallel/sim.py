@@ -1,0 +1,241 @@
+"""Deterministic in-process multi-replica simulator.
+
+The reference's only "test harness" is a real InfiniBand cluster driven by
+shell scripts (benchmarks/run.sh, reconf_bench.sh) — there are no unit
+tests, mocks, or fake backends (SURVEY.md §4).  This module is the fake
+backend: N ``Node`` instances wired through a ``SimTransport`` that
+performs one-sided region accesses directly on the peers' memory (the
+"HCA DMA" — no target CPU involvement), with deterministic, seeded fault
+injection:
+
+- per-link message drop probability (WC-error analog),
+- partitions (set of blocked node pairs),
+- crashed nodes (all ops to/from them fail; they stop ticking),
+- fencing enforced exactly as the device plane enforces it (term-masked
+  log writes; see apus_tpu.parallel.transport docstring).
+
+Time is simulated: ``Cluster.run`` advances a virtual clock in fixed
+steps, ticking every live node each step, so every run with the same seed
+is bit-identical — election races, leader crashes, and log divergence
+become replayable unit tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import random
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.node import Node, NodeConfig
+from apus_tpu.core.sid import Sid
+from apus_tpu.core.types import Role
+from apus_tpu.models.sm import RecordingStateMachine, StateMachine
+from apus_tpu.parallel.transport import (LogState, Region, Transport,
+                                         WriteResult)
+
+
+class SimTransport(Transport):
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0):
+        self.nodes: list[Node] = []
+        self.rng = random.Random(seed)
+        self.drop_rate = drop_rate
+        self.crashed: set[int] = set()
+        self.blocked: set[tuple[int, int]] = set()   # directed pairs
+        self.initiator: Optional[int] = None         # set by Cluster per tick
+        self.op_count = 0
+
+    def attach(self, nodes: list[Node]) -> None:
+        self.nodes = nodes
+
+    # -- fault injection --------------------------------------------------
+
+    def partition(self, group_a: set[int], group_b: set[int]) -> None:
+        for a in group_a:
+            for b in group_b:
+                self.blocked.add((a, b))
+                self.blocked.add((b, a))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+    def _reachable(self, target: int) -> bool:
+        self.op_count += 1
+        src = self.initiator
+        if target in self.crashed or (src is not None and src in self.crashed):
+            return False
+        if src is not None and (src, target) in self.blocked:
+            return False
+        if self.drop_rate and self.rng.random() < self.drop_rate:
+            return False
+        return True
+
+    # -- one-sided ops ----------------------------------------------------
+
+    def ctrl_write(self, target: int, region: Region, slot: int,
+                   value) -> WriteResult:
+        if not self._reachable(target):
+            return WriteResult.DROPPED
+        self.nodes[target].regions.ctrl[region][slot] = value
+        return WriteResult.OK
+
+    def ctrl_read(self, target: int, region: Region, slot: int):
+        if not self._reachable(target):
+            return None
+        return self.nodes[target].regions.ctrl[region][slot]
+
+    def log_write(self, target: int, writer_sid: Sid,
+                  entries: list[LogEntry], commit: int) -> WriteResult:
+        if not self._reachable(target):
+            return WriteResult.DROPPED
+        tgt = self.nodes[target]
+        if not tgt.regions.log_write_allowed(writer_sid):
+            return WriteResult.FENCED
+        for e in entries:
+            if e.idx < tgt.log.end:
+                continue              # idempotent re-write
+            if e.idx > tgt.log.end:
+                break                 # non-contiguous: stop (leader re-adjusts)
+            tgt.log.write(dataclasses.replace(e))
+        tgt.log.advance_commit(min(commit, tgt.log.end))
+        return WriteResult.OK
+
+    def log_read_state(self, target: int) -> Optional[LogState]:
+        if not self._reachable(target):
+            return None
+        log = self.nodes[target].log
+        return LogState(commit=log.commit, end=log.end,
+                        nc_determinants=log.nc_determinants())
+
+    def log_set_end(self, target: int, writer_sid: Sid,
+                    new_end: int) -> WriteResult:
+        if not self._reachable(target):
+            return WriteResult.DROPPED
+        tgt = self.nodes[target]
+        if not tgt.regions.log_write_allowed(writer_sid):
+            return WriteResult.FENCED
+        tgt.log.truncate(new_end)
+        return WriteResult.OK
+
+    def log_bulk_read(self, target: int, start: int,
+                      stop: int) -> Optional[list[LogEntry]]:
+        if not self._reachable(target):
+            return None
+        log = self.nodes[target].log
+        return [dataclasses.replace(e) for e in log.entries(start, stop)]
+
+
+class Cluster:
+    """N-replica simulated cluster with a virtual clock."""
+
+    def __init__(self, n: int, seed: int = 0, drop_rate: float = 0.0,
+                 sm_factory: Callable[[], StateMachine] = RecordingStateMachine,
+                 **cfg_overrides):
+        self.n = n
+        self.now = 0.0
+        self.dt = 0.001
+        self.transport = SimTransport(seed=seed, drop_rate=drop_rate)
+        cid = Cid.initial(n)
+        self.nodes = [
+            Node(NodeConfig(idx=i, seed=seed, **cfg_overrides), cid,
+                 sm_factory(), self.transport)
+            for i in range(n)
+        ]
+        self.transport.attach(self.nodes)
+        # Stagger initial election timers so a fresh start elects cleanly
+        # (randomized timeouts, dare_server.c:1237).
+        for node in self.nodes:
+            node._last_hb_seen = node.rng.random() * node.cfg.elect_high
+
+    # -- stepping ---------------------------------------------------------
+
+    def step(self) -> None:
+        self.now += self.dt
+        for node in self.nodes:
+            if node.idx in self.transport.crashed:
+                continue
+            self.transport.initiator = node.idx
+            node.tick(self.now)
+        self.transport.initiator = None
+
+    def run(self, duration: float) -> None:
+        steps = int(duration / self.dt)
+        for _ in range(steps):
+            self.step()
+
+    def run_until(self, pred: Callable[[], bool], timeout: float = 10.0) -> bool:
+        deadline = self.now + timeout
+        while self.now < deadline:
+            self.step()
+            if pred():
+                return True
+        return False
+
+    # -- queries ----------------------------------------------------------
+
+    def leader(self) -> Optional[Node]:
+        leaders = [n for n in self.nodes
+                   if n.is_leader and n.idx not in self.transport.crashed]
+        if not leaders:
+            return None
+        return max(leaders, key=lambda n: n.current_term)
+
+    def wait_for_leader(self, timeout: float = 10.0) -> Node:
+        ok = self.run_until(lambda: self.leader() is not None, timeout)
+        assert ok, "no leader elected within timeout"
+        leader = self.leader()
+        assert leader is not None
+        return leader
+
+    # -- client ops -------------------------------------------------------
+
+    _req_seq = 0
+
+    def submit(self, data: bytes, timeout: float = 5.0):
+        """Submit via the current leader and wait for commit (the proxy
+        spin-wait analog, proxy.c:160)."""
+        Cluster._req_seq += 1
+        leader = self.wait_for_leader(timeout)
+        pr = leader.submit(Cluster._req_seq, 0, data)
+        assert pr is not None
+        ok = self.run_until(
+            lambda: pr.idx is not None and leader.log.commit > pr.idx,
+            timeout)
+        assert ok, f"request not committed within {timeout}s"
+        return pr
+
+    # -- fault injection --------------------------------------------------
+
+    def crash(self, idx: int) -> None:
+        self.transport.crashed.add(idx)
+
+    def recover(self, idx: int) -> None:
+        """Restart a crashed node with empty volatile state (the log is
+        volatile in the reference too — durability is BDB + replication,
+        SURVEY.md §5.4).  Recovery/catch-up is driven by the leader's
+        adjustment + snapshot path."""
+        self.transport.crashed.discard(idx)
+        old = self.nodes[idx]
+        node = Node(old.cfg, old.cid, type(old.sm)(), self.transport)
+        prv = old.regions.ctrl[Region.PRV][idx]
+        if prv is not None:
+            node.regions.ctrl[Region.PRV][idx] = prv   # durable vote survives
+        node._last_hb_seen = self.now  # grace period before electioneering
+        self.nodes[idx] = node
+        self.transport.attach(self.nodes)
+
+    # -- invariants -------------------------------------------------------
+
+    def check_logs_consistent(self) -> None:
+        """Safety: committed prefixes agree across all replicas."""
+        for node in self.nodes:
+            node.log.check()
+        min_commit = min(n.log.commit for n in self.nodes
+                         if n.idx not in self.transport.crashed)
+        for i in range(1, min_commit):
+            dets = {n.log.get(i).determinant() for n in self.nodes
+                    if n.idx not in self.transport.crashed
+                    and n.log.head <= i < n.log.commit}
+            assert len(dets) <= 1, f"divergent committed entry at idx {i}: {dets}"

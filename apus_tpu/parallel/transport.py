@@ -1,0 +1,125 @@
+"""One-sided transport abstraction.
+
+The reference's entire protocol is expressed as one-sided RDMA accesses to
+two remotely-exposed regions per server: the control data (ctrl_data_t,
+dare_server.h:123-140 — per-peer slots for vote requests, heartbeats,
+acks, offsets) and the log (dare_log.h).  We preserve that model as the
+*abstract interface* because it maps cleanly onto all three of our
+backends:
+
+- ``SimTransport`` (apus_tpu.parallel.sim): direct memory access with
+  deterministic fault injection — the in-process test backend the
+  reference never had.
+- the JAX device plane (apus_tpu.ops): control slots and log slots become
+  sharded arrays; "writes" are collective permutes/reductions inside a
+  jitted step.
+- the DCN control plane (apus_tpu.proxy.net): slots become RPC'd mailbox
+  writes between hosts.
+
+Fencing redesign: the reference physically blocks a deposed leader's
+one-sided writes by resetting QPs (rc_revoke_log_access
+dare_ibv_rc.c:2156-2255).  Collectives have no such mechanism — every
+replica participates in every step — so fencing is explicit: each node's
+log region carries ``(granted_to, fence_term)`` and the target applies a
+log write only if the writer's SID passes the fence.  The same check runs
+inside the jitted device step (term-masked writes, apus_tpu.ops.commit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.sid import Sid
+from apus_tpu.core.types import MAX_SERVER_COUNT
+
+
+class Region(str, enum.Enum):
+    """Named control slots, one array per node, indexed by peer
+    (ctrl_data_t parity, dare_server.h:123-140)."""
+
+    VOTE_REQ = "vote_req"     # VoteRequest from candidate peer
+    VOTE_ACK = "vote_ack"     # voter's commit idx, written to candidate
+    HB = "hb"                 # SID word heartbeats
+    PRV = "prv"               # replicated (durable) votes: sid words
+    APPLY_IDX = "apply_idx"   # peers' apply indices (for pruning)
+    REP_ACK = "rep_ack"       # follower -> leader: highest replicated idx
+    SM_REQ = "sm_req"         # snapshot request flags
+    SM_REP = "sm_rep"         # snapshot replies {sid_word, snapshot}
+
+
+class Regions:
+    """A node's remotely-writable memory: control slots + log fence."""
+
+    def __init__(self) -> None:
+        self.ctrl: dict[Region, list[Any]] = {
+            r: [None] * MAX_SERVER_COUNT for r in Region
+        }
+        # Log-access fence (replaces QP-state fencing).
+        self.granted_to: Optional[int] = None
+        self.fence_term: int = 0
+
+    def grant_log_access(self, idx: Optional[int], term: int) -> None:
+        """restore/revoke analog (dare_ibv_rc.c:2156-2255): ``idx=None``
+        revokes all access; otherwise only ``idx`` at ``term`` may write."""
+        self.granted_to = idx
+        self.fence_term = max(self.fence_term, term)
+
+    def log_write_allowed(self, writer_sid: Sid) -> bool:
+        return (self.granted_to == writer_sid.idx
+                and writer_sid.term >= self.fence_term)
+
+
+class WriteResult(enum.Enum):
+    OK = 0
+    DROPPED = 1     # network loss / partition (WC error analog)
+    FENCED = 2      # log fence rejected the write
+
+
+@dataclasses.dataclass
+class LogState:
+    """Snapshot of a remote log's offsets + NC determinants, as read by
+    the leader during adjustment (LR_GET_WRITE/NCE steps,
+    dare_ibv_rc.c:1292-1451)."""
+
+    commit: int
+    end: int
+    nc_determinants: list[tuple[int, int]]
+
+
+class Transport:
+    """Initiator-side one-sided operations.  All may fail (None/DROPPED)
+    — failures feed the failure detector exactly like CTRL-QP work-
+    completion errors do in the reference (dare_ibv_rc.c:2747-2749)."""
+
+    # control plane -------------------------------------------------------
+    def ctrl_write(self, target: int, region: Region, slot: int,
+                   value: Any) -> WriteResult:
+        raise NotImplementedError
+
+    def ctrl_read(self, target: int, region: Region, slot: int) -> Any:
+        raise NotImplementedError
+
+    # log data plane ------------------------------------------------------
+    def log_write(self, target: int, writer_sid: Sid,
+                  entries: list[LogEntry], commit: int) -> WriteResult:
+        """Replicate ``entries`` into target's log and advance its commit
+        (update_remote_logs analog, dare_ibv_rc.c:1460-1826)."""
+        raise NotImplementedError
+
+    def log_read_state(self, target: int) -> Optional[LogState]:
+        """Read target's offsets + NC buffer (adjustment read)."""
+        raise NotImplementedError
+
+    def log_set_end(self, target: int, writer_sid: Sid,
+                    new_end: int) -> WriteResult:
+        """Truncate target's log (LR_SET_END, dare_ibv_rc.c:1292-1451)."""
+        raise NotImplementedError
+
+    def log_bulk_read(self, target: int, start: int,
+                      stop: int) -> Optional[list[LogEntry]]:
+        """Bulk-fetch entries for recovery (rc_recover_log analog,
+        dare_ibv_rc.c:726-856)."""
+        raise NotImplementedError

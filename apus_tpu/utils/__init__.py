@@ -1,0 +1,5 @@
+"""Config, logging, and timing utilities."""
+
+from apus_tpu.utils.config import ClusterSpec, load_config
+
+__all__ = ["ClusterSpec", "load_config"]

@@ -1,0 +1,78 @@
+"""Configuration: file + environment, replacing libconfig + env vars.
+
+The reference splits configuration between environment variables
+(per-process identity: server_idx, group_size, server_type, config_path,
+dare_log_file, mgid — proxy.c:33-59) and a libconfig file for shared
+timing + proxy endpoint (target/nodes.local.cfg, readers
+config-dare.c:12-54 / config-proxy.c:6-56).  We keep the same split with
+JSON as the file format (stdlib-only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from apus_tpu.core.types import DEFAULT_LOG_SLOTS, DEFAULT_SLOT_BYTES
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """Shared cluster configuration (nodes.local.cfg analog)."""
+
+    group_size: int = 3
+    # timing (seconds; reference DEBUG values: hb=10ms, elect=100-300ms,
+    # nodes.local.cfg:22-37)
+    hb_period: float = 0.010
+    hb_timeout: float = 0.050
+    elect_low: float = 0.100
+    elect_high: float = 0.300
+    prune_period: float = 0.500
+    # log geometry
+    n_slots: int = DEFAULT_LOG_SLOTS
+    slot_bytes: int = DEFAULT_SLOT_BYTES
+    max_batch: int = 64
+    # control plane endpoints, one per server idx ("host:port")
+    peers: list[str] = dataclasses.field(default_factory=list)
+    # proxied application endpoint (config-proxy.c:14-45)
+    app_host: str = "127.0.0.1"
+    app_port: int = 8888
+    # durability
+    db_path: str = "apus_records.db"
+    req_log: bool = False
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClusterSpec":
+        known = {f.name for f in dataclasses.fields(ClusterSpec)}
+        return ClusterSpec(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class ProcessEnv:
+    """Per-process identity from environment (proxy.c:33-59 analog)."""
+
+    server_idx: int = 0
+    group_size: int = 3
+    server_type: str = "start"          # start | join | loggp
+    config_path: Optional[str] = None
+    log_file: Optional[str] = None
+
+    @staticmethod
+    def from_env(env: Optional[dict] = None) -> "ProcessEnv":
+        e = os.environ if env is None else env
+        return ProcessEnv(
+            server_idx=int(e.get("APUS_SERVER_IDX", e.get("server_idx", 0))),
+            group_size=int(e.get("APUS_GROUP_SIZE", e.get("group_size", 3))),
+            server_type=e.get("APUS_SERVER_TYPE", e.get("server_type", "start")),
+            config_path=e.get("APUS_CONFIG", e.get("config_path")),
+            log_file=e.get("APUS_LOG_FILE", e.get("dare_log_file")),
+        )
+
+
+def load_config(path: Optional[str] = None) -> ClusterSpec:
+    if path is None or not os.path.exists(path):
+        return ClusterSpec()
+    with open(path) as f:
+        return ClusterSpec.from_dict(json.load(f))

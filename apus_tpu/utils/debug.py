@@ -1,0 +1,30 @@
+"""Leveled per-server logging (debug.h analog: info/debug/error macros to
+per-server FILE*, reference include/dare/debug.h:24-92)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def make_logger(name: str, log_file: str | None = None,
+                level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if logger.handlers:
+        return logger
+    logger.setLevel(level)
+    handler = (logging.FileHandler(log_file) if log_file
+               else logging.StreamHandler(sys.stderr))
+    handler.setFormatter(logging.Formatter(
+        "[%(asctime)s.%(msecs)03d] %(name)s: %(message)s", "%H:%M:%S"))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+def server_logger(idx: int, log_dir: str | None = None) -> logging.Logger:
+    """Per-server log file srv<i>.log (run.sh greps these to find the
+    leader, benchmarks/run.sh:46-68 — our ops tooling does the same)."""
+    path = os.path.join(log_dir, f"srv{idx}.log") if log_dir else None
+    return make_logger(f"apus.srv{idx}", path)

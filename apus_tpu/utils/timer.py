@@ -1,0 +1,46 @@
+"""High-resolution timing (timer.h analog).
+
+The reference uses x86 ``rdtsc`` with frequency calibration
+(include/dare/timer.h:23-61); on our hosts ``time.perf_counter_ns`` is the
+portable monotonic clock.  Scoped timers mirror TIMER_INIT/START/STOP/INFO
+(timer.h:75-91) and feed the stats/observability layer.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def now() -> float:
+    return time.perf_counter()
+
+
+def now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+class ScopedTimer:
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.samples_ns: list[int] = []
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.samples_ns.append(now_ns() - self._t0)
+        return False
+
+    def percentile(self, p: float) -> float:
+        """p in [0,100]; returns microseconds."""
+        if not self.samples_ns:
+            return 0.0
+        s = sorted(self.samples_ns)
+        k = min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))
+        return s[k] / 1000.0
+
+    def summary(self) -> dict:
+        return {"name": self.name, "n": len(self.samples_ns),
+                "p50_us": self.percentile(50), "p99_us": self.percentile(99)}

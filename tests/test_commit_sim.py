@@ -1,0 +1,99 @@
+"""Replication/commit tests: the client-write hot path (SURVEY.md §3.2)
+simulated end-to-end, plus lossy-network and consistency properties."""
+
+from apus_tpu.models.kvs import KvsStateMachine, encode_put
+from apus_tpu.parallel.sim import Cluster
+
+
+def test_submit_commits_and_applies_everywhere():
+    c = Cluster(3, seed=2)
+    leader = c.wait_for_leader()
+    pr = c.submit(b"hello")
+    assert pr.idx is not None
+    # Followers replicate + apply shortly after commit.
+    c.run(0.5)
+    for n in c.nodes:
+        assert n.log.commit > pr.idx
+        assert n.log.apply > pr.idx
+    c.check_logs_consistent()
+
+
+def test_kvs_replicated_state_converges():
+    c = Cluster(3, seed=4, sm_factory=KvsStateMachine)
+    c.wait_for_leader()
+    for k in range(10):
+        c.submit(encode_put(b"k%d" % k, b"v%d" % k))
+    c.run(0.5)
+    stores = [n.sm.store for n in c.nodes]
+    assert stores[0] == {b"k%d" % k: b"v%d" % k for k in range(10)}
+    assert stores[0] == stores[1] == stores[2]
+
+
+def test_many_requests_batched():
+    c = Cluster(5, seed=6)
+    leader = c.wait_for_leader()
+    handles = [leader.submit(i, 0, b"req-%d" % i) for i in range(200)]
+    ok = c.run_until(
+        lambda: all(h.idx is not None and leader.log.commit > h.idx
+                    for h in handles),
+        timeout=10.0)
+    assert ok
+    c.run(0.5)
+    c.check_logs_consistent()
+    # All replicas applied all 200 in identical order.
+    applied = [[e for _, e in n.sm.applied] if hasattr(n.sm, "applied")
+               else None for n in c.nodes]
+    stores_equal = all(n.log.apply == c.nodes[0].log.apply for n in c.nodes)
+    assert stores_equal
+
+
+def test_lossy_network_still_commits():
+    """Message drops (WC-error analog) delay but do not break commit."""
+    c = Cluster(3, seed=8, drop_rate=0.05)
+    c.wait_for_leader(timeout=30.0)
+    pr = c.submit(b"lossy", timeout=30.0)
+    assert pr.idx is not None
+    c.run(1.0)
+    c.check_logs_consistent()
+
+
+def test_follower_restart_catches_up():
+    """Crash a follower mid-stream; after restart the leader's adjustment
+    + replication path catches it back up (volatile log, durable quorum)."""
+    c = Cluster(3, seed=9, auto_remove=False)
+    leader = c.wait_for_leader()
+    c.submit(b"a")
+    victim = next(n.idx for n in c.nodes if n.idx != leader.idx)
+    c.crash(victim)
+    for i in range(5):
+        c.submit(b"during-%d" % i)
+    c.recover(victim)
+    ok = c.run_until(
+        lambda: c.nodes[victim].log.commit >= leader.log.commit
+        and leader.log.commit > 1, timeout=15.0)
+    assert ok, (c.nodes[victim].log, leader.log)
+    c.run(0.5)
+    c.check_logs_consistent()
+
+
+def test_leader_commit_monotone_and_prefix():
+    c = Cluster(5, seed=10)
+    leader = c.wait_for_leader()
+    commits = []
+    for i in range(20):
+        c.submit(b"m%d" % i)
+        commits.append(leader.log.commit)
+    assert commits == sorted(commits)
+    c.check_logs_consistent()
+
+
+def test_pruning_advances_head():
+    c = Cluster(3, seed=12, prune_period=0.05, n_slots=64)
+    c.wait_for_leader()
+    for i in range(40):
+        c.submit(b"p%d" % i)
+    c.run(2.0)
+    # Heads advanced on all nodes (P1-P3 respected by construction).
+    for n in c.nodes:
+        assert n.log.head > 1, n.log
+    c.check_logs_consistent()

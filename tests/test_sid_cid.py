@@ -1,0 +1,94 @@
+"""SID packing / CAS and CID membership-transition tests."""
+
+import pytest
+
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.core.quorum import have_majority, quorum_size
+from apus_tpu.core.sid import AtomicSid, Sid
+
+
+def test_sid_roundtrip():
+    for term in (0, 1, 7, 2**40):
+        for leader in (False, True):
+            for idx in (0, 3, 12):
+                w = Sid.pack(term, leader, idx)
+                s = Sid.unpack(w)
+                assert (s.term, s.leader, s.idx) == (term, leader, idx)
+                assert s.word == w
+
+
+def test_sid_ordering_by_term():
+    # Higher term always packs to a larger word regardless of L/idx bits.
+    assert Sid.pack(2, False, 0) > Sid.pack(1, True, 12)
+
+
+def test_atomic_sid_cas():
+    cell = AtomicSid(Sid.pack(1, False, 0))
+    old = cell.word
+    assert cell.cas(old, Sid.pack(2, False, 1))
+    assert not cell.cas(old, Sid.pack(3, False, 2))
+    assert cell.sid.term == 2
+    assert cell.update(Sid.pack(5, True, 1))
+    assert not cell.update(Sid.pack(5, True, 1))   # no-op
+
+
+def test_cid_initial_and_membership():
+    cid = Cid.initial(3)
+    assert cid.members() == [0, 1, 2]
+    assert cid.group_size == 3
+    assert cid.majorities() == (2,)
+    assert not cid.contains(5)
+
+
+def test_cid_add_remove_in_slot():
+    cid = Cid.initial(5).without_server(3)
+    assert cid.members() == [0, 1, 2, 4]
+    assert cid.empty_slot() == 3
+    cid2 = cid.with_server(3)
+    assert cid2.members() == [0, 1, 2, 3, 4]
+
+
+def test_cid_resize_ladder():
+    """STABLE -> EXTENDED -> TRANSIT -> STABLE (dare_config.h:17-24)."""
+    cid = Cid.initial(3)
+    ext = cid.extend(5)
+    assert ext.state == CidState.EXTENDED
+    assert ext.epoch == 1
+    assert ext.extended_group_size == 5
+    assert ext.majorities() == (2,)           # old majority only
+    tra = ext.with_server(3).with_server(4).to_transit()
+    assert tra.majorities() == (2, 3)         # dual majority
+    stable = tra.stabilize()
+    assert stable.state == CidState.STABLE
+    assert stable.size == 5
+    assert stable.majorities() == (3,)
+
+
+def test_cid_transition_guards():
+    cid = Cid.initial(3)
+    with pytest.raises(ValueError):
+        cid.to_transit()
+    with pytest.raises(ValueError):
+        cid.stabilize()
+    with pytest.raises(ValueError):
+        cid.extend(2)
+
+
+def test_quorum_size():
+    assert [quorum_size(n) for n in (1, 2, 3, 4, 5, 7)] == [1, 2, 2, 3, 3, 4]
+
+
+def test_dual_majority():
+    tra = Cid.initial(3).extend(5).with_server(3).with_server(4).to_transit()
+    # acks from {0,1} -> old majority ok (2/3) but new majority (2/5) short.
+    assert not have_majority(0b00011, tra)
+    # acks {0,1,3} -> old 2/3 ok, new 3/5 ok.
+    assert have_majority(0b01011, tra)
+    # acks {2,3,4} -> old majority only 1/3 — fails despite 3 total acks.
+    assert not have_majority(0b11100, tra)
+
+
+def test_majority_include_self():
+    cid = Cid.initial(3)
+    assert have_majority(0b010, cid, include_self=0)
+    assert not have_majority(0b000, cid, include_self=0)
