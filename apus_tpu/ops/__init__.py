@@ -1,0 +1,29 @@
+"""JAX device data plane: the consensus hot path as jitted mesh steps.
+
+This package is the TPU re-expression of the reference's RDMA data plane
+(src/dare/dare_ibv_rc.c).  The mapping (BASELINE.json north star):
+
+| reference (RDMA)                          | here (JAX/XLA on ICI)        |
+|-------------------------------------------|------------------------------|
+| leader RDMA WRITEs entries into followers'| masked psum broadcast of the |
+| logs (update_remote_logs :1460-1644)      | batch over the replica axis  |
+| followers poke 1-byte acks into the       | per-replica ack index,       |
+| leader's entry reply[] (:1828-1863)       | all_gather'ed                |
+| leader spin-polls reply[] for quorum      | closed-form quorum over the  |
+| (:1650-1758, loop_for_commit :1883-1945)  | gathered ack vector — the    |
+|                                           | collective IS the barrier    |
+| QP-reset fencing (:2156-2255)             | in-step term/grant masking   |
+| LogGP microbenchmark (:3322-3749)         | ops.loggp step-param probe   |
+
+All state lives in HBM as fixed-width arrays sharded over a ``replica``
+mesh axis (ops.logplane).  One ``commit_step`` call performs: scatter of
+a 64-entry batch, fence check, slot writes, quorum reduction, and commit
+advance — entirely inside XLA, no host round-trips mid-protocol.
+"""
+
+from apus_tpu.ops.mesh import replica_mesh
+from apus_tpu.ops.logplane import DeviceLog, make_device_log
+from apus_tpu.ops.commit import build_commit_step, CommitControl
+
+__all__ = ["replica_mesh", "DeviceLog", "make_device_log",
+           "build_commit_step", "CommitControl"]

@@ -1,0 +1,132 @@
+"""Device control-plane steps: election vote round and heartbeat round.
+
+The reference's control plane is a set of per-server RDMA-written slots
+(vote_req[], vote_ack[], hb[], prv_data[] — ctrl_data_t,
+dare_server.h:123-140) polled by each server.  The *decisions* (whom to
+vote for, when to time out) belong on the host control plane
+(apus_tpu.core.node); these device steps accelerate the *rounds*: one
+collective evaluates every replica's grant/alive predicate and reduces
+the quorum, replacing N one-sided writes + a poll loop with a single
+jitted program.  They also let the driver validate full-cluster election
+math on a mesh (dryrun_multichip) without any host networking.
+
+State arrays (sharded over the replica axis):
+    vote_state [R, 3] i32: (voted_term, voted_for, granted_fence_term)
+    hb_state   [R, 2] i32: (last_seen_term, last_seen_counter)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apus_tpu.ops.logplane import META_IDX, META_TERM, OFF_END
+from apus_tpu.ops.mesh import REPLICA_AXIS
+
+VS_TERM, VS_FOR, VS_FENCE = range(3)
+HB_TERM, HB_COUNT = range(2)
+
+
+def _vote_body(vote_state, offs, log_meta, cand, *, block: int,
+               n_slots: int):
+    """One vote round.  ``cand`` = [cand_idx, cand_term, cand_last_idx,
+    cand_last_term, q_old, q_new] replicated i32[6] packed with the
+    membership masks appended: full layout [6 + 2R].
+
+    Per replica: grant iff cand_term > voted_term and the candidate's log
+    is up-to-date vs ours (poll_vote_requests check,
+    dare_server.c:1591-1652).  Granting updates the durable vote record
+    and the fence term (restore_log_access analog).
+    """
+    K = log_meta.shape[0]
+    S = n_slots
+    R = (cand.shape[0] - 6) // 2
+    a = lax.axis_index(REPLICA_AXIS)
+    rid = a * K + jnp.arange(K, dtype=jnp.int32)
+    c_idx, c_term, c_lidx, c_lterm, q_old, q_new = (cand[i] for i in range(6))
+    mask_old = cand[6:6 + R]
+    mask_new = cand[6 + R:6 + 2 * R]
+
+    # Our last determinant from the device log: slot of entry (end-1),
+    # slot formula (idx-1) % S (ops.logplane.slot_of).
+    own_end = offs[:, OFF_END]                          # [K]
+    last_slot = (own_end - 2) % S
+    own_last_idx = jnp.take_along_axis(
+        log_meta[:, :, META_IDX], last_slot[:, None], axis=1)[:, 0]
+    own_last_term = jnp.take_along_axis(
+        log_meta[:, :, META_TERM], last_slot[:, None], axis=1)[:, 0]
+    # An empty log (end == first index) has no determinant.
+    empty = own_last_idx != own_end - 1
+    own_last_idx = jnp.where(empty, 0, own_last_idx)
+    own_last_term = jnp.where(empty, 0, own_last_term)
+
+    term_ok = c_term > vote_state[:, VS_TERM]
+    # Idempotence (Raft: votedFor == candidate at equal term re-grants):
+    # a retried round for the same (candidate, term) must count again.
+    repeat = ((vote_state[:, VS_TERM] == c_term)
+              & (vote_state[:, VS_FOR] == c_idx))
+    up_to_date = jnp.where(c_lterm != own_last_term,
+                           c_lterm > own_last_term,
+                           c_lidx >= own_last_idx)
+    # Candidate self-vote skips the log check (its log trivially matches
+    # itself) but NOT the term check — a stale self-round must not
+    # overwrite a newer durable vote.
+    grant = ((term_ok | repeat) & (up_to_date | (rid == c_idx)))
+
+    vote_state = jnp.where(
+        grant[:, None],
+        jnp.stack([jnp.full((K,), c_term), jnp.full((K,), c_idx),
+                   jnp.full((K,), c_term)], axis=-1),
+        vote_state)
+
+    grants = lax.all_gather(grant.astype(jnp.int32), REPLICA_AXIS).reshape(-1)
+    n_old = jnp.sum(grants * mask_old)
+    n_new = jnp.sum(grants * mask_new)
+    elected = (n_old >= q_old) & ((q_new == 0) | (n_new >= q_new))
+    return vote_state, grants, elected
+
+
+def _hb_body(hb_state, beat, *, block: int):
+    """One heartbeat round.  ``beat`` = [leader_idx, term, counter] i32
+    replicated.  The leader's beat fans out (pmax broadcast); each
+    replica records the newest (term, counter) it has seen and reports
+    whether this round delivered a fresh beat (the hb[] scan analog,
+    dare_server.c:822-922)."""
+    K = hb_state.shape[0]
+    a = lax.axis_index(REPLICA_AXIS)
+    rid = a * K + jnp.arange(K, dtype=jnp.int32)
+    is_leader = rid == beat[0]
+    # Broadcast (term, counter) from the leader row.
+    local = jnp.where(is_leader[:, None], beat[None, 1:3], 0).max(axis=0)
+    seen = lax.pmax(local, REPLICA_AXIS)                 # [2]
+    newer = ((seen[0] > hb_state[:, HB_TERM]) |
+             ((seen[0] == hb_state[:, HB_TERM]) &
+              (seen[1] > hb_state[:, HB_COUNT])))
+    hb_state = jnp.where(newer[:, None], seen[None, :], hb_state)
+    fresh = lax.all_gather(newer.astype(jnp.int32), REPLICA_AXIS).reshape(-1)
+    return hb_state, fresh
+
+
+def build_vote_step(mesh: Mesh, n_replicas: int, n_slots: int):
+    axis = mesh.shape[REPLICA_AXIS]
+    assert n_replicas % axis == 0
+    body = functools.partial(_vote_body, block=n_replicas // axis,
+                             n_slots=n_slots)
+    s, r = P(REPLICA_AXIS), P()
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(s, s, s, r),
+                       out_specs=(s, r, r), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_hb_step(mesh: Mesh, n_replicas: int):
+    axis = mesh.shape[REPLICA_AXIS]
+    assert n_replicas % axis == 0
+    body = functools.partial(_hb_body, block=n_replicas // axis)
+    s, r = P(REPLICA_AXIS), P()
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(s, r), out_specs=(s, r),
+                       check_vma=False)
+    return jax.jit(fn)

@@ -1,0 +1,46 @@
+"""Device-mesh construction for the replica axis.
+
+The reference's "cluster" is N servers on an IB fabric; ours is N replica
+shards on a ``jax.sharding.Mesh`` axis named ``"replica"``.  On real
+hardware each replica maps to one TPU chip and collectives ride ICI; in
+tests the mesh is 8 virtual CPU devices (conftest.py); single-chip
+benches fold the replica axis onto one device (XLA still emits the same
+program, collectives become local shuffles).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPLICA_AXIS = "replica"
+
+
+def replica_mesh(n_replicas: int, devices=None) -> Mesh:
+    """A 1-D mesh with ``n_replicas`` entries along the replica axis.
+
+    If fewer physical devices exist than replicas, devices are reused
+    (valid for functional testing / single-chip benchmarking: XLA runs
+    the identical collective program; inter-replica traffic stays on-chip)."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) >= n_replicas:
+        devs = np.array(devices[:n_replicas])
+        return Mesh(devs, (REPLICA_AXIS,))
+    if len(devices) == 1:
+        # Single-chip fold: a 1-entry mesh; replica state keeps its leading
+        # axis and collectives reduce over a size-1 axis — the protocol
+        # math is then vectorized over the replica-batch dim instead.
+        return Mesh(np.array(devices), (REPLICA_AXIS,))
+    raise ValueError(
+        f"need 1 or >= {n_replicas} devices, have {len(devices)}")
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding for per-replica state arrays."""
+    return NamedSharding(mesh, P(REPLICA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

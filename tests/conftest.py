@@ -9,10 +9,20 @@ set before jax is imported anywhere, hence this conftest.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""   # disable TPU (axon) registration
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize registers the axon (TPU) PJRT plugin at
+# interpreter start and forces jax_platforms="axon,cpu" — *before* this
+# conftest runs — so env vars alone don't keep tests off the (single,
+# possibly tunnel-flaky) TPU chip.  Override the config knob back to cpu
+# before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

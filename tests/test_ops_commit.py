@@ -1,0 +1,199 @@
+"""Device-plane commit step tests on the virtual 8-device CPU mesh.
+
+Validates that the jitted collective program implements the same commit
+rule as the pure core (quorum, dual-majority, fencing, contiguity), and
+that it works across mesh foldings (8-device, 1-device-per-replica,
+all-replicas-on-one-device).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.ops.commit import CommitControl, build_commit_step, place_batch
+from apus_tpu.ops.logplane import (META_IDX, META_LEN, META_TERM, META_TYPE,
+                                   OFF_COMMIT,
+                                   OFF_END, FENCE_GRANTED, FENCE_TERM,
+                                   host_batch_to_device, make_device_log)
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+
+
+def run_step(R=4, B=8, S=32, SB=64, leader=0, term=1, n_reqs=5,
+             fence_overrides=None, offs_overrides=None, cid=None,
+             devices=None, end0=1):
+    mesh = replica_mesh(R, devices=devices)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, S, SB, batch=B, leader=leader, term=term,
+                             sharding=sh)
+    if fence_overrides:
+        f = np.array(devlog.fence)
+        for r, (g, t) in fence_overrides.items():
+            f[r] = (g, t)
+        devlog.fence = jax.device_put(f, sh)
+    if offs_overrides:
+        o = np.array(devlog.offs)
+        for r, end in offs_overrides.items():
+            o[r, OFF_END] = end
+        devlog.offs = jax.device_put(o, sh)
+    step = build_commit_step(mesh, R, S, SB, B)
+    reqs = [b"req-%d" % i for i in range(n_reqs)]
+    bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+    bdata, bmeta = place_batch(mesh, R, leader, bd, bm)
+    cid = cid or Cid.initial(R)
+    ctrl = CommitControl.from_cid(cid, R, leader=leader, term=term,
+                                  end0=end0)
+    devlog, acks, commit = step(devlog, bdata, bmeta, ctrl)
+    return devlog, np.asarray(acks), int(commit)
+
+
+def test_basic_commit_all_replicas():
+    # Full batch of B=8 appended: 5 real entries + 3 NOOP pads (idx 1..8).
+    devlog, acks, commit = run_step(R=4, n_reqs=5)
+    assert commit == 9
+    assert list(acks) == [9, 9, 9, 9]
+    offs = np.asarray(devlog.offs)
+    assert (offs[:, OFF_COMMIT] == 9).all()
+    assert (offs[:, OFF_END] == 9).all()
+    # Payload identical on every replica, metadata correct.
+    data = np.asarray(devlog.data)
+    meta = np.asarray(devlog.meta)
+    for r in range(4):
+        slot = (3 - 1) % 32                  # entry idx 3
+        assert bytes(data[r, slot, :5]) == b"req-2"
+        assert meta[r, slot, META_IDX] == 3
+        assert meta[r, slot, META_TERM] == 1
+        assert meta[r, slot, META_LEN] == 5
+        pad_slot = (6 - 1) % 32              # entry idx 6 = NOOP padding
+        assert meta[r, pad_slot, META_TYPE] == 0
+        assert meta[r, pad_slot, META_IDX] == 6
+
+
+def test_fenced_replica_rejects_write():
+    """A replica whose fence names a different leader must not accept the
+    batch — and with 2 of 4 fenced, quorum still holds (3 of 4 incl.
+    leader); with 3 fenced it must not."""
+    devlog, acks, commit = run_step(
+        R=4, fence_overrides={1: (2, 5)})    # replica 1 granted to 2@term5
+    assert list(acks) == [9, 1, 9, 9]
+    assert commit == 9                       # 3/4 still a majority
+    devlog, acks, commit = run_step(
+        R=4, fence_overrides={1: (2, 5), 2: (2, 5), 3: (2, 5)})
+    assert list(acks) == [9, 1, 1, 1]
+    assert commit == 1                       # no quorum -> commit stays at 1
+
+
+def test_stale_term_is_fenced():
+    """Writer term below the fence term is rejected (deposed leader)."""
+    devlog, acks, commit = run_step(
+        R=4, term=1, fence_overrides={1: (0, 3), 2: (0, 3), 3: (0, 3)})
+    # granted_to == leader(0) but fence_term 3 > writer term 1.
+    assert list(acks) == [9, 1, 1, 1]
+    assert commit == 1
+
+
+def test_non_contiguous_follower_does_not_ack():
+    """A lagging replica (end != batch start) skips the write; its ack
+    stays at its own end (host adjustment path catches it up)."""
+    devlog, acks, commit = run_step(R=4, offs_overrides={2: 0}, end0=1)
+    # replica 2 claims end=0 != 1: no write.  (clamped candidates)
+    assert acks[2] == 0
+    assert commit == 9                       # other 3 form the majority
+
+
+def test_minority_cannot_commit():
+    """Only the leader in the member mask -> no commit (partition analog)."""
+    cid = Cid.initial(4).without_server(1).without_server(2)
+    # members {0,3}; but majority of size-4 config requires 3 acks.
+    devlog, acks, commit = run_step(R=4, fence_overrides={1: (9, 9), 2: (9, 9),
+                                                          3: (9, 9)}, cid=cid)
+    assert commit <= 1                       # nothing newly committed
+
+
+def test_dual_majority_transit():
+    """TRANSIT config: commit needs a majority of both the old 3-group
+    and the new 5-group (dare_ibv_rc.c:2799-2957 analog)."""
+    cid = Cid.initial(3).extend(5).with_server(3).with_server(4).to_transit()
+    # All 5 replicas healthy: commits.
+    devlog, acks, commit = run_step(R=5, cid=cid)
+    assert commit == 9
+    # New-group members 3,4 fenced out: old majority ok, new majority
+    # (needs 3 of {0..4}) ok via 0,1,2... both masks overlap; fence 2,3,4:
+    # old majority = {0,1} of 3 => 2>=2 ok; new = {0,1} of 5 => 2<3 fails.
+    devlog, acks, commit = run_step(
+        R=5, cid=cid, fence_overrides={2: (9, 9), 3: (9, 9), 4: (9, 9)})
+    assert commit == 1
+
+
+def test_single_device_fold():
+    """All replicas folded onto one device: identical protocol results
+    (the single-chip bench configuration)."""
+    devices = jax.devices()[:1]
+    devlog, acks, commit = run_step(R=4, devices=devices, n_reqs=5)
+    assert commit == 9
+    assert list(acks) == [9, 9, 9, 9]
+
+
+def test_sequential_batches_advance():
+    """Multiple rounds: end/commit advance monotonically; slots reused
+    modulo S only after… (no pruning here, so stay within S)."""
+    R, B, S, SB = 4, 4, 64, 32
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    step = build_commit_step(mesh, R, S, SB, B)
+    cid = Cid.initial(R)
+    end0 = 1
+    for round_ in range(5):
+        reqs = [b"r%d-%d" % (round_, i) for i in range(B)]
+        bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+        bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, end0)
+        devlog, acks, commit = step(devlog, bdata, bmeta, ctrl)
+        end0 += B
+        assert int(commit) == end0
+    meta = np.asarray(devlog.meta)
+    data = np.asarray(devlog.data)
+    # entry idx 13 = round 3, item 0 (1 + 3*4 = 13); slot = (13-1) % S
+    assert meta[2, 12 % S, META_IDX] == 13
+    assert bytes(data[2, 12 % S, :4]) == b"r3-0"
+
+
+def test_device_vs_core_quorum_equivalence():
+    """The device commit rule and the pure-core commit rule agree on
+    randomized ack patterns."""
+    import random
+    from apus_tpu.core.quorum import have_majority
+    rng = random.Random(0)
+    R = 5
+    for trial in range(50):
+        cid = Cid.initial(R)
+        acks = [rng.randint(1, 10) for _ in range(R)]
+        leader_ack = max(acks)
+        # core rule: largest c <= leader_ack s.t. mask(acks>=c) has majority
+        best = 0
+        for c in sorted(set(acks), reverse=True):
+            c = min(c, leader_ack)
+            mask = sum(1 << i for i, a in enumerate(acks) if a >= c)
+            if have_majority(mask, cid):
+                best = max(best, c)
+        # device rule (numpy mirror of the in-step math)
+        import numpy as np
+        av = np.array(acks)
+        cand = np.minimum(av, leader_ack)
+        ge = av[None, :] >= cand[:, None]
+        n = (ge * np.ones(R, int)[None, :]).sum(1)
+        ok = n >= (R // 2 + 1)
+        dev_best = int(np.max(np.where(ok, cand, 0)))
+        assert dev_best == best, (acks, best, dev_best)
+
+
+def test_rejected_replica_does_not_advance_commit():
+    """A fenced/divergent replica must NOT adopt the global commit (its
+    suffix may conflict; host adjustment must run first)."""
+    devlog, acks, commit = run_step(R=4, fence_overrides={1: (2, 5)})
+    offs = np.asarray(devlog.offs)
+    assert commit == 9
+    assert offs[1, OFF_COMMIT] == 1      # rejected: commit unchanged
+    assert offs[0, OFF_COMMIT] == 9 and offs[2, OFF_COMMIT] == 9

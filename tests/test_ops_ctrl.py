@@ -1,0 +1,131 @@
+"""Device control-plane step tests (vote round, heartbeat round)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apus_tpu.core.quorum import quorum_size
+from apus_tpu.ops.ctrl import (HB_COUNT, HB_TERM, VS_FENCE, VS_FOR, VS_TERM,
+                               build_hb_step, build_vote_step)
+from apus_tpu.ops.logplane import make_device_log
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+
+
+def pack_cand(R, cand_idx, cand_term, last_idx, last_term,
+              mask_old=None, mask_new=None, q_old=None, q_new=0):
+    mask_old = mask_old if mask_old is not None else [1] * R
+    mask_new = mask_new if mask_new is not None else [0] * R
+    q_old = q_old if q_old is not None else quorum_size(R)
+    return jnp.asarray([cand_idx, cand_term, last_idx, last_term,
+                        q_old, q_new] + list(mask_old) + list(mask_new),
+                       jnp.int32)
+
+
+def test_vote_round_grants_and_elects():
+    R = 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, n_slots=16, slot_bytes=8, batch=8,
+                             sharding=sh)
+    vote_state = jax.device_put(np.zeros((R, 3), np.int32), sh)
+    step = build_vote_step(mesh, R, 16)
+    cand = pack_cand(R, cand_idx=2, cand_term=1, last_idx=0, last_term=0)
+    vote_state, grants, elected = step(vote_state, devlog.offs, devlog.meta,
+                                       cand)
+    assert bool(elected)
+    assert list(np.asarray(grants)) == [1, 1, 1, 1]
+    vs = np.asarray(vote_state)
+    assert (vs[:, VS_TERM] == 1).all()
+    assert (vs[:, VS_FOR] == 2).all()
+
+
+def test_vote_round_refuses_stale_term():
+    """Replicas that already voted in term >= candidate's refuse."""
+    R = 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, n_slots=16, slot_bytes=8, batch=8,
+                             sharding=sh)
+    vs0 = np.zeros((R, 3), np.int32)
+    vs0[:, VS_TERM] = 5          # everyone voted in term 5 already
+    vote_state = jax.device_put(vs0, sh)
+    step = build_vote_step(mesh, R, 16)
+    cand = pack_cand(R, cand_idx=1, cand_term=3, last_idx=0, last_term=0)
+    vote_state, grants, elected = step(vote_state, devlog.offs, devlog.meta,
+                                       cand)
+    g = list(np.asarray(grants))
+    # Nobody grants — not even the candidate itself: a stale self-round
+    # must not overwrite a newer durable vote (double-vote hazard).
+    assert g == [0, 0, 0, 0]
+    assert not bool(elected)
+    vs = np.asarray(vote_state)
+    assert (vs[:, VS_TERM] == 5).all()   # durable votes untouched
+
+
+def test_vote_round_up_to_date_check():
+    """A replica whose log is ahead refuses the vote
+    (dare_server.c:1591-1652)."""
+    import numpy as np
+    R = 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, n_slots=16, slot_bytes=8, batch=8,
+                             sharding=sh)
+    # Give replica 3 a log entry at idx 1, term 2 (ahead of candidate).
+    meta = np.array(devlog.meta)
+    offs = np.array(devlog.offs)
+    meta[3, 0, 0] = 1   # slot (1-1)%S = 0: idx
+    meta[3, 0, 1] = 2   # term
+    offs[3, 3] = 2      # end = 2
+    devlog.meta = jax.device_put(meta, sh)
+    devlog.offs = jax.device_put(offs, sh)
+    vote_state = jax.device_put(np.zeros((R, 3), np.int32), sh)
+    step = build_vote_step(mesh, R, 16)
+    # Candidate 0 with empty log, term 3.
+    cand = pack_cand(R, cand_idx=0, cand_term=3, last_idx=0, last_term=0)
+    _, grants, elected = step(vote_state, devlog.offs, devlog.meta, cand)
+    g = list(np.asarray(grants))
+    assert g[3] == 0             # refused: our last term 2 > cand's 0
+    assert g[0] == 1 and g[1] == 1 and g[2] == 1
+    assert bool(elected)         # 3 of 4 still a majority
+
+
+def test_hb_round_broadcast_and_staleness():
+    R = 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    hb_state = jax.device_put(np.zeros((R, 2), np.int32), sh)
+    step = build_hb_step(mesh, R)
+    beat = jnp.asarray([1, 3, 7], jnp.int32)      # leader 1, term 3, count 7
+    hb_state, fresh = step(hb_state, beat)
+    assert list(np.asarray(fresh)) == [1, 1, 1, 1]
+    hs = np.asarray(hb_state)
+    assert (hs[:, HB_TERM] == 3).all() and (hs[:, HB_COUNT] == 7).all()
+    # Replaying the same beat is stale everywhere.
+    hb_state, fresh = step(hb_state, beat)
+    assert list(np.asarray(fresh)) == [0, 0, 0, 0]
+    # A newer counter is fresh again.
+    hb_state, fresh = step(hb_state, jnp.asarray([1, 3, 8], jnp.int32))
+    assert list(np.asarray(fresh)) == [1, 1, 1, 1]
+
+
+def test_vote_round_idempotent_retry():
+    """A retried round for the same (candidate, term) re-grants
+    (Raft: votedFor == candidate at equal term)."""
+    R = 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, n_slots=16, slot_bytes=8, batch=8,
+                             sharding=sh)
+    vote_state = jax.device_put(np.zeros((R, 3), np.int32), sh)
+    step = build_vote_step(mesh, R, 16)
+    cand = pack_cand(R, cand_idx=2, cand_term=4, last_idx=0, last_term=0)
+    vote_state, grants, elected = step(vote_state, devlog.offs, devlog.meta,
+                                       cand)
+    assert bool(elected)
+    # Retry the identical round: must elect again, not deadlock.
+    vote_state, grants, elected = step(vote_state, devlog.offs, devlog.meta,
+                                       cand)
+    assert bool(elected)
+    assert list(np.asarray(grants)) == [1, 1, 1, 1]
