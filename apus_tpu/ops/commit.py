@@ -92,7 +92,7 @@ class CommitControl:
 
 
 def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
-                 *, block: int, batch: int, n_slots: int):
+                 *, batch: int, n_slots: int):
     """Per-shard body.  Shapes: log_data [K,S+B,SB], log_meta [K,S+B,6],
     offs [K,4], fence [K,2], bdata [K,B,SB], bmeta [K,B,4].
 
@@ -195,10 +195,7 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     if n_slots % batch != 0:
         raise ValueError(f"n_slots ({n_slots}) must be a multiple of "
                          f"batch ({batch})")
-    block = n_replicas // axis_size
-
-    body = functools.partial(_commit_body, block=block, batch=batch,
-                             n_slots=n_slots)
+    body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
     sharded = P(REPLICA_AXIS)
     repl = P()
     ctrl_specs = CommitControl(*([repl] * 7))

@@ -31,8 +31,7 @@ VS_TERM, VS_FOR, VS_FENCE = range(3)
 HB_TERM, HB_COUNT = range(2)
 
 
-def _vote_body(vote_state, offs, log_meta, cand, *, block: int,
-               n_slots: int):
+def _vote_body(vote_state, offs, log_meta, cand, *, n_slots: int):
     """One vote round.  ``cand`` = [cand_idx, cand_term, cand_last_idx,
     cand_last_term, q_old, q_new] replicated i32[6] packed with the
     membership masks appended: full layout [6 + 2R].
@@ -90,7 +89,7 @@ def _vote_body(vote_state, offs, log_meta, cand, *, block: int,
     return vote_state, grants, elected
 
 
-def _hb_body(hb_state, beat, *, block: int):
+def _hb_body(hb_state, beat):
     """One heartbeat round.  ``beat`` = [leader_idx, term, counter] i32
     replicated.  The leader's beat fans out (pmax broadcast); each
     replica records the newest (term, counter) it has seen and reports
@@ -114,8 +113,7 @@ def _hb_body(hb_state, beat, *, block: int):
 def build_vote_step(mesh: Mesh, n_replicas: int, n_slots: int):
     axis = mesh.shape[REPLICA_AXIS]
     assert n_replicas % axis == 0
-    body = functools.partial(_vote_body, block=n_replicas // axis,
-                             n_slots=n_slots)
+    body = functools.partial(_vote_body, n_slots=n_slots)
     s, r = P(REPLICA_AXIS), P()
     fn = jax.shard_map(body, mesh=mesh, in_specs=(s, s, s, r),
                        out_specs=(s, r, r), check_vma=False)
@@ -125,7 +123,7 @@ def build_vote_step(mesh: Mesh, n_replicas: int, n_slots: int):
 def build_hb_step(mesh: Mesh, n_replicas: int):
     axis = mesh.shape[REPLICA_AXIS]
     assert n_replicas % axis == 0
-    body = functools.partial(_hb_body, block=n_replicas // axis)
+    body = _hb_body
     s, r = P(REPLICA_AXIS), P()
     fn = jax.shard_map(body, mesh=mesh, in_specs=(s, r), out_specs=(s, r),
                        check_vma=False)

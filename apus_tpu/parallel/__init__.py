@@ -1,6 +1,17 @@
-"""Transport abstraction, device-mesh helpers, and the in-process simulator."""
+"""Transport abstraction, DCN networking, and the in-process simulator.
 
-from apus_tpu.parallel.transport import Transport, Regions, WriteResult
-from apus_tpu.parallel.sim import Cluster, SimTransport
+Submodules import lazily: ``apus_tpu.parallel.sim``/``.net`` depend on
+``apus_tpu.core.node``, which itself imports ``apus_tpu.parallel.transport``
+— an eager import here would be circular.
+"""
+
+from apus_tpu.parallel.transport import Regions, Transport, WriteResult
 
 __all__ = ["Transport", "Regions", "WriteResult", "Cluster", "SimTransport"]
+
+
+def __getattr__(name):
+    if name in ("Cluster", "SimTransport"):
+        from apus_tpu.parallel import sim
+        return getattr(sim, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,340 @@
+"""DCN transport: one-sided ops over TCP between replica daemons.
+
+The reference's data plane is one-sided RDMA over per-peer RC queue pairs
+(dare_ibv_rc.c) and its control plane is UD + IB multicast
+(dare_ibv_ud.c).  On TPU pods the analogous host-side fabric is the data
+center network; this module is the initiator/target pair:
+
+- ``PeerServer`` — the passive target.  A listener thread accepts peer
+  connections; every request frame is applied to the local node's exposed
+  regions via apus_tpu.parallel.onesided (the "HCA DMA"), under the
+  daemon's node lock, and a response frame is returned.  The protocol
+  logic never runs here — exactly as the reference's followers are
+  passive on the replication path.
+- ``NetTransport`` — the initiator.  One lazily-connected TCP socket per
+  peer (the RC QP analog), blocking request/response with a short
+  timeout; any socket error marks the peer down for a backoff window and
+  surfaces as DROPPED/None, feeding the failure detector the way CTRL-QP
+  work-completion errors do (dare_ibv_rc.c:2747-2749).
+
+Locking model: the caller may pass ``yield_lock`` — the daemon's node
+lock.  The transport *releases it while blocked on the wire* and
+reacquires before returning, mirroring one-sided semantics (remote writes
+land in our regions while we wait) and preventing distributed deadlock
+between two daemons writing to each other simultaneously.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.node import Node
+from apus_tpu.core.sid import Sid
+from apus_tpu.parallel import onesided, wire
+from apus_tpu.parallel.transport import (LogState, Region, Transport,
+                                         WriteResult)
+
+_ST_OF_RESULT = {WriteResult.OK: wire.ST_OK,
+                 WriteResult.DROPPED: wire.ST_DROPPED,
+                 WriteResult.FENCED: wire.ST_FENCED}
+_RESULT_OF_ST = {v: k for k, v in _ST_OF_RESULT.items()}
+
+
+class PeerServer:
+    """Passive target endpoint exposing a node's regions to peers."""
+
+    def __init__(self, node_ref: Callable[[], Node], lock: threading.RLock,
+                 host: str = "127.0.0.1", port: int = 0,
+                 sock: Optional[socket.socket] = None,
+                 extra_ops: Optional[dict] = None, logger=None):
+        self._node_ref = node_ref
+        self._lock = lock
+        self._logger = logger
+        # extra_ops: op byte -> handler(body_reader) -> response payload
+        # (used by the runtime for JOIN / snapshot-fetch, which are
+        # two-sided control messages, not one-sided region ops).
+        self._extra_ops = extra_ops if extra_ops is not None else {}
+        if sock is not None:
+            self._sock = sock
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.addr = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def reserve(host: str = "127.0.0.1") -> socket.socket:
+        """Bind an ephemeral port now so a ClusterSpec can be built before
+        the servers start (the reference knows peers from nodes.cfg)."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"apus-peersrv-{self.addr[1]}", daemon=True)
+        t.start()
+        self._accept_thread = t
+
+    def stop(self) -> None:
+        """Kill the endpoint: listener AND every established connection —
+        a stopped replica must not serve or mutate anything afterwards
+        (crash-fault fidelity for kill-based tests)."""
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._stop.is_set():
+                    conn.close()
+                    continue
+                self._conns.add(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                req = wire.read_frame(conn)
+                if req is None or self._stop.is_set():
+                    return
+                conn.sendall(wire.frame(self._dispatch(req)))
+        except (OSError, ConnectionError, ValueError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: bytes) -> bytes:
+        r = wire.Reader(req)
+        op = r.u8()
+        try:
+            if op in self._extra_ops:
+                return self._extra_ops[op](r)
+            with self._lock:
+                return self._apply(op, r)
+        except Exception:
+            # Server-side protocol/codec bugs must be visible, not
+            # laundered into what the initiator sees as a network drop.
+            if self._logger is not None:
+                self._logger.exception("peer-server op %d failed", op)
+            else:
+                import traceback
+                traceback.print_exc()
+            return wire.u8(wire.ST_ERROR)
+
+    def _apply(self, op: int, r: wire.Reader) -> bytes:
+        node = self._node_ref()
+        if op == wire.OP_CTRL_WRITE:
+            region = wire.REGION_LIST[r.u8()]
+            slot = r.u8()
+            value = wire.decode_value(r)
+            res = onesided.apply_ctrl_write(node, region, slot, value)
+            return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_CTRL_READ:
+            region = wire.REGION_LIST[r.u8()]
+            slot = r.u8()
+            value = onesided.apply_ctrl_read(node, region, slot)
+            return wire.u8(wire.ST_OK) + wire.encode_value(value)
+        if op == wire.OP_LOG_WRITE:
+            writer = Sid.unpack(r.u64())
+            commit = r.u64()
+            entries = wire.decode_entries(r)
+            res = onesided.apply_log_write(node, writer, entries, commit)
+            return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_LOG_READ_STATE:
+            state = onesided.apply_log_read_state(node)
+            return wire.u8(wire.ST_OK) + wire.encode_log_state(state)
+        if op == wire.OP_LOG_SET_END:
+            writer = Sid.unpack(r.u64())
+            new_end = r.u64()
+            res = onesided.apply_log_set_end(node, writer, new_end)
+            return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_LOG_BULK_READ:
+            start, stop = r.u64(), r.u64()
+            entries = onesided.apply_log_bulk_read(node, start, stop)
+            return wire.u8(wire.ST_OK) + wire.encode_entries(entries)
+        return wire.u8(wire.ST_ERROR)
+
+
+class NetTransport(Transport):
+    """Initiator side: per-peer lazily-connected sockets with backoff."""
+
+    def __init__(self, peers: dict[int, tuple[str, int]],
+                 timeout: float = 0.2, backoff: float = 0.5,
+                 yield_lock: Optional[threading.RLock] = None):
+        self.peers = dict(peers)
+        self.timeout = timeout
+        self.backoff = backoff
+        self.yield_lock = yield_lock
+        self._conns: dict[int, socket.socket] = {}
+        self._down_until: dict[int, float] = {}
+        self._peer_locks: dict[int, threading.Lock] = {}
+
+    def set_peer(self, idx: int, addr: tuple[str, int]) -> None:
+        """Register/replace a peer endpoint (membership change)."""
+        self.peers[idx] = addr
+        self._drop_conn(idx)
+        self._down_until.pop(idx, None)
+
+    def close(self) -> None:
+        for idx in list(self._conns):
+            self._drop_conn(idx)
+
+    # -- connection management -------------------------------------------
+
+    def _peer_lock(self, target: int) -> threading.Lock:
+        lock = self._peer_locks.get(target)
+        if lock is None:
+            lock = self._peer_locks.setdefault(target, threading.Lock())
+        return lock
+
+    def _connect(self, target: int) -> Optional[socket.socket]:
+        conn = self._conns.get(target)
+        if conn is not None:
+            return conn
+        now = time.monotonic()
+        if now < self._down_until.get(target, 0.0):
+            return None
+        addr = self.peers.get(target)
+        if addr is None:
+            return None
+        try:
+            conn = socket.create_connection(addr, timeout=self.timeout)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(self.timeout)
+            self._conns[target] = conn
+            return conn
+        except OSError:
+            self._down_until[target] = now + self.backoff
+            return None
+
+    def _drop_conn(self, target: int) -> None:
+        conn = self._conns.pop(target, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _roundtrip(self, target: int, payload: bytes) -> Optional[bytes]:
+        """Send one request frame, await the response frame.  Releases
+        the daemon's node lock while blocked (see module docstring)."""
+        lock = self.yield_lock
+        depth = 0
+        if lock is not None:
+            # Fully release our recursion of the RLock while on the wire.
+            while lock._is_owned():            # type: ignore[attr-defined]
+                lock.release()
+                depth += 1
+        try:
+            with self._peer_lock(target):
+                conn = self._connect(target)
+                if conn is None:
+                    return None
+                try:
+                    conn.sendall(wire.frame(payload))
+                    resp = wire.read_frame(conn)
+                    if resp is None:
+                        raise ConnectionError("peer closed")
+                    return resp
+                except (OSError, ConnectionError, ValueError):
+                    self._drop_conn(target)
+                    self._down_until[target] = \
+                        time.monotonic() + self.backoff
+                    return None
+        finally:
+            for _ in range(depth):
+                lock.acquire()     # type: ignore[union-attr]
+
+    # -- one-sided ops ----------------------------------------------------
+
+    def ctrl_write(self, target: int, region: Region, slot: int,
+                   value: Any) -> WriteResult:
+        payload = (wire.u8(wire.OP_CTRL_WRITE)
+                   + wire.u8(wire.REGION_INDEX[region]) + wire.u8(slot)
+                   + wire.encode_value(value))
+        resp = self._roundtrip(target, payload)
+        if resp is None:
+            return WriteResult.DROPPED
+        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+
+    def ctrl_read(self, target: int, region: Region, slot: int) -> Any:
+        payload = (wire.u8(wire.OP_CTRL_READ)
+                   + wire.u8(wire.REGION_INDEX[region]) + wire.u8(slot))
+        resp = self._roundtrip(target, payload)
+        if resp is None or resp[0] != wire.ST_OK:
+            return None
+        return wire.decode_value(wire.Reader(resp[1:]))
+
+    def log_write(self, target: int, writer_sid: Sid,
+                  entries: list[LogEntry], commit: int) -> WriteResult:
+        payload = (wire.u8(wire.OP_LOG_WRITE) + wire.u64(writer_sid.word)
+                   + wire.u64(commit) + wire.encode_entries(entries))
+        resp = self._roundtrip(target, payload)
+        if resp is None:
+            return WriteResult.DROPPED
+        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+
+    def log_read_state(self, target: int) -> Optional[LogState]:
+        resp = self._roundtrip(target, wire.u8(wire.OP_LOG_READ_STATE))
+        if resp is None or resp[0] != wire.ST_OK:
+            return None
+        return wire.decode_log_state(wire.Reader(resp[1:]))
+
+    def log_set_end(self, target: int, writer_sid: Sid,
+                    new_end: int) -> WriteResult:
+        payload = (wire.u8(wire.OP_LOG_SET_END) + wire.u64(writer_sid.word)
+                   + wire.u64(new_end))
+        resp = self._roundtrip(target, payload)
+        if resp is None:
+            return WriteResult.DROPPED
+        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+
+    def log_bulk_read(self, target: int, start: int,
+                      stop: int) -> Optional[list[LogEntry]]:
+        payload = (wire.u8(wire.OP_LOG_BULK_READ) + wire.u64(start)
+                   + wire.u64(stop))
+        resp = self._roundtrip(target, payload)
+        if resp is None or resp[0] != wire.ST_OK:
+            return None
+        return wire.decode_entries(wire.Reader(resp[1:]))
+
+    # -- generic request (two-sided control messages: join, snapshots) ----
+
+    def request(self, target: int, payload: bytes) -> Optional[bytes]:
+        return self._roundtrip(target, payload)

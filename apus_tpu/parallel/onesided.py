@@ -1,0 +1,77 @@
+"""Passive-target semantics of one-sided operations.
+
+In the reference, the target CPU is not involved in one-sided accesses:
+the HCA DMAs directly into the exposed ctrl/log regions
+(update_remote_logs dare_ibv_rc.c:1460-1644, hb/vote writes throughout).
+The *semantics* of those accesses — fence checks via QP state, idempotent
+entry placement, commit clamping — live partly in hardware (QP
+RESET/RTS) and partly in careful protocol layout.
+
+Here those semantics are ONE shared module applied by every backend's
+target side: the deterministic simulator (apus_tpu.parallel.sim) and the
+DCN peer server (apus_tpu.parallel.net) call these functions so a log
+write behaves bit-identically under test and in production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.node import Node
+from apus_tpu.core.sid import Sid
+from apus_tpu.parallel.transport import LogState, Region, WriteResult
+
+
+def apply_ctrl_write(node: Node, region: Region, slot: int,
+                     value: Any) -> WriteResult:
+    """Deposit a value in a control slot (ctrl_data_t write)."""
+    node.regions.ctrl[region][slot] = value
+    return WriteResult.OK
+
+
+def apply_ctrl_read(node: Node, region: Region, slot: int) -> Any:
+    return node.regions.ctrl[region][slot]
+
+
+def apply_log_write(node: Node, writer_sid: Sid, entries: list[LogEntry],
+                    commit: int) -> WriteResult:
+    """Leader's one-sided log write into a follower (update_remote_logs
+    analog): fence-checked, idempotent for already-present entries,
+    stops at the first non-contiguous index (the leader re-adjusts)."""
+    if not node.regions.log_write_allowed(writer_sid):
+        return WriteResult.FENCED
+    for e in entries:
+        if e.idx < node.log.end:
+            continue              # idempotent re-write
+        if e.idx > node.log.end:
+            break                 # non-contiguous: stop
+        if node.log.is_full:
+            break
+        node.log.write(dataclasses.replace(e))
+    node.log.advance_commit(min(commit, node.log.end))
+    return WriteResult.OK
+
+
+def apply_log_read_state(node: Node) -> LogState:
+    log = node.log
+    return LogState(commit=log.commit, end=log.end,
+                    nc_determinants=log.nc_determinants())
+
+
+def apply_log_set_end(node: Node, writer_sid: Sid,
+                      new_end: int) -> WriteResult:
+    if not node.regions.log_write_allowed(writer_sid):
+        return WriteResult.FENCED
+    # Fail fast on new_end < commit: the adjustment algorithm never asks a
+    # follower to truncate committed entries (NC determinants start at
+    # commit, dare_log.h:339-359) — reaching here is a protocol bug that
+    # must surface loudly, not be clamped away.
+    node.log.truncate(new_end)
+    return WriteResult.OK
+
+
+def apply_log_bulk_read(node: Node, start: int,
+                        stop: int) -> list[LogEntry]:
+    return [dataclasses.replace(e) for e in node.log.entries(start, stop)]

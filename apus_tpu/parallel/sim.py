@@ -33,6 +33,7 @@ from apus_tpu.core.node import Node, NodeConfig
 from apus_tpu.core.sid import Sid
 from apus_tpu.core.types import Role
 from apus_tpu.models.sm import RecordingStateMachine, StateMachine
+from apus_tpu.parallel import onesided
 from apus_tpu.parallel.transport import (LogState, Region, Transport,
                                          WriteResult)
 
@@ -78,53 +79,38 @@ class SimTransport(Transport):
                    value) -> WriteResult:
         if not self._reachable(target):
             return WriteResult.DROPPED
-        self.nodes[target].regions.ctrl[region][slot] = value
-        return WriteResult.OK
+        return onesided.apply_ctrl_write(self.nodes[target], region, slot,
+                                         value)
 
     def ctrl_read(self, target: int, region: Region, slot: int):
         if not self._reachable(target):
             return None
-        return self.nodes[target].regions.ctrl[region][slot]
+        return onesided.apply_ctrl_read(self.nodes[target], region, slot)
 
     def log_write(self, target: int, writer_sid: Sid,
                   entries: list[LogEntry], commit: int) -> WriteResult:
         if not self._reachable(target):
             return WriteResult.DROPPED
-        tgt = self.nodes[target]
-        if not tgt.regions.log_write_allowed(writer_sid):
-            return WriteResult.FENCED
-        for e in entries:
-            if e.idx < tgt.log.end:
-                continue              # idempotent re-write
-            if e.idx > tgt.log.end:
-                break                 # non-contiguous: stop (leader re-adjusts)
-            tgt.log.write(dataclasses.replace(e))
-        tgt.log.advance_commit(min(commit, tgt.log.end))
-        return WriteResult.OK
+        return onesided.apply_log_write(self.nodes[target], writer_sid,
+                                        entries, commit)
 
     def log_read_state(self, target: int) -> Optional[LogState]:
         if not self._reachable(target):
             return None
-        log = self.nodes[target].log
-        return LogState(commit=log.commit, end=log.end,
-                        nc_determinants=log.nc_determinants())
+        return onesided.apply_log_read_state(self.nodes[target])
 
     def log_set_end(self, target: int, writer_sid: Sid,
                     new_end: int) -> WriteResult:
         if not self._reachable(target):
             return WriteResult.DROPPED
-        tgt = self.nodes[target]
-        if not tgt.regions.log_write_allowed(writer_sid):
-            return WriteResult.FENCED
-        tgt.log.truncate(new_end)
-        return WriteResult.OK
+        return onesided.apply_log_set_end(self.nodes[target], writer_sid,
+                                          new_end)
 
     def log_bulk_read(self, target: int, start: int,
                       stop: int) -> Optional[list[LogEntry]]:
         if not self._reachable(target):
             return None
-        log = self.nodes[target].log
-        return [dataclasses.replace(e) for e in log.entries(start, stop)]
+        return onesided.apply_log_bulk_read(self.nodes[target], start, stop)
 
 
 class Cluster:

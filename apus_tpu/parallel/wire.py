@@ -1,0 +1,245 @@
+"""Binary wire codec for the DCN control plane.
+
+The reference's control plane rides raw IB messages with packed C structs
+(ud_hdr_t / rc_syn_t / client_req_t, dare_ibv_ud.h:29-81) and its data
+plane writes raw log bytes.  Our DCN analog speaks a compact framed
+binary protocol over TCP sockets: every message is ``u32 length`` +
+payload, with fixed little-endian struct layouts below.  The same layouts
+are shared by the native C++ proxy (native/apus_wire.h) so host tools and
+the Python runtime interoperate.
+
+Struct layouts (little endian):
+
+    Cid        = epoch:u32 state:u8 size:u8 new_size:u8 bitmask:u16
+    LogEntry   = idx:u64 term:u64 req_id:u64 clt_id:u32 type:u8 head:u64
+                 flags:u8 [cid if flags&1] dlen:u32 data
+    VoteReq    = sid:u64 last_idx:u64 last_term:u64 epoch:u32
+    Snapshot   = last_idx:u64 last_term:u64 dlen:u32 data
+
+One-sided RPC requests are ``op:u8`` + body; responses are ``status:u8``
++ body (see OP_* / ST_* constants).  Control-slot values are a tagged
+variant (VAR_*).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.core.election import VoteRequest
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.types import EntryType
+from apus_tpu.models.sm import Snapshot
+from apus_tpu.parallel.transport import LogState, Region
+
+# -- ops (initiator -> target) -------------------------------------------
+OP_CTRL_WRITE = 1
+OP_CTRL_READ = 2
+OP_LOG_WRITE = 3
+OP_LOG_READ_STATE = 4
+OP_LOG_SET_END = 5
+OP_LOG_BULK_READ = 6
+OP_JOIN = 7          # membership join request (ud_join_cluster analog)
+OP_SNAP_FETCH = 8    # snapshot fetch for recovery (rc_recover_sm analog)
+
+# -- response status ------------------------------------------------------
+ST_OK = 0
+ST_DROPPED = 1
+ST_FENCED = 2
+ST_ERROR = 3
+
+# -- ctrl value variants --------------------------------------------------
+VAR_NONE = 0
+VAR_U64 = 1
+VAR_VOTEREQ = 2
+VAR_BYTES = 3
+VAR_SNAPSHOT = 4
+
+# Stable region indices for the wire (Region is a str enum).
+REGION_LIST = list(Region)
+REGION_INDEX = {r: i for i, r in enumerate(REGION_LIST)}
+
+_CID = struct.Struct("<IBBBH")
+_ENTRY_FIXED = struct.Struct("<QQQIBQB")
+_VOTEREQ = struct.Struct("<QQQI")
+_SNAP_FIXED = struct.Struct("<QQI")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class Reader:
+    """Cursor over a bytes buffer."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise ValueError("short buffer")
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u32(self) -> int:
+        return _U32.unpack(self.take(4))[0]
+
+    def u64(self) -> int:
+        return _U64.unpack(self.take(8))[0]
+
+    def blob(self) -> bytes:
+        return self.take(self.u32())
+
+    @property
+    def remaining(self) -> int:
+        return len(self.buf) - self.pos
+
+
+def u8(v: int) -> bytes:
+    return bytes([v])
+
+
+def u32(v: int) -> bytes:
+    return _U32.pack(v)
+
+
+def u64(v: int) -> bytes:
+    return _U64.pack(v)
+
+
+def blob(b: bytes) -> bytes:
+    return _U32.pack(len(b)) + b
+
+
+# -- Cid ------------------------------------------------------------------
+
+def encode_cid(c: Cid) -> bytes:
+    return _CID.pack(c.epoch, int(c.state), c.size, c.new_size, c.bitmask)
+
+
+def decode_cid(r: Reader) -> Cid:
+    epoch, state, size, new_size, bitmask = _CID.unpack(r.take(_CID.size))
+    return Cid(epoch=epoch, state=CidState(state), size=size,
+               new_size=new_size, bitmask=bitmask)
+
+
+# -- LogEntry -------------------------------------------------------------
+
+def encode_entry(e: LogEntry) -> bytes:
+    flags = 1 if e.cid is not None else 0
+    out = [_ENTRY_FIXED.pack(e.idx, e.term, e.req_id, e.clt_id,
+                             int(e.type), e.head, flags)]
+    if e.cid is not None:
+        out.append(encode_cid(e.cid))
+    out.append(blob(e.data))
+    return b"".join(out)
+
+
+def decode_entry(r: Reader) -> LogEntry:
+    idx, term, req_id, clt_id, etype, head, flags = \
+        _ENTRY_FIXED.unpack(r.take(_ENTRY_FIXED.size))
+    cid = decode_cid(r) if flags & 1 else None
+    data = r.blob()
+    return LogEntry(idx=idx, term=term, req_id=req_id, clt_id=clt_id,
+                    type=EntryType(etype), head=head, cid=cid, data=data)
+
+
+def encode_entries(entries: list[LogEntry]) -> bytes:
+    return struct.pack("<H", len(entries)) + \
+        b"".join(encode_entry(e) for e in entries)
+
+
+def decode_entries(r: Reader) -> list[LogEntry]:
+    n = struct.unpack("<H", r.take(2))[0]
+    return [decode_entry(r) for _ in range(n)]
+
+
+# -- ctrl variants --------------------------------------------------------
+
+def encode_value(v: Any) -> bytes:
+    if v is None:
+        return u8(VAR_NONE)
+    if isinstance(v, int):
+        return u8(VAR_U64) + u64(v)
+    if isinstance(v, VoteRequest):
+        return u8(VAR_VOTEREQ) + _VOTEREQ.pack(v.sid_word, v.last_idx,
+                                               v.last_term, v.cid_epoch)
+    if isinstance(v, bytes):
+        return u8(VAR_BYTES) + blob(v)
+    if isinstance(v, Snapshot):
+        return u8(VAR_SNAPSHOT) + _SNAP_FIXED.pack(
+            v.last_idx, v.last_term, len(v.data)) + v.data
+    raise TypeError(f"unencodable ctrl value {type(v)}")
+
+
+def decode_value(r: Reader) -> Any:
+    tag = r.u8()
+    if tag == VAR_NONE:
+        return None
+    if tag == VAR_U64:
+        return r.u64()
+    if tag == VAR_VOTEREQ:
+        sid, li, lt, ep = _VOTEREQ.unpack(r.take(_VOTEREQ.size))
+        return VoteRequest(sid_word=sid, last_idx=li, last_term=lt,
+                           cid_epoch=ep)
+    if tag == VAR_BYTES:
+        return r.blob()
+    if tag == VAR_SNAPSHOT:
+        li, lt, n = _SNAP_FIXED.unpack(r.take(_SNAP_FIXED.size))
+        return Snapshot(li, lt, r.take(n))
+    raise ValueError(f"bad variant tag {tag}")
+
+
+# -- log state ------------------------------------------------------------
+
+def encode_log_state(s: LogState) -> bytes:
+    out = [u64(s.commit), u64(s.end), struct.pack("<H", len(s.nc_determinants))]
+    for idx, term in s.nc_determinants:
+        out.append(u64(idx))
+        out.append(u64(term))
+    return b"".join(out)
+
+
+def decode_log_state(r: Reader) -> LogState:
+    commit, end = r.u64(), r.u64()
+    n = struct.unpack("<H", r.take(2))[0]
+    nc = [(r.u64(), r.u64()) for _ in range(n)]
+    return LogState(commit=commit, end=end, nc_determinants=nc)
+
+
+# -- framing --------------------------------------------------------------
+
+def frame(payload: bytes) -> bytes:
+    return _U32.pack(len(payload)) + payload
+
+
+def read_frame(sock) -> Optional[bytes]:
+    """Read one length-prefixed frame; None on clean EOF."""
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _U32.unpack(hdr)
+    if n > 1 << 27:          # 128 MB sanity cap
+        raise ValueError(f"oversized frame {n}")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("truncated frame")
+    return body
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(n - got)
+        if not c:
+            if got == 0:
+                return None
+            raise ConnectionError("truncated frame")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)

@@ -1,0 +1,12 @@
+"""Host runtime: replica daemons, client service, proxy bridge.
+
+This package is the live counterpart of the reference's in-process DARE
+thread (proxy.c:76-81 spawns dare_server_init): each replica runs a
+``ReplicaDaemon`` that ticks the pure protocol Node over a DCN
+NetTransport, persists committed records, serves client sessions, and
+feeds the native proxy/interposer pair.
+"""
+
+from apus_tpu.runtime.daemon import ReplicaDaemon
+
+__all__ = ["ReplicaDaemon"]

@@ -1,0 +1,126 @@
+"""LocalCluster: N in-process replica daemons on loopback.
+
+The live-network analog of the simulator's Cluster (apus_tpu.parallel.sim)
+and of the reference's ssh-launched groups (benchmarks/run.sh:23-31): it
+reserves loopback ports, builds one shared ClusterSpec (nodes.cfg
+analog), and runs each replica's daemon with real TCP between them.
+Used by the end-to-end tests and the benchmark harness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+from apus_tpu.models.kvs import KvsStateMachine
+from apus_tpu.models.sm import StateMachine
+from apus_tpu.parallel.net import PeerServer
+from apus_tpu.runtime.daemon import ReplicaDaemon
+from apus_tpu.utils.config import ClusterSpec
+
+
+class LocalCluster:
+    def __init__(self, n: int, spec: Optional[ClusterSpec] = None,
+                 sm_factory: Callable[[], StateMachine] = KvsStateMachine,
+                 daemon_cls=ReplicaDaemon, seed: int = 0, **daemon_kwargs):
+        self.n = n
+        self.sm_factory = sm_factory
+        self.daemon_cls = daemon_cls
+        # Reserve ports first so every daemon knows all peers up front.
+        socks = [PeerServer.reserve() for _ in range(n)]
+        peers = [f"{s.getsockname()[0]}:{s.getsockname()[1]}" for s in socks]
+        base = spec or ClusterSpec(
+            hb_period=0.005, hb_timeout=0.030,
+            elect_low=0.050, elect_high=0.150)
+        self.spec = dataclasses.replace(base, group_size=n, peers=peers)
+        self.daemons: list[Optional[ReplicaDaemon]] = [
+            daemon_cls(i, self.spec, sm=sm_factory(), listen_sock=socks[i],
+                       seed=seed, **daemon_kwargs)
+            for i in range(n)
+        ]
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for d in self.daemons:
+            if d is not None:
+                d.start()
+
+    def stop(self) -> None:
+        for d in self.daemons:
+            if d is not None:
+                d.stop()
+
+    def __enter__(self) -> "LocalCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- queries ----------------------------------------------------------
+
+    def live(self) -> list[ReplicaDaemon]:
+        return [d for d in self.daemons if d is not None]
+
+    def leader(self) -> Optional[ReplicaDaemon]:
+        leaders = [d for d in self.live() if d.is_leader]
+        if not leaders:
+            return None
+        return max(leaders, key=lambda d: d.term)
+
+    def wait_for_leader(self, timeout: float = 15.0) -> ReplicaDaemon:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            # A stable leader: exactly one live daemon claims leadership.
+            leaders = [d for d in self.live() if d.is_leader]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.005)
+        raise AssertionError("no stable leader within timeout")
+
+    # -- client ops -------------------------------------------------------
+
+    _seq = 0
+
+    def submit(self, data: bytes, timeout: float = 10.0,
+               clt_id: int = 0):
+        """Submit to the current leader, retrying across elections."""
+        LocalCluster._seq += 1
+        req_id = LocalCluster._seq
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leader = self.wait_for_leader(deadline - time.monotonic())
+            pr = leader.submit(req_id, clt_id, data)
+            if pr is not None and leader.wait_committed(
+                    pr, min(2.0, deadline - time.monotonic())):
+                return leader, pr
+        raise AssertionError(f"request not committed within {timeout}s")
+
+    # -- fault injection --------------------------------------------------
+
+    def kill(self, idx: int) -> None:
+        d = self.daemons[idx]
+        if d is not None:
+            d.stop()
+            self.daemons[idx] = None
+
+    # -- invariants -------------------------------------------------------
+
+    def check_logs_consistent(self) -> None:
+        nodes = [d.node for d in self.live()]
+        with_locks = [d.lock for d in self.live()]
+        for lock in with_locks:
+            lock.acquire()
+        try:
+            for node in nodes:
+                node.log.check()
+            min_commit = min(n.log.commit for n in nodes)
+            for i in range(1, min_commit):
+                dets = {n.log.get(i).determinant() for n in nodes
+                        if n.log.head <= i < n.log.commit}
+                assert len(dets) <= 1, f"divergent committed idx {i}: {dets}"
+        finally:
+            for lock in with_locks:
+                lock.release()

@@ -1,0 +1,173 @@
+"""ReplicaDaemon: one live replica — protocol thread + peer server.
+
+The reference runs consensus as a thread inside the application process
+(proxy.c:76-81 -> dare_server_init -> ev_run, dare_server.c:173-238).
+Our TPU-era split keeps the application untouched and runs consensus in a
+separate daemon process per replica; the native proxy talks to it over a
+unix socket + shared-memory commit counter (apus_tpu.runtime.bridge).
+
+The daemon owns:
+- the pure protocol ``Node`` (apus_tpu.core.node), ticked by a dedicated
+  thread at sub-millisecond cadence (the libev loop analog,
+  dare_server.c:216-238);
+- a ``PeerServer`` exposing its regions/log to peers (the registered MRs);
+- a ``NetTransport`` for its own one-sided ops to peers (the QPs);
+- committed-entry upcalls: persistence + replay/release callbacks (the
+  proxy callback table analog, dare_sm.h:42-47).
+
+Thread-safety: a single RLock guards the node.  The tick thread holds it
+for each tick but the transport releases it while blocked on the wire
+(see apus_tpu.parallel.net docstring); peer-server handlers and client
+submits take it for their short critical sections.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.node import Node, NodeConfig, PendingRequest
+from apus_tpu.models.sm import StateMachine
+from apus_tpu.models.kvs import KvsStateMachine
+from apus_tpu.parallel.net import NetTransport, PeerServer
+from apus_tpu.utils.config import ClusterSpec
+from apus_tpu.utils.debug import make_logger
+
+
+def _parse_peer(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class ReplicaDaemon:
+    """One replica of the group, live on the network."""
+
+    def __init__(self, idx: int, spec: ClusterSpec,
+                 sm: Optional[StateMachine] = None,
+                 cid: Optional[Cid] = None,
+                 listen_sock=None,
+                 tick_interval: float = 0.0005,
+                 log_file: Optional[str] = None,
+                 seed: int = 0):
+        self.idx = idx
+        self.spec = spec
+        self.lock = threading.RLock()
+        self.logger = make_logger(f"apus.srv{idx}", log_file)
+        self._tick_interval = tick_interval
+
+        peers = {i: _parse_peer(a) for i, a in enumerate(spec.peers)}
+        self.transport = NetTransport(peers, yield_lock=self.lock)
+        cfg = NodeConfig(
+            idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
+            hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
+            elect_high=spec.elect_high, prune_period=spec.prune_period,
+            max_batch=spec.max_batch, seed=seed)
+        self.node = Node(cfg, cid or Cid.initial(spec.group_size),
+                         sm or KvsStateMachine(), self.transport)
+        # Fresh-start grace: randomize the first election timeout so a
+        # cold cluster elects cleanly (dare_server.c:1237).
+        self.node._last_hb_seen = (time.monotonic()
+                                   + self.node.rng.random()
+                                   * self.node.cfg.elect_high)
+
+        host, port = peers.get(idx, ("127.0.0.1", 0))
+        self.server = PeerServer(lambda: self.node, self.lock,
+                                 host=host, port=port, sock=listen_sock,
+                                 extra_ops=self._extra_ops(),
+                                 logger=self.logger)
+
+        # Committed-entry observers (proxy callback table analog):
+        # each gets (LogEntry); registered by persistence/replay layers.
+        self.on_commit: list[Callable[[LogEntry], None]] = []
+
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._last_role = None
+
+    # -- extra (two-sided) control ops: filled in by runtime layers -------
+
+    def _extra_ops(self) -> dict:
+        return {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        t = threading.Thread(target=self._run, name=f"apus-tick-{self.idx}",
+                             daemon=True)
+        t.start()
+        self._tick_thread = t
+        self.logger.info("daemon %d up at %s", self.idx, self.server.addr)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=2.0)
+        self.server.stop()
+        self.transport.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self.lock:
+                self.node.tick(time.monotonic())
+                self._drain_upcalls()
+                self._log_role_changes()
+            time.sleep(self._tick_interval)
+
+    def _drain_upcalls(self) -> None:
+        if not self.node.committed_upcalls:
+            return
+        entries, self.node.committed_upcalls = \
+            self.node.committed_upcalls, []
+        for e in entries:
+            for cb in self.on_commit:
+                cb(e)
+
+    def _log_role_changes(self) -> None:
+        role = (self.node.role, self.node.current_term)
+        if role != self._last_role:
+            self._last_role = role
+            # Leader banner greppable by ops tooling, matching the
+            # "[T<term>] LEADER" lines run.sh greps (run.sh:46-68).
+            if self.node.is_leader:
+                self.logger.info("[T%d] LEADER", self.node.current_term)
+            else:
+                self.logger.info("[T%d] %s", self.node.current_term,
+                                 self.node.role.name)
+
+    # -- client-facing API ------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node.is_leader
+
+    @property
+    def term(self) -> int:
+        return self.node.current_term
+
+    @property
+    def leader_hint(self) -> Optional[int]:
+        return self.node.leader_hint
+
+    def submit(self, req_id: int, clt_id: int,
+               data: bytes) -> Optional[PendingRequest]:
+        with self.lock:
+            return self.node.submit(req_id, clt_id, data)
+
+    def wait_committed(self, pr: PendingRequest,
+                       timeout: float = 5.0) -> bool:
+        """Block until the request commits (the proxy spin-wait analog,
+        proxy.c:160 — but sleeping, since we're not inside the app's
+        read() here; the native proxy does the true spin on shm)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if pr.idx is not None and self.node.log.commit > pr.idx:
+                    return True
+                if not self.node.is_leader:
+                    return False      # lost leadership: client must retry
+            time.sleep(0.0002)
+        return False

@@ -1,0 +1,55 @@
+"""Wire codec roundtrips (apus_tpu.parallel.wire)."""
+
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.core.election import VoteRequest
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.types import EntryType
+from apus_tpu.models.sm import Snapshot
+from apus_tpu.parallel import wire
+from apus_tpu.parallel.transport import LogState, Region
+
+
+def rt_value(v):
+    return wire.decode_value(wire.Reader(wire.encode_value(v)))
+
+
+def test_value_variants():
+    assert rt_value(None) is None
+    assert rt_value(0) == 0
+    assert rt_value(1 << 62) == 1 << 62
+    assert rt_value(b"hello\x00world") == b"hello\x00world"
+    vr = VoteRequest(sid_word=12345, last_idx=7, last_term=3, cid_epoch=2)
+    assert rt_value(vr) == vr
+    snap = Snapshot(last_idx=9, last_term=4, data=b"\x01" * 100)
+    out = rt_value(snap)
+    assert (out.last_idx, out.last_term, out.data) == (9, 4, snap.data)
+
+
+def test_entry_roundtrip():
+    cid = Cid(epoch=3, state=CidState.TRANSIT, size=3, new_size=5,
+              bitmask=0b10111)
+    for e in [
+        LogEntry(idx=1, term=1, type=EntryType.NOOP),
+        LogEntry(idx=2, term=1, req_id=9, clt_id=4, data=b"x" * 1000),
+        LogEntry(idx=3, term=2, type=EntryType.CONFIG, cid=cid),
+        LogEntry(idx=4, term=2, type=EntryType.HEAD, head=2),
+    ]:
+        out = wire.decode_entry(wire.Reader(wire.encode_entry(e)))
+        assert out == e
+
+    batch = [LogEntry(idx=i, term=1, data=bytes([i])) for i in range(1, 20)]
+    out = wire.decode_entries(wire.Reader(wire.encode_entries(batch)))
+    assert out == batch
+
+
+def test_log_state_roundtrip():
+    s = LogState(commit=5, end=9, nc_determinants=[(5, 1), (6, 2), (7, 2),
+                                                   (8, 3)])
+    out = wire.decode_log_state(wire.Reader(wire.encode_log_state(s)))
+    assert out == s
+
+
+def test_region_indices_stable():
+    # The wire indexes regions positionally; adding regions must append.
+    assert wire.REGION_LIST[0] == Region.VOTE_REQ
+    assert wire.REGION_INDEX[Region.HB] == 2
