@@ -1,0 +1,98 @@
+"""Client-endpoint database: dedup + pending linearizable reads.
+
+Parity with the reference's ep_db (dare_ep_db.c, dare_ep_db.h:20-46):
+an rbtree of non-member endpoints keyed by LID, deduplicating join and
+client requests via ``last_req_id``/``committed`` and holding pending
+linearizable reads (``wait_for_idx``) that are answered only after the
+commit index passes the registration point AND leadership has been
+re-verified (ep_dp_reply_read_req dare_ep_db.c:132-161,
+rc_verify_leadership dare_ibv_rc.c:1182-1280).
+
+Redesign notes:
+- keyed by ``clt_id`` (a stable client/session id) rather than IB LID;
+- dedup state is *derived from the replicated log* on apply, so a new
+  leader reconstructs it and client retries stay exactly-once across
+  failovers (the reference gets this implicitly because commands carry
+  ``req_id``/``clt_id`` in the log entry, dare_log.h:38-40);
+- the last committed reply is cached per endpoint so a duplicate of an
+  already-committed request is answered without re-executing it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """One client endpoint (dare_ep_t analog, dare_ep_db.h:20-31)."""
+
+    clt_id: int
+    last_req_id: int = 0          # highest req_id APPLIED for this client
+    last_idx: int = 0             # log index of that request
+    last_reply: Optional[bytes] = None
+    # join-request dedup (used by the membership service)
+    committed: bool = False
+
+
+@dataclasses.dataclass
+class PendingRead:
+    """A registered linearizable read (wait_for_idx analog)."""
+
+    clt_id: int
+    req_id: int
+    data: bytes
+    wait_idx: int                 # answer only once apply >= wait_idx
+    registered_at: float = 0.0    # tick clock at registration
+    done: bool = False
+    error: bool = False           # query raised: answered as an error
+    reply: Optional[bytes] = None
+
+
+class EndpointDB:
+    """In-memory endpoint table (std dict replaces the kernel rbtree the
+    reference vendors, utils/rbtree/)."""
+
+    def __init__(self) -> None:
+        self._eps: dict[int, Endpoint] = {}
+
+    def search(self, clt_id: int) -> Optional[Endpoint]:
+        return self._eps.get(clt_id)
+
+    def insert(self, clt_id: int) -> Endpoint:
+        ep = self._eps.get(clt_id)
+        if ep is None:
+            ep = Endpoint(clt_id)
+            self._eps[clt_id] = ep
+        return ep
+
+    def erase(self, clt_id: int) -> None:
+        self._eps.pop(clt_id, None)
+
+    def __len__(self) -> int:
+        return len(self._eps)
+
+    # -- write dedup ------------------------------------------------------
+
+    def duplicate_of_applied(self, clt_id: int,
+                             req_id: int) -> Optional[Endpoint]:
+        """If (clt_id, req_id) was already applied, return the endpoint
+        (whose cached reply answers the duplicate); else None.  Client
+        req_ids are per-client monotone, as in the reference
+        (handle_server_join_request dedup, dare_ibv_ud.c:988-1006)."""
+        ep = self._eps.get(clt_id)
+        if ep is not None and req_id <= ep.last_req_id:
+            return ep
+        return None
+
+    def note_applied(self, clt_id: int, req_id: int, idx: int,
+                     reply: Optional[bytes]) -> None:
+        """Record an applied request (called from the apply path, so every
+        replica — and any future leader — has identical dedup state)."""
+        ep = self.insert(clt_id)
+        if req_id >= ep.last_req_id:
+            ep.last_req_id = req_id
+            ep.last_idx = idx
+            ep.last_reply = reply
+            ep.committed = True

@@ -31,6 +31,7 @@ from typing import Optional
 from apus_tpu.core.cid import Cid, CidState
 from apus_tpu.core.election import (VoteRequest, best_vote_request,
                                     random_election_timeout, should_grant)
+from apus_tpu.core.epdb import EndpointDB, PendingRead
 from apus_tpu.core.log import LogEntry, SlotLog
 from apus_tpu.core.quorum import have_majority
 from apus_tpu.core.sid import AtomicSid, Sid
@@ -72,6 +73,7 @@ class PendingRequest:
     clt_id: int
     data: bytes
     idx: Optional[int] = None         # log index once appended
+    reply: Optional[bytes] = None     # SM reply once applied
 
 
 class Node:
@@ -106,11 +108,17 @@ class Node:
         self._fail_count: dict[int, int] = {}     # CTRL failure counter
         self._fail_last: dict[int, float] = {}    # last counted failure time
         self._pending_head: Optional[int] = None  # HEAD entry in flight
+        self._term_start_idx = 0                  # idx of our term's blank entry
 
-        # client requests
+        # client requests + endpoint db (dare_ep_db.c analog)
         self._pending: list[PendingRequest] = []
+        self._inflight: dict[tuple[int, int], PendingRequest] = {}
+        self._pending_reads: list[PendingRead] = []
+        self.epdb = EndpointDB()
+        self._leader_verified_at = float("-inf")
         self.committed_upcalls: list[LogEntry] = []   # drained by runtime
         self._known_leader: Optional[int] = None
+        self._now = 0.0                     # last tick clock (sim-safe)
 
         # stats (observability, §5.5)
         self.stats = {"elections": 0, "commits": 0, "applied": 0,
@@ -135,15 +143,55 @@ class Node:
 
     def submit(self, req_id: int, clt_id: int, data: bytes) -> Optional[PendingRequest]:
         """Enqueue a client request (leader only).  Returns a handle whose
-        ``idx`` is set once appended; committed when log.commit > idx."""
+        ``idx`` is set once appended; committed when log.commit > idx.
+
+        Exactly-once: duplicates of an applied (clt_id, req_id) are
+        answered from the endpoint DB's cached reply, and duplicates of
+        an in-flight request return the existing handle — so client
+        retries across timeouts/failovers never double-append
+        (ep_db dedup analog, dare_ep_db.h:20-31).  Client req_ids must be
+        per-client monotone."""
         if not self.is_leader:
             return None
+        ep = self.epdb.duplicate_of_applied(clt_id, req_id)
+        if ep is not None:
+            return PendingRequest(req_id, clt_id, data, idx=ep.last_idx,
+                                  reply=ep.last_reply or b"")
+        key = (clt_id, req_id)
+        existing = self._inflight.get(key)
+        if existing is not None:
+            return existing
         pr = PendingRequest(req_id, clt_id, data)
         self._pending.append(pr)
+        self._inflight[key] = pr
         return pr
+
+    def read(self, req_id: int, clt_id: int,
+             data: bytes) -> Optional[PendingRead]:
+        """Register a linearizable read (leader only): answered once
+        every entry committed before registration is applied AND
+        leadership has been re-verified against a majority
+        (ud_clt_answer_read_request + wait_for_idx,
+        dare_ibv_ud.c:1424-1449, dare_ep_db.c:132-161)."""
+        if not self.is_leader:
+            return None
+        # Read-index rule: a fresh leader's commit may lag the cluster
+        # until its own term's blank entry commits — wait for at least
+        # that entry so the read reflects every previously-committed
+        # write (Raft §8 read-only optimization; the reference gets this
+        # from poll_config_entries before answering, dare_server.c:1399).
+        wait_idx = max(self.log.commit, self._term_start_idx + 1)
+        rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
+                         registered_at=self._now)
+        self._pending_reads.append(rr)
+        return rr
 
     def tick(self, now: float) -> None:
         """One poll-loop iteration (polling(), dare_server.c:1013-1152)."""
+        self._now = now
+        # Mirror our SID into remotely-readable memory (the rsid[] slot
+        # peers read during leadership verification).
+        self.regions.ctrl[Region.RSID][self.idx] = self.sid.word
         self._poll_vote_requests(now)
         if self.role == Role.LEADER:
             self._leader_tick(now)
@@ -198,13 +246,14 @@ class Node:
         # can advance in the new term (NOOP/CONFIG append on win,
         # dare_server.c:1412-1491): if a resize is mid-flight, continue it.
         if self.cid.state == CidState.EXTENDED:
-            self.log.append(my.term, type=EntryType.CONFIG,
-                            cid=self.cid.to_transit())
+            self._term_start_idx = self.log.append(
+                my.term, type=EntryType.CONFIG, cid=self.cid.to_transit())
         elif self.cid.state == CidState.TRANSIT:
-            self.log.append(my.term, type=EntryType.CONFIG,
-                            cid=self.cid.stabilize())
+            self._term_start_idx = self.log.append(
+                my.term, type=EntryType.CONFIG, cid=self.cid.stabilize())
         else:
-            self.log.append(my.term, type=EntryType.NOOP)
+            self._term_start_idx = self.log.append(my.term,
+                                                   type=EntryType.NOOP)
 
     def become_follower(self, leader_sid: Sid, now: float) -> None:
         """server_to_follower analog (dare_server.h:200)."""
@@ -213,6 +262,9 @@ class Node:
         self._election_deadline = None
         self._last_hb_seen = now
         self._pending.clear()
+        self._inflight.clear()
+        self._pending_reads.clear()    # clients retry against the new leader
+        self._leader_verified_at = float("-inf")
 
     # ------------------------------------------------------------------
     # voting
@@ -354,6 +406,7 @@ class Node:
         if now >= self._next_prune:
             self._maybe_prune(my)
             self._next_prune = now + self.cfg.prune_period
+        self._serve_reads(now)
 
     def _drain_pending(self, my: Sid) -> None:
         """tailq drain -> log append (get_tailq_message,
@@ -440,6 +493,53 @@ class Node:
                 self._note_failure(peer, now)
         self.stats["hb_sent"] += 1
 
+    def _serve_reads(self, now: float) -> None:
+        """Answer pending linearizable reads (ep_dp_reply_read_req
+        analog): requires apply >= wait_idx and a leadership proof
+        obtained AFTER the read was registered (Raft read-index rule —
+        a proof predating the read could miss a concurrent election)."""
+        ready = [r for r in self._pending_reads
+                 if self.log.apply >= r.wait_idx]
+        if not ready:
+            return
+        if self._leader_verified_at < max(r.registered_at for r in ready):
+            if not self._verify_leadership(now):
+                return
+        for r in ready:
+            if r.registered_at > self._leader_verified_at:
+                continue               # needs a fresher proof: next tick
+            try:
+                r.reply = self.sm.query(r.data)
+            except Exception:
+                # A malformed read must fail that read, not the replica.
+                r.reply = None
+                r.error = True
+            r.done = True
+        self._pending_reads = [r for r in self._pending_reads if not r.done]
+
+    def _verify_leadership(self, now: float) -> bool:
+        """rc_verify_leadership analog (dare_ibv_rc.c:1182-1280): read a
+        majority of remote SIDs and confirm they still follow us in our
+        term.  On success the proof is stamped at ``now``; callers gate
+        on the stamp relative to each read's registration time."""
+        my = self.sid.sid
+        mask = 1 << self.idx
+        for peer in self.cid.members():
+            if peer == self.idx:
+                continue
+            word = self.t.ctrl_read(peer, Region.RSID, peer)
+            if word is None:
+                continue
+            s = Sid.unpack(word)
+            if s.term > my.term:
+                return False           # we are deposed
+            if s.term == my.term and s.idx == self.idx:
+                mask |= 1 << peer      # peer's SID records following us
+        if have_majority(mask, self.cid):
+            self._leader_verified_at = now
+            return True
+        return False
+
     def _note_failure(self, peer: int, now: float) -> None:
         """check_failure_count analog (dare_server.c:1189-1227): after
         PERMANENT_FAILURE failures — counted at most once per fail_window —
@@ -486,8 +586,29 @@ class Node:
             e = self.log.get(self.log.apply)
             assert e is not None
             if e.type == EntryType.CSM:
-                self.sm.apply(e.idx, e.data)
-                self.committed_upcalls.append(e)
+                # Apply-time dedup: a failover retry can legally append
+                # a second entry with the same (clt_id, req_id) — e.g.
+                # the old leader's entry survives the election and the
+                # client's retry lands on the new leader before apply
+                # catches up.  Only the first execution runs; duplicates
+                # are skipped (client req_ids are per-client monotone,
+                # starting at 1).
+                dup = (e.req_id > 0 and
+                       self.epdb.duplicate_of_applied(e.clt_id, e.req_id))
+                if dup:
+                    reply = dup.last_reply
+                else:
+                    reply = self.sm.apply(e.idx, e.data)
+                    self.epdb.note_applied(e.clt_id, e.req_id, e.idx, reply)
+                    self.committed_upcalls.append(e)
+                pr = self._inflight.pop((e.clt_id, e.req_id), None)
+                if pr is not None:
+                    # Sentinel contract: reply stays None until THIS
+                    # client's entry applied, then is always bytes — the
+                    # client service acks only on it (never inferred
+                    # from apply position, which a truncated entry's
+                    # index could falsely satisfy).
+                    pr.reply = reply if reply is not None else b""
             elif e.type == EntryType.CONFIG:
                 self._apply_config(e, now)
             elif e.type == EntryType.HEAD:

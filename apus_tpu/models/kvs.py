@@ -47,6 +47,13 @@ class KvsStateMachine(StateMachine):
             return b"OK"
         raise ValueError(f"bad kvs op {op!r}")
 
+    def query(self, cmd: bytes) -> bytes | None:
+        """GET without logging (linearizable-read path).  GET is
+        side-effect-free, so it shares apply's decode+lookup."""
+        if cmd[:1] != b"G":
+            raise ValueError("only GET is a read-only command")
+        return self.apply(0, cmd)
+
     def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
         items = b"".join(b"%d:%s%d:%s" % (len(k), k, len(v), v)
                          for k, v in sorted(self.store.items()))

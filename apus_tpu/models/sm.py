@@ -21,6 +21,12 @@ class StateMachine:
     def apply(self, idx: int, cmd: bytes) -> bytes | None:
         raise NotImplementedError
 
+    def query(self, cmd: bytes) -> bytes | None:
+        """Read-only command, never logged — the linearizable-read path
+        (ud_clt_answer_read_request analog, dare_ibv_ud.c:1424-1449).
+        Default: not supported."""
+        raise NotImplementedError(f"{type(self).__name__} has no query path")
+
     def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
         raise NotImplementedError
 

@@ -204,6 +204,15 @@ class NetTransport(Transport):
         self._conns: dict[int, socket.socket] = {}
         self._down_until: dict[int, float] = {}
         self._peer_locks: dict[int, threading.Lock] = {}
+        # Connection setup is asynchronous (the reference pre-establishes
+        # RC QPs at bootstrap; data ops never wait for connection setup):
+        # ops on an unconnected peer fail fast with DROPPED while a
+        # background connector dials.  Otherwise one blackholed peer
+        # would stall the tick thread's heartbeat fan-out past
+        # hb_timeout and trigger spurious elections.
+        self._dialing: set[int] = set()
+        self._dial_lock = threading.Lock()
+        self._closed = False
 
     def set_peer(self, idx: int, addr: tuple[str, int]) -> None:
         """Register/replace a peer endpoint (membership change)."""
@@ -212,6 +221,7 @@ class NetTransport(Transport):
         self._down_until.pop(idx, None)
 
     def close(self) -> None:
+        self._closed = True
         for idx in list(self._conns):
             self._drop_conn(idx)
 
@@ -224,24 +234,38 @@ class NetTransport(Transport):
         return lock
 
     def _connect(self, target: int) -> Optional[socket.socket]:
+        """Return an established connection or None (kicking off a
+        background dial attempt).  Never blocks on connection setup."""
         conn = self._conns.get(target)
         if conn is not None:
             return conn
         now = time.monotonic()
-        if now < self._down_until.get(target, 0.0):
-            return None
+        if now >= self._down_until.get(target, 0.0) \
+                and target in self.peers and not self._closed:
+            with self._dial_lock:
+                dialing = target in self._dialing
+                if not dialing:
+                    self._dialing.add(target)
+            if not dialing:
+                threading.Thread(target=self._dial, args=(target,),
+                                 daemon=True).start()
+        return None
+
+    def _dial(self, target: int) -> None:
         addr = self.peers.get(target)
-        if addr is None:
-            return None
         try:
             conn = socket.create_connection(addr, timeout=self.timeout)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(self.timeout)
-            self._conns[target] = conn
-            return conn
+            if self._closed:
+                conn.close()
+            else:
+                self._conns[target] = conn
         except OSError:
-            self._down_until[target] = now + self.backoff
-            return None
+            self._down_until[target] = time.monotonic() + self.backoff
+        finally:
+            with self._dial_lock:
+                self._dialing.discard(target)
 
     def _drop_conn(self, target: int) -> None:
         conn = self._conns.pop(target, None)

@@ -48,6 +48,11 @@ class Region(str, enum.Enum):
     REP_ACK = "rep_ack"       # follower -> leader: highest replicated idx
     SM_REQ = "sm_req"         # snapshot request flags
     SM_REP = "sm_rep"         # snapshot replies {sid_word, snapshot}
+    RSID = "rsid"             # each node mirrors its own SID in slot[own]
+                              # for remote leadership verification
+                              # (rc_verify_leadership reads, dare_ibv_rc.c
+                              # :1182-1280; new regions append — the wire
+                              # indexes positionally)
 
 
 class Regions:

@@ -1,0 +1,246 @@
+"""Client service + client library.
+
+The reference's clients reach the group over UD datagrams
+(client_req_t/client_rep_t, dare_ibv_ud.h:60-81; handled in
+handle_message_from_client, dare_ibv_ud.c:863-944) — under APUS proper
+the "client" is the proxy, but the DARE client path (CLT_WRITE/CLT_READ)
+is fully present.  This module is that path over the DCN:
+
+- daemon side: two extra ops on the replica's PeerServer port —
+  CLT_WRITE (submit, block until applied, return the SM reply) and
+  CLT_READ (linearizable read).  Non-leaders answer NOT_LEADER with a
+  hint, the leader-redirect analog of clients multicasting until they
+  find the leader.
+- ``ApusClient``: retrying client with per-client monotone req_ids;
+  safe to retry across failovers because the server dedups on
+  (clt_id, req_id) (exactly-once; see apus_tpu.core.epdb).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from apus_tpu.parallel import wire
+
+ST_ERROR = wire.ST_ERROR
+
+OP_CLT_WRITE = 16
+OP_CLT_READ = 17
+
+ST_NOT_LEADER = 4
+ST_TIMEOUT = 5
+
+NO_HINT = 255
+
+
+def make_client_ops(daemon) -> dict:
+    """Extra PeerServer ops for a ReplicaDaemon (runs on per-connection
+    server threads; blocking a handler blocks only that client's
+    connection)."""
+
+    def clt_write(r: wire.Reader) -> bytes:
+        req_id, clt_id = r.u64(), r.u64()
+        data = r.blob()
+        with daemon.lock:
+            pr = daemon.node.submit(req_id, clt_id, data)
+        if pr is None:
+            return _not_leader(daemon)
+        deadline = time.monotonic() + daemon.client_op_timeout
+        while time.monotonic() < deadline:
+            with daemon.lock:
+                # Ack ONLY on the reply sentinel (set when this client's
+                # entry applied) — apply position alone can be satisfied
+                # by a different entry after truncation.
+                if pr.reply is not None:
+                    return wire.u8(wire.ST_OK) + wire.blob(pr.reply)
+                if not daemon.node.is_leader:
+                    return _not_leader(daemon)
+            time.sleep(0.0002)
+        return wire.u8(ST_TIMEOUT)
+
+    def clt_read(r: wire.Reader) -> bytes:
+        req_id, clt_id = r.u64(), r.u64()
+        data = r.blob()
+        with daemon.lock:
+            rr = daemon.node.read(req_id, clt_id, data)
+        if rr is None:
+            return _not_leader(daemon)
+        deadline = time.monotonic() + daemon.client_op_timeout
+        while time.monotonic() < deadline:
+            with daemon.lock:
+                if rr.done:
+                    if rr.error:
+                        return wire.u8(wire.ST_ERROR)
+                    return wire.u8(wire.ST_OK) + wire.blob(rr.reply or b"")
+                if not daemon.node.is_leader:
+                    return _not_leader(daemon)
+            time.sleep(0.0002)
+        return wire.u8(ST_TIMEOUT)
+
+    return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read}
+
+
+def _not_leader(daemon) -> bytes:
+    """NOT_LEADER + the leader's address (not its index: the client's
+    peer list may be partial or reordered, so an index is meaningless to
+    it).  Empty hint = unknown."""
+    hint = daemon.leader_hint
+    addr = b""
+    if hint is not None and hint < len(daemon.spec.peers):
+        addr = daemon.spec.peers[hint].encode()
+    return wire.u8(ST_NOT_LEADER) + wire.blob(addr)
+
+
+class ApusClient:
+    """Cluster client: leader discovery, retries, exactly-once writes.
+
+    ``clt_id`` defaults to a pid/thread-derived id; req_ids are
+    per-client monotone, which the server-side dedup requires.
+    """
+
+    def __init__(self, peers: list[str], clt_id: Optional[int] = None,
+                 timeout: float = 5.0):
+        self.peers = [self._parse(p) for p in peers]
+        self.clt_id = clt_id if clt_id is not None else (
+            (os.getpid() << 20) ^ threading.get_ident()) & ((1 << 63) - 1)
+        self.timeout = timeout
+        self._req_seq = 0
+        self._leader: Optional[int] = None
+        self._conns: dict[int, socket.socket] = {}
+
+    @staticmethod
+    def _parse(addr: str) -> tuple[str, int]:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    def __enter__(self) -> "ApusClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- raw ops ----------------------------------------------------------
+
+    def write(self, data: bytes) -> bytes:
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq, data)
+
+    def read(self, data: bytes) -> bytes:
+        self._req_seq += 1
+        return self._op(OP_CLT_READ, self._req_seq, data)
+
+    # -- kvs convenience (the DARE client's PUT/GET/RM, dare_kvs_sm.c) ----
+
+    def put(self, key: bytes, value: bytes) -> bytes:
+        from apus_tpu.models.kvs import encode_put
+        return self.write(encode_put(key, value))
+
+    def get(self, key: bytes) -> bytes:
+        from apus_tpu.models.kvs import encode_get
+        return self.read(encode_get(key))
+
+    def delete(self, key: bytes) -> bytes:
+        from apus_tpu.models.kvs import encode_delete
+        return self.write(encode_delete(key))
+
+    # -- internals --------------------------------------------------------
+
+    def _op(self, op: int, req_id: int, data: bytes) -> bytes:
+        payload = (wire.u8(op) + wire.u64(req_id) + wire.u64(self.clt_id)
+                   + wire.blob(data))
+        deadline = time.monotonic() + self.timeout
+        target = self._leader
+        while time.monotonic() < deadline:
+            if target is None:
+                target = self._probe_any(deadline)
+                if target is None:
+                    continue
+            resp = self._roundtrip(target, payload, deadline)
+            if resp is None:
+                target = self._next(target)
+                continue
+            st = resp[0]
+            if st == wire.ST_OK:
+                self._leader = target
+                return wire.Reader(resp[1:]).blob()
+            if st == ST_NOT_LEADER:
+                hint = wire.Reader(resp[1:]).blob().decode() if \
+                    len(resp) > 1 else ""
+                target = self._peer_index(hint) if hint \
+                    else self._next(target)
+                time.sleep(0.01)
+                continue
+            if st == ST_TIMEOUT:
+                continue                  # same req_id: dedup makes it safe
+            raise RuntimeError(f"server error (status {st})")
+        raise TimeoutError(f"request {req_id} not served in {self.timeout}s")
+
+    def _peer_index(self, addr: str) -> int:
+        """Index of ``addr`` in our peer list, learning it if new."""
+        pa = self._parse(addr)
+        for i, p in enumerate(self.peers):
+            if p == pa:
+                return i
+        self.peers.append(pa)
+        return len(self.peers) - 1
+
+    def _next(self, current: Optional[int]) -> int:
+        self._leader = None
+        if current is None:
+            return 0
+        return (current + 1) % len(self.peers)
+
+    def _probe_any(self, deadline: float) -> Optional[int]:
+        for i in range(len(self.peers)):
+            if self._connect(i, deadline) is not None:
+                return i
+        time.sleep(0.05)
+        return None
+
+    def _connect(self, target: int,
+                 deadline: float) -> Optional[socket.socket]:
+        conn = self._conns.get(target)
+        if conn is not None:
+            return conn
+        try:
+            conn = socket.create_connection(
+                self.peers[target],
+                timeout=max(0.05, min(1.0, deadline - time.monotonic())))
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[target] = conn
+            return conn
+        except OSError:
+            return None
+
+    def _roundtrip(self, target: int, payload: bytes,
+                   deadline: float) -> Optional[bytes]:
+        conn = self._connect(target, deadline)
+        if conn is None:
+            return None
+        try:
+            conn.settimeout(max(0.05, deadline - time.monotonic()))
+            conn.sendall(wire.frame(payload))
+            return wire.read_frame(conn)
+        except (OSError, ConnectionError, ValueError):
+            self._drop(target)
+            return None
+
+    def _drop(self, target: int) -> None:
+        conn = self._conns.pop(target, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass

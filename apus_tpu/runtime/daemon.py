@@ -87,10 +87,14 @@ class ReplicaDaemon:
         self._tick_thread: Optional[threading.Thread] = None
         self._last_role = None
 
-    # -- extra (two-sided) control ops: filled in by runtime layers -------
+    # -- extra (two-sided) control ops ------------------------------------
+
+    #: how long a client-facing handler blocks waiting for commit/apply
+    client_op_timeout: float = 5.0
 
     def _extra_ops(self) -> dict:
-        return {}
+        from apus_tpu.runtime.client import make_client_ops
+        return make_client_ops(self)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -111,10 +115,17 @@ class ReplicaDaemon:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            with self.lock:
-                self.node.tick(time.monotonic())
-                self._drain_upcalls()
-                self._log_role_changes()
+            try:
+                with self.lock:
+                    self.node.tick(time.monotonic())
+                    self._drain_upcalls()
+                    self._log_role_changes()
+            except Exception:
+                # A tick must never silently kill the replica (a dead
+                # tick thread with a live PeerServer is a zombie that
+                # still acks writes).  Log and keep ticking; persistent
+                # faults will surface via the failure detector.
+                self.logger.exception("tick failed")
             time.sleep(self._tick_interval)
 
     def _drain_upcalls(self) -> None:

@@ -1,0 +1,130 @@
+"""Client session layer: dedup, exactly-once retries, linearizable reads."""
+
+import time
+
+from apus_tpu.core.epdb import EndpointDB
+from apus_tpu.core.types import EntryType
+from apus_tpu.models.kvs import encode_put
+from apus_tpu.parallel.sim import Cluster
+from apus_tpu.runtime.client import ApusClient
+from apus_tpu.runtime.cluster import LocalCluster
+
+
+def test_epdb_dedup():
+    db = EndpointDB()
+    assert db.duplicate_of_applied(7, 1) is None
+    db.note_applied(7, 1, idx=5, reply=b"OK")
+    ep = db.duplicate_of_applied(7, 1)
+    assert ep is not None and ep.last_reply == b"OK" and ep.last_idx == 5
+    assert db.duplicate_of_applied(7, 2) is None     # newer req: not a dup
+    db.note_applied(7, 2, idx=6, reply=b"r2")
+    assert db.duplicate_of_applied(7, 1).last_reply == b"r2"  # stale dup
+    db.erase(7)
+    assert db.search(7) is None
+
+
+def test_sim_submit_dedup_exactly_once():
+    c = Cluster(3, seed=7)
+    leader = c.wait_for_leader()
+    pr1 = leader.submit(1, 42, b"cmd")
+    pr2 = leader.submit(1, 42, b"cmd")       # in-flight duplicate
+    assert pr2 is pr1
+    c.run_until(lambda: pr1.idx is not None and leader.log.commit > pr1.idx)
+    c.run(0.05)
+    pr3 = leader.submit(1, 42, b"cmd")       # applied duplicate
+    assert pr3 is not pr1 and pr3.idx == pr1.idx
+    csm = [e for e in leader.log.entries(1)
+           if e.type == EntryType.CSM and e.clt_id == 42]
+    assert len(csm) == 1
+
+
+def test_client_write_read_live():
+    with LocalCluster(3) as c:
+        c.wait_for_leader()
+        with ApusClient(c.spec.peers, clt_id=1) as client:
+            assert client.put(b"a", b"1") == b"OK"
+            assert client.get(b"a") == b"1"
+            assert client.put(b"a", b"2") == b"OK"
+            assert client.get(b"a") == b"2"
+            assert client.delete(b"a") == b"OK"
+            assert client.get(b"a") == b""
+
+
+def test_client_follower_redirect():
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        follower = next(d for d in c.live() if d.idx != leader.idx)
+        # Point the client at a follower only: it must discover the leader.
+        addr = c.spec.peers[follower.idx]
+        with ApusClient([addr] + c.spec.peers, clt_id=2) as client:
+            assert client.put(b"r", b"x") == b"OK"
+            assert client.get(b"r") == b"x"
+
+
+def test_client_exactly_once_across_failover():
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        with ApusClient(c.spec.peers, clt_id=3, timeout=20.0) as client:
+            for i in range(5):
+                client.put(b"k%d" % i, b"v%d" % i)
+            c.kill(leader.idx)
+            # Retries across the failover must not double-apply.
+            for i in range(5, 10):
+                client.put(b"k%d" % i, b"v%d" % i)
+            assert client.get(b"k7") == b"v7"
+        # No duplicate (clt_id, req_id) CSM entries anywhere.
+        new_leader = c.wait_for_leader()
+        with new_leader.lock:
+            seen = set()
+            for e in new_leader.node.log.entries(1):
+                if e.type == EntryType.CSM and e.clt_id == 3:
+                    key = (e.clt_id, e.req_id)
+                    assert key not in seen, f"duplicate entry {key}"
+                    seen.add(key)
+            assert len(seen) == 10
+        c.check_logs_consistent()
+
+
+def test_linearizable_read_after_failover():
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        with ApusClient(c.spec.peers, clt_id=4, timeout=20.0) as client:
+            client.put(b"x", b"before")
+            c.kill(leader.idx)
+            # The read must reflect the committed write even though the
+            # new leader has never seen it applied-by-a-client (read-index
+            # rule: waits for the new term's blank entry).
+            assert client.get(b"x") == b"before"
+
+
+def test_apply_time_dedup_duplicate_entries():
+    """A failover retry can append two entries with the same
+    (clt_id, req_id); only the first may execute (apply-time dedup)."""
+    c = Cluster(3, seed=11)
+    leader = c.wait_for_leader()
+    with_term = leader.current_term
+    # Simulate the race by appending the duplicate directly.
+    leader.log.append(with_term, req_id=5, clt_id=9, data=b"P1:kx")
+    leader.log.append(with_term, req_id=5, clt_id=9, data=b"P1:kx")
+    c.run(0.3)
+    # All replicas applied the command exactly once.
+    for n in c.nodes:
+        csm = [e for e in n.log.entries(1) if e.clt_id == 9]
+        assert len(csm) == 2          # both entries are in the log...
+        ep = n.epdb.search(9)
+        assert ep is not None and ep.last_req_id == 5
+        assert ep.last_idx == csm[0].idx   # ...but only the first executed
+
+
+def test_malformed_read_fails_read_not_replica():
+    with LocalCluster(3) as c:
+        c.wait_for_leader()
+        with ApusClient(c.spec.peers, clt_id=5) as client:
+            client.put(b"ok", b"1")
+            try:
+                client.read(b"\xff garbage")
+                assert False, "expected error"
+            except RuntimeError:
+                pass
+            # The replica survived and still serves.
+            assert client.get(b"ok") == b"1"
