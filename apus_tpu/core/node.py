@@ -115,7 +115,11 @@ class Node:
         self._inflight: dict[tuple[int, int], PendingRequest] = {}
         self._pending_reads: list[PendingRead] = []
         self.epdb = EndpointDB()
-        self._leader_verified_at = float("-inf")
+        # Leadership proofs are ordered by a registration COUNTER, not
+        # the tick clock: a proof stamped at tick-time T could tie with
+        # a read registered between ticks and be mistaken for "after".
+        self._reg_seq = 0
+        self._leader_verified_seq = -1
         self.committed_upcalls: list[LogEntry] = []   # drained by runtime
         self._known_leader: Optional[int] = None
         self._now = 0.0                     # last tick clock (sim-safe)
@@ -181,8 +185,9 @@ class Node:
         # write (Raft §8 read-only optimization; the reference gets this
         # from poll_config_entries before answering, dare_server.c:1399).
         wait_idx = max(self.log.commit, self._term_start_idx + 1)
+        self._reg_seq += 1
         rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
-                         registered_at=self._now)
+                         registered_at=self._reg_seq)
         self._pending_reads.append(rr)
         return rr
 
@@ -264,7 +269,7 @@ class Node:
         self._pending.clear()
         self._inflight.clear()
         self._pending_reads.clear()    # clients retry against the new leader
-        self._leader_verified_at = float("-inf")
+        self._leader_verified_seq = -1
 
     # ------------------------------------------------------------------
     # voting
@@ -498,15 +503,20 @@ class Node:
         analog): requires apply >= wait_idx and a leadership proof
         obtained AFTER the read was registered (Raft read-index rule —
         a proof predating the read could miss a concurrent election)."""
-        ready = [r for r in self._pending_reads
-                 if self.log.apply >= r.wait_idx]
-        if not ready:
+        if not self._pending_reads:
             return
-        if self._leader_verified_at < max(r.registered_at for r in ready):
+        if not any(self.log.apply >= r.wait_idx for r in self._pending_reads):
+            return
+        newest = max(r.registered_at for r in self._pending_reads
+                     if self.log.apply >= r.wait_idx)
+        if self._leader_verified_seq < newest:
             if not self._verify_leadership(now):
                 return
-        for r in ready:
-            if r.registered_at > self._leader_verified_at:
+        # Re-derive the ready set AFTER verification: the transport
+        # yields the node lock on the wire, so _pending_reads (and our
+        # role) may have changed mid-verification.
+        for r in self._pending_reads:
+            if self.log.apply < r.wait_idx                     or r.registered_at > self._leader_verified_seq:
                 continue               # needs a fresher proof: next tick
             try:
                 r.reply = self.sm.query(r.data)
@@ -520,9 +530,10 @@ class Node:
     def _verify_leadership(self, now: float) -> bool:
         """rc_verify_leadership analog (dare_ibv_rc.c:1182-1280): read a
         majority of remote SIDs and confirm they still follow us in our
-        term.  On success the proof is stamped at ``now``; callers gate
-        on the stamp relative to each read's registration time."""
+        term.  The proof covers reads registered up to the sequence
+        captured BEFORE the remote reads begin."""
         my = self.sid.sid
+        seq_at_start = self._reg_seq
         mask = 1 << self.idx
         for peer in self.cid.members():
             if peer == self.idx:
@@ -535,8 +546,15 @@ class Node:
                 return False           # we are deposed
             if s.term == my.term and s.idx == self.idx:
                 mask |= 1 << peer      # peer's SID records following us
+        # The remote reads yield the node lock: we may have stepped down
+        # (or been re-elected in a later term) mid-verification.  The
+        # proof is only valid if we are STILL the leader of ``my.term``.
+        cur = self.sid.sid
+        if not (self.role == Role.LEADER and cur.leader
+                and cur.term == my.term and cur.idx == self.idx):
+            return False
         if have_majority(mask, self.cid):
-            self._leader_verified_at = now
+            self._leader_verified_seq = seq_at_start
             return True
         return False
 

@@ -27,6 +27,7 @@ between two daemons writing to each other simultaneously.
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -92,6 +93,13 @@ class PeerServer:
         (crash-fault fidelity for kill-based tests)."""
         self._stop.set()
         try:
+            # shutdown() wakes the thread blocked in accept(); a bare
+            # close() would leave the kernel LISTEN socket alive (the
+            # blocked accept holds a reference) and the port unbindable.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -99,7 +107,11 @@ class PeerServer:
             conns, self._conns = list(self._conns), set()
         for c in conns:
             try:
-                c.shutdown(socket.SHUT_RDWR)
+                # RST-close (linger 0): like a crashed process, and the
+                # port is immediately rebindable (a FIN-close parks the
+                # accepted sockets in FIN_WAIT, blocking restart binds).
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
             except OSError:
                 pass
             try:
@@ -221,7 +233,8 @@ class NetTransport(Transport):
         self._down_until.pop(idx, None)
 
     def close(self) -> None:
-        self._closed = True
+        with self._dial_lock:
+            self._closed = True
         for idx in list(self._conns):
             self._drop_conn(idx)
 
@@ -257,10 +270,13 @@ class NetTransport(Transport):
             conn = socket.create_connection(addr, timeout=self.timeout)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(self.timeout)
-            if self._closed:
-                conn.close()
-            else:
-                self._conns[target] = conn
+            with self._dial_lock:
+                # Paired with close(): _closed is set under this lock,
+                # so we cannot insert into a closed transport.
+                if self._closed:
+                    conn.close()
+                else:
+                    self._conns[target] = conn
         except OSError:
             self._down_until[target] = time.monotonic() + self.backoff
         finally:

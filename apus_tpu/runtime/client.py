@@ -34,8 +34,6 @@ OP_CLT_READ = 17
 ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
 
-NO_HINT = 255
-
 
 def make_client_ops(daemon) -> dict:
     """Extra PeerServer ops for a ReplicaDaemon (runs on per-connection
@@ -50,8 +48,8 @@ def make_client_ops(daemon) -> dict:
         if pr is None:
             return _not_leader(daemon)
         deadline = time.monotonic() + daemon.client_op_timeout
-        while time.monotonic() < deadline:
-            with daemon.lock:
+        with daemon.commit_cond:
+            while True:
                 # Ack ONLY on the reply sentinel (set when this client's
                 # entry applied) — apply position alone can be satisfied
                 # by a different entry after truncation.
@@ -59,8 +57,10 @@ def make_client_ops(daemon) -> dict:
                     return wire.u8(wire.ST_OK) + wire.blob(pr.reply)
                 if not daemon.node.is_leader:
                     return _not_leader(daemon)
-            time.sleep(0.0002)
-        return wire.u8(ST_TIMEOUT)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return wire.u8(ST_TIMEOUT)
+                daemon.commit_cond.wait(min(left, 0.05))
 
     def clt_read(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
@@ -70,16 +70,18 @@ def make_client_ops(daemon) -> dict:
         if rr is None:
             return _not_leader(daemon)
         deadline = time.monotonic() + daemon.client_op_timeout
-        while time.monotonic() < deadline:
-            with daemon.lock:
+        with daemon.commit_cond:
+            while True:
                 if rr.done:
                     if rr.error:
                         return wire.u8(wire.ST_ERROR)
                     return wire.u8(wire.ST_OK) + wire.blob(rr.reply or b"")
                 if not daemon.node.is_leader:
                     return _not_leader(daemon)
-            time.sleep(0.0002)
-        return wire.u8(ST_TIMEOUT)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return wire.u8(ST_TIMEOUT)
+                daemon.commit_cond.wait(min(left, 0.05))
 
     return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read}
 

@@ -27,6 +27,8 @@ class LocalCluster:
         self.n = n
         self.sm_factory = sm_factory
         self.daemon_cls = daemon_cls
+        self.seed = seed
+        self.daemon_kwargs = daemon_kwargs
         # Reserve ports first so every daemon knows all peers up front.
         socks = [PeerServer.reserve() for _ in range(n)]
         peers = [f"{s.getsockname()[0]}:{s.getsockname()[1]}" for s in socks]
@@ -105,6 +107,16 @@ class LocalCluster:
         if d is not None:
             d.stop()
             self.daemons[idx] = None
+
+    def restart(self, idx: int) -> "ReplicaDaemon":
+        """Restart a killed replica at its original endpoint (full
+        recovery path: durable-store replay + catch-up from peers)."""
+        assert self.daemons[idx] is None, "kill before restart"
+        d = self.daemon_cls(idx, self.spec, sm=self.sm_factory(),
+                            seed=self.seed, **self.daemon_kwargs)
+        self.daemons[idx] = d
+        d.start()
+        return d
 
     # -- invariants -------------------------------------------------------
 

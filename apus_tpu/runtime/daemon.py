@@ -51,6 +51,7 @@ class ReplicaDaemon:
                  listen_sock=None,
                  tick_interval: float = 0.0005,
                  log_file: Optional[str] = None,
+                 db_dir: Optional[str] = None,
                  seed: int = 0):
         self.idx = idx
         self.spec = spec
@@ -64,7 +65,8 @@ class ReplicaDaemon:
             idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
             elect_high=spec.elect_high, prune_period=spec.prune_period,
-            max_batch=spec.max_batch, seed=seed)
+            max_batch=spec.max_batch, auto_remove=spec.auto_remove,
+            seed=seed)
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
         # Fresh-start grace: randomize the first election timeout so a
@@ -83,9 +85,27 @@ class ReplicaDaemon:
         # each gets (LogEntry); registered by persistence/replay layers.
         self.on_commit: list[Callable[[LogEntry], None]] = []
 
+        # Durable store (stable storage, db-interface.c analog).  On
+        # restart with an existing store, replay it into the SM and
+        # endpoint DB first: catch-up re-replication then hits the
+        # apply-time dedup, so commands are neither re-executed nor
+        # re-persisted (the reference replays its BDB dump the same way,
+        # proxy.c:306-339).
+        self.persistence = None
+        if db_dir is not None:
+            from apus_tpu.runtime.persist import (Persistence,
+                                                  daemon_store_path)
+            self.persistence = Persistence(daemon_store_path(db_dir, idx))
+            if self.persistence.store.count:
+                self.persistence.replay_into(self.node.sm, self.node.epdb)
+            self.on_commit.append(self.persistence.on_commit)
+
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
         self._last_role = None
+        # Client-facing handlers wait on this instead of polling the
+        # lock (K pollers at 0.2 ms would starve the tick thread).
+        self.commit_cond = threading.Condition(self.lock)
 
     # -- extra (two-sided) control ops ------------------------------------
 
@@ -112,6 +132,8 @@ class ReplicaDaemon:
             self._tick_thread.join(timeout=2.0)
         self.server.stop()
         self.transport.close()
+        if self.persistence is not None:
+            self.persistence.close()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -120,6 +142,7 @@ class ReplicaDaemon:
                     self.node.tick(time.monotonic())
                     self._drain_upcalls()
                     self._log_role_changes()
+                    self.commit_cond.notify_all()
             except Exception:
                 # A tick must never silently kill the replica (a dead
                 # tick thread with a live PeerServer is a zombie that
@@ -170,15 +193,18 @@ class ReplicaDaemon:
 
     def wait_committed(self, pr: PendingRequest,
                        timeout: float = 5.0) -> bool:
-        """Block until the request commits (the proxy spin-wait analog,
-        proxy.c:160 — but sleeping, since we're not inside the app's
-        read() here; the native proxy does the true spin on shm)."""
+        """Block until the request is applied (the proxy release analog,
+        proxy_update_state proxy.c:263-267).  Success is gated on the
+        reply sentinel — commit/apply position alone can be satisfied by
+        a DIFFERENT entry after a truncation."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self.lock:
-                if pr.idx is not None and self.node.log.commit > pr.idx:
+        with self.commit_cond:
+            while True:
+                if pr.reply is not None:
                     return True
                 if not self.node.is_leader:
                     return False      # lost leadership: client must retry
-            time.sleep(0.0002)
-        return False
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self.commit_cond.wait(min(left, 0.05))

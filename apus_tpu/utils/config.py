@@ -34,6 +34,9 @@ class ClusterSpec:
     n_slots: int = DEFAULT_LOG_SLOTS
     slot_bytes: int = DEFAULT_SLOT_BYTES
     max_batch: int = 64
+    # failure detector: auto-remove dead members via CONFIG entries
+    # (check_failure_count analog, dare_server.c:1189-1227)
+    auto_remove: bool = True
     # control plane endpoints, one per server idx ("host:port")
     peers: list[str] = dataclasses.field(default_factory=list)
     # proxied application endpoint (config-proxy.c:14-45)

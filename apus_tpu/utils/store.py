@@ -1,0 +1,257 @@
+"""ctypes binding for the native durable record store (libapusstore).
+
+The reference binds BerkeleyDB from C (src/db/db-interface.c); our
+native store is C++ (native/store.cpp) and this module is the Python
+daemon's handle to it.  A pure-Python fallback with identical semantics
+exists for environments without a toolchain (and to cross-check the
+native implementation in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.environ.get(
+    "APUS_NATIVE_DIR", os.path.join(_REPO_ROOT, "native"))
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    """Load libapusstore.so, building it on first use."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = os.path.join(_NATIVE_DIR, "build", "libapusstore.so")
+        if not os.path.exists(so):
+            src = os.path.join(_NATIVE_DIR, "store.cpp")
+            if not os.path.exists(src):
+                return None
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "store"],
+                               check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.apus_store_open.restype = ctypes.c_void_p
+        lib.apus_store_open.argtypes = [ctypes.c_char_p]
+        lib.apus_store_append.restype = ctypes.c_uint64
+        lib.apus_store_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint32]
+        lib.apus_store_sync.restype = ctypes.c_int
+        lib.apus_store_sync.argtypes = [ctypes.c_void_p]
+        lib.apus_store_count.restype = ctypes.c_uint64
+        lib.apus_store_count.argtypes = [ctypes.c_void_p]
+        lib.apus_store_payload_bytes.restype = ctypes.c_uint64
+        lib.apus_store_payload_bytes.argtypes = [ctypes.c_void_p]
+        lib.apus_store_dump_size.restype = ctypes.c_uint64
+        lib.apus_store_dump_size.argtypes = [ctypes.c_void_p]
+        lib.apus_store_dump.restype = ctypes.c_uint64
+        lib.apus_store_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64]
+        lib.apus_store_load_dump.restype = ctypes.c_uint64
+        lib.apus_store_load_dump.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_uint64]
+        lib.apus_store_close.restype = None
+        lib.apus_store_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeRecordStore:
+    """Handle to a libapusstore file (store_record/dump_records parity,
+    db-interface.c:65-128)."""
+
+    def __init__(self, path: str):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("libapusstore unavailable")
+        self._lib = lib
+        self._h = lib.apus_store_open(path.encode())
+        if not self._h:
+            raise OSError(f"apus_store_open({path!r}) failed")
+        self.path = path
+
+    def append(self, data: bytes) -> int:
+        n = self._lib.apus_store_append(self._h, data, len(data))
+        if n == 0:
+            raise OSError("apus_store_append failed")
+        return n
+
+    def sync(self) -> None:
+        if self._lib.apus_store_sync(self._h) != 0:
+            raise OSError("apus_store_sync failed")
+
+    @property
+    def count(self) -> int:
+        return self._lib.apus_store_count(self._h)
+
+    @property
+    def payload_bytes(self) -> int:
+        return self._lib.apus_store_payload_bytes(self._h)
+
+    def dump(self) -> bytes:
+        size = self._lib.apus_store_dump_size(self._h)
+        buf = ctypes.create_string_buffer(size)
+        w = self._lib.apus_store_dump(self._h, buf, size)
+        if w != size:
+            raise OSError("apus_store_dump failed")
+        return buf.raw[:w]
+
+    def load_dump(self, blob: bytes) -> int:
+        n = self._lib.apus_store_load_dump(self._h, blob, len(blob))
+        if n == (1 << 64) - 1:
+            raise OSError("apus_store_load_dump failed")
+        return n
+
+    def records(self) -> list[bytes]:
+        return parse_dump(self.dump())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.apus_store_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PyRecordStore:
+    """Pure-Python reference implementation (bit-identical file format)."""
+
+    _MAGIC = b"APUSTOR1"
+
+    def __init__(self, path: str):
+        self.path = path
+        self.count = 0
+        self.payload_bytes = 0
+        self._offsets: list[tuple[int, int]] = []   # (offset, len)
+        create = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, "r+b" if not create else "w+b")
+        if create:
+            self._f.write(self._MAGIC)
+            self._f.flush()
+            self._size = len(self._MAGIC)
+        else:
+            self._scan()
+
+    def _scan(self) -> None:
+        f = self._f
+        f.seek(0, os.SEEK_END)
+        total = f.tell()
+        f.seek(0)
+        if f.read(8) != self._MAGIC:
+            raise OSError(f"bad store header in {self.path}")
+        off = 8
+        while off + 8 <= total:
+            f.seek(off)
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                break
+            ln, crc = struct.unpack("<II", hdr)
+            if off + 8 + ln > total:
+                break
+            data = f.read(ln)
+            if len(data) < ln or (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+                break
+            self._offsets.append((off + 8, ln))
+            self.count += 1
+            self.payload_bytes += ln
+            off += 8 + ln
+        self._size = off
+        if off < total:
+            f.truncate(off)          # torn tail
+
+    def append(self, data: bytes) -> int:
+        f = self._f
+        f.seek(self._size)
+        f.write(struct.pack("<II", len(data),
+                            zlib.crc32(data) & 0xFFFFFFFF))
+        f.write(data)
+        f.flush()
+        self._offsets.append((self._size + 8, len(data)))
+        self._size += 8 + len(data)
+        self.count += 1
+        self.payload_bytes += len(data)
+        return self.count
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fdatasync(self._f.fileno())
+
+    def dump(self) -> bytes:
+        out = [struct.pack("<Q", self.count)]
+        for off, ln in self._offsets:
+            self._f.seek(off)
+            out.append(struct.pack("<I", ln))
+            out.append(self._f.read(ln))
+        return b"".join(out)
+
+    def load_dump(self, blob: bytes) -> int:
+        records = parse_dump(blob)
+        self._f.truncate(0)
+        self._f.seek(0)
+        self._f.write(self._MAGIC)
+        self._size = len(self._MAGIC)
+        self._offsets = []
+        self.count = 0
+        self.payload_bytes = 0
+        for r in records:
+            self.append(r)
+        return self.count
+
+    def records(self) -> list[bytes]:
+        return parse_dump(self.dump())
+
+    def close(self) -> None:
+        if self._f is not None:
+            self.sync()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def parse_dump(blob: bytes) -> list[bytes]:
+    """Decode the dump format: u64 count | (u32 len | data)*."""
+    (count,) = struct.unpack_from("<Q", blob, 0)
+    out = []
+    off = 8
+    for _ in range(count):
+        (ln,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        out.append(blob[off:off + ln])
+        off += ln
+    return out
+
+
+def open_store(path: str, prefer_native: bool = True):
+    """Open the durable store, preferring the native implementation."""
+    if prefer_native:
+        try:
+            return NativeRecordStore(path)
+        except (RuntimeError, OSError):
+            pass
+    return PyRecordStore(path)

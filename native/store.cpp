@@ -1,0 +1,267 @@
+// libapusstore: append-only durable record store (C ABI).
+//
+// TPU-era equivalent of the reference's stable storage
+// (src/db/db-interface.c): a BerkeleyDB RECNO append-only database with
+// store_record / dump_records / get_records_len (db-interface.c:21-134),
+// used by the proxy to persist every captured CONNECT/SEND/CLOSE record
+// and to build/apply snapshots (proxy.c:269-339).
+//
+// Redesign rather than a BDB binding: a single append-only file of
+// CRC-framed records.  Recovery semantics the reference delegates to
+// BDB are explicit here: on open the file is scanned and a torn tail
+// (partial write at crash) is truncated back to the last valid record.
+//
+// On-disk layout (little endian):
+//   header: "APUSTOR1" (8 bytes)
+//   record: u32 len | u32 crc32(data) | data[len]
+//
+// Dump format (for snapshots, in-memory): u64 count | (u32 len | data)*
+//
+// Thread-safety: callers serialize (the daemon holds its node lock on
+// the persistence path, matching the reference's single DARE thread).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'A', 'P', 'U', 'S', 'T', 'O', 'R', '1'};
+constexpr uint32_t kMaxRecord = 1u << 27;  // 128 MB sanity cap
+
+uint32_t crc32_table[256];
+bool crc32_init_done = false;
+
+void crc32_init() {
+  if (crc32_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+  crc32_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* data, size_t len) {
+  crc32_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+struct apus_store {
+  int fd = -1;
+  std::string path;
+  uint64_t count = 0;        // records
+  uint64_t payload_bytes = 0;
+  uint64_t file_size = 0;    // valid bytes (scan-validated)
+};
+
+extern "C" {
+
+// Open (creating if needed); scans and truncates a torn tail.
+// Returns NULL on error.
+apus_store* apus_store_open(const char* path) {
+  int fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return nullptr;
+
+  apus_store* s = new apus_store();
+  s->fd = fd;
+  s->path = path;
+
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+
+  if (st.st_size == 0) {
+    if (!write_exact(fd, kMagic, sizeof(kMagic))) {
+      close(fd);
+      delete s;
+      return nullptr;
+    }
+    s->file_size = sizeof(kMagic);
+    return s;
+  }
+
+  // Validate header.
+  char magic[8];
+  lseek(fd, 0, SEEK_SET);
+  if (!read_exact(fd, magic, 8) || memcmp(magic, kMagic, 8) != 0) {
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+
+  // Scan records; stop at the first torn/corrupt one.
+  uint64_t off = sizeof(kMagic);
+  std::vector<uint8_t> buf;
+  while (off + 8 <= static_cast<uint64_t>(st.st_size)) {
+    uint32_t hdr[2];
+    lseek(fd, static_cast<off_t>(off), SEEK_SET);
+    if (!read_exact(fd, hdr, 8)) break;
+    uint32_t len = hdr[0], crc = hdr[1];
+    if (len > kMaxRecord || off + 8 + len > static_cast<uint64_t>(st.st_size))
+      break;
+    buf.resize(len);
+    if (len > 0 && !read_exact(fd, buf.data(), len)) break;
+    if (crc32(buf.data(), len) != crc) break;
+    off += 8 + len;
+    s->count++;
+    s->payload_bytes += len;
+  }
+  s->file_size = off;
+  if (off < static_cast<uint64_t>(st.st_size)) {
+    // Torn tail: truncate back to the last valid record.
+    if (ftruncate(fd, static_cast<off_t>(off)) != 0) {
+      close(fd);
+      delete s;
+      return nullptr;
+    }
+  }
+  lseek(fd, static_cast<off_t>(off), SEEK_SET);
+  return s;
+}
+
+// Append one record (store_record analog, db-interface.c:65-96).
+// Returns the new record count, or 0 on error.
+uint64_t apus_store_append(apus_store* s, const void* data, uint32_t len) {
+  if (s == nullptr || len > kMaxRecord) return 0;
+  uint32_t hdr[2] = {len, crc32(static_cast<const uint8_t*>(data), len)};
+  lseek(s->fd, static_cast<off_t>(s->file_size), SEEK_SET);
+  if (!write_exact(s->fd, hdr, 8)) return 0;
+  if (len > 0 && !write_exact(s->fd, data, len)) {
+    // Roll back the partial record so the in-memory view stays valid.
+    ftruncate(s->fd, static_cast<off_t>(s->file_size));
+    return 0;
+  }
+  s->file_size += 8 + len;
+  s->count++;
+  s->payload_bytes += len;
+  return s->count;
+}
+
+int apus_store_sync(apus_store* s) {
+  if (s == nullptr) return -1;
+  return fdatasync(s->fd);
+}
+
+uint64_t apus_store_count(apus_store* s) { return s ? s->count : 0; }
+
+uint64_t apus_store_payload_bytes(apus_store* s) {
+  return s ? s->payload_bytes : 0;
+}
+
+// Size in bytes of the dump (get_records_len analog).
+uint64_t apus_store_dump_size(apus_store* s) {
+  if (s == nullptr) return 0;
+  return 8 + s->count * 4 + s->payload_bytes;
+}
+
+// Serialize all records into buf (dump_records analog,
+// db-interface.c:98-128).  buf must hold apus_store_dump_size() bytes.
+// Returns bytes written, or 0 on error.
+uint64_t apus_store_dump(apus_store* s, void* buf, uint64_t cap) {
+  if (s == nullptr) return 0;
+  uint64_t need = apus_store_dump_size(s);
+  if (cap < need) return 0;
+  uint8_t* out = static_cast<uint8_t*>(buf);
+  memcpy(out, &s->count, 8);
+  uint64_t w = 8;
+  uint64_t off = sizeof(kMagic);
+  std::vector<uint8_t> rec;
+  for (uint64_t i = 0; i < s->count; i++) {
+    uint32_t hdr[2];
+    lseek(s->fd, static_cast<off_t>(off), SEEK_SET);
+    if (!read_exact(s->fd, hdr, 8)) return 0;
+    uint32_t len = hdr[0];
+    rec.resize(len);
+    if (len > 0 && !read_exact(s->fd, rec.data(), len)) return 0;
+    memcpy(out + w, &len, 4);
+    w += 4;
+    memcpy(out + w, rec.data(), len);
+    w += len;
+    off += 8 + len;
+  }
+  lseek(s->fd, static_cast<off_t>(s->file_size), SEEK_SET);
+  return w;
+}
+
+// Replace the store's contents with a dump (snapshot apply analog,
+// proxy.c:306-339 re-stores every dumped record).  Returns the new
+// record count, or (uint64_t)-1 on error.
+uint64_t apus_store_load_dump(apus_store* s, const void* buf, uint64_t len) {
+  if (s == nullptr || len < 8) return static_cast<uint64_t>(-1);
+  const uint8_t* in = static_cast<const uint8_t*>(buf);
+  uint64_t count;
+  memcpy(&count, in, 8);
+  // Rewrite the file from scratch.
+  if (ftruncate(s->fd, 0) != 0) return static_cast<uint64_t>(-1);
+  lseek(s->fd, 0, SEEK_SET);
+  if (!write_exact(s->fd, kMagic, sizeof(kMagic)))
+    return static_cast<uint64_t>(-1);
+  s->count = 0;
+  s->payload_bytes = 0;
+  s->file_size = sizeof(kMagic);
+  uint64_t r = 8;
+  for (uint64_t i = 0; i < count; i++) {
+    if (r + 4 > len) return static_cast<uint64_t>(-1);
+    uint32_t rlen;
+    memcpy(&rlen, in + r, 4);
+    r += 4;
+    if (r + rlen > len || rlen > kMaxRecord)
+      return static_cast<uint64_t>(-1);
+    if (apus_store_append(s, in + r, rlen) == 0)
+      return static_cast<uint64_t>(-1);
+    r += rlen;
+  }
+  return s->count;
+}
+
+void apus_store_close(apus_store* s) {
+  if (s == nullptr) return;
+  fdatasync(s->fd);
+  close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,182 @@
+"""Durable record store: native C++ implementation, Python fallback,
+file-format interop, torn-tail recovery, daemon persistence."""
+
+import os
+import struct
+
+import pytest
+
+from apus_tpu.utils.store import (NativeRecordStore, PyRecordStore,
+                                  open_store, parse_dump)
+
+RECORDS = [b"alpha", b"", b"x" * 10000, bytes(range(256)) * 7, b"tail"]
+
+
+def native_available():
+    try:
+        from apus_tpu.utils.store import _load_lib
+        return _load_lib() is not None
+    except Exception:
+        return False
+
+
+@pytest.fixture(params=["native", "python"])
+def store_cls(request):
+    if request.param == "native":
+        if not native_available():
+            pytest.fail("native store must build in this image")
+        return NativeRecordStore
+    return PyRecordStore
+
+
+def test_append_reopen(tmp_path, store_cls):
+    p = str(tmp_path / "s.db")
+    with store_cls(p) as s:
+        for i, r in enumerate(RECORDS):
+            assert s.append(r) == i + 1
+        s.sync()
+        assert s.count == len(RECORDS)
+    with store_cls(p) as s:
+        assert s.count == len(RECORDS)
+        assert s.records() == RECORDS
+
+
+def test_dump_load_roundtrip(tmp_path, store_cls):
+    p1, p2 = str(tmp_path / "a.db"), str(tmp_path / "b.db")
+    with store_cls(p1) as a, store_cls(p2) as b:
+        for r in RECORDS:
+            a.append(r)
+        blob = a.dump()
+        assert parse_dump(blob) == RECORDS
+        assert b.load_dump(blob) == len(RECORDS)
+        assert b.records() == RECORDS
+
+
+def test_torn_tail_truncated(tmp_path, store_cls):
+    p = str(tmp_path / "s.db")
+    with store_cls(p) as s:
+        for r in RECORDS:
+            s.append(r)
+    # Corrupt the last record's payload byte -> crc mismatch.
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size - 1)
+        f.write(b"\xFF")
+    with store_cls(p) as s:
+        assert s.count == len(RECORDS) - 1
+        assert s.records() == RECORDS[:-1]
+        # And appending after recovery works.
+        s.append(b"recovered")
+    with store_cls(p) as s:
+        assert s.records() == RECORDS[:-1] + [b"recovered"]
+
+
+def test_partial_header_truncated(tmp_path, store_cls):
+    p = str(tmp_path / "s.db")
+    with store_cls(p) as s:
+        s.append(b"good")
+    with open(p, "ab") as f:
+        f.write(struct.pack("<I", 100))     # torn: len but no crc/data
+    with store_cls(p) as s:
+        assert s.records() == [b"good"]
+
+
+def test_cross_implementation_interop(tmp_path):
+    if not native_available():
+        pytest.fail("native store must build in this image")
+    p = str(tmp_path / "x.db")
+    with PyRecordStore(p) as s:
+        for r in RECORDS:
+            s.append(r)
+    with NativeRecordStore(p) as s:             # py -> native
+        assert s.records() == RECORDS
+        s.append(b"from-native")
+    with PyRecordStore(p) as s:                 # native -> py
+        assert s.records() == RECORDS + [b"from-native"]
+
+
+def test_daemon_persistence(tmp_path):
+    from apus_tpu.core.epdb import EndpointDB
+    from apus_tpu.models.kvs import KvsStateMachine
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.persist import Persistence, daemon_store_path
+
+    db = str(tmp_path / "dbs")
+    with LocalCluster(3, db_dir=db) as c:
+        c.wait_for_leader()
+        with ApusClient(c.spec.peers, clt_id=8) as client:
+            for i in range(10):
+                client.put(b"p%d" % i, b"q%d" % i)
+            client.get(b"p9")     # linearizable: all applied on leader
+        leader = c.wait_for_leader()
+        import time
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with leader.lock:
+                if leader.persistence.store.count >= 10:
+                    break
+            time.sleep(0.01)
+    # Offline: replay the leader's store into a fresh SM.
+    p = Persistence(daemon_store_path(db, leader.idx))
+    sm, epdb = KvsStateMachine(), EndpointDB()
+    nxt = p.replay_into(sm, epdb)
+    assert sm.store[b"p0"] == b"q0" and sm.store[b"p9"] == b"q9"
+    assert epdb.search(8).last_req_id >= 10
+    assert nxt > 10
+    p.close()
+
+
+def test_restart_no_record_duplication(tmp_path):
+    """Restarting with an existing store must replay it (not re-execute)
+    and catch-up must not re-persist already-stored records."""
+    import time
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.client import ApusClient
+
+    from apus_tpu.utils.config import ClusterSpec
+    db = str(tmp_path / "dbs")
+    # auto_remove off: re-admission of a removed member is the JOIN
+    # protocol's job (covered by the membership tests); here we exercise
+    # pure restart recovery of a still-member replica.
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030, elect_low=0.050,
+                       elect_high=0.150, auto_remove=False)
+    with LocalCluster(3, spec=spec, db_dir=db) as c:
+        leader = c.wait_for_leader()
+        follower = next(d for d in c.live() if d.idx != leader.idx)
+        fidx = follower.idx
+        with ApusClient(c.spec.peers, clt_id=6, timeout=20.0) as client:
+            for i in range(10):
+                client.put(b"r%d" % i, b"v%d" % i)
+            # Let the follower persist, then crash it.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with follower.lock:
+                    if follower.persistence.store.count >= 10:
+                        break
+                time.sleep(0.01)
+            c.kill(fidx)
+            for i in range(10, 20):
+                client.put(b"r%d" % i, b"v%d" % i)
+            d = c.restart(fidx)
+            # Catch-up: the restarted follower converges to 20 records
+            # with no duplicates.
+            deadline = time.monotonic() + 15
+            ok = False
+            while time.monotonic() < deadline:
+                with d.lock:
+                    if (d.persistence.store.count == 20
+                            and len(d.node.sm.store) == 20):
+                        ok = True
+                        break
+                time.sleep(0.02)
+            assert ok, (d.persistence.store.count, len(d.node.sm.store))
+            with d.lock:
+                recs = d.persistence.store.records()
+                idxs = [  # every persisted entry exactly once
+                    __import__("apus_tpu.runtime.persist",
+                               fromlist=["decode_record"])
+                    .decode_record(r).idx for r in recs]
+                assert len(idxs) == len(set(idxs))
+                assert d.node.sm.store[b"r0"] == b"v0"
+                assert d.node.sm.store[b"r19"] == b"v19"
