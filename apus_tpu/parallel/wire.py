@@ -11,7 +11,7 @@ the Python runtime interoperate.
 Struct layouts (little endian):
 
     Cid        = epoch:u32 state:u8 size:u8 new_size:u8 bitmask:u16
-    LogEntry   = idx:u64 term:u64 req_id:u64 clt_id:u32 type:u8 head:u64
+    LogEntry   = idx:u64 term:u64 req_id:u64 clt_id:u64 type:u8 head:u64
                  flags:u8 [cid if flags&1] dlen:u32 data
     VoteReq    = sid:u64 last_idx:u64 last_term:u64 epoch:u32
     Snapshot   = last_idx:u64 last_term:u64 dlen:u32 data
@@ -61,7 +61,7 @@ REGION_LIST = list(Region)
 REGION_INDEX = {r: i for i, r in enumerate(REGION_LIST)}
 
 _CID = struct.Struct("<IBBBH")
-_ENTRY_FIXED = struct.Struct("<QQQIBQB")
+_ENTRY_FIXED = struct.Struct("<QQQQBQB")
 _VOTEREQ = struct.Struct("<QQQI")
 _SNAP_FIXED = struct.Struct("<QQI")
 _U32 = struct.Struct("<I")

@@ -1,0 +1,205 @@
+"""ProxiedCluster: replicated *unmodified applications*.
+
+The full APUS deployment shape (benchmarks/run.sh:23-31): every replica
+runs (a) a consensus daemon and (b) an unmodified TCP server launched
+under ``LD_PRELOAD=interpose.so`` with env vars pointing at its local
+bridge.  Clients talk TCP to the leader's app; every inbound byte-stream
+is replicated through the log before the app sees it, and followers'
+apps are fed the same stream by replay — so any replica's app can answer
+reads and any replica can take over as leader.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import tempfile
+import time
+from typing import Optional, Sequence
+
+from apus_tpu.runtime.bridge import (INTERPOSE_SO, NATIVE_BUILD, REPO_ROOT,
+                                     Bridge, RelayStateMachine, proxy_env)
+from apus_tpu.runtime.cluster import LocalCluster
+from apus_tpu.utils.config import ClusterSpec
+
+#: Timing envelope for proxied clusters — the reference's DEBUG config
+#: (hb=10 ms, elect=100-300 ms, nodes.local.cfg:22-37).  Python daemons
+#: sharing cores with app processes and replay threads get GIL-starved
+#: at tighter timeouts, which shows up as spurious elections mid-bench.
+PROXIED_SPEC = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                           elect_low=0.150, elect_high=0.400)
+
+TOYSERVER = os.path.join(NATIVE_BUILD, "toyserver")
+
+
+def build_native() -> None:
+    """Ensure the native artifacts exist (make -C native)."""
+    if os.path.exists(TOYSERVER) and os.path.exists(INTERPOSE_SO):
+        return
+    subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "native")],
+                   check=True, capture_output=True, timeout=180)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ProxiedCluster:
+    """N replicas, each = daemon + bridge + app-under-interposer."""
+
+    def __init__(self, n: int, app_argv: Optional[Sequence[str]] = None,
+                 workdir: Optional[str] = None, spin_timeout_ms: int = 8000,
+                 **cluster_kwargs):
+        build_native()
+        self.n = n
+        self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proxied-")
+        self.app_ports = [free_port() for _ in range(n)]
+        self._app_argv = app_argv       # None -> toyserver
+        self._spin_timeout_ms = spin_timeout_ms
+        cluster_kwargs.setdefault("spec", PROXIED_SPEC)
+        self.cluster = LocalCluster(n, sm_factory=RelayStateMachine,
+                                    **cluster_kwargs)
+        self.bridges: list[Optional[Bridge]] = [
+            Bridge(d, self.workdir, app_port=self.app_ports[i])
+            for i, d in enumerate(self.cluster.daemons)
+        ]
+        self.apps: list[Optional[subprocess.Popen]] = [None] * n
+        self._app_logs: list = [None] * n
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.cluster.start()
+        for i in range(self.n):
+            self.bridges[i].start()
+            self.apps[i] = self._launch_app(i)
+        for i in range(self.n):
+            self._wait_app(i)
+
+    def stop(self) -> None:
+        for p in self.apps:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in self.apps:
+            if p is not None:
+                try:
+                    p.wait(timeout=3.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for b in self.bridges:
+            if b is not None:
+                b.stop()
+        self.cluster.stop()
+        for i, f in enumerate(self._app_logs):
+            if f is not None:
+                f.close()
+                self._app_logs[i] = None
+
+    def __enter__(self) -> "ProxiedCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _launch_app(self, i: int) -> subprocess.Popen:
+        argv = (list(self._app_argv) if self._app_argv is not None
+                else [TOYSERVER]) + [str(self.app_ports[i])]
+        env = dict(os.environ)
+        env.update(proxy_env(
+            self.bridges[i],
+            log_path=os.path.join(self.workdir, f"proxy{i}.log"),
+            spin_timeout_ms=self._spin_timeout_ms))
+        if self._app_logs[i] is None:
+            self._app_logs[i] = open(
+                os.path.join(self.workdir, f"app{i}.out"), "ab")
+        return subprocess.Popen(argv, env=env, stdout=self._app_logs[i],
+                                stderr=subprocess.STDOUT)
+
+    def _wait_app(self, i: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", self.app_ports[i]), timeout=0.5):
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise AssertionError(f"app {i} did not come up")
+
+    # -- fault injection --------------------------------------------------
+
+    def kill(self, idx: int) -> None:
+        """Crash one replica: app + bridge + daemon (the reconf_bench
+        kill -2 analog, reconf_bench.sh:100-117)."""
+        p = self.apps[idx]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait(timeout=3.0)
+        self.apps[idx] = None
+        b = self.bridges[idx]
+        if b is not None:
+            b.stop()
+        self.bridges[idx] = None
+        self.cluster.kill(idx)
+
+    # -- queries ----------------------------------------------------------
+
+    def leader_idx(self, timeout: float = 15.0) -> int:
+        d = self.cluster.wait_for_leader(timeout)
+        return d.idx
+
+    def app_addr(self, idx: int) -> tuple[str, int]:
+        return ("127.0.0.1", self.app_ports[idx])
+
+    # -- leader-aware client helper ---------------------------------------
+
+    def write_round(self, cmds: Sequence[str],
+                    attempts: int = 5) -> tuple[int, list[str]]:
+        """Issue commands to the leader's app, retrying the whole round
+        if leadership moved mid-round.  Real APUS clients chase the
+        leader the same way: capture is leader-gated (proxy.c:108), so
+        bytes written to a deposed leader's app bypass replication and
+        the round must be re-issued against the new leader."""
+        for _ in range(attempts):
+            leader = self.leader_idx()
+            try:
+                with LineClient(self.app_addr(leader)) as c:
+                    replies = [c.cmd(cmd) for cmd in cmds]
+            except OSError:
+                continue
+            d = self.cluster.daemons[leader]
+            if d is not None and d.node.is_leader:
+                return leader, replies
+        raise AssertionError("no stable leadership for a full write round")
+
+
+class LineClient:
+    """Tiny line-protocol client for toyserver-style apps."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 10.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    def cmd(self, line: str) -> str:
+        self.sock.sendall(line.encode() + b"\n")
+        while b"\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("app closed connection")
+            self._buf += chunk
+        out, self._buf = self._buf.split(b"\n", 1)
+        return out.decode()
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self) -> "LineClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

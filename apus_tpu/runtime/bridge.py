@@ -1,0 +1,445 @@
+"""Bridge: the replica daemon's half of the native proxy protocol.
+
+The reference proxy and consensus share one address space: captured
+requests flow through a spinlocked tailq (message.h:5-23) and commit
+release is two shared counters (cur_rec/highest_rec, proxy.c:45-46,
+proxy.c:160).  Our consensus runs in a separate daemon process, so this
+module terminates the proxy's unix-socket record stream, submits each
+record into the protocol ``Node``, and releases the app's spinning
+thread by writing ``highest_rec`` into the shared-memory block the proxy
+mmaps (native/apus_wire.h is the authoritative layout).
+
+Replay (the reference's follower half, do_action_connect/send/close,
+proxy.c:373-439) also lives here: committed records captured by *other*
+replicas are replayed into the local unmodified app over loopback TCP.
+A dedicated replay thread does the socket I/O so the protocol tick
+thread never blocks on the app.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import queue
+import select
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.types import EntryType, ProxyAction
+from apus_tpu.models.sm import Snapshot, StateMachine
+
+# -- shm layout (native/apus_wire.h parity) -------------------------------
+SHM_MAGIC = b"APUSSHM1"
+SHM_SIZE = 64
+_OFF_HIGHEST = 8
+_OFF_IS_LEADER = 16
+_OFF_TERM = 24
+_OFF_CUR_REC = 32
+_OFF_ABORTED = 40
+
+# proxy -> daemon frame body: u8 action | u64 conn_id | u64 cur_rec | data
+_HDR = struct.Struct("<BQQ")
+
+# Replicated record payload (the opaque "command" in the log entry):
+# u8 action | u64 conn_id | data.
+_REC = struct.Struct("<BQ")
+
+#: clt_id namespace for bridge-submitted records — disjoint from real
+#: client ids (ApusClient masks to 63 bits) so apply-time routing can
+#: recognize proxy records by the top bit.
+BRIDGE_CLT_BASE = 1 << 63
+
+
+def bridge_clt_id(replica_idx: int) -> int:
+    return BRIDGE_CLT_BASE | replica_idx
+
+
+def is_bridge_clt(clt_id: int) -> bool:
+    return bool(clt_id & BRIDGE_CLT_BASE)
+
+
+def encode_record(action: int, conn_id: int, data: bytes) -> bytes:
+    return _REC.pack(action, conn_id) + data
+
+
+def decode_record(payload: bytes) -> tuple[int, int, bytes]:
+    action, conn_id = _REC.unpack_from(payload, 0)
+    return action, conn_id, payload[_REC.size:]
+
+
+class RelayStateMachine(StateMachine):
+    """SM used by proxied replicas: the *real* state machine is the
+    replayed application (as in the reference, where the built-in KVS is
+    vestigial under APUS, dare_server.c:265-274).  Applied records are
+    retained so snapshots can rebuild a joiner's app by re-replay — the
+    reference's snapshot likewise *is* the proxy's durable record dump
+    (proxy.c:300, stablestorage_dump_records)."""
+
+    def __init__(self) -> None:
+        self.records: list[bytes] = []
+
+    def apply(self, idx: int, cmd: bytes) -> bytes:
+        self.records.append(cmd)
+        return b"OK"
+
+    def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
+        blob = b"".join(struct.pack("<I", len(r)) + r for r in self.records)
+        return Snapshot(last_idx, last_term, blob)
+
+    def apply_snapshot(self, snap: Snapshot) -> None:
+        self.records = []
+        off = 0
+        while off < len(snap.data):
+            (n,) = struct.unpack_from("<I", snap.data, off)
+            off += 4
+            self.records.append(snap.data[off:off + n])
+            off += n
+
+
+class Replayer:
+    """Replays committed records into the local unmodified app
+    (do_action_to_server analog, proxy.c:341-439).  Runs on its own
+    thread; the app's replies are drained and discarded (the reference
+    optionally logs them, proxy.c:354-366)."""
+
+    def __init__(self, app_host: str, app_port: int, logger=None):
+        self.app = (app_host, app_port)
+        self.logger = logger
+        self._q: "queue.Queue[Optional[tuple[int, int, bytes]]]" = \
+            queue.Queue()
+        self._conns: dict[int, socket.socket] = {}
+        self._thread: Optional[threading.Thread] = None
+        self.replayed = 0
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._run, name="apus-replay",
+                             daemon=True)
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    def submit(self, action: int, conn_id: int, data: bytes) -> None:
+        self._q.put((action, conn_id, data))
+
+    # -- worker -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            action, conn_id, data = item
+            try:
+                self._replay(action, conn_id, data)
+                self.replayed += 1
+            except OSError as e:
+                if self.logger is not None:
+                    self.logger.warning(
+                        "replay %s conn=%x failed: %s",
+                        ProxyAction(action).name, conn_id, e)
+
+    def _replay(self, action: int, conn_id: int, data: bytes) -> None:
+        if action == ProxyAction.CONNECT:
+            self._conns[conn_id] = self._connect()
+        elif action == ProxyAction.SEND:
+            conn = self._conns.get(conn_id)
+            if conn is None:
+                # Record stream started before we did (e.g. joiner whose
+                # snapshot replay recreated state but not live sockets).
+                conn = self._conns[conn_id] = self._connect()
+            conn.sendall(data)
+            self._drain(conn)
+        elif action == ProxyAction.CLOSE:
+            conn = self._conns.pop(conn_id, None)
+            if conn is not None:
+                conn.close()
+
+    #: Source address replay connections bind to.  The interposer
+    #: recognizes this peer address at accept time and permanently
+    #: excludes the connection from capture — otherwise a follower that
+    #: becomes leader mid-replay would re-capture replayed bytes and
+    #: double-replicate them.  (The reference's analog is the is_inner
+    #: thread check, proxy.c:91-106: replay I/O there is issued by the
+    #: consensus thread inside the same process.)
+    REPLAY_SRC = "127.0.0.2"
+
+    def _connect(self) -> socket.socket:
+        last: Optional[OSError] = None
+        for _ in range(50):                 # app may still be starting
+            try:
+                s = socket.create_connection(
+                    self.app, timeout=1.0,
+                    source_address=(self.REPLAY_SRC, 0))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Blocking sends (with a generous timeout) — a partial
+                # non-blocking send would tear the replayed byte stream.
+                s.settimeout(10.0)
+                return s
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise last or OSError("replay connect failed")
+
+    def _drain(self, conn: socket.socket) -> None:
+        """Discard pending replies so the app's send buffer never fills.
+        Readability is pre-checked with a zero-timeout select — a plain
+        recv on a timeout-mode socket would block up to the send timeout
+        when the app hasn't replied yet."""
+        try:
+            while select.select([conn], [], [], 0)[0]:
+                if not conn.recv(65536):
+                    break
+        except OSError:
+            pass
+
+
+class Bridge:
+    """Daemon-side endpoint for one replica's native proxy."""
+
+    def __init__(self, daemon, workdir: str,
+                 app_host: Optional[str] = None,
+                 app_port: Optional[int] = None):
+        self.daemon = daemon
+        self.idx = daemon.idx
+        self.clt_id = bridge_clt_id(self.idx)
+        self.logger = daemon.logger
+        os.makedirs(workdir, exist_ok=True)
+        self.shm_path = os.path.join(workdir, f"bridge{self.idx}.shm")
+        self.sock_path = os.path.join(workdir, f"bridge{self.idx}.sock")
+
+        host = app_host if app_host is not None else daemon.spec.app_host
+        port = app_port if app_port is not None else daemon.spec.app_port
+        self.replayer = Replayer(host, port, self.logger)
+
+        # shm block: create + zero + magic.
+        with open(self.shm_path, "wb") as f:
+            f.write(SHM_MAGIC + b"\0" * (SHM_SIZE - len(SHM_MAGIC)))
+        self._shm_file = open(self.shm_path, "r+b")
+        self._shm = mmap.mmap(self._shm_file.fileno(), SHM_SIZE)
+        # Guards every shm counter update: _release/abort accounting runs
+        # from both bridge reader threads and the daemon tick thread, and
+        # an unsynchronized check-then-write could move highest_rec
+        # backwards (stranding a spinning app thread).
+        self._shm_lock = threading.Lock()
+
+        # Restart continuity: record numbering must stay strictly above
+        # every req_id this bridge EVER issued — including pre-crash
+        # records that were logged but not yet applied (the durable
+        # store holds applied entries only, so their req_ids are not
+        # recoverable locally; a peer may still deliver them during
+        # catch-up, and a collision would make exactly-once dedup
+        # swallow a fresh distinct capture).  A wall-clock-seconds boot
+        # epoch in the high half makes every restart's numbering range
+        # disjoint and per-client monotone, as the endpoint DB requires.
+        ep = daemon.node.epdb.search(self.clt_id)
+        base = max(int(time.time()) << 32,
+                   (ep.last_req_id + 1) if ep is not None else 0)
+        self._shm_set(_OFF_CUR_REC, base)
+        self._shm_set(_OFF_HIGHEST, base)
+        self._last_submitted = base
+
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        self._lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lsock.bind(self.sock_path)
+        self._lsock.listen(4)
+        self._lsock.settimeout(0.2)
+
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._sub_lock = threading.Lock()
+
+        daemon.on_commit.append(self._on_commit)
+        # Role/term mirrored into shm inside the daemon tick (under the
+        # node lock): a client that observed leadership via the locked
+        # wait_for_leader path is then guaranteed an open capture gate.
+        daemon.on_tick.append(self._mirror_role)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.replayer.start()
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"apus-bridge-accept-{self.idx}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Unhook from the daemon first (under its lock) so no tick can
+        # touch the mmap once it's closed below.
+        with self.daemon.lock:
+            if self._mirror_role in self.daemon.on_tick:
+                self.daemon.on_tick.remove(self._mirror_role)
+            if self._on_commit in self.daemon.on_commit:
+                self.daemon.on_commit.remove(self._on_commit)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.replayer.stop()
+        self._lsock.close()
+        self._shm.close()
+        self._shm_file.close()
+        for p in (self.sock_path,):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    # -- shm accessors ----------------------------------------------------
+
+    def _shm_get(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._shm, off)[0]
+
+    def _shm_set(self, off: int, val: int) -> None:
+        struct.pack_into("<Q", self._shm, off, val)
+
+    @property
+    def highest_rec(self) -> int:
+        return self._shm_get(_OFF_HIGHEST)
+
+    def _release(self, rec: int, abort: bool = False) -> None:
+        """Monotone advance of the release counter
+        (update_highest_rec analog, proxy.c:263-267)."""
+        with self._shm_lock:
+            prev = self._shm_get(_OFF_HIGHEST)
+            if rec > prev:
+                self._shm_set(_OFF_HIGHEST, rec)
+                if abort:
+                    self._shm_set(_OFF_ABORTED,
+                                  self._shm_get(_OFF_ABORTED) + rec - prev)
+
+    # -- proxy socket -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._reader, args=(conn,),
+                                 name=f"apus-bridge-rd-{self.idx}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _reader(self, conn: socket.socket) -> None:
+        """Drain one proxy connection: frames arrive in cur_rec order
+        (the tailq-drain analog, get_tailq_message dare_ibv_ud.c:780-790)."""
+        conn.settimeout(0.5)
+        buf = b""
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                buf = self._consume(buf)
+        finally:
+            conn.close()
+
+    def _consume(self, buf: bytes) -> bytes:
+        off = 0
+        while len(buf) - off >= 4:
+            (n,) = struct.unpack_from("<I", buf, off)
+            if len(buf) - off - 4 < n:
+                break
+            body = buf[off + 4:off + 4 + n]
+            off += 4 + n
+            action, conn_id, cur_rec = _HDR.unpack_from(body, 0)
+            self._submit(action, conn_id, cur_rec, body[_HDR.size:])
+        return buf[off:]
+
+    def _submit(self, action: int, conn_id: int, cur_rec: int,
+                data: bytes) -> None:
+        payload = encode_record(action, conn_id, data)
+        with self._sub_lock:
+            self._last_submitted = max(self._last_submitted, cur_rec)
+        with self.daemon.lock:
+            pr = self.daemon.node.submit(cur_rec, self.clt_id, payload)
+        if pr is None:
+            # Not leader (anymore): the record can't commit through us.
+            # Release the spinning app thread; the client will observe
+            # failover semantics and retry (reference behavior: capture
+            # is leader-gated, proxy.c:108).
+            self._release(cur_rec, abort=True)
+        elif pr.reply is not None:
+            # Duplicate of an already-applied record (daemon restarted
+            # and replayed its durable store): already released.
+            self._release(cur_rec)
+
+    # -- role mirror + abort sweep (runs in the daemon tick, under the
+    # node lock) ----------------------------------------------------------
+
+    def _mirror_role(self) -> None:
+        """Mirror role/term into shm for the proxy's capture gate, and
+        release records stranded by leadership loss (they can no longer
+        commit through this replica; the spinning app thread proceeds
+        and the client observes failover semantics)."""
+        node = self.daemon.node
+        self._shm_set(_OFF_IS_LEADER, 1 if node.is_leader else 0)
+        self._shm_set(_OFF_TERM, node.current_term)
+        if not node.is_leader:
+            with self._sub_lock:
+                last = self._last_submitted
+            if self.highest_rec < last:
+                self._release(last, abort=True)
+
+    # -- commit upcall ----------------------------------------------------
+
+    def _on_commit(self, e: LogEntry) -> None:
+        """Committed-entry routing (apply_committed_entries' proxy calls,
+        dare_server.c:1953-1955): our own records release the captured
+        app thread; records captured elsewhere replay into the local app."""
+        if e.type != EntryType.CSM or not is_bridge_clt(e.clt_id):
+            return
+        if e.clt_id == self.clt_id:
+            self._release(e.req_id)
+        else:
+            action, conn_id, data = decode_record(e.data)
+            self.replayer.submit(action, conn_id, data)
+
+
+#: Repo-root native build artifacts (single source of truth; appcluster
+#: and the benchmark harness import these).
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_BUILD = os.path.join(REPO_ROOT, "native", "build")
+INTERPOSE_SO = os.path.join(NATIVE_BUILD, "interpose.so")
+
+
+def proxy_env(bridge: Bridge, log_path: Optional[str] = None,
+              spin_timeout_ms: Optional[int] = None) -> dict[str, str]:
+    """Environment for launching an app under the interposer against
+    this bridge (the run.sh:23-31 env-var analog)."""
+    env = {
+        "LD_PRELOAD": INTERPOSE_SO,
+        "APUS_BRIDGE_SOCK": bridge.sock_path,
+        "APUS_BRIDGE_SHM": bridge.shm_path,
+    }
+    if log_path is not None:
+        env["APUS_PROXY_LOG"] = log_path
+    if spin_timeout_ms is not None:
+        env["APUS_SPIN_TIMEOUT_MS"] = str(spin_timeout_ms)
+    return env

@@ -76,7 +76,14 @@ class LocalCluster:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             # A stable leader: exactly one live daemon claims leadership.
-            leaders = [d for d in self.live() if d.is_leader]
+            # Checked under each daemon's lock so that when this returns,
+            # the leader's current tick — including its bridge's shm role
+            # mirror (runtime/bridge.py) — has fully completed.
+            leaders = []
+            for d in self.live():
+                with d.lock:
+                    if d.is_leader:
+                        leaders.append(d)
             if len(leaders) == 1:
                 return leaders[0]
             time.sleep(0.005)

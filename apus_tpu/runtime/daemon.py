@@ -84,6 +84,10 @@ class ReplicaDaemon:
         # Committed-entry observers (proxy callback table analog):
         # each gets (LogEntry); registered by persistence/replay layers.
         self.on_commit: list[Callable[[LogEntry], None]] = []
+        # Per-tick observers, called under the node lock after upcalls —
+        # used by the bridge to mirror role/term into shared memory
+        # synchronously with role transitions (no stale-flag window).
+        self.on_tick: list[Callable[[], None]] = []
 
         # Durable store (stable storage, db-interface.c analog).  On
         # restart with an existing store, replay it into the SM and
@@ -142,6 +146,8 @@ class ReplicaDaemon:
                     self.node.tick(time.monotonic())
                     self._drain_upcalls()
                     self._log_role_changes()
+                    for cb in self.on_tick:
+                        cb()
                     self.commit_cond.notify_all()
             except Exception:
                 # A tick must never silently kill the replica (a dead

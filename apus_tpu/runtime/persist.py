@@ -25,6 +25,13 @@ from apus_tpu.models.sm import Snapshot, StateMachine
 from apus_tpu.parallel import wire
 from apus_tpu.utils.store import open_store, parse_dump
 
+#: On-disk record layout magic.  The wire LogEntry layout is shared
+#: with the network protocol, which may evolve; the 4-byte magic makes a
+#: stale store fail loudly instead of decoding garbage — deterministic,
+#: unlike a 1-byte version that a v1 record's idx LSB could collide
+#: with.  (APR1 was a dev format with u32 clt_id; APR2 widened it.)
+RECORD_MAGIC = b"APR2"
+
 
 class Persistence:
     """Attach to a ReplicaDaemon: persists every applied CSM entry."""
@@ -33,7 +40,7 @@ class Persistence:
         self.store = open_store(path, prefer_native=prefer_native)
 
     def on_commit(self, e: LogEntry) -> None:
-        self.store.append(wire.encode_entry(e))
+        self.store.append(RECORD_MAGIC + wire.encode_entry(e))
 
     # -- snapshots --------------------------------------------------------
 
@@ -72,24 +79,17 @@ class Persistence:
 
 
 def decode_record(rec: bytes) -> LogEntry:
-    return wire.decode_entry(wire.Reader(rec))
+    if rec[:4] != RECORD_MAGIC:
+        raise ValueError(
+            f"unsupported store record format {rec[:4]!r} "
+            f"(expected {RECORD_MAGIC!r}); refusing to decode")
+    return wire.decode_entry(wire.Reader(rec[4:]))
 
 
 def last_record_entry(blob: bytes):
-    """Decode only the final record of a dump (walks lengths, copies
-    nothing but the last record)."""
-    import struct
-    (count,) = struct.unpack_from("<Q", blob, 0)
-    if count == 0:
-        return None
-    off = 8
-    last = None
-    for _ in range(count):
-        (ln,) = struct.unpack_from("<I", blob, off)
-        off += 4
-        last = (off, ln)
-        off += ln
-    return decode_record(blob[last[0]:last[0] + last[1]])
+    """Decode the final record of a dump, or None if empty."""
+    recs = parse_dump(blob)
+    return decode_record(recs[-1]) if recs else None
 
 
 def replay(records: list[bytes], sm: StateMachine,

@@ -1,0 +1,55 @@
+// Shared wire/shm layout between the native proxy (interpose.so) and the
+// Python replica daemon (apus_tpu/runtime/bridge.py).  Keep in sync with
+// the constants there.
+//
+// TPU-era re-cut of the reference's in-process proxy<->DARE handoff:
+// the reference shares a spinlocked tailq + two counters between the app
+// thread and the consensus thread (message.h:5-23, proxy.c:108-161,
+// cur_rec/highest_rec proxy.c:45-46).  We run consensus in a separate
+// daemon process, so the tailq becomes a unix-domain socket stream of
+// framed records and the counters live in a small mmap'd shared-memory
+// region the proxy spin-reads (the proxy.c:160 spin analog).
+
+#ifndef APUS_WIRE_H_
+#define APUS_WIRE_H_
+
+#include <stdint.h>
+
+// -- replicated request record kinds (ProxyAction parity; proxy.c:341-439)
+enum apus_action : uint8_t {
+  APUS_ACT_CONNECT = 0,
+  APUS_ACT_SEND = 1,
+  APUS_ACT_CLOSE = 2,
+};
+
+// -- proxy -> daemon frame over the unix socket ---------------------------
+// u32 len | u8 action | u64 conn_id | u64 cur_rec | payload[len-17]
+// (len counts everything after the u32).  Records are submitted in
+// cur_rec order; the stream socket preserves it, which is what makes the
+// single highest_rec release counter sufficient.
+struct apus_bridge_hdr {
+  uint8_t action;
+  uint64_t conn_id;
+  uint64_t cur_rec;
+} __attribute__((packed));
+
+// -- shared-memory control block -----------------------------------------
+// The daemon creates and owns the file; the proxy mmaps it.  All fields
+// are 8-byte aligned; cross-process visibility via __atomic builtins.
+#define APUS_SHM_MAGIC "APUSSHM1"
+#define APUS_SHM_SIZE 64
+
+struct apus_shm {
+  char magic[8];
+  volatile uint64_t highest_rec;  // last released record (daemon writes)
+  volatile uint64_t is_leader;    // role flag (daemon writes)
+  volatile uint64_t term;         // current term (daemon writes)
+  volatile uint64_t cur_rec;      // capture counter (proxy fetch-adds)
+  volatile uint64_t aborted;      // records released without commit
+  uint64_t pad[2];
+};
+
+// Max raw request record (TCP rcvbuf-sized, message.h:7 parity).
+#define APUS_MAX_RECORD 87380
+
+#endif  // APUS_WIRE_H_

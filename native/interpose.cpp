@@ -1,0 +1,114 @@
+// interpose.so: LD_PRELOAD syscall interposer.
+//
+// TPU-era equivalent of the reference's src/spec_hooks.cpp: hijack
+// __libc_start_main to initialize the proxy before the app's main
+// (spec_hooks.cpp:48-100), then wrap accept/accept4/read/close and
+// forward socket events to the proxy (spec_hooks.cpp:102-178).  The
+// fstat+S_ISSOCK guard mirrors spec_hooks.cpp:111-117; fds owned by the
+// proxy itself are skipped (the reference instead skips events raised
+// from DARE-internal threads, proxy.c:91-106 — our consensus runs out of
+// process, so only the bridge socket needs exclusion).
+
+#include <dlfcn.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+void apus_proxy_init(void);
+void apus_proxy_on_accept(int fd);
+void apus_proxy_on_read(int fd, const void* buf, long n);
+void apus_proxy_on_close(int fd);
+int apus_proxy_owns_fd(int fd);
+int apus_proxy_active(void);
+}
+
+namespace {
+
+bool fd_is_socket(int fd) {
+  struct stat st;
+  return fstat(fd, &st) == 0 && S_ISSOCK(st.st_mode);
+}
+
+template <typename Fn>
+Fn next_sym(const char* name) {
+  return reinterpret_cast<Fn>(dlsym(RTLD_NEXT, name));
+}
+
+using main_fn = int (*)(int, char**, char**);
+main_fn real_main = nullptr;
+
+int wrapped_main(int argc, char** argv, char** envp) {
+  apus_proxy_init();
+  return real_main(argc, argv, envp);
+}
+
+}  // namespace
+
+extern "C" {
+
+// __libc_start_main hook (spec_hooks.cpp:48): swap in wrapped_main so
+// the proxy comes up before the unmodified app's main.
+int __libc_start_main(main_fn main_ptr, int argc, char** ubp_av,
+                      void (*init)(int, char**, char**), void (*fini)(void),
+                      void (*rtld_fini)(void), void* stack_end) {
+  using start_fn = int (*)(main_fn, int, char**,
+                           void (*)(int, char**, char**), void (*)(void),
+                           void (*)(void), void*);
+  static start_fn real = next_sym<start_fn>("__libc_start_main");
+  real_main = main_ptr;
+  return real(wrapped_main, argc, ubp_av, init, fini, rtld_fini, stack_end);
+}
+
+int accept(int sockfd, struct sockaddr* addr, socklen_t* addrlen) {
+  using fn = int (*)(int, struct sockaddr*, socklen_t*);
+  static fn real = next_sym<fn>("accept");
+  int fd = real(sockfd, addr, addrlen);
+  if (fd >= 0 && apus_proxy_active() && fd_is_socket(fd))
+    apus_proxy_on_accept(fd);
+  return fd;
+}
+
+int accept4(int sockfd, struct sockaddr* addr, socklen_t* addrlen,
+            int flags) {
+  using fn = int (*)(int, struct sockaddr*, socklen_t*, int);
+  static fn real = next_sym<fn>("accept4");
+  int fd = real(sockfd, addr, addrlen, flags);
+  if (fd >= 0 && apus_proxy_active() && fd_is_socket(fd))
+    apus_proxy_on_accept(fd);
+  return fd;
+}
+
+ssize_t read(int fd, void* buf, size_t count) {
+  using fn = ssize_t (*)(int, void*, size_t);
+  static fn real = next_sym<fn>("read");
+  ssize_t n = real(fd, buf, count);
+  // The proxy's captured-connection map filters out non-captured fds, so
+  // plain file reads pay one map lookup only when the proxy is active.
+  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd))
+    apus_proxy_on_read(fd, buf, n);
+  return n;
+}
+
+// recv() commonly backs the same code paths as read() in socket servers
+// (the reference's redis build happens to use read; hooking both keeps
+// us app-agnostic).
+ssize_t recv(int fd, void* buf, size_t count, int flags) {
+  using fn = ssize_t (*)(int, void*, size_t, int);
+  static fn real = next_sym<fn>("recv");
+  ssize_t n = real(fd, buf, count, flags);
+  if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
+      !apus_proxy_owns_fd(fd))
+    apus_proxy_on_read(fd, buf, n);
+  return n;
+}
+
+int close(int fd) {
+  using fn = int (*)(int);
+  static fn real = next_sym<fn>("close");
+  if (apus_proxy_active() && !apus_proxy_owns_fd(fd))
+    apus_proxy_on_close(fd);
+  return real(fd);
+}
+
+}  // extern "C"

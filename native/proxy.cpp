@@ -1,0 +1,293 @@
+// Native proxy: leader-side request capture for unmodified servers.
+//
+// TPU-era equivalent of the reference proxy's capture half
+// (src/proxy/proxy.c).  The reference numbers every intercepted
+// CONNECT/SEND/CLOSE under a spinlock, appends it to an in-process tailq
+// shared with the consensus thread, and spin-waits on
+// `cur_rec > highest_rec` until the entry is committed + applied
+// cluster-wide (leader_handle_submit_req, proxy.c:108-161).
+//
+// Here consensus lives in a separate replica daemon, so:
+//   - the tailq is a unix-domain socket stream of framed records
+//     (ordering preserved by the stream = ordering by cur_rec);
+//   - cur_rec is a fetch-add counter in a daemon-owned shared-memory
+//     block; highest_rec is written there by the daemon when the record
+//     is applied (apus_tpu/runtime/bridge.py), and the app thread spins
+//     on it exactly like proxy.c:160.
+//
+// The replay half (do_action_connect/send/close, proxy.c:373-439) runs
+// in the daemon: followers replay committed records into their local app
+// over loopback TCP, so this library is capture-only.
+//
+// Role handling: capture happens only while the shm role flag says
+// leader (proxy_on_read's is_leader gate analog).  Records that can no
+// longer commit (leadership lost mid-flight) are released by the daemon
+// via the same counter, with `aborted` bumped for observability.
+
+#include "apus_wire.h"
+
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+// conn map values: 0 = registered but unnumbered, kExcluded = never
+// capture (daemon replay connection), else the replicated conn_id.
+constexpr uint64_t kExcluded = ~0ULL;
+
+// Source address the daemon's replayer binds to (bridge.py REPLAY_SRC).
+// Connections from it carry replayed bytes and must never be captured,
+// or a follower promoted to leader mid-replay would re-replicate them
+// (the reference's is_inner exclusion, proxy.c:91-106).
+constexpr uint32_t kReplaySrcBE = 0x0200007f;  // 127.0.0.2, network order
+
+struct ProxyState {
+  bool active = false;
+  int sock = -1;                       // unix socket to the daemon
+  apus_shm* shm = nullptr;
+  // Two locks on purpose: `lock` guards only the conns map (taken by
+  // every hooked read()/close(), including on uncaptured fds, so it
+  // must never wait on I/O); `send_lock` serializes {cur_rec fetch-add,
+  // socket write} so stream order matches record numbering even when
+  // the daemon applies backpressure.
+  pthread_mutex_t lock = PTHREAD_MUTEX_INITIALIZER;
+  pthread_mutex_t send_lock = PTHREAD_MUTEX_INITIALIZER;
+  std::unordered_map<int, uint64_t> conns;  // registered fd -> conn_id
+  uint64_t conn_seq = 0;
+  uint64_t spin_timeout_ms = 10000;
+  FILE* log = nullptr;
+};
+
+ProxyState g;
+
+void plog(const char* fmt, ...) {
+  if (g.log == nullptr) return;
+  va_list ap;
+  va_start(ap, fmt);
+  vfprintf(g.log, fmt, ap);
+  va_end(ap);
+  fputc('\n', g.log);
+  fflush(g.log);
+}
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool is_leader() {
+  return g.shm != nullptr &&
+         __atomic_load_n(&g.shm->is_leader, __ATOMIC_ACQUIRE) != 0;
+}
+
+// Ship one record to the daemon and return its cur_rec number.  Caller
+// holds no lock; numbering + socket write happen under g.send_lock so
+// the stream order matches cur_rec order (the reference gets the same
+// guarantee from assigning cur_rec inside the tailq critical section,
+// proxy.c:114-156).
+uint64_t ship_record(uint8_t action, uint64_t conn_id, const void* data,
+                     uint32_t len) {
+  apus_bridge_hdr hdr;
+  hdr.action = action;
+  hdr.conn_id = conn_id;
+  uint32_t frame_len = static_cast<uint32_t>(sizeof(hdr)) + len;
+
+  pthread_mutex_lock(&g.send_lock);
+  uint64_t rec =
+      __atomic_add_fetch(&g.shm->cur_rec, 1, __ATOMIC_ACQ_REL);
+  hdr.cur_rec = rec;
+  bool ok = write_exact(g.sock, &frame_len, 4) &&
+            write_exact(g.sock, &hdr, sizeof(hdr)) &&
+            (len == 0 || write_exact(g.sock, data, len));
+  pthread_mutex_unlock(&g.send_lock);
+
+  if (!ok) {
+    plog("proxy: daemon socket write failed (errno %d); deactivating",
+         errno);
+    g.active = false;
+    return 0;
+  }
+  return rec;
+}
+
+// Block until the record is applied cluster-wide (proxy.c:160 analog).
+void wait_released(uint64_t rec) {
+  if (rec == 0) return;
+  uint64_t start = now_ms();
+  uint32_t spins = 0;
+  while (__atomic_load_n(&g.shm->highest_rec, __ATOMIC_ACQUIRE) < rec) {
+    if (++spins < 4096) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+      continue;
+    }
+    // Past the hot window, yield the core; sub-ms wakeups keep the
+    // added latency far below the consensus round itself.
+    struct timespec ts = {0, 50000};  // 50 us
+    nanosleep(&ts, nullptr);
+    if (g.spin_timeout_ms > 0 && now_ms() - start > g.spin_timeout_ms) {
+      plog("proxy: record %llu not released in %llu ms; proceeding",
+           (unsigned long long)rec, (unsigned long long)g.spin_timeout_ms);
+      return;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// True for fds the proxy itself owns (the interposer must not capture
+// events on them; is_inner analog, proxy.c:91-106).
+int apus_proxy_owns_fd(int fd) { return g.active && fd == g.sock; }
+
+int apus_proxy_active(void) { return g.active ? 1 : 0; }
+
+// Called once before the app's main() (tern_init_func analog,
+// spec_hooks.cpp:22-34).  Activates only when both bridge endpoints are
+// configured and reachable; otherwise the app runs untouched.
+void apus_proxy_init(void) {
+  const char* sock_path = getenv("APUS_BRIDGE_SOCK");
+  const char* shm_path = getenv("APUS_BRIDGE_SHM");
+  const char* log_path = getenv("APUS_PROXY_LOG");
+  const char* timeout = getenv("APUS_SPIN_TIMEOUT_MS");
+  if (log_path != nullptr) g.log = fopen(log_path, "a");
+  if (sock_path == nullptr || shm_path == nullptr) {
+    plog("proxy: APUS_BRIDGE_SOCK/APUS_BRIDGE_SHM unset; inactive");
+    return;
+  }
+  if (timeout != nullptr) g.spin_timeout_ms = strtoull(timeout, nullptr, 10);
+
+  int fd = open(shm_path, O_RDWR);
+  if (fd < 0) {
+    plog("proxy: open(%s) failed (errno %d); inactive", shm_path, errno);
+    return;
+  }
+  void* m = mmap(nullptr, APUS_SHM_SIZE, PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (m == MAP_FAILED ||
+      memcmp(m, APUS_SHM_MAGIC, 8) != 0) {
+    plog("proxy: bad shm at %s; inactive", shm_path);
+    return;
+  }
+  g.shm = static_cast<apus_shm*>(m);
+
+  int s = socket(AF_UNIX, SOCK_STREAM, 0);
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (s < 0 || connect(s, reinterpret_cast<sockaddr*>(&addr),
+                       sizeof(addr)) != 0) {
+    plog("proxy: connect(%s) failed (errno %d); inactive", sock_path, errno);
+    if (s >= 0) close(s);
+    return;
+  }
+  g.sock = s;
+  g.active = true;
+  plog("proxy: active (sock=%s shm=%s pid=%d)", sock_path, shm_path,
+       getpid());
+}
+
+// accept/accept4 returned a new connection (proxy_on_accept analog,
+// proxy.c:241-248).  The connection is registered but NOT yet numbered:
+// the capture decision is made per-read against the *current* role —
+// exactly the reference's gate (proxy_on_read checks is_leader at read
+// time) — so a connection accepted an instant before the role flag
+// settles still gets captured from its first leader-side read on.
+void apus_proxy_on_accept(int fd) {
+  if (!g.active) return;
+  uint64_t mark = 0;  // unnumbered (no CONNECT replicated yet)
+  struct sockaddr_in peer;
+  socklen_t plen = sizeof(peer);
+  if (getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) == 0 &&
+      peer.sin_family == AF_INET &&
+      peer.sin_addr.s_addr == kReplaySrcBE)
+    mark = kExcluded;  // daemon replay connection: never capture
+  pthread_mutex_lock(&g.lock);
+  g.conns[fd] = mark;
+  pthread_mutex_unlock(&g.lock);
+}
+
+// read() returned n>0 bytes on a registered connection (proxy_on_read
+// analog, proxy.c:230-239): replicate before the app may act on them.
+void apus_proxy_on_read(int fd, const void* buf, long n) {
+  if (!g.active || n <= 0 || !is_leader()) return;
+  pthread_mutex_lock(&g.lock);
+  auto it = g.conns.find(fd);
+  uint64_t conn_id = 0;
+  bool fresh = false;
+  if (it != g.conns.end() && it->second != kExcluded) {
+    if (it->second == 0) {
+      // First leader-side read: number the connection now (pid-salted
+      // sequence, unique across restarts/failovers).
+      it->second = (static_cast<uint64_t>(getpid()) << 32) | ++g.conn_seq;
+      fresh = true;
+    }
+    conn_id = it->second;
+  }
+  pthread_mutex_unlock(&g.lock);
+  if (conn_id == 0) return;
+  if (fresh) wait_released(ship_record(APUS_ACT_CONNECT, conn_id, nullptr, 0));
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  // Oversized reads segment into max-record chunks (the reference caps
+  // records at its rcvbuf size instead, message.h:7).
+  while (n > 0) {
+    uint32_t chunk =
+        n > APUS_MAX_RECORD ? APUS_MAX_RECORD : static_cast<uint32_t>(n);
+    wait_released(ship_record(APUS_ACT_SEND, conn_id, p, chunk));
+    p += chunk;
+    n -= chunk;
+  }
+}
+
+// close() on a registered connection (proxy_on_close analog,
+// proxy.c:250-261).  Only numbered (captured) connections replicate a
+// CLOSE — unnumbered ones never produced a CONNECT.
+void apus_proxy_on_close(int fd) {
+  if (!g.active) return;
+  pthread_mutex_lock(&g.lock);
+  auto it = g.conns.find(fd);
+  uint64_t conn_id = 0;
+  if (it != g.conns.end()) {
+    conn_id = it->second;
+    g.conns.erase(it);
+  }
+  pthread_mutex_unlock(&g.lock);
+  if (conn_id == 0 || conn_id == kExcluded) return;
+  wait_released(ship_record(APUS_ACT_CLOSE, conn_id, nullptr, 0));
+}
+
+}  // extern "C"

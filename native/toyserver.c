@@ -1,0 +1,209 @@
+/* toyserver: a minimal unmodified TCP key-value server.
+ *
+ * Stands in for the reference's real applications (redis/memcached/ssdb,
+ * apps/) in hermetic tests: a single-threaded select() loop speaking a
+ * newline protocol over read()/write() — exactly the syscall surface the
+ * interposer hooks (accept/read/close).  It knows nothing about
+ * replication; fault tolerance comes entirely from running it under
+ * LD_PRELOAD=interpose.so, as the reference does with redis
+ * (benchmarks/run.sh:26).
+ *
+ * Protocol (one command per line):
+ *   SET <key> <value>   -> OK
+ *   GET <key>           -> <value> | NIL
+ *   DEL <key>           -> OK | NIL
+ *   COUNT               -> <number of keys>
+ *   PING                -> PONG
+ *
+ * Usage: toyserver <port>
+ */
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define MAX_CLIENTS 64
+#define BUF_SIZE 65536
+#define MAX_KEYS 4096
+#define MAX_KEY 256
+#define MAX_VAL 4096
+
+struct kv {
+  char key[MAX_KEY];
+  char val[MAX_VAL];
+  int used;
+};
+
+static struct kv table[MAX_KEYS];
+
+static struct kv* kv_find(const char* key) {
+  for (int i = 0; i < MAX_KEYS; i++)
+    if (table[i].used && strcmp(table[i].key, key) == 0) return &table[i];
+  return NULL;
+}
+
+static int kv_set(const char* key, const char* val) {
+  struct kv* e = kv_find(key);
+  if (e == NULL) {
+    for (int i = 0; i < MAX_KEYS; i++)
+      if (!table[i].used) {
+        e = &table[i];
+        break;
+      }
+    if (e == NULL) return -1;
+    snprintf(e->key, MAX_KEY, "%s", key);
+    e->used = 1;
+  }
+  snprintf(e->val, MAX_VAL, "%s", val);
+  return 0;
+}
+
+static int kv_count(void) {
+  int n = 0;
+  for (int i = 0; i < MAX_KEYS; i++) n += table[i].used;
+  return n;
+}
+
+struct client {
+  int fd;
+  char buf[BUF_SIZE];
+  size_t len;
+};
+
+static void reply(int fd, const char* s) {
+  size_t n = strlen(s);
+  const char* p = s;
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) return;
+    p += w;
+    n -= (size_t)w;
+  }
+}
+
+static void handle_line(int fd, char* line) {
+  char* sp = strchr(line, ' ');
+  if (strcmp(line, "PING") == 0) {
+    reply(fd, "PONG\n");
+  } else if (strcmp(line, "COUNT") == 0) {
+    char out[32];
+    snprintf(out, sizeof(out), "%d\n", kv_count());
+    reply(fd, out);
+  } else if (sp != NULL && strncmp(line, "SET ", 4) == 0) {
+    char* key = line + 4;
+    char* val = strchr(key, ' ');
+    if (val == NULL) {
+      reply(fd, "ERR\n");
+      return;
+    }
+    *val++ = '\0';
+    reply(fd, kv_set(key, val) == 0 ? "OK\n" : "ERR\n");
+  } else if (sp != NULL && strncmp(line, "GET ", 4) == 0) {
+    struct kv* e = kv_find(line + 4);
+    if (e == NULL) {
+      reply(fd, "NIL\n");
+    } else {
+      reply(fd, e->val);
+      reply(fd, "\n");
+    }
+  } else if (sp != NULL && strncmp(line, "DEL ", 4) == 0) {
+    struct kv* e = kv_find(line + 4);
+    if (e == NULL) {
+      reply(fd, "NIL\n");
+    } else {
+      e->used = 0;
+      reply(fd, "OK\n");
+    }
+  } else {
+    reply(fd, "ERR\n");
+  }
+}
+
+static void drain(struct client* c) {
+  char* start = c->buf;
+  char* nl;
+  while ((nl = memchr(start, '\n', c->len - (size_t)(start - c->buf)))) {
+    *nl = '\0';
+    if (nl > start && nl[-1] == '\r') nl[-1] = '\0';
+    handle_line(c->fd, start);
+    start = nl + 1;
+  }
+  size_t rest = c->len - (size_t)(start - c->buf);
+  memmove(c->buf, start, rest);
+  c->len = rest;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <port>\n", argv[0]);
+    return 1;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  int port = atoi(argv[1]);
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((unsigned short)port);
+  if (bind(lfd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(lfd, 64) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "toyserver: listening on 127.0.0.1:%d\n", port);
+
+  struct client clients[MAX_CLIENTS];
+  for (int i = 0; i < MAX_CLIENTS; i++) clients[i].fd = -1;
+
+  for (;;) {
+    fd_set rfds;
+    FD_ZERO(&rfds);
+    FD_SET(lfd, &rfds);
+    int maxfd = lfd;
+    for (int i = 0; i < MAX_CLIENTS; i++)
+      if (clients[i].fd >= 0) {
+        FD_SET(clients[i].fd, &rfds);
+        if (clients[i].fd > maxfd) maxfd = clients[i].fd;
+      }
+    if (select(maxfd + 1, &rfds, NULL, NULL, NULL) < 0) continue;
+
+    if (FD_ISSET(lfd, &rfds)) {
+      int fd = accept(lfd, NULL, NULL);
+      if (fd >= 0) {
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        int placed = 0;
+        for (int i = 0; i < MAX_CLIENTS; i++)
+          if (clients[i].fd < 0) {
+            clients[i].fd = fd;
+            clients[i].len = 0;
+            placed = 1;
+            break;
+          }
+        if (!placed) close(fd);
+      }
+    }
+    for (int i = 0; i < MAX_CLIENTS; i++) {
+      struct client* c = &clients[i];
+      if (c->fd < 0 || !FD_ISSET(c->fd, &rfds)) continue;
+      ssize_t n = read(c->fd, c->buf + c->len, BUF_SIZE - c->len - 1);
+      if (n <= 0) {
+        close(c->fd);
+        c->fd = -1;
+        continue;
+      }
+      c->len += (size_t)n;
+      drain(c);
+      if (c->len >= BUF_SIZE - 1) c->len = 0; /* oversized line: reset */
+    }
+  }
+}
