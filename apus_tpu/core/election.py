@@ -17,12 +17,21 @@ from apus_tpu.core.sid import Sid
 @dataclasses.dataclass(frozen=True)
 class VoteRequest:
     """Candidate's vote request (the vote_req[] ctrl slot payload,
-    ctrl_data_t dare_server.h:123-140)."""
+    ctrl_data_t dare_server.h:123-140).
+
+    ``prevote`` marks a PreVote probe (Raft §9.6, an addition over the
+    reference): the would-be candidate asks whether it COULD win at
+    ``sid.term`` without anyone adopting that term.  Pre-grants are
+    non-binding and cause no voter state change, so a partitioned or
+    flapping replica can never inflate cluster terms or depose a healthy
+    leader — the failure mode the reference leaves to its adaptive
+    timeouts to avoid."""
 
     sid_word: int          # candidate SID [term|0|idx]
     last_idx: int          # determinant of candidate's last log entry
     last_term: int
     cid_epoch: int
+    prevote: bool = False
 
     @property
     def sid(self) -> Sid:

@@ -96,3 +96,17 @@ class EndpointDB:
             ep.last_idx = idx
             ep.last_reply = reply
             ep.committed = True
+
+    # -- snapshot support --------------------------------------------------
+
+    def dump(self) -> list[tuple[int, int, int, Optional[bytes]]]:
+        """Dedup state for inclusion in snapshots: without it, a
+        duplicate request straddling a snapshot boundary (first instance
+        inside, retry after) would double-apply on the installer."""
+        return [(ep.clt_id, ep.last_req_id, ep.last_idx, ep.last_reply)
+                for ep in self._eps.values()]
+
+    def load(self, entries: list[tuple[int, int, int, Optional[bytes]]]) \
+            -> None:
+        for clt_id, req_id, idx, reply in entries:
+            self.note_applied(clt_id, req_id, idx, reply)

@@ -192,6 +192,15 @@ class SlotLog:
             self._slots[self.slot_of(i)] = None
         self.head = max(self.head, new_head)
 
+    def reset(self, first_idx: int) -> None:
+        """Re-base an (effectively discarded) log at ``first_idx`` —
+        snapshot installation: everything below is covered by the
+        snapshot, everything at/above will be re-replicated (the
+        reference sets log->apply to the snapshot's last-entry offset
+        after rc_recover_sm, dare_server.c:657-704)."""
+        self.head = self.apply = self.commit = self.end = first_idx
+        self._slots = [None] * self.n_slots
+
     # -- log adjustment (NC-buffer algorithm) -----------------------------
 
     def nc_determinants(self) -> list[tuple[int, int]]:

@@ -35,9 +35,9 @@ from apus_tpu.core.epdb import EndpointDB, PendingRead
 from apus_tpu.core.log import LogEntry, SlotLog
 from apus_tpu.core.quorum import have_majority
 from apus_tpu.core.sid import AtomicSid, Sid
-from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, PERMANENT_FAILURE,
-                                 EntryType, Role)
-from apus_tpu.models.sm import StateMachine
+from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, MAX_SERVER_COUNT,
+                                 PERMANENT_FAILURE, EntryType, Role)
+from apus_tpu.models.sm import Snapshot, StateMachine
 from apus_tpu.parallel.transport import (Region, Regions, Transport,
                                          WriteResult)
 
@@ -62,6 +62,27 @@ class NodeConfig:
     # 2-strike rule is implicitly time-throttled too).
     auto_remove: bool = True
     fail_window: float = 0.100
+    # Recovery start: a restarted/joining replica must not campaign
+    # before making contact with the group — its stale log cannot win,
+    # but its vote requests bump terms and depose live leaders in a
+    # self-sustaining storm (each deposition delays the catch-up that
+    # would end it).  The reference runs recovery before election
+    # participation for the same reason (dare_server.c:738-745).  A
+    # fallback timeout preserves liveness when the whole group restarts.
+    recovery_start: bool = False
+
+
+@dataclasses.dataclass
+class PendingJoin:
+    """A join request in flight (CONFIG entry appended, awaiting apply);
+    the handle the membership service waits on before sending the
+    CFG_REPLY analog (handle_server_join_request -> ud_send_clt_reply,
+    dare_ibv_ud.c:972-1068, :1451-1498)."""
+
+    addr: str
+    slot: int
+    entry_idx: Optional[int] = None
+    done: bool = False
 
 
 @dataclasses.dataclass
@@ -98,6 +119,7 @@ class Node:
         self._hb_timeout = cfg.hb_timeout
         self._next_hb_send = 0.0
         self._election_deadline: Optional[float] = None
+        self._prevote_deadline: Optional[float] = None
         self._next_prune = 0.0
         self._next_apply_report = 0.0
 
@@ -105,6 +127,7 @@ class Node:
         self._next_idx: dict[int, int] = {}       # per-follower next entry
         self._commit_sent: dict[int, int] = {}    # lazy remote-commit writes
         self._adjusted: dict[int, bool] = {}      # log adjustment done?
+        self._ack_progress: dict[int, tuple] = {} # stale-match detection
         self._fail_count: dict[int, int] = {}     # CTRL failure counter
         self._fail_last: dict[int, float] = {}    # last counted failure time
         self._pending_head: Optional[int] = None  # HEAD entry in flight
@@ -121,7 +144,34 @@ class Node:
         self._reg_seq = 0
         self._leader_verified_seq = -1
         self.committed_upcalls: list[LogEntry] = []   # drained by runtime
+        # Applied CONFIG entries for the runtime (peer-table updates on
+        # join/resize; the CFG_REPLY + poll_config_entries analog).
+        self.config_upcalls: list[LogEntry] = []
+        # In-flight join requests by joiner address (ep_db join dedup
+        # analog, dare_ep_db.h:20-31 / handle_server_join_request).
+        self._pending_joins: dict[str, PendingJoin] = {}
+        # Applied member addresses (from join CONFIG payloads): lets a
+        # retried join whose reply was lost be answered idempotently
+        # instead of admitting the same address into a second slot.
+        self._member_addrs: dict[str, int] = {}
+        # Installed snapshots awaiting the runtime (persistence must
+        # record them or a restart would replay a store missing the
+        # snapshot prefix).
+        self.snapshot_upcalls: list[tuple[Snapshot, list]] = []
+        # (snap, ep_dump, cid, member_addrs) — valid while snap.last_idx+1
+        # >= log.head (see make_snapshot).
+        self._snap_cache: Optional[tuple[Snapshot, list, Cid, dict]] = None
+        # Determinant of the last applied entry — the snapshot anchor
+        # (snapshot_t.last_entry analog, dare_log.h:107-112); survives
+        # pruning, unlike log.get(apply-1).
+        self._applied_det: tuple[int, int] = (0, 0)
+        # True while a TRANSIT CONFIG entry is in flight (guards against
+        # re-appending it every tick during EXTENDED catch-up).
+        self._transit_pending = False
         self._known_leader: Optional[int] = None
+        # Contact gate for recovery starts (see NodeConfig.recovery_start).
+        self._await_contact = cfg.recovery_start
+        self._contact_deadline: Optional[float] = None
         self._now = 0.0                     # last tick clock (sim-safe)
 
         # stats (observability, §5.5)
@@ -191,6 +241,106 @@ class Node:
         self._pending_reads.append(rr)
         return rr
 
+    def handle_join(self, addr: str) -> Optional[PendingJoin]:
+        """Admit a new server (handle_server_join_request analog,
+        dare_ibv_ud.c:972-1068): assign the lowest empty slot, or up-size
+        the configuration STABLE -> EXTENDED when full.  Returns a handle
+        that completes when the CONFIG entry applies; None when not
+        leader, mid-resize, or at capacity."""
+        if not self.is_leader:
+            return None
+        pj = self._pending_joins.get(addr)
+        if pj is not None:                   # retransmitted join: dedup
+            return pj
+        # Already a member (its join committed but the reply was lost,
+        # e.g. across a leader change): answer idempotently.
+        existing = self._member_addrs.get(addr)
+        if existing is not None and self.cid.contains(existing):
+            return PendingJoin(addr=addr, slot=existing, done=True)
+        # One membership change at a time: a CONFIG built from the
+        # current cid while another is in flight would conflict with it
+        # when both apply (e.g. two joiners assigned the same empty
+        # slot, or a join resurrecting a concurrently-removed server).
+        # Scan from APPLY, not commit: a committed-but-unapplied CONFIG
+        # hasn't updated self.cid yet and is just as conflicting.
+        if any(e.type == EntryType.CONFIG
+               for e in self.log.entries(self.log.apply)):
+            return None
+        slot = self.cid.empty_slot()
+        if slot is not None:
+            new_cid = dataclasses.replace(
+                self.cid.with_server(slot), epoch=self.cid.epoch + 1)
+        elif self.cid.state != CidState.STABLE:
+            return None                      # one resize at a time
+        elif self.cid.size >= MAX_SERVER_COUNT:
+            return None                      # at protocol capacity
+        else:
+            slot = self.cid.size
+            new_cid = self.cid.extend(self.cid.size + 1).with_server(slot)
+        if self.log.is_full:
+            return None
+        pj = PendingJoin(addr=addr, slot=slot)
+        pj.entry_idx = self.log.append(
+            self.sid.sid.term, type=EntryType.CONFIG, cid=new_cid,
+            data=f"{slot} {addr}".encode())
+        self._pending_joins[addr] = pj
+        return pj
+
+    # -- snapshots (SM recovery, §3.4) ---------------------------------
+
+    def make_snapshot(self) -> tuple[Snapshot, list, Cid, dict]:
+        """Snapshot at the current apply point: SM state, endpoint-DB
+        dump (exactly-once state must travel with the SM state), plus
+        the configuration at that point — CONFIG entries inside the
+        covered prefix are never applied by the installer, so membership
+        must ride with the snapshot or the installer keeps a stale view.
+
+        Cached until pruning moves the head past it — a snapshot stays
+        pushable as long as replication can resume at last_idx+1 >= head.
+        (Keying on the apply point instead would rebuild the full state
+        blob every tick while a lagging peer is unreachable; the
+        reference likewise reuses its preregistered snapshot until the
+        head moves, dare_server.c:643,2052.)"""
+        if self._snap_cache is not None and \
+                self._snap_cache[0].last_idx + 1 >= self.log.head:
+            return self._snap_cache
+        last_idx, last_term = self._applied_det
+        snap = self.sm.create_snapshot(last_idx, last_term)
+        self._snap_cache = (snap, self.epdb.dump(), self.cid,
+                            dict(self._member_addrs))
+        return self._snap_cache
+
+    def install_snapshot(self, snap: Snapshot, ep_dump: list,
+                         cid: Optional[Cid] = None,
+                         member_addrs: Optional[dict] = None) -> bool:
+        """Install a snapshot pushed by the leader (rc_recover_sm analog,
+        dare_ibv_rc.c:603-689): replaces SM + dedup state, re-bases the
+        log just past the snapshot, and adopts the snapshot-point
+        configuration (synthetic CONFIG upcalls let the runtime learn
+        the peer table it would have built from the skipped entries).
+        Rejected when stale."""
+        if snap.last_idx < self.log.commit:
+            return False                     # we already have more
+        self.sm.apply_snapshot(snap)
+        self.epdb.load(ep_dump)
+        self.log.reset(snap.last_idx + 1)
+        self._applied_det = (snap.last_idx, snap.last_term)
+        self._snap_cache = None
+        if cid is not None and cid.epoch >= self.cid.epoch:
+            self.cid = cid
+            for addr, slot in (member_addrs or {}).items():
+                if not cid.contains(slot):
+                    continue
+                self._member_addrs[addr] = slot
+                self.config_upcalls.append(LogEntry(
+                    idx=snap.last_idx, term=snap.last_term,
+                    type=EntryType.CONFIG, cid=cid,
+                    data=f"{slot} {addr}".encode()))
+        self.snapshot_upcalls.append((snap, ep_dump))
+        self.stats["snapshots_installed"] = \
+            self.stats.get("snapshots_installed", 0) + 1
+        return True
+
     def tick(self, now: float) -> None:
         """One poll-loop iteration (polling(), dare_server.c:1013-1152)."""
         self._now = now
@@ -209,6 +359,38 @@ class Node:
     # ------------------------------------------------------------------
     # role transitions
     # ------------------------------------------------------------------
+
+    def _prevote_tick(self, now: float) -> None:
+        """PreVote (Raft §9.6; an addition over the reference): probe
+        whether a majority would elect us at term+1 BEFORE bumping any
+        real term.  Pre-grants are non-binding, so a flapping or
+        partitioned replica can never inflate terms or depose a healthy
+        leader — real elections start only with majority evidence that
+        the leader is gone."""
+        target = self.sid.sid.term + 1
+        if self._prevote_deadline is not None:
+            acks = self.regions.ctrl[Region.PREVOTE_ACK]
+            mask = 0
+            for peer, a in enumerate(acks):
+                if a == target:
+                    mask |= 1 << peer
+            if have_majority(mask, self.cid, include_self=self.idx):
+                self._prevote_deadline = None
+                self.start_election(now)
+                return
+        if self._prevote_deadline is None or now >= self._prevote_deadline:
+            self.regions.ctrl[Region.PREVOTE_ACK] = \
+                [None] * MAX_SERVER_COUNT
+            last_idx, last_term = self.log.last_determinant()
+            req = VoteRequest(Sid(target, False, self.idx).word,
+                              last_idx, last_term, self.cid.epoch,
+                              prevote=True)
+            for peer in self.cid.members():
+                if peer != self.idx:
+                    self.t.ctrl_write(peer, Region.VOTE_REQ, self.idx, req)
+            self._prevote_deadline = now + random_election_timeout(
+                self.rng, self.cfg.elect_low, self.cfg.elect_high)
+            self.stats["prevotes"] = self.stats.get("prevotes", 0) + 1
 
     def start_election(self, now: float) -> None:
         """start_election analog (dare_server.c:1264-1322)."""
@@ -242,9 +424,12 @@ class Node:
         self._next_idx = {}
         self._commit_sent = {}
         self._adjusted = {}
+        self._ack_progress = {}
         self._fail_count = {}
         self._fail_last = {}
         self._pending_head = None
+        self._pending_joins.clear()
+        self._transit_pending = False
         self.regions.grant_log_access(self.idx, my.term)
         # A fresh leader may not know its own tail if it recovered; our
         # absolute-index log always does.  Append a blank entry so commit
@@ -269,6 +454,7 @@ class Node:
         self._pending.clear()
         self._inflight.clear()
         self._pending_reads.clear()    # clients retry against the new leader
+        self._pending_joins.clear()    # joiners retry against the new leader
         self._leader_verified_seq = -1
 
     # ------------------------------------------------------------------
@@ -278,13 +464,56 @@ class Node:
     def _poll_vote_requests(self, now: float) -> None:
         """poll_vote_requests analog (dare_server.c:1526-1743)."""
         slots = self.regions.ctrl[Region.VOTE_REQ]
-        reqs = [r for r in slots if r is not None]
-        if not reqs:
+        # Non-members cannot campaign: an evicted/stale server's vote
+        # requests must not even bump our term, or it can depose live
+        # leaders forever (the disruptive-server problem; the reference
+        # only processes votes from configuration members).
+        reqs = [r for r in slots
+                if r is not None and self.cid.contains(r.sid.idx)]
+        if not any(r is not None for r in slots):
             return
         for i in range(len(slots)):
             slots[i] = None
+        if not reqs:
+            return
+        self._await_contact = False         # group contact established
+        # PreVote probes: answered without ANY voter state change.  An
+        # acting leader always refuses (its authority is attested by the
+        # quorum acks it keeps receiving, not by its hb timer).
+        prevotes = [r for r in reqs if r.prevote]
+        reqs = [r for r in reqs if not r.prevote]
+        if prevotes:
+            my = self.sid.sid
+            last_idx, last_term = self.log.last_determinant()
+            alive = (self.role == Role.LEADER
+                     or (self._known_leader is not None
+                         and now - self._last_hb_seen < self._hb_timeout))
+            # Refuse UNCONDITIONALLY while we believe the leader is alive
+            # (or are it): should_grant's known-leader rule only covers
+            # cand.term <= ours, but prevote probes are always term+1 —
+            # without this check a flapping follower still collects
+            # pre-grants and deposes a healthy leader.
+            if not alive:
+                for r in prevotes:
+                    if should_grant(r, my, last_idx, last_term, False):
+                        self.t.ctrl_write(r.sid.idx, Region.PREVOTE_ACK,
+                                          self.idx, r.sid.term)
+        if not reqs:
+            return
         best = best_vote_request(reqs)
         my = self.sid.sid
+        # A higher term demotes a leader/candidate to follower BEFORE the
+        # vote decision (Raft §5.1) — but WITHOUT adopting the term yet:
+        # writing (best.term, own_idx) here would trip the no-vote-switch
+        # rule below (same term, different idx) and refuse the very vote
+        # we are about to consider, leaving the requester one term ahead
+        # and us demoted — a dueling livelock where terms escalate
+        # forever and no election ever completes.  The grant path adopts
+        # the candidate's full SID; the refuse path bumps the bare term.
+        if best.sid.term > my.term and self.role != Role.FOLLOWER:
+            self.role = Role.FOLLOWER
+            self._known_leader = None
+            self._election_deadline = None
         last_idx, last_term = self.log.last_determinant()
         leader_alive = (self._known_leader is not None and
                         now - self._last_hb_seen < self._hb_timeout)
@@ -335,7 +564,12 @@ class Node:
             self.become_leader(now)
             return
         if self._election_deadline is not None and now >= self._election_deadline:
-            self.start_election(now)
+            # Election failed (split vote / lost majority): return to
+            # follower and requalify through PreVote rather than blindly
+            # escalating terms against a possibly-recovered leader.
+            self.role = Role.FOLLOWER
+            self._election_deadline = None
+            self._prevote_deadline = None
 
     # ------------------------------------------------------------------
     # follower
@@ -346,8 +580,18 @@ class Node:
         (dare_server.c:822-922, persist_new_entries :1792-1810)."""
         self._scan_heartbeats(now)
         if now - self._last_hb_seen > self._hb_timeout:
-            self.start_election(now)
+            if self._await_contact:
+                # No campaigning before group contact; fall back to
+                # normal elections if nobody reaches us for a long time
+                # (the whole group may have restarted together).
+                if self._contact_deadline is None:
+                    self._contact_deadline = now + 10 * self.cfg.elect_high
+                if now < self._contact_deadline:
+                    return
+                self._await_contact = False
+            self._prevote_tick(now)
             return
+        self._prevote_deadline = None   # leader alive: abandon prevote
         leader = self._known_leader
         if leader is None or leader == self.idx:
             return
@@ -379,6 +623,7 @@ class Node:
             if best is None or s.term > best.term:
                 best = s
         if best is not None:
+            self._await_contact = False     # group contact established
             if best.term > my.term or self._known_leader != best.idx:
                 self.sid.update(Sid(best.term, False, best.idx).word)
                 self.regions.grant_log_access(best.idx, best.term)
@@ -405,6 +650,7 @@ class Node:
         self._drain_pending(my)
         self._replicate(my, now)
         self._advance_commit(my)
+        self._maybe_advance_resize(my)
         if now >= self._next_hb_send:
             self._send_heartbeats(my, now)
             self._next_hb_send = now + self.cfg.hb_period
@@ -427,6 +673,25 @@ class Node:
         """rc_write_remote_logs analog (dare_ibv_rc.c:1870-1948): adjust
         diverged followers, then write entry ranges."""
         for peer in self._replication_targets():
+            # Stale-match detection: followers ack their log end every
+            # tick (REP_ACK).  A follower that restarted with an empty
+            # log still looks "adjusted" to us — our writes land
+            # non-contiguously as silent no-ops — so if its acked end
+            # sits below our next_idx without progressing for a
+            # heartbeat timeout, the match state is stale: re-adjust.
+            # (The reference re-reads follower state on every commit
+            # loop instead, rc_write_remote_logs dare_ibv_rc.c:1883-1945.)
+            ack = self.regions.ctrl[Region.REP_ACK][peer]
+            if (self._adjusted.get(peer, False) and ack is not None
+                    and ack < self._next_idx.get(peer, 0)):
+                prev_ack, since = self._ack_progress.get(peer, (None, now))
+                if ack != prev_ack:
+                    self._ack_progress[peer] = (ack, now)
+                elif now - since > self.cfg.hb_timeout:
+                    self._adjusted[peer] = False
+                    self._ack_progress.pop(peer, None)
+            else:
+                self._ack_progress.pop(peer, None)
             if not self._adjusted.get(peer, False):
                 state = self.t.log_read_state(peer)
                 if state is None:
@@ -442,8 +707,21 @@ class Node:
                 self._adjusted[peer] = True
             nxt = self._next_idx.get(peer, self.log.commit)
             if nxt < self.log.head:
-                # Peer is behind our pruned head — needs a snapshot
-                # (recovery path, phase 6); skip replication for now.
+                # Peer is behind our pruned head: push a snapshot
+                # (leader-driven form of rc_recover_sm, the reference's
+                # joiner instead RDMA-reads it, dare_ibv_rc.c:603-689),
+                # then resume log replication just past it.
+                snap, ep_dump, snap_cid, members = self.make_snapshot()
+                res = self.t.snap_push(peer, my, snap, ep_dump,
+                                       snap_cid, members)
+                if res == WriteResult.OK:
+                    self._next_idx[peer] = snap.last_idx + 1
+                    self.stats["snapshots_pushed"] = \
+                        self.stats.get("snapshots_pushed", 0) + 1
+                elif res == WriteResult.FENCED:
+                    self._adjusted[peer] = False
+                else:
+                    self._note_failure(peer, now)
                 continue
             batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
             if not batch and self._commit_sent.get(peer, 0) >= self.log.commit:
@@ -490,12 +768,44 @@ class Node:
                         self.stats["commits"] += 1
                 break
 
+    def _maybe_advance_resize(self, my: Sid) -> None:
+        """EXTENDED -> TRANSIT once every new slot has caught up
+        (the reference moves to TRANSIT when the joiner's recovery
+        completes; cf. dare_ibv_ud.c:1024-1037).  TRANSIT -> STABLE then
+        happens on TRANSIT's apply (_apply_config)."""
+        if self.cid.state != CidState.EXTENDED or self._transit_pending:
+            return
+        # Another CONFIG in flight (e.g. an auto-removal built from the
+        # same cid): appending TRANSIT now would apply after it at the
+        # same epoch and resurrect the removed member.
+        if any(e.type == EntryType.CONFIG
+               for e in self.log.entries(self.log.apply)):
+            return
+        acks = self.regions.ctrl[Region.REP_ACK]
+        new_members = [m for m in self.cid.members() if m >= self.cid.size]
+        if not new_members:
+            return
+        for m in new_members:
+            a = acks[m]
+            if a is None or a < self.log.commit:
+                return
+        if self.log.is_full:
+            return
+        self.log.append(my.term, type=EntryType.CONFIG,
+                        cid=self.cid.to_transit())
+        self._transit_pending = True
+
     def _send_heartbeats(self, my: Sid, now: float) -> None:
         """rc_send_hb analog (dare_ibv_rc.c:868-926)."""
         for peer in self._replication_targets():
             if self.t.ctrl_write(peer, Region.HB, self.idx, my.word) \
                     != WriteResult.OK:
                 self._note_failure(peer, now)
+            else:
+                # A reachable peer is not failing: reset the counter so
+                # sporadic drops (async dial, transient congestion) far
+                # apart never accumulate to PERMANENT_FAILURE.
+                self._fail_count[peer] = 0
         self.stats["hb_sent"] += 1
 
     def _serve_reads(self, now: float) -> None:
@@ -571,10 +881,16 @@ class Node:
         self._fail_count[peer] = n
         if n >= PERMANENT_FAILURE and self.cid.contains(peer):
             in_flight = any(e.type == EntryType.CONFIG
-                            for e in self.log.entries(self.log.commit))
-            if not in_flight:
-                self.log.append(self.sid.sid.term, type=EntryType.CONFIG,
-                                cid=self.cid.without_server(peer))
+                            for e in self.log.entries(self.log.apply))
+            if not in_flight and not self.log.is_full:
+                # Epoch bump: every membership-changing CONFIG must be
+                # ordered; an unbumped removal would share an epoch with
+                # a later join and leave replicas with incomparable cids.
+                self.log.append(
+                    self.sid.sid.term, type=EntryType.CONFIG,
+                    cid=dataclasses.replace(
+                        self.cid.without_server(peer),
+                        epoch=self.cid.epoch + 1))
 
     def _maybe_prune(self, my: Sid) -> None:
         """log_pruning analog (dare_server.c:1996-2067).  P1: only applied
@@ -630,11 +946,13 @@ class Node:
             elif e.type == EntryType.CONFIG:
                 self._apply_config(e, now)
             elif e.type == EntryType.HEAD:
+                self._applied_det = e.determinant()
                 self.log.advance_apply(e.idx + 1)
                 self.log.advance_head(min(e.head, self.log.apply))
                 if self.is_leader:
                     self._pending_head = None
                 continue
+            self._applied_det = e.determinant()
             self.log.advance_apply(e.idx + 1)
             self.stats["applied"] += 1
 
@@ -645,14 +963,60 @@ class Node:
         new_cid = e.cid
         if new_cid.epoch < self.cid.epoch:
             return
+        # Newly-added members: (a) failure-count grace — their endpoint
+        # needs (re)dialing, and counting those initial drops would evict
+        # a joiner the moment it was admitted; (b) reset per-peer
+        # replication state — a reused slot (rejoin after removal) must
+        # be re-adjusted from scratch, or the stale next_idx silently
+        # stops the new occupant from ever receiving the log.
+        for m in new_cid.members():
+            if not self.cid.contains(m) and m != self.idx:
+                self._fail_count.pop(m, None)
+                self._fail_last[m] = now + 10 * self.cfg.hb_timeout
+                self._adjusted.pop(m, None)
+                self._next_idx.pop(m, None)
+                self._commit_sent.pop(m, None)
+                self.regions.ctrl[Region.REP_ACK][m] = None
+                self.regions.ctrl[Region.APPLY_IDX][m] = None
         self.cid = new_cid
+        # Learn the joiner's address (idempotent-join dedup).  A reused
+        # slot evicts the previous occupant's address claim, and slots
+        # leaving the configuration drop theirs — a stale claim would
+        # answer a removed-then-rejoining address "already member" for a
+        # slot now owned by a DIFFERENT server, spawning two daemons
+        # with the same replica idx.
+        if e.data:
+            try:
+                slot_s, addr_s = e.data.decode().split(" ", 1)
+                slot = int(slot_s)
+            except ValueError:
+                pass
+            else:
+                self._member_addrs = {a: s for a, s
+                                      in self._member_addrs.items()
+                                      if s != slot}
+                self._member_addrs[addr_s] = slot
+        self._member_addrs = {a: s for a, s in self._member_addrs.items()
+                              if new_cid.contains(s)}
+        # Runtime notification (peer-table update on join, role of the
+        # CFG_REPLY + poll_config_entries pair, dare_server.c:2133-2187).
+        self.config_upcalls.append(e)
+        # Resolve join handles waiting on this entry.
+        for addr, pj in list(self._pending_joins.items()):
+            if pj.entry_idx is not None and pj.entry_idx <= e.idx:
+                pj.done = True
+                del self._pending_joins[addr]
         if self.is_leader:
             # Drive the joint-consensus ladder forward.
             if new_cid.state == CidState.EXTENDED:
                 pass  # wait: new servers must catch up before TRANSIT
+                      # (_maybe_advance_resize)
             elif new_cid.state == CidState.TRANSIT:
-                self.log.append(self.sid.sid.term, type=EntryType.CONFIG,
-                                cid=new_cid.stabilize())
+                self._transit_pending = False
+                if not self.log.is_full:
+                    self.log.append(self.sid.sid.term,
+                                    type=EntryType.CONFIG,
+                                    cid=new_cid.stabilize())
         # Suicide path: removed from the configuration (DIE_AF_COMMIT
         # analog, dare_server.c:1870-1874) — handled by the runtime
         # observing cid.contains(self.idx) == False.

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from apus_tpu.core.cid import Cid
 from apus_tpu.core.log import LogEntry
 from apus_tpu.core.node import Node
 from apus_tpu.core.sid import Sid
@@ -200,6 +201,16 @@ class PeerServer:
             start, stop = r.u64(), r.u64()
             entries = onesided.apply_log_bulk_read(node, start, stop)
             return wire.u8(wire.ST_OK) + wire.encode_entries(entries)
+        if op == wire.OP_SNAP_PUSH:
+            writer = Sid.unpack(r.u64())
+            snap = wire.decode_value(r)
+            ep_dump = wire.decode_ep_dump(r)
+            cid = wire.decode_cid(r)
+            members = wire.decode_members(r)
+            res = onesided.apply_snap_push(
+                node, writer, snap, ep_dump,
+                cid if cid.size > 0 else None, members)
+            return wire.u8(_ST_OF_RESULT[res])
         return wire.u8(wire.ST_ERROR)
 
 
@@ -291,9 +302,11 @@ class NetTransport(Transport):
             except OSError:
                 pass
 
-    def _roundtrip(self, target: int, payload: bytes) -> Optional[bytes]:
+    def _roundtrip(self, target: int, payload: bytes,
+                   timeout: Optional[float] = None) -> Optional[bytes]:
         """Send one request frame, await the response frame.  Releases
-        the daemon's node lock while blocked (see module docstring)."""
+        the daemon's node lock while blocked (see module docstring).
+        ``timeout`` overrides the per-op wire timeout (bulk transfers)."""
         lock = self.yield_lock
         depth = 0
         if lock is not None:
@@ -307,6 +320,8 @@ class NetTransport(Transport):
                 if conn is None:
                     return None
                 try:
+                    if timeout is not None:
+                        conn.settimeout(timeout)
                     conn.sendall(wire.frame(payload))
                     resp = wire.read_frame(conn)
                     if resp is None:
@@ -317,6 +332,12 @@ class NetTransport(Transport):
                     self._down_until[target] = \
                         time.monotonic() + self.backoff
                     return None
+                finally:
+                    if timeout is not None:
+                        try:
+                            conn.settimeout(self.timeout)
+                        except OSError:
+                            pass
         finally:
             for _ in range(depth):
                 lock.acquire()     # type: ignore[union-attr]
@@ -373,6 +394,21 @@ class NetTransport(Transport):
         if resp is None or resp[0] != wire.ST_OK:
             return None
         return wire.decode_entries(wire.Reader(resp[1:]))
+
+    def snap_push(self, target: int, writer_sid: Sid, snap,
+                  ep_dump: list, cid=None, member_addrs=None) -> WriteResult:
+        payload = (wire.u8(wire.OP_SNAP_PUSH) + wire.u64(writer_sid.word)
+                   + wire.encode_value(snap) + wire.encode_ep_dump(ep_dump)
+                   + wire.encode_cid(cid if cid is not None
+                                     else Cid.initial(0))
+                   + wire.encode_members(member_addrs or {}))
+        # Snapshots can be far larger than a control write: allow a
+        # proportionally longer wire timeout for this op.
+        resp = self._roundtrip(target, payload,
+                               timeout=max(self.timeout, 2.0))
+        if resp is None:
+            return WriteResult.DROPPED
+        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
 
     # -- generic request (two-sided control messages: join, snapshots) ----
 

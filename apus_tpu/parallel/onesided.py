@@ -75,3 +75,15 @@ def apply_log_set_end(node: Node, writer_sid: Sid,
 def apply_log_bulk_read(node: Node, start: int,
                         stop: int) -> list[LogEntry]:
     return [dataclasses.replace(e) for e in node.log.entries(start, stop)]
+
+
+def apply_snap_push(node: Node, writer_sid: Sid, snap: Any,
+                    ep_dump: list, cid: Any = None,
+                    member_addrs: dict | None = None) -> WriteResult:
+    """Install a leader-pushed snapshot.  Fence-checked exactly like log
+    writes (it rewrites the log base); staleness is rejected inside
+    install_snapshot."""
+    if not node.regions.log_write_allowed(writer_sid):
+        return WriteResult.FENCED
+    node.install_snapshot(snap, ep_dump, cid, member_addrs)
+    return WriteResult.OK

@@ -53,6 +53,8 @@ class Region(str, enum.Enum):
                               # (rc_verify_leadership reads, dare_ibv_rc.c
                               # :1182-1280; new regions append — the wire
                               # indexes positionally)
+    PREVOTE_ACK = "prevote_ack"  # voter -> precandidate: pre-granted term
+                              # (PreVote, Raft §9.6 — see VoteRequest)
 
 
 class Regions:
@@ -127,4 +129,15 @@ class Transport:
                       stop: int) -> Optional[list[LogEntry]]:
         """Bulk-fetch entries for recovery (rc_recover_log analog,
         dare_ibv_rc.c:726-856)."""
+        raise NotImplementedError
+
+    def snap_push(self, target: int, writer_sid: Sid, snap: Any,
+                  ep_dump: list, cid: Any = None,
+                  member_addrs: Optional[dict] = None) -> WriteResult:
+        """Install a snapshot on a lagging/joining peer (leader-driven
+        form of the reference's snapshot recovery, rc_recover_sm
+        dare_ibv_rc.c:603-689).  Fence-checked like log writes.
+        ``cid``/``member_addrs`` carry the snapshot-point configuration
+        (CONFIG entries inside the covered prefix are never applied by
+        the installer)."""
         raise NotImplementedError

@@ -13,7 +13,7 @@ Struct layouts (little endian):
     Cid        = epoch:u32 state:u8 size:u8 new_size:u8 bitmask:u16
     LogEntry   = idx:u64 term:u64 req_id:u64 clt_id:u64 type:u8 head:u64
                  flags:u8 [cid if flags&1] dlen:u32 data
-    VoteReq    = sid:u64 last_idx:u64 last_term:u64 epoch:u32
+    VoteReq    = sid:u64 last_idx:u64 last_term:u64 epoch:u32 prevote:u8
     Snapshot   = last_idx:u64 last_term:u64 dlen:u32 data
 
 One-sided RPC requests are ``op:u8`` + body; responses are ``status:u8``
@@ -42,6 +42,7 @@ OP_LOG_SET_END = 5
 OP_LOG_BULK_READ = 6
 OP_JOIN = 7          # membership join request (ud_join_cluster analog)
 OP_SNAP_FETCH = 8    # snapshot fetch for recovery (rc_recover_sm analog)
+OP_SNAP_PUSH = 9     # leader-pushed snapshot install (lagging peer/joiner)
 
 # -- response status ------------------------------------------------------
 ST_OK = 0
@@ -62,7 +63,7 @@ REGION_INDEX = {r: i for i, r in enumerate(REGION_LIST)}
 
 _CID = struct.Struct("<IBBBH")
 _ENTRY_FIXED = struct.Struct("<QQQQBQB")
-_VOTEREQ = struct.Struct("<QQQI")
+_VOTEREQ = struct.Struct("<QQQIB")
 _SNAP_FIXED = struct.Struct("<QQI")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -167,7 +168,8 @@ def encode_value(v: Any) -> bytes:
         return u8(VAR_U64) + u64(v)
     if isinstance(v, VoteRequest):
         return u8(VAR_VOTEREQ) + _VOTEREQ.pack(v.sid_word, v.last_idx,
-                                               v.last_term, v.cid_epoch)
+                                               v.last_term, v.cid_epoch,
+                                               1 if v.prevote else 0)
     if isinstance(v, bytes):
         return u8(VAR_BYTES) + blob(v)
     if isinstance(v, Snapshot):
@@ -183,15 +185,54 @@ def decode_value(r: Reader) -> Any:
     if tag == VAR_U64:
         return r.u64()
     if tag == VAR_VOTEREQ:
-        sid, li, lt, ep = _VOTEREQ.unpack(r.take(_VOTEREQ.size))
+        sid, li, lt, ep, pv = _VOTEREQ.unpack(r.take(_VOTEREQ.size))
         return VoteRequest(sid_word=sid, last_idx=li, last_term=lt,
-                           cid_epoch=ep)
+                           cid_epoch=ep, prevote=bool(pv))
     if tag == VAR_BYTES:
         return r.blob()
     if tag == VAR_SNAPSHOT:
         li, lt, n = _SNAP_FIXED.unpack(r.take(_SNAP_FIXED.size))
         return Snapshot(li, lt, r.take(n))
     raise ValueError(f"bad variant tag {tag}")
+
+
+# -- endpoint-DB dump (travels with snapshots for exactly-once) -----------
+
+def encode_ep_dump(entries: list) -> bytes:
+    out = [u32(len(entries))]
+    for clt_id, req_id, idx, reply in entries:
+        out.append(_U64.pack(clt_id) + _U64.pack(req_id) + _U64.pack(idx))
+        out.append(u8(1) + blob(reply) if reply is not None else u8(0))
+    return b"".join(out)
+
+
+def decode_ep_dump(r: Reader) -> list:
+    n = r.u32()
+    out = []
+    for _ in range(n):
+        clt_id, req_id, idx = r.u64(), r.u64(), r.u64()
+        reply = r.blob() if r.u8() else None
+        out.append((clt_id, req_id, idx, reply))
+    return out
+
+
+# -- member address table (travels with snapshots: the installer never
+# applies the covered CONFIG entries, so membership rides alongside) ------
+
+def encode_members(members: dict) -> bytes:
+    out = [u32(len(members))]
+    for addr, slot in members.items():
+        out.append(u8(slot) + blob(addr.encode()))
+    return b"".join(out)
+
+
+def decode_members(r: Reader) -> dict:
+    n = r.u32()
+    out = {}
+    for _ in range(n):
+        slot = r.u8()
+        out[r.blob().decode()] = slot
+    return out
 
 
 # -- log state ------------------------------------------------------------

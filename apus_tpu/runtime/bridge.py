@@ -45,8 +45,11 @@ _OFF_ABORTED = 40
 _HDR = struct.Struct("<BQQ")
 
 # Replicated record payload (the opaque "command" in the log entry):
-# u8 action | u64 conn_id | data.
-_REC = struct.Struct("<BQ")
+# u8 action | u64 conn_id | u64 clt_id | u64 req_id | data.  clt_id and
+# req_id mirror the log entry's own fields: snapshot replay works from
+# the relay SM's record dump, where entry metadata is gone, yet must
+# still route records by origin (skip ones this app executed live).
+_REC = struct.Struct("<BQQQ")
 
 #: clt_id namespace for bridge-submitted records — disjoint from real
 #: client ids (ApusClient masks to 63 bits) so apply-time routing can
@@ -62,13 +65,14 @@ def is_bridge_clt(clt_id: int) -> bool:
     return bool(clt_id & BRIDGE_CLT_BASE)
 
 
-def encode_record(action: int, conn_id: int, data: bytes) -> bytes:
-    return _REC.pack(action, conn_id) + data
+def encode_record(action: int, conn_id: int, data: bytes,
+                  clt_id: int = 0, req_id: int = 0) -> bytes:
+    return _REC.pack(action, conn_id, clt_id, req_id) + data
 
 
-def decode_record(payload: bytes) -> tuple[int, int, bytes]:
-    action, conn_id = _REC.unpack_from(payload, 0)
-    return action, conn_id, payload[_REC.size:]
+def decode_record(payload: bytes) -> tuple[int, int, bytes, int, int]:
+    action, conn_id, clt_id, req_id = _REC.unpack_from(payload, 0)
+    return action, conn_id, payload[_REC.size:], clt_id, req_id
 
 
 class RelayStateMachine(StateMachine):
@@ -251,6 +255,13 @@ class Bridge:
         self._shm_set(_OFF_CUR_REC, base)
         self._shm_set(_OFF_HIGHEST, base)
         self._last_submitted = base
+        self._boot_base = base
+        # (clt_id, req_id) of every record already routed to the local
+        # app this incarnation (released or replayed): snapshot replay
+        # must skip these or a live replica that falls behind the pruned
+        # head would re-execute its whole history (records are retained
+        # forever in the relay SM anyway, so the set adds O(1)/record).
+        self._routed: set[tuple[int, int]] = set()
 
         if os.path.exists(self.sock_path):
             os.unlink(self.sock_path)
@@ -268,6 +279,12 @@ class Bridge:
         # node lock): a client that observed leadership via the locked
         # wait_for_leader path is then guaranteed an open capture gate.
         daemon.on_tick.append(self._mirror_role)
+        # A leader-pushed snapshot replaced the relay SM wholesale: the
+        # local app (freshly started for a joiner) must be primed by
+        # replaying every snapshot-covered record (the reference's
+        # proxy_apply_db_snapshot replays its dump the same way,
+        # proxy.c:306-339).
+        daemon.on_snapshot.append(self._on_snapshot)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -288,6 +305,8 @@ class Bridge:
                 self.daemon.on_tick.remove(self._mirror_role)
             if self._on_commit in self.daemon.on_commit:
                 self.daemon.on_commit.remove(self._on_commit)
+            if self._on_snapshot in self.daemon.on_snapshot:
+                self.daemon.on_snapshot.remove(self._on_snapshot)
         for t in self._threads:
             t.join(timeout=2.0)
         self.replayer.stop()
@@ -373,7 +392,8 @@ class Bridge:
 
     def _submit(self, action: int, conn_id: int, cur_rec: int,
                 data: bytes) -> None:
-        payload = encode_record(action, conn_id, data)
+        payload = encode_record(action, conn_id, data,
+                                clt_id=self.clt_id, req_id=cur_rec)
         with self._sub_lock:
             self._last_submitted = max(self._last_submitted, cur_rec)
         with self.daemon.lock:
@@ -408,16 +428,51 @@ class Bridge:
 
     # -- commit upcall ----------------------------------------------------
 
+    def _on_snapshot(self, snap, ep_dump) -> None:
+        """A leader-pushed snapshot replaced the relay SM wholesale:
+        prime the local app with the snapshot-covered records it has NOT
+        executed yet.  Three classes are skipped: records already routed
+        through _on_commit (a live replica that merely fell behind the
+        pruned head has executed that prefix), records this app
+        incarnation captured live (req_id >= the boot base — the app
+        executed the bytes itself when the capture was released), and
+        non-bridge payloads (KVS client commands have no app to replay
+        into).  A fresh joiner's empty _routed set means full replay,
+        matching the reference's proxy_apply_db_snapshot (proxy.c:306)."""
+        records = getattr(self.daemon.node.sm, "records", [])
+        for rec in records:
+            try:
+                action, conn_id, data, clt, rid = decode_record(rec)
+            except Exception:
+                continue
+            if not is_bridge_clt(clt):
+                continue
+            key = (clt, rid)
+            if key in self._routed:
+                continue
+            self._routed.add(key)
+            if clt == self.clt_id and rid >= self._boot_base:
+                # Our own live capture, now committed under the snapshot:
+                # the app executed the bytes itself — release the spin
+                # instead of replaying.
+                self._release(rid)
+                continue
+            self.replayer.submit(action, conn_id, data)
+
     def _on_commit(self, e: LogEntry) -> None:
         """Committed-entry routing (apply_committed_entries' proxy calls,
         dare_server.c:1953-1955): our own records release the captured
         app thread; records captured elsewhere replay into the local app."""
         if e.type != EntryType.CSM or not is_bridge_clt(e.clt_id):
             return
+        key = (e.clt_id, e.req_id)
+        if key in self._routed:
+            return                    # already primed via snapshot replay
+        self._routed.add(key)
         if e.clt_id == self.clt_id:
             self._release(e.req_id)
         else:
-            action, conn_id, data = decode_record(e.data)
+            action, conn_id, data, _, _ = decode_record(e.data)
             self.replayer.submit(action, conn_id, data)
 
 

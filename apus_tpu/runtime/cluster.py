@@ -17,6 +17,7 @@ from apus_tpu.models.kvs import KvsStateMachine
 from apus_tpu.models.sm import StateMachine
 from apus_tpu.parallel.net import PeerServer
 from apus_tpu.runtime.daemon import ReplicaDaemon
+from apus_tpu.runtime.membership import request_join
 from apus_tpu.utils.config import ClusterSpec
 
 
@@ -120,10 +121,58 @@ class LocalCluster:
         recovery path: durable-store replay + catch-up from peers)."""
         assert self.daemons[idx] is None, "kill before restart"
         d = self.daemon_cls(idx, self.spec, sm=self.sm_factory(),
-                            seed=self.seed, **self.daemon_kwargs)
+                            recovery_start=True, seed=self.seed,
+                            **self.daemon_kwargs)
         self.daemons[idx] = d
         d.start()
         return d
+
+    def add_replica(self, timeout: float = 15.0) -> "ReplicaDaemon":
+        """Grow the group: reserve an endpoint, run the join protocol
+        against the current leader, then start the new replica — which
+        catches up via normal adjustment/replication (plus a snapshot
+        push if it is behind the leader's pruned head).  The AddServer /
+        Upsize scenario of reconf_bench.sh:147-180."""
+        sock = PeerServer.reserve()
+        host, port = sock.getsockname()
+        addr = f"{host}:{port}"
+        try:
+            slot, cid, peers = request_join(
+                [p for p in self.spec.peers if p], addr, timeout=timeout)
+        except BaseException:
+            sock.close()               # release the reserved endpoint
+            raise
+        assert peers[slot] == addr, (slot, addr, peers)
+        # Extend the shared spec in place so every current daemon (and
+        # future restarts) sees the same slot-indexed peer table.
+        while len(self.spec.peers) <= slot:
+            self.spec.peers.append("")
+        self.spec.peers[slot] = addr
+        d = self.daemon_cls(slot, self.spec, sm=self.sm_factory(), cid=cid,
+                            listen_sock=sock, recovery_start=True,
+                            seed=self.seed, **self.daemon_kwargs)
+        while len(self.daemons) <= slot:
+            self.daemons.append(None)
+        self.daemons[slot] = d
+        self.n = max(self.n, slot + 1)
+        d.start()
+        return d
+
+    def wait_caught_up(self, idx: int, timeout: float = 15.0) -> None:
+        """Block until replica ``idx`` has applied everything committed
+        cluster-wide at call time."""
+        leader = self.wait_for_leader(timeout)
+        with leader.lock:
+            target = leader.node.log.commit
+        deadline = time.monotonic() + timeout
+        d = self.daemons[idx]
+        while time.monotonic() < deadline:
+            with d.lock:
+                if d.node.log.apply >= target:
+                    return
+            time.sleep(0.01)
+        raise AssertionError(
+            f"replica {idx} not caught up to {target} within {timeout}s")
 
     # -- invariants -------------------------------------------------------
 

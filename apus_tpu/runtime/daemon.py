@@ -52,6 +52,7 @@ class ReplicaDaemon:
                  tick_interval: float = 0.0005,
                  log_file: Optional[str] = None,
                  db_dir: Optional[str] = None,
+                 recovery_start: bool = False,
                  seed: int = 0):
         self.idx = idx
         self.spec = spec
@@ -66,6 +67,7 @@ class ReplicaDaemon:
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
             elect_high=spec.elect_high, prune_period=spec.prune_period,
             max_batch=spec.max_batch, auto_remove=spec.auto_remove,
+            fail_window=spec.fail_window, recovery_start=recovery_start,
             seed=seed)
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
@@ -88,6 +90,10 @@ class ReplicaDaemon:
         # used by the bridge to mirror role/term into shared memory
         # synchronously with role transitions (no stale-flag window).
         self.on_tick: list[Callable[[], None]] = []
+        # Snapshot-install observers: (Snapshot, ep_dump) after a
+        # leader-pushed snapshot replaced local state (persistence must
+        # record it; a proxied replica's bridge re-primes its app).
+        self.on_snapshot: list[Callable] = []
 
         # Durable store (stable storage, db-interface.c analog).  On
         # restart with an existing store, replay it into the SM and
@@ -103,6 +109,7 @@ class ReplicaDaemon:
             if self.persistence.store.count:
                 self.persistence.replay_into(self.node.sm, self.node.epdb)
             self.on_commit.append(self.persistence.on_commit)
+            self.on_snapshot.append(self.persistence.on_snapshot)
 
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
@@ -118,7 +125,8 @@ class ReplicaDaemon:
 
     def _extra_ops(self) -> dict:
         from apus_tpu.runtime.client import make_client_ops
-        return make_client_ops(self)
+        from apus_tpu.runtime.membership import make_membership_ops
+        return {**make_client_ops(self), **make_membership_ops(self)}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -158,6 +166,16 @@ class ReplicaDaemon:
             time.sleep(self._tick_interval)
 
     def _drain_upcalls(self) -> None:
+        if self.node.snapshot_upcalls:
+            snaps, self.node.snapshot_upcalls = \
+                self.node.snapshot_upcalls, []
+            for snap, ep_dump in snaps:
+                for cb in self.on_snapshot:
+                    cb(snap, ep_dump)
+        if self.node.config_upcalls:
+            cfgs, self.node.config_upcalls = self.node.config_upcalls, []
+            for e in cfgs:
+                self._handle_config_entry(e)
         if not self.node.committed_upcalls:
             return
         entries, self.node.committed_upcalls = \
@@ -165,6 +183,28 @@ class ReplicaDaemon:
         for e in entries:
             for cb in self.on_commit:
                 cb(e)
+
+    def _handle_config_entry(self, e: LogEntry) -> None:
+        """Applied CONFIG entry: learn new peers (the poll_config_entries
+        follower side, dare_server.c:2133-2187).  Join entries carry
+        ``"<slot> <addr>"`` in data."""
+        if e.data:
+            try:
+                slot_s, addr = e.data.decode().split(" ", 1)
+                slot = int(slot_s)
+            except ValueError:
+                self.logger.warning("bad CONFIG payload %r", e.data)
+                return
+            if slot != self.idx:
+                self.transport.set_peer(slot, _parse_peer(addr))
+            # Shared-spec peer table: idempotent slot-indexed write (all
+            # daemons of a LocalCluster share one spec object).
+            peers = self.spec.peers
+            while len(peers) <= slot:
+                peers.append("")
+            peers[slot] = addr
+            self.logger.info("CONFIG: slot %d -> %s (%r)", slot, addr,
+                             e.cid)
 
     def _log_role_changes(self) -> None:
         role = (self.node.role, self.node.current_term)

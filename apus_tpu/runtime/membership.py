@@ -1,0 +1,108 @@
+"""Membership service: join protocol over the DCN control plane.
+
+The reference's join path rides UD multicast: a joiner mcasts JOIN,
+the leader assigns a slot or up-sizes the configuration and appends a
+CONFIG entry, and the reply (CFG_REPLY: idx, cid, head) arrives once the
+entry applies (ud_join_cluster dare_ibv_ud.c:952-967,
+handle_server_join_request :972-1068, ud_send_clt_reply :1451-1498).
+
+Our control plane is TCP to any replica's PeerServer: non-leaders answer
+NOT_LEADER with a hint (the joiner "multicasts" by iterating peers), the
+leader blocks the join connection until the CONFIG entry applies, then
+replies with the assigned slot, the new Cid, and the full peer list.
+Log/state catch-up needs no separate handshake: the leader's replication
+path adjusts the joiner from scratch and pushes a snapshot if the
+joiner is behind the pruned head (Node._replicate).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Optional
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.parallel import wire
+from apus_tpu.runtime.client import ST_NOT_LEADER, ST_TIMEOUT, _not_leader
+
+OP_JOIN = wire.OP_JOIN
+
+
+def make_membership_ops(daemon) -> dict:
+    """Extra PeerServer op: JOIN (runs on a per-connection thread)."""
+
+    def join(r: wire.Reader) -> bytes:
+        addr = r.blob().decode()
+        with daemon.lock:
+            pj = daemon.node.handle_join(addr)
+        if pj is None:
+            return _not_leader(daemon)
+        deadline = time.monotonic() + daemon.client_op_timeout
+        with daemon.commit_cond:
+            while True:
+                if pj.done:
+                    daemon.logger.info("JOIN %s -> slot %d (%r)", addr,
+                                       pj.slot, daemon.node.cid)
+                    return (wire.u8(wire.ST_OK) + wire.u8(pj.slot)
+                            + wire.encode_cid(daemon.node.cid)
+                            + wire.blob(json.dumps(
+                                daemon.spec.peers).encode()))
+                if not daemon.node.is_leader:
+                    return _not_leader(daemon)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return wire.u8(ST_TIMEOUT)
+                daemon.commit_cond.wait(min(left, 0.05))
+
+    return {OP_JOIN: join}
+
+
+def request_join(peers: list[str], my_addr: str,
+                 timeout: float = 15.0) -> tuple[int, Cid, list[str]]:
+    """Joiner side: find the leader and request admission.  Returns
+    (slot, cid, full peer list).  Retries across redirects/elections."""
+    payload = wire.u8(OP_JOIN) + wire.blob(my_addr.encode())
+    deadline = time.monotonic() + timeout
+    candidates = list(peers)
+    i = 0
+    while time.monotonic() < deadline:
+        target = candidates[i % len(candidates)]
+        i += 1
+        resp = _roundtrip(target, payload, deadline)
+        if resp is None:
+            time.sleep(0.05)
+            continue
+        st = resp[0]
+        if st == wire.ST_OK:
+            r = wire.Reader(resp[1:])
+            slot = r.u8()
+            cid = wire.decode_cid(r)
+            full_peers = json.loads(r.blob().decode())
+            return slot, cid, full_peers
+        if st == ST_NOT_LEADER:
+            hint = wire.Reader(resp[1:]).blob().decode() \
+                if len(resp) > 1 else ""
+            if hint and hint not in candidates:
+                candidates.append(hint)
+            if hint:
+                i = candidates.index(hint)
+            time.sleep(0.01)
+            continue
+        time.sleep(0.05)      # ST_TIMEOUT / transient: retry
+    raise TimeoutError(f"join of {my_addr} not admitted in {timeout}s")
+
+
+def _roundtrip(addr: str, payload: bytes,
+               deadline: float) -> Optional[bytes]:
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection(
+                (host, int(port)),
+                timeout=max(0.05, min(2.0, deadline - time.monotonic()))) \
+                as conn:
+            conn.settimeout(max(0.05, deadline - time.monotonic()))
+            conn.sendall(wire.frame(payload))
+            return wire.read_frame(conn)
+    except (OSError, ConnectionError, ValueError):
+        return None

@@ -1,40 +1,41 @@
-"""Durable persistence of applied commands + snapshot build/apply.
+"""Durable persistence of applied commands + installed snapshots.
 
 Parity with the reference's stable-storage path: every captured request
-is persisted to BerkeleyDB (stablestorage_store_cmd, proxy.c:269-291),
-the SM snapshot *is* the DB dump (stablestorage_dump_records,
-proxy.c:300), and applying a snapshot both re-stores and replays it
-(proxy.c:306-339).
+is persisted to BerkeleyDB (stablestorage_store_cmd, proxy.c:269-291)
+and applying a snapshot re-stores its records (proxy.c:306-339).
 
 Design difference (deliberate): the reference persists entries at
 replication time, pre-commit (persist_new_entries,
 dare_server.c:1792-1810), so its store can contain entries that never
 commit.  We persist at apply time — the store is always a prefix of the
-committed, applied log, which makes restart recovery exact: replay the
-store into the SM + endpoint DB, then catch up the rest from peers.
+committed, applied log — and we persist installed snapshots as store
+records too, so a replica that caught up via snapshot push still
+recovers its full state on restart: replay scans the store in order,
+resetting at each snapshot record and applying entry records after it.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import struct
 
 from apus_tpu.core.epdb import EndpointDB
 from apus_tpu.core.log import LogEntry
 from apus_tpu.models.sm import Snapshot, StateMachine
 from apus_tpu.parallel import wire
-from apus_tpu.utils.store import open_store, parse_dump
+from apus_tpu.utils.store import open_store
 
-#: On-disk record layout magic.  The wire LogEntry layout is shared
-#: with the network protocol, which may evolve; the 4-byte magic makes a
-#: stale store fail loudly instead of decoding garbage — deterministic,
-#: unlike a 1-byte version that a v1 record's idx LSB could collide
-#: with.  (APR1 was a dev format with u32 clt_id; APR2 widened it.)
-RECORD_MAGIC = b"APR2"
+#: On-disk record layout magics.  The wire LogEntry layout is shared
+#: with the network protocol, which may evolve; 4-byte magics make a
+#: stale store fail loudly instead of decoding garbage.  (APR1 was a
+#: dev format with u32 clt_id; APR2 widened it.)
+RECORD_MAGIC = b"APR2"     # one applied log entry
+SNAP_MAGIC = b"APS2"       # an installed snapshot (SM blob + epdb dump)
 
 
 class Persistence:
-    """Attach to a ReplicaDaemon: persists every applied CSM entry."""
+    """Attach to a ReplicaDaemon: persists applied CSM entries and
+    installed snapshots."""
 
     def __init__(self, path: str, prefer_native: bool = True):
         self.store = open_store(path, prefer_native=prefer_native)
@@ -42,62 +43,51 @@ class Persistence:
     def on_commit(self, e: LogEntry) -> None:
         self.store.append(RECORD_MAGIC + wire.encode_entry(e))
 
-    # -- snapshots --------------------------------------------------------
-
-    def snapshot(self) -> Snapshot:
-        """The snapshot is the store dump (proxy.c:300 analog).  One
-        dump serves both the payload and the last determinant."""
-        blob = self.store.dump()
-        e = last_record_entry(blob)
-        last_idx, last_term = (e.idx, e.term) if e else (0, 0)
-        return Snapshot(last_idx, last_term, blob)
-
-    def apply_snapshot(self, snap: Snapshot, sm: StateMachine,
-                       epdb: EndpointDB) -> None:
-        """Replace the store with the snapshot and replay it
-        (proxy.c:306-339 analog: re-store + replay every record)."""
-        self.store.load_dump(snap.data)
-        replay(self.store.records(), sm, epdb)
+    def on_snapshot(self, snap: Snapshot, ep_dump: list) -> None:
+        """Record a leader-pushed snapshot install (without it, restart
+        replay would rebuild from a store missing the snapshot prefix)."""
+        self.store.append(
+            SNAP_MAGIC + struct.pack("<QQ", snap.last_idx, snap.last_term)
+            + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump))
 
     # -- recovery ---------------------------------------------------------
-
-    def last_determinant(self) -> tuple[int, int]:
-        e = last_record_entry(self.store.dump())
-        return (e.idx, e.term) if e else (0, 0)
 
     def replay_into(self, sm: StateMachine, epdb: EndpointDB) -> int:
         """Rebuild SM + endpoint-DB state from the store; returns the
         next log index to fetch from peers (apply floor)."""
-        recs = self.store.records()
-        replay(recs, sm, epdb)
-        if not recs:
-            return 1
-        return decode_record(recs[-1]).idx + 1
+        nxt = 1
+        for rec in self.store.records():
+            kind, payload = decode_record(rec)
+            if kind == "entry":
+                reply = sm.apply(payload.idx, payload.data)
+                epdb.note_applied(payload.clt_id, payload.req_id,
+                                  payload.idx, reply)
+                nxt = payload.idx + 1
+            else:
+                snap, ep_dump = payload
+                sm.apply_snapshot(snap)
+                epdb.load(ep_dump)
+                nxt = snap.last_idx + 1
+        return nxt
 
     def close(self) -> None:
         self.store.close()
 
 
-def decode_record(rec: bytes) -> LogEntry:
-    if rec[:4] != RECORD_MAGIC:
-        raise ValueError(
-            f"unsupported store record format {rec[:4]!r} "
-            f"(expected {RECORD_MAGIC!r}); refusing to decode")
-    return wire.decode_entry(wire.Reader(rec[4:]))
-
-
-def last_record_entry(blob: bytes):
-    """Decode the final record of a dump, or None if empty."""
-    recs = parse_dump(blob)
-    return decode_record(recs[-1]) if recs else None
-
-
-def replay(records: list[bytes], sm: StateMachine,
-           epdb: EndpointDB) -> None:
-    for rec in records:
-        e = decode_record(rec)
-        reply = sm.apply(e.idx, e.data)
-        epdb.note_applied(e.clt_id, e.req_id, e.idx, reply)
+def decode_record(rec: bytes):
+    """-> ("entry", LogEntry) | ("snapshot", (Snapshot, ep_dump))."""
+    magic = rec[:4]
+    if magic == RECORD_MAGIC:
+        return "entry", wire.decode_entry(wire.Reader(rec[4:]))
+    if magic == SNAP_MAGIC:
+        last_idx, last_term = struct.unpack_from("<QQ", rec, 4)
+        r = wire.Reader(rec[20:])
+        data = r.blob()
+        ep_dump = wire.decode_ep_dump(r)
+        return "snapshot", (Snapshot(last_idx, last_term, data), ep_dump)
+    raise ValueError(
+        f"unsupported store record format {magic!r} "
+        f"(expected {RECORD_MAGIC!r} or {SNAP_MAGIC!r}); refusing to decode")
 
 
 def daemon_store_path(db_dir: str, idx: int) -> str:

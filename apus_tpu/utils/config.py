@@ -35,8 +35,10 @@ class ClusterSpec:
     slot_bytes: int = DEFAULT_SLOT_BYTES
     max_batch: int = 64
     # failure detector: auto-remove dead members via CONFIG entries
-    # (check_failure_count analog, dare_server.c:1189-1227)
+    # (check_failure_count analog, dare_server.c:1189-1227); failures
+    # counted at most once per fail_window seconds
     auto_remove: bool = True
+    fail_window: float = 0.100
     # control plane endpoints, one per server idx ("host:port")
     peers: list[str] = dataclasses.field(default_factory=list)
     # proxied application endpoint (config-proxy.c:14-45)

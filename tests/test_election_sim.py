@@ -134,3 +134,24 @@ def test_deterministic_replay():
                 [n.current_term for n in c.nodes],
                 [n.stats["elections"] for n in c.nodes])
     assert run() == run()
+
+
+def test_failover_terms_stay_bounded():
+    """Regression: dueling candidates must converge, not escalate terms.
+
+    A demote-on-higher-term that adopts (term, own_idx) before the vote
+    decision trips the no-vote-switch rule and refuses the very vote it
+    was demoted for — each survivor then deposes the other one term up,
+    forever (observed terms in the thousands within seconds).  After a
+    single leader crash the election must settle within a handful of
+    terms."""
+    for seed in (1, 5, 9):
+        c = Cluster(3, seed=seed)
+        old = c.wait_for_leader()
+        old_term = old.current_term
+        c.crash(old.idx)
+        new = c.wait_for_leader(timeout=20.0)
+        assert new.current_term <= old_term + 5, (
+            f"seed {seed}: term escalated {old_term} -> {new.current_term}")
+        c.submit(b"ok")
+        c.check_logs_consistent()

@@ -24,7 +24,12 @@ def test_record_codec_roundtrip():
     for action, conn, data in [(0, 1, b""), (1, 2 ** 40, b"SET a b\n"),
                                (2, 7, b"")]:
         assert decode_record(encode_record(action, conn, data)) == \
-            (action, conn, data)
+            (action, conn, data, 0, 0)
+    # Origin metadata travels with the record (snapshot replay routing).
+    clt, rid = bridge_clt_id(3), 99
+    assert decode_record(
+        encode_record(1, 5, b"x", clt_id=clt, req_id=rid)) == \
+        (1, 5, b"x", clt, rid)
 
 
 def test_bridge_clt_id_namespace():

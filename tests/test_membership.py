@@ -1,0 +1,166 @@
+"""Elasticity: join protocol, resize ladder, snapshot catch-up.
+
+Reference scenarios: AddServer/Upsize in reconf_bench.sh:147-180, the
+join path of §3.4 (SURVEY.md), and the EXTENDED->TRANSIT->STABLE ladder
+(dare_config.h:17-24, dare_server.c:1888-1930).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from apus_tpu.core.cid import CidState
+from apus_tpu.models.kvs import KvsStateMachine, encode_put
+from apus_tpu.runtime.cluster import LocalCluster
+from apus_tpu.utils.config import ClusterSpec
+
+# Reference DEBUG-scale timings (nodes.local.cfg:22-37): tighter
+# timeouts flap under full-suite CPU contention.
+SPEC = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                   elect_low=0.150, elect_high=0.400,
+                   prune_period=0.200)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _stores_equal(cluster, idxs):
+    stores = []
+    for i in idxs:
+        d = cluster.daemons[i]
+        with d.lock:
+            stores.append(dict(d.node.sm.store))
+    return all(s == stores[0] for s in stores)
+
+
+def test_add_replica_upsize_to_stable():
+    """3 -> 4 replicas: join admits, EXTENDED -> TRANSIT -> STABLE, and
+    the joiner converges to the cluster state."""
+    with LocalCluster(3, spec=SPEC) as c:
+        for i in range(10):
+            c.submit(encode_put(b"k%d" % i, b"v%d" % i))
+        d = c.add_replica()
+        assert d.idx == 3
+
+        # Ladder completes: every replica reaches STABLE at size 4.
+        def stable4():
+            for dd in c.live():
+                with dd.lock:
+                    cid = dd.node.cid
+                    if not (cid.state == CidState.STABLE and cid.size == 4
+                            and cid.contains(3)):
+                        return False
+            return True
+        _wait(stable4, msg="STABLE size-4 cid on all replicas")
+
+        c.wait_caught_up(3)
+        _wait(lambda: _stores_equal(c, range(4)), msg="stores converge")
+        c.check_logs_consistent()
+
+        # The grown group still commits (now needing 3-of-4).
+        c.submit(encode_put(b"after", b"grow"))
+        c.wait_caught_up(3)
+        with d.lock:
+            assert d.node.sm.store[b"after"] == b"grow"
+
+
+def test_join_behind_pruned_head_gets_snapshot():
+    """A joiner arriving after log pruning catches up via the leader's
+    snapshot push (rc_recover_sm analog) + tail replication."""
+    with LocalCluster(3, spec=SPEC) as c:
+        for i in range(30):
+            c.submit(encode_put(b"k%d" % i, b"v%d" % i))
+        # Wait for pruning to advance the leader's head past 1.
+        def pruned():
+            leader = c.leader()
+            if leader is None:
+                return False
+            with leader.lock:
+                return leader.node.log.head > 10
+        _wait(pruned, msg="leader log pruned")
+
+        d = c.add_replica()
+        c.wait_caught_up(d.idx, timeout=20.0)
+        _wait(lambda: _stores_equal(c, [0, 1, 2, d.idx]),
+              msg="joiner store converges")
+
+        leader = c.wait_for_leader()
+        with leader.lock:
+            assert leader.node.stats.get("snapshots_pushed", 0) >= 1, \
+                "catch-up should have used a snapshot"
+        with d.lock:
+            assert d.node.stats.get("snapshots_installed", 0) >= 1
+            assert d.node.sm.store[b"k0"] == b"v0"
+            assert d.node.sm.store[b"k29"] == b"v29"
+
+
+def test_snapshot_install_is_persisted(tmp_path):
+    """A replica that catches up via snapshot push must recover its FULL
+    state from its durable store on restart — the store records the
+    installed snapshot, not just post-snapshot entries."""
+    from apus_tpu.core.epdb import EndpointDB
+    from apus_tpu.runtime.persist import Persistence, daemon_store_path
+
+    with LocalCluster(3, spec=SPEC, db_dir=str(tmp_path)) as c:
+        for i in range(30):
+            c.submit(encode_put(b"k%d" % i, b"v%d" % i))
+
+        def pruned():
+            leader = c.leader()
+            if leader is None:
+                return False
+            with leader.lock:
+                return leader.node.log.head > 10
+        _wait(pruned, msg="leader log pruned")
+
+        d = c.add_replica()
+        c.wait_caught_up(d.idx, timeout=20.0)
+        with d.lock:
+            assert d.node.stats.get("snapshots_installed", 0) >= 1
+        c.submit(encode_put(b"post", b"snap"))
+        c.wait_caught_up(d.idx)
+        joiner_idx = d.idx
+        c.kill(joiner_idx)
+
+        # The store alone must rebuild the complete state.
+        sm = KvsStateMachine()
+        p = Persistence(daemon_store_path(str(tmp_path), joiner_idx))
+        p.replay_into(sm, EndpointDB())
+        p.close()
+        assert sm.store[b"k0"] == b"v0", \
+            "snapshot-covered entries missing from durable store"
+        assert sm.store[b"k29"] == b"v29"
+        assert sm.store[b"post"] == b"snap"
+
+
+def test_two_sequential_joins():
+    """3 -> 4 -> 5, each join completing the full ladder."""
+    with LocalCluster(3, spec=SPEC) as c:
+        c.submit(encode_put(b"a", b"1"))
+        d4 = c.add_replica()
+        c.wait_caught_up(d4.idx)
+        d5 = c.add_replica()
+        c.wait_caught_up(d5.idx)
+
+        def stable5():
+            for dd in c.live():
+                with dd.lock:
+                    cid = dd.node.cid
+                    if not (cid.state == CidState.STABLE
+                            and cid.size == 5):
+                        return False
+            return True
+        _wait(stable5, msg="STABLE size-5")
+        c.submit(encode_put(b"b", b"2"))
+        c.wait_caught_up(d4.idx)
+        c.wait_caught_up(d5.idx)
+        _wait(lambda: _stores_equal(c, range(5)), msg="stores converge")
+        c.check_logs_consistent()

@@ -1,0 +1,125 @@
+"""Failure-driven removal, rejoin, and whole-system failover.
+
+Reference scenarios: RemoveServer/RemoveLeader + re-add in
+reconf_bench.sh (:16-24, :100-180), failure counting
+(check_failure_count, dare_server.c:1189-1227), and recovery §3.4.
+"""
+
+from __future__ import annotations
+
+import time
+
+from apus_tpu.core.cid import CidState
+from apus_tpu.models.kvs import KvsStateMachine, encode_put
+from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster
+from apus_tpu.runtime.cluster import LocalCluster
+from apus_tpu.utils.config import ClusterSpec
+
+# Reference DEBUG-scale timings (nodes.local.cfg:22-37): tighter
+# timeouts flap under full-suite CPU contention.
+SPEC = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                   elect_low=0.150, elect_high=0.400,
+                   prune_period=0.200, fail_window=0.100)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_crashed_follower_removed_then_rejoins():
+    """A crashed follower is auto-removed via CONFIG (failure detector),
+    the group keeps committing, and a replacement joins into the freed
+    slot and converges."""
+    with LocalCluster(5, spec=SPEC) as c:
+        c.submit(encode_put(b"pre", b"1"))
+        leader = c.wait_for_leader()
+        victim = next(i for i in range(5)
+                      if c.daemons[i] is not None and i != leader.idx)
+        c.kill(victim)
+
+        def removed():
+            ld = c.leader()
+            if ld is None:
+                return False
+            with ld.lock:
+                return not ld.node.cid.contains(victim)
+        _wait(removed, msg=f"victim {victim} removed from cid")
+
+        # Still commits with the shrunk membership.
+        c.submit(encode_put(b"during", b"2"))
+
+        # A replacement joins; the freed slot is reused (empty_slot).
+        d = c.add_replica()
+        assert d.idx == victim, (d.idx, victim)
+        c.wait_caught_up(d.idx)
+        with d.lock:
+            assert d.node.sm.store[b"pre"] == b"1"
+            assert d.node.sm.store[b"during"] == b"2"
+            assert d.node.cid.contains(victim)
+        c.check_logs_consistent()
+
+
+def test_leader_crash_failover_and_rejoin():
+    """RemoveLeader scenario: kill the leader; a new one takes over and
+    the group keeps serving; the old leader's slot can be refilled."""
+    with LocalCluster(3, spec=SPEC) as c:
+        c.submit(encode_put(b"a", b"1"))
+        old = c.wait_for_leader()
+        t0 = time.monotonic()
+        c.kill(old.idx)
+        new = c.wait_for_leader()
+        failover_s = time.monotonic() - t0
+        assert new.idx != old.idx
+        c.submit(encode_put(b"b", b"2"))
+        # Sanity envelope: re-election within the configured timeouts'
+        # order of magnitude (elect_high=150 ms + detection).
+        assert failover_s < 10.0, failover_s
+
+        _wait(lambda: c.leader() is not None
+              and not c.leader().node.cid.contains(old.idx),
+              msg="old leader removed")
+        d = c.add_replica()
+        assert d.idx == old.idx
+        c.wait_caught_up(d.idx)
+        with d.lock:
+            assert d.node.sm.store[b"a"] == b"1"
+            assert d.node.sm.store[b"b"] == b"2"
+
+
+def test_proxied_cluster_leader_failover():
+    """Whole-system failover: kill the leader's replica (daemon + app +
+    bridge); clients re-discover the new leader's app and writes resume;
+    survivors converge."""
+    with ProxiedCluster(3) as pc:
+        leader, replies = pc.write_round(
+            [f"SET k{i} v{i}" for i in range(5)])
+        assert replies == ["OK"] * 5
+
+        pc.kill(leader)
+        survivors = [i for i in range(3) if i != leader]
+
+        # New leader emerges among survivors; write through its app.
+        leader2, replies2 = pc.write_round(
+            [f"SET m{i} w{i}" for i in range(5)], attempts=10)
+        assert leader2 in survivors
+        assert replies2 == ["OK"] * 5
+
+        # Both surviving apps converge to pre- and post-failover writes.
+        def converged():
+            for f in survivors:
+                try:
+                    with LineClient(pc.app_addr(f), timeout=2.0) as cl:
+                        if cl.cmd("GET k4") != "v4":
+                            return False
+                        if cl.cmd("GET m4") != "w4":
+                            return False
+                except OSError:
+                    return False
+            return True
+        _wait(converged, timeout=15.0, msg="surviving apps converge")
+        pc.cluster.check_logs_consistent()
