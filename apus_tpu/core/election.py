@@ -111,6 +111,11 @@ class AdaptiveTimeout:
         if hb_gap > self.timeout:
             self.false_positives += 1
             self.timeout *= self.growth
-        if (self.samples >= self.min_samples and
+        # Freeze only once the sample count can actually attest a rate
+        # below fp_target: 0 fps in 100 samples says nothing about a
+        # 1e-4 target — freezing there would lock the base timeout in
+        # before the first expected false positive could ever occur.
+        need = max(self.min_samples, int(round(1.0 / self.fp_target)))
+        if (self.samples >= need and
                 self.false_positives / self.samples < self.fp_target):
             self.frozen = True

@@ -29,7 +29,8 @@ import random
 from typing import Optional
 
 from apus_tpu.core.cid import Cid, CidState
-from apus_tpu.core.election import (VoteRequest, best_vote_request,
+from apus_tpu.core.election import (AdaptiveTimeout, VoteRequest,
+                                    best_vote_request,
                                     random_election_timeout, should_grant)
 from apus_tpu.core.epdb import EndpointDB, PendingRead
 from apus_tpu.core.log import LogEntry, SlotLog
@@ -62,6 +63,12 @@ class NodeConfig:
     # 2-strike rule is implicitly time-throttled too).
     auto_remove: bool = True
     fail_window: float = 0.100
+    # Adaptive failure detector (to_adjust_cb analog,
+    # dare_server.c:763-817): grow hb_timeout from observed heartbeat
+    # gaps until the false-positive rate is negligible, then freeze.
+    # Keeps GIL-jittery deployments from spurious elections without
+    # hand-tuning hb_timeout per environment.
+    adaptive_timeout: bool = True
     # Recovery start: a restarted/joining replica must not campaign
     # before making contact with the group — its stale log cannot win,
     # but its vote requests bump terms and depose live leaders in a
@@ -117,6 +124,8 @@ class Node:
         # timers
         self._last_hb_seen = 0.0
         self._hb_timeout = cfg.hb_timeout
+        self._hb_adapt = (AdaptiveTimeout(cfg.hb_timeout)
+                          if cfg.adaptive_timeout else None)
         self._next_hb_send = 0.0
         self._election_deadline: Optional[float] = None
         self._prevote_deadline: Optional[float] = None
@@ -628,6 +637,13 @@ class Node:
                 self.sid.update(Sid(best.term, False, best.idx).word)
                 self.regions.grant_log_access(best.idx, best.term)
                 self.become_follower(best.with_leader(True), now)
+            elif self._hb_adapt is not None and self._last_hb_seen > 0:
+                # Same leader, steady state: feed the observed gap to the
+                # failure detector (gaps beyond the current timeout are
+                # the false positives it widens itself over).
+                self._hb_adapt.observe(now - self._last_hb_seen)
+                self._hb_timeout = max(self.cfg.hb_timeout,
+                                       self._hb_adapt.timeout)
             self._last_hb_seen = now
 
     # ------------------------------------------------------------------

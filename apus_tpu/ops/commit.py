@@ -172,6 +172,23 @@ def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
     return log_data, log_meta, offs, fence, acks, commit_global
 
 
+def _check_geometry(mesh: Mesh, n_replicas: int, n_slots: int,
+                    batch: int) -> None:
+    axis_size = mesh.shape[REPLICA_AXIS]
+    if n_replicas % axis_size != 0:
+        raise ValueError(f"{n_replicas} replicas on {axis_size}-wide mesh")
+    if n_slots % batch != 0:
+        raise ValueError(f"n_slots ({n_slots}) must be a multiple of "
+                         f"batch ({batch})")
+
+
+def _assert_devlog_geometry(devlog: DeviceLog, n_slots: int,
+                            slot_bytes: int, batch: int) -> None:
+    assert devlog.data.shape[1:] == (n_slots + batch, slot_bytes), \
+        f"devlog geometry {devlog.data.shape} != step geometry " \
+        f"({n_slots}+{batch}, {slot_bytes})"
+
+
 def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
                       slot_bytes: int, batch: int, auto_advance: bool = False):
     """Compile-ready commit step bound to a mesh + static geometry.
@@ -189,12 +206,7 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     forward control block (``end0 += B``) so a steady-state pipeline can
     loop device-side values without host reconstruction.
     """
-    axis_size = mesh.shape[REPLICA_AXIS]
-    if n_replicas % axis_size != 0:
-        raise ValueError(f"{n_replicas} replicas on {axis_size}-wide mesh")
-    if n_slots % batch != 0:
-        raise ValueError(f"n_slots ({n_slots}) must be a multiple of "
-                         f"batch ({batch})")
+    _check_geometry(mesh, n_replicas, n_slots, batch)
     body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
     sharded = P(REPLICA_AXIS)
     repl = P()
@@ -208,9 +220,7 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(devlog: DeviceLog, batch_data, batch_meta, ctrl: CommitControl):
-        assert devlog.data.shape[1:] == (n_slots + batch, slot_bytes), \
-            f"devlog geometry {devlog.data.shape} != step geometry " \
-            f"({n_slots}+{batch}, {slot_bytes})"
+        _assert_devlog_geometry(devlog, n_slots, slot_bytes, batch)
         d, m, o, f, acks, commit = fn(devlog.data, devlog.meta, devlog.offs,
                                       devlog.fence, batch_data, batch_meta,
                                       ctrl)
@@ -219,6 +229,75 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
             nxt = dataclasses.replace(ctrl, end0=ctrl.end0 + batch)
             return out + (nxt,)
         return out
+
+    return step
+
+
+def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
+                                slot_bytes: int, batch: int, depth: int,
+                                staged_depth: int | None = None):
+    """Device-resident pipelined commit: ``depth`` consecutive commit
+    rounds execute inside ONE XLA program (a ``lax.scan`` over staged
+    batches), so host dispatch cost is paid once per ``depth`` rounds.
+
+    This is the TPU re-expression of the reference's pipelining — many
+    outstanding unsignaled WRs with selective signaling (post_send,
+    dare_ibv_rc.c:2552-2568): the RDMA path overlaps rounds by keeping
+    the NIC queue full; the XLA path overlaps them by keeping the whole
+    round loop on-device.  Semantics per round are identical to
+    ``build_commit_step`` (same body), with ``end0`` rolled forward
+    round over round.
+
+    Returns ``step(devlog, staged_data [SD,R,B,SB] u8, staged_meta
+    [SD,R,B,4] i32, ctrl) -> (devlog', commits [D] i32, ctrl')`` where
+    ``commits[i]`` is the global commit index after round i and ``ctrl'``
+    has ``end0`` advanced by ``D*B`` (steady-state loops feed it back).
+
+    ``staged_depth`` (SD, default = depth) is how many distinct staged
+    batches are provided; round i consumes batch ``i % SD``.  SD=1 with
+    a large depth is the steady-state throughput shape: one resident
+    batch re-committed round after round with no staging cost.
+    """
+    staged_depth = depth if staged_depth is None else staged_depth
+    _check_geometry(mesh, n_replicas, n_slots, batch)
+    body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
+    sharded = P(REPLICA_AXIS)
+    staged = P(None, REPLICA_AXIS)
+    repl = P()
+    ctrl_specs = CommitControl(*([repl] * 7))
+
+    def pipe(log_data, log_meta, offs, fence, sdata, smeta, ctrl):
+        def one(carry, i):
+            log_data, log_meta, offs, fence, ctrl = carry
+            bdata = lax.dynamic_index_in_dim(sdata, i % staged_depth,
+                                             axis=0, keepdims=False)
+            bmeta = lax.dynamic_index_in_dim(smeta, i % staged_depth,
+                                             axis=0, keepdims=False)
+            log_data, log_meta, offs, fence, _, commit = body(
+                log_data, log_meta, offs, fence, bdata, bmeta, ctrl)
+            ctrl = dataclasses.replace(ctrl, end0=ctrl.end0 + batch)
+            return (log_data, log_meta, offs, fence, ctrl), commit
+        (log_data, log_meta, offs, fence, ctrl), commits = lax.scan(
+            one, (log_data, log_meta, offs, fence, ctrl),
+            jnp.arange(depth, dtype=jnp.int32))
+        return log_data, log_meta, offs, fence, commits, ctrl
+
+    fn = jax.shard_map(
+        pipe, mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, staged, staged,
+                  ctrl_specs),
+        out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs),
+        check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(devlog: DeviceLog, staged_data, staged_meta,
+             ctrl: CommitControl):
+        _assert_devlog_geometry(devlog, n_slots, slot_bytes, batch)
+        assert staged_data.shape[0] == staged_depth
+        d, m, o, f, commits, ctrl = fn(devlog.data, devlog.meta,
+                                       devlog.offs, devlog.fence,
+                                       staged_data, staged_meta, ctrl)
+        return DeviceLog(d, m, o, f), commits, ctrl
 
     return step
 

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""LogGP parameter estimation for the replication paths.
+
+The reference ships a built-in LogGP mode measuring its NIC's o (send
+overhead), o_poll (completion-poll overhead), L (latency) and G (gap
+per byte) to size queues and predict commit latency
+(rc_get_loggp_params / rc_loggp_prtt, dare_ibv_rc.c:3322-3749,
+SRV_TYPE_LOGGP dare_server.h:26).  This is the analog for our two
+planes:
+
+  DCN plane (host control): o + L from round-tripping small ctrl_write
+  RPCs between two live replica daemons; G from streaming log_write
+  batches of increasing payload size.
+
+  Device plane (ICI/XLA): o_dispatch from the single commit-step
+  dispatch latency; g_round from the marginal cost of one extra
+  pipelined round (depth-D scan vs depth-1, slope per round).
+
+Output: one human table + one JSON line.
+
+Usage: [env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu] \
+           python benchmarks/loggp.py [--payload-max 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.runtime.cluster import LocalCluster  # noqa: E402
+from apus_tpu.parallel.transport import Region  # noqa: E402
+
+
+def measure_dcn(payload_max: int) -> dict:
+    from apus_tpu.core.log import LogEntry
+
+    with LocalCluster(2) as c:
+        leader = c.wait_for_leader()
+        peer = next(d.idx for d in c.live() if d.idx != leader.idx)
+        t = leader.transport
+
+        # o + L: small ctrl round trips (HB-slot write, 8 bytes).
+        n = 300
+        with leader.lock:
+            sid_word = leader.node.sid.word
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter_ns()
+            t.ctrl_write(peer, Region.HB, leader.idx, sid_word)
+            lat.append((time.perf_counter_ns() - t0) / 1e3)
+        lat.sort()
+        o_plus_l = lat[n // 2]
+
+        # G: marginal cost per byte from streaming payload sizes.  The
+        # entries are never appended (idx far beyond the peer's end is
+        # rejected as non-contiguous server-side) — we measure the wire,
+        # not the log.
+        sizes = [256, 4096, payload_max]
+        per_size = {}
+        with leader.lock:
+            term = leader.node.current_term
+            my = leader.node.sid.sid
+        for sz in sizes:
+            e = LogEntry(idx=1 << 40, term=term, data=b"x" * sz)
+            m = 30
+            ls = []
+            for _ in range(m):
+                t0 = time.perf_counter_ns()
+                t.log_write(peer, my, [e], 0)
+                ls.append((time.perf_counter_ns() - t0) / 1e3)
+            ls.sort()
+            per_size[sz] = ls[m // 2]
+        big, small = max(sizes), min(sizes)
+        g_ns_per_byte = max(
+            0.0, (per_size[big] - per_size[small]) * 1e3 / (big - small))
+
+    return {"o_plus_L_us": round(o_plus_l, 1),
+            "G_ns_per_byte": round(g_ns_per_byte, 3),
+            "rtt_by_payload_us": {str(k): round(v, 1)
+                                  for k, v in per_size.items()}}
+
+
+def measure_device() -> dict:
+    import jax
+
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.ops.commit import (CommitControl, build_commit_step,
+                                     build_pipelined_commit_step, place_batch)
+    from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+    from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+
+    R, S, SB, B, D = 5, 1024, 1024, 64, 64
+    mesh = replica_mesh(R, devices=jax.devices()[:1])
+    sh = replica_sharding(mesh)
+    cid = Cid.initial(R)
+    reqs = [b"loggp-%d" % i for i in range(B)]
+    bd, bm, _ = host_batch_to_device(reqs, SB, batch_size=B)
+    bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+
+    def timed(fn, *args, iters=20):
+        out = fn(*args)            # warmup/compile
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        ls = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            out = fn(*args)
+            jax.block_until_ready(jax.tree.leaves(out)[0])
+            ls.append((time.perf_counter_ns() - t0) / 1e3)
+        ls.sort()
+        return ls[len(ls) // 2]
+
+    step = build_commit_step(mesh, R, S, SB, B)
+
+    def single():
+        devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                                 sharding=sh)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+        return step(devlog, bdata, bmeta, ctrl)
+
+    o_dispatch = timed(lambda: single())
+
+    pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D,
+                                       staged_depth=1)
+    sdata, smeta = bdata[None], bmeta[None]
+
+    def pipelined():
+        devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                                 sharding=sh)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+        return pipe(devlog, sdata, smeta, ctrl)
+
+    wall_d = timed(lambda: pipelined())
+    g_round = max(0.0, (wall_d - o_dispatch) / (D - 1))
+
+    return {"backend": jax.default_backend(),
+            "o_dispatch_us": round(o_dispatch, 1),
+            "g_round_us": round(g_round, 2),
+            "pipeline_depth": D}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--payload-max", type=int, default=65536)
+    ap.add_argument("--skip-device", action="store_true")
+    args = ap.parse_args()
+
+    dcn = measure_dcn(args.payload_max)
+    result = {"metric": "loggp_params", "value": dcn["o_plus_L_us"],
+              "unit": "us(o+L,dcn)", "detail": {"dcn": dcn}}
+    if not args.skip_device:
+        result["detail"]["device"] = measure_device()
+
+    print(f"DCN     o+L = {dcn['o_plus_L_us']} us   "
+          f"G = {dcn['G_ns_per_byte']} ns/B")
+    if not args.skip_device:
+        dev = result["detail"]["device"]
+        print(f"device  o_dispatch = {dev['o_dispatch_us']} us   "
+              f"g_round = {dev['g_round_us']} us ({dev['backend']})")
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Failover / reconfiguration benchmark.
+
+The reconf_bench.sh analog (reference: benchmarks/reconf_bench.sh):
+
+  FailLeader  — kill the leader replica (app + bridge + daemon, the
+                kill -2 analog, reconf_bench.sh:100-117) and measure
+                (a) time to a new elected leader and (b) time to the
+                first write committed through it (:255-275).
+  FailServer  — kill a follower; writes must continue uninterrupted
+                (:120-145).
+  AddServer   — grow the group by one replica via the join protocol and
+                measure time to admission + full catch-up (:147-180);
+                runs on the daemon-only cluster (no proxied app for the
+                joiner — the join path is identical).
+
+Output: one human table + one JSON line per scenario on stdout.
+
+Usage: python benchmarks/reconf_bench.py [--replicas N] [--writes W]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.models.kvs import encode_put  # noqa: E402
+from apus_tpu.runtime.appcluster import (LineClient,  # noqa: E402
+                                         ProxiedCluster)
+from apus_tpu.runtime.cluster import LocalCluster  # noqa: E402
+
+
+def fail_leader(pc: ProxiedCluster, writes: int) -> dict:
+    leader = pc.leader_idx()
+    # Warm traffic before the fault.
+    pc.write_round([f"SET pre:{i} v{i}" for i in range(writes)])
+    t0 = time.perf_counter()
+    pc.kill(leader)
+    new_leader = pc.leader_idx(timeout=30.0)
+    t_elect = time.perf_counter() - t0
+    # First write committed through the new leader.
+    pc.write_round(["SET post:0 v"])
+    t_first_write = time.perf_counter() - t0
+    assert new_leader != leader
+    return {
+        "metric": "leader_failover_time",
+        "value": round(t_elect * 1e3, 1), "unit": "ms",
+        "detail": {
+            "old_leader": leader, "new_leader": new_leader,
+            "first_commit_ms": round(t_first_write * 1e3, 1),
+        },
+    }
+
+
+def fail_server(pc: ProxiedCluster, writes: int) -> dict:
+    leader = pc.leader_idx()
+    victim = next(i for i in range(pc.n)
+                  if i != leader and pc.apps[i] is not None)
+    pc.kill(victim)
+    t0 = time.perf_counter()
+    _, replies = pc.write_round([f"SET fs:{i} v{i}" for i in range(writes)])
+    wall = time.perf_counter() - t0
+    ok = sum(1 for r in replies if r == "OK")
+    return {
+        "metric": "follower_crash_write_availability",
+        "value": round(ok / max(1, writes), 3), "unit": "fraction_ok",
+        "detail": {"victim": victim, "writes": writes,
+                   "wall_s": round(wall, 3)},
+    }
+
+
+def add_server(n: int, writes: int) -> dict:
+    with LocalCluster(n) as c:
+        c.wait_for_leader()
+        for i in range(writes):
+            c.submit(encode_put(b"as:%d" % i, b"v"))
+        t0 = time.perf_counter()
+        d = c.add_replica(timeout=30.0)
+        t_admit = time.perf_counter() - t0
+        c.wait_caught_up(d.idx, timeout=30.0)
+        t_caught_up = time.perf_counter() - t0
+        return {
+            "metric": "add_server_catch_up_time",
+            "value": round(t_caught_up * 1e3, 1), "unit": "ms",
+            "detail": {"admission_ms": round(t_admit * 1e3, 1),
+                       "new_idx": d.idx, "prior_writes": writes},
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--writes", type=int, default=50)
+    args = ap.parse_args()
+
+    results = []
+    # Scenario order mirrors the reference's main loop
+    # (reconf_bench.sh:333-344): Start -> FailLeader -> FailServer.
+    with ProxiedCluster(max(args.replicas, 3)) as pc:
+        results.append(fail_leader(pc, args.writes))
+        if sum(1 for a in pc.apps if a is not None) >= 3:
+            results.append(fail_server(pc, args.writes))
+    results.append(add_server(args.replicas, args.writes))
+
+    print(f"{'scenario':<36}{'value':>10}  unit")
+    for r in results:
+        print(f"{r['metric']:<36}{r['value']:>10}  {r['unit']}")
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Throughput/latency benchmark against a replicated, proxied app.
+
+The run.sh analog (benchmarks/run.sh:6-80 in the reference): start N
+replicas — each an unmodified TCP key-value server under
+LD_PRELOAD=interpose.so wired to its local consensus daemon — find the
+leader, and drive client load at the leader's app with SET (replicated
+writes, each committed through the log before the app sees it) and GET
+(served by the app directly), exactly as redis-benchmark -t set,get does
+against APUS-replicated redis.  Afterwards every replica's app is
+checked for replication (same key count via COUNT).
+
+Output: one human table + one JSON line per phase on stdout.
+
+Usage: python benchmarks/run_bench.py [--replicas N] [--clients C]
+           [--requests R] [--value-bytes V] [--app CMD]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster  # noqa: E402
+
+
+def percentile(sorted_us: list[float], q: float) -> float:
+    if not sorted_us:
+        return float("nan")
+    return sorted_us[min(len(sorted_us) - 1, int(len(sorted_us) * q))]
+
+
+def drive(pc: ProxiedCluster, op: str, requests: int, clients: int,
+          value: str) -> dict:
+    """C client threads, each issuing requests/C ops at the leader app."""
+    leader = pc.leader_idx()
+    addr = pc.app_addr(leader)
+    lat_us: list[list[float]] = [[] for _ in range(clients)]
+    errors = [0] * clients
+    per_client = requests // clients
+
+    def worker(ci: int) -> None:
+        try:
+            c = LineClient(addr, timeout=30.0)
+            for i in range(per_client):
+                key = f"bench:{ci}:{i}"
+                line = (f"SET {key} {value}" if op == "set"
+                        else f"GET {key}")
+                t0 = time.perf_counter_ns()
+                reply = c.cmd(line)
+                lat_us[ci].append((time.perf_counter_ns() - t0) / 1e3)
+                if op == "set" and reply != "OK":
+                    errors[ci] += 1
+            c.close()
+        except (OSError, ConnectionError):
+            errors[ci] += per_client - len(lat_us[ci])
+
+    threads = [threading.Thread(target=worker, args=(ci,))
+               for ci in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    flat = sorted(x for ls in lat_us for x in ls)
+    done = len(flat)
+    return {
+        "metric": f"proxied_{op}_throughput",
+        "value": round(done / wall, 1),
+        "unit": "ops/sec",
+        "detail": {
+            "requests": done, "errors": sum(errors),
+            "clients": clients, "leader": leader,
+            "wall_s": round(wall, 3),
+            "p50_us": round(percentile(flat, 0.50), 1),
+            "p95_us": round(percentile(flat, 0.95), 1),
+            "p99_us": round(percentile(flat, 0.99), 1),
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--value-bytes", type=int, default=64)
+    ap.add_argument("--app", default=None,
+                    help="app argv (default: native toyserver); the app "
+                         "gets the port appended, run.sh style")
+    args = ap.parse_args()
+
+    value = "x" * args.value_bytes
+    app_argv = args.app.split() if args.app else None
+
+    with ProxiedCluster(args.replicas, app_argv=app_argv) as pc:
+        results = [drive(pc, "set", args.requests, args.clients, value),
+                   drive(pc, "get", args.requests, args.clients, value)]
+
+        # Replication check: every live replica's app converges to the
+        # same key count (GET-after-SET on all replicas, run.sh's
+        # correctness criterion).
+        leader = pc.leader_idx()
+        with LineClient(pc.app_addr(leader)) as c:
+            want = c.cmd("COUNT")
+        counts = {}
+        deadline = time.monotonic() + 15.0
+        for i in range(args.replicas):
+            if pc.apps[i] is None:
+                continue
+            while time.monotonic() < deadline:
+                with LineClient(pc.app_addr(i)) as c:
+                    counts[i] = c.cmd("COUNT")
+                if counts[i] == want:
+                    break
+                time.sleep(0.2)
+        replicated = all(v == want for v in counts.values())
+        results.append({
+            "metric": "replication_converged",
+            "value": 1 if replicated else 0, "unit": "bool",
+            "detail": {"leader_count": want, "counts": counts},
+        })
+
+    print(f"{'phase':<28}{'value':>12}  unit")
+    for r in results:
+        print(f"{r['metric']:<28}{r['value']:>12}  {r['unit']}"
+              + (f"   p50={r['detail']['p50_us']}us"
+                 f" p99={r['detail']['p99_us']}us"
+                 if "p50_us" in r.get("detail", {}) else ""))
+    for r in results:
+        print(json.dumps(r))
+    return 0 if replicated else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

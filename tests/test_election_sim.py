@@ -155,3 +155,33 @@ def test_failover_terms_stay_bounded():
             f"seed {seed}: term escalated {old_term} -> {new.current_term}")
         c.submit(b"ok")
         c.check_logs_consistent()
+
+
+def test_adaptive_timeout_widens_then_freezes():
+    """to_adjust_cb analog (dare_server.c:763-817): the detector widens
+    on late heartbeats and freezes once the false-positive rate is
+    negligible."""
+    from apus_tpu.core.election import AdaptiveTimeout
+    at = AdaptiveTimeout(base=0.010, min_samples=100)
+    base = at.timeout
+    at.observe(0.015)                 # late: a false positive
+    assert at.timeout > base
+    widened = at.timeout
+    for _ in range(20000):            # steady on-time heartbeats
+        at.observe(0.005)             # (1 fp / 20001 < fp_target 1e-4)
+    assert at.frozen
+    assert at.timeout == widened      # frozen: no further growth
+    at.observe(1.0)                   # even a huge gap is ignored now
+    assert at.timeout == widened
+
+
+def test_node_hb_timeout_tracks_detector():
+    """Followers widen their leader-death timeout from observed gaps."""
+    c = Cluster(3, seed=2)
+    leader = c.wait_for_leader()
+    c.run(0.2)
+    for n in c.nodes:
+        if n.idx == leader.idx:
+            continue
+        assert n._hb_timeout >= n.cfg.hb_timeout
+        assert n._hb_adapt is not None and n._hb_adapt.samples > 0

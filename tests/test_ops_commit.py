@@ -197,3 +197,50 @@ def test_rejected_replica_does_not_advance_commit():
     assert commit == 9
     assert offs[1, OFF_COMMIT] == 1      # rejected: commit unchanged
     assert offs[0, OFF_COMMIT] == 9 and offs[2, OFF_COMMIT] == 9
+
+
+def test_pipelined_matches_sequential():
+    """D rounds inside one dispatch == D sequential step() calls."""
+    from apus_tpu.ops.commit import build_pipelined_commit_step
+
+    R, B, S, SB, D = 4, 8, 64, 64, 4
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    cid = Cid.initial(R)
+
+    def staged_round(i):
+        reqs = [b"piperound-%d-%d" % (i, j) for j in range(B)]
+        bd, bm, _ = host_batch_to_device(reqs, SB, batch_size=B)
+        return place_batch(mesh, R, 0, bd, bm)
+
+    batches = [staged_round(i) for i in range(D)]
+
+    # Sequential reference.
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    step = build_commit_step(mesh, R, S, SB, B)
+    seq_commits = []
+    for i in range(D):
+        ctrl = CommitControl.from_cid(cid, R, leader=0, term=1,
+                                      end0=1 + i * B)
+        devlog, acks, commit = step(devlog, batches[i][0], batches[i][1],
+                                    ctrl)
+        seq_commits.append(int(commit))
+    seq_data = np.asarray(devlog.data)
+    seq_offs = np.asarray(devlog.offs)
+
+    # Pipelined: one dispatch.
+    devlog2 = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                              sharding=sh)
+    pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D)
+    sdata = jax.device_put(
+        np.stack([np.asarray(b[0]) for b in batches]),
+        jax.NamedSharding(mesh, jax.P(None, "replica")))
+    smeta = jax.device_put(
+        np.stack([np.asarray(b[1]) for b in batches]),
+        jax.NamedSharding(mesh, jax.P(None, "replica")))
+    ctrl0 = CommitControl.from_cid(cid, R, leader=0, term=1, end0=1)
+    devlog2, commits, ctrl_out = pipe(devlog2, sdata, smeta, ctrl0)
+    assert list(np.asarray(commits)) == seq_commits
+    assert int(ctrl_out.end0) == 1 + D * B
+    assert (np.asarray(devlog2.data) == seq_data).all()
+    assert (np.asarray(devlog2.offs) == seq_offs).all()
