@@ -21,22 +21,44 @@ nodes.local.cfg) — for a 64-entry batched round, per-entry cost
 15/64 ~= 0.23 us.  vs_baseline = baseline_p50 / our_p50 (>1 is better
 than baseline).
 
-Run on the real TPU chip (replicas folded onto one device: XLA executes
-the identical collective program; ICI hops are absent, matching how the
-driver benches single-chip).  Falls back to CPU when no TPU is present.
+Robustness: this file is its own watchdog.  The parent process forks a
+child (same file, ``_APUS_BENCH_CHILD=1``) per backend attempt: first
+the default backend (TPU when present) under a hard timeout, then a
+``JAX_PLATFORMS=cpu`` fallback at reduced depth.  Whatever happens —
+TPU tunnel hang, backend init error, compile stall — the parent always
+prints exactly one JSON line and exits 0, with the backend that
+actually produced the number recorded in ``detail.backend``.
+
+Env knobs: APUS_BENCH_DEPTH (pipeline depth, default 1024 TPU / 64
+CPU), APUS_BENCH_BUDGET (total seconds, default 225),
+APUS_BENCH_TPU_TIMEOUT (first-attempt watchdog, default 150).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+BASELINE_ROUND_US = 15.0        # RDMA commit-round envelope (see docstring)
 
-def main() -> None:
+
+def _bench() -> None:
+    """Child process: run the measurement on whatever backend JAX gives
+    us and print the JSON line.  May hang or die — the parent watches."""
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # The image's sitecustomize registers the axon (TPU) PJRT plugin
+        # and forces jax_platforms="axon,cpu" at interpreter start, so the
+        # env var alone doesn't keep us off a hung TPU tunnel — override
+        # the config knob before any backend initializes (same dance as
+        # tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
 
     from apus_tpu.core.cid import Cid
     from apus_tpu.ops.commit import (CommitControl, build_commit_step,
@@ -44,8 +66,12 @@ def main() -> None:
     from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
     from apus_tpu.ops.mesh import replica_mesh, replica_sharding
 
+    backend = jax.default_backend()
+    cpu = backend == "cpu"
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
-    D = int(os.environ.get("APUS_BENCH_DEPTH", "1024"))
+    D = int(os.environ.get("APUS_BENCH_DEPTH", "64" if cpu else "1024"))
+    dispatches = 5 if cpu else 10
+    single_iters = 10 if cpu else 20
     mesh = replica_mesh(R, devices=jax.devices()[:1])
     sh = replica_sharding(mesh)
     cid = Cid.initial(R)
@@ -67,7 +93,6 @@ def main() -> None:
     jax.block_until_ready(commits)
     assert int(np.asarray(commits)[-1]) == 1 + D * B, "pipeline did not commit"
 
-    dispatches = 10
     walls_us = []
     for _ in range(dispatches):
         t0 = time.perf_counter_ns()
@@ -80,42 +105,139 @@ def main() -> None:
     per_entry_p50 = round_p50 / B
     commits_per_sec = 1e6 / round_p50          # rounds (quorum commits)/sec
 
+    def emit(single_p50):
+        result = {
+            "metric": "commit_round_p50_latency_batch64_5rep_pipelined",
+            "value": round(round_p50, 3),
+            "unit": "us",
+            "vs_baseline": round(BASELINE_ROUND_US / round_p50, 4),
+            "detail": {
+                "backend": backend,
+                "pipeline_depth": D,
+                "dispatch_wall_p50_us": round(wall_p50, 1),
+                "single_dispatch_round_p50_us":
+                    None if single_p50 is None else round(single_p50, 2),
+                "per_entry_p50_us": round(per_entry_p50, 4),
+                "commits_per_sec": round(commits_per_sec),
+                "entries_per_sec": round(commits_per_sec * B),
+                "batch": B, "replicas": R, "slot_bytes": SB,
+                "baseline_round_us": BASELINE_ROUND_US,
+            },
+        }
+        print(json.dumps(result), flush=True)
+
+    # The headline is in hand — flush it NOW so a watchdog kill during the
+    # optional single-dispatch phase can't forfeit it (the parent parses
+    # the LAST JSON line, so the richer re-emit below supersedes this one).
+    emit(None)
+
     # -- single-dispatch round (for reference; RTT-dominated on tunnel) ---
+    # Skipped when the watchdog deadline is near: a second slow compile
+    # must not push the process into the kill window.
+    deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
+    if deadline and time.time() > deadline - 30:
+        return
     step = build_commit_step(mesh, R, S, SB, B, auto_advance=True)
-    devlog1 = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    devlog1 = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                              sharding=sh)
     c1 = CommitControl.from_cid(cid, R, 0, 1, 1)
     cur, _, commit, c1 = step(devlog1, bdata, bmeta, c1)
     jax.block_until_ready(commit)
     lat = []
-    for _ in range(20):
+    for _ in range(single_iters):
         t0 = time.perf_counter_ns()
         cur, _, commit, c1 = step(cur, bdata, bmeta, c1)
         jax.block_until_ready(commit)
         lat.append((time.perf_counter_ns() - t0) / 1e3)
     lat.sort()
-    single_p50 = lat[len(lat) // 2]
+    emit(lat[len(lat) // 2])
 
-    baseline_round_us = 15.0             # RDMA commit-round envelope (see doc)
-    vs_baseline = baseline_round_us / round_p50
 
-    result = {
-        "metric": "commit_round_p50_latency_batch64_5rep_pipelined",
-        "value": round(round_p50, 3),
-        "unit": "us",
-        "vs_baseline": round(vs_baseline, 4),
-        "detail": {
-            "backend": jax.default_backend(),
-            "pipeline_depth": D,
-            "dispatch_wall_p50_us": round(wall_p50, 1),
-            "single_dispatch_round_p50_us": round(single_p50, 2),
-            "per_entry_p50_us": round(per_entry_p50, 4),
-            "commits_per_sec": round(commits_per_sec),
-            "entries_per_sec": round(commits_per_sec * B),
-            "batch": B, "replicas": R, "slot_bytes": SB,
-            "baseline_round_us": baseline_round_us,
-        },
-    }
-    print(json.dumps(result))
+def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
+    """Run the measurement in a watched subprocess; return the parsed
+    JSON result or None on failure/timeout (stderr passes through)."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["_APUS_BENCH_CHILD"] = "1"
+    env["_APUS_BENCH_DEADLINE"] = str(time.time() + timeout_s)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        print(f"bench: attempt timed out after {timeout_s:.0f}s "
+              f"(env={extra_env})", file=sys.stderr)
+        # The child flushes the headline JSON before any optional extra
+        # phases — a timeout may still have a valid result in its stdout.
+        return _parse_last_json(e.stdout)
+    except Exception as e:                       # noqa: BLE001 — must not die
+        print(f"bench: attempt failed to launch: {e}", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"bench: attempt rc={proc.returncode} (env={extra_env})",
+              file=sys.stderr)
+        # A crash in an optional post-headline phase must not discard an
+        # already-flushed headline JSON (mirrors the timeout salvage).
+        return _parse_last_json(proc.stdout)
+    result = _parse_last_json(proc.stdout)
+    if result is None:
+        print("bench: attempt produced no JSON line", file=sys.stderr)
+    return result
+
+
+def _parse_last_json(stdout: bytes | None) -> dict | None:
+    if not stdout:
+        return None
+    for line in reversed(stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    if os.environ.get("_APUS_BENCH_CHILD"):
+        _bench()
+        return
+
+    t_start = time.monotonic()
+    budget = float(os.environ.get("APUS_BENCH_BUDGET", "225"))
+    tpu_timeout = float(os.environ.get("APUS_BENCH_TPU_TIMEOUT", "150"))
+
+    attempts = []
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        attempts.append(({}, min(tpu_timeout, budget * 0.7)))
+    # CPU fallback: forced CPU backend (depth default is backend-keyed in
+    # the child: 1024 TPU / 64 CPU).
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    attempts.append((cpu_env, None))             # None = remaining budget
+
+    result = None
+    for extra_env, t in attempts:
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 20:
+            break
+        timeout_s = min(t, remaining) if t is not None else remaining
+        result = _run_child(extra_env, timeout_s)
+        if result is not None:
+            break
+
+    if result is None:
+        # Degraded but well-formed: never leave the driver with rc!=0.
+        result = {
+            "metric": "commit_round_p50_latency_batch64_5rep_pipelined",
+            "value": None,
+            "unit": "us",
+            "vs_baseline": 0.0,
+            "detail": {"backend": "none",
+                       "error": "all backend attempts failed or timed out",
+                       "baseline_round_us": BASELINE_ROUND_US},
+        }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
