@@ -178,6 +178,34 @@ class Node:
         # re-appending it every tick during EXTENDED catch-up).
         self._transit_pending = False
         self._known_leader: Optional[int] = None
+        # Device-plane handoff: when True, the commit decision is owned
+        # by the jitted device quorum (runtime.device_plane) and the
+        # host ack-quorum rule stands down — mirroring how the
+        # reference's commit is owned by the RDMA reply scan
+        # (dare_ibv_rc.c:1650-1758), with the host path kept as the
+        # fallback the driver can re-enable.
+        self.external_commit = False
+        # First log index covered by the device plane (set by the
+        # driver alongside external_commit).  For covered spans the
+        # leader's TCP writes carry only the commit offset — entry
+        # bodies travel via the device scatter + follower shard drain —
+        # unless a peer's ack stalls (drain not landing: diverged
+        # follower, no driver, wedged runner), in which case TCP entry
+        # shipping resumes for that peer.  This mirrors the reference's
+        # split: entries via RDMA data plane, commit offsets lazily
+        # written (dare_ibv_rc.c:1760-1826).
+        self.device_covered_from: Optional[int] = None
+        self._drain_wait: dict[int, tuple] = {}
+        # Election-time log reconciliation (set by the device-plane
+        # driver): called before this node grants a real vote or
+        # campaigns, so its host log first absorbs every entry its
+        # device shard holds.  Without this, a voter whose host log
+        # trails its shard could elect a leader lacking device-committed
+        # entries — the device quorum attests SHARD placement, so the
+        # shard must count as the log for election up-to-dateness
+        # (exactly as the reference's recovery reads the same memory
+        # its RDMA writes landed in, rc_recover_log dare_ibv_rc.c:726).
+        self.pre_election_hook = None
         # Contact gate for recovery starts (see NodeConfig.recovery_start).
         self._await_contact = cfg.recovery_start
         self._contact_deadline: Optional[float] = None
@@ -403,6 +431,8 @@ class Node:
 
     def start_election(self, now: float) -> None:
         """start_election analog (dare_server.c:1264-1322)."""
+        if self.pre_election_hook is not None:
+            self.pre_election_hook()
         my = self.sid.sid
         new = Sid(my.term + 1, False, self.idx)
         self.sid.update(new.word)
@@ -428,6 +458,9 @@ class Node:
         self.sid.update(my.with_leader(True).word)
         self.role = Role.LEADER
         self._known_leader = self.idx
+        self.external_commit = False       # host rules until a driver re-arms
+        self.device_covered_from = None
+        self._drain_wait = {}
         self._election_deadline = None
         self._next_hb_send = now           # heartbeat immediately
         self._next_idx = {}
@@ -458,6 +491,8 @@ class Node:
         """server_to_follower analog (dare_server.h:200)."""
         self.role = Role.FOLLOWER
         self._known_leader = leader_sid.idx if leader_sid.leader else None
+        self.external_commit = False       # host rules until a driver re-arms
+        self.device_covered_from = None
         self._election_deadline = None
         self._last_hb_seen = now
         self._pending.clear()
@@ -509,6 +544,8 @@ class Node:
                                           self.idx, r.sid.term)
         if not reqs:
             return
+        if self.pre_election_hook is not None:
+            self.pre_election_hook()       # shard -> host log before voting
         best = best_vote_request(reqs)
         my = self.sid.sid
         # A higher term demotes a leader/candidate to follower BEFORE the
@@ -722,6 +759,14 @@ class Node:
                 self._next_idx[peer] = div
                 self._adjusted[peer] = True
             nxt = self._next_idx.get(peer, self.log.commit)
+            # Fast-forward past entries the peer already holds: with the
+            # device plane delivering entries directly into follower
+            # logs (runtime.device_plane drain), the acked end routinely
+            # runs AHEAD of our TCP write cursor — re-sending that span
+            # would be pure idempotent waste.
+            if (self._adjusted.get(peer, False) and ack is not None
+                    and nxt < ack <= self.log.end):
+                nxt = self._next_idx[peer] = ack
             if nxt < self.log.head:
                 # Peer is behind our pruned head: push a snapshot
                 # (leader-driven form of rc_recover_sm, the reference's
@@ -739,7 +784,14 @@ class Node:
                 else:
                     self._note_failure(peer, now)
                 continue
-            batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
+            covered = (self.external_commit
+                       and self.device_covered_from is not None
+                       and nxt >= self.device_covered_from)
+            if covered and not self._drain_stalled(peer, ack, now):
+                batch = []     # entries ride the device plane; TCP
+                               # carries only the commit offset
+            else:
+                batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
             if not batch and self._commit_sent.get(peer, 0) >= self.log.commit:
                 continue   # nothing new and remote commit is current
             res = self.t.log_write(peer, my, batch, self.log.commit)
@@ -754,6 +806,22 @@ class Node:
             else:
                 self._note_failure(peer, now)
 
+    def _drain_stalled(self, peer: int, ack: Optional[int],
+                       now: float) -> bool:
+        """Is the peer's acked end failing to advance while entries it
+        should be draining from its device shard are outstanding?  If
+        so, TCP entry shipping must resume for it."""
+        if ack is None:
+            return True               # no evidence the drain works: ship
+        if ack >= self.log.end:
+            self._drain_wait.pop(peer, None)
+            return False
+        prev, since = self._drain_wait.get(peer, (None, now))
+        if ack != prev:
+            self._drain_wait[peer] = (ack, now)
+            return False
+        return now - since > self._hb_timeout
+
     def _replication_targets(self) -> list[int]:
         members = set(self.cid.members())
         if self.cid.state != CidState.STABLE:
@@ -765,6 +833,8 @@ class Node:
     def _advance_commit(self, my: Sid) -> None:
         """Commit rule from ack indices (the host mirror of the device
         psum; cf. dare_ibv_rc.c:1725-1758)."""
+        if self.external_commit:
+            return          # the device-plane quorum owns commit
         acks = self.regions.ctrl[Region.REP_ACK]
         candidates = sorted({a for a in acks if a is not None} | {self.log.end},
                             reverse=True)

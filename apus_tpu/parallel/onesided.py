@@ -16,6 +16,7 @@ write behaves bit-identically under test and in production.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Optional
 
 from apus_tpu.core.log import LogEntry
@@ -28,6 +29,7 @@ def apply_ctrl_write(node: Node, region: Region, slot: int,
                      value: Any) -> WriteResult:
     """Deposit a value in a control slot (ctrl_data_t write)."""
     node.regions.ctrl[region][slot] = value
+    node.regions.touch(region, slot, time.monotonic())
     return WriteResult.OK
 
 

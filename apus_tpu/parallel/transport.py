@@ -67,6 +67,15 @@ class Regions:
         # Log-access fence (replaces QP-state fencing).
         self.granted_to: Optional[int] = None
         self.fence_term: int = 0
+        # Wall-clock of the last remote write per (region, slot) — the
+        # liveness evidence the device-plane quorum mask consumes (a
+        # peer whose control writes stopped arriving is not counted;
+        # see runtime.device_plane safety argument 3).  Unused by the
+        # virtual-time simulator.
+        self.touched: dict[tuple[Region, int], float] = {}
+
+    def touch(self, region: Region, slot: int, now: float) -> None:
+        self.touched[(region, slot)] = now
 
     def grant_log_access(self, idx: Optional[int], term: int) -> None:
         """restore/revoke analog (dare_ibv_rc.c:2156-2255): ``idx=None``

@@ -52,8 +52,10 @@ class ProxiedCluster:
 
     def __init__(self, n: int, app_argv: Optional[Sequence[str]] = None,
                  workdir: Optional[str] = None, spin_timeout_ms: int = 8000,
-                 **cluster_kwargs):
+                 device_plane: bool = False, **cluster_kwargs):
         build_native()
+        if device_plane:
+            cluster_kwargs["device_plane"] = True
         self.n = n
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proxied-")
         self.app_ports = [free_port() for _ in range(n)]

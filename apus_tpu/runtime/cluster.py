@@ -24,7 +24,9 @@ from apus_tpu.utils.config import ClusterSpec
 class LocalCluster:
     def __init__(self, n: int, spec: Optional[ClusterSpec] = None,
                  sm_factory: Callable[[], StateMachine] = KvsStateMachine,
-                 daemon_cls=ReplicaDaemon, seed: int = 0, **daemon_kwargs):
+                 daemon_cls=ReplicaDaemon, seed: int = 0,
+                 device_plane: bool = False, device_batch: int = 16,
+                 device_devices=None, **daemon_kwargs):
         self.n = n
         self.sm_factory = sm_factory
         self.daemon_cls = daemon_cls
@@ -37,9 +39,22 @@ class LocalCluster:
             hb_period=0.005, hb_timeout=0.030,
             elect_low=0.050, elect_high=0.150)
         self.spec = dataclasses.replace(base, group_size=n, peers=peers)
+        # Shared device-plane engine (one mesh per process, like one TPU
+        # pod slice per host); each daemon's driver binds its replica to
+        # a shard.  Replication through the jitted commit step, host TCP
+        # as control plane + catch-up (runtime.device_plane).
+        self.device_runner = None
+        if device_plane:
+            from apus_tpu.runtime.device_plane import DeviceCommitRunner
+            self.device_runner = DeviceCommitRunner(
+                n_replicas=n, n_slots=self.spec.n_slots,
+                slot_bytes=self.spec.slot_bytes, batch=device_batch,
+                devices=device_devices)
+            self.daemon_kwargs = dict(self.daemon_kwargs,
+                                      device_runner=self.device_runner)
         self.daemons: list[Optional[ReplicaDaemon]] = [
             daemon_cls(i, self.spec, sm=sm_factory(), listen_sock=socks[i],
-                       seed=seed, **daemon_kwargs)
+                       seed=seed, **self.daemon_kwargs)
             for i in range(n)
         ]
 

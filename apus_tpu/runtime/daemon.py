@@ -53,7 +53,8 @@ class ReplicaDaemon:
                  log_file: Optional[str] = None,
                  db_dir: Optional[str] = None,
                  recovery_start: bool = False,
-                 seed: int = 0):
+                 seed: int = 0,
+                 device_runner=None):
         self.idx = idx
         self.spec = spec
         self.lock = threading.RLock()
@@ -111,6 +112,15 @@ class ReplicaDaemon:
             self.on_commit.append(self.persistence.on_commit)
             self.on_snapshot.append(self.persistence.on_snapshot)
 
+        # Device plane (runtime.device_plane): the jitted commit step as
+        # the primary replication/quorum engine, host TCP as control
+        # plane + catch-up (the RC-data/UD-control split of the
+        # reference, SURVEY §5.8).
+        self.device_driver = None
+        if device_runner is not None:
+            from apus_tpu.runtime.device_plane import DevicePlaneDriver
+            self.device_driver = DevicePlaneDriver(self, device_runner)
+
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
         self._last_role = None
@@ -136,10 +146,14 @@ class ReplicaDaemon:
                              daemon=True)
         t.start()
         self._tick_thread = t
+        if self.device_driver is not None:
+            self.device_driver.start()
         self.logger.info("daemon %d up at %s", self.idx, self.server.addr)
 
     def stop(self) -> None:
         self._stop.set()
+        if self.device_driver is not None:
+            self.device_driver.stop()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=2.0)
         self.server.stop()

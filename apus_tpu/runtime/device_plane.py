@@ -1,0 +1,626 @@
+"""Device plane wired into the live runtime.
+
+The reference's one-sided data plane runs INSIDE the commit loop —
+``rc_write_remote_logs`` is called from ``commit_new_entries`` on the
+leader's hot path (dare_server.c:1751-1763 -> dare_ibv_rc.c:1870-1948) —
+while everything asymmetric/asynchronous (election, join, heartbeats)
+rides the UD control plane.  This module gives the live runtime the same
+split: the jitted commit step (apus_tpu.ops.commit) becomes the primary
+replication + quorum engine, and the host TCP plane
+(apus_tpu.parallel.net) remains control plane + divergence repair +
+catch-up.
+
+Components:
+
+- ``DeviceCommitRunner`` — one per process (shared by every in-process
+  replica daemon, the way one TPU mesh is shared by the replica shards
+  it hosts).  Owns the HBM ``DeviceLog`` (leading replica axis, sharded
+  over the mesh), the compiled commit step, and the round cursor.  The
+  leader's driver feeds it batches; follower drivers read their own
+  shard back out of it.
+
+- ``DevicePlaneDriver`` — one thread per daemon.
+  Leader half: pad the host log to a batch boundary, ship each aligned
+  64-entry span through the jitted step (leader->all pmax scatter,
+  fence mask, psum quorum — one XLA program), and advance the host
+  ``log.commit`` from the device quorum result; once the device plane
+  covers everything past its base index, the host ack-quorum rule is
+  switched off (``node.external_commit``) so commit decisions are owned
+  by the device plane, exactly as the reference's commit is owned by
+  the RDMA ack scan (dare_ibv_rc.c:1650-1758).
+  Follower half: drain committed-round rows from the local replica's
+  device shard into the host log (the device plane IS the entry
+  transport; TCP merely repairs divergence and carries the commit
+  offset, mirroring the reference's lazily-written remote commit,
+  dare_ibv_rc.c:1760-1826).
+
+Safety arguments (the seams that matter):
+
+1. *Commit chaining.*  Device quorum for a round attests replication of
+   ``[dev_base, end0+B)`` across the replica shards — nothing below
+   ``dev_base`` (shards are reset empty at each leadership change).  The
+   leader therefore only adopts device commit results once its host
+   commit has reached ``dev_base`` through the ordinary host ack quorum;
+   from then on every advance is prefix-complete.
+2. *Follower drain.*  A follower appends device rows only when its last
+   host-log entry carries the CURRENT leader's term: by the Raft log-
+   matching property that entry pins the whole prefix to the leader's
+   log, so building on it cannot graft new entries onto a diverged tail.
+   (The leader guarantees a term-T entry exists below ``dev_base``: the
+   become_leader blank entry, plus any alignment padding, are appended
+   at term T before the device base is chosen.)  Followers never advance
+   commit from the device arrays — the commit offset arrives via the
+   leader's TCP writes, which already encode the gating of (1).
+3. *Live-mask honesty.*  In-process, a crashed daemon's device shard
+   still accepts scatters (the arrays outlive the thread), so device
+   acks alone would count the dead.  The driver masks the quorum vote to
+   members whose host control-plane writes (REP_ACK) were observed
+   within a failure-detection window — the quorum *denominator* stays
+   ``quorum_size(cid)``, so masking can only make commit harder, never
+   easier.  This matches the reference's window: RDMA acks are also
+   trusted until QP retry exhaustion flags the peer.
+
+Oversized records (> slot width, pending apus_tpu.runtime.segment) make
+a round device-ineligible: the driver falls back to host-path commit for
+that span and re-bases the device plane past it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.quorum import quorum_size
+from apus_tpu.core.types import EntryType
+from apus_tpu.parallel import wire
+from apus_tpu.parallel.transport import Region
+
+
+class DeviceCommitRunner:
+    """Process-wide device-plane engine: HBM log shards + jitted commit
+    step, shared by all in-process replica daemons."""
+
+    def __init__(self, n_replicas: int, n_slots: int = 4096,
+                 slot_bytes: int = 4096, batch: int = 64,
+                 devices=None, logger=None):
+        self.n_replicas = n_replicas
+        self.n_slots = n_slots
+        self.slot_bytes = slot_bytes
+        self.batch = batch
+        self._devices = devices
+        self.logger = logger
+        self.lock = threading.Lock()
+        self._build_lock = threading.Lock()
+        self.generation = 0               # bumped by every reset()
+        self._devlog = None
+        self._next_end0: Optional[int] = None
+        self._leader: Optional[int] = None
+        self._term = 0
+        self._built = False
+        self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
+                      "entries_devplane": 0}
+        # Build + compile eagerly: a lazy multi-second first compile
+        # would hand the opening of every first leadership to the host
+        # path (and leave the device cursor behind a pruned head).
+        self._build()
+
+    # -- lazy jax build ---------------------------------------------------
+
+    def _build(self) -> None:
+        with self._build_lock:
+            self._build_locked()
+
+    def _build_locked(self) -> None:
+        if self._built:
+            return
+        import jax
+
+        from apus_tpu.ops.commit import build_commit_step
+        from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+
+        devices = self._devices
+        if devices is None:
+            devices = jax.devices()[:1]   # single-chip fold by default
+        self._mesh = replica_mesh(self.n_replicas, devices=devices)
+        self._sharding = replica_sharding(self._mesh)
+        self._step = build_commit_step(self._mesh, self.n_replicas,
+                                       self.n_slots, self.slot_bytes,
+                                       self.batch)
+        # Follower drain fetch: exactly one batch of rows per call, so
+        # the device->host transfer is B*SB bytes (a naive
+        # ``np.asarray(devlog.data[r])`` would ship the whole 16 MB
+        # shard per poll and starve the commit path).
+        self._gather = jax.jit(lambda d, m, r, s: (d[r, s], m[r, s]))
+        self._jax = jax
+        self._warmup()
+        self._built = True
+
+    def _warmup(self) -> None:
+        """Pay the XLA compile up front on a throwaway log: a first
+        round that compiles for seconds mid-leadership would hand the
+        whole window to the host path (and once wedged a killed
+        daemon's zombie driver inside it, pre-fencing)."""
+        from apus_tpu.core.cid import Cid
+        from apus_tpu.ops.commit import place_batch
+        from apus_tpu.ops.logplane import make_device_log
+
+        B, SB, R = self.batch, self.slot_bytes, self.n_replicas
+        devlog = make_device_log(R, self.n_slots, SB, batch=B,
+                                 first_idx=1, leader=0, term=1,
+                                 sharding=self._sharding)
+        bdata, bmeta = place_batch(self._mesh, R, 0,
+                                   np.zeros((B, SB), np.uint8),
+                                   np.zeros((B, 4), np.int32))
+        ctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
+                               live=set(range(R)))
+        _, _, commit = self._step(devlog, bdata, bmeta, ctrl)
+        self._jax.block_until_ready(commit)
+
+    #: bytes of wire-codec overhead per slot payload (encode_entry
+    #: header + optional cid, upper bound).  The authoritative gate is
+    #: ``len(wire.encode_entry(e)) <= slot_bytes`` (commit_round and the
+    #: driver's oversize check); max_data_bytes is the conservative
+    #: sizing contract the segmentation layer cuts records against.
+    WIRE_OVERHEAD = 64
+
+    def max_data_bytes(self) -> int:
+        return self.slot_bytes - self.WIRE_OVERHEAD
+
+    # -- lifecycle of a leadership ---------------------------------------
+
+    def reset(self, leader: int, term: int, first_idx: int) -> Optional[int]:
+        """Fresh device log for a new leadership: all shards empty at
+        ``first_idx``, fence granted to ``leader``@``term``.  Returns the
+        new generation token; rounds from older generations are
+        discarded.  Stale terms are REFUSED (None): a zombie driver of a
+        killed daemon (its node frozen as leader of an old term) must
+        not hijack the runner out from under the live leadership — the
+        device-plane form of term fencing (cf. QP-reset fencing,
+        dare_ibv_rc.c:2156-2255)."""
+        self._build()
+        from apus_tpu.ops.logplane import make_device_log
+        with self.lock:
+            if term < self._term:
+                return None
+            self.generation += 1
+            self._devlog = make_device_log(
+                self.n_replicas, self.n_slots, self.slot_bytes,
+                batch=self.batch, first_idx=first_idx, leader=leader,
+                term=term, sharding=self._sharding)
+            self._next_end0 = first_idx
+            self._leader, self._term = leader, term
+            self.stats["resets"] += 1
+            if self.logger is not None:
+                self.logger.info(
+                    "device plane reset: gen=%d leader=%d term=%d base=%d",
+                    self.generation, leader, term, first_idx)
+            return self.generation
+
+    # -- leader round -----------------------------------------------------
+
+    def commit_round(self, gen: int, end0: int, entries: list[LogEntry],
+                     cid, live: set[int]) -> Optional[tuple[list, int]]:
+        """Run one commit round: scatter ``entries`` (exactly one batch,
+        idx-contiguous from ``end0``) to every shard and evaluate the
+        masked quorum.  Returns (acks, device_commit) or None if ``gen``
+        is stale."""
+        from apus_tpu.ops.commit import CommitControl, place_batch
+
+        B, SB = self.batch, self.slot_bytes
+        assert len(entries) == B, (len(entries), B)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            leader, term = self._leader, self._term
+
+            bdata = np.zeros((B, SB), np.uint8)
+            bmeta = np.zeros((B, 4), np.int32)
+            for j, e in enumerate(entries):
+                assert e.idx == end0 + j, (e.idx, end0, j)
+                blob = wire.encode_entry(e)
+                if len(blob) > SB:
+                    raise ValueError(
+                        f"entry {e.idx} wire size {len(blob)} > slot "
+                        f"{SB}; segment upstream")
+                bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
+                bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
+                            int(e.type), len(blob))
+            pdata, pmeta = place_batch(self._mesh, self.n_replicas,
+                                       leader, bdata, bmeta)
+            ctrl = self._make_ctrl(cid, leader, term, end0, live)
+            devlog, acks, commit = self._step(self._devlog, pdata, pmeta,
+                                              ctrl)
+            self._jax.block_until_ready(commit)
+            self._devlog = devlog
+            self._next_end0 = end0 + B
+            acks_host = [int(a) for a in np.asarray(acks)]
+            commit_host = int(commit)
+            self.stats["rounds"] += 1
+            self.stats["entries_devplane"] += B
+            if commit_host < end0 + B:
+                self.stats["quorum_fail_rounds"] += 1
+            return acks_host, commit_host
+
+    def _make_ctrl(self, cid, leader: int, term: int, end0: int,
+                   live: set[int]):
+        """CommitControl with the quorum vote masked to live members.
+        Masking shrinks only the numerator: quorum thresholds stay
+        derived from the full configuration sizes."""
+        import jax.numpy as jnp
+
+        from apus_tpu.core.cid import CidState
+        from apus_tpu.ops.commit import CommitControl
+
+        R = self.n_replicas
+        mask_old = np.array(
+            [1 if (cid.contains(i) and i < cid.size and i in live) else 0
+             for i in range(R)], np.int32)
+        if cid.state == CidState.TRANSIT:
+            mask_new = np.array(
+                [1 if (cid.contains(i) and i < cid.new_size and i in live)
+                 else 0 for i in range(R)], np.int32)
+            q_new = quorum_size(cid.new_size)
+        else:
+            mask_new = np.zeros(R, np.int32)
+            q_new = 0
+        i32 = lambda v: jnp.asarray(v, jnp.int32)   # noqa: E731
+        return CommitControl(i32(leader), i32(term), i32(end0),
+                             jnp.asarray(mask_old), jnp.asarray(mask_new),
+                             i32(quorum_size(cid.size)), i32(q_new))
+
+    # -- follower shard readback -----------------------------------------
+
+    def shard_end(self, replica: int, gen: int) -> Optional[int]:
+        """The device-log end of ``replica``'s shard (None if stale gen)."""
+        from apus_tpu.ops.logplane import OFF_END
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            return int(np.asarray(self._devlog.offs[replica])[OFF_END])
+
+    def read_rows(self, replica: int, gen: int, lo: int,
+                  hi: int) -> Optional[list[LogEntry]]:
+        """Decode rows [lo, hi) from ``replica``'s shard (at most one
+        batch).  Rows whose stored absolute index no longer matches
+        (ring overwritten, or not yet written) are cut off; the caller
+        appends what it gets and retries later."""
+        from apus_tpu.ops.logplane import META_IDX, META_LEN, slot_of
+        hi = min(hi, lo + self.batch)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            if hi <= lo:
+                return []
+            # Fixed-size [B] slot vector (static shape -> one compiled
+            # gather); rows past hi are fetched and discarded.
+            slots = np.array([slot_of(lo + j, self.n_slots)
+                              for j in range(self.batch)], np.int32)
+            data_rows, meta_rows = self._gather(
+                self._devlog.data, self._devlog.meta,
+                np.int32(replica), slots)
+            data = np.asarray(data_rows)
+            meta = np.asarray(meta_rows)
+        out: list[LogEntry] = []
+        for j, idx in enumerate(range(lo, hi)):
+            if int(meta[j, META_IDX]) != idx:
+                break
+            n = int(meta[j, META_LEN])
+            blob = data[j, :n].tobytes()
+            try:
+                e = wire.decode_entry(wire.Reader(blob))
+            except Exception:
+                break
+            if e.idx != idx:
+                break
+            out.append(e)
+        return out
+
+
+class DevicePlaneDriver:
+    """Per-daemon thread binding one replica to the shared runner."""
+
+    def __init__(self, daemon, runner: DeviceCommitRunner):
+        self.daemon = daemon
+        self.runner = runner
+        self.logger = daemon.logger
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Leader-side round state (valid while _gen matches the runner).
+        self._gen: Optional[int] = None
+        self._dev_base = 0
+        self._dev_next = 0
+        self._last_end_seen = 0
+        self._last_commit_advance = 0.0
+        # Follower-side: skip drain polling while nothing new happened
+        # (keyed on (generation, rounds) at the last fruitless drain).
+        self._drain_idle_key = None
+        # After a stall fallback, device work pauses and commit
+        # ownership may not be re-armed until this deadline passes AND
+        # the cursor has caught up (prevents a 0.5 s own/stall flap).
+        self._cooldown_until = 0.0
+        self.stats = {"rounds": 0, "drained": 0, "holes": 0,
+                      "fallbacks": 0}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        with self.daemon.lock:
+            # Election safety: the host log must absorb the device
+            # shard before this replica votes or campaigns.
+            self.daemon.node.pre_election_hook = self._drain_for_election
+            # Stall watchdog runs in the TICK thread: the driver thread
+            # itself may be the thing that is wedged (hung dispatch).
+            self.daemon.on_tick.append(self._tick_watchdog)
+        t = threading.Thread(target=self._run,
+                             name=f"apus-devplane-{self.daemon.idx}",
+                             daemon=True)
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self.daemon.lock:
+            node = self.daemon.node
+            node.external_commit = False
+            if node.pre_election_hook == self._drain_for_election:
+                node.pre_election_hook = None
+            if self._tick_watchdog in self.daemon.on_tick:
+                self.daemon.on_tick.remove(self._tick_watchdog)
+
+    def _tick_watchdog(self) -> None:
+        """Runs under the daemon lock in the tick thread.  If the device
+        plane owns commit but hasn't advanced it despite pending
+        entries, hand commit back to the host ack path — even (above
+        all) when the driver thread is stuck inside a hung device
+        dispatch and cannot police itself."""
+        node = self.daemon.node
+        if not (node.is_leader and node.external_commit):
+            return
+        window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+        if node.log.end > node.log.commit and \
+                time.monotonic() - self._last_commit_advance > window:
+            node.external_commit = False
+            self._cooldown_until = time.monotonic() + window
+            self.stats["fallbacks"] += 1
+            self.logger.warning("device plane stalled; host commit path "
+                                "re-enabled")
+
+    # -- main loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        poll = max(self.daemon._tick_interval, 0.0005)
+        while not self._stop.is_set():
+            try:
+                if not self._step_once():
+                    time.sleep(poll)
+            except Exception:
+                self.logger.exception("device-plane driver error")
+                self._deactivate()
+                time.sleep(10 * poll)
+
+    def _deactivate(self) -> None:
+        with self.daemon.lock:
+            self.daemon.node.external_commit = False
+            self.daemon.node.device_covered_from = None
+        self._gen = None
+
+    def _step_once(self) -> bool:
+        """One driver iteration.  Returns True if work was done (skip
+        the idle sleep)."""
+        node = self.daemon.node
+        with self.daemon.lock:
+            if node.is_leader:
+                return self._leader_step(node)
+            if self._gen is not None:
+                self._gen = None
+                node.external_commit = False
+        return self._follower_step(node)
+
+    # -- leader half ------------------------------------------------------
+
+    def _leader_step(self, node) -> bool:
+        """Called under the daemon lock.  Heavy work (device dispatch)
+        runs with the lock RELEASED; results are re-validated after."""
+        term = node.current_term
+        B = self.runner.batch
+        if node.cid.extended_group_size > self.runner.n_replicas:
+            # Configuration outgrew the device geometry: host path owns
+            # commit until it fits again.
+            if self._gen is not None:
+                self._gen = None
+                node.external_commit = False
+                node.device_covered_from = None
+                self.stats["fallbacks"] += 1
+            return False
+
+        if self._gen is None or self.runner._term != term \
+                or self.runner._leader != node.idx:
+            return self._reset_for_leadership(node, term)
+
+        # Re-base when pruning moved past the device cursor: that span
+        # can no longer be read out of the host log, so the contiguity
+        # chain must restart from a fresh base.  (A host-committed-but-
+        # unpruned span is NOT a reason to re-base — the device rounds
+        # re-attest it idempotently and catch up to the live edge.)
+        if self._dev_next < node.log.head:
+            self._gen = None
+            return True
+
+        # Re-arm device-owned commit once (a) the host quorum has
+        # committed the prefix below the device base (safety argument
+        # 1), (b) any stall cooldown has passed, and (c) the device
+        # cursor has caught up to the commit frontier — re-owning
+        # commit while trailing would immediately stall again.
+        if not node.external_commit and node.log.commit >= self._dev_base \
+                and time.monotonic() >= self._cooldown_until \
+                and self._dev_next >= node.log.commit:
+            node.external_commit = True
+            self._last_commit_advance = time.monotonic()
+            self.logger.info("device plane owns commit from idx %d",
+                             self._dev_base)
+
+        end = node.log.end
+        if end <= self._dev_next:
+            return False
+        # Micro-batching: take a partial batch only once arrivals pause
+        # (one poll of delay), so bursts fill rounds instead of padding.
+        if end - self._dev_next < B and end != self._last_end_seen:
+            self._last_end_seen = end
+            return False
+        self._last_end_seen = end
+        # Pad a PARTIAL tail to the round boundary with NOOPs (partial
+        # batches arrive NOOP-padded by contract; the reference appends
+        # NOOPs too, dare_log.h:22).  A backlog >= B needs no padding —
+        # the round takes B real entries from dev_next.
+        if end - self._dev_next < B:
+            while (node.log.end - 1) % B != 0 and not node.log.is_full:
+                node.log.append(term, type=EntryType.NOOP)
+            if (node.log.end - 1) % B != 0:
+                return False               # log full: wait for pruning
+        entries = list(node.log.entries(self._dev_next,
+                                        self._dev_next + B))
+        if len(entries) != B:
+            return False
+        if any(len(wire.encode_entry(e)) > self.runner.slot_bytes
+               for e in entries):
+            # Oversized record: this span must commit via the host path;
+            # re-base the device plane past it once that happens.
+            self.stats["holes"] += 1
+            if node.external_commit:
+                node.external_commit = False
+            if node.log.commit >= self._dev_next + B:
+                self._gen = None           # re-base next iteration
+            return False
+        gen, end0 = self._gen, self._dev_next
+        cid = node.cid
+        live = self._live_members(node)
+
+        # -- device dispatch outside the daemon lock --
+        self.daemon.lock.release()
+        try:
+            res = self.runner.commit_round(gen, end0, entries, cid, live)
+        finally:
+            self.daemon.lock.acquire()
+
+        if res is None:                    # stale generation
+            self._gen = None
+            return True
+        acks, dev_commit = res
+        self._dev_next = end0 + B
+        self.stats["rounds"] += 1
+        # Re-validate leadership before adopting the result: an election
+        # (or our own daemon's death) may have happened while the lock
+        # was released.
+        if self._stop.is_set() \
+                or not (node.is_leader and node.current_term == term):
+            self._gen = None
+            return True
+        if node.log.commit >= self._dev_base and dev_commit > node.log.commit:
+            before = node.log.commit
+            after = node.log.advance_commit(min(dev_commit, node.log.end))
+            if after > before:
+                self._last_commit_advance = time.monotonic()
+                node.stats["commits"] += 1
+                node.stats["devplane_commits"] = \
+                    node.stats.get("devplane_commits", 0) + 1
+                self.daemon.commit_cond.notify_all()
+        return True
+
+    def _reset_for_leadership(self, node, term: int) -> bool:
+        """New leadership: choose the device base just past our current
+        log end (guaranteeing a term-T entry sits below it — the blank
+        entry from become_leader at minimum) and reset the shards."""
+        B = self.runner.batch
+        while (node.log.end - 1) % B != 0 and not node.log.is_full:
+            node.log.append(term, type=EntryType.NOOP)
+        if (node.log.end - 1) % B != 0:
+            return False
+        base = node.log.end
+        idx = node.idx
+        self.daemon.lock.release()
+        try:
+            gen = self.runner.reset(idx, term, base)
+        finally:
+            self.daemon.lock.acquire()
+        if gen is None or self._stop.is_set() \
+                or not (node.is_leader and node.current_term == term):
+            return True
+        self._gen = gen
+        self._dev_base = base
+        self._dev_next = base
+        self._last_end_seen = 0
+        self._last_commit_advance = time.monotonic()
+        # Host ack quorum owns commit until it has covered the prefix
+        # below the device base; under load that may already be true by
+        # the time the shards are rebuilt — take over immediately then,
+        # or the racing host path keeps outrunning every fresh base.
+        node.external_commit = node.log.commit >= base
+        node.device_covered_from = base
+        if node.external_commit:
+            self.logger.info("device plane owns commit from idx %d", base)
+        return True
+
+    def _live_members(self, node) -> set[int]:
+        """Members whose control-plane writes were recently observed
+        (plus ourselves).  Window = the failure-detector timeout."""
+        window = max(node._hb_timeout, 4 * self.daemon.spec.hb_period)
+        now = time.monotonic()
+        live = {node.idx}
+        touched = node.regions.touched
+        for m in node.cid.members():
+            if m == node.idx:
+                continue
+            t = touched.get((Region.REP_ACK, m))
+            if t is not None and now - t <= window:
+                live.add(m)
+        return live
+
+    # -- follower half ----------------------------------------------------
+
+    def _follower_step(self, node) -> bool:
+        """Drain device rows from our shard into the host log (safety
+        argument 2: only on top of a current-term entry).  Never touches
+        commit — that arrives via the leader's TCP writes."""
+        gen = self.runner.generation
+        if gen == 0:
+            return False
+        key = (gen, self.runner.stats["rounds"])
+        if key == self._drain_idle_key:
+            return False               # nothing new since the last look
+        with self.daemon.lock:
+            if node.is_leader:
+                return False
+            term = node.current_term
+            end = node.log.end
+            prev = node.log.get(end - 1)
+            if prev is None or prev.term != term:
+                return False
+        shard_end = self.runner.shard_end(self.daemon.idx, gen)
+        if shard_end is None or shard_end <= end:
+            self._drain_idle_key = key
+            return False
+        rows = self.runner.read_rows(self.daemon.idx, gen, end,
+                                     min(shard_end,
+                                         end + self.runner.batch))
+        if not rows:
+            self._drain_idle_key = key
+            return False
+        appended = 0
+        with self.daemon.lock:
+            if node.is_leader or node.current_term != term:
+                return False
+            for e in rows:
+                if e.term != term or e.idx != node.log.end \
+                        or node.log.is_full:
+                    break
+                node.log.write(e)
+                appended += 1
+        self.stats["drained"] += appended
+        return appended > 0

@@ -96,12 +96,17 @@ def main() -> int:
     ap.add_argument("--app", default=None,
                     help="app argv (default: native toyserver); the app "
                          "gets the port appended, run.sh style")
+    ap.add_argument("--device-plane", action="store_true",
+                    help="replicate through the jitted device commit "
+                         "step (runtime.device_plane); host TCP stays "
+                         "control plane + catch-up")
     args = ap.parse_args()
 
     value = "x" * args.value_bytes
     app_argv = args.app.split() if args.app else None
 
-    with ProxiedCluster(args.replicas, app_argv=app_argv) as pc:
+    with ProxiedCluster(args.replicas, app_argv=app_argv,
+                        device_plane=args.device_plane) as pc:
         results = [drive(pc, "set", args.requests, args.clients, value),
                    drive(pc, "get", args.requests, args.clients, value)]
 
@@ -128,6 +133,17 @@ def main() -> int:
             "value": 1 if replicated else 0, "unit": "bool",
             "detail": {"leader_count": want, "counts": counts},
         })
+        if args.device_plane and pc.cluster.device_runner is not None:
+            r = pc.cluster.device_runner
+            ld = pc.cluster.daemons[leader]
+            results.append({
+                "metric": "device_plane_rounds",
+                "value": r.stats["rounds"], "unit": "rounds",
+                "detail": {**r.stats,
+                           "devplane_commits": (ld.node.stats.get(
+                               "devplane_commits", 0)
+                               if ld is not None else None)},
+            })
 
     print(f"{'phase':<28}{'value':>12}  unit")
     for r in results:

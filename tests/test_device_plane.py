@@ -1,0 +1,139 @@
+"""Device plane wired into the live runtime (runtime.device_plane).
+
+The round-2 contract (VERDICT item 2): live replication runs through the
+jitted commit step — leader rounds scatter batches over the replica
+shards and the device quorum result advances host commit (with the host
+ack-quorum rule stood down), followers drain entries from their device
+shards — while host TCP stays control plane + catch-up.  These tests
+assert the device plane is LOAD-BEARING, not decorative: commits happen
+with ``external_commit`` set (host commit rule disabled), entries arrive
+at followers via the shard drain, and the plane survives failover by
+re-basing under the new leader.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from apus_tpu.models.kvs import KvsStateMachine, encode_get, encode_put
+from apus_tpu.runtime.cluster import LocalCluster
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_device_plane_commits_live_traffic():
+    with LocalCluster(3, device_plane=True) as c:
+        leader = c.wait_for_leader()
+        # The driver takes over commit once the host path has committed
+        # the prefix below the device base.
+        _wait(lambda: leader.node.external_commit or not leader.is_leader,
+              msg="device plane owning commit")
+        for i in range(40):
+            c.submit(encode_put(b"k%d" % i, b"v%d" % i))
+        runner = c.device_runner
+        assert runner.stats["rounds"] > 0, "no device rounds ran"
+        ld = c.leader()
+        assert ld is not None
+        assert ld.node.stats.get("devplane_commits", 0) > 0, \
+            "no commit advance came from device quorum results"
+        assert ld.node.external_commit, \
+            "host commit path was not stood down"
+        # Followers got entries via the shard drain (the device plane is
+        # the entry transport, not just an ack counter).
+        drained = sum(d.device_driver.stats["drained"]
+                      for d in c.live() if d.device_driver is not None)
+        assert drained > 0, "no follower drained entries from its shard"
+        # Convergence: every replica's KVS holds every write.
+        for i in range(3):
+            c.wait_caught_up(i)
+        for d in c.live():
+            for i in range(40):
+                assert d.node.sm.query(encode_get(b"k%d" % i)) == \
+                    b"v%d" % i, (d.idx, i)
+        c.check_logs_consistent()
+
+
+def test_device_plane_survives_failover():
+    with LocalCluster(3, device_plane=True) as c:
+        c.submit(encode_put(b"before", b"1"))
+        old = c.wait_for_leader()
+        resets_before = c.device_runner.stats["resets"]
+        c.kill(old.idx)
+        # New leader re-bases the device plane and traffic keeps flowing.
+        _wait(lambda: c.leader() is not None and c.leader().idx != old.idx,
+              msg="new leader")
+        for i in range(20):
+            c.submit(encode_put(b"after%d" % i, b"x"))
+        assert c.device_runner.stats["resets"] > resets_before, \
+            "device plane did not re-base under the new leader"
+        new = c.leader()
+        _wait(lambda: new.node.external_commit or not new.is_leader,
+              msg="device plane re-owning commit after failover")
+        c.submit(encode_put(b"final", b"y"))
+        assert new.node.stats.get("devplane_commits", 0) > 0
+        live = [d.idx for d in c.live()]
+        for i in live:
+            c.wait_caught_up(i)
+        for d in c.live():
+            assert d.node.sm.query(encode_get(b"before")) == b"1"
+            assert d.node.sm.query(encode_get(b"final")) == b"y"
+        c.check_logs_consistent()
+
+
+def test_device_plane_proxied_app_traffic():
+    """The full APUS shape with the device plane live: an unmodified app
+    under LD_PRELOAD, every captured byte-stream committed through the
+    jitted step before the app sees it, follower apps fed by replay."""
+    from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster
+
+    with ProxiedCluster(3, device_plane=True) as pc:
+        leader = pc.leader_idx()
+        ld = pc.cluster.daemons[leader]
+        _wait(lambda: ld.node.external_commit or not ld.is_leader,
+              msg="device plane owning commit")
+        _, replies = pc.write_round(
+            [f"SET dk{i} dv{i}" for i in range(30)] + ["GET dk0"])
+        assert replies[-1] == "dv0"
+        runner = pc.cluster.device_runner
+        assert runner.stats["rounds"] > 0
+        ld2 = pc.cluster.leader()
+        assert ld2.node.stats.get("devplane_commits", 0) > 0, \
+            "app traffic did not commit through the device plane"
+        # Convergence on every replica's app.
+        deadline = time.monotonic() + 15.0
+        for i in range(3):
+            while time.monotonic() < deadline:
+                with LineClient(pc.app_addr(i)) as c:
+                    if c.cmd("GET dk29") == "dv29":
+                        break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(f"replica {i} app did not converge")
+
+
+def test_device_plane_oversized_record_falls_back():
+    """A record too large for a slot makes its span commit via the host
+    path (device-ineligible round), then the plane re-bases past it —
+    no stall, no loss.  (Until runtime.segment splits these upstream.)"""
+    with LocalCluster(3, device_plane=True) as c:
+        leader = c.wait_for_leader()
+        _wait(lambda: leader.node.external_commit or not leader.is_leader,
+              msg="device plane owning commit")
+        big = b"B" * (c.device_runner.slot_bytes + 100)
+        c.submit(encode_put(b"big", big), timeout=20.0)
+        c.submit(encode_put(b"small", b"s"))
+        for i in range(3):
+            c.wait_caught_up(i)
+        for d in c.live():
+            assert d.node.sm.query(encode_get(b"big")) == big
+            assert d.node.sm.query(encode_get(b"small")) == b"s"
+        c.check_logs_consistent()
