@@ -745,6 +745,7 @@ class Node:
                     self._ack_progress.pop(peer, None)
             else:
                 self._ack_progress.pop(peer, None)
+            just_adjusted = False
             if not self._adjusted.get(peer, False):
                 state = self.t.log_read_state(peer)
                 if state is None:
@@ -758,14 +759,19 @@ class Node:
                         continue
                 self._next_idx[peer] = div
                 self._adjusted[peer] = True
+                just_adjusted = True
             nxt = self._next_idx.get(peer, self.log.commit)
             # Fast-forward past entries the peer already holds: with the
             # device plane delivering entries directly into follower
             # logs (runtime.device_plane drain), the acked end routinely
             # runs AHEAD of our TCP write cursor — re-sending that span
-            # would be pure idempotent waste.
-            if (self._adjusted.get(peer, False) and ack is not None
-                    and nxt < ack <= self.log.end):
+            # would be pure idempotent waste.  Never on the iteration
+            # that just (re)adjusted the peer: ``ack`` was read BEFORE
+            # the adjustment truncated the follower to ``div``, so a
+            # stale ack > div would skip entries the follower no longer
+            # holds and stall replication until the watchdog re-adjusts.
+            if (not just_adjusted and self._adjusted.get(peer, False)
+                    and ack is not None and nxt < ack <= self.log.end):
                 nxt = self._next_idx[peer] = ack
             if nxt < self.log.head:
                 # Peer is behind our pruned head: push a snapshot

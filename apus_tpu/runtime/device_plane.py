@@ -135,6 +135,9 @@ class DeviceCommitRunner:
         # ``np.asarray(devlog.data[r])`` would ship the whole 16 MB
         # shard per poll and starve the commit path).
         self._gather = jax.jit(lambda d, m, r, s: (d[r, s], m[r, s]))
+        # One replica's offsets row, as a NEW buffer: shard_end must not
+        # hand out a view of the (donated) devlog arrays.
+        self._offs_one = jax.jit(lambda o, r: o[r])
         self._jax = jax
         self._warmup()
         self._built = True
@@ -217,34 +220,45 @@ class DeviceCommitRunner:
                 return None
             assert end0 == self._next_end0, (end0, self._next_end0)
             leader, term = self._leader, self._term
-
-            bdata = np.zeros((B, SB), np.uint8)
-            bmeta = np.zeros((B, 4), np.int32)
-            for j, e in enumerate(entries):
-                assert e.idx == end0 + j, (e.idx, end0, j)
-                blob = wire.encode_entry(e)
-                if len(blob) > SB:
-                    raise ValueError(
-                        f"entry {e.idx} wire size {len(blob)} > slot "
-                        f"{SB}; segment upstream")
-                bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
-                bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
-                            int(e.type), len(blob))
-            pdata, pmeta = place_batch(self._mesh, self.n_replicas,
-                                       leader, bdata, bmeta)
-            ctrl = self._make_ctrl(cid, leader, term, end0, live)
-            devlog, acks, commit = self._step(self._devlog, pdata, pmeta,
-                                              ctrl)
-            self._jax.block_until_ready(commit)
-            self._devlog = devlog
+        # Host-side encode + staging run with the runner lock RELEASED.
+        # Lock discipline (donation-safe): every *enqueue* touching
+        # self._devlog happens under the lock (enqueues are fast —
+        # compile was paid in _warmup), because the step DONATES the
+        # devlog buffers and a reader enqueueing on a donated array
+        # would crash; every *blocking wait* happens outside it, so
+        # follower drains and shard_end polls never serialize behind a
+        # round's device execution (nor behind a hung dispatch).
+        bdata = np.zeros((B, SB), np.uint8)
+        bmeta = np.zeros((B, 4), np.int32)
+        for j, e in enumerate(entries):
+            assert e.idx == end0 + j, (e.idx, end0, j)
+            blob = wire.encode_entry(e)
+            if len(blob) > SB:
+                raise ValueError(
+                    f"entry {e.idx} wire size {len(blob)} > slot "
+                    f"{SB}; segment upstream")
+            bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
+            bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
+                        int(e.type), len(blob))
+        pdata, pmeta = place_batch(self._mesh, self.n_replicas,
+                                   leader, bdata, bmeta)
+        ctrl = self._make_ctrl(cid, leader, term, end0, live)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None            # reset raced the staging: discard
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            new_devlog, acks, commit = self._step(self._devlog, pdata,
+                                                  pmeta, ctrl)
+            self._devlog = new_devlog
             self._next_end0 = end0 + B
-            acks_host = [int(a) for a in np.asarray(acks)]
-            commit_host = int(commit)
             self.stats["rounds"] += 1
             self.stats["entries_devplane"] += B
-            if commit_host < end0 + B:
-                self.stats["quorum_fail_rounds"] += 1
-            return acks_host, commit_host
+        self._jax.block_until_ready(commit)
+        acks_host = [int(a) for a in np.asarray(acks)]
+        commit_host = int(commit)
+        if commit_host < end0 + B:
+            self.stats["quorum_fail_rounds"] += 1
+        return acks_host, commit_host
 
     def _make_ctrl(self, cid, leader: int, term: int, end0: int,
                    live: set[int]):
@@ -276,12 +290,20 @@ class DeviceCommitRunner:
     # -- follower shard readback -----------------------------------------
 
     def shard_end(self, replica: int, gen: int) -> Optional[int]:
-        """The device-log end of ``replica``'s shard (None if stale gen)."""
+        """The device-log end of ``replica``'s shard (None if stale gen
+        or ``replica`` outside the device geometry — a joiner beyond
+        n_replicas must not silently read another replica's shard via
+        JAX index clamping)."""
         from apus_tpu.ops.logplane import OFF_END
+        if not (0 <= replica < self.n_replicas):
+            return None
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
-            return int(np.asarray(self._devlog.offs[replica])[OFF_END])
+            # Enqueue under the lock (donation safety); the wait for the
+            # tiny [4]-int transfer happens outside it.
+            row = self._offs_one(self._devlog.offs, np.int32(replica))
+        return int(np.asarray(row)[OFF_END])
 
     def read_rows(self, replica: int, gen: int, lo: int,
                   hi: int) -> Optional[list[LogEntry]]:
@@ -290,21 +312,27 @@ class DeviceCommitRunner:
         (ring overwritten, or not yet written) are cut off; the caller
         appends what it gets and retries later."""
         from apus_tpu.ops.logplane import META_IDX, META_LEN, slot_of
+        if not (0 <= replica < self.n_replicas):
+            return None
         hi = min(hi, lo + self.batch)
+        # Fixed-size [B] slot vector (static shape -> one compiled
+        # gather); rows past hi are fetched and discarded.
+        slots = np.array([slot_of(lo + j, self.n_slots)
+                          for j in range(self.batch)], np.int32)
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
             if hi <= lo:
                 return []
-            # Fixed-size [B] slot vector (static shape -> one compiled
-            # gather); rows past hi are fetched and discarded.
-            slots = np.array([slot_of(lo + j, self.n_slots)
-                              for j in range(self.batch)], np.int32)
+            # Enqueue under the lock (donation safety: the commit step
+            # donates the devlog buffers, so reader enqueues must be
+            # ordered against round dispatches); the device->host wait
+            # happens outside it.
             data_rows, meta_rows = self._gather(
                 self._devlog.data, self._devlog.meta,
                 np.int32(replica), slots)
-            data = np.asarray(data_rows)
-            meta = np.asarray(meta_rows)
+        data = np.asarray(data_rows)
+        meta = np.asarray(meta_rows)
         out: list[LogEntry] = []
         for j, idx in enumerate(range(lo, hi)):
             if int(meta[j, META_IDX]) != idx:
@@ -582,12 +610,59 @@ class DevicePlaneDriver:
                 live.add(m)
         return live
 
+    # -- election-time shard reconciliation -------------------------------
+
+    def _drain_for_election(self) -> None:
+        """node.pre_election_hook: runs UNDER the daemon lock, from the
+        tick thread, before this replica grants a real vote or
+        campaigns.  The host log absorbs every current-term row the
+        replica's own device shard holds: the device quorum attests
+        SHARD placement (safety argument 1/3), so the shard must count
+        as the log for election up-to-dateness (node.py pre_election_hook
+        contract) — exactly as the reference's recovery reads back the
+        same memory its RDMA writes landed in (rc_recover_log,
+        dare_ibv_rc.c:726-856).  Same term/idx/prev-entry guards as
+        _follower_step; loops until shard_end is absorbed or a guard
+        fails (tail not at current term, decode hole, full log)."""
+        node = self.daemon.node
+        if not (0 <= self.daemon.idx < self.runner.n_replicas):
+            return
+        while True:
+            gen = self.runner.generation
+            if gen == 0:
+                return
+            term = node.current_term
+            end = node.log.end
+            prev = node.log.get(end - 1)
+            if prev is None or prev.term != term:
+                return                 # diverged/stale tail: do not graft
+            shard_end = self.runner.shard_end(self.daemon.idx, gen)
+            if shard_end is None or shard_end <= end:
+                return                 # shard fully absorbed
+            rows = self.runner.read_rows(
+                self.daemon.idx, gen, end,
+                min(shard_end, end + self.runner.batch))
+            if not rows:
+                return
+            appended = 0
+            for e in rows:
+                if e.term != term or e.idx != node.log.end \
+                        or node.log.is_full:
+                    break
+                node.log.write(e)
+                appended += 1
+            self.stats["drained"] += appended
+            if appended == 0:
+                return
+
     # -- follower half ----------------------------------------------------
 
     def _follower_step(self, node) -> bool:
         """Drain device rows from our shard into the host log (safety
         argument 2: only on top of a current-term entry).  Never touches
         commit — that arrives via the leader's TCP writes."""
+        if not (0 <= self.daemon.idx < self.runner.n_replicas):
+            return False       # outside the device geometry (joiner)
         gen = self.runner.generation
         if gen == 0:
             return False
