@@ -785,7 +785,10 @@ class Node:
                     self._next_idx[peer] = snap.last_idx + 1
                     self.stats["snapshots_pushed"] = \
                         self.stats.get("snapshots_pushed", 0) + 1
-                elif res == WriteResult.FENCED:
+                elif res in (WriteResult.FENCED, WriteResult.REFUSED):
+                    # REFUSED: the peer's commit is already past the
+                    # snapshot (our view of it was stale) — re-read its
+                    # real log state instead of assuming the push landed.
                     self._adjusted[peer] = False
                 else:
                     self._note_failure(peer, now)

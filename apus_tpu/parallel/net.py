@@ -42,7 +42,8 @@ from apus_tpu.parallel.transport import (LogState, Region, Transport,
 
 _ST_OF_RESULT = {WriteResult.OK: wire.ST_OK,
                  WriteResult.DROPPED: wire.ST_DROPPED,
-                 WriteResult.FENCED: wire.ST_FENCED}
+                 WriteResult.FENCED: wire.ST_FENCED,
+                 WriteResult.REFUSED: wire.ST_REFUSED}
 _RESULT_OF_ST = {v: k for k, v in _ST_OF_RESULT.items()}
 
 

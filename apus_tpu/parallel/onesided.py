@@ -87,5 +87,9 @@ def apply_snap_push(node: Node, writer_sid: Sid, snap: Any,
     install_snapshot."""
     if not node.regions.log_write_allowed(writer_sid):
         return WriteResult.FENCED
-    node.install_snapshot(snap, ep_dump, cid, member_addrs)
+    if not node.install_snapshot(snap, ep_dump, cid, member_addrs):
+        # Stale snapshot (target's commit is already past it): surface
+        # the refusal so the pusher re-reads our real state instead of
+        # assuming we now sit at snap.last_idx.
+        return WriteResult.REFUSED
     return WriteResult.OK

@@ -92,6 +92,8 @@ class WriteResult(enum.Enum):
     OK = 0
     DROPPED = 1     # network loss / partition (WC error analog)
     FENCED = 2      # log fence rejected the write
+    REFUSED = 3     # target rejected as stale (e.g. snapshot older than
+                    # its commit): not a failure, re-read its state
 
 
 @dataclasses.dataclass

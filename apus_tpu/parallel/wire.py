@@ -49,6 +49,7 @@ ST_OK = 0
 ST_DROPPED = 1
 ST_FENCED = 2
 ST_ERROR = 3
+ST_REFUSED = 4
 
 # -- ctrl value variants --------------------------------------------------
 VAR_NONE = 0

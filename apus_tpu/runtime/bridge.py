@@ -40,6 +40,7 @@ _OFF_IS_LEADER = 16
 _OFF_TERM = 24
 _OFF_CUR_REC = 32
 _OFF_ABORTED = 40
+_OFF_SPIN_TIMEOUTS = 48
 
 # proxy -> daemon frame body: u8 action | u64 conn_id | u64 cur_rec | data
 _HDR = struct.Struct("<BQQ")
@@ -110,6 +111,10 @@ class Replayer:
     thread; the app's replies are drained and discarded (the reference
     optionally logs them, proxy.c:354-366)."""
 
+    #: Reconnect-and-resend attempts per record before declaring the
+    #: app dirty and falling back to a full re-prime.
+    MAX_RETRIES = 3
+
     def __init__(self, app_host: str, app_port: int, logger=None):
         self.app = (app_host, app_port)
         self.logger = logger
@@ -118,6 +123,16 @@ class Replayer:
         self._conns: dict[int, socket.socket] = {}
         self._thread: Optional[threading.Thread] = None
         self.replayed = 0
+        self.failed = 0          # records given up on after retries
+        self.reprimes = 0        # full history re-primes performed
+        self.dirty = False       # app state diverged; re-prime pending
+        #: _connect attempts (x100ms); tests shrink this so the
+        #: app-down failure path stays fast.
+        self.connect_attempts = 50
+        #: Set by the bridge: returns the full (action, conn_id, data)
+        #: record history to rebuild a dirty app from (the same dump a
+        #: leader-pushed snapshot primes a joiner with).
+        self.reprime_source = None
 
     def start(self) -> None:
         t = threading.Thread(target=self._run, name="apus-replay",
@@ -147,30 +162,100 @@ class Replayer:
             if item is None:
                 return
             action, conn_id, data = item
+            if self.dirty:
+                # A previous failure left the app diverged (re-prime
+                # attempted then failed too — app still down).  Retry
+                # the rebuild before applying anything newer.
+                self._reprime()
             try:
                 self._replay(action, conn_id, data)
                 self.replayed += 1
             except OSError as e:
+                # A committed record could not be applied to the local
+                # app even with bounded reconnection: the app has
+                # diverged from the replicated history (likely crashed
+                # and restarted empty).  Dropping the record here would
+                # silently serve wrong data after a failover, so
+                # rebuild the app from the retained record history —
+                # the same dump a leader-pushed snapshot primes a
+                # joiner with (proxy.c:306-339).
+                self.failed += 1
+                self.dirty = True
                 if self.logger is not None:
-                    self.logger.warning(
-                        "replay %s conn=%x failed: %s",
-                        ProxyAction(action).name, conn_id, e)
+                    self.logger.error(
+                        "replay %s conn=%x failed after %d attempts "
+                        "(%s); re-priming app from record history",
+                        ProxyAction(action).name, conn_id,
+                        self.MAX_RETRIES, e)
+                self._reprime()
 
     def _replay(self, action: int, conn_id: int, data: bytes) -> None:
         if action == ProxyAction.CONNECT:
             self._conns[conn_id] = self._connect()
         elif action == ProxyAction.SEND:
-            conn = self._conns.get(conn_id)
-            if conn is None:
-                # Record stream started before we did (e.g. joiner whose
-                # snapshot replay recreated state but not live sockets).
-                conn = self._conns[conn_id] = self._connect()
-            conn.sendall(data)
-            self._drain(conn)
+            last: Optional[OSError] = None
+            for _ in range(self.MAX_RETRIES):
+                conn = self._conns.get(conn_id)
+                if conn is None:
+                    # Record stream started before we did (e.g. joiner
+                    # whose snapshot replay recreated state but not live
+                    # sockets) — or the previous attempt tore it down.
+                    conn = self._conns[conn_id] = self._connect()
+                try:
+                    conn.sendall(data)
+                    self._drain(conn)
+                    return
+                except OSError as e:
+                    # Broken app socket: reconnect and resend.  The
+                    # record is one whole captured request span, so
+                    # resending it on a fresh connection preserves the
+                    # app-visible framing.
+                    last = e
+                    self._conns.pop(conn_id, None)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+            raise last or OSError("replay send failed")
         elif action == ProxyAction.CLOSE:
             conn = self._conns.pop(conn_id, None)
             if conn is not None:
                 conn.close()
+
+    def _reprime(self) -> None:
+        """Rebuild a dirty app by replaying the full retained record
+        history.  At-least-once across the repair: records that DID land
+        before the failure are applied again (strictly better than the
+        silent drop this path replaces — replayed records are whole
+        client requests, and the SET-shaped traffic this layer carries
+        converges under re-application)."""
+        if self.reprime_source is None:
+            return
+        try:
+            records = self.reprime_source()
+        except Exception:                                # noqa: BLE001
+            return
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        self.reprimes += 1
+        for action, conn_id, data in records:
+            try:
+                self._replay(action, conn_id, data)
+            except OSError as e:
+                self.failed += 1
+                if self.logger is not None:
+                    self.logger.error(
+                        "re-prime replay failed (%s); app remains dirty "
+                        "until the next committed record retries", e)
+                return
+        self.dirty = False
+        if self.logger is not None:
+            self.logger.info("re-primed app with %d records",
+                             len(records))
 
     #: Source address replay connections bind to.  The interposer
     #: recognizes this peer address at accept time and permanently
@@ -183,7 +268,7 @@ class Replayer:
 
     def _connect(self) -> socket.socket:
         last: Optional[OSError] = None
-        for _ in range(50):                 # app may still be starting
+        for _ in range(self.connect_attempts):   # app may still be starting
             try:
                 s = socket.create_connection(
                     self.app, timeout=1.0,
@@ -202,13 +287,13 @@ class Replayer:
         """Discard pending replies so the app's send buffer never fills.
         Readability is pre-checked with a zero-timeout select — a plain
         recv on a timeout-mode socket would block up to the send timeout
-        when the app hasn't replied yet."""
-        try:
-            while select.select([conn], [], [], 0)[0]:
-                if not conn.recv(65536):
-                    break
-        except OSError:
-            pass
+        when the app hasn't replied yet.  EOF raises: the app closed the
+        connection under us, so the record just sent may never have been
+        processed — the caller's bounded retry resends it on a fresh
+        connection (at-least-once, vs silently feeding a dead socket)."""
+        while select.select([conn], [], [], 0)[0]:
+            if not conn.recv(65536):
+                raise OSError("app closed replay connection")
 
 
 class Bridge:
@@ -228,6 +313,8 @@ class Bridge:
         host = app_host if app_host is not None else daemon.spec.app_host
         port = app_port if app_port is not None else daemon.spec.app_port
         self.replayer = Replayer(host, port, self.logger)
+        self.replayer.reprime_source = self._reprime_records
+        self._spin_timeouts_seen = 0
 
         # shm block: create + zero + magic.
         with open(self.shm_path, "wb") as f:
@@ -420,11 +507,46 @@ class Bridge:
         node = self.daemon.node
         self._shm_set(_OFF_IS_LEADER, 1 if node.is_leader else 0)
         self._shm_set(_OFF_TERM, node.current_term)
+        # Surface proxy-side spin timeouts (proxy.cpp wait_released):
+        # each one is a reply the app sent for a record consensus never
+        # released — invisible divergence unless accounted here.
+        spins = self._shm_get(_OFF_SPIN_TIMEOUTS)
+        if spins > self._spin_timeouts_seen:
+            node.stats["proxy_spin_timeouts"] = spins
+            if self.logger is not None:
+                self.logger.error(
+                    "proxy proceeded on %d unreleased record(s) (spin "
+                    "timeout): app replies may precede replication",
+                    spins - self._spin_timeouts_seen)
+            self._spin_timeouts_seen = spins
         if not node.is_leader:
             with self._sub_lock:
                 last = self._last_submitted
             if self.highest_rec < last:
                 self._release(last, abort=True)
+
+    def _reprime_records(self) -> list[tuple[int, int, bytes]]:
+        """Record history for a dirty-app rebuild (Replayer._reprime):
+        every bridge-captured record in the relay SM, minus this app
+        incarnation's own live captures (the app executed those bytes
+        itself when the capture was released) — the same skip set the
+        snapshot prime uses (_on_snapshot)."""
+        with self.daemon.lock:
+            records = list(getattr(self.daemon.node.sm, "records", []))
+            self.daemon.node.stats["replay_reprimes"] = \
+                self.daemon.node.stats.get("replay_reprimes", 0) + 1
+        out: list[tuple[int, int, bytes]] = []
+        for rec in records:
+            try:
+                action, conn_id, data, clt, rid = decode_record(rec)
+            except Exception:                            # noqa: BLE001
+                continue
+            if not is_bridge_clt(clt):
+                continue
+            if clt == self.clt_id and rid >= self._boot_base:
+                continue
+            out.append((action, conn_id, data))
+        return out
 
     # -- commit upcall ----------------------------------------------------
 

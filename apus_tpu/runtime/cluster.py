@@ -181,6 +181,10 @@ class LocalCluster:
             target = leader.node.log.commit
         deadline = time.monotonic() + timeout
         d = self.daemons[idx]
+        if d is None:
+            raise AssertionError(
+                f"replica {idx} is not running (killed or never started); "
+                f"cannot wait for catch-up")
         while time.monotonic() < deadline:
             with d.lock:
                 if d.node.log.apply >= target:

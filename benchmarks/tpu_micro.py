@@ -1,0 +1,65 @@
+"""Micro-experiments to locate TPU time: dispatch RTT, scan-carry copy cost."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+t0 = time.monotonic()
+def mark(m): print(f"[micro +{time.monotonic()-t0:6.1f}s] {m}", file=sys.stderr, flush=True)
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+mark(f"backend={jax.default_backend()}")
+
+# 1. raw dispatch RTT: trivial jit
+@jax.jit
+def triv(x): return x + 1
+x = jnp.zeros((8,), jnp.int32)
+triv(x).block_until_ready()
+ts = []
+for _ in range(20):
+    a = time.perf_counter_ns(); triv(x).block_until_ready()
+    ts.append((time.perf_counter_ns()-a)/1e3)
+ts.sort(); mark(f"trivial dispatch RTT p50 {ts[10]:.0f}us")
+
+# 2. scan doing K DUS writes into a big carry, depth D — marginal cost vs size
+def build(S, SB, K, B, D):
+    @jax.jit
+    def f(log, batch):
+        def one(carry, i):
+            log = carry
+            start = (i * B) % S
+            for k in range(K):
+                log = lax.dynamic_update_slice(log, batch[None], (jnp.int32(k), start, jnp.int32(0)))
+            return log, jnp.sum(batch[0, :1].astype(jnp.int32))
+        log, outs = lax.scan(one, log, jnp.arange(D, dtype=jnp.int32))
+        return log, outs
+    return f
+
+for S in (1024, 4096):
+    for D in (64, 256):
+        K, B, SB = 5, 64, 4096
+        f = build(S, SB, K, B, D)
+        log = jnp.zeros((K, S+B, SB), jnp.uint8)
+        batch = jnp.ones((B, SB), jnp.uint8)
+        log, outs = f(log, batch); jax.block_until_ready(outs)
+        ws = []
+        for _ in range(5):
+            a = time.perf_counter_ns()
+            log, outs = f(log, batch); jax.block_until_ready(outs)
+            ws.append((time.perf_counter_ns()-a)/1e3)
+        ws.sort()
+        mark(f"S={S} D={D}: total p50 {ws[2]:.0f}us, {ws[2]/D:.1f}us/iter")
+
+# 3. same but with donation
+for S in (4096,):
+    for D in (64, 256):
+        K, B, SB = 5, 64, 4096
+        f0 = build(S, SB, K, B, D)
+        f = jax.jit(f0, donate_argnums=0)
+        log = jnp.zeros((K, S+B, SB), jnp.uint8)
+        batch = jnp.ones((B, SB), jnp.uint8)
+        log, outs = f(log, batch); jax.block_until_ready(outs)
+        ws = []
+        for _ in range(5):
+            a = time.perf_counter_ns()
+            log, outs = f(log, batch); jax.block_until_ready(outs)
+            ws.append((time.perf_counter_ns()-a)/1e3)
+        ws.sort()
+        mark(f"donated S={S} D={D}: total p50 {ws[2]:.0f}us, {ws[2]/D:.1f}us/iter")

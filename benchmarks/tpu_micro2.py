@@ -1,0 +1,102 @@
+"""Bisect the pipelined commit step cost: body-only vs shard_map vs pieces."""
+import os, sys, time, functools, dataclasses
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+t0 = time.monotonic()
+def mark(m): print(f"[micro2 +{time.monotonic()-t0:6.1f}s] {m}", file=sys.stderr, flush=True)
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mark(f"backend={jax.default_backend()}")
+
+from apus_tpu.ops.commit import CommitControl, build_pipelined_commit_step, place_batch
+from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding, REPLICA_AXIS
+from apus_tpu.core.cid import Cid
+
+R, S, SB, B, D = 5, 4096, 4096, 64, 64
+mesh = replica_mesh(R, devices=jax.devices()[:1])
+sh = replica_sharding(mesh)
+cid = Cid.initial(R)
+reqs = [b"x" * 80 for _ in range(B)]
+bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+sdata, smeta = bdata[None], bmeta[None]
+
+def run(name, fn, *args):
+    out = fn(*args); jax.block_until_ready(jax.tree.leaves(out)[-1])
+    ws = []
+    for _ in range(5):
+        a = time.perf_counter_ns()
+        out = fn(*args); jax.block_until_ready(jax.tree.leaves(out)[-1])
+        ws.append((time.perf_counter_ns()-a)/1e3)
+    ws.sort(); mark(f"{name}: p50 {ws[2]:.0f}us total, {ws[2]/D:.2f}us/round")
+
+# 1. the real thing
+pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D, staged_depth=1)
+devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+run("full pipelined step", lambda: pipe(devlog, sdata, smeta, ctrl))
+
+# 2. body in scan, no shard_map, no collectives (K=R local)
+def body_local(log_data, log_meta, offs, fence, bdata, bmeta, ctrl):
+    K, rows, _SB = log_data.shape
+    rid = jnp.arange(K, dtype=jnp.int32)
+    is_leader = rid == ctrl.leader
+    bcast_d = jnp.max(bdata, axis=0)
+    bcast_m = jnp.max(bmeta, axis=0)
+    fence_ok = ((fence[:, 0] == ctrl.leader) & (ctrl.term >= fence[:, 1])) | is_leader
+    own_end = offs[:, 1]
+    contig = own_end == ctrl.end0
+    do_write = fence_ok & contig
+    span = (ctrl.end0 - 1) % S
+    start = jnp.where(do_write, span, S)
+    j = jnp.arange(B, dtype=jnp.int32)
+    entry_idx = ctrl.end0 + j
+    fresh_meta = jnp.stack([entry_idx, jnp.full((B,), ctrl.term, jnp.int32),
+                            bcast_m[:,0], bcast_m[:,1], bcast_m[:,2], bcast_m[:,3]], axis=-1)
+    for k in range(K):
+        log_data = lax.dynamic_update_slice(log_data, bcast_d[None], (jnp.int32(k), start[k], jnp.int32(0)))
+        log_meta = lax.dynamic_update_slice(log_meta, fresh_meta[None], (jnp.int32(k), start[k], jnp.int32(0)))
+    new_end = jnp.where(do_write, ctrl.end0 + B, own_end)
+    acks = new_end
+    cand = jnp.minimum(acks, ctrl.end0 + B)
+    ge = acks[None,:] >= cand[:,None]
+    n_old = jnp.sum(ge * ctrl.mask_old[None,:], axis=1)
+    ok = n_old >= ctrl.q_old
+    commit_global = jnp.max(jnp.where(ok, cand, 0))
+    own_commit = offs[:, 0]
+    new_commit = jnp.where(do_write, jnp.maximum(own_commit, jnp.minimum(commit_global, new_end)), own_commit)
+    offs = offs.at[:, 1].set(new_end)
+    offs = offs.at[:, 0].set(new_commit)
+    return log_data, log_meta, offs, fence, commit_global
+
+@functools.partial(jax.jit, donate_argnums=(0,1))
+def pipe_local(log_data, log_meta, offs, fence, sdata, smeta, ctrl):
+    def one(carry, i):
+        log_data, log_meta, offs, fence, ctrl = carry
+        bdata = lax.dynamic_index_in_dim(sdata, i % 1, axis=0, keepdims=False)
+        bmeta = lax.dynamic_index_in_dim(smeta, i % 1, axis=0, keepdims=False)
+        log_data, log_meta, offs, fence, commit = body_local(log_data, log_meta, offs, fence, bdata, bmeta, ctrl)
+        ctrl = dataclasses.replace(ctrl, end0=ctrl.end0 + B)
+        return (log_data, log_meta, offs, fence, ctrl), commit
+    (log_data, log_meta, offs, fence, ctrl), commits = lax.scan(
+        one, (log_data, log_meta, offs, fence, ctrl), jnp.arange(D, dtype=jnp.int32))
+    return log_data, log_meta, offs, fence, commits, ctrl
+
+dl = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+state = [dl.data, dl.meta, dl.offs, dl.fence]
+def call_local():
+    out = pipe_local(state[0], state[1], state[2], state[3], sdata, smeta, ctrl)
+    state[0], state[1] = out[0], out[1]
+    return out[4]
+run("local body scan (no shard_map)", call_local)
+
+# 3. u8 max-reduce alone in scan
+@jax.jit
+def just_bcast(sdata, n):
+    def one(c, i):
+        bdata = lax.dynamic_index_in_dim(sdata, i % 1, axis=0, keepdims=False)
+        return c + jnp.max(jnp.max(bdata, axis=0)).astype(jnp.int32), 0
+    c, _ = lax.scan(one, jnp.int32(0), jnp.arange(n, dtype=jnp.int32))
+    return c
+run("u8 max-reduce scan", lambda: just_bcast(sdata, D))

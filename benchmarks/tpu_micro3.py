@@ -1,0 +1,66 @@
+"""A/B: chained (feed outputs back) vs unchained (same inputs each call)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+t0 = time.monotonic()
+def mark(m): print(f"[m3 +{time.monotonic()-t0:6.1f}s] {m}", file=sys.stderr, flush=True)
+import warnings
+import jax, jax.numpy as jnp, numpy as np
+mark(f"backend={jax.default_backend()}")
+from apus_tpu.ops.commit import CommitControl, build_pipelined_commit_step, place_batch
+from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+from apus_tpu.core.cid import Cid
+
+R, S, SB, B, D = 5, 4096, 4096, 64, 64
+mesh = replica_mesh(R, devices=jax.devices()[:1])
+sh = replica_sharding(mesh)
+cid = Cid.initial(R)
+reqs = [b"x" * 80 for _ in range(B)]
+bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+sdata, smeta = bdata[None], bmeta[None]
+pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D, staged_depth=1)
+
+with warnings.catch_warnings(record=True) as ws:
+    warnings.simplefilter("always")
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+    out = pipe(devlog, sdata, smeta, ctrl)
+    jax.block_until_ready(out[1])
+    for w in ws: mark(f"WARN: {w.message}")
+
+# unchained: reuse ORIGINAL (donated!) inputs
+devlog0 = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+pipe(devlog0, sdata, smeta, ctrl)  # may donate devlog0
+try:
+    ts = []
+    for _ in range(5):
+        a = time.perf_counter_ns()
+        o = pipe(devlog0, sdata, smeta, ctrl); jax.block_until_ready(o[1])
+        ts.append((time.perf_counter_ns()-a)/1e3)
+    ts.sort(); mark(f"unchained p50 {ts[2]:.0f}us ({ts[2]/D:.2f}us/round)")
+except Exception as e:
+    mark(f"unchained raised: {type(e).__name__}: {e}")
+
+# chained: feed outputs back
+devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+jax.block_until_ready(commits)
+ts = []
+for _ in range(10):
+    a = time.perf_counter_ns()
+    devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+    jax.block_until_ready(commits)
+    ts.append((time.perf_counter_ns()-a)/1e3)
+mark("chained each: " + " ".join(f"{t:.0f}" for t in ts))
+ts.sort(); mark(f"chained p50 {ts[5]:.0f}us ({ts[5]/D:.2f}us/round)")
+
+# chained but block on devlog.offs too
+ts = []
+for _ in range(5):
+    a = time.perf_counter_ns()
+    devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+    jax.block_until_ready((commits, devlog.offs))
+    ts.append((time.perf_counter_ns()-a)/1e3)
+ts.sort(); mark(f"chained+block offs p50 {ts[2]:.0f}us")

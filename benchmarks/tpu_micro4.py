@@ -1,0 +1,40 @@
+"""Bisect probe-vs-micro3 1000x gap: does reading commits poison the loop?"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+t0 = time.monotonic()
+def mark(m): print(f"[m4 +{time.monotonic()-t0:6.1f}s] {m}", file=sys.stderr, flush=True)
+import jax, jax.numpy as jnp, numpy as np
+mark(f"backend={jax.default_backend()}")
+from apus_tpu.ops.commit import CommitControl, build_pipelined_commit_step, place_batch
+from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+from apus_tpu.core.cid import Cid
+
+R, S, SB, B, D = 5, 4096, 4096, 64, 64
+mesh = replica_mesh(R, devices=jax.devices()[:1])
+sh = replica_sharding(mesh)
+cid = Cid.initial(R)
+reqs = [b"x" * 80 for _ in range(B)]
+bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+sdata, smeta = bdata[None], bmeta[None]
+pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D, staged_depth=1)
+
+def loop(tag, read_commits):
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+    devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+    jax.block_until_ready(commits)
+    if read_commits:
+        _ = int(np.asarray(commits)[-1])
+    ts = []
+    for _ in range(8):
+        a = time.perf_counter_ns()
+        devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+        jax.block_until_ready(commits)
+        ts.append((time.perf_counter_ns()-a)/1e3)
+    mark(f"{tag}: " + " ".join(f"{t:.0f}" for t in ts))
+
+loop("no-read", False)
+loop("with-read", True)
+loop("no-read-2", False)

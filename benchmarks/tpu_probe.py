@@ -1,0 +1,61 @@
+"""Phase-timed probe of the device commit path on the live backend.
+
+Prints one stderr line per phase so a watchdog log shows exactly where
+time went: backend init, op build, compile (depth ladder), execute.
+Usage: python benchmarks/tpu_probe.py [depth ...]
+"""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.monotonic()
+def mark(msg):
+    print(f"[probe +{time.monotonic()-t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+cache = os.environ.get("APUS_JAX_CACHE", "/root/repo/.jax_cache")
+mark("importing jax")
+import jax
+if cache:
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+import numpy as np
+mark(f"jax {jax.__version__} imported; initializing backend")
+devs = jax.devices()
+mark(f"backend={jax.default_backend()} devices={devs}")
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.ops.commit import (CommitControl, build_commit_step,
+                                 build_pipelined_commit_step, place_batch)
+from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+mark("apus_tpu imported")
+
+R, S, SB, B = 5, 4096, 4096, 64
+mesh = replica_mesh(R, devices=devs[:1])
+sh = replica_sharding(mesh)
+cid = Cid.initial(R)
+reqs = [b"x" * 80 for _ in range(B)]
+bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+sdata, smeta = bdata[None], bmeta[None]
+mark("staged batch placed on device")
+
+depths = [int(a) for a in sys.argv[1:]] or [16, 64, 256, 1024]
+for D in depths:
+    pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D, staged_depth=1)
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
+    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+    tc = time.monotonic()
+    devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+    jax.block_until_ready(commits)
+    mark(f"depth={D}: warmup(compile+run) {time.monotonic()-tc:.1f}s; "
+         f"commit={int(np.asarray(commits)[-1])}")
+    walls = []
+    for _ in range(5):
+        ts = time.monotonic()
+        devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+        jax.block_until_ready(commits)
+        walls.append(time.monotonic() - ts)
+    walls.sort()
+    mark(f"depth={D}: exec p50 {walls[2]*1e6:.0f}us total, "
+         f"{walls[2]*1e6/D:.2f}us/round")

@@ -46,7 +46,10 @@ struct apus_shm {
   volatile uint64_t term;         // current term (daemon writes)
   volatile uint64_t cur_rec;      // capture counter (proxy fetch-adds)
   volatile uint64_t aborted;      // records released without commit
-  uint64_t pad[2];
+  volatile uint64_t spin_timeouts;  // records the app proceeded on after
+                                    // the release spin timed out (proxy
+                                    // writes; daemon surfaces in stats)
+  uint64_t pad[1];
 };
 
 // Max raw request record (TCP rcvbuf-sized, message.h:7 parity).

@@ -12,6 +12,7 @@
 #include <dlfcn.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 extern "C" {
@@ -100,6 +101,59 @@ ssize_t recv(int fd, void* buf, size_t count, int flags) {
   if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
       !apus_proxy_owns_fd(fd))
     apus_proxy_on_read(fd, buf, n);
+  return n;
+}
+
+// Scatter-gather and addressed receive paths (libevent- and
+// libuv-backed servers reach sockets through these; the reference's
+// hook set covers read only because its pinned apps do, so going wider
+// here keeps the interposer app-agnostic).  Captured bytes are fed to
+// the proxy in iovec order — the same byte stream a read() would have
+// produced.
+ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
+  using fn = ssize_t (*)(int, const struct iovec*, int);
+  static fn real = next_sym<fn>("readv");
+  ssize_t n = real(fd, iov, iovcnt);
+  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd)) {
+    ssize_t left = n;
+    for (int i = 0; i < iovcnt && left > 0; ++i) {
+      ssize_t take = static_cast<ssize_t>(iov[i].iov_len) < left
+                         ? static_cast<ssize_t>(iov[i].iov_len)
+                         : left;
+      apus_proxy_on_read(fd, iov[i].iov_base, take);
+      left -= take;
+    }
+  }
+  return n;
+}
+
+ssize_t recvfrom(int fd, void* buf, size_t len, int flags,
+                 struct sockaddr* src_addr, socklen_t* addrlen) {
+  using fn = ssize_t (*)(int, void*, size_t, int, struct sockaddr*,
+                         socklen_t*);
+  static fn real = next_sym<fn>("recvfrom");
+  ssize_t n = real(fd, buf, len, flags, src_addr, addrlen);
+  if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
+      !apus_proxy_owns_fd(fd))
+    apus_proxy_on_read(fd, buf, n);
+  return n;
+}
+
+ssize_t recvmsg(int fd, struct msghdr* msg, int flags) {
+  using fn = ssize_t (*)(int, struct msghdr*, int);
+  static fn real = next_sym<fn>("recvmsg");
+  ssize_t n = real(fd, msg, flags);
+  if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
+      !apus_proxy_owns_fd(fd)) {
+    ssize_t left = n;
+    for (size_t i = 0; i < msg->msg_iovlen && left > 0; ++i) {
+      ssize_t take = static_cast<ssize_t>(msg->msg_iov[i].iov_len) < left
+                         ? static_cast<ssize_t>(msg->msg_iov[i].iov_len)
+                         : left;
+      apus_proxy_on_read(fd, msg->msg_iov[i].iov_base, take);
+      left -= take;
+    }
+  }
   return n;
 }
 

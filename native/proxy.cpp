@@ -159,6 +159,10 @@ void wait_released(uint64_t rec) {
     if (g.spin_timeout_ms > 0 && now_ms() - start > g.spin_timeout_ms) {
       plog("proxy: record %llu not released in %llu ms; proceeding",
            (unsigned long long)rec, (unsigned long long)g.spin_timeout_ms);
+      // Make the unreplicated ack visible to the daemon: it watches
+      // this counter each tick and logs/accounts the divergence (a
+      // reply went out for a record consensus never released).
+      __atomic_add_fetch(&g.shm->spin_timeouts, 1, __ATOMIC_ACQ_REL);
       return;
     }
   }

@@ -1,0 +1,269 @@
+"""Regression tests for the advisor findings (ADVICE.md rounds 1-2).
+
+Each test pins one repaired failure mode:
+  - stale snapshot push is REFUSED (not silently "installed"), and the
+    leader re-reads the follower's state instead of assuming success;
+  - wait_caught_up on a killed replica fails with a clear message, not
+    a None-dereference;
+  - the interposer exports the full receive-path hook set
+    (readv/recvfrom/recvmsg alongside read/recv);
+  - proxy spin timeouts are visible to the daemon (shm counter ->
+    node stats), not just a line in the proxy's own log;
+  - a committed record that cannot be replayed into the local app
+    triggers bounded reconnect+retry and then a full history re-prime,
+    instead of being logged and dropped (silent app divergence).
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from apus_tpu.models.sm import Snapshot
+from apus_tpu.parallel import onesided
+from apus_tpu.parallel.sim import Cluster
+from apus_tpu.parallel.transport import WriteResult
+from apus_tpu.runtime.bridge import Replayer
+
+
+# -- snapshot-push refusal -------------------------------------------------
+
+def test_snap_push_stale_is_refused():
+    c = Cluster(3, seed=11)
+    leader = c.wait_for_leader()
+    for i in range(5):
+        c.submit(b"cmd-%d" % i)
+    c.run(0.5)
+    follower = next(n for n in c.nodes if n is not leader)
+    assert follower.log.commit > 1
+    stale = Snapshot(last_idx=0, last_term=0, data=b"")
+    res = onesided.apply_snap_push(follower, leader.sid.sid, stale, [])
+    assert res == WriteResult.REFUSED
+    # Follower state untouched by the refused push.
+    assert follower.log.commit > 1
+
+
+def test_snap_push_wire_status_roundtrip():
+    from apus_tpu.parallel import wire
+    from apus_tpu.parallel.net import _RESULT_OF_ST, _ST_OF_RESULT
+    assert _ST_OF_RESULT[WriteResult.REFUSED] == wire.ST_REFUSED
+    assert _RESULT_OF_ST[wire.ST_REFUSED] == WriteResult.REFUSED
+    # Every WriteResult has a wire encoding (a new member that silently
+    # decodes as DROPPED would count as a peer failure).
+    assert set(_ST_OF_RESULT) == set(WriteResult)
+
+
+# -- wait_caught_up on a dead replica --------------------------------------
+
+def test_wait_caught_up_killed_replica_raises_cleanly():
+    from apus_tpu.runtime.cluster import LocalCluster
+    with LocalCluster(3) as lc:
+        leader = lc.wait_for_leader()
+        victim = next(i for i in range(3) if lc.daemons[i] is not leader)
+        lc.kill(victim)
+        with pytest.raises(AssertionError, match="not running"):
+            lc.wait_caught_up(victim, timeout=0.5)
+
+
+# -- interposer hook coverage ----------------------------------------------
+
+def test_interpose_exports_scatter_gather_hooks():
+    from apus_tpu.runtime.appcluster import build_native
+    from apus_tpu.runtime.bridge import INTERPOSE_SO
+    build_native()
+    out = subprocess.run(["nm", "-D", INTERPOSE_SO], check=True,
+                         stdout=subprocess.PIPE, text=True).stdout
+    exported = {line.split()[-1] for line in out.splitlines()
+                if " T " in line}
+    for sym in ("read", "recv", "readv", "recvfrom", "recvmsg",
+                "accept", "accept4", "close"):
+        assert sym in exported, f"interpose.so missing {sym} hook"
+
+
+# -- spin-timeout visibility -----------------------------------------------
+
+def test_proxy_spin_timeouts_surface_in_daemon_stats():
+    """The proxy's give-up counter (shm->spin_timeouts, proxy.cpp
+    wait_released) reaches the daemon's stats within a tick."""
+    from apus_tpu.runtime.appcluster import ProxiedCluster, build_native
+    from apus_tpu.runtime.bridge import _OFF_SPIN_TIMEOUTS
+    build_native()
+    with ProxiedCluster(3) as pc:
+        leader = pc.leader_idx()
+        bridge = pc.bridges[leader]
+        # Simulate the proxy bumping the counter (a record it proceeded
+        # on without release).
+        with bridge._shm_lock:
+            bridge._shm_set(_OFF_SPIN_TIMEOUTS, 2)
+        daemon = pc.cluster.daemons[leader]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with daemon.lock:
+                if daemon.node.stats.get("proxy_spin_timeouts") == 2:
+                    break
+            time.sleep(0.02)
+        with daemon.lock:
+            assert daemon.node.stats.get("proxy_spin_timeouts") == 2
+
+
+# -- replay failure: bounded retry then re-prime ---------------------------
+
+class _FakeApp:
+    """Line-oriented app stand-in: accepts connections, records every
+    received line, replies ``OK``.  Can be stopped (connections die) and
+    restarted empty on the same port — a crashed-and-restarted app."""
+
+    def __init__(self):
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self.port = self._lsock.getsockname()[1]
+        self.lines: list[bytes] = []
+        self._stop = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._stop.clear()
+        self.lines = []
+        self._conns = []
+        if self._lsock is None:
+            self._lsock = socket.socket()
+            self._lsock.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEADDR, 1)
+            self._lsock.bind(("127.0.0.1", self.port))
+        self._lsock.listen(8)
+        self._lsock.settimeout(0.1)
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        self._thread = t
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        conn.settimeout(0.2)
+        buf = b""
+        while not self._stop.is_set():
+            try:
+                chunk = conn.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                self.lines.append(line)
+                try:
+                    conn.sendall(b"OK\n")
+                except OSError:
+                    return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._lsock is not None:
+            self._lsock.close()
+            self._lsock = None
+
+
+def test_replayer_reconnects_on_broken_socket():
+    """Transient socket break: the record lands via reconnect+resend,
+    no re-prime needed."""
+    app = _FakeApp()
+    app.start()
+    try:
+        r = Replayer("127.0.0.1", app.port)
+        r.connect_attempts = 5
+        r.start()
+        r.submit(1, 7, b"SET a 1\n")      # SEND on an implicit connection
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and b"SET a 1" not in app.lines:
+            time.sleep(0.02)
+        assert b"SET a 1" in app.lines
+        # Break the app-side sockets (but keep the app up): the next
+        # replay's first send hits a dead socket and must reconnect.
+        for c in app._conns:
+            c.close()
+        time.sleep(0.3)                   # let the FIN reach the replayer
+        r.submit(1, 7, b"SET b 2\n")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and b"SET b 2" not in app.lines:
+            time.sleep(0.02)
+        assert b"SET b 2" in app.lines
+        assert r.reprimes == 0
+        r.stop()
+    finally:
+        app.stop()
+
+
+def test_replayer_reprimes_restarted_app():
+    """App crash + restart: the failed record is NOT dropped — once the
+    app is back, the replayer rebuilds it from the full record history
+    (bounded retry, then snapshot-style re-prime)."""
+    app = _FakeApp()
+    app.start()
+    history = [(1, 7, b"SET a 1\n"), (1, 7, b"SET b 2\n"),
+               (1, 7, b"SET c 3\n")]
+    delivered: list[tuple[int, int, bytes]] = []
+
+    r = Replayer("127.0.0.1", app.port)
+    r.connect_attempts = 3                 # keep the app-down path fast
+    r.reprime_source = lambda: list(delivered)
+    r.start()
+    try:
+        delivered.append(history[0])
+        r.submit(*history[0])
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and b"SET a 1" not in app.lines:
+            time.sleep(0.02)
+        assert b"SET a 1" in app.lines
+
+        app.stop()                         # app crashes
+        time.sleep(0.3)                   # let the FIN reach the replayer
+        delivered.append(history[1])
+        r.submit(*history[1])              # fails after bounded retries
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not r.dirty:
+            time.sleep(0.05)
+        assert r.dirty and r.failed > 0
+
+        app.start()                        # app restarts EMPTY
+        delivered.append(history[2])
+        r.submit(*history[2])              # triggers re-prime first
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and b"SET c 3" not in app.lines:
+            time.sleep(0.05)
+        # The re-prime replayed the whole history — including the record
+        # that failed while the app was down — before the new one.
+        assert b"SET a 1" in app.lines
+        assert b"SET b 2" in app.lines
+        assert b"SET c 3" in app.lines
+        assert app.lines.index(b"SET b 2") < app.lines.index(b"SET c 3")
+        assert r.reprimes >= 1 and not r.dirty
+        r.stop()
+    finally:
+        app.stop()
