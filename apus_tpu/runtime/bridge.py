@@ -165,8 +165,14 @@ class Replayer:
             if self.dirty:
                 # A previous failure left the app diverged (re-prime
                 # attempted then failed too — app still down).  Retry
-                # the rebuild before applying anything newer.
+                # the rebuild; the current record is already part of
+                # the retained history the re-prime replays (it was
+                # applied to the relay SM before this upcall), so it is
+                # NEVER applied directly while dirty — landing it on an
+                # un-primed app would reorder it ahead of the missing
+                # prefix and freeze the divergence in.
                 self._reprime()
+                continue
             try:
                 self._replay(action, conn_id, data)
                 self.replayed += 1

@@ -10,8 +10,6 @@ amortized across rounds.  This mirrors how the reference reaches its
 own numbers: its RDMA commit loop keeps many unsignaled WRs outstanding
 and overlaps rounds in the NIC queue (post_send selective signaling,
 dare_ibv_rc.c:2552-2568); ours keeps the round loop in HBM/MXU-land.
-The single-dispatch (unpipelined) p50 is reported in ``detail`` — on a
-tunneled TPU it is dominated by host<->device RTT.
 
 Baseline: the reference repository publishes no numbers (BASELINE.md).
 We baseline against the DARE/APUS RDMA envelope of ~15 us per commit
@@ -22,16 +20,21 @@ nodes.local.cfg) — for a 64-entry batched round, per-entry cost
 than baseline).
 
 Robustness: this file is its own watchdog.  The parent process forks a
-child (same file, ``_APUS_BENCH_CHILD=1``) per backend attempt: first
-the default backend (TPU when present) under a hard timeout, then a
-``JAX_PLATFORMS=cpu`` fallback at reduced depth.  Whatever happens —
-TPU tunnel hang, backend init error, compile stall — the parent always
-prints exactly one JSON line and exits 0, with the backend that
-actually produced the number recorded in ``detail.backend``.
+child (same file, ``_APUS_BENCH_CHILD=1``) per backend attempt: TPU up to
+three times (the axon tunnel is intermittently degraded or wedged; a
+retry often lands in the fast state) under hard timeouts, then a
+forced-CPU fallback.  The child climbs a DEPTH LADDER (64 -> 256 -> 1024 rounds
+per dispatch), flushing a complete JSON headline after every depth — a
+watchdog kill mid-ladder still leaves the best completed number on
+stdout, and the parent takes the LAST JSON line.  Per-phase progress
+goes to stderr so a timeout is diagnosable (backend init vs compile vs
+execute).  The JAX persistent compilation cache turns repeat compiles
+into disk hits.
 
-Env knobs: APUS_BENCH_DEPTH (pipeline depth, default 1024 TPU / 64
-CPU), APUS_BENCH_BUDGET (total seconds, default 225),
-APUS_BENCH_TPU_TIMEOUT (first-attempt watchdog, default 150).
+Env knobs: APUS_BENCH_DEPTHS (comma ladder, default "64,256,1024" TPU /
+"64" CPU), APUS_BENCH_BUDGET (total seconds, default 225),
+APUS_BENCH_TPU_TIMEOUT (per-TPU-attempt watchdog, default 60),
+APUS_JAX_CACHE (compilation cache dir, default <repo>/.jax_cache).
 """
 
 from __future__ import annotations
@@ -45,12 +48,28 @@ import time
 import numpy as np
 
 BASELINE_ROUND_US = 15.0        # RDMA commit-round envelope (see docstring)
+_T0 = time.monotonic()
+
+
+def _mark(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _bench() -> None:
     """Child process: run the measurement on whatever backend JAX gives
-    us and print the JSON line.  May hang or die — the parent watches."""
+    us and print a JSON line per completed ladder depth.  May hang or
+    die — the parent watches and keeps the last flushed line."""
+    _mark("importing jax")
     import jax
+
+    cache = os.environ.get(
+        "APUS_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # The image's sitecustomize registers the axon (TPU) PJRT plugin
@@ -66,13 +85,18 @@ def _bench() -> None:
     from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
     from apus_tpu.ops.mesh import replica_mesh, replica_sharding
 
+    _mark("initializing backend")
     backend = jax.default_backend()
+    devices = jax.devices()
+    _mark(f"backend={backend} devices={devices}")
     cpu = backend == "cpu"
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
-    D = int(os.environ.get("APUS_BENCH_DEPTH", "64" if cpu else "1024"))
+    depths = [int(d) for d in os.environ.get(
+        "APUS_BENCH_DEPTHS", "64" if cpu else "64,256,1024").split(",")]
     dispatches = 5 if cpu else 10
     single_iters = 10 if cpu else 20
-    mesh = replica_mesh(R, devices=jax.devices()[:1])
+    deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
+    mesh = replica_mesh(R, devices=devices[:1])
     sh = replica_sharding(mesh)
     cid = Cid.initial(R)
 
@@ -83,29 +107,15 @@ def _bench() -> None:
     bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
     bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
     sdata, smeta = bdata[None], bmeta[None]     # one resident staged batch
+    _mark("staged batch placed on device")
 
-    # -- pipelined steady state (headline) --------------------------------
-    pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D,
-                                       staged_depth=1)
-    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1, sharding=sh)
-    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
-    devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)    # warmup
-    jax.block_until_ready(commits)
-    assert int(np.asarray(commits)[-1]) == 1 + D * B, "pipeline did not commit"
+    best = None            # (round_p50, depth, wall_p50, walls)
+    per_depth = {}
 
-    walls_us = []
-    for _ in range(dispatches):
-        t0 = time.perf_counter_ns()
-        devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
-        jax.block_until_ready(commits)
-        walls_us.append((time.perf_counter_ns() - t0) / 1e3)
-    walls_us.sort()
-    wall_p50 = walls_us[len(walls_us) // 2]
-    round_p50 = wall_p50 / D
-    per_entry_p50 = round_p50 / B
-    commits_per_sec = 1e6 / round_p50          # rounds (quorum commits)/sec
-
-    def emit(single_p50):
+    def emit(single_p50=None):
+        round_p50, D, wall_p50, _ = best
+        per_entry_p50 = round_p50 / B
+        commits_per_sec = 1e6 / round_p50      # rounds (quorum commits)/sec
         result = {
             "metric": "commit_round_p50_latency_batch64_5rep_pipelined",
             "value": round(round_p50, 3),
@@ -114,6 +124,8 @@ def _bench() -> None:
             "detail": {
                 "backend": backend,
                 "pipeline_depth": D,
+                "depth_ladder_round_p50_us": {
+                    str(d): round(v, 3) for d, v in per_depth.items()},
                 "dispatch_wall_p50_us": round(wall_p50, 1),
                 "single_dispatch_round_p50_us":
                     None if single_p50 is None else round(single_p50, 2),
@@ -126,17 +138,54 @@ def _bench() -> None:
         }
         print(json.dumps(result), flush=True)
 
-    # The headline is in hand — flush it NOW so a watchdog kill during the
-    # optional single-dispatch phase can't forfeit it (the parent parses
-    # the LAST JSON line, so the richer re-emit below supersedes this one).
-    emit(None)
+    # -- pipelined steady state (headline), climbing the depth ladder -----
+    for D in depths:
+        if deadline and time.time() > deadline - 15:
+            _mark(f"deadline near; stopping ladder before depth {D}")
+            break
+        t_c = time.monotonic()
+        pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D,
+                                           staged_depth=1)
+        devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                                 sharding=sh)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+        devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)   # compile
+        jax.block_until_ready(commits)
+        assert int(np.asarray(commits)[-1]) == 1 + D * B, \
+            "pipeline did not commit"
+        # One more chained warmup: feeding device-resident outputs back
+        # re-specializes the program once; measure after that.
+        devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+        jax.block_until_ready(commits)
+        _mark(f"depth={D}: compiled+warm in {time.monotonic() - t_c:.1f}s")
+        walls_us = []
+        for _ in range(dispatches):
+            t0 = time.perf_counter_ns()
+            devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
+            jax.block_until_ready(commits)
+            walls_us.append((time.perf_counter_ns() - t0) / 1e3)
+        walls_us.sort()
+        wall_p50 = walls_us[len(walls_us) // 2]
+        round_p50 = wall_p50 / D
+        per_depth[D] = round_p50
+        _mark(f"depth={D}: round p50 {round_p50:.2f}us "
+              f"(dispatch {wall_p50:.0f}us)")
+        if best is None or round_p50 < best[0]:
+            best = (round_p50, D, wall_p50, walls_us)
+        # Flush NOW: a watchdog kill later in the ladder must not
+        # forfeit this completed measurement (the parent parses the
+        # LAST JSON line, so deeper-ladder re-emits supersede).
+        emit()
+
+    if best is None:
+        return
 
     # -- single-dispatch round (for reference; RTT-dominated on tunnel) ---
     # Skipped when the watchdog deadline is near: a second slow compile
     # must not push the process into the kill window.
-    deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
     if deadline and time.time() > deadline - 30:
         return
+    _mark("measuring single-dispatch round")
     step = build_commit_step(mesh, R, S, SB, B, auto_advance=True)
     devlog1 = make_device_log(R, S, SB, batch=B, leader=0, term=1,
                               sharding=sh)
@@ -150,6 +199,7 @@ def _bench() -> None:
         jax.block_until_ready(commit)
         lat.append((time.perf_counter_ns() - t0) / 1e3)
     lat.sort()
+    _mark(f"single-dispatch round p50 {lat[len(lat) // 2]:.0f}us")
     emit(lat[len(lat) // 2])
 
 
@@ -168,8 +218,8 @@ def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
     except subprocess.TimeoutExpired as e:
         print(f"bench: attempt timed out after {timeout_s:.0f}s "
               f"(env={extra_env})", file=sys.stderr)
-        # The child flushes the headline JSON before any optional extra
-        # phases — a timeout may still have a valid result in its stdout.
+        # The child flushes a complete headline JSON after every ladder
+        # depth — a timeout may still have a valid result in its stdout.
         return _parse_last_json(e.stdout)
     except Exception as e:                       # noqa: BLE001 — must not die
         print(f"bench: attempt failed to launch: {e}", file=sys.stderr)
@@ -206,13 +256,17 @@ def main() -> None:
 
     t_start = time.monotonic()
     budget = float(os.environ.get("APUS_BENCH_BUDGET", "225"))
-    tpu_timeout = float(os.environ.get("APUS_BENCH_TPU_TIMEOUT", "150"))
+    tpu_timeout = float(os.environ.get("APUS_BENCH_TPU_TIMEOUT", "60"))
 
     attempts = []
     if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
-        attempts.append(({}, min(tpu_timeout, budget * 0.7)))
-    # CPU fallback: forced CPU backend (depth default is backend-keyed in
-    # the child: 1024 TPU / 64 CPU).
+        # Three TPU attempts: the axon tunnel is intermittently wedged
+        # or degraded, and a fresh process often lands in the fast state
+        # (a healthy tunnel yields the depth-64 headline within ~15 s).
+        for _ in range(3):
+            attempts.append(({}, min(tpu_timeout, budget * 0.3)))
+    # CPU fallback: forced CPU backend (depth ladder is backend-keyed in
+    # the child: 64,256,1024 TPU / 64 CPU).
     cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
     attempts.append((cpu_env, None))             # None = remaining budget
 

@@ -12,7 +12,10 @@ t0 = time.monotonic()
 def mark(msg):
     print(f"[probe +{time.monotonic()-t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
-cache = os.environ.get("APUS_JAX_CACHE", "/root/repo/.jax_cache")
+cache = os.environ.get(
+    "APUS_JAX_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
 mark("importing jax")
 import jax
 if cache:
