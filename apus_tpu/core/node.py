@@ -969,6 +969,14 @@ class Node:
         the leader removes the peer via a CONFIG entry."""
         if not self.cfg.auto_remove:
             return
+        if not self.t.peer_established(peer):
+            # Never reached at its current address: a cold-starting or
+            # still-joining member, not a failed one.  The reference can
+            # only see WC errors on QPs that completed connection setup;
+            # counting pre-establishment failures here would auto-remove
+            # slow-booting replicas (first dial + backoff can outlast
+            # PERMANENT_FAILURE * fail_window on process launch).
+            return
         if now - self._fail_last.get(peer, -1e9) < self.cfg.fail_window:
             return
         self._fail_last[peer] = now

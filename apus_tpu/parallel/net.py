@@ -237,12 +237,34 @@ class NetTransport(Transport):
         self._dialing: set[int] = set()
         self._dial_lock = threading.Lock()
         self._closed = False
+        # Peers successfully dialed at least once at their current
+        # address: the failure detector's eligibility set (see
+        # Transport.peer_established).  _first_dial records when we
+        # FIRST tried each address: a peer that stays unreachable past
+        # ``establish_grace`` counts as established-for-failure-purposes
+        # anyway, so a restarted leader (whose in-memory set starts
+        # empty) can still auto-remove a peer that died before the
+        # restart — the grace only shields cold-starting processes.
+        self._established: set[int] = set()
+        self._first_dial: dict[int, float] = {}
+        self.establish_grace = 10.0
+
+    def peer_established(self, target: int) -> bool:
+        if target in self._established:
+            return True
+        first = self._first_dial.get(target)
+        return (first is not None
+                and time.monotonic() - first > self.establish_grace)
 
     def set_peer(self, idx: int, addr: tuple[str, int]) -> None:
         """Register/replace a peer endpoint (membership change)."""
         self.peers[idx] = addr
         self._drop_conn(idx)
         self._down_until.pop(idx, None)
+        # New address, new eligibility: a member that moved (or a fresh
+        # joiner) must be reached once before its failures count.
+        self._established.discard(idx)
+        self._first_dial.pop(idx, None)
 
     def close(self) -> None:
         with self._dial_lock:
@@ -278,17 +300,23 @@ class NetTransport(Transport):
 
     def _dial(self, target: int) -> None:
         addr = self.peers.get(target)
+        self._first_dial.setdefault(target, time.monotonic())
         try:
             conn = socket.create_connection(addr, timeout=self.timeout)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(self.timeout)
             with self._dial_lock:
                 # Paired with close(): _closed is set under this lock,
-                # so we cannot insert into a closed transport.
-                if self._closed:
+                # so we cannot insert into a closed transport.  Also
+                # re-check the peer table: a set_peer() that raced this
+                # dial means ``conn`` reaches the OLD address — installing
+                # it would both talk to a stale endpoint and wrongly mark
+                # the NEW address established.
+                if self._closed or self.peers.get(target) != addr:
                     conn.close()
                 else:
                     self._conns[target] = conn
+                    self._established.add(target)
         except OSError:
             self._down_until[target] = time.monotonic() + self.backoff
         finally:

@@ -112,6 +112,15 @@ class Transport:
     — failures feed the failure detector exactly like CTRL-QP work-
     completion errors do in the reference (dare_ibv_rc.c:2747-2749)."""
 
+    def peer_established(self, target: int) -> bool:
+        """Whether this transport has EVER reached ``target`` at its
+        current address.  The failure detector only counts failures for
+        established peers — the reference's analog is that WC errors can
+        only occur on QPs that completed bootstrap connection setup
+        (dare_ibv_rc.c:2747-2749); a cold-starting cluster member that
+        has not come up yet must not be auto-removed as "failed"."""
+        return True
+
     # control plane -------------------------------------------------------
     def ctrl_write(self, target: int, region: Region, slot: int,
                    value: Any) -> WriteResult:

@@ -30,6 +30,7 @@ ST_ERROR = wire.ST_ERROR
 
 OP_CLT_WRITE = 16
 OP_CLT_READ = 17
+OP_STATUS = 18
 
 ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
@@ -83,7 +84,52 @@ def make_client_ops(daemon) -> dict:
                     return wire.u8(ST_TIMEOUT)
                 daemon.commit_cond.wait(min(left, 0.05))
 
-    return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read}
+    def status(r: wire.Reader) -> bytes:
+        """Observability probe (ops tooling / process launchers): role,
+        term, log offsets — the information run.sh greps out of server
+        logs ("[T%d] LEADER" banners, run.sh:46-68), as a queryable op."""
+        import json
+        with daemon.lock:
+            n = daemon.node
+            st = {
+                "idx": daemon.idx,
+                "role": n.role.name,
+                "is_leader": n.is_leader,
+                "term": n.current_term,
+                "leader_hint": n.leader_hint,
+                "commit": n.log.commit,
+                "apply": n.log.apply,
+                "end": n.log.end,
+                "epoch": n.cid.epoch,
+                "group_size": n.cid.size,
+                "members": [i for i in range(n.cid.extended_group_size)
+                            if n.cid.contains(i)],
+            }
+        return wire.u8(wire.ST_OK) + wire.blob(json.dumps(st).encode())
+
+    return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read,
+            OP_STATUS: status}
+
+
+def probe_status(addr: str, timeout: float = 0.5) -> Optional[dict]:
+    """One-shot status query against a daemon's peer port.  Returns the
+    parsed status dict, or None if the daemon is unreachable."""
+    import json
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(wire.frame(wire.u8(OP_STATUS)))
+            resp = wire.read_frame(conn)
+    except (OSError, ConnectionError, ValueError):
+        return None
+    if not resp or resp[0] != wire.ST_OK:
+        return None
+    try:
+        return json.loads(wire.Reader(resp[1:]).blob().decode())
+    except (ValueError, KeyError):
+        return None
 
 
 def _not_leader(daemon) -> bytes:

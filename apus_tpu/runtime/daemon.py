@@ -62,7 +62,12 @@ class ReplicaDaemon:
         self._tick_interval = tick_interval
 
         peers = {i: _parse_peer(a) for i, a in enumerate(spec.peers)}
-        self.transport = NetTransport(peers, yield_lock=self.lock)
+        # Dial backoff scaled to the timing envelope: at the production
+        # envelope (hb=1 ms) a 0.5 s backoff would leave a transiently
+        # unreachable peer unreplicated for hundreds of heartbeats.
+        self.transport = NetTransport(
+            peers, yield_lock=self.lock,
+            backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)))
         cfg = NodeConfig(
             idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
@@ -268,3 +273,250 @@ class ReplicaDaemon:
                 if left <= 0:
                     return False
                 self.commit_cond.wait(min(left, 0.05))
+
+
+# -- CLI: one replica as a standalone OS process ---------------------------
+#
+# The reference deploys one server process per machine (benchmarks/
+# run.sh:23-31 over ssh), configured by env vars (server_idx/group_size/
+# server_type/config_path/dare_log_file, proxy.c:22-89) plus a shared
+# config file.  This CLI is that contract: `python -m
+# apus_tpu.runtime.daemon --idx I --config cluster.json ...` runs ONE
+# replica — daemon + (optionally) bridge + app-under-interposer — until
+# SIGTERM.  Multi-host deployment = run it on each host with the same
+# config; the local multi-process launcher is apus_tpu.runtime.proc.
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    import json as _json
+    import os
+    import shlex
+    import signal
+    import subprocess
+    import sys
+
+    from apus_tpu.utils.config import ProcessEnv, load_config
+
+    env = ProcessEnv.from_env()
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.runtime.daemon",
+        description="One APUS-TPU replica as a standalone process.")
+    ap.add_argument("--idx", type=int, default=env.server_idx,
+                    help="replica slot (env APUS_SERVER_IDX)")
+    ap.add_argument("--config", default=env.config_path,
+                    help="ClusterSpec JSON: peers, timing "
+                         "(env APUS_CONFIG)")
+    ap.add_argument("--join", action="store_true",
+                    default=env.server_type == "join",
+                    help="join a RUNNING cluster instead of starting as "
+                         "a static member (env APUS_SERVER_TYPE=join); "
+                         "--idx is ignored, the leader assigns the slot")
+    ap.add_argument("--join-addr", default=None,
+                    help="with --join: bind this host:port instead of an "
+                         "ephemeral one (a recovered server re-joining "
+                         "at its original endpoint)")
+    ap.add_argument("--db-dir", default=os.environ.get("APUS_DB_DIR"),
+                    help="durable-store directory (restart recovery)")
+    ap.add_argument("--log-file", default=env.log_file,
+                    help="daemon log (env APUS_LOG_FILE)")
+    ap.add_argument("--workdir", default=os.environ.get("APUS_WORKDIR"),
+                    help="bridge shm/socket dir; enables the app bridge")
+    ap.add_argument("--app", default=os.environ.get("APUS_APP"),
+                    help="app argv to launch under interpose.so (port "
+                         "appended, run.sh style); requires --workdir")
+    ap.add_argument("--app-port", type=int,
+                    default=int(os.environ.get("APUS_APP_PORT", "0")) or None)
+    ap.add_argument("--spin-timeout-ms", type=int, default=8000)
+    ap.add_argument("--tick-interval", type=float, default=0.0005)
+    ap.add_argument("--ready-file", default=None,
+                    help="write a JSON readiness record here once serving")
+    args = ap.parse_args(argv)
+
+    spec = load_config(args.config)
+    sm = None
+    bridged = args.workdir is not None
+    if bridged:
+        from apus_tpu.runtime.bridge import RelayStateMachine
+        sm = RelayStateMachine()
+        if args.app and args.app_port is None:
+            import socket as _socket
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                args.app_port = s.getsockname()[1]
+
+    if args.join:
+        import socket as _socket
+
+        from apus_tpu.parallel.net import PeerServer
+        from apus_tpu.runtime.membership import request_join
+        if args.join_addr:
+            host, port_s = args.join_addr.rsplit(":", 1)
+            sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            sock.bind((host, int(port_s)))
+        else:
+            sock = PeerServer.reserve()
+        host, port = sock.getsockname()
+        my_addr = f"{host}:{port}"
+        slot, cid, peers = request_join(
+            [p for p in spec.peers if p], my_addr)
+        spec.peers = list(peers)
+        while len(spec.peers) <= slot:
+            spec.peers.append("")
+        spec.peers[slot] = my_addr
+        daemon = ReplicaDaemon(slot, spec, sm=sm, cid=cid,
+                               listen_sock=sock, recovery_start=True,
+                               tick_interval=args.tick_interval,
+                               log_file=args.log_file, db_dir=args.db_dir)
+    else:
+        daemon = ReplicaDaemon(args.idx, spec, sm=sm,
+                               tick_interval=args.tick_interval,
+                               log_file=args.log_file, db_dir=args.db_dir,
+                               recovery_start=bool(
+                                   args.db_dir
+                                   and daemon_store_exists(args.db_dir,
+                                                           args.idx)))
+
+    bridge = None
+    app_proc = None
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    daemon.start()
+    try:
+        if bridged:
+            from apus_tpu.runtime.bridge import Bridge, proxy_env
+            bridge = Bridge(daemon, args.workdir, app_port=args.app_port)
+            bridge.start()
+            if args.app:
+                app_argv = shlex.split(args.app) + [str(args.app_port)]
+                app_env = dict(os.environ)
+                app_env.update(proxy_env(
+                    bridge,
+                    log_path=os.path.join(args.workdir,
+                                          f"proxy{daemon.idx}.log"),
+                    spin_timeout_ms=args.spin_timeout_ms))
+                app_proc = subprocess.Popen(app_argv, env=app_env)
+
+        addr = f"{daemon.server.addr[0]}:{daemon.server.addr[1]}"
+        ready = {"idx": daemon.idx, "addr": addr, "pid": os.getpid(),
+                 "app_port": args.app_port if bridged else None}
+        if args.ready_file:
+            tmp = args.ready_file + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump(ready, f)
+            os.replace(tmp, args.ready_file)
+        print(f"APUS-READY {_json.dumps(ready)}", flush=True)
+
+        # Removal self-detection (DARE recovery semantics): a replica
+        # that the failure detector removed while it was down/partitioned
+        # receives nothing ever again — PreVote keeps it from even
+        # bumping its term.  If our state makes no progress while some
+        # peer IS a leader whose membership excludes us, re-enter the
+        # group through the join protocol at our own endpoint.
+        last_progress = None
+        progress_t = time.monotonic()
+        last_probe = 0.0
+        while not stop_evt.is_set():
+            if app_proc is not None and app_proc.poll() is not None:
+                daemon.logger.error("app exited rc=%d; shutting down",
+                                    app_proc.returncode)
+                return 1
+            now = time.monotonic()
+            with daemon.lock:
+                progress = (daemon.node.current_term, daemon.node.log.commit,
+                            daemon.node.is_leader)
+                hb_age = now - daemon.node._last_hb_seen
+            if progress != last_progress:
+                last_progress, progress_t = progress, now
+            # "Stalled" keys off heartbeat age, not just state change:
+            # an idle-but-led follower hears the leader every hb_period
+            # and must never start probing peers.
+            stalled = (not progress[2] and now - progress_t > 3.0
+                       and hb_age > 3.0)
+            if stalled and now - last_probe > 0.5:
+                last_probe = now
+                if _excluded_by_live_leader(daemon, spec):
+                    daemon.logger.error(
+                        "removed from the group (a live leader excludes "
+                        "slot %d); re-joining at %s", daemon.idx,
+                        spec.peers[daemon.idx])
+                    my_addr = spec.peers[daemon.idx]
+                    # Full teardown, then re-exec in join mode at the
+                    # same endpoint (the recovered-server path).
+                    if app_proc is not None and app_proc.poll() is None:
+                        app_proc.terminate()
+                        try:
+                            app_proc.wait(timeout=3.0)
+                        except subprocess.TimeoutExpired:
+                            app_proc.kill()
+                        app_proc = None
+                    if bridge is not None:
+                        bridge.stop()
+                        bridge = None
+                    daemon.stop()
+                    rejoin = [sys.executable, "-m",
+                              "apus_tpu.runtime.daemon",
+                              "--join", "--join-addr", my_addr]
+                    for flag, val in [
+                            ("--config", args.config),
+                            ("--db-dir", args.db_dir),
+                            ("--log-file", args.log_file),
+                            ("--workdir", args.workdir),
+                            ("--app", args.app),
+                            ("--ready-file", args.ready_file)]:
+                        if val:
+                            rejoin += [flag, val]
+                    if args.app_port:
+                        rejoin += ["--app-port", str(args.app_port)]
+                    rejoin += ["--spin-timeout-ms",
+                               str(args.spin_timeout_ms),
+                               "--tick-interval", str(args.tick_interval)]
+                    os.execv(sys.executable, rejoin)
+            stop_evt.wait(0.2)
+        return 0
+    finally:
+        if app_proc is not None and app_proc.poll() is None:
+            app_proc.terminate()
+            try:
+                app_proc.wait(timeout=3.0)
+            except subprocess.TimeoutExpired:
+                app_proc.kill()
+        if bridge is not None:
+            bridge.stop()
+        daemon.stop()
+
+
+def daemon_store_exists(db_dir: str, idx: int) -> bool:
+    import os
+
+    from apus_tpu.runtime.persist import daemon_store_path
+    return os.path.exists(daemon_store_path(db_dir, idx))
+
+
+def _excluded_by_live_leader(daemon: "ReplicaDaemon", spec) -> bool:
+    """True iff some reachable peer is a leader (at a term >= ours)
+    whose membership does NOT contain our slot — the affirmative signal
+    that the failure detector removed us.  A mere partition (no leader
+    reachable, or a leader that still lists us) never triggers."""
+    from apus_tpu.runtime.client import probe_status
+    my_addr = spec.peers[daemon.idx] if daemon.idx < len(spec.peers) else ""
+    for addr in spec.peers:
+        if not addr or addr == my_addr:
+            continue
+        st = probe_status(addr, timeout=0.3)
+        if (st is not None and st.get("is_leader")
+                and st.get("term", 0) >= daemon.node.current_term
+                and daemon.idx not in st.get("members", [])):
+            return True
+    return False
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

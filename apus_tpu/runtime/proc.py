@@ -1,0 +1,336 @@
+"""Process-per-replica deployment: the run.sh launcher, locally.
+
+The reference deploys one server process per machine over ssh
+(benchmarks/run.sh:23-31) — consensus never shares an address space
+with another replica.  The thread-based LocalCluster/ProxiedCluster are
+hermetic test rigs; THIS module is the deployment shape: every replica
+is its own OS process (`python -m apus_tpu.runtime.daemon`), with its
+own interpreter and GIL, its own durable store, its own bridge + app.
+Multi-host deployment is the same CLI with the same config file on each
+host; ProcCluster is the local N-process launcher (and the harness the
+failover benchmarks use).
+
+Because replicas no longer contend on one GIL, the timing envelope
+tightens from the thread-cluster DEBUG values (hb=10 ms,
+elect=150-400 ms; appcluster.PROXIED_SPEC) to the reference's
+production envelope (hb=1 ms, elect=10-30 ms, nodes.local.cfg:22-37) —
+PROC_SPEC below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional, Sequence
+
+from apus_tpu.runtime.client import probe_status
+from apus_tpu.utils.config import ClusterSpec
+
+#: Production timing envelope (nodes.local.cfg:22-37): hb=1 ms,
+#: elect=10-30 ms.  Viable here because each replica process owns its
+#: interpreter — the tick thread is never starved by sibling replicas.
+PROC_SPEC = ClusterSpec(hb_period=0.001, hb_timeout=0.010,
+                        elect_low=0.010, elect_high=0.030,
+                        fail_window=0.100)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ProcCluster:
+    """N replica processes on this host (the run.sh:23-31 analog).
+
+    ``app_argv=None`` runs bare consensus daemons (DARE mode: clients
+    use ApusClient against the peer ports).  ``app_argv=[...]`` runs an
+    unmodified app under interpose.so per replica (APUS mode), port
+    appended run.sh-style; ``app_argv="toyserver"`` uses the bundled
+    native toy KV server.
+    """
+
+    def __init__(self, n: int, app_argv: Optional[Sequence[str] | str] = None,
+                 workdir: Optional[str] = None,
+                 spec: Optional[ClusterSpec] = None,
+                 db: bool = True,
+                 spin_timeout_ms: int = 8000):
+        self.n = n
+        self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
+        os.makedirs(self.workdir, exist_ok=True)
+        base = dataclasses.replace(spec or PROC_SPEC)
+        base.group_size = n
+        base.peers = [f"127.0.0.1:{_free_port()}" for _ in range(n)]
+        self.spec = base
+        self.config_path = os.path.join(self.workdir, "cluster.json")
+        with open(self.config_path, "w") as f:
+            json.dump(dataclasses.asdict(base), f, indent=1)
+
+        if app_argv == "toyserver":
+            from apus_tpu.runtime.appcluster import TOYSERVER, build_native
+            build_native()
+            app_argv = [TOYSERVER]
+        self._app_argv = (list(app_argv)
+                          if app_argv is not None else None)
+        self._spin_timeout_ms = spin_timeout_ms
+        self._db = db
+        self.app_ports: list[Optional[int]] = [
+            _free_port() if app_argv is not None else None
+            for _ in range(n)]
+        self.procs: list[Optional[subprocess.Popen]] = [None] * n
+        self._logs: list = [None] * n
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, timeout: float = 30.0) -> None:
+        for i in range(self.n):
+            self._spawn(i)
+        deadline = time.monotonic() + timeout
+        for i in range(self.n):
+            self._wait_ready(i, deadline)
+
+    def _spawn(self, i: int) -> None:
+        argv = [sys.executable, "-m", "apus_tpu.runtime.daemon",
+                "--idx", str(i),
+                "--config", self.config_path,
+                "--log-file", os.path.join(self.workdir, f"srv{i}.log"),
+                "--ready-file", self._ready_path(i)]
+        if self._db:
+            argv += ["--db-dir", os.path.join(self.workdir, "db")]
+        if self._app_argv is not None:
+            argv += ["--workdir", self.workdir,
+                     "--app", shlex.join(self._app_argv),
+                     "--app-port", str(self.app_ports[i]),
+                     "--spin-timeout-ms", str(self._spin_timeout_ms)]
+        if self._logs[i] is None:
+            self._logs[i] = open(
+                os.path.join(self.workdir, f"proc{i}.out"), "ab")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in [os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                env.get("PYTHONPATH")] if p])
+        # One process group per replica: kill() takes down the daemon
+        # AND its app child in one signal, like a machine crash.
+        self.procs[i] = subprocess.Popen(
+            argv, env=env, stdout=self._logs[i], stderr=subprocess.STDOUT,
+            start_new_session=True)
+
+    def _ready_path(self, i: int) -> str:
+        return os.path.join(self.workdir, f"ready{i}.json")
+
+    def _wait_ready(self, i: int, deadline: float) -> dict:
+        path = self._ready_path(i)
+        while time.monotonic() < deadline:
+            p = self.procs[i]
+            if p is not None and p.poll() is not None:
+                raise AssertionError(
+                    f"replica process {i} exited rc={p.returncode} "
+                    f"before READY (see {self.workdir}/proc{i}.out)")
+            if os.path.exists(path):
+                with open(path) as f:
+                    return json.load(f)
+            time.sleep(0.02)
+        raise AssertionError(f"replica process {i} not ready in time")
+
+    def stop(self) -> None:
+        for i, p in enumerate(self.procs):
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (OSError, ProcessLookupError):
+                    p.terminate()
+        for i, p in enumerate(self.procs):
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    p.kill()
+                p.wait(timeout=3.0)
+            self.procs[i] = None
+        for i, f in enumerate(self._logs):
+            if f is not None:
+                f.close()
+                self._logs[i] = None
+
+    def __enter__(self) -> "ProcCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- fault injection --------------------------------------------------
+
+    def kill(self, idx: int) -> None:
+        """Machine-crash a replica: SIGKILL its whole process group
+        (daemon + app), no shutdown handshake (reconf_bench.sh:100-117)."""
+        p = self.procs[idx]
+        if p is None:
+            return
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            p.kill()
+        try:
+            p.wait(timeout=3.0)
+        except subprocess.TimeoutExpired:
+            pass
+        self.procs[idx] = None
+        try:
+            os.unlink(self._ready_path(idx))
+        except OSError:
+            pass
+
+    def restart(self, idx: int, timeout: float = 30.0) -> dict:
+        """Restart a killed replica at its original endpoint (durable
+        store replay + catch-up)."""
+        assert self.procs[idx] is None, "kill before restart"
+        self._spawn(idx)
+        return self._wait_ready(idx, time.monotonic() + timeout)
+
+    def add_replica(self, timeout: float = 30.0) -> int:
+        """Grow the group: spawn a NEW process that runs the join
+        protocol against the current leader (`--join`; the AddServer /
+        Upsize scenario, reconf_bench.sh:147-180).  Returns the slot the
+        leader assigned."""
+        i = len(self.procs)
+        self.procs.append(None)
+        self.app_ports.append(
+            _free_port() if self._app_argv is not None else None)
+        self._logs.append(None)
+        argv = [sys.executable, "-m", "apus_tpu.runtime.daemon",
+                "--join",
+                "--config", self.config_path,
+                "--log-file", os.path.join(self.workdir, f"srv-join{i}.log"),
+                "--ready-file", self._ready_path(i)]
+        if self._db:
+            argv += ["--db-dir", os.path.join(self.workdir, "db")]
+        if self._app_argv is not None:
+            argv += ["--workdir", self.workdir,
+                     "--app", shlex.join(self._app_argv),
+                     "--app-port", str(self.app_ports[i]),
+                     "--spin-timeout-ms", str(self._spin_timeout_ms)]
+        self._logs[i] = open(
+            os.path.join(self.workdir, f"proc-join{i}.out"), "ab")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in [os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                env.get("PYTHONPATH")] if p])
+        self.procs[i] = subprocess.Popen(
+            argv, env=env, stdout=self._logs[i], stderr=subprocess.STDOUT,
+            start_new_session=True)
+        ready = self._wait_ready(i, time.monotonic() + timeout)
+        slot = ready["idx"]
+        # Mirror the joiner's endpoint into our local peer view (live
+        # members learned it from the replicated CONFIG entry).
+        while len(self.spec.peers) <= slot:
+            self.spec.peers.append("")
+        self.spec.peers[slot] = ready["addr"]
+        if slot != i:
+            # Slot reuse (joiner filled a removed member's slot): keep
+            # proc bookkeeping aligned with slots.
+            self.procs[slot], self.procs[i] = self.procs[i], None
+            self.app_ports[slot] = self.app_ports[i]
+        return slot
+
+    # -- queries ----------------------------------------------------------
+
+    def status(self, idx: int, timeout: float = 0.5) -> Optional[dict]:
+        return probe_status(self.spec.peers[idx], timeout=timeout)
+
+    def leader_idx(self, timeout: float = 15.0) -> int:
+        """Index of the (single) live leader, polled over the wire."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = []
+            for i in range(len(self.spec.peers)):
+                if self.procs[i] is None:
+                    continue
+                st = self.status(i, timeout=0.3)
+                if st is not None and st.get("is_leader"):
+                    leaders.append((i, st["term"]))
+            if len(leaders) == 1:
+                return leaders[0][0]
+            if len(leaders) > 1:
+                # Two banners can coexist briefly across a term bump;
+                # trust the higher term only once it is unique.
+                time.sleep(0.01)
+                continue
+            time.sleep(0.01)
+        raise AssertionError("no stable leader within timeout")
+
+    def app_addr(self, idx: int) -> tuple[str, int]:
+        assert self.app_ports[idx] is not None
+        return ("127.0.0.1", self.app_ports[idx])
+
+    def measure_failover(self, timeout: float = 15.0) -> float:
+        """Kill the current leader and return seconds until a NEW leader
+        is elected and answering status (reconf_bench.sh leader-failure
+        scenario).  With PROC_SPEC this lands in the tens of
+        milliseconds — the envelope the reference achieves with hb=1 ms
+        / elect=10-30 ms."""
+        victim = self.leader_idx()
+        t0 = time.monotonic()
+        self.kill(victim)
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            for i in range(len(self.spec.peers)):
+                if i == victim or self.procs[i] is None:
+                    continue
+                st = self.status(i, timeout=0.2)
+                if st is not None and st.get("is_leader"):
+                    return time.monotonic() - t0
+            time.sleep(0.002)
+        raise AssertionError("no new leader after killing the old one")
+
+
+def main(argv: Optional[list] = None) -> int:
+    """`python -m apus_tpu.runtime.proc`: bring up N replica processes,
+    print status, and keep running until Ctrl-C (a local stand-in for
+    the reference's ssh fan-out in run.sh)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m apus_tpu.runtime.proc")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--app", default=None,
+                    help='app argv, or "toyserver" for the bundled one')
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    app = args.app
+    if app is not None and app != "toyserver":
+        app = shlex.split(app)
+    pc = ProcCluster(args.replicas, app_argv=app, workdir=args.workdir)
+    pc.start()
+    try:
+        leader = pc.leader_idx()
+        print(f"cluster up: {args.replicas} replica processes, "
+              f"leader={leader}, workdir={pc.workdir}")
+        for i in range(args.replicas):
+            print(f"  replica {i}: peer={pc.spec.peers[i]} "
+                  f"app_port={pc.app_ports[i]} "
+                  f"pid={pc.procs[i].pid if pc.procs[i] else None}")
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        pc.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
