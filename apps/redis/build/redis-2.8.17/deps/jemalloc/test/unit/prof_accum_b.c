@@ -1,0 +1,3 @@
+#include "prof_accum.h"
+
+alloc_n_gen(1)

@@ -1,0 +1,1 @@
+# Test runtime reconfiguration command SENTINEL SET.

@@ -179,6 +179,93 @@ class ProxiedCluster:
         raise AssertionError("no stable leadership for a full write round")
 
 
+#: Pinned unmodified redis (the reference's flagship app, apps/redis/mk)
+#: built by apps/redis/mk; ./run launches it under the interposer.
+REDIS_RUN = os.path.join(REPO_ROOT, "apps", "redis", "run")
+REDIS_SERVER = os.path.join(REPO_ROOT, "apps", "redis", "build",
+                            "redis-2.8.17", "src", "redis-server")
+
+
+def build_redis() -> bool:
+    """Build the pinned redis from the vendored third-party tarball
+    (apps/redis/mk).  Returns False when neither a built binary nor the
+    tarball is available (callers skip redis-specific paths)."""
+    if os.path.exists(REDIS_SERVER):
+        return True
+    mk = os.path.join(REPO_ROOT, "apps", "redis", "mk")
+    try:
+        subprocess.run([mk], check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
+        return False
+    return os.path.exists(REDIS_SERVER)
+
+
+class RespClient:
+    """Minimal RESP (redis protocol) client — the redis-benchmark stand-
+    in for driving SET/GET at a replicated redis (run.sh:70-80)."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 10.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    def cmd(self, *args: str | bytes):
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a.encode() if isinstance(a, str) else a
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(out))
+        return self._reply()
+
+    def _line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis closed connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis closed connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _reply(self):
+        line = self._line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RuntimeError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self._exact(n)
+            self._exact(2)                       # trailing CRLF
+            return data
+        if t == b"*":
+            return [self._reply() for _ in range(int(rest))]
+        raise RuntimeError(f"bad RESP type byte {t!r}")
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self) -> "RespClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class LineClient:
     """Tiny line-protocol client for toyserver-style apps."""
 

@@ -339,10 +339,8 @@ def main(argv: Optional[list] = None) -> int:
         from apus_tpu.runtime.bridge import RelayStateMachine
         sm = RelayStateMachine()
         if args.app and args.app_port is None:
-            import socket as _socket
-            with _socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                args.app_port = s.getsockname()[1]
+            from apus_tpu.runtime.appcluster import free_port
+            args.app_port = free_port()
 
     if args.join:
         import socket as _socket
@@ -449,13 +447,8 @@ def main(argv: Optional[list] = None) -> int:
                     my_addr = spec.peers[daemon.idx]
                     # Full teardown, then re-exec in join mode at the
                     # same endpoint (the recovered-server path).
-                    if app_proc is not None and app_proc.poll() is None:
-                        app_proc.terminate()
-                        try:
-                            app_proc.wait(timeout=3.0)
-                        except subprocess.TimeoutExpired:
-                            app_proc.kill()
-                        app_proc = None
+                    _stop_app(app_proc)
+                    app_proc = None
                     if bridge is not None:
                         bridge.stop()
                         bridge = None
@@ -481,15 +474,20 @@ def main(argv: Optional[list] = None) -> int:
             stop_evt.wait(0.2)
         return 0
     finally:
-        if app_proc is not None and app_proc.poll() is None:
-            app_proc.terminate()
-            try:
-                app_proc.wait(timeout=3.0)
-            except subprocess.TimeoutExpired:
-                app_proc.kill()
+        _stop_app(app_proc)
         if bridge is not None:
             bridge.stop()
         daemon.stop()
+
+
+def _stop_app(app_proc) -> None:
+    import subprocess
+    if app_proc is not None and app_proc.poll() is None:
+        app_proc.terminate()
+        try:
+            app_proc.wait(timeout=3.0)
+        except subprocess.TimeoutExpired:
+            app_proc.kill()
 
 
 def daemon_store_exists(db_dir: str, idx: int) -> bool:

@@ -30,6 +30,7 @@ import tempfile
 import time
 from typing import Optional, Sequence
 
+from apus_tpu.runtime.appcluster import free_port as _free_port
 from apus_tpu.runtime.client import probe_status
 from apus_tpu.utils.config import ClusterSpec
 
@@ -39,13 +40,6 @@ from apus_tpu.utils.config import ClusterSpec
 PROC_SPEC = ClusterSpec(hb_period=0.001, hb_timeout=0.010,
                         elect_low=0.010, elect_high=0.030,
                         fail_window=0.100)
-
-
-def _free_port() -> int:
-    import socket
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 class ProcCluster:
@@ -91,18 +85,58 @@ class ProcCluster:
     # -- lifecycle --------------------------------------------------------
 
     def start(self, timeout: float = 30.0) -> None:
-        for i in range(self.n):
-            self._spawn(i)
-        deadline = time.monotonic() + timeout
-        for i in range(self.n):
-            self._wait_ready(i, deadline)
+        # Port allocation is bind-then-close (_free_port): a child can
+        # lose the EADDRINUSE race against an unrelated process.  One
+        # full retry with fresh ports covers that rare loss.
+        for attempt in (0, 1):
+            try:
+                for i in range(self.n):
+                    self._spawn(i)
+                deadline = time.monotonic() + timeout
+                for i in range(self.n):
+                    self._wait_ready(i, deadline)
+                for i in range(self.n):
+                    self._wait_app(i, deadline)
+                return
+            except AssertionError:
+                if attempt == 1:
+                    raise
+                self.stop()
+                self.spec.peers = [f"127.0.0.1:{_free_port()}"
+                                   for _ in range(self.n)]
+                self.app_ports = [
+                    _free_port() if self._app_argv is not None else None
+                    for _ in range(self.n)]
+                with open(self.config_path, "w") as f:
+                    json.dump(dataclasses.asdict(self.spec), f, indent=1)
 
-    def _spawn(self, i: int) -> None:
+    def _wait_app(self, i: int, deadline: float) -> None:
+        """Block until replica i's app (launched by the daemon process)
+        accepts connections."""
+        import socket
+        if self.app_ports[i] is None:
+            return
+        while time.monotonic() < deadline:
+            p = self.procs[i]
+            if p is not None and p.poll() is not None:
+                raise AssertionError(
+                    f"replica process {i} died while its app was "
+                    f"starting (see {self.workdir}/proc{i}.out)")
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", self.app_ports[i]), timeout=0.5):
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise AssertionError(f"app of replica {i} did not come up")
+
+    def _spawn(self, i: int, join: bool = False) -> None:
+        tag = f"join{i}" if join else str(i)
         argv = [sys.executable, "-m", "apus_tpu.runtime.daemon",
-                "--idx", str(i),
                 "--config", self.config_path,
-                "--log-file", os.path.join(self.workdir, f"srv{i}.log"),
+                "--log-file", os.path.join(self.workdir, f"srv{tag}.log"),
                 "--ready-file", self._ready_path(i)]
+        argv += ["--join"] if join else ["--idx", str(i)]
         if self._db:
             argv += ["--db-dir", os.path.join(self.workdir, "db")]
         if self._app_argv is not None:
@@ -112,12 +146,19 @@ class ProcCluster:
                      "--spin-timeout-ms", str(self._spin_timeout_ms)]
         if self._logs[i] is None:
             self._logs[i] = open(
-                os.path.join(self.workdir, f"proc{i}.out"), "ab")
+                os.path.join(self.workdir, f"proc{tag}.out"), "ab")
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(
             [p for p in [os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))),
                 env.get("PYTHONPATH")] if p])
+        # A stale ready file (unclean previous run in a reused workdir,
+        # or a restart) would make _wait_ready return before the daemon
+        # is actually up.
+        try:
+            os.unlink(self._ready_path(i))
+        except OSError:
+            pass
         # One process group per replica: kill() takes down the daemon
         # AND its app child in one signal, like a machine crash.
         self.procs[i] = subprocess.Popen(
@@ -211,28 +252,7 @@ class ProcCluster:
         self.app_ports.append(
             _free_port() if self._app_argv is not None else None)
         self._logs.append(None)
-        argv = [sys.executable, "-m", "apus_tpu.runtime.daemon",
-                "--join",
-                "--config", self.config_path,
-                "--log-file", os.path.join(self.workdir, f"srv-join{i}.log"),
-                "--ready-file", self._ready_path(i)]
-        if self._db:
-            argv += ["--db-dir", os.path.join(self.workdir, "db")]
-        if self._app_argv is not None:
-            argv += ["--workdir", self.workdir,
-                     "--app", shlex.join(self._app_argv),
-                     "--app-port", str(self.app_ports[i]),
-                     "--spin-timeout-ms", str(self._spin_timeout_ms)]
-        self._logs[i] = open(
-            os.path.join(self.workdir, f"proc-join{i}.out"), "ab")
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in [os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))),
-                env.get("PYTHONPATH")] if p])
-        self.procs[i] = subprocess.Popen(
-            argv, env=env, stdout=self._logs[i], stderr=subprocess.STDOUT,
-            start_new_session=True)
+        self._spawn(i, join=True)
         ready = self._wait_ready(i, time.monotonic() + timeout)
         slot = ready["idx"]
         # Mirror the joiner's endpoint into our local peer view (live

@@ -145,7 +145,13 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.proc:
-        r = proc_fail_leader(max(args.replicas, 5), rounds=2)
+        n = args.replicas
+        if n < 3:
+            print(f"--proc needs >=3 replicas; using 3 (got {n})",
+                  file=sys.stderr)
+            n = 3
+        rounds = max(1, (n - 1) // 2)   # kills we can absorb w/ quorum
+        r = proc_fail_leader(n, rounds=rounds)
         print(f"{r['metric']:<36}{r['value']:>10}  {r['unit']}")
         print(json.dumps(r))
         return 0

@@ -27,7 +27,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster  # noqa: E402
+from apus_tpu.runtime.appcluster import (LineClient,  # noqa: E402
+                                         ProxiedCluster, RespClient)
 
 
 def percentile(sorted_us: list[float], q: float) -> float:
@@ -36,7 +37,44 @@ def percentile(sorted_us: list[float], q: float) -> float:
     return sorted_us[min(len(sorted_us) - 1, int(len(sorted_us) * q))]
 
 
-def drive(pc: ProxiedCluster, op: str, requests: int, clients: int,
+class LineDriver:
+    """toyserver-style line protocol."""
+
+    make = staticmethod(lambda addr: LineClient(addr, timeout=30.0))
+
+    @staticmethod
+    def set(c, key, value):
+        return c.cmd(f"SET {key} {value}") == "OK"
+
+    @staticmethod
+    def get(c, key):
+        return c.cmd(f"GET {key}")
+
+    @staticmethod
+    def count(c):
+        return c.cmd("COUNT")
+
+
+class RespDriver:
+    """redis protocol (the redis-benchmark -t set,get shape,
+    run.sh:70-80)."""
+
+    make = staticmethod(lambda addr: RespClient(addr, timeout=30.0))
+
+    @staticmethod
+    def set(c, key, value):
+        return c.cmd("SET", key, value) == "OK"
+
+    @staticmethod
+    def get(c, key):
+        return c.cmd("GET", key)
+
+    @staticmethod
+    def count(c):
+        return c.cmd("DBSIZE")
+
+
+def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
           value: str) -> dict:
     """C client threads, each issuing requests/C ops at the leader app."""
     leader = pc.leader_idx()
@@ -47,15 +85,23 @@ def drive(pc: ProxiedCluster, op: str, requests: int, clients: int,
 
     def worker(ci: int) -> None:
         try:
-            c = LineClient(addr, timeout=30.0)
+            c = drv.make(addr)
             for i in range(per_client):
                 key = f"bench:{ci}:{i}"
-                line = (f"SET {key} {value}" if op == "set"
-                        else f"GET {key}")
                 t0 = time.perf_counter_ns()
-                reply = c.cmd(line)
+                try:
+                    if op == "set":
+                        ok = drv.set(c, key, value)
+                    else:
+                        drv.get(c, key)
+                        ok = True
+                except RuntimeError:
+                    # App-level error reply (e.g. redis -ERR): count it
+                    # and keep driving — only transport failures abort
+                    # this worker.
+                    ok = False
                 lat_us[ci].append((time.perf_counter_ns() - t0) / 1e3)
-                if op == "set" and reply != "OK":
+                if not ok:
                     errors[ci] += 1
             c.close()
         except (OSError, ConnectionError):
@@ -96,6 +142,11 @@ def main() -> int:
     ap.add_argument("--app", default=None,
                     help="app argv (default: native toyserver); the app "
                          "gets the port appended, run.sh style")
+    ap.add_argument("--redis", action="store_true",
+                    help="drive the pinned unmodified redis "
+                         "(apps/redis/run, RESP protocol) — the "
+                         "reference's flagship benchmark shape "
+                         "(redis-benchmark -t set,get, run.sh:70-80)")
     ap.add_argument("--device-plane", action="store_true",
                     help="replicate through the jitted device commit "
                          "step (runtime.device_plane); host TCP stays "
@@ -104,26 +155,35 @@ def main() -> int:
 
     value = "x" * args.value_bytes
     app_argv = args.app.split() if args.app else None
+    drv = LineDriver
+    if args.redis:
+        from apus_tpu.runtime.appcluster import REDIS_RUN, build_redis
+        if not build_redis():
+            print("pinned redis unavailable (no tarball, no binary)",
+                  file=sys.stderr)
+            return 2
+        app_argv = [REDIS_RUN]
+        drv = RespDriver
 
     with ProxiedCluster(args.replicas, app_argv=app_argv,
                         device_plane=args.device_plane) as pc:
-        results = [drive(pc, "set", args.requests, args.clients, value),
-                   drive(pc, "get", args.requests, args.clients, value)]
+        results = [drive(pc, drv, "set", args.requests, args.clients, value),
+                   drive(pc, drv, "get", args.requests, args.clients, value)]
 
         # Replication check: every live replica's app converges to the
         # same key count (GET-after-SET on all replicas, run.sh's
         # correctness criterion).
         leader = pc.leader_idx()
-        with LineClient(pc.app_addr(leader)) as c:
-            want = c.cmd("COUNT")
+        with drv.make(pc.app_addr(leader)) as c:
+            want = drv.count(c)
         counts = {}
         deadline = time.monotonic() + 15.0
         for i in range(args.replicas):
             if pc.apps[i] is None:
                 continue
             while time.monotonic() < deadline:
-                with LineClient(pc.app_addr(i)) as c:
-                    counts[i] = c.cmd("COUNT")
+                with drv.make(pc.app_addr(i)) as c:
+                    counts[i] = drv.count(c)
                 if counts[i] == want:
                     break
                 time.sleep(0.2)

@@ -77,7 +77,9 @@ def test_proc_cluster_proxied_apps_replicate(tmp_path):
                 if counts[i] == "10":
                     break
                 time.sleep(0.1)
-        assert all(v == "10" for v in counts.values()), counts
+        # Every replica must have been verified — a missing key means
+        # the deadline expired before that replica's poll loop ran.
+        assert counts == {0: "10", 1: "10", 2: "10"}, counts
 
         t = pc.measure_failover()
         assert t < 5.0
