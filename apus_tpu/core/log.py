@@ -18,7 +18,7 @@ this structure is a pair of dense arrays ``[n_slots, slot_bytes] u8`` +
 
 Oversized requests (up to MAX_REQUEST_BYTES, message.h:7) are segmented
 across consecutive slots by the proxy layer and reassembled on apply
-(see apus_tpu.proxy.segment).
+(see apus_tpu.core.segment).
 
 Invariants (checked by ``check()``)::
 

@@ -38,6 +38,7 @@ from apus_tpu.core.quorum import have_majority
 from apus_tpu.core.sid import AtomicSid, Sid
 from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, MAX_SERVER_COUNT,
                                  PERMANENT_FAILURE, EntryType, Role)
+from apus_tpu.core import segment
 from apus_tpu.models.sm import Snapshot, StateMachine
 from apus_tpu.parallel.transport import (Region, Regions, Transport,
                                          WriteResult)
@@ -77,6 +78,13 @@ class NodeConfig:
     # participation for the same reason (dare_server.c:738-745).  A
     # fallback timeout preserves liveness when the whole group restarts.
     recovery_start: bool = False
+    # Record segmentation (core.segment): commands larger than this are
+    # split into chunk entries at submit and reassembled at apply, so
+    # the reference's full 87,380 B request envelope (message.h:7) fits
+    # the fixed-slot device plane (DeviceCommitRunner.max_data_bytes is
+    # the sizing contract).  0 disables splitting (payloads ride whole,
+    # device-ineligible when oversized).
+    seg_chunk: int = 0
 
 
 @dataclasses.dataclass
@@ -102,6 +110,9 @@ class PendingRequest:
     data: bytes
     idx: Optional[int] = None         # log index once appended
     reply: Optional[bytes] = None     # SM reply once applied
+    #: Earlier chunk payloads of a segmented record (core.segment),
+    #: consumed by _drain_pending ahead of ``data`` (the final chunk).
+    chunks: Optional[list[bytes]] = None
 
 
 class Node:
@@ -147,6 +158,9 @@ class Node:
         self._inflight: dict[tuple[int, int], PendingRequest] = {}
         self._pending_reads: list[PendingRead] = []
         self.epdb = EndpointDB()
+        # Segmented-record reassembly (core.segment): apply-side chunk
+        # buffer, deterministic across replicas.
+        self._seg = segment.Reassembler()
         # Leadership proofs are ordered by a registration COUNTER, not
         # the tick clock: a proof stamped at tick-time T could tie with
         # a read registered between ticks and be mistaken for "after".
@@ -253,6 +267,20 @@ class Node:
         if existing is not None:
             return existing
         pr = PendingRequest(req_id, clt_id, data)
+        if self.cfg.seg_chunk > 0 and len(data) > self.cfg.seg_chunk:
+            parts = segment.split(data, self.cfg.seg_chunk,
+                                  clt_id, req_id)
+            pr.chunks, pr.data = parts[:-1], parts[-1]
+            self.stats["seg_split"] = self.stats.get("seg_split", 0) + 1
+        else:
+            # Magic-prefix escape runs UNCONDITIONALLY (even with
+            # splitting disabled): the apply path treats any MAGIC-
+            # prefixed payload as a chunk envelope, so a colliding raw
+            # payload must always be wrapped or apply would parse
+            # garbage out of it.
+            wrapped = segment.maybe_wrap(data, clt_id, req_id)
+            if wrapped is not None:
+                pr.data = wrapped
         self._pending.append(pr)
         self._inflight[key] = pr
         return pr
@@ -325,7 +353,7 @@ class Node:
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
 
-    def make_snapshot(self) -> tuple[Snapshot, list, Cid, dict]:
+    def make_snapshot(self) -> Optional[tuple[Snapshot, list, Cid, dict]]:
         """Snapshot at the current apply point: SM state, endpoint-DB
         dump (exactly-once state must travel with the SM state), plus
         the configuration at that point — CONFIG entries inside the
@@ -341,6 +369,13 @@ class Node:
         if self._snap_cache is not None and \
                 self._snap_cache[0].last_idx + 1 >= self.log.head:
             return self._snap_cache
+        # Segmentation gate: never cut a snapshot while a chunk group is
+        # in flight at the apply point — the installer would receive the
+        # group's final chunk with its early chunks below the snapshot
+        # (seg_incomplete).  Stale orphans (finals truncated away long
+        # ago) don't block: groups complete within ~max_batch entries.
+        if self._seg.active_since(self.log.apply - 4 * self.cfg.max_batch):
+            return None
         last_idx, last_term = self._applied_det
         snap = self.sm.create_snapshot(last_idx, last_term)
         self._snap_cache = (snap, self.epdb.dump(), self.cid,
@@ -360,6 +395,7 @@ class Node:
             return False                     # we already have more
         self.sm.apply_snapshot(snap)
         self.epdb.load(ep_dump)
+        self._seg = segment.Reassembler()    # chunk buffer is pre-snapshot
         self.log.reset(snap.last_idx + 1)
         self._applied_det = (snap.last_idx, snap.last_term)
         self._snap_cache = None
@@ -716,9 +752,19 @@ class Node:
         """tailq drain -> log append (get_tailq_message,
         dare_ibv_ud.c:780-790)."""
         for pr in self._pending:
-            if pr.idx is None and not self.log.is_full:
-                pr.idx = self.log.append(my.term, req_id=pr.req_id,
-                                         clt_id=pr.clt_id, data=pr.data)
+            if pr.idx is not None:
+                continue
+            # Segmented record: earlier chunks first, as anonymous
+            # entries ((0,0) skips per-entry dedup/reply — those fire
+            # once, on the final chunk which carries the real ids).
+            # Consumed destructively so a log-full pause resumes where
+            # it left off instead of re-appending chunks.
+            while pr.chunks and not self.log.is_full:
+                self.log.append(my.term, data=pr.chunks.pop(0))
+            if pr.chunks or self.log.is_full:
+                continue
+            pr.idx = self.log.append(my.term, req_id=pr.req_id,
+                                     clt_id=pr.clt_id, data=pr.data)
         self._pending = [p for p in self._pending
                          if p.idx is None or p.idx >= self.log.commit]
 
@@ -778,7 +824,10 @@ class Node:
                 # (leader-driven form of rc_recover_sm, the reference's
                 # joiner instead RDMA-reads it, dare_ibv_rc.c:603-689),
                 # then resume log replication just past it.
-                snap, ep_dump, snap_cid, members = self.make_snapshot()
+                made = self.make_snapshot()
+                if made is None:
+                    continue        # mid-group gate; retry next tick
+                snap, ep_dump, snap_cid, members = made
                 res = self.t.snap_push(peer, my, snap, ep_dump,
                                        snap_cid, members)
                 if res == WriteResult.OK:
@@ -1032,12 +1081,46 @@ class Node:
                 # starting at 1).
                 dup = (e.req_id > 0 and
                        self.epdb.duplicate_of_applied(e.clt_id, e.req_id))
+                data = e.data
+                if segment.is_chunk(data):
+                    if dup:
+                        # Logical record already applied in a previous
+                        # incarnation: discard any buffered chunks.
+                        self._seg.prune(e.clt_id, e.req_id)
+                        data = None
+                    else:
+                        final, full = self._seg.feed(data, e.idx)
+                        if not final:
+                            # Intermediate chunk: buffered only; the SM,
+                            # dedup, reply, and upcalls all fire on the
+                            # final chunk with the reassembled record.
+                            self._applied_det = e.determinant()
+                            self.log.advance_apply(e.idx + 1)
+                            self.stats["applied"] += 1
+                            continue
+                        if full is None:
+                            # Early chunks below an installed snapshot
+                            # point — cannot happen while make_snapshot
+                            # gates on in-flight groups; surface loudly
+                            # if it ever does.
+                            self.stats["seg_incomplete"] = \
+                                self.stats.get("seg_incomplete", 0) + 1
+                            data = None
+                        else:
+                            data = full
                 if dup:
                     reply = dup.last_reply
+                elif data is None:
+                    reply = b""
                 else:
-                    reply = self.sm.apply(e.idx, e.data)
+                    reply = self.sm.apply(e.idx, data)
                     self.epdb.note_applied(e.clt_id, e.req_id, e.idx, reply)
-                    self.committed_upcalls.append(e)
+                    # Upcalls observe the LOGICAL record (reassembled
+                    # payload), never envelope chunks — persistence and
+                    # proxy replay stay segmentation-oblivious.
+                    self.committed_upcalls.append(
+                        e if data is e.data
+                        else dataclasses.replace(e, data=data))
                 pr = self._inflight.pop((e.clt_id, e.req_id), None)
                 if pr is not None:
                     # Sentinel contract: reply stays None until THIS

@@ -1,0 +1,135 @@
+"""Record segmentation: oversized commands as device-eligible chunks.
+
+The reference's request envelope is TCP-rcvbuf-sized — records up to
+87,380 B (/root/reference/src/include/dare/message.h:7; apus_wire.h
+keeps the constant) ride whole through its byte-ring log.  Our fixed-
+slot device log carries at most ``slot_bytes`` (4 KiB) of wire-encoded
+entry per row (ops.logplane), so a large record must be CUT into chunk
+entries at submit and REASSEMBLED into one logical record at apply:
+
+- ``split()`` wraps each chunk in a small envelope carrying the real
+  ``(clt_id, req_id)`` of the logical record plus ``(seq, total)``;
+  every chunk then travels as an ordinary log entry — replicated,
+  quorum-committed, and device-plane-eligible like any other.  Chunk
+  entries other than the last carry ``(clt_id=0, req_id=0)`` so the
+  endpoint-DB dedup and reply machinery fire exactly once, on the FINAL
+  chunk, which carries the real ids (core.node.submit).
+- ``Reassembler.feed()`` buffers chunks by ``(clt_id, req_id)`` and
+  yields the full payload when the final chunk applies.  Chunks
+  overwrite by ``seq``, which makes a group idempotent across the
+  failover-retry shape: a half-appended group truncated by an election
+  is simply overwritten by the client's retry at the new leader — and
+  exactly-once still holds because the dedup decision rides the final
+  chunk's real ``(clt_id, req_id)`` (apply-time dedup, node.py).
+
+Any payload that happens to START with the envelope magic is escaped by
+wrapping it as a single-chunk group (``maybe_wrap``) so the apply path
+can treat the magic prefix as authoritative.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+#: Envelope magic: an improbable prefix for real client payloads
+#: (escaped via maybe_wrap when it does occur).
+MAGIC = b"\xa5SG1"
+_HDR = struct.Struct("<4sQQII")      # magic | clt_id | req_id | seq | total
+OVERHEAD = _HDR.size
+
+#: The reference's maximum request record (message.h:7).
+MAX_RECORD = 87380
+
+
+def is_chunk(payload: bytes) -> bool:
+    return payload.startswith(MAGIC) and len(payload) >= _HDR.size
+
+
+def parse(payload: bytes) -> tuple[int, int, int, int, bytes]:
+    """-> (clt_id, req_id, seq, total, piece)."""
+    magic, clt, req, seq, total = _HDR.unpack_from(payload, 0)
+    return clt, req, seq, total, payload[_HDR.size:]
+
+
+def _wrap(clt_id: int, req_id: int, seq: int, total: int,
+          piece: bytes) -> bytes:
+    return _HDR.pack(MAGIC, clt_id, req_id, seq, total) + piece
+
+
+def split(data: bytes, chunk: int, clt_id: int,
+          req_id: int) -> list[bytes]:
+    """Cut ``data`` into envelope-wrapped pieces of at most ``chunk``
+    payload bytes each (at least one)."""
+    assert chunk > 0
+    pieces = [data[o:o + chunk] for o in range(0, len(data), chunk)] \
+        or [b""]
+    total = len(pieces)
+    return [_wrap(clt_id, req_id, k, total, p)
+            for k, p in enumerate(pieces)]
+
+
+def maybe_wrap(data: bytes, clt_id: int, req_id: int) -> Optional[bytes]:
+    """Escape a real payload that collides with the magic prefix by
+    wrapping it as a single-chunk group; None when no escape needed."""
+    if data.startswith(MAGIC):
+        return _wrap(clt_id, req_id, 0, 1, data)
+    return None
+
+
+class Reassembler:
+    """Apply-side chunk buffer.  Deterministic across replicas: all
+    replicas apply the same entries in the same order, so all complete
+    groups at the same final-chunk index.
+
+    A group whose final chunk was truncated by an election is orphaned
+    (its client's retry runs under a new capture id); orphans are
+    bounded by ``MAX_GROUPS`` LRU eviction and, being stale, stop
+    blocking snapshots once the apply point moves past them
+    (``active_since``)."""
+
+    MAX_GROUPS = 4096
+
+    def __init__(self) -> None:
+        #: key -> (seq -> piece, last_fed_apply_idx)
+        self._groups: dict[tuple[int, int],
+                           tuple[dict[int, bytes], int]] = {}
+
+    @property
+    def pending(self) -> int:
+        return len(self._groups)
+
+    def active_since(self, min_idx: int) -> bool:
+        """True if some group was fed at apply index >= min_idx — an
+        in-flight group.  Snapshot gating (core.node.make_snapshot):
+        a snapshot cut mid-group would strand the joiner with finals
+        whose early chunks are below the snapshot point; stale orphans
+        (final truncated away) must NOT block snapshots forever."""
+        return any(last >= min_idx for _, last in self._groups.values())
+
+    def feed(self, payload: bytes, idx: int) -> tuple[bool, Optional[bytes]]:
+        """Absorb one applied chunk (``idx`` = its log index).  Returns
+        (final, full_payload): ``final`` is True when this chunk closes
+        its group — then ``full_payload`` is the reassembled record, or
+        None if earlier chunks are missing (only possible after an
+        ill-gated snapshot install; counted by the caller)."""
+        clt, req, seq, total, piece = parse(payload)
+        key = (clt, req)
+        entry = self._groups.get(key)
+        group = entry[0] if entry is not None else {}
+        group[seq] = piece
+        if seq != total - 1:
+            self._groups[key] = (group, idx)
+            if len(self._groups) > self.MAX_GROUPS:
+                oldest = min(self._groups, key=lambda k: self._groups[k][1])
+                self._groups.pop(oldest, None)
+            return False, None
+        self._groups.pop(key, None)
+        if len(group) != total:
+            return True, None
+        return True, b"".join(group[k] for k in range(total))
+
+    def prune(self, clt_id: int, req_id: int) -> None:
+        """Drop a buffered group (its final chunk was deduplicated —
+        the logical record already applied in a previous incarnation)."""
+        self._groups.pop((clt_id, req_id), None)

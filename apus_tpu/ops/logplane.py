@@ -108,7 +108,7 @@ def host_batch_to_device(requests: list[bytes], slot_bytes: int,
 
     Returns (batch_data [B, SB] u8, batch_meta [B, 4] i32, n_valid).
     batch_meta columns: (req_id, clt_id, type, len).  Oversized payloads
-    must already be segmented (apus_tpu.proxy.segment).
+    must already be segmented (apus_tpu.core.segment, applied in core.node.submit).
     """
     b = len(requests) if batch_size is None else batch_size
     assert len(requests) <= b

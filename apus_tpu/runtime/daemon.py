@@ -74,7 +74,11 @@ class ReplicaDaemon:
             elect_high=spec.elect_high, prune_period=spec.prune_period,
             max_batch=spec.max_batch, auto_remove=spec.auto_remove,
             fail_window=spec.fail_window, recovery_start=recovery_start,
-            seed=seed)
+            seed=seed,
+            # Segment oversized records so every entry stays device-
+            # eligible (slot_bytes minus wire-codec + envelope headroom;
+            # DeviceCommitRunner.max_data_bytes is the contract).
+            seg_chunk=max(0, spec.slot_bytes - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
         # Fresh-start grace: randomize the first election timeout so a

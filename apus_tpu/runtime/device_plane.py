@@ -60,7 +60,7 @@ Safety arguments (the seams that matter):
    easier.  This matches the reference's window: RDMA acks are also
    trusted until QP retry exhaustion flags the peer.
 
-Oversized records (> slot width, pending apus_tpu.runtime.segment) make
+Oversized records (> slot width; none once core.segment is enabled) make
 a round device-ineligible: the driver falls back to host-path commit for
 that span and re-bases the device plane past it.
 """

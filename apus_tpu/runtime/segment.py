@@ -1,0 +1,11 @@
+"""Segmentation layer — re-export of :mod:`apus_tpu.core.segment`.
+
+The codec and reassembler live in ``core`` because the split/reassemble
+points are inside the protocol node (submit and apply,
+core.node); this module keeps the promised ``apus_tpu.runtime.segment``
+name for runtime-level callers and docs.
+"""
+
+from apus_tpu.core.segment import (MAGIC, MAX_RECORD, OVERHEAD,  # noqa: F401
+                                   Reassembler, is_chunk, maybe_wrap,
+                                   parse, split)

@@ -27,14 +27,24 @@ from apus_tpu.runtime.appcluster import (REDIS_RUN, ProxiedCluster,
                                          build_redis)
 from apus_tpu.runtime.proc import ProcCluster
 
-pytestmark = pytest.mark.skipif(not build_redis(),
-                                reason="pinned redis unavailable "
-                                       "(no tarball, no built binary)")
+import os
+
+_TARBALL = os.environ.get("APUS_REDIS_TARBALL",
+                          "/root/reference/apps/redis/redis-2.8.17.tar.gz")
+_BUILT = os.path.join(os.path.dirname(REDIS_RUN), "build", "redis-2.8.17",
+                      "src", "redis-server")
+# Collection-time check stays CHEAP (existence only); the actual build
+# (up to minutes) happens in the module fixture, not at collection.
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(_BUILT) or os.path.exists(_TARBALL)),
+    reason="pinned redis unavailable (no tarball, no built binary)")
 
 
 @pytest.fixture(scope="module", autouse=True)
 def native():
     build_native()
+    if not build_redis():
+        pytest.skip("pinned redis failed to build")
 
 
 def _wait_key(addr, key: str, want: bytes, timeout: float = 15.0):
