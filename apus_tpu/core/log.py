@@ -101,6 +101,14 @@ class SlotLog:
     def is_full(self) -> bool:
         return self.end - self.head >= self.n_slots
 
+    def near_full(self, headroom: int = 2) -> bool:
+        """Full up to a reserve of ``headroom`` slots.  Client-entry
+        appends stop HERE, not at is_full: a log driven completely full
+        would have no slot left for the HEAD (pruning) entry that frees
+        space — a permanent wedge (pruning itself appends,
+        log_pruning dare_server.c:1996-2067)."""
+        return self.end - self.head >= self.n_slots - headroom
+
     @property
     def tail(self) -> int:
         """Index of the last entry (or head-1 if empty)."""

@@ -152,6 +152,7 @@ class Node:
         self._fail_last: dict[int, float] = {}    # last counted failure time
         self._pending_head: Optional[int] = None  # HEAD entry in flight
         self._term_start_idx = 0                  # idx of our term's blank entry
+        self._term_blank_pending = False          # deferred by a full log
 
         # client requests + endpoint db (dare_ep_db.c analog)
         self._pending: list[PendingRequest] = []
@@ -342,8 +343,8 @@ class Node:
         else:
             slot = self.cid.size
             new_cid = self.cid.extend(self.cid.size + 1).with_server(slot)
-        if self.log.is_full:
-            return None
+        if self.log.near_full(1):
+            return None     # reserve the last slot for the HEAD entry
         pj = PendingJoin(addr=addr, slot=slot)
         pj.entry_idx = self.log.append(
             self.sid.sid.term, type=EntryType.CONFIG, cid=new_cid,
@@ -352,6 +353,10 @@ class Node:
         return pj
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
+
+    #: Snapshot gate quiet window (seconds of tick clock): a chunk group
+    #: fed more recently than this blocks make_snapshot.
+    SEG_SNAPSHOT_QUIET = 2.0
 
     def make_snapshot(self) -> Optional[tuple[Snapshot, list, Cid, dict]]:
         """Snapshot at the current apply point: SM state, endpoint-DB
@@ -372,9 +377,10 @@ class Node:
         # Segmentation gate: never cut a snapshot while a chunk group is
         # in flight at the apply point — the installer would receive the
         # group's final chunk with its early chunks below the snapshot
-        # (seg_incomplete).  Stale orphans (finals truncated away long
-        # ago) don't block: groups complete within ~max_batch entries.
-        if self._seg.active_since(self.log.apply - 4 * self.cfg.max_batch):
+        # (seg_incomplete).  Time-aged (tick clock): stale orphans whose
+        # final an election truncated must not block snapshots forever,
+        # even on a quiescent cluster (see Reassembler.active_within).
+        if self._seg.active_within(self._now, self.SEG_SNAPSHOT_QUIET):
             return None
         last_idx, last_term = self._applied_det
         snap = self.sm.create_snapshot(last_idx, last_term)
@@ -513,15 +519,34 @@ class Node:
         # absolute-index log always does.  Append a blank entry so commit
         # can advance in the new term (NOOP/CONFIG append on win,
         # dare_server.c:1412-1491): if a resize is mid-flight, continue it.
+        self._append_term_start(my)
+
+    def _append_term_start(self, my: Sid) -> None:
+        """Blank/CONFIG entry opening our term.  Deferred (retried each
+        leader tick) when the log is transiently full at election — the
+        old term's HEAD entry may still be in flight; reads stay gated
+        on _term_start_idx + 1 until the blank lands."""
+        if self.log.near_full(1):
+            # Respect the HEAD reserve: the blank must never consume the
+            # last slot, or _maybe_prune could never append the HEAD
+            # entry that frees space (permanent wedge).  Deferral is
+            # safe — the HEAD entry is itself a current-term entry, so
+            # commit can advance and pruning can run before the blank.
+            self._term_start_idx = self.log.end
+            self._term_blank_pending = True
+            return
         if self.cid.state == CidState.EXTENDED:
             self._term_start_idx = self.log.append(
-                my.term, type=EntryType.CONFIG, cid=self.cid.to_transit())
+                my.term, type=EntryType.CONFIG,
+                cid=self.cid.to_transit())
         elif self.cid.state == CidState.TRANSIT:
             self._term_start_idx = self.log.append(
-                my.term, type=EntryType.CONFIG, cid=self.cid.stabilize())
+                my.term, type=EntryType.CONFIG,
+                cid=self.cid.stabilize())
         else:
-            self._term_start_idx = self.log.append(my.term,
-                                                   type=EntryType.NOOP)
+            self._term_start_idx = self.log.append(
+                my.term, type=EntryType.NOOP)
+        self._term_blank_pending = False
 
     def become_follower(self, leader_sid: Sid, now: float) -> None:
         """server_to_follower analog (dare_server.h:200)."""
@@ -725,6 +750,8 @@ class Node:
 
     def _leader_tick(self, now: float) -> None:
         my = self.sid.sid
+        if self._term_blank_pending:
+            self._append_term_start(my)
         # Step down if a higher term appeared (hb_send_cb step-down check,
         # dare_server.c:927-993).
         hb = self.regions.ctrl[Region.HB]
@@ -758,10 +785,13 @@ class Node:
             # entries ((0,0) skips per-entry dedup/reply — those fire
             # once, on the final chunk which carries the real ids).
             # Consumed destructively so a log-full pause resumes where
-            # it left off instead of re-appending chunks.
-            while pr.chunks and not self.log.is_full:
+            # it left off instead of re-appending chunks.  near_full
+            # (not is_full): client entries must leave slots for the
+            # HEAD entry pruning appends, or a filled log can never be
+            # pruned again.
+            while pr.chunks and not self.log.near_full(3):
                 self.log.append(my.term, data=pr.chunks.pop(0))
-            if pr.chunks or self.log.is_full:
+            if pr.chunks or self.log.near_full(3):
                 continue
             pr.idx = self.log.append(my.term, req_id=pr.req_id,
                                      clt_id=pr.clt_id, data=pr.data)
@@ -933,8 +963,8 @@ class Node:
             a = acks[m]
             if a is None or a < self.log.commit:
                 return
-        if self.log.is_full:
-            return
+        if self.log.near_full(1):
+            return          # reserve the last slot for the HEAD entry
         self.log.append(my.term, type=EntryType.CONFIG,
                         cid=self.cid.to_transit())
         self._transit_pending = True
@@ -1034,7 +1064,7 @@ class Node:
         if n >= PERMANENT_FAILURE and self.cid.contains(peer):
             in_flight = any(e.type == EntryType.CONFIG
                             for e in self.log.entries(self.log.apply))
-            if not in_flight and not self.log.is_full:
+            if not in_flight and not self.log.near_full(1):
                 # Epoch bump: every membership-changing CONFIG must be
                 # ordered; an unbumped removal would share an epoch with
                 # a later join and leave replicas with incomparable cids.
@@ -1058,7 +1088,12 @@ class Node:
             if a is None:
                 return
             floor = min(floor, a)
-        if floor > self.log.head and not self.log.is_empty:
+        if floor > self.log.head and not self.log.is_empty \
+                and not self.log.is_full:
+            # is_full can only be transient here: every other append
+            # class stops at a reserve (clients 3, CONFIG 1), so a full
+            # log means a HEAD is already in flight — whose apply frees
+            # space — and we retry next prune tick.
             self.log.append(my.term, type=EntryType.HEAD, head=floor)
             self._pending_head = floor
 
@@ -1089,7 +1124,7 @@ class Node:
                         self._seg.prune(e.clt_id, e.req_id)
                         data = None
                     else:
-                        final, full = self._seg.feed(data, e.idx)
+                        final, full = self._seg.feed(data, self._now)
                         if not final:
                             # Intermediate chunk: buffered only; the SM,
                             # dedup, reply, and upcalls all fire on the
@@ -1199,7 +1234,7 @@ class Node:
                       # (_maybe_advance_resize)
             elif new_cid.state == CidState.TRANSIT:
                 self._transit_pending = False
-                if not self.log.is_full:
+                if not self.log.near_full(1):
                     self.log.append(self.sid.sid.term,
                                     type=EntryType.CONFIG,
                                     cid=new_cid.stabilize())

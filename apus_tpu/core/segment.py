@@ -91,24 +91,30 @@ class Reassembler:
     MAX_GROUPS = 4096
 
     def __init__(self) -> None:
-        #: key -> (seq -> piece, last_fed_apply_idx)
+        #: key -> (seq -> piece, last_fed_tick_time)
         self._groups: dict[tuple[int, int],
-                           tuple[dict[int, bytes], int]] = {}
+                           tuple[dict[int, bytes], float]] = {}
 
     @property
     def pending(self) -> int:
         return len(self._groups)
 
-    def active_since(self, min_idx: int) -> bool:
-        """True if some group was fed at apply index >= min_idx — an
-        in-flight group.  Snapshot gating (core.node.make_snapshot):
-        a snapshot cut mid-group would strand the joiner with finals
-        whose early chunks are below the snapshot point; stale orphans
-        (final truncated away) must NOT block snapshots forever."""
-        return any(last >= min_idx for _, last in self._groups.values())
+    def active_within(self, now: float, window: float) -> bool:
+        """True if some group was fed within the last ``window`` seconds
+        of tick time — an in-flight group.  Snapshot gating
+        (core.node.make_snapshot): a snapshot cut mid-group would strand
+        the installer with finals whose early chunks are below the
+        snapshot point.  A group can only complete-from-the-log shortly
+        after its last chunk applied (chunks append contiguously), so
+        TIME-aging lets stale orphans (final truncated by an election,
+        client gone) stop blocking snapshots even on a quiescent cluster
+        — where apply-progress-based aging would block forever."""
+        return any(last > now - window
+                   for _, last in self._groups.values())
 
-    def feed(self, payload: bytes, idx: int) -> tuple[bool, Optional[bytes]]:
-        """Absorb one applied chunk (``idx`` = its log index).  Returns
+    def feed(self, payload: bytes,
+             now: float = 0.0) -> tuple[bool, Optional[bytes]]:
+        """Absorb one applied chunk (``now`` = the tick clock).  Returns
         (final, full_payload): ``final`` is True when this chunk closes
         its group — then ``full_payload`` is the reassembled record, or
         None if earlier chunks are missing (only possible after an
@@ -119,7 +125,7 @@ class Reassembler:
         group = entry[0] if entry is not None else {}
         group[seq] = piece
         if seq != total - 1:
-            self._groups[key] = (group, idx)
+            self._groups[key] = (group, now)
             if len(self._groups) > self.MAX_GROUPS:
                 oldest = min(self._groups, key=lambda k: self._groups[k][1])
                 self._groups.pop(oldest, None)

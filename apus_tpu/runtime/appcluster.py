@@ -184,6 +184,10 @@ class ProxiedCluster:
 REDIS_RUN = os.path.join(REPO_ROOT, "apps", "redis", "run")
 REDIS_SERVER = os.path.join(REPO_ROOT, "apps", "redis", "build",
                             "redis-2.8.17", "src", "redis-server")
+#: Default tarball location (apps/redis/mk reads the same env knob).
+REDIS_TARBALL = os.environ.get(
+    "APUS_REDIS_TARBALL",
+    "/root/reference/apps/redis/redis-2.8.17.tar.gz")
 
 
 def build_redis() -> bool:

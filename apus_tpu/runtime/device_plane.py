@@ -508,7 +508,7 @@ class DevicePlaneDriver:
         # NOOPs too, dare_log.h:22).  A backlog >= B needs no padding —
         # the round takes B real entries from dev_next.
         if end - self._dev_next < B:
-            while (node.log.end - 1) % B != 0 and not node.log.is_full:
+            while (node.log.end - 1) % B != 0 and not node.log.near_full(2):
                 node.log.append(term, type=EntryType.NOOP)
             if (node.log.end - 1) % B != 0:
                 return False               # log full: wait for pruning
@@ -566,7 +566,7 @@ class DevicePlaneDriver:
         log end (guaranteeing a term-T entry sits below it — the blank
         entry from become_leader at minimum) and reset the shards."""
         B = self.runner.batch
-        while (node.log.end - 1) % B != 0 and not node.log.is_full:
+        while (node.log.end - 1) % B != 0 and not node.log.near_full(2):
             node.log.append(term, type=EntryType.NOOP)
         if (node.log.end - 1) % B != 0:
             return False

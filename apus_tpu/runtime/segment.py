@@ -6,6 +6,4 @@ core.node); this module keeps the promised ``apus_tpu.runtime.segment``
 name for runtime-level callers and docs.
 """
 
-from apus_tpu.core.segment import (MAGIC, MAX_RECORD, OVERHEAD,  # noqa: F401
-                                   Reassembler, is_chunk, maybe_wrap,
-                                   parse, split)
+from apus_tpu.core.segment import *  # noqa: F401,F403 — tracks core.segment

@@ -29,14 +29,12 @@ from apus_tpu.runtime.proc import ProcCluster
 
 import os
 
-_TARBALL = os.environ.get("APUS_REDIS_TARBALL",
-                          "/root/reference/apps/redis/redis-2.8.17.tar.gz")
-_BUILT = os.path.join(os.path.dirname(REDIS_RUN), "build", "redis-2.8.17",
-                      "src", "redis-server")
+from apus_tpu.runtime.appcluster import REDIS_SERVER, REDIS_TARBALL
+
 # Collection-time check stays CHEAP (existence only); the actual build
 # (up to minutes) happens in the module fixture, not at collection.
 pytestmark = pytest.mark.skipif(
-    not (os.path.exists(_BUILT) or os.path.exists(_TARBALL)),
+    not (os.path.exists(REDIS_SERVER) or os.path.exists(REDIS_TARBALL)),
     reason="pinned redis unavailable (no tarball, no built binary)")
 
 

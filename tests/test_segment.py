@@ -27,9 +27,9 @@ def test_split_reassemble_roundtrip():
     assert all(segment.is_chunk(c) for c in chunks)
     r = segment.Reassembler()
     for i, c in enumerate(chunks[:-1]):
-        final, full = r.feed(c, idx=i)
+        final, full = r.feed(c, now=float(i))
         assert not final and full is None
-    final, full = r.feed(chunks[-1], idx=len(chunks))
+    final, full = r.feed(chunks[-1], now=float(len(chunks)))
     assert final and full == data
     assert r.pending == 0
 
@@ -121,6 +121,74 @@ def test_leader_crash_mid_group_retry_is_exactly_once():
     pr3 = new_leader.submit(101, 55, data)
     assert pr3.reply is not None
     c.check_logs_consistent()
+
+
+def test_snapshot_gate_blocks_mid_group():
+    """The gate's blocking direction: while a chunk group is in flight
+    at the apply point, make_snapshot() must return None (a snapshot cut
+    there would strand installers with finals missing early chunks)."""
+    c = Cluster(3, seed=3, sm_factory=KvsStateMachine, seg_chunk=CHUNK)
+    leader = c.wait_for_leader()
+    chunks = segment.split(b"y" * 400, CHUNK, clt_id=9, req_id=1)
+    # Simulate apply stopping mid-group: early chunks applied, final not.
+    final0, full0 = leader._seg.feed(chunks[0], leader._now)
+    assert not final0 and full0 is None
+    assert leader.make_snapshot() is None, \
+        "snapshot cut while a chunk group is in flight"
+    # Group completes -> the gate lifts.
+    final1 = full1 = None
+    for ch in chunks[1:]:
+        final1, full1 = leader._seg.feed(ch, leader._now)
+    assert final1 and full1 == b"y" * 400
+    assert leader.make_snapshot() is not None
+    # Orphan aging: a group whose final never arrives stops blocking
+    # snapshots once the quiet window passes, even with apply parked.
+    orphan = segment.split(b"z" * 300, CHUNK, clt_id=9, req_id=2)
+    leader._snap_cache = None
+    leader._seg.feed(orphan[0], leader._now)
+    assert leader.make_snapshot() is None
+    c.run(leader.SEG_SNAPSHOT_QUIET + 0.5)    # quiescent: no new traffic
+    leader = c.wait_for_leader()
+    assert leader.make_snapshot() is not None, \
+        "stale orphan blocked snapshots forever"
+
+
+def test_joiner_snapshot_under_segmented_traffic():
+    """A joiner admitted behind the pruned head installs a leader-pushed
+    snapshot while segmented records flow, and converges with zero
+    seg_incomplete — the end-to-end scenario the gate protects."""
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    from apus_tpu.utils.config import ClusterSpec
+
+    big = b"J" * 9000
+    # Tiny log forces pruning, so the joiner sits behind the head and
+    # MUST install a leader-pushed snapshot (asserted below).
+    spec = ClusterSpec(n_slots=128, hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150)
+    with LocalCluster(3, spec=spec) as lc:
+        for d in lc.daemons:
+            d.node.cfg.seg_chunk = 256
+        lc.wait_for_leader()
+        for i in range(8):
+            lc.submit(encode_put(b"jk%d" % i, big), timeout=30.0)
+        d_new = lc.add_replica(timeout=30.0)
+        d_new.node.cfg.seg_chunk = 256
+        # Post-join writes stall while the joiner gates pruning of the
+        # tiny log (head can't pass its apply point), so give them the
+        # full catch-up window.
+        for i in range(8, 12):
+            lc.submit(encode_put(b"jk%d" % i, big), timeout=30.0)
+        lc.wait_caught_up(d_new.idx, timeout=30.0)
+        with d_new.lock:
+            for i in range(12):
+                assert d_new.node.sm.store.get(b"jk%d" % i) == big, i
+            assert d_new.node.stats.get("seg_incomplete", 0) == 0
+            installed = d_new.node.stats.get("snapshots_installed", 0)
+        assert installed >= 1, "joiner never installed a snapshot"
+        for d in lc.live():
+            with d.lock:
+                assert d.node.stats.get("seg_incomplete", 0) == 0
 
 
 def test_snapshot_gating_and_joiner_catches_up():
