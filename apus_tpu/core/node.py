@@ -354,10 +354,6 @@ class Node:
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
 
-    #: Snapshot gate quiet window (seconds of tick clock): a chunk group
-    #: fed more recently than this blocks make_snapshot.
-    SEG_SNAPSHOT_QUIET = 2.0
-
     def make_snapshot(self) -> Optional[tuple[Snapshot, list, Cid, dict]]:
         """Snapshot at the current apply point: SM state, endpoint-DB
         dump (exactly-once state must travel with the SM state), plus
@@ -374,16 +370,14 @@ class Node:
         if self._snap_cache is not None and \
                 self._snap_cache[0].last_idx + 1 >= self.log.head:
             return self._snap_cache
-        # Segmentation gate: never cut a snapshot while a chunk group is
-        # in flight at the apply point — the installer would receive the
-        # group's final chunk with its early chunks below the snapshot
-        # (seg_incomplete).  Time-aged (tick clock): stale orphans whose
-        # final an election truncated must not block snapshots forever,
-        # even on a quiescent cluster (see Reassembler.active_within).
-        if self._seg.active_within(self._now, self.SEG_SNAPSHOT_QUIET):
-            return None
         last_idx, last_term = self._applied_det
         snap = self.sm.create_snapshot(last_idx, last_term)
+        # Partially-reassembled chunk groups at the apply point ride
+        # WITH the snapshot (deterministic function of the applied
+        # prefix): an installer can then complete a group whose early
+        # chunks lie below the snapshot cut — no mid-group gating, no
+        # stranded seg_incomplete finals (core.segment.Reassembler).
+        snap = dataclasses.replace(snap, seg=self._seg.dump())
         self._snap_cache = (snap, self.epdb.dump(), self.cid,
                             dict(self._member_addrs))
         return self._snap_cache
@@ -401,7 +395,9 @@ class Node:
             return False                     # we already have more
         self.sm.apply_snapshot(snap)
         self.epdb.load(ep_dump)
-        self._seg = segment.Reassembler()    # chunk buffer is pre-snapshot
+        # Adopt the snapshot point's partial chunk groups: finals
+        # applying above the snapshot find their early chunks here.
+        self._seg = segment.Reassembler.load(snap.seg)
         self.log.reset(snap.last_idx + 1)
         self._applied_det = (snap.last_idx, snap.last_term)
         self._snap_cache = None
@@ -526,12 +522,17 @@ class Node:
         leader tick) when the log is transiently full at election — the
         old term's HEAD entry may still be in flight; reads stay gated
         on _term_start_idx + 1 until the blank lands."""
-        if self.log.near_full(1):
-            # Respect the HEAD reserve: the blank must never consume the
-            # last slot, or _maybe_prune could never append the HEAD
-            # entry that frees space (permanent wedge).  Deferral is
-            # safe — the HEAD entry is itself a current-term entry, so
-            # commit can advance and pruning can run before the blank.
+        if self.log.is_full:
+            # A full ring at election is the one place deferral could
+            # wedge forever: with an OLD-term tail filling the log, no
+            # current-term entry can land, and commit (which only
+            # advances on a current-term entry) never moves.  Free the
+            # locally-applied prefix without consensus (safe: see
+            # _emergency_free) and append the blank into the space.
+            self._emergency_free()
+        if self.log.is_full:
+            # Nothing applied to free (apply == head): wait for apply
+            # to progress and retry every leader tick.
             self._term_start_idx = self.log.end
             self._term_blank_pending = True
             return
@@ -1088,18 +1089,37 @@ class Node:
             if a is None:
                 return
             floor = min(floor, a)
+        if self.log.is_full:
+            # The slot classes (clients 3, device drain / CONFIG 1)
+            # normally leave room for the HEAD entry; a ring that
+            # filled anyway (e.g. a term blank took the last slot) is
+            # relieved by dropping the locally-applied prefix.
+            self._emergency_free()
         if floor > self.log.head and not self.log.is_empty \
                 and not self.log.is_full:
-            # is_full can only be transient here: every other append
-            # class stops at a reserve (clients 3, CONFIG 1), so a full
-            # log means a HEAD is already in flight — whose apply frees
-            # space — and we retry next prune tick.
             self.log.append(my.term, type=EntryType.HEAD, head=floor)
             self._pending_head = floor
 
     # ------------------------------------------------------------------
     # apply
     # ------------------------------------------------------------------
+
+    def _emergency_free(self) -> None:
+        """Last-resort LOCAL pruning when the ring is completely full:
+        drop the locally-APPLIED prefix without a HEAD entry.  Safe on
+        any role: applied state lives in the SM (+ snapshot cache +
+        durable store), repair/adjustment reads start at the commit
+        point, and a peer that later needs a dropped entry is served by
+        snapshot push (the nxt < head path).  Windowed pruning (P1-P3
+        HEAD entries) remains the steady-state mechanism; this only
+        breaks full-ring deadlocks — e.g. a new leader whose log is
+        full of old-term entries could otherwise never append the
+        current-term entry that lets commit advance."""
+        if self.log.is_full and self.log.apply > self.log.head:
+            self.log.advance_head(self.log.apply)
+            self._pending_head = None
+            self.stats["emergency_prunes"] = \
+                self.stats.get("emergency_prunes", 0) + 1
 
     def _apply_committed(self, now: float) -> None:
         """apply_committed_entries analog (dare_server.c:1815-1974)."""
@@ -1124,7 +1144,7 @@ class Node:
                         self._seg.prune(e.clt_id, e.req_id)
                         data = None
                     else:
-                        final, full = self._seg.feed(data, self._now)
+                        final, full = self._seg.feed(data)
                         if not final:
                             # Intermediate chunk: buffered only; the SM,
                             # dedup, reply, and upcalls all fire on the
@@ -1176,6 +1196,10 @@ class Node:
             self._applied_det = e.determinant()
             self.log.advance_apply(e.idx + 1)
             self.stats["applied"] += 1
+        if self.log.is_full:
+            # Followers never run _maybe_prune; a ring filled by
+            # replicated writes/drains frees its applied prefix here.
+            self._emergency_free()
 
     def _apply_config(self, e: LogEntry, now: float) -> None:
         """CONFIG application incl. resize progression

@@ -79,53 +79,44 @@ def maybe_wrap(data: bytes, clt_id: int, req_id: int) -> Optional[bytes]:
 
 class Reassembler:
     """Apply-side chunk buffer.  Deterministic across replicas: all
-    replicas apply the same entries in the same order, so all complete
-    groups at the same final-chunk index.
+    replicas apply the same entries in the same order, so every replica
+    holds the SAME buffer after the same applied prefix — which is what
+    lets the buffer travel inside snapshots (``dump``/``load``,
+    models.sm.Snapshot.seg): an installer resumes groups whose early
+    chunks lie below the snapshot point.
 
     A group whose final chunk was truncated by an election is orphaned
     (its client's retry runs under a new capture id); orphans are
-    bounded by ``MAX_GROUPS`` LRU eviction and, being stale, stop
-    blocking snapshots once the apply point moves past them
-    (``active_since``)."""
+    bounded by ``MAX_GROUPS`` eviction in feed order (a deterministic
+    sequence number, NOT wall time, preserving cross-replica and
+    dump/load determinism)."""
 
     MAX_GROUPS = 4096
 
     def __init__(self) -> None:
-        #: key -> (seq -> piece, last_fed_tick_time)
+        #: key -> (seq -> piece, feed_seq)
         self._groups: dict[tuple[int, int],
-                           tuple[dict[int, bytes], float]] = {}
+                           tuple[dict[int, bytes], int]] = {}
+        self._feed_seq = 0
 
     @property
     def pending(self) -> int:
         return len(self._groups)
 
-    def active_within(self, now: float, window: float) -> bool:
-        """True if some group was fed within the last ``window`` seconds
-        of tick time — an in-flight group.  Snapshot gating
-        (core.node.make_snapshot): a snapshot cut mid-group would strand
-        the installer with finals whose early chunks are below the
-        snapshot point.  A group can only complete-from-the-log shortly
-        after its last chunk applied (chunks append contiguously), so
-        TIME-aging lets stale orphans (final truncated by an election,
-        client gone) stop blocking snapshots even on a quiescent cluster
-        — where apply-progress-based aging would block forever."""
-        return any(last > now - window
-                   for _, last in self._groups.values())
-
-    def feed(self, payload: bytes,
-             now: float = 0.0) -> tuple[bool, Optional[bytes]]:
-        """Absorb one applied chunk (``now`` = the tick clock).  Returns
-        (final, full_payload): ``final`` is True when this chunk closes
-        its group — then ``full_payload`` is the reassembled record, or
-        None if earlier chunks are missing (only possible after an
-        ill-gated snapshot install; counted by the caller)."""
+    def feed(self, payload: bytes) -> tuple[bool, Optional[bytes]]:
+        """Absorb one applied chunk.  Returns (final, full_payload):
+        ``final`` is True when this chunk closes its group — then
+        ``full_payload`` is the reassembled record, or None if earlier
+        chunks are missing (a protocol violation now that partial
+        buffers ride snapshots; counted loudly by the caller)."""
         clt, req, seq, total, piece = parse(payload)
         key = (clt, req)
         entry = self._groups.get(key)
         group = entry[0] if entry is not None else {}
         group[seq] = piece
         if seq != total - 1:
-            self._groups[key] = (group, now)
+            self._feed_seq += 1
+            self._groups[key] = (group, self._feed_seq)
             if len(self._groups) > self.MAX_GROUPS:
                 oldest = min(self._groups, key=lambda k: self._groups[k][1])
                 self._groups.pop(oldest, None)
@@ -139,3 +130,37 @@ class Reassembler:
         """Drop a buffered group (its final chunk was deduplicated —
         the logical record already applied in a previous incarnation)."""
         self._groups.pop((clt_id, req_id), None)
+
+    # -- snapshot transport ------------------------------------------------
+
+    def dump(self) -> bytes:
+        """Serialize the partial groups (sorted keys: deterministic)."""
+        out = [struct.pack("<I", len(self._groups))]
+        for (clt, req) in sorted(self._groups):
+            group, _ = self._groups[(clt, req)]
+            out.append(struct.pack("<QQI", clt, req, len(group)))
+            for seq in sorted(group):
+                piece = group[seq]
+                out.append(struct.pack("<II", seq, len(piece)))
+                out.append(piece)
+        return b"".join(out)
+
+    @staticmethod
+    def load(blob: bytes) -> "Reassembler":
+        r = Reassembler()
+        if not blob:
+            return r
+        (ngroups,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        for _ in range(ngroups):
+            clt, req, npieces = struct.unpack_from("<QQI", blob, off)
+            off += 20
+            group: dict[int, bytes] = {}
+            for _ in range(npieces):
+                seq, n = struct.unpack_from("<II", blob, off)
+                off += 8
+                group[seq] = blob[off:off + n]
+                off += n
+            r._feed_seq += 1
+            r._groups[(clt, req)] = (group, r._feed_seq)
+        return r

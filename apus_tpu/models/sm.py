@@ -8,11 +8,18 @@ import dataclasses
 @dataclasses.dataclass
 class Snapshot:
     """SM snapshot (snapshot_t analog, dare_log.h:107-112): the state
-    blob plus the determinant of the last applied entry."""
+    blob plus the determinant of the last applied entry.
+
+    ``seg`` carries the partially-reassembled chunk groups at the
+    snapshot point (core.segment.Reassembler.dump): the buffer is a
+    deterministic function of the applied prefix, so it travels WITH
+    the prefix — an installer can then complete a group whose early
+    chunks lie below the snapshot and whose final applies above it."""
 
     last_idx: int
     last_term: int
     data: bytes
+    seg: bytes = b""
 
 
 class StateMachine:

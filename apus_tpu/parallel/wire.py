@@ -174,8 +174,8 @@ def encode_value(v: Any) -> bytes:
     if isinstance(v, bytes):
         return u8(VAR_BYTES) + blob(v)
     if isinstance(v, Snapshot):
-        return u8(VAR_SNAPSHOT) + _SNAP_FIXED.pack(
-            v.last_idx, v.last_term, len(v.data)) + v.data
+        return (u8(VAR_SNAPSHOT) + _SNAP_FIXED.pack(
+            v.last_idx, v.last_term, len(v.data)) + v.data + blob(v.seg))
     raise TypeError(f"unencodable ctrl value {type(v)}")
 
 
@@ -193,7 +193,8 @@ def decode_value(r: Reader) -> Any:
         return r.blob()
     if tag == VAR_SNAPSHOT:
         li, lt, n = _SNAP_FIXED.unpack(r.take(_SNAP_FIXED.size))
-        return Snapshot(li, lt, r.take(n))
+        data = r.take(n)
+        return Snapshot(li, lt, data, seg=r.blob())
     raise ValueError(f"bad variant tag {tag}")
 
 

@@ -117,7 +117,8 @@ class ReplicaDaemon:
                                                   daemon_store_path)
             self.persistence = Persistence(daemon_store_path(db_dir, idx))
             if self.persistence.store.count:
-                self.persistence.replay_into(self.node.sm, self.node.epdb)
+                self.persistence.replay_into(self.node.sm, self.node.epdb,
+                                             node=self.node)
             self.on_commit.append(self.persistence.on_commit)
             self.on_snapshot.append(self.persistence.on_snapshot)
 

@@ -647,7 +647,11 @@ class DevicePlaneDriver:
             appended = 0
             for e in rows:
                 if e.term != term or e.idx != node.log.end \
-                        or node.log.is_full:
+                        or node.log.near_full(1):
+                    # near_full (not is_full): device drains must not
+                    # consume the HEAD-entry reserve, or a filled host
+                    # log could never be pruned; rows resume at
+                    # log.end once pruning frees space.
                     break
                 node.log.write(e)
                 appended += 1
@@ -693,7 +697,11 @@ class DevicePlaneDriver:
                 return False
             for e in rows:
                 if e.term != term or e.idx != node.log.end \
-                        or node.log.is_full:
+                        or node.log.near_full(1):
+                    # near_full (not is_full): device drains must not
+                    # consume the HEAD-entry reserve, or a filled host
+                    # log could never be pruned; rows resume at
+                    # log.end once pruning frees space.
                     break
                 node.log.write(e)
                 appended += 1

@@ -45,16 +45,24 @@ class Persistence:
 
     def on_snapshot(self, snap: Snapshot, ep_dump: list) -> None:
         """Record a leader-pushed snapshot install (without it, restart
-        replay would rebuild from a store missing the snapshot prefix)."""
+        replay would rebuild from a store missing the snapshot prefix).
+        The partial-chunk-group buffer (snap.seg) is part of the
+        snapshot point: a restart must resume those groups or finals
+        delivered during catch-up would reassemble incomplete."""
         self.store.append(
             SNAP_MAGIC + struct.pack("<QQ", snap.last_idx, snap.last_term)
-            + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump))
+            + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
+            + wire.blob(snap.seg))
 
     # -- recovery ---------------------------------------------------------
 
-    def replay_into(self, sm: StateMachine, epdb: EndpointDB) -> int:
+    def replay_into(self, sm: StateMachine, epdb: EndpointDB,
+                    node=None) -> int:
         """Rebuild SM + endpoint-DB state from the store; returns the
-        next log index to fetch from peers (apply floor)."""
+        next log index to fetch from peers (apply floor).  With
+        ``node``, a replayed snapshot's partial-chunk-group buffer is
+        restored into the node's reassembler (catch-up may deliver
+        finals whose early chunks predate the snapshot)."""
         nxt = 1
         for rec in self.store.records():
             kind, payload = decode_record(rec)
@@ -67,6 +75,9 @@ class Persistence:
                 snap, ep_dump = payload
                 sm.apply_snapshot(snap)
                 epdb.load(ep_dump)
+                if node is not None:
+                    from apus_tpu.core.segment import Reassembler
+                    node._seg = Reassembler.load(snap.seg)
                 nxt = snap.last_idx + 1
         return nxt
 
@@ -84,7 +95,9 @@ def decode_record(rec: bytes):
         r = wire.Reader(rec[20:])
         data = r.blob()
         ep_dump = wire.decode_ep_dump(r)
-        return "snapshot", (Snapshot(last_idx, last_term, data), ep_dump)
+        seg = r.blob() if r.remaining else b""
+        return "snapshot", (Snapshot(last_idx, last_term, data, seg=seg),
+                            ep_dump)
     raise ValueError(
         f"unsupported store record format {magic!r} "
         f"(expected {RECORD_MAGIC!r} or {SNAP_MAGIC!r}); refusing to decode")

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Standalone fabric self-test (the mckey.c analog).
+
+The reference vendors ``mckey.c`` — an RDMA-CM multicast self-test run
+before blaming DARE for fabric problems (benchmarks/README:1-8).  The
+TPU-era fabric is the device mesh + XLA collectives, so this CLI checks
+exactly the primitives the data plane stands on, one by one, and prints
+PASS/FAIL with timings:
+
+  1. backend init + device enumeration;
+  2. pmax broadcast over the replica axis (the leader->all scatter);
+  3. all_gather (the ack vector);
+  4. donated dynamic_update_slice into a sharded log (the slot write);
+  5. a depth-8 pipelined commit scan (the steady-state loop).
+
+Exit code 0 iff every check passes.  Use ``--devices N`` with
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
+for a virtual mesh, or run bare on real hardware.
+
+Usage: python benchmarks/meshcheck.py [--devices N] [--timeout S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+
+def _mark(status: str, name: str, detail: str = "") -> None:
+    print(f"[meshcheck +{time.monotonic() - _T0:6.1f}s] {status:4} {name}"
+          + (f" — {detail}" if detail else ""), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="mesh width (0 = all visible devices)")
+    args = ap.parse_args()
+
+    failures = 0
+
+    # 1. backend init
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+        devices = jax.devices()
+        _mark("PASS", "backend-init",
+              f"{jax.default_backend()}: {len(devices)} device(s)")
+    except Exception as e:                                # noqa: BLE001
+        _mark("FAIL", "backend-init", repr(e))
+        return 1
+
+    n = args.devices or len(devices)
+    if n > len(devices):
+        _mark("FAIL", "device-count",
+              f"need {n}, have {len(devices)} (set JAX_PLATFORMS=cpu "
+              f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+              f"for a virtual mesh)")
+        return 1
+    devices = devices[:n]
+
+    from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = replica_mesh(n, devices=devices)
+    sh = NamedSharding(mesh, P(REPLICA_AXIS))
+
+    # 2. pmax broadcast: row 0 carries data, the rest zeros; after the
+    # collective every shard must hold row 0's payload.
+    try:
+        t = time.monotonic()
+        x = np.zeros((n, 64), np.int32)
+        x[0] = np.arange(64)
+        xd = jax.device_put(x, sh)
+        f = jax.jit(jax.shard_map(
+            lambda a: lax.pmax(jnp.max(a, axis=0), REPLICA_AXIS)[None],
+            mesh=mesh, in_specs=P(REPLICA_AXIS), out_specs=P(REPLICA_AXIS),
+            check_vma=False))
+        out = np.asarray(f(xd))
+        assert (out == np.arange(64)).all(), out[:, :4]
+        _mark("PASS", "pmax-broadcast",
+              f"{(time.monotonic() - t) * 1e3:.0f} ms")
+    except Exception as e:                                # noqa: BLE001
+        _mark("FAIL", "pmax-broadcast", repr(e))
+        failures += 1
+
+    # 3. all_gather: each shard contributes its id; all shards see all.
+    try:
+        t = time.monotonic()
+        ids = jax.device_put(np.arange(n, dtype=np.int32)[:, None], sh)
+        g = jax.jit(jax.shard_map(
+            lambda a: lax.all_gather(a[:, 0], REPLICA_AXIS)
+            .reshape(1, -1),
+            mesh=mesh, in_specs=P(REPLICA_AXIS), out_specs=P(REPLICA_AXIS),
+            check_vma=False))
+        out = np.asarray(g(ids))
+        assert (out == np.arange(n)).all(), out
+        _mark("PASS", "all-gather", f"{(time.monotonic() - t) * 1e3:.0f} ms")
+    except Exception as e:                                # noqa: BLE001
+        _mark("FAIL", "all-gather", repr(e))
+        failures += 1
+
+    # 4 + 5. the real data-plane ops: one commit step, then a depth-8
+    # pipelined scan (donation + DUS + quorum inside).
+    try:
+        from apus_tpu.core.cid import Cid
+        from apus_tpu.ops.commit import (CommitControl, build_commit_step,
+                                         build_pipelined_commit_step,
+                                         place_batch)
+        from apus_tpu.ops.logplane import (host_batch_to_device,
+                                           make_device_log)
+        from apus_tpu.ops.mesh import replica_sharding
+        R, S, SB, B = n, 32, 64, 8
+        rsh = replica_sharding(mesh)
+        cid = Cid.initial(R)
+        t = time.monotonic()
+        devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                                 sharding=rsh)
+        bd, bm, _ = host_batch_to_device(
+            [b"meshcheck-%d" % i for i in range(B)], SB, batch_size=B)
+        bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+        step = build_commit_step(mesh, R, S, SB, B)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+        devlog, acks, commit = step(devlog, bdata, bmeta, ctrl)
+        jax.block_until_ready(commit)
+        assert int(commit) == 1 + B, int(commit)
+        assert (np.asarray(acks) == 1 + B).all(), np.asarray(acks)
+        _mark("PASS", "commit-step",
+              f"commit={int(commit)} in {(time.monotonic() - t) * 1e3:.0f} ms")
+    except Exception as e:                                # noqa: BLE001
+        _mark("FAIL", "commit-step", repr(e))
+        failures += 1
+
+    try:
+        t = time.monotonic()
+        depth = 8
+        pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=depth,
+                                           staged_depth=1)
+        devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                                 sharding=rsh)
+        ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+        devlog, commits, ctrl = pipe(devlog, bdata[None], bmeta[None], ctrl)
+        jax.block_until_ready(commits)
+        assert int(np.asarray(commits)[-1]) == 1 + depth * B
+        _mark("PASS", "pipelined-scan",
+              f"depth={depth} in {(time.monotonic() - t) * 1e3:.0f} ms")
+    except Exception as e:                                # noqa: BLE001
+        _mark("FAIL", "pipelined-scan", repr(e))
+        failures += 1
+
+    _mark("PASS" if failures == 0 else "FAIL", "meshcheck",
+          f"{4 - failures}/4 fabric checks ok on {n}-device mesh")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

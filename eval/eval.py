@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Evaluation harness: run the benchmark suite across replica counts,
+aggregate the JSON results, and emit the BASELINE.md metric table
+(+ optional plots).
+
+The reference's ``eval/eval.py`` drives its benchmarks, then aggregates
+timings into mean/std tables (``write_stats``, eval/eval.py:153-235)
+and matplotlib scatter plots (:165-180).  This is that harness for the
+TPU-era stack, organized around BASELINE.md's target metrics: p50/p99
+commit latency and commits/sec (redis/toyserver SET) at 3/5/7 replicas,
+plus leader failover time at the production envelope and the
+device-plane pipelined commit round.
+
+Commands (one command runs everything):
+    python eval/eval.py all   [--replicas 3,5,7] [--requests N] [--redis]
+    python eval/eval.py run   ...        # execute benches -> runs.jsonl
+    python eval/eval.py report [--plot]  # aggregate -> stats.md (+ PNGs)
+
+Every benchmark invocation appends one JSON record per metric line to
+``eval/results/runs.jsonl`` with run metadata, so repeated runs
+accumulate and the report shows mean/std across runs (the reference
+accumulates per-client logs the same way, eval/eval.py:225-234).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "eval", "results")
+RUNS = os.path.join(RESULTS, "runs.jsonl")
+
+#: Env that keeps the cluster harnesses off a possibly-wedged TPU
+#: tunnel (the device-plane microbench manages its own backend).
+CPU_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
+
+def _record(out, rec: dict, **meta) -> None:
+    rec = dict(rec)
+    rec.update(meta)
+    rec["ts"] = time.time()
+    out.write(json.dumps(rec) + "\n")
+    out.flush()
+
+
+def _json_lines(stdout: str) -> list[dict]:
+    out = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def _run_tool(argv: list[str], timeout: float, env_extra=CPU_ENV):
+    env = dict(os.environ)
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(argv, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"  TIMEOUT: {' '.join(argv)}", file=sys.stderr)
+        return []
+    if proc.returncode != 0:
+        print(f"  rc={proc.returncode}: {' '.join(argv)}\n"
+              f"{proc.stderr[-800:]}", file=sys.stderr)
+    return _json_lines(proc.stdout)
+
+
+def cmd_run(args) -> int:
+    os.makedirs(RESULTS, exist_ok=True)
+    replica_counts = [int(x) for x in args.replicas.split(",")]
+    with open(RUNS, "a") as out:
+        # 1. Proxied app SET/GET + replication across replica counts
+        # (run.sh analog; --redis drives the pinned real redis).
+        for n in replica_counts:
+            argv = [sys.executable,
+                    os.path.join(REPO, "benchmarks", "run_bench.py"),
+                    "--replicas", str(n), "--requests", str(args.requests)]
+            if args.redis:
+                argv.append("--redis")
+            print(f"run_bench: {n} replicas"
+                  + (" (real redis)" if args.redis else " (toyserver)"))
+            for rec in _run_tool(argv, timeout=420):
+                _record(out, rec, replicas=n, bench="run_bench",
+                        app="redis" if args.redis else "toyserver")
+
+        # 2. Leader failover at the production envelope (process-per-
+        # replica; reconf_bench.sh FailLeader analog).
+        print("reconf_bench --proc: leader failover")
+        for rec in _run_tool(
+                [sys.executable,
+                 os.path.join(REPO, "benchmarks", "reconf_bench.py"),
+                 "--proc", "--replicas", str(max(replica_counts))],
+                timeout=240):
+            _record(out, rec, replicas=max(replica_counts),
+                    bench="reconf_bench")
+
+        # 3. Device-plane pipelined commit round (bench.py; tries the
+        # real TPU first, falls back to CPU under its own watchdog).
+        print("bench.py: pipelined commit round")
+        for rec in _run_tool([sys.executable,
+                              os.path.join(REPO, "bench.py")],
+                             timeout=300, env_extra={}):
+            _record(out, rec, replicas=5, bench="bench")
+    print(f"results appended to {RUNS}")
+    return 0
+
+
+# -- aggregation -----------------------------------------------------------
+
+def _load_runs() -> list[dict]:
+    if not os.path.exists(RUNS):
+        return []
+    out = []
+    with open(RUNS) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def _stats(values: list[float]) -> dict:
+    if not values:
+        return {}
+    return {
+        "n": len(values),
+        "mean": statistics.fmean(values),
+        "std": statistics.pstdev(values) if len(values) > 1 else 0.0,
+        "min": min(values),
+        "max": max(values),
+    }
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return f"{v:,}"
+
+
+def cmd_report(args) -> int:
+    runs = _load_runs()
+    if not runs:
+        print(f"no runs recorded yet ({RUNS}); run "
+              f"`python eval/eval.py run` first", file=sys.stderr)
+        return 1
+
+    # Group: (metric, replicas, app) -> list of records.
+    groups: dict[tuple, list[dict]] = {}
+    for r in runs:
+        key = (r.get("metric"), r.get("replicas"), r.get("app", ""))
+        groups.setdefault(key, []).append(r)
+
+    lines = ["# Benchmark report",
+             "",
+             f"{len(runs)} records in {os.path.relpath(RUNS, REPO)}; "
+             f"mean over repeated runs, latencies in us.",
+             "",
+             "| metric | replicas | app | runs | value (mean) | unit | "
+             "p50 | p95 | p99 |",
+             "|---|---|---|---|---|---|---|---|---|"]
+    plot_data: dict[str, dict[int, float]] = {}
+    for (metric, n, app), recs in sorted(
+            groups.items(), key=lambda kv: (kv[0][0] or "", kv[0][1] or 0)):
+        vals = [r["value"] for r in recs
+                if isinstance(r.get("value"), (int, float))]
+        st = _stats(vals)
+        p50 = _stats([r["detail"]["p50_us"] for r in recs
+                      if "p50_us" in r.get("detail", {})])
+        p95 = _stats([r["detail"]["p95_us"] for r in recs
+                      if "p95_us" in r.get("detail", {})])
+        p99 = _stats([r["detail"]["p99_us"] for r in recs
+                      if "p99_us" in r.get("detail", {})])
+        unit = recs[-1].get("unit", "")
+        lines.append(
+            f"| {metric} | {n} | {app} | {st.get('n', 0)} "
+            f"| {_fmt(st.get('mean'))} | {unit} "
+            f"| {_fmt(p50.get('mean'))} | {_fmt(p95.get('mean'))} "
+            f"| {_fmt(p99.get('mean'))} |")
+        if metric and metric.endswith("_throughput") and n:
+            plot_data.setdefault(f"{metric} ({app})", {})[n] = \
+                st.get("mean", 0.0)
+
+    # Headline extracts matching BASELINE.md's target metrics.
+    lines += ["", "## BASELINE.md target metrics", ""]
+    pipe = [r for r in runs if r.get("bench") == "bench"
+            and isinstance(r.get("value"), (int, float))]
+    if pipe:
+        last = pipe[-1]
+        lines.append(
+            f"- consensus commit round (64-entry batch, 5 replicas, "
+            f"pipelined): p50 {_fmt(last['value'], 2)} us "
+            f"[{last['detail'].get('backend')}], "
+            f"{_fmt(last['detail'].get('commits_per_sec'))} commits/sec, "
+            f"{_fmt(last['detail'].get('entries_per_sec'))} entries/sec, "
+            f"vs_baseline {last.get('vs_baseline')}")
+    fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
+          and isinstance(r.get("value"), (int, float))]
+    if fo:
+        st = _stats([r["value"] for r in fo])
+        lines.append(f"- leader failover (production envelope, process-"
+                     f"per-replica): {_fmt(st['mean'])} ms "
+                     f"(n={st['n']}, min {_fmt(st['min'])})")
+    for (metric, n, app), recs in sorted(groups.items(),
+                                         key=lambda kv: kv[0][1] or 0):
+        if metric == "proxied_set_throughput":
+            vals = [r["value"] for r in recs
+                    if isinstance(r.get("value"), (int, float))]
+            p50 = [r["detail"]["p50_us"] for r in recs
+                   if "p50_us" in r.get("detail", {})]
+            p99 = [r["detail"]["p99_us"] for r in recs
+                   if "p99_us" in r.get("detail", {})]
+            if vals:
+                lines.append(
+                    f"- replicated SET @ {n} replicas ({app}): "
+                    f"{_fmt(statistics.fmean(vals))} ops/sec, "
+                    f"p50 {_fmt(statistics.fmean(p50) if p50 else None)} us, "
+                    f"p99 {_fmt(statistics.fmean(p99) if p99 else None)} us")
+
+    report = "\n".join(lines) + "\n"
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, "stats.md")
+    with open(path, "w") as f:
+        f.write(report)
+    print(report)
+    print(f"written to {os.path.relpath(path, REPO)}")
+
+    if args.plot:
+        _plots(groups)
+    return 0
+
+
+def _plots(groups) -> None:
+    """Throughput-vs-replicas and latency-percentile plots (the
+    eval.py:165-180 scatter analog).  Soft dependency: skipped with a
+    note when matplotlib is unavailable."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; skipping plots", file=sys.stderr)
+        return
+    # Throughput vs replica count per app/op.
+    series: dict[str, dict[int, float]] = {}
+    lat: dict[str, dict[int, tuple]] = {}
+    for (metric, n, app), recs in groups.items():
+        if not metric or not n:
+            continue
+        vals = [r["value"] for r in recs
+                if isinstance(r.get("value"), (int, float))]
+        if metric.endswith("_throughput") and vals:
+            series.setdefault(f"{metric}:{app}", {})[n] = \
+                statistics.fmean(vals)
+        p50 = [r["detail"]["p50_us"] for r in recs
+               if "p50_us" in r.get("detail", {})]
+        p99 = [r["detail"]["p99_us"] for r in recs
+               if "p99_us" in r.get("detail", {})]
+        if metric == "proxied_set_throughput" and p50:
+            lat.setdefault(app or "app", {})[n] = (
+                statistics.fmean(p50),
+                statistics.fmean(p99) if p99 else None)
+    if series:
+        plt.figure(figsize=(7, 4.5))
+        for name, pts in sorted(series.items()):
+            xs = sorted(pts)
+            plt.plot(xs, [pts[x] for x in xs], marker="o", label=name)
+        plt.xlabel("replicas")
+        plt.ylabel("ops/sec")
+        plt.title("Replicated throughput vs group size")
+        plt.legend(fontsize=7)
+        plt.grid(True, alpha=0.3)
+        out = os.path.join(RESULTS, "throughput.png")
+        plt.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close()
+        print(f"plot: {os.path.relpath(out, REPO)}")
+    if lat:
+        plt.figure(figsize=(7, 4.5))
+        for app, pts in sorted(lat.items()):
+            xs = sorted(pts)
+            plt.plot(xs, [pts[x][0] for x in xs], marker="o",
+                     label=f"{app} SET p50")
+            if all(pts[x][1] is not None for x in xs):
+                plt.plot(xs, [pts[x][1] for x in xs], marker="s",
+                         linestyle="--", label=f"{app} SET p99")
+        plt.xlabel("replicas")
+        plt.ylabel("latency (us)")
+        plt.title("Replicated SET latency vs group size")
+        plt.legend(fontsize=8)
+        plt.grid(True, alpha=0.3)
+        out = os.path.join(RESULTS, "latency.png")
+        plt.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close()
+        print(f"plot: {os.path.relpath(out, REPO)}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="python eval/eval.py")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_run = sub.add_parser("run", help="execute the benchmark suite")
+    p_all = sub.add_parser("all", help="run + report")
+    for p in (p_run, p_all):
+        p.add_argument("--replicas", default="3,5,7",
+                       help="comma list of group sizes")
+        p.add_argument("--requests", type=int, default=2000)
+        p.add_argument("--redis", action="store_true",
+                       help="drive the pinned real redis instead of "
+                            "toyserver")
+    p_rep = sub.add_parser("report", help="aggregate results")
+    for p in (p_rep, p_all):
+        p.add_argument("--plot", action="store_true",
+                       help="write PNG plots (needs matplotlib)")
+    args = ap.parse_args()
+    if args.cmd == "run":
+        return cmd_run(args)
+    if args.cmd == "report":
+        return cmd_report(args)
+    rc = cmd_run(args)
+    return rc or cmd_report(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

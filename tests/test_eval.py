@@ -1,0 +1,58 @@
+"""Eval harness: aggregation of benchmark runs into the metric table
+(the reference's write_stats analog, eval/eval.py:153-235)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import types
+
+
+def _load_eval(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "apus_eval", os.path.join(repo, "eval", "eval.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.RESULTS = str(tmp_path / "results")
+    mod.RUNS = str(tmp_path / "results" / "runs.jsonl")
+    return mod
+
+
+def test_report_aggregates_runs(tmp_path, capsys):
+    ev = _load_eval(tmp_path)
+    os.makedirs(ev.RESULTS)
+    recs = [
+        {"metric": "proxied_set_throughput", "value": 500.0,
+         "unit": "ops/sec", "replicas": 3, "app": "redis",
+         "detail": {"p50_us": 2000.0, "p95_us": 3000.0, "p99_us": 4000.0}},
+        {"metric": "proxied_set_throughput", "value": 700.0,
+         "unit": "ops/sec", "replicas": 3, "app": "redis",
+         "detail": {"p50_us": 1800.0, "p95_us": 2900.0, "p99_us": 3900.0}},
+        {"metric": "proc_leader_failover_time", "value": 25.0,
+         "unit": "ms", "replicas": 5, "bench": "reconf_bench",
+         "detail": {}},
+        {"metric": "commit_round_p50_latency_batch64_5rep_pipelined",
+         "value": 12.5, "unit": "us", "replicas": 5, "bench": "bench",
+         "vs_baseline": 1.2,
+         "detail": {"backend": "tpu", "commits_per_sec": 80000,
+                    "entries_per_sec": 5120000}},
+    ]
+    with open(ev.RUNS, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rc = ev.cmd_report(types.SimpleNamespace(plot=False))
+    assert rc == 0
+    out = capsys.readouterr().out
+    # Mean across the two runs of the same metric cell.
+    assert "| proxied_set_throughput | 3 | redis | 2 | 600.0 |" in out
+    assert "leader failover" in out and "25.0 ms" in out
+    assert "p50 12.50 us [tpu]" in out and "80,000 commits/sec" in out
+    assert os.path.exists(os.path.join(ev.RESULTS, "stats.md"))
+
+
+def test_report_empty_is_graceful(tmp_path):
+    ev = _load_eval(tmp_path)
+    rc = ev.cmd_report(types.SimpleNamespace(plot=False))
+    assert rc == 1

@@ -26,10 +26,10 @@ def test_split_reassemble_roundtrip():
     assert len(chunks) == (len(data) + CHUNK - 1) // CHUNK
     assert all(segment.is_chunk(c) for c in chunks)
     r = segment.Reassembler()
-    for i, c in enumerate(chunks[:-1]):
-        final, full = r.feed(c, now=float(i))
+    for c in chunks[:-1]:
+        final, full = r.feed(c)
         assert not final and full is None
-    final, full = r.feed(chunks[-1], now=float(len(chunks)))
+    final, full = r.feed(chunks[-1])
     assert final and full == data
     assert r.pending == 0
 
@@ -39,19 +39,40 @@ def test_duplicate_and_overwritten_chunks():
     chunks = segment.split(data, CHUNK, 1, 2)
     r = segment.Reassembler()
     # A truncated first attempt re-sent from scratch: overwrites by seq.
-    r.feed(chunks[0], 1)
-    r.feed(chunks[0], 5)                  # retry re-appends chunk 0
-    r.feed(chunks[1], 6)
-    r.feed(chunks[2], 7)
-    final, full = r.feed(chunks[3], 8)
+    r.feed(chunks[0])
+    r.feed(chunks[0])                     # retry re-appends chunk 0
+    r.feed(chunks[1])
+    r.feed(chunks[2])
+    final, full = r.feed(chunks[3])
     assert final and full == data
+
+
+def test_dump_load_roundtrip_resumes_groups():
+    """Partial groups survive dump/load (the Snapshot.seg transport):
+    an installer completes a group whose early chunks predate the cut."""
+    data = b"s" * 500
+    chunks = segment.split(data, CHUNK, 11, 3)
+    r = segment.Reassembler()
+    for c in chunks[:3]:
+        r.feed(c)
+    blob = r.dump()
+    r2 = segment.Reassembler.load(blob)
+    assert r2.pending == 1
+    for c in chunks[3:-1]:
+        r2.feed(c)
+    final, full = r2.feed(chunks[-1])
+    assert final and full == data
+    # Empty dump round-trips too.
+    assert segment.Reassembler.load(b"").pending == 0
+    assert segment.Reassembler().dump() == \
+        segment.Reassembler.load(segment.Reassembler().dump()).dump()
 
 
 def test_magic_collision_escape():
     evil = segment.MAGIC + b"not really a chunk"
     wrapped = segment.maybe_wrap(evil, 3, 4)
     assert wrapped is not None and segment.is_chunk(wrapped)
-    final, full = segment.Reassembler().feed(wrapped, 1)
+    final, full = segment.Reassembler().feed(wrapped)
     assert final and full == evil
     assert segment.maybe_wrap(b"ordinary", 3, 4) is None
 
@@ -123,34 +144,27 @@ def test_leader_crash_mid_group_retry_is_exactly_once():
     c.check_logs_consistent()
 
 
-def test_snapshot_gate_blocks_mid_group():
-    """The gate's blocking direction: while a chunk group is in flight
-    at the apply point, make_snapshot() must return None (a snapshot cut
-    there would strand installers with finals missing early chunks)."""
+def test_snapshot_carries_partial_groups():
+    """A snapshot cut mid-group carries the partial buffer
+    (Snapshot.seg); installing it lets the group complete from finals
+    applied ABOVE the snapshot point — no mid-group gating needed."""
     c = Cluster(3, seed=3, sm_factory=KvsStateMachine, seg_chunk=CHUNK)
     leader = c.wait_for_leader()
     chunks = segment.split(b"y" * 400, CHUNK, clt_id=9, req_id=1)
-    # Simulate apply stopping mid-group: early chunks applied, final not.
-    final0, full0 = leader._seg.feed(chunks[0], leader._now)
+    # Apply stops mid-group: early chunks applied, final not.
+    final0, full0 = leader._seg.feed(chunks[0])
     assert not final0 and full0 is None
-    assert leader.make_snapshot() is None, \
-        "snapshot cut while a chunk group is in flight"
-    # Group completes -> the gate lifts.
+    leader._snap_cache = None
+    made = leader.make_snapshot()
+    assert made is not None
+    snap = made[0]
+    assert snap.seg, "partial chunk group missing from the snapshot"
+    # Installer resumes exactly where the snapshot point left off.
+    r2 = segment.Reassembler.load(snap.seg)
     final1 = full1 = None
     for ch in chunks[1:]:
-        final1, full1 = leader._seg.feed(ch, leader._now)
+        final1, full1 = r2.feed(ch)
     assert final1 and full1 == b"y" * 400
-    assert leader.make_snapshot() is not None
-    # Orphan aging: a group whose final never arrives stops blocking
-    # snapshots once the quiet window passes, even with apply parked.
-    orphan = segment.split(b"z" * 300, CHUNK, clt_id=9, req_id=2)
-    leader._snap_cache = None
-    leader._seg.feed(orphan[0], leader._now)
-    assert leader.make_snapshot() is None
-    c.run(leader.SEG_SNAPSHOT_QUIET + 0.5)    # quiescent: no new traffic
-    leader = c.wait_for_leader()
-    assert leader.make_snapshot() is not None, \
-        "stale orphan blocked snapshots forever"
 
 
 def test_joiner_snapshot_under_segmented_traffic():
