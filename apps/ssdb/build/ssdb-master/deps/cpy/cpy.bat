@@ -1,0 +1,3 @@
+
+@echo off
+python %~dp0cpy.py %1 %2 %3 %4 %5 %6 %7 %8 %9

@@ -1,0 +1,17 @@
+
+arr = [10, 11, 12];
+foreach(arr as k=>v){
+	print k, v;
+}
+
+# output: #
+
+d = {
+	'a': 1,
+	'b': 2,
+	'c': 3,
+	};
+
+foreach(d as k=>v){
+	print k, v;
+}

@@ -1,0 +1,8 @@
+
+function f(a, b=1){
+	return a + b;
+}
+
+print f(1);
+print f(1, 2);
+

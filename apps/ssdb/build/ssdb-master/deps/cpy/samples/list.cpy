@@ -1,0 +1,8 @@
+a = []; // empty array
+a.append(1);
+a.append(2);
+print a[0]; // output: 1
+print a; // output: [1, 2]
+
+a = [1, 2];
+print a;

@@ -1,0 +1,8 @@
+
+a = {}; // empty dictionary
+a['x'] = [1, 2];
+a['y'] = [3, 4];
+foreach(a as k=>v1, v2){
+	printf('%s: %d, %d\n', k, v1, v2);
+}
+

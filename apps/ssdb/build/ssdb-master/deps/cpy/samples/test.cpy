@@ -1,0 +1,6 @@
+a = {}; // empty dictionary
+a['x'] = 1;
+a['y'] = 2;
+foreach(a as k,v){
+	print k, v;
+}

@@ -1,0 +1,5 @@
+#ifndef SSDB_DEPS_H
+#ifndef SSDB_VERSION
+#define SSDB_VERSION "1.9.4"
+#endif
+#endif

@@ -354,7 +354,7 @@ class Node:
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
 
-    def make_snapshot(self) -> Optional[tuple[Snapshot, list, Cid, dict]]:
+    def make_snapshot(self) -> tuple[Snapshot, list, Cid, dict]:
         """Snapshot at the current apply point: SM state, endpoint-DB
         dump (exactly-once state must travel with the SM state), plus
         the configuration at that point — CONFIG entries inside the
@@ -855,10 +855,7 @@ class Node:
                 # (leader-driven form of rc_recover_sm, the reference's
                 # joiner instead RDMA-reads it, dare_ibv_rc.c:603-689),
                 # then resume log replication just past it.
-                made = self.make_snapshot()
-                if made is None:
-                    continue        # mid-group gate; retry next tick
-                snap, ep_dump, snap_cid, members = made
+                snap, ep_dump, snap_cid, members = self.make_snapshot()
                 res = self.t.snap_push(peer, my, snap, ep_dump,
                                        snap_cid, members)
                 if res == WriteResult.OK:
@@ -1154,10 +1151,12 @@ class Node:
                             self.stats["applied"] += 1
                             continue
                         if full is None:
-                            # Early chunks below an installed snapshot
-                            # point — cannot happen while make_snapshot
-                            # gates on in-flight groups; surface loudly
-                            # if it ever does.
+                            # The group was evicted under the orphan
+                            # bound (Reassembler.MAX_GROUPS/MAX_BYTES)
+                            # — deterministically, so every replica
+                            # answers this final identically (empty
+                            # reply).  Loud: >4096 concurrent partial
+                            # groups means something is very wrong.
                             self.stats["seg_incomplete"] = \
                                 self.stats.get("seg_incomplete", 0) + 1
                             data = None

@@ -87,41 +87,59 @@ class Reassembler:
 
     A group whose final chunk was truncated by an election is orphaned
     (its client's retry runs under a new capture id); orphans are
-    bounded by ``MAX_GROUPS`` eviction in feed order (a deterministic
-    sequence number, NOT wall time, preserving cross-replica and
-    dump/load determinism)."""
+    bounded by ``MAX_GROUPS``/``MAX_BYTES`` eviction in feed order — a
+    deterministic sequence number that ``dump`` PRESERVES, so replicas
+    that installed a snapshot evict the same groups as replicas that
+    applied the prefix natively (eviction order is part of the
+    replicated state: evicting differently would diverge the SMs when
+    an evicted group's final applies)."""
 
     MAX_GROUPS = 4096
+    #: Byte cap on buffered pieces: bounds Snapshot.seg (orphans could
+    #: otherwise bloat every snapshot push / store record unboundedly).
+    MAX_BYTES = 16 * 1024 * 1024
 
     def __init__(self) -> None:
         #: key -> (seq -> piece, feed_seq)
         self._groups: dict[tuple[int, int],
                            tuple[dict[int, bytes], int]] = {}
         self._feed_seq = 0
+        self._bytes = 0
 
     @property
     def pending(self) -> int:
         return len(self._groups)
 
+    def _evict(self) -> None:
+        while self._groups and (len(self._groups) > self.MAX_GROUPS
+                                or self._bytes > self.MAX_BYTES):
+            oldest = min(self._groups, key=lambda k: self._groups[k][1])
+            group, _ = self._groups.pop(oldest)
+            self._bytes -= sum(len(p) for p in group.values())
+
     def feed(self, payload: bytes) -> tuple[bool, Optional[bytes]]:
         """Absorb one applied chunk.  Returns (final, full_payload):
         ``final`` is True when this chunk closes its group — then
         ``full_payload`` is the reassembled record, or None if earlier
-        chunks are missing (a protocol violation now that partial
-        buffers ride snapshots; counted loudly by the caller)."""
+        chunks are missing (the group was evicted under the
+        MAX_GROUPS/MAX_BYTES orphan bound — deterministically, on every
+        replica alike; counted loudly by the caller)."""
         clt, req, seq, total, piece = parse(payload)
         key = (clt, req)
         entry = self._groups.get(key)
         group = entry[0] if entry is not None else {}
+        if seq in group:
+            self._bytes -= len(group[seq])
         group[seq] = piece
         if seq != total - 1:
             self._feed_seq += 1
+            self._bytes += len(piece)
             self._groups[key] = (group, self._feed_seq)
-            if len(self._groups) > self.MAX_GROUPS:
-                oldest = min(self._groups, key=lambda k: self._groups[k][1])
-                self._groups.pop(oldest, None)
+            self._evict()
             return False, None
-        self._groups.pop(key, None)
+        if key in self._groups:
+            self._groups.pop(key)
+            self._bytes -= sum(len(p) for p in group.values()) - len(piece)
         if len(group) != total:
             return True, None
         return True, b"".join(group[k] for k in range(total))
@@ -129,16 +147,21 @@ class Reassembler:
     def prune(self, clt_id: int, req_id: int) -> None:
         """Drop a buffered group (its final chunk was deduplicated —
         the logical record already applied in a previous incarnation)."""
-        self._groups.pop((clt_id, req_id), None)
+        entry = self._groups.pop((clt_id, req_id), None)
+        if entry is not None:
+            self._bytes -= sum(len(p) for p in entry[0].values())
 
     # -- snapshot transport ------------------------------------------------
 
     def dump(self) -> bytes:
-        """Serialize the partial groups (sorted keys: deterministic)."""
-        out = [struct.pack("<I", len(self._groups))]
+        """Serialize the partial groups WITH their feed sequence
+        numbers: eviction order is part of the replicated state (see
+        class docstring), so an installer must continue evicting in the
+        same order a natively-caught-up replica would."""
+        out = [struct.pack("<IQ", len(self._groups), self._feed_seq)]
         for (clt, req) in sorted(self._groups):
-            group, _ = self._groups[(clt, req)]
-            out.append(struct.pack("<QQI", clt, req, len(group)))
+            group, fseq = self._groups[(clt, req)]
+            out.append(struct.pack("<QQQI", clt, req, fseq, len(group)))
             for seq in sorted(group):
                 piece = group[seq]
                 out.append(struct.pack("<II", seq, len(piece)))
@@ -150,17 +173,18 @@ class Reassembler:
         r = Reassembler()
         if not blob:
             return r
-        (ngroups,) = struct.unpack_from("<I", blob, 0)
-        off = 4
+        ngroups, feed_seq = struct.unpack_from("<IQ", blob, 0)
+        r._feed_seq = feed_seq
+        off = 12
         for _ in range(ngroups):
-            clt, req, npieces = struct.unpack_from("<QQI", blob, off)
-            off += 20
+            clt, req, fseq, npieces = struct.unpack_from("<QQQI", blob, off)
+            off += 28
             group: dict[int, bytes] = {}
             for _ in range(npieces):
                 seq, n = struct.unpack_from("<II", blob, off)
                 off += 8
                 group[seq] = blob[off:off + n]
                 off += n
-            r._feed_seq += 1
-            r._groups[(clt, req)] = (group, r._feed_seq)
+            r._groups[(clt, req)] = (group, fseq)
+            r._bytes += sum(len(p) for p in group.values())
         return r

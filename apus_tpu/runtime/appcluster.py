@@ -189,6 +189,28 @@ REDIS_TARBALL = os.environ.get(
     "APUS_REDIS_TARBALL",
     "/root/reference/apps/redis/redis-2.8.17.tar.gz")
 
+#: Pinned unmodified ssdb (the reference's third app, apps/ssdb/mk) —
+#: speaks the redis protocol, so RespClient drives it too.
+SSDB_RUN = os.path.join(REPO_ROOT, "apps", "ssdb", "run")
+SSDB_SERVER = os.path.join(REPO_ROOT, "apps", "ssdb", "build",
+                           "ssdb-master", "ssdb-server")
+SSDB_TARBALL = os.environ.get(
+    "APUS_SSDB_TARBALL", "/root/reference/apps/ssdb/master.tar.gz")
+
+
+def build_ssdb() -> bool:
+    """Build the pinned ssdb from the vendored third-party tarball
+    (apps/ssdb/mk).  Returns False when unavailable."""
+    if os.path.exists(SSDB_SERVER):
+        return True
+    mk = os.path.join(REPO_ROOT, "apps", "ssdb", "mk")
+    try:
+        subprocess.run([mk], check=True, capture_output=True, timeout=600)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
+        return False
+    return os.path.exists(SSDB_SERVER)
+
 
 def build_redis() -> bool:
     """Build the pinned redis from the vendored third-party tarball

@@ -17,7 +17,7 @@ Exit code 0 iff every check passes.  Use ``--devices N`` with
 ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
 for a virtual mesh, or run bare on real hardware.
 
-Usage: python benchmarks/meshcheck.py [--devices N] [--timeout S]
+Usage: python benchmarks/meshcheck.py [--devices N]
 """
 
 from __future__ import annotations
@@ -156,7 +156,8 @@ def main() -> int:
         failures += 1
 
     _mark("PASS" if failures == 0 else "FAIL", "meshcheck",
-          f"{4 - failures}/4 fabric checks ok on {n}-device mesh")
+          f"backend init + {4 - failures}/4 data-plane checks ok on "
+          f"{n}-device mesh")
     return 0 if failures == 0 else 1
 
 

@@ -74,6 +74,16 @@ class RespDriver:
         return c.cmd("DBSIZE")
 
 
+class SsdbDriver(RespDriver):
+    """ssdb speaks RESP but its DBSIZE is a leveldb byte estimate;
+    count keys with a full-range ``keys`` scan instead (the
+    ssdb-bench verification shape, run.sh:71-73)."""
+
+    @staticmethod
+    def count(c):
+        return len(c.cmd("keys", "", "", "1000000000"))
+
+
 def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
           value: str) -> dict:
     """C client threads, each issuing requests/C ops at the leader app."""
@@ -147,6 +157,10 @@ def main() -> int:
                          "(apps/redis/run, RESP protocol) — the "
                          "reference's flagship benchmark shape "
                          "(redis-benchmark -t set,get, run.sh:70-80)")
+    ap.add_argument("--ssdb", action="store_true",
+                    help="drive the pinned unmodified ssdb "
+                         "(apps/ssdb/run; ssdb-bench shape, "
+                         "run.sh:71-73)")
     ap.add_argument("--device-plane", action="store_true",
                     help="replicate through the jitted device commit "
                          "step (runtime.device_plane); host TCP stays "
@@ -164,6 +178,14 @@ def main() -> int:
             return 2
         app_argv = [REDIS_RUN]
         drv = RespDriver
+    elif args.ssdb:
+        from apus_tpu.runtime.appcluster import SSDB_RUN, build_ssdb
+        if not build_ssdb():
+            print("pinned ssdb unavailable (no tarball, no binary)",
+                  file=sys.stderr)
+            return 2
+        app_argv = [SSDB_RUN]
+        drv = SsdbDriver
 
     with ProxiedCluster(args.replicas, app_argv=app_argv,
                         device_plane=args.device_plane) as pc:

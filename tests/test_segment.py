@@ -68,6 +68,36 @@ def test_dump_load_roundtrip_resumes_groups():
         segment.Reassembler.load(segment.Reassembler().dump()).dump()
 
 
+def test_dump_load_preserves_eviction_order():
+    """Eviction order is replicated state: an installer must evict the
+    SAME groups a natively-caught-up replica would, or their SMs
+    diverge when an evicted group's final applies.  dump/load therefore
+    preserves feed sequence numbers exactly."""
+    a = segment.Reassembler()
+    for req in (1, 2, 3):                  # fed in this order
+        a.feed(segment.split(b"q" * 200, CHUNK, 5, req)[0])
+    b = segment.Reassembler.load(a.dump())
+    assert b.dump() == a.dump()
+    # Force one eviction on each: the OLDEST (req=1) must go on both.
+    a.MAX_GROUPS = b.MAX_GROUPS = 3
+    newer = segment.split(b"q" * 200, CHUNK, 5, 9)[0]
+    a.feed(newer)
+    b.feed(newer)
+    assert (5, 1) not in a._groups and (5, 1) not in b._groups
+    assert set(a._groups) == set(b._groups)
+
+
+def test_byte_cap_bounds_buffer_and_snapshot():
+    r = segment.Reassembler()
+    r.MAX_BYTES = 4096
+    big = b"B" * 1024
+    for req in range(10):                  # 10 orphans x ~1KB pieces
+        r.feed(segment.split(big + big, 1024, 7, req)[0])
+    assert r._bytes <= r.MAX_BYTES
+    assert r.pending <= 4
+    assert len(r.dump()) < 3 * r.MAX_BYTES
+
+
 def test_magic_collision_escape():
     evil = segment.MAGIC + b"not really a chunk"
     wrapped = segment.maybe_wrap(evil, 3, 4)

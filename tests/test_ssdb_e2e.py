@@ -1,0 +1,56 @@
+"""Real unmodified ssdb made fault-tolerant via LD_PRELOAD.
+
+The reference's third replicated app (apps/ssdb/mk,run; ssdb-bench
+drives it in benchmarks/run.sh:71-73).  ssdb speaks the redis wire
+protocol, so the same RespClient drives it.  Skipped when neither the
+pinned tarball nor a built binary is available.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from apus_tpu.runtime.appcluster import (SSDB_RUN, SSDB_SERVER,
+                                         SSDB_TARBALL, ProxiedCluster,
+                                         RespClient, build_native,
+                                         build_ssdb)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(SSDB_SERVER) or os.path.exists(SSDB_TARBALL)),
+    reason="pinned ssdb unavailable (no tarball, no built binary)")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native(tmp_path_factory):
+    build_native()
+    if not build_ssdb():
+        pytest.skip("pinned ssdb failed to build")
+    # Per-test-run var dirs (each app instance keys its own by port).
+    os.environ["TMPDIR"] = str(tmp_path_factory.mktemp("ssdb-var"))
+
+
+def test_ssdb_replicates_to_followers():
+    with ProxiedCluster(3, app_argv=[SSDB_RUN]) as pc:
+        leader = pc.leader_idx()
+        with RespClient(pc.app_addr(leader)) as c:
+            for i in range(20):
+                assert c.cmd("set", f"sk:{i}", f"sv:{i}") == "OK"
+            assert c.cmd("get", "sk:7") == b"sv:7"
+        # GET-after-SET on every replica's ssdb (run.sh's criterion).
+        deadline = time.monotonic() + 20
+        for i in range(3):
+            if pc.apps[i] is None:
+                continue
+            last = None
+            while time.monotonic() < deadline:
+                with RespClient(pc.app_addr(i)) as c:
+                    last = c.cmd("get", "sk:19")
+                if last == b"sv:19":
+                    break
+                time.sleep(0.2)
+            assert last == b"sv:19", (i, last)
+            with RespClient(pc.app_addr(i)) as c:
+                assert c.cmd("get", "sk:0") == b"sv:0"
