@@ -199,32 +199,27 @@ SSDB_TARBALL = os.environ.get(
 
 
 def build_ssdb() -> bool:
-    """Build the pinned ssdb from the vendored third-party tarball
-    (apps/ssdb/mk).  Returns False when unavailable."""
-    if os.path.exists(SSDB_SERVER):
+    return _build_app(SSDB_SERVER, "ssdb", timeout=600)
+
+
+def _build_app(server_path: str, app_dir: str, timeout: float) -> bool:
+    """Build a pinned third-party app via its apps/<name>/mk script.
+    Returns False when the binary can't be produced (no tarball /
+    missing build deps) — callers skip app-specific paths."""
+    if os.path.exists(server_path):
         return True
-    mk = os.path.join(REPO_ROOT, "apps", "ssdb", "mk")
+    mk = os.path.join(REPO_ROOT, "apps", app_dir, "mk")
     try:
-        subprocess.run([mk], check=True, capture_output=True, timeout=600)
+        subprocess.run([mk], check=True, capture_output=True,
+                       timeout=timeout)
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
             OSError):
         return False
-    return os.path.exists(SSDB_SERVER)
+    return os.path.exists(server_path)
 
 
 def build_redis() -> bool:
-    """Build the pinned redis from the vendored third-party tarball
-    (apps/redis/mk).  Returns False when neither a built binary nor the
-    tarball is available (callers skip redis-specific paths)."""
-    if os.path.exists(REDIS_SERVER):
-        return True
-    mk = os.path.join(REPO_ROOT, "apps", "redis", "mk")
-    try:
-        subprocess.run([mk], check=True, capture_output=True, timeout=300)
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-            OSError):
-        return False
-    return os.path.exists(REDIS_SERVER)
+    return _build_app(REDIS_SERVER, "redis", timeout=300)
 
 
 class RespClient:

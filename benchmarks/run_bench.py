@@ -165,7 +165,15 @@ def main() -> int:
                     help="replicate through the jitted device commit "
                          "step (runtime.device_plane); host TCP stays "
                          "control plane + catch-up")
+    ap.add_argument("--proc", action="store_true",
+                    help="one replica per OS process at the production "
+                         "timing envelope (run.sh deployment shape) "
+                         "instead of the in-process thread cluster")
     args = ap.parse_args()
+    if args.proc and args.device_plane:
+        print("--proc and --device-plane are mutually exclusive (the "
+              "device runner shares one in-process mesh)", file=sys.stderr)
+        return 2
 
     value = "x" * args.value_bytes
     app_argv = args.app.split() if args.app else None
@@ -187,8 +195,20 @@ def main() -> int:
         app_argv = [SSDB_RUN]
         drv = SsdbDriver
 
-    with ProxiedCluster(args.replicas, app_argv=app_argv,
-                        device_plane=args.device_plane) as pc:
+    if args.proc:
+        from apus_tpu.runtime.proc import ProcCluster
+        pc_factory = lambda: ProcCluster(  # noqa: E731
+            args.replicas, app_argv=app_argv or "toyserver")
+    else:
+        pc_factory = lambda: ProxiedCluster(  # noqa: E731
+            args.replicas, app_argv=app_argv,
+            device_plane=args.device_plane)
+
+    def app_alive(pc, i):
+        return (pc.apps[i] if hasattr(pc, "apps") else pc.procs[i]) \
+            is not None
+
+    with pc_factory() as pc:
         results = [drive(pc, drv, "set", args.requests, args.clients, value),
                    drive(pc, drv, "get", args.requests, args.clients, value)]
 
@@ -201,7 +221,7 @@ def main() -> int:
         counts = {}
         deadline = time.monotonic() + 15.0
         for i in range(args.replicas):
-            if pc.apps[i] is None:
+            if not app_alive(pc, i):
                 continue
             while time.monotonic() < deadline:
                 with drv.make(pc.app_addr(i)) as c:

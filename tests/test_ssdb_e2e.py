@@ -28,8 +28,15 @@ def native(tmp_path_factory):
     build_native()
     if not build_ssdb():
         pytest.skip("pinned ssdb failed to build")
-    # Per-test-run var dirs (each app instance keys its own by port).
+    # Per-test-run var dirs (each app instance keys its own by port);
+    # restored afterwards so later modules see the real TMPDIR.
+    saved = os.environ.get("TMPDIR")
     os.environ["TMPDIR"] = str(tmp_path_factory.mktemp("ssdb-var"))
+    yield
+    if saved is None:
+        os.environ.pop("TMPDIR", None)
+    else:
+        os.environ["TMPDIR"] = saved
 
 
 def test_ssdb_replicates_to_followers():
