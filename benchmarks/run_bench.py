@@ -197,18 +197,17 @@ def main() -> int:
 
     if args.proc:
         from apus_tpu.runtime.proc import ProcCluster
-        pc_factory = lambda: ProcCluster(  # noqa: E731
-            args.replicas, app_argv=app_argv or "toyserver")
+        cluster = ProcCluster(args.replicas,
+                              app_argv=app_argv or "toyserver")
     else:
-        pc_factory = lambda: ProxiedCluster(  # noqa: E731
-            args.replicas, app_argv=app_argv,
-            device_plane=args.device_plane)
+        cluster = ProxiedCluster(args.replicas, app_argv=app_argv,
+                                 device_plane=args.device_plane)
 
     def app_alive(pc, i):
         return (pc.apps[i] if hasattr(pc, "apps") else pc.procs[i]) \
             is not None
 
-    with pc_factory() as pc:
+    with cluster as pc:
         results = [drive(pc, drv, "set", args.requests, args.clients, value),
                    drive(pc, drv, "get", args.requests, args.clients, value)]
 
