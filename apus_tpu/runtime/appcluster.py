@@ -121,7 +121,7 @@ class ProxiedCluster:
         return subprocess.Popen(argv, env=env, stdout=self._app_logs[i],
                                 stderr=subprocess.STDOUT)
 
-    def _wait_app(self, i: int, timeout: float = 10.0) -> None:
+    def _wait_app(self, i: int, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             try:

@@ -138,6 +138,46 @@ class DeviceCommitRunner:
         # One replica's offsets row, as a NEW buffer: shard_end must not
         # hand out a view of the (donated) devlog arrays.
         self._offs_one = jax.jit(lambda o, r: o[r])
+        # Leader-row expansion ON DEVICE: the host ships only the
+        # leader's [B,SB] batch; the [R,B,SB] leader-row-only layout the
+        # step consumes (zeros elsewhere) is built by XLA.  Staging a
+        # host-side [R,B,SB] zeros array instead (ops.commit.place_batch)
+        # costs ~1 MB of alloc+transfer of zeros per round — measured at
+        # ~30% of the live round on the bench's live-runner phase.
+        import jax.numpy as jnp
+
+        R, B, SB = self.n_replicas, self.batch, self.slot_bytes
+
+        def _expand(bd, bm, leader):
+            # DYNAMIC leader index (one program for every leader): a
+            # static leader would recompile on the first round of each
+            # new leadership — a multi-second stall the driver's own
+            # watchdog would misread as a wedged device plane.
+            data = jnp.zeros((R, B, SB), jnp.uint8) \
+                .at[leader].set(bd)
+            meta = jnp.zeros((R, B, 4), jnp.int32) \
+                .at[leader].set(bm)
+            return data, meta
+
+        self._place_dev = jax.jit(
+            _expand, out_shardings=(self._sharding, self._sharding))
+        # On the CPU backend there is no transfer to save and the
+        # jitted zeros+scatter costs MORE than the plain host staging
+        # (measured on the bench's live-runner phase) — keep the
+        # host-side place_batch there.
+        self._use_device_expand = jax.default_backend() != "cpu"
+
+        def _place(bd, bm, leader):
+            if self._use_device_expand:
+                return self._place_dev(bd, bm, np.int32(leader))
+            from apus_tpu.ops.commit import place_batch
+            return place_batch(self._mesh, R, leader, bd, bm)
+
+        self._place = _place
+        #: CommitControl template cache: all fields but ``end0`` are
+        #: constant within (leader, term, cid, live) — rebuilding seven
+        #: device scalars per round is measurable host overhead.
+        self._ctrl_cache: Optional[tuple] = None
         self._jax = jax
         self._warmup()
         self._built = True
@@ -148,16 +188,15 @@ class DeviceCommitRunner:
         whole window to the host path (and once wedged a killed
         daemon's zombie driver inside it, pre-fencing)."""
         from apus_tpu.core.cid import Cid
-        from apus_tpu.ops.commit import place_batch
         from apus_tpu.ops.logplane import make_device_log
 
         B, SB, R = self.batch, self.slot_bytes, self.n_replicas
         devlog = make_device_log(R, self.n_slots, SB, batch=B,
                                  first_idx=1, leader=0, term=1,
                                  sharding=self._sharding)
-        bdata, bmeta = place_batch(self._mesh, R, 0,
-                                   np.zeros((B, SB), np.uint8),
-                                   np.zeros((B, 4), np.int32))
+        bdata, bmeta = self._place(np.zeros((B, SB), np.uint8),
+                                   np.zeros((B, 4), np.int32), 0)
+        self._jax.block_until_ready(bdata)
         ctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
                                live=set(range(R)))
         _, _, commit = self._step(devlog, bdata, bmeta, ctrl)
@@ -211,8 +250,6 @@ class DeviceCommitRunner:
         idx-contiguous from ``end0``) to every shard and evaluate the
         masked quorum.  Returns (acks, device_commit) or None if ``gen``
         is stale."""
-        from apus_tpu.ops.commit import CommitControl, place_batch
-
         B, SB = self.batch, self.slot_bytes
         assert len(entries) == B, (len(entries), B)
         with self.lock:
@@ -240,9 +277,9 @@ class DeviceCommitRunner:
             bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
             bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
                         int(e.type), len(blob))
-        pdata, pmeta = place_batch(self._mesh, self.n_replicas,
-                                   leader, bdata, bmeta)
+        pdata, pmeta = self._place(bdata, bmeta, leader)
         ctrl = self._make_ctrl(cid, leader, term, end0, live)
+        del bdata, bmeta
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None            # reset raced the staging: discard
@@ -264,12 +301,22 @@ class DeviceCommitRunner:
                    live: set[int]):
         """CommitControl with the quorum vote masked to live members.
         Masking shrinks only the numerator: quorum thresholds stay
-        derived from the full configuration sizes."""
+        derived from the full configuration sizes.
+
+        Everything but ``end0`` is constant within a (leader, term, cid,
+        live) epoch, so the device scalars are cached and only ``end0``
+        is re-staged per round."""
+        import dataclasses as _dc
+
         import jax.numpy as jnp
 
         from apus_tpu.core.cid import CidState
         from apus_tpu.ops.commit import CommitControl
 
+        key = (leader, term, repr(cid), tuple(sorted(live)))
+        if self._ctrl_cache is not None and self._ctrl_cache[0] == key:
+            return _dc.replace(self._ctrl_cache[1],
+                               end0=jnp.asarray(end0, jnp.int32))
         R = self.n_replicas
         mask_old = np.array(
             [1 if (cid.contains(i) and i < cid.size and i in live) else 0
@@ -283,9 +330,11 @@ class DeviceCommitRunner:
             mask_new = np.zeros(R, np.int32)
             q_new = 0
         i32 = lambda v: jnp.asarray(v, jnp.int32)   # noqa: E731
-        return CommitControl(i32(leader), i32(term), i32(end0),
+        ctrl = CommitControl(i32(leader), i32(term), i32(end0),
                              jnp.asarray(mask_old), jnp.asarray(mask_new),
                              i32(quorum_size(cid.size)), i32(q_new))
+        self._ctrl_cache = (key, ctrl)
+        return ctrl
 
     # -- follower shard readback -----------------------------------------
 

@@ -21,7 +21,7 @@ from apus_tpu.models.kvs import KvsStateMachine, encode_get, encode_put
 from apus_tpu.runtime.cluster import LocalCluster
 
 
-def _wait(pred, timeout=10.0, msg="condition"):
+def _wait(pred, timeout=30.0, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():
