@@ -126,6 +126,7 @@ class Replayer:
         self.failed = 0          # records given up on after retries
         self.reprimes = 0        # full history re-primes performed
         self.dirty = False       # app state diverged; re-prime pending
+        self._stopping = False
         #: _connect attempts (x100ms); tests shrink this so the
         #: app-down failure path stays fast.
         self.connect_attempts = 50
@@ -141,6 +142,10 @@ class Replayer:
         self._thread = t
 
     def stop(self) -> None:
+        # Quiet shutdown: records still queued behind the sentinel are
+        # best-effort — failures must not trigger retries/re-primes
+        # against an app that is being torn down with us.
+        self._stopping = True
         self._q.put(None)
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -177,6 +182,8 @@ class Replayer:
                 self._replay(action, conn_id, data)
                 self.replayed += 1
             except OSError as e:
+                if self._stopping:
+                    continue      # teardown race, not app divergence
                 # A committed record could not be applied to the local
                 # app even with bounded reconnection: the app has
                 # diverged from the replicated history (likely crashed
