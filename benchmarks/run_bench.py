@@ -143,6 +143,50 @@ def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
     }
 
 
+def redis_benchmark(pc, requests: int, clients: int,
+                    value_bytes: int) -> dict | None:
+    """Run the pinned build's own redis-benchmark at the leader's
+    replicated redis (the run.sh:70-80 measurement, verbatim tool)."""
+    import subprocess
+
+    from apus_tpu.runtime.appcluster import REDIS_SERVER
+    bench = os.path.join(os.path.dirname(REDIS_SERVER), "redis-benchmark")
+    if not os.path.exists(bench):
+        return None
+    host, port = pc.app_addr(pc.leader_idx())
+    try:
+        proc = subprocess.run(
+            [bench, "-h", host, "-p", str(port), "-t", "set,get",
+             "-n", str(requests), "-c", str(clients),
+             "-d", str(value_bytes), "-q"],
+            stdout=subprocess.PIPE, text=True, timeout=300)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print(f"redis-benchmark failed: {e}", file=sys.stderr)
+        return None
+    rps = {}
+    for line in proc.stdout.splitlines():  # "SET: 843.17 requests per second"
+        if ":" in line and "requests per second" in line:
+            op, rest = line.split(":", 1)
+            try:
+                rps[op.strip().lower()] = float(rest.split()[0])
+            except (ValueError, IndexError):
+                pass
+    if proc.returncode != 0 or "set" not in rps:
+        # A missing measurement must be VISIBLY missing, never a 0.0
+        # that reads as a catastrophic regression downstream.
+        print(f"redis-benchmark rc={proc.returncode}, parsed={rps}; "
+              f"output tail: {proc.stdout[-300:]!r}", file=sys.stderr)
+        return None
+    return {
+        "metric": "redis_benchmark_rps",
+        "value": rps["set"],
+        "unit": "ops/sec(set)",
+        "detail": {"tool": "redis-benchmark (pinned build)",
+                   "requests": requests, "clients": clients,
+                   "value_bytes": value_bytes, **rps},
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=3)
@@ -210,6 +254,15 @@ def main() -> int:
     with cluster as pc:
         results = [drive(pc, drv, "set", args.requests, args.clients, value),
                    drive(pc, drv, "get", args.requests, args.clients, value)]
+
+        if args.redis:
+            # The reference's OWN benchmark tool against the replicated
+            # redis (redis-benchmark -t set,get, run.sh:70-80) — built
+            # alongside the pinned server by apps/redis/mk.
+            r = redis_benchmark(pc, args.requests, args.clients,
+                                args.value_bytes)
+            if r is not None:
+                results.append(r)
 
         # Replication check: every live replica's app converges to the
         # same key count (GET-after-SET on all replicas, run.sh's

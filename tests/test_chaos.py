@@ -52,6 +52,15 @@ def test_chaos_soak_crashes_partitions_loss():
             c.run(0.5)
             c.transport.heal()
         c.run(1.0)
+        # Election safety: never two leaders in one term among live
+        # nodes (Raft invariant; a stale partitioned "leader" of an
+        # OLDER term is legal — PreVote deposes it on heal).
+        by_term: dict[int, set] = {}
+        for n in c.nodes:
+            if n.idx not in c.transport.crashed and n.is_leader:
+                by_term.setdefault(n.current_term, set()).add(n.idx)
+        for term, who in by_term.items():
+            assert len(who) == 1, f"two leaders in term {term}: {who}"
         # Availability: a quorum is up (>=3 of 5), so writes commit.
         burst(5)
         # Durability: every acknowledged write is still readable.
@@ -66,11 +75,15 @@ def test_chaos_soak_crashes_partitions_loss():
             c.run(1.0)
 
     # Final convergence: all nodes recovered, everything everywhere.
+    # (Target hoisted OUT of the predicate: wait_for_leader re-steps
+    # the sim, so calling it per predicate evaluation would both skew
+    # the clock and raise its own assert on transient leader loss.)
     for idx in list(c.transport.crashed):
         c.recover(idx)
+    target = c.wait_for_leader().log.commit
+    assert target > 1
     assert c.run_until(
-        lambda: all(n.log.apply >= c.wait_for_leader().log.commit > 1
-                    for n in c.nodes), timeout=30.0)
+        lambda: all(n.log.apply >= target for n in c.nodes), timeout=30.0)
     for n in c.nodes:
         for k, v in acknowledged.items():
             assert n.sm.store.get(k) == v, (n.idx, k)
@@ -98,9 +111,9 @@ def test_chaos_with_segmentation_and_big_records():
                 c.run(1.0)
                 c.recover(victim)
         c.run(1.0)
+    target = c.wait_for_leader().log.commit
     assert c.run_until(
-        lambda: all(n.log.apply >= c.wait_for_leader().log.commit
-                    for n in c.nodes), timeout=30.0)
+        lambda: all(n.log.apply >= target for n in c.nodes), timeout=30.0)
     for n in c.nodes:
         for k, v in acknowledged.items():
             assert n.sm.store.get(k) == v, (n.idx, k)
