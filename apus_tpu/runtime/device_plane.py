@@ -84,6 +84,11 @@ class DeviceCommitRunner:
     """Process-wide device-plane engine: HBM log shards + jitted commit
     step, shared by all in-process replica daemons."""
 
+    #: Rounds per pipelined dispatch (commit_rounds): one lax.scan
+    #: program covering PIPE_DEPTH consecutive rounds, used by the
+    #: driver when the backlog allows.
+    PIPE_DEPTH = 4
+
     def __init__(self, n_replicas: int, n_slots: int = 4096,
                  slot_bytes: int = 4096, batch: int = 64,
                  devices=None, logger=None):
@@ -102,7 +107,7 @@ class DeviceCommitRunner:
         self._term = 0
         self._built = False
         self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
-                      "entries_devplane": 0}
+                      "entries_devplane": 0, "pipelined_dispatches": 0}
         # Build + compile eagerly: a lazy multi-second first compile
         # would hand the opening of every first leadership to the host
         # path (and leave the device cursor behind a pruned head).
@@ -174,6 +179,44 @@ class DeviceCommitRunner:
             return place_batch(self._mesh, R, leader, bd, bm)
 
         self._place = _place
+
+        # Pipelined dispatch: K consecutive rounds inside ONE XLA
+        # program (lax.scan) — the live form of the reference's many-
+        # outstanding-WRs pipelining (post_send selective signaling,
+        # dare_ibv_rc.c:2552-2568).  The driver uses it whenever the
+        # host backlog covers K full batches, cutting dispatch+sync
+        # overhead per round by ~K.
+        from apus_tpu.ops.commit import build_pipelined_commit_step
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apus_tpu.ops.mesh import REPLICA_AXIS
+        K = self.PIPE_DEPTH
+        self._pipe = build_pipelined_commit_step(
+            self._mesh, R, self.n_slots, SB, B, depth=K, staged_depth=K)
+        staged_sh = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
+        self._staged_sharding = staged_sh
+
+        def _expand_staged(bd, bm, leader):
+            data = jnp.zeros((K, R, B, SB), jnp.uint8) \
+                .at[:, leader].set(bd)
+            meta = jnp.zeros((K, R, B, 4), jnp.int32) \
+                .at[:, leader].set(bm)
+            return data, meta
+
+        self._place_staged_dev = jax.jit(
+            _expand_staged, out_shardings=(staged_sh, staged_sh))
+
+        def _place_staged(bd, bm, leader):
+            if self._use_device_expand:
+                return self._place_staged_dev(bd, bm, np.int32(leader))
+            data = np.zeros((K, R, B, SB), np.uint8)
+            meta = np.zeros((K, R, B, 4), np.int32)
+            data[:, leader] = bd
+            meta[:, leader] = bm
+            return (jax.device_put(data, staged_sh),
+                    jax.device_put(meta, staged_sh))
+
+        self._place_staged = _place_staged
         #: CommitControl template cache: all fields but ``end0`` are
         #: constant within (leader, term, cid, live) — rebuilding seven
         #: device scalars per round is measurable host overhead.
@@ -199,8 +242,18 @@ class DeviceCommitRunner:
         self._jax.block_until_ready(bdata)
         ctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
                                live=set(range(R)))
-        _, _, commit = self._step(devlog, bdata, bmeta, ctrl)
+        devlog, _, commit = self._step(devlog, bdata, bmeta, ctrl)
         self._jax.block_until_ready(commit)
+        # Pipelined program too (compiled now, never mid-leadership),
+        # reusing the step's returned devlog — a second make_device_log
+        # would allocate+transfer another full shard set just to warm a
+        # compile that only needs shapes/shardings.  (Rounds land in
+        # scratch: the warm devlog's end is past ctrl.end0 — harmless.)
+        K = self.PIPE_DEPTH
+        sdata, smeta = self._place_staged(np.zeros((K, B, SB), np.uint8),
+                                          np.zeros((K, B, 4), np.int32), 0)
+        _, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
+        self._jax.block_until_ready(commits)
 
     #: bytes of wire-codec overhead per slot payload (encode_entry
     #: header + optional cid, upper bound).  The authoritative gate is
@@ -265,18 +318,7 @@ class DeviceCommitRunner:
         # would crash; every *blocking wait* happens outside it, so
         # follower drains and shard_end polls never serialize behind a
         # round's device execution (nor behind a hung dispatch).
-        bdata = np.zeros((B, SB), np.uint8)
-        bmeta = np.zeros((B, 4), np.int32)
-        for j, e in enumerate(entries):
-            assert e.idx == end0 + j, (e.idx, end0, j)
-            blob = wire.encode_entry(e)
-            if len(blob) > SB:
-                raise ValueError(
-                    f"entry {e.idx} wire size {len(blob)} > slot "
-                    f"{SB}; segment upstream")
-            bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
-            bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
-                        int(e.type), len(blob))
+        bdata, bmeta = self._encode_batch(entries, end0)
         pdata, pmeta = self._place(bdata, bmeta, leader)
         ctrl = self._make_ctrl(cid, leader, term, end0, live)
         del bdata, bmeta
@@ -296,6 +338,65 @@ class DeviceCommitRunner:
         if commit_host < end0 + B:
             self.stats["quorum_fail_rounds"] += 1
         return acks_host, commit_host
+
+    def _encode_batch(self, entries: list[LogEntry], end0: int):
+        """Wire-encode one idx-contiguous batch into slot rows."""
+        B, SB = self.batch, self.slot_bytes
+        bdata = np.zeros((B, SB), np.uint8)
+        bmeta = np.zeros((B, 4), np.int32)
+        for j, e in enumerate(entries):
+            assert e.idx == end0 + j, (e.idx, end0, j)
+            blob = wire.encode_entry(e)
+            if len(blob) > SB:
+                raise ValueError(
+                    f"entry {e.idx} wire size {len(blob)} > slot "
+                    f"{SB}; segment upstream")
+            bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
+            bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
+                        int(e.type), len(blob))
+        return bdata, bmeta
+
+    def commit_rounds(self, gen: int, end0: int, entries: list[LogEntry],
+                      cid, live: set[int]) -> Optional[int]:
+        """PIPE_DEPTH consecutive commit rounds in ONE dispatch
+        (lax.scan; the live analog of the reference's outstanding-WR
+        pipelining).  ``entries`` is exactly PIPE_DEPTH*batch entries,
+        idx-contiguous from ``end0``.  Returns the device commit index
+        after the last round, or None if ``gen`` is stale.  Same lock
+        discipline as commit_round."""
+        K, B = self.PIPE_DEPTH, self.batch
+        assert len(entries) == K * B, (len(entries), K, B)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            leader, term = self._leader, self._term
+        bd = np.zeros((K, B, self.slot_bytes), np.uint8)
+        bm = np.zeros((K, B, 4), np.int32)
+        for k in range(K):
+            bd[k], bm[k] = self._encode_batch(
+                entries[k * B:(k + 1) * B], end0 + k * B)
+        sdata, smeta = self._place_staged(bd, bm, leader)
+        ctrl = self._make_ctrl(cid, leader, term, end0, live)
+        del bd, bm
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None            # reset raced the staging: discard
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            new_devlog, commits, _ = self._pipe(self._devlog, sdata,
+                                                smeta, ctrl)
+            self._devlog = new_devlog
+            self._next_end0 = end0 + K * B
+            self.stats["rounds"] += K
+            self.stats["entries_devplane"] += K * B
+            self.stats["pipelined_dispatches"] += 1
+        self._jax.block_until_ready(commits)
+        commits_host = np.asarray(commits)
+        # Per-round accounting (parity with the single-round path: a
+        # dispatch where all K rounds miss quorum counts K, not 1).
+        self.stats["quorum_fail_rounds"] += int(sum(
+            int(commits_host[k]) < end0 + (k + 1) * B for k in range(K)))
+        return int(commits_host[-1])
 
     def _make_ctrl(self, cid, leader: int, term: int, end0: int,
                    live: set[int]):
@@ -561,20 +662,37 @@ class DevicePlaneDriver:
                 node.log.append(term, type=EntryType.NOOP)
             if (node.log.end - 1) % B != 0:
                 return False               # log full: wait for pruning
-        entries = list(node.log.entries(self._dev_next,
-                                        self._dev_next + B))
-        if len(entries) != B:
-            return False
-        if any(len(wire.encode_entry(e)) > self.runner.slot_bytes
-               for e in entries):
-            # Oversized record: this span must commit via the host path;
-            # re-base the device plane past it once that happens.
-            self.stats["holes"] += 1
-            if node.external_commit:
-                node.external_commit = False
-            if node.log.commit >= self._dev_next + B:
-                self._gen = None           # re-base next iteration
-            return False
+        # Pipelined dispatch when the backlog covers K clean batches:
+        # K rounds ride one XLA program (runner.commit_rounds) instead
+        # of K dispatch+sync cycles.
+        K = self.runner.PIPE_DEPTH
+        span_rounds = 1
+        if end - self._dev_next >= K * B:
+            span = list(node.log.entries(self._dev_next,
+                                         self._dev_next + K * B))
+            if len(span) == K * B and not any(
+                    len(wire.encode_entry(e)) > self.runner.slot_bytes
+                    for e in span):
+                entries, span_rounds = span, K
+            else:
+                entries = span[:B] if len(span) >= B else []
+        else:
+            entries = list(node.log.entries(self._dev_next,
+                                            self._dev_next + B))
+        if span_rounds == 1:
+            if len(entries) != B:
+                return False
+            if any(len(wire.encode_entry(e)) > self.runner.slot_bytes
+                   for e in entries):
+                # Oversized record: this span must commit via the host
+                # path; re-base the device plane past it once that
+                # happens.
+                self.stats["holes"] += 1
+                if node.external_commit:
+                    node.external_commit = False
+                if node.log.commit >= self._dev_next + B:
+                    self._gen = None       # re-base next iteration
+                return False
         gen, end0 = self._gen, self._dev_next
         cid = node.cid
         live = self._live_members(node)
@@ -582,7 +700,13 @@ class DevicePlaneDriver:
         # -- device dispatch outside the daemon lock --
         self.daemon.lock.release()
         try:
-            res = self.runner.commit_round(gen, end0, entries, cid, live)
+            if span_rounds == K:
+                dev_commit = self.runner.commit_rounds(gen, end0, entries,
+                                                       cid, live)
+                res = None if dev_commit is None else ((), dev_commit)
+            else:
+                res = self.runner.commit_round(gen, end0, entries, cid,
+                                               live)
         finally:
             self.daemon.lock.acquire()
 
@@ -590,8 +714,8 @@ class DevicePlaneDriver:
             self._gen = None
             return True
         acks, dev_commit = res
-        self._dev_next = end0 + B
-        self.stats["rounds"] += 1
+        self._dev_next = end0 + span_rounds * B
+        self.stats["rounds"] += span_rounds
         # Re-validate leadership before adopting the result: an election
         # (or our own daemon's death) may have happened while the lock
         # was released.

@@ -151,21 +151,72 @@ def test_device_plane_live_on_multidevice_mesh():
         pytest.skip("needs a 4-device mesh (virtual CPU devices)")
     with LocalCluster(4, device_plane=True,
                       device_devices=devices[:4]) as c:
-        leader = c.wait_for_leader()
-        _wait(lambda: leader.node.external_commit or not leader.is_leader,
-              msg="device plane owning commit on the 4-device mesh")
-        for i in range(24):
-            c.submit(encode_put(b"mk%d" % i, b"mv%d" % i))
         runner = c.device_runner
-        assert runner.stats["rounds"] > 0
         assert runner._mesh.shape["replica"] == 4, \
             "mesh did not span the 4 devices"
+        # Leadership can flap under 1-core CI load: wait on the CURRENT
+        # leader owning commit, and keep traffic flowing until device
+        # rounds actually ran (a flap mid-wait sends writes host-path).
+        _wait(lambda: (lambda ld: ld is not None
+                       and ld.node.external_commit)(c.leader()),
+              msg="device plane owning commit on the 4-device mesh")
+        n = 24
+        for i in range(n):
+            c.submit(encode_put(b"mk%d" % i, b"mv%d" % i))
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            ld = c.leader()
+            if runner.stats["rounds"] > 0 and ld is not None \
+                    and ld.node.stats.get("devplane_commits", 0) > 0:
+                break
+            c.submit(encode_put(b"mk%d" % n, b"mv%d" % n))
+            n += 1
+        assert runner.stats["rounds"] > 0, "no device rounds ran"
         ld = c.leader()
-        assert ld.node.stats.get("devplane_commits", 0) > 0
+        assert ld is not None \
+            and ld.node.stats.get("devplane_commits", 0) > 0
         for i in range(4):
             c.wait_caught_up(i)
         for d in c.live():
-            for i in range(24):
+            for i in range(n):
                 assert d.node.sm.query(encode_get(b"mk%d" % i)) == \
                     b"mv%d" % i
+        c.check_logs_consistent()
+
+
+def test_device_plane_pipelined_dispatch_under_burst():
+    """A burst backlog (non-blocking submits) rides the depth-K
+    pipelined program: K rounds per dispatch instead of K dispatch+sync
+    cycles (runner.commit_rounds; the live form of the reference's
+    outstanding-WR pipelining, dare_ibv_rc.c:2552-2568)."""
+    with LocalCluster(3, device_plane=True) as c:
+        leader = c.wait_for_leader()
+        _wait(lambda: leader.node.external_commit or not leader.is_leader,
+              msg="device plane owning commit")
+        runner = c.device_runner
+        K, B = runner.PIPE_DEPTH, runner.batch
+        # Enqueue a deep backlog without waiting on commits.
+        n = 6 * K * B
+        with leader.lock:
+            prs = [leader.node.submit(i + 1, 424242,
+                                      encode_put(b"bk%d" % i, b"bv"))
+                   for i in range(n)]
+        if any(p is None for p in prs):
+            pytest.skip("leadership flapped before the burst enqueued")
+        _wait(lambda: runner.stats["pipelined_dispatches"] > 0
+              or not leader.is_leader,
+              timeout=40, msg="a pipelined dispatch")
+        # Whole backlog commits (last submit applied) then replicates.
+        _wait(lambda: prs[-1].reply is not None or not leader.is_leader,
+              timeout=60, msg="burst fully applied on the leader")
+        if prs[-1].reply is None:
+            # Deposed mid-burst: uncommitted tail entries are lawfully
+            # discarded — the pipelining assertion below would be
+            # vacuous and the durability check wrong.  (1-core CI flap.)
+            pytest.skip("leadership flapped mid-burst")
+        assert runner.stats["pipelined_dispatches"] > 0
+        for i in range(3):
+            c.wait_caught_up(i, timeout=60.0)
+        for d in c.live():
+            assert d.node.sm.query(encode_get(b"bk%d" % (n - 1))) == b"bv"
         c.check_logs_consistent()
