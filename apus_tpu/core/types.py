@@ -59,6 +59,10 @@ class ProxyAction(enum.IntEnum):
     CONNECT = 0
     SEND = 1
     CLOSE = 2
+    #: proxy -> daemon verdict frame (never logged): the app's read
+    #: covering a record range was FAILED; committed members must be
+    #: locally replayed (apus_wire.h APUS_ACT_NACK).
+    NACK = 3
 
 
 # Failure detector: consecutive control-plane failures before the leader

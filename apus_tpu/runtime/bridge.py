@@ -41,6 +41,7 @@ _OFF_TERM = 24
 _OFF_CUR_REC = 32
 _OFF_ABORTED = 40
 _OFF_SPIN_TIMEOUTS = 48
+_OFF_ABORT_FLOOR = 56
 
 # proxy -> daemon frame body: u8 action | u64 conn_id | u64 cur_rec | data
 _HDR = struct.Struct("<BQQ")
@@ -328,6 +329,13 @@ class Bridge:
         self.replayer = Replayer(host, port, self.logger)
         self.replayer.reprime_source = self._reprime_records
         self._spin_timeouts_seen = 0
+        # Record ranges whose reads the proxy FAILED (NACK frames):
+        # committed members must be locally replayed (see _handle_nack).
+        # _nack_replayed marks which already were — the NACK frame and
+        # the commit upcall race in both orders, and each path replays
+        # only if the other hasn't (exactly-once per record).
+        self._nacked: list[tuple[int, int]] = []
+        self._nack_replayed: set[tuple[int, int]] = set()
 
         # shm block: create + zero + magic.
         with open(self.shm_path, "wb") as f:
@@ -432,15 +440,25 @@ class Bridge:
         return self._shm_get(_OFF_HIGHEST)
 
     def _release(self, rec: int, abort: bool = False) -> None:
-        """Monotone advance of the release counter
-        (update_highest_rec analog, proxy.c:263-267)."""
+        """Monotone advance of the release channels
+        (update_highest_rec analog, proxy.c:263-267) — SPLIT by
+        verdict: commit releases raise ``highest_rec``, abort sweeps
+        raise ``abort_floor``.  The proxy's spin exits when either
+        covers its record and fails the app's read iff the floor does
+        (then NACKs, so records that commit anyway get locally
+        replayed) — no byte the app acts on ever escapes replication,
+        and no client gets an ack for an unreplicated write."""
         with self._shm_lock:
-            prev = self._shm_get(_OFF_HIGHEST)
-            if rec > prev:
-                self._shm_set(_OFF_HIGHEST, rec)
-                if abort:
+            if abort:
+                prev = max(self._shm_get(_OFF_HIGHEST),
+                           self._shm_get(_OFF_ABORT_FLOOR))
+                if rec > self._shm_get(_OFF_ABORT_FLOOR):
+                    self._shm_set(_OFF_ABORT_FLOOR, rec)
+                if rec > prev:
                     self._shm_set(_OFF_ABORTED,
                                   self._shm_get(_OFF_ABORTED) + rec - prev)
+            elif rec > self._shm_get(_OFF_HIGHEST):
+                self._shm_set(_OFF_HIGHEST, rec)
 
     # -- proxy socket -----------------------------------------------------
 
@@ -487,8 +505,70 @@ class Bridge:
             body = buf[off + 4:off + 4 + n]
             off += 4 + n
             action, conn_id, cur_rec = _HDR.unpack_from(body, 0)
-            self._submit(action, conn_id, cur_rec, body[_HDR.size:])
+            if action == ProxyAction.NACK:
+                self._handle_nack(conn_id, cur_rec)
+            else:
+                self._submit(action, conn_id, cur_rec, body[_HDR.size:])
         return buf[off:]
+
+    def _handle_nack(self, lo: int, hi: int) -> None:
+        """The proxy failed the app's read covering records [lo, hi] —
+        the app executed none of their bytes.  Any of them that COMMIT
+        (the abort sweep raced a commit the new leader preserved) must
+        be replayed into our own app like a foreign record, or this
+        app alone would miss a write every other replica applies.
+        Already-committed members replay now — marked in ``_routed``
+        under the daemon lock so a racing ``_on_commit`` upcall can't
+        replay them a second time; future ones at their _on_commit (the
+        range is remembered)."""
+        to_replay = []
+        with self.daemon.lock:
+            self._nacked.append((lo, hi))
+            for rec in getattr(self.daemon.node.sm, "records", []):
+                try:
+                    action, conn_id, data, clt, rid = decode_record(rec)
+                except Exception:                        # noqa: BLE001
+                    continue
+                # Replay only records whose commit upcall ALREADY ran
+                # (key in _routed — it saw no NACK then); ones still in
+                # the upcall queue will see the range at _on_commit.
+                if clt == self.clt_id and lo <= rid <= hi \
+                        and (clt, rid) in self._routed \
+                        and (clt, rid) not in self._nack_replayed:
+                    self._nack_replayed.add((clt, rid))
+                    to_replay.append((action, conn_id, data))
+            # Lossless pruning: own records commit in req_id order (the
+            # proxy numbers in submit order and aborted records never
+            # enter the log), so once the endpoint DB's last applied
+            # req for this bridge passes a range's hi, every member is
+            # resolved — committed ones were handled above or at their
+            # _on_commit, the rest can never commit.
+            ep = self.daemon.node.epdb.search(self.clt_id)
+            if ep is not None:
+                self._nacked = [(a, b) for a, b in self._nacked
+                                if b > ep.last_req_id]
+                self._nack_replayed = {
+                    (c, r) for c, r in self._nack_replayed
+                    if any(a <= r <= b for a, b in self._nacked)}
+            if len(self._nacked) > 4096:
+                # Backstop only (a storm of >4096 UNRESOLVED failed
+                # reads): dropping a live range risks silent app
+                # divergence, so account loudly instead of trimming
+                # quietly.
+                self.daemon.node.stats["nack_ranges_dropped"] = \
+                    self.daemon.node.stats.get("nack_ranges_dropped", 0) \
+                    + len(self._nacked) - 4096
+                if self.logger is not None:
+                    self.logger.error(
+                        "NACK range backstop hit: dropping %d oldest "
+                        "ranges (app may need a re-prime)",
+                        len(self._nacked) - 4096)
+                self._nacked = self._nacked[-4096:]
+        for action, conn_id, data in to_replay:
+            self.replayer.submit(action, conn_id, data)
+
+    def _is_nacked(self, rec: int) -> bool:
+        return any(lo <= rec <= hi for lo, hi in self._nacked)
 
     def _submit(self, action: int, conn_id: int, cur_rec: int,
                 data: bytes) -> None:
@@ -535,7 +615,9 @@ class Bridge:
         if not node.is_leader:
             with self._sub_lock:
                 last = self._last_submitted
-            if self.highest_rec < last:
+            covered = max(self.highest_rec,
+                          self._shm_get(_OFF_ABORT_FLOOR))
+            if covered < last:
                 self._release(last, abort=True)
 
     def _reprime_records(self) -> list[tuple[int, int, bytes]]:
@@ -586,12 +668,16 @@ class Bridge:
             if key in self._routed:
                 continue
             self._routed.add(key)
-            if clt == self.clt_id and rid >= self._boot_base:
+            if clt == self.clt_id and rid >= self._boot_base \
+                    and not self._is_nacked(rid):
                 # Our own live capture, now committed under the snapshot:
                 # the app executed the bytes itself — release the spin
-                # instead of replaying.
+                # instead of replaying.  (NACKed captures were NOT
+                # executed — those fall through to the replay below.)
                 self._release(rid)
                 continue
+            if clt == self.clt_id:
+                self._nack_replayed.add((clt, rid))
             self.replayer.submit(action, conn_id, data)
 
     def _on_commit(self, e: LogEntry) -> None:
@@ -605,6 +691,16 @@ class Bridge:
             return                    # already primed via snapshot replay
         self._routed.add(key)
         if e.clt_id == self.clt_id:
+            if self._is_nacked(e.req_id) and key not in self._nack_replayed:
+                # The proxy FAILED the app's read that carried this
+                # record (leadership lost mid-flight), yet the record
+                # committed anyway (the new leader preserved it): our
+                # own app never executed these bytes — replay them
+                # locally like a foreign record, or this app alone
+                # would miss a committed write.
+                self._nack_replayed.add(key)
+                action, conn_id, data, _, _ = decode_record(e.data)
+                self.replayer.submit(action, conn_id, data)
             self._release(e.req_id)
         else:
             action, conn_id, data, _, _ = decode_record(e.data)

@@ -144,9 +144,12 @@ def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
 
 
 def redis_benchmark(pc, requests: int, clients: int,
-                    value_bytes: int) -> dict | None:
+                    value_bytes: int, pipeline: int = 1) -> dict | None:
     """Run the pinned build's own redis-benchmark at the leader's
-    replicated redis (the run.sh:70-80 measurement, verbatim tool)."""
+    replicated redis (the run.sh:70-80 measurement, verbatim tool).
+    ``pipeline`` > 1 sends bursts per connection (-P) — the traffic
+    shape that builds the backlog the device plane's pipelined
+    dispatch feeds on."""
     import subprocess
 
     from apus_tpu.runtime.appcluster import REDIS_SERVER
@@ -158,7 +161,7 @@ def redis_benchmark(pc, requests: int, clients: int,
         proc = subprocess.run(
             [bench, "-h", host, "-p", str(port), "-t", "set,get",
              "-n", str(requests), "-c", str(clients),
-             "-d", str(value_bytes), "-q"],
+             "-d", str(value_bytes), "-P", str(max(1, pipeline)), "-q"],
             stdout=subprocess.PIPE, text=True, timeout=300)
     except (subprocess.TimeoutExpired, OSError) as e:
         print(f"redis-benchmark failed: {e}", file=sys.stderr)
@@ -183,7 +186,8 @@ def redis_benchmark(pc, requests: int, clients: int,
         "unit": "ops/sec(set)",
         "detail": {"tool": "redis-benchmark (pinned build)",
                    "requests": requests, "clients": clients,
-                   "value_bytes": value_bytes, **rps},
+                   "value_bytes": value_bytes, "pipeline": pipeline,
+                   **rps},
     }
 
 
@@ -205,6 +209,10 @@ def main() -> int:
                     help="drive the pinned unmodified ssdb "
                          "(apps/ssdb/run; ssdb-bench shape, "
                          "run.sh:71-73)")
+    ap.add_argument("--pipeline", type=int, default=1,
+                    help="redis-benchmark -P: commands per burst "
+                         "(builds the backlog the device plane's "
+                         "pipelined dispatch feeds on)")
     ap.add_argument("--device-plane", action="store_true",
                     help="replicate through the jitted device commit "
                          "step (runtime.device_plane); host TCP stays "
@@ -260,7 +268,7 @@ def main() -> int:
             # redis (redis-benchmark -t set,get, run.sh:70-80) — built
             # alongside the pinned server by apps/redis/mk.
             r = redis_benchmark(pc, args.requests, args.clients,
-                                args.value_bytes)
+                                args.value_bytes, pipeline=args.pipeline)
             if r is not None:
                 results.append(r)
 

@@ -20,6 +20,13 @@ enum apus_action : uint8_t {
   APUS_ACT_CONNECT = 0,
   APUS_ACT_SEND = 1,
   APUS_ACT_CLOSE = 2,
+  // proxy -> daemon verdict: the app's read covering records
+  // [conn_id .. cur_rec] (inclusive; conn_id reused as range-lo) was
+  // FAILED — the app executed none of their bytes.  The bridge must
+  // locally replay any of them that nonetheless commit (abort sweep
+  // racing a commit), or the leader's own app would miss committed
+  // writes every other replica replays.
+  APUS_ACT_NACK = 3,
 };
 
 // -- proxy -> daemon frame over the unix socket ---------------------------
@@ -49,7 +56,19 @@ struct apus_shm {
   volatile uint64_t spin_timeouts;  // records the app proceeded on after
                                     // the release spin timed out (proxy
                                     // writes; daemon surfaces in stats)
-  uint64_t pad[1];
+  volatile uint64_t abort_floor;    // highest record released WITHOUT
+                                    // commit (daemon writes).  Release
+                                    // channels are SPLIT: highest_rec
+                                    // rises only on commit releases,
+                                    // abort_floor only on abort
+                                    // sweeps; a spin exits when either
+                                    // covers its record and FAILS the
+                                    // read iff the floor does — then
+                                    // NACKs the range so the daemon
+                                    // replays any record that commits
+                                    // anyway.  (The reference lets the
+                                    // app reply on aborts — a false
+                                    // ack the client cannot detect.)
 };
 
 // Max raw request record (TCP rcvbuf-sized, message.h:7 parity).

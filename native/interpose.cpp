@@ -9,6 +9,7 @@
 // from DARE-internal threads, proxy.c:91-106 — our consensus runs out of
 // process, so only the bridge socket needs exclusion).
 
+#include <cerrno>
 #include <dlfcn.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -18,7 +19,9 @@
 extern "C" {
 void apus_proxy_init(void);
 void apus_proxy_on_accept(int fd);
-void apus_proxy_on_read(int fd, const void* buf, long n);
+int apus_proxy_on_read(int fd, const void* buf, long n);
+int apus_proxy_on_readv(int fd, const struct iovec* iov, int iovcnt,
+                        long n);
 void apus_proxy_on_close(int fd);
 int apus_proxy_owns_fd(int fd);
 int apus_proxy_active(void);
@@ -86,8 +89,13 @@ ssize_t read(int fd, void* buf, size_t count) {
   ssize_t n = real(fd, buf, count);
   // The proxy's captured-connection map filters out non-captured fds, so
   // plain file reads pay one map lookup only when the proxy is active.
-  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd))
-    apus_proxy_on_read(fd, buf, n);
+  // A negative verdict means the bytes could not be replicated
+  // (leadership lost): fail the read so the app never acts on them.
+  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd) &&
+      apus_proxy_on_read(fd, buf, n) < 0) {
+    errno = ECONNRESET;
+    return -1;
+  }
   return n;
 }
 
@@ -99,8 +107,10 @@ ssize_t recv(int fd, void* buf, size_t count, int flags) {
   static fn real = next_sym<fn>("recv");
   ssize_t n = real(fd, buf, count, flags);
   if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
-      !apus_proxy_owns_fd(fd))
-    apus_proxy_on_read(fd, buf, n);
+      !apus_proxy_owns_fd(fd) && apus_proxy_on_read(fd, buf, n) < 0) {
+    errno = ECONNRESET;
+    return -1;
+  }
   return n;
 }
 
@@ -114,15 +124,13 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
   using fn = ssize_t (*)(int, const struct iovec*, int);
   static fn real = next_sym<fn>("readv");
   ssize_t n = real(fd, iov, iovcnt);
-  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd)) {
-    ssize_t left = n;
-    for (int i = 0; i < iovcnt && left > 0; ++i) {
-      ssize_t take = static_cast<ssize_t>(iov[i].iov_len) < left
-                         ? static_cast<ssize_t>(iov[i].iov_len)
-                         : left;
-      apus_proxy_on_read(fd, iov[i].iov_base, take);
-      left -= take;
-    }
+  // One logical read: single wait + whole-range NACK (per-iovec calls
+  // could commit an early iovec, then fail the call on a later one,
+  // losing the committed bytes locally with no NACK covering them).
+  if (n > 0 && apus_proxy_active() && !apus_proxy_owns_fd(fd) &&
+      apus_proxy_on_readv(fd, iov, iovcnt, n) < 0) {
+    errno = ECONNRESET;
+    return -1;
   }
   return n;
 }
@@ -134,8 +142,10 @@ ssize_t recvfrom(int fd, void* buf, size_t len, int flags,
   static fn real = next_sym<fn>("recvfrom");
   ssize_t n = real(fd, buf, len, flags, src_addr, addrlen);
   if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
-      !apus_proxy_owns_fd(fd))
-    apus_proxy_on_read(fd, buf, n);
+      !apus_proxy_owns_fd(fd) && apus_proxy_on_read(fd, buf, n) < 0) {
+    errno = ECONNRESET;
+    return -1;
+  }
   return n;
 }
 
@@ -144,15 +154,11 @@ ssize_t recvmsg(int fd, struct msghdr* msg, int flags) {
   static fn real = next_sym<fn>("recvmsg");
   ssize_t n = real(fd, msg, flags);
   if (n > 0 && (flags & MSG_PEEK) == 0 && apus_proxy_active() &&
-      !apus_proxy_owns_fd(fd)) {
-    ssize_t left = n;
-    for (size_t i = 0; i < msg->msg_iovlen && left > 0; ++i) {
-      ssize_t take = static_cast<ssize_t>(msg->msg_iov[i].iov_len) < left
-                         ? static_cast<ssize_t>(msg->msg_iov[i].iov_len)
-                         : left;
-      apus_proxy_on_read(fd, msg->msg_iov[i].iov_base, take);
-      left -= take;
-    }
+      !apus_proxy_owns_fd(fd) &&
+      apus_proxy_on_readv(fd, msg->msg_iov,
+                          static_cast<int>(msg->msg_iovlen), n) < 0) {
+    errno = ECONNRESET;
+    return -1;
   }
   return n;
 }

@@ -38,6 +38,7 @@
 #include <netinet/in.h>
 #include <pthread.h>
 #include <sys/mman.h>
+#include <sys/uio.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/un.h>
@@ -140,12 +141,27 @@ uint64_t ship_record(uint8_t action, uint64_t conn_id, const void* data,
   return rec;
 }
 
-// Block until the record is applied cluster-wide (proxy.c:160 analog).
-void wait_released(uint64_t rec) {
-  if (rec == 0) return;
+// Block until the record is released (proxy.c:160 analog).  The
+// release channels are split — highest_rec rises only when the record
+// committed + applied, abort_floor only when records were swept as
+// uncommittable (leadership lost) — so the verdict is per-channel:
+// returns 0 on a commit release, -1 on an abort (floor checked FIRST:
+// a record covered by a sweep must fail even if a LATER record's
+// commit release also covers its number).  The caller then fails the
+// app's read and NACKs the range so the daemon locally replays any of
+// it that committed after all.
+int wait_released(uint64_t rec) {
+  if (rec == 0) return 0;
   uint64_t start = now_ms();
   uint32_t spins = 0;
-  while (__atomic_load_n(&g.shm->highest_rec, __ATOMIC_ACQUIRE) < rec) {
+  for (;;) {
+    if (__atomic_load_n(&g.shm->abort_floor, __ATOMIC_ACQUIRE) >= rec) {
+      plog("proxy: record %llu aborted (leadership lost); failing the read",
+           (unsigned long long)rec);
+      return -1;
+    }
+    if (__atomic_load_n(&g.shm->highest_rec, __ATOMIC_ACQUIRE) >= rec)
+      return 0;
     if (++spins < 4096) {
 #if defined(__x86_64__)
       __builtin_ia32_pause();
@@ -163,8 +179,26 @@ void wait_released(uint64_t rec) {
       // this counter each tick and logs/accounts the divergence (a
       // reply went out for a record consensus never released).
       __atomic_add_fetch(&g.shm->spin_timeouts, 1, __ATOMIC_ACQ_REL);
-      return;
+      return 0;
     }
+  }
+}
+
+// Tell the daemon the app's read covering [lo, hi] was failed: none of
+// those bytes executed locally (see APUS_ACT_NACK).
+void ship_nack(uint64_t lo, uint64_t hi) {
+  apus_bridge_hdr hdr;
+  hdr.action = APUS_ACT_NACK;
+  hdr.conn_id = lo;
+  hdr.cur_rec = hi;
+  uint32_t frame_len = static_cast<uint32_t>(sizeof(hdr));
+  pthread_mutex_lock(&g.send_lock);
+  bool ok = write_exact(g.sock, &frame_len, 4) &&
+            write_exact(g.sock, &hdr, sizeof(hdr));
+  pthread_mutex_unlock(&g.send_lock);
+  if (!ok) {
+    plog("proxy: NACK write failed (errno %d); deactivating", errno);
+    g.active = false;
   }
 }
 
@@ -247,34 +281,145 @@ void apus_proxy_on_accept(int fd) {
 
 // read() returned n>0 bytes on a registered connection (proxy_on_read
 // analog, proxy.c:230-239): replicate before the app may act on them.
-void apus_proxy_on_read(int fd, const void* buf, long n) {
-  if (!g.active || n <= 0 || !is_leader()) return;
+// Returns 0 to let the bytes through, -1 when the read must FAIL
+// (record aborted / leadership lost on a captured connection): the
+// interposer then returns ECONNRESET to the app, so no byte the app
+// acts on ever escaped replication.  The reference instead lets the
+// app execute and reply (proxy.c releases aborted records and returns
+// the bytes) — a false ack the client cannot detect; failing the read
+// closes that window.
+int apus_proxy_on_read(int fd, const void* buf, long n) {
+  if (!g.active || n <= 0) return 0;
+  bool leader_now = is_leader();
   pthread_mutex_lock(&g.lock);
   auto it = g.conns.find(fd);
   uint64_t conn_id = 0;
   bool fresh = false;
+  bool numbered_skip = false;
   if (it != g.conns.end() && it->second != kExcluded) {
-    if (it->second == 0) {
-      // First leader-side read: number the connection now (pid-salted
-      // sequence, unique across restarts/failovers).
-      it->second = (static_cast<uint64_t>(getpid()) << 32) | ++g.conn_seq;
-      fresh = true;
+    if (!leader_now) {
+      // A numbered connection only exists on an app that captured as
+      // leader: its reads must not execute unreplicated after a
+      // demotion — fail them (client reconnects and re-discovers).
+      numbered_skip = (it->second != 0);
+    } else {
+      if (it->second == 0) {
+        // First leader-side read: number the connection now (pid-salted
+        // sequence, unique across restarts/failovers).
+        it->second = (static_cast<uint64_t>(getpid()) << 32) | ++g.conn_seq;
+        fresh = true;
+      }
+      conn_id = it->second;
     }
-    conn_id = it->second;
   }
   pthread_mutex_unlock(&g.lock);
-  if (conn_id == 0) return;
-  if (fresh) wait_released(ship_record(APUS_ACT_CONNECT, conn_id, nullptr, 0));
+  if (numbered_skip) {
+    plog("proxy: failing read on captured conn fd=%d (%ld bytes): "
+         "leadership lost", fd, n);
+    return -1;
+  }
+  if (conn_id == 0) return 0;
+  // Ship EVERY record of this read first, then wait once on the LAST:
+  // commits release in record order, so the last record's commit
+  // implies all earlier ones committed; a per-record wait would let an
+  // early chunk commit + release while a later chunk aborts, losing
+  // the early bytes with no one knowing.  On failure the NACK covers
+  // the whole range, so committed members get locally replayed.
+  uint64_t first_rec = 0, last_rec = 0;
+  if (fresh) {
+    first_rec = last_rec = ship_record(APUS_ACT_CONNECT, conn_id,
+                                       nullptr, 0);
+    if (last_rec == 0) return 0;  // daemon gone: run unreplicated
+  }
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   // Oversized reads segment into max-record chunks (the reference caps
   // records at its rcvbuf size instead, message.h:7).
   while (n > 0) {
     uint32_t chunk =
         n > APUS_MAX_RECORD ? APUS_MAX_RECORD : static_cast<uint32_t>(n);
-    wait_released(ship_record(APUS_ACT_SEND, conn_id, p, chunk));
+    uint64_t rec = ship_record(APUS_ACT_SEND, conn_id, p, chunk);
+    if (rec == 0) return 0;       // daemon gone: run unreplicated
+    if (first_rec == 0) first_rec = rec;
+    last_rec = rec;
     p += chunk;
     n -= chunk;
   }
+  if (last_rec != 0 && wait_released(last_rec) < 0) {
+    ship_nack(first_rec, last_rec);
+    return -1;
+  }
+  return 0;
+}
+
+// Vectored receive (readv/recvmsg): ONE logical read spread over
+// iovecs — must be captured as one unit with a single wait + a NACK
+// covering the WHOLE range, exactly like apus_proxy_on_read's chunk
+// loop.  Per-iovec calls would let an early iovec's records commit and
+// release (proxy believes the app executed them) before a later
+// iovec's abort fails the whole call — silently diverging this app.
+int apus_proxy_on_readv(int fd, const struct iovec* iov, int iovcnt,
+                        long n) {
+  if (!g.active || n <= 0) return 0;
+  long left = n;
+  int verdict = 0;
+  uint64_t first_rec = 0, last_rec = 0;
+  for (int i = 0; i < iovcnt && left > 0; ++i) {
+    long take = static_cast<long>(iov[i].iov_len) < left
+                    ? static_cast<long>(iov[i].iov_len)
+                    : left;
+    // Reuse the single-buffer path for numbering/shipping, but defer
+    // the wait: capture the rec range it shipped by peeking cur_rec
+    // around the call would race other threads — instead inline the
+    // ship loop here.
+    bool leader_now = is_leader();
+    pthread_mutex_lock(&g.lock);
+    auto it = g.conns.find(fd);
+    uint64_t conn_id = 0;
+    bool fresh = false;
+    bool numbered_skip = false;
+    if (it != g.conns.end() && it->second != kExcluded) {
+      if (!leader_now) {
+        numbered_skip = (it->second != 0);
+      } else {
+        if (it->second == 0) {
+          it->second =
+              (static_cast<uint64_t>(getpid()) << 32) | ++g.conn_seq;
+          fresh = true;
+        }
+        conn_id = it->second;
+      }
+    }
+    pthread_mutex_unlock(&g.lock);
+    if (numbered_skip) {
+      verdict = -1;
+      break;
+    }
+    if (conn_id == 0) { left -= take; continue; }
+    if (fresh) {
+      uint64_t rec = ship_record(APUS_ACT_CONNECT, conn_id, nullptr, 0);
+      if (rec != 0) {
+        if (first_rec == 0) first_rec = rec;
+        last_rec = rec;
+      }
+    }
+    const uint8_t* p = static_cast<const uint8_t*>(iov[i].iov_base);
+    long m = take;
+    while (m > 0) {
+      uint32_t chunk =
+          m > APUS_MAX_RECORD ? APUS_MAX_RECORD : static_cast<uint32_t>(m);
+      uint64_t rec = ship_record(APUS_ACT_SEND, conn_id, p, chunk);
+      if (rec == 0) break;          // daemon gone: run unreplicated
+      if (first_rec == 0) first_rec = rec;
+      last_rec = rec;
+      p += chunk;
+      m -= chunk;
+    }
+    left -= take;
+  }
+  if (verdict == 0 && last_rec != 0 && wait_released(last_rec) < 0)
+    verdict = -1;
+  if (verdict < 0 && last_rec != 0) ship_nack(first_rec, last_rec);
+  return verdict;
 }
 
 // close() on a registered connection (proxy_on_close analog,

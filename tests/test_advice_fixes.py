@@ -267,3 +267,73 @@ def test_replayer_reprimes_restarted_app():
         r.stop()
     finally:
         app.stop()
+
+
+# -- abort-floor semantics (no false acks across demotions) ---------------
+
+def test_abort_release_and_nack_replay_semantics():
+    """Leadership-loss releases raise the shm ABORT FLOOR (a separate
+    channel from commit releases) so the proxy FAILS the affected reads
+    — the client sees an error, never a false +OK for an unreplicated
+    write (stronger than the reference, which lets the app reply).  A
+    failed read NACKs its record range; any member that turns out
+    COMMITTED (the sweep raced a commit the new leader preserved) is
+    replayed into our own app — which never executed the bytes — in
+    either arrival order."""
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster
+    from apus_tpu.runtime.bridge import (_OFF_ABORT_FLOOR, _OFF_CUR_REC,
+                                         _OFF_HIGHEST, encode_record)
+
+    with ProxiedCluster(3) as pc:
+        leader = pc.leader_idx()
+        bridge = pc.bridges[leader]
+        with LineClient(pc.app_addr(leader)) as c:
+            assert c.cmd("SET pre 1") == "OK"
+        base = bridge._shm_get(_OFF_HIGHEST)
+        # Keep the production invariant floor <= max issued cur_rec.
+        with bridge._shm_lock:
+            bridge._shm_set(_OFF_CUR_REC, base + 8)
+        # (a) split channels: abort raises the floor, NOT highest.
+        bridge._release(base + 5, abort=True)
+        assert bridge._shm_get(_OFF_ABORT_FLOOR) == base + 5
+        assert bridge._shm_get(_OFF_HIGHEST) == base
+        bridge._release(base + 6)                 # commit release
+        assert bridge._shm_get(_OFF_HIGHEST) == base + 6
+        assert bridge._shm_get(_OFF_ABORT_FLOOR) == base + 5
+
+        def own_entry(rid, key):
+            rec = encode_record(1, 0xDEAD, b"SET %s v\n" % key,
+                                clt_id=bridge.clt_id, req_id=rid)
+            return LogEntry(idx=900000 + rid % 1000, term=1,
+                            type=EntryType.CSM, req_id=rid,
+                            clt_id=bridge.clt_id, data=rec)
+
+        def wait_key(key, want="v"):
+            deadline = time.monotonic() + 10
+            val = None
+            while time.monotonic() < deadline:
+                with LineClient(pc.app_addr(leader)) as c:
+                    val = c.cmd("GET " + key)
+                if val == want:
+                    return val
+                time.sleep(0.05)
+            return val
+
+        # (b) NACK then commit: _on_commit replays the nacked record.
+        bridge._handle_nack(base + 5, base + 5)
+        bridge._on_commit(own_entry(base + 5, b"nack-then-commit"))
+        assert wait_key("nack-then-commit") == "v"
+        # (c) commit then NACK: the range scan replays it (the record
+        # is in the relay SM by apply time).
+        e2 = own_entry(base + 7, b"commit-then-nack")
+        daemon = pc.cluster.daemons[leader]
+        with daemon.lock:
+            daemon.node.sm.records.append(e2.data)
+        bridge._on_commit(e2)                      # not nacked yet
+        bridge._handle_nack(base + 7, base + 7)
+        assert wait_key("commit-then-nack") == "v"
+        # Un-nacked committed own records are NOT replayed (the app
+        # executed them itself at capture).
+        assert not bridge._is_nacked(base + 6)
