@@ -18,6 +18,7 @@ thread never blocks on the app.
 
 from __future__ import annotations
 
+import collections
 import mmap
 import os
 import queue
@@ -370,6 +371,18 @@ class Bridge:
         # head would re-execute its whole history (records are retained
         # forever in the relay SM anyway, so the set adds O(1)/record).
         self._routed: set[tuple[int, int]] = set()
+        # rid -> encoded record for OWN routed records, so _handle_nack
+        # resolves a range in O(range) instead of scanning the whole
+        # never-pruned relay history under the daemon lock (the values
+        # alias the bytes the relay SM retains anyway — no copy).
+        # Bounded window: beyond the cap, oldest entries evict and
+        # ranges reaching below ``_own_routed_floor`` fall back to the
+        # full scan (a NACK can only reference recent in-flight reads,
+        # so the fallback is a never-in-practice safety net).
+        self._own_routed: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+        self._own_routed_floor = 0
+        self._OWN_ROUTED_CAP = 65536
 
         if os.path.exists(self.sock_path):
             os.unlink(self.sock_path)
@@ -524,18 +537,30 @@ class Bridge:
         to_replay = []
         with self.daemon.lock:
             self._nacked.append((lo, hi))
-            for rec in getattr(self.daemon.node.sm, "records", []):
-                try:
-                    action, conn_id, data, clt, rid = decode_record(rec)
-                except Exception:                        # noqa: BLE001
-                    continue
-                # Replay only records whose commit upcall ALREADY ran
-                # (key in _routed — it saw no NACK then); ones still in
-                # the upcall queue will see the range at _on_commit.
-                if clt == self.clt_id and lo <= rid <= hi \
-                        and (clt, rid) in self._routed \
-                        and (clt, rid) not in self._nack_replayed:
-                    self._nack_replayed.add((clt, rid))
+            # Replay only records whose commit upcall ALREADY ran
+            # (rid in _own_routed implies key in _routed — it saw no
+            # NACK then); ones still in the upcall queue will see the
+            # range at _on_commit.  O(range) via the rid index; ranges
+            # reaching below the index window scan the full history.
+            if lo > self._own_routed_floor:
+                candidates = [(rid, self._own_routed[rid])
+                              for rid in range(lo, hi + 1)
+                              if rid in self._own_routed]
+            else:
+                candidates = []
+                for rec in getattr(self.daemon.node.sm, "records", []):
+                    try:
+                        _, _, _, clt, rid = decode_record(rec)
+                    except Exception:                    # noqa: BLE001
+                        continue
+                    if clt == self.clt_id and lo <= rid <= hi \
+                            and (clt, rid) in self._routed:
+                        candidates.append((rid, rec))
+            for rid, rec in candidates:
+                key = (self.clt_id, rid)
+                if key not in self._nack_replayed:
+                    self._nack_replayed.add(key)
+                    action, conn_id, data, _, _ = decode_record(rec)
                     to_replay.append((action, conn_id, data))
             # Lossless pruning: own records commit in req_id order (the
             # proxy numbers in submit order and aborted records never
@@ -645,6 +670,15 @@ class Bridge:
 
     # -- commit upcall ----------------------------------------------------
 
+    def _index_own(self, rid: int, rec: bytes) -> None:
+        """Index an own routed record for O(range) NACK resolution
+        (caller holds the daemon lock)."""
+        self._own_routed[rid] = rec
+        while len(self._own_routed) > self._OWN_ROUTED_CAP:
+            old, _ = self._own_routed.popitem(last=False)
+            if old > self._own_routed_floor:
+                self._own_routed_floor = old
+
     def _on_snapshot(self, snap, ep_dump) -> None:
         """A leader-pushed snapshot replaced the relay SM wholesale:
         prime the local app with the snapshot-covered records it has NOT
@@ -668,6 +702,8 @@ class Bridge:
             if key in self._routed:
                 continue
             self._routed.add(key)
+            if clt == self.clt_id:
+                self._index_own(rid, rec)
             if clt == self.clt_id and rid >= self._boot_base \
                     and not self._is_nacked(rid):
                 # Our own live capture, now committed under the snapshot:
@@ -691,6 +727,7 @@ class Bridge:
             return                    # already primed via snapshot replay
         self._routed.add(key)
         if e.clt_id == self.clt_id:
+            self._index_own(e.req_id, e.data)
             if self._is_nacked(e.req_id) and key not in self._nack_replayed:
                 # The proxy FAILED the app's read that carried this
                 # record (leadership lost mid-flight), yet the record

@@ -81,7 +81,8 @@ def _bench() -> None:
 
     from apus_tpu.core.cid import Cid
     from apus_tpu.ops.commit import (CommitControl, build_commit_step,
-                                     build_pipelined_commit_step, place_batch)
+                                     build_pipelined_commit_step_fused,
+                                     place_batch)
     from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
     from apus_tpu.ops.mesh import replica_mesh, replica_sharding
 
@@ -92,7 +93,8 @@ def _bench() -> None:
     cpu = backend == "cpu"
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
     depths = [int(d) for d in os.environ.get(
-        "APUS_BENCH_DEPTHS", "64" if cpu else "64,256,1024").split(",")]
+        "APUS_BENCH_DEPTHS",
+        "64,1024" if cpu else "1024,4096,16384").split(",")]
     dispatches = 5 if cpu else 10
     single_iters = 10 if cpu else 20
     deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
@@ -140,31 +142,44 @@ def _bench() -> None:
         print(json.dumps(result), flush=True)
 
     # -- pipelined steady state (headline), climbing the depth ladder -----
+    # The fused (closed-form) pipelined step: the whole depth-D window is
+    # one bulk ring update + vectorized quorum math (ops.commit, same
+    # strength reduction as the reference's entry-range RDMA WRITEs).
+    # Each timed iteration reads the final commit index back to the host
+    # — the leader host needs it to release spinning app threads
+    # (proxy.c:160 analog), so the readback is part of the round, and it
+    # is also what makes the timing honest on the async axon tunnel
+    # (block_until_ready alone under-measures there).
     for D in depths:
         if deadline and time.time() > deadline - 15:
             _mark(f"deadline near; stopping ladder before depth {D}")
             break
         t_c = time.monotonic()
-        pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D,
-                                           staged_depth=1)
+        pipe = build_pipelined_commit_step_fused(mesh, R, S, SB, B, depth=D,
+                                                 staged_depth=1)
         devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
                                  sharding=sh)
         ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
         devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)   # compile
-        jax.block_until_ready(commits)
         assert int(np.asarray(commits)[-1]) == 1 + D * B, \
             "pipeline did not commit"
         # One more chained warmup: feeding device-resident outputs back
         # re-specializes the program once; measure after that.
         devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
-        jax.block_until_ready(commits)
+        int(np.asarray(commits)[-1])
         _mark(f"depth={D}: compiled+warm in {time.monotonic() - t_c:.1f}s")
         walls_us = []
+        expect = None
         for _ in range(dispatches):
             t0 = time.perf_counter_ns()
             devlog, commits, ctrl = pipe(devlog, sdata, smeta, ctrl)
-            jax.block_until_ready(commits)
+            got = int(commits[-1])   # single-scalar readback: all the
             walls_us.append((time.perf_counter_ns() - t0) / 1e3)
+            # leader host needs is the final commit index; fetching the
+            # whole [D] vector would inflate the timed region with a
+            # transfer the production driver never performs.
+            assert expect is None or got == expect, (got, expect)
+            expect = got + D * B
         walls_us.sort()
         wall_p50 = walls_us[len(walls_us) // 2]
         round_p50 = wall_p50 / D
@@ -192,12 +207,12 @@ def _bench() -> None:
                               sharding=sh)
     c1 = CommitControl.from_cid(cid, R, 0, 1, 1)
     cur, _, commit, c1 = step(devlog1, bdata, bmeta, c1)
-    jax.block_until_ready(commit)
+    int(np.asarray(commit))
     lat = []
     for _ in range(single_iters):
         t0 = time.perf_counter_ns()
         cur, _, commit, c1 = step(cur, bdata, bmeta, c1)
-        jax.block_until_ready(commit)
+        int(np.asarray(commit))
         lat.append((time.perf_counter_ns() - t0) / 1e3)
     lat.sort()
     _mark(f"single-dispatch round p50 {lat[len(lat) // 2]:.0f}us")

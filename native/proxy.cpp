@@ -33,6 +33,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <unordered_map>
+#include <vector>
 
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -288,7 +289,26 @@ void apus_proxy_on_accept(int fd) {
 // app execute and reply (proxy.c releases aborted records and returns
 // the bytes) — a false ack the client cannot detect; failing the read
 // closes that window.
-int apus_proxy_on_read(int fd, const void* buf, long n) {
+// Shared capture path for read()/readv()/recvmsg(): ONE logical read —
+// possibly spread over iovecs and segmented into max-record chunks
+// (the reference instead caps records at its rcvbuf size, message.h:7)
+// — is shipped as a unit, waited once, and on failure NACKed as a
+// unit.  Ship EVERY record first, then wait once on the LAST: commits
+// release in record order, so the last record's commit implies all
+// earlier ones committed; a per-record wait would let an early chunk
+// commit + release while a later chunk aborts, losing the early bytes
+// with no one knowing.
+//
+// On failure the NACK covers EXACTLY the records this call shipped —
+// not the contiguous range [first, last]: cur_rec is a global counter,
+// so a concurrent app thread's record can land BETWEEN this call's
+// records, and a range NACK would cover that foreign record too.  Its
+// read succeeded and its bytes executed; the daemon replaying it from
+// the NACK would double-apply an already-executed write (silent
+// divergence for non-idempotent commands).  Contiguous runs of the
+// call's own records coalesce into one NACK frame each.
+static int capture_read(int fd, const struct iovec* iov, int iovcnt,
+                        long n) {
   if (!g.active || n <= 0) return 0;
   bool leader_now = is_leader();
   pthread_mutex_lock(&g.lock);
@@ -319,107 +339,66 @@ int apus_proxy_on_read(int fd, const void* buf, long n) {
     return -1;
   }
   if (conn_id == 0) return 0;
-  // Ship EVERY record of this read first, then wait once on the LAST:
-  // commits release in record order, so the last record's commit
-  // implies all earlier ones committed; a per-record wait would let an
-  // early chunk commit + release while a later chunk aborts, losing
-  // the early bytes with no one knowing.  On failure the NACK covers
-  // the whole range, so committed members get locally replayed.
-  uint64_t first_rec = 0, last_rec = 0;
+  std::vector<uint64_t> recs;
   if (fresh) {
-    first_rec = last_rec = ship_record(APUS_ACT_CONNECT, conn_id,
-                                       nullptr, 0);
-    if (last_rec == 0) return 0;  // daemon gone: run unreplicated
-  }
-  const uint8_t* p = static_cast<const uint8_t*>(buf);
-  // Oversized reads segment into max-record chunks (the reference caps
-  // records at its rcvbuf size instead, message.h:7).
-  while (n > 0) {
-    uint32_t chunk =
-        n > APUS_MAX_RECORD ? APUS_MAX_RECORD : static_cast<uint32_t>(n);
-    uint64_t rec = ship_record(APUS_ACT_SEND, conn_id, p, chunk);
+    uint64_t rec = ship_record(APUS_ACT_CONNECT, conn_id, nullptr, 0);
     if (rec == 0) return 0;       // daemon gone: run unreplicated
-    if (first_rec == 0) first_rec = rec;
-    last_rec = rec;
-    p += chunk;
-    n -= chunk;
+    recs.push_back(rec);
   }
-  if (last_rec != 0 && wait_released(last_rec) < 0) {
-    ship_nack(first_rec, last_rec);
-    return -1;
-  }
-  return 0;
-}
-
-// Vectored receive (readv/recvmsg): ONE logical read spread over
-// iovecs — must be captured as one unit with a single wait + a NACK
-// covering the WHOLE range, exactly like apus_proxy_on_read's chunk
-// loop.  Per-iovec calls would let an early iovec's records commit and
-// release (proxy believes the app executed them) before a later
-// iovec's abort fails the whole call — silently diverging this app.
-int apus_proxy_on_readv(int fd, const struct iovec* iov, int iovcnt,
-                        long n) {
-  if (!g.active || n <= 0) return 0;
   long left = n;
-  int verdict = 0;
-  uint64_t first_rec = 0, last_rec = 0;
   for (int i = 0; i < iovcnt && left > 0; ++i) {
     long take = static_cast<long>(iov[i].iov_len) < left
                     ? static_cast<long>(iov[i].iov_len)
                     : left;
-    // Reuse the single-buffer path for numbering/shipping, but defer
-    // the wait: capture the rec range it shipped by peeking cur_rec
-    // around the call would race other threads — instead inline the
-    // ship loop here.
-    bool leader_now = is_leader();
-    pthread_mutex_lock(&g.lock);
-    auto it = g.conns.find(fd);
-    uint64_t conn_id = 0;
-    bool fresh = false;
-    bool numbered_skip = false;
-    if (it != g.conns.end() && it->second != kExcluded) {
-      if (!leader_now) {
-        numbered_skip = (it->second != 0);
-      } else {
-        if (it->second == 0) {
-          it->second =
-              (static_cast<uint64_t>(getpid()) << 32) | ++g.conn_seq;
-          fresh = true;
-        }
-        conn_id = it->second;
-      }
-    }
-    pthread_mutex_unlock(&g.lock);
-    if (numbered_skip) {
-      verdict = -1;
-      break;
-    }
-    if (conn_id == 0) { left -= take; continue; }
-    if (fresh) {
-      uint64_t rec = ship_record(APUS_ACT_CONNECT, conn_id, nullptr, 0);
-      if (rec != 0) {
-        if (first_rec == 0) first_rec = rec;
-        last_rec = rec;
-      }
-    }
     const uint8_t* p = static_cast<const uint8_t*>(iov[i].iov_base);
     long m = take;
     while (m > 0) {
       uint32_t chunk =
           m > APUS_MAX_RECORD ? APUS_MAX_RECORD : static_cast<uint32_t>(m);
       uint64_t rec = ship_record(APUS_ACT_SEND, conn_id, p, chunk);
-      if (rec == 0) break;          // daemon gone: run unreplicated
-      if (first_rec == 0) first_rec = rec;
-      last_rec = rec;
+      if (rec == 0) return 0;     // daemon gone mid-call: unreplicated
+      recs.push_back(rec);
       p += chunk;
       m -= chunk;
     }
     left -= take;
   }
-  if (verdict == 0 && last_rec != 0 && wait_released(last_rec) < 0)
-    verdict = -1;
-  if (verdict < 0 && last_rec != 0) ship_nack(first_rec, last_rec);
-  return verdict;
+  if (recs.empty()) return 0;
+  bool aborted = wait_released(recs.back()) < 0;
+  if (!aborted && recs.size() > 1) {
+    // Mixed-verdict guard: the last record committed, but an abort
+    // sweep may still cover an EARLIER record of this call (swept
+    // before ever entering the log, while later frames committed
+    // post-re-election).  The call is all-or-nothing: fail it.
+    aborted = __atomic_load_n(&g.shm->abort_floor, __ATOMIC_ACQUIRE) >=
+              recs.front();
+  }
+  if (aborted) {
+    uint64_t lo = recs.front(), hi = recs.front();
+    for (size_t i = 1; i < recs.size(); ++i) {
+      if (recs[i] == hi + 1) {
+        hi = recs[i];
+      } else {
+        ship_nack(lo, hi);
+        lo = hi = recs[i];
+      }
+    }
+    ship_nack(lo, hi);
+    return -1;
+  }
+  return 0;
+}
+
+int apus_proxy_on_read(int fd, const void* buf, long n) {
+  struct iovec v;
+  v.iov_base = const_cast<void*>(buf);
+  v.iov_len = n > 0 ? static_cast<size_t>(n) : 0;
+  return capture_read(fd, &v, 1, n);
+}
+
+int apus_proxy_on_readv(int fd, const struct iovec* iov, int iovcnt,
+                        long n) {
+  return capture_read(fd, iov, iovcnt, n);
 }
 
 // close() on a registered connection (proxy_on_close analog,

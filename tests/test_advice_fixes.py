@@ -337,3 +337,62 @@ def test_abort_release_and_nack_replay_semantics():
         # Un-nacked committed own records are NOT replayed (the app
         # executed them itself at capture).
         assert not bridge._is_nacked(base + 6)
+
+
+def test_nack_index_eviction_falls_back_to_history_scan():
+    """_handle_nack resolves ranges in O(range) via the own-record rid
+    index; when the bounded index has evicted the range, the full relay
+    history scan still finds committed members (correctness never
+    depends on the window size)."""
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.appcluster import LineClient, ProxiedCluster
+    from apus_tpu.runtime.bridge import (_OFF_CUR_REC, _OFF_HIGHEST,
+                                         encode_record)
+
+    with ProxiedCluster(3) as pc:
+        leader = pc.leader_idx()
+        bridge = pc.bridges[leader]
+        daemon = pc.cluster.daemons[leader]
+        with LineClient(pc.app_addr(leader)) as c:
+            assert c.cmd("SET pre 1") == "OK"
+        base = bridge._shm_get(_OFF_HIGHEST)
+        with bridge._shm_lock:
+            bridge._shm_set(_OFF_CUR_REC, base + 16)
+
+        def own_entry(rid, key):
+            rec = encode_record(1, 0xBEEF, b"SET %s v\n" % key,
+                                clt_id=bridge.clt_id, req_id=rid)
+            return LogEntry(idx=910000 + rid % 1000, term=1,
+                            type=EntryType.CSM, req_id=rid,
+                            clt_id=bridge.clt_id, data=rec)
+
+        # Tiny window: committing a second record evicts the first.
+        bridge._OWN_ROUTED_CAP = 1
+        e1 = own_entry(base + 3, b"evicted-one")
+        e2 = own_entry(base + 4, b"kept-one")
+        with daemon.lock:
+            daemon.node.sm.records.append(e1.data)
+            daemon.node.sm.records.append(e2.data)
+        bridge._on_commit(e1)
+        bridge._on_commit(e2)
+        assert base + 3 not in bridge._own_routed     # evicted
+        assert bridge._own_routed_floor >= base + 3
+        # NACK reaching below the window floor: fallback scan replays.
+        bridge._handle_nack(base + 3, base + 3)
+
+        def wait_key(key, want="v"):
+            deadline = time.monotonic() + 10
+            val = None
+            while time.monotonic() < deadline:
+                with LineClient(pc.app_addr(leader)) as c:
+                    val = c.cmd("GET " + key)
+                if val == want:
+                    return val
+                time.sleep(0.05)
+            return val
+
+        assert wait_key("evicted-one") == "v"
+        # Indexed path (above the floor) replays too.
+        bridge._handle_nack(base + 4, base + 4)
+        assert wait_key("kept-one") == "v"

@@ -244,3 +244,109 @@ def test_pipelined_matches_sequential():
     assert int(ctrl_out.end0) == 1 + D * B
     assert (np.asarray(devlog2.data) == seq_data).all()
     assert (np.asarray(devlog2.offs) == seq_offs).all()
+
+
+# ---------------------------------------------------------------------------
+# Fused (closed-form) pipelined step: differential vs the scan step.
+# ---------------------------------------------------------------------------
+
+def _run_pipelined(builder, *, R=4, B=8, S=64, SB=64, D=4, SD=None,
+                   leader=0, term=1, end0=1, cid=None,
+                   fence_overrides=None, offs_overrides=None,
+                   distinct_batches=True):
+    """Run one pipelined dispatch via ``builder`` and return host copies
+    of (live data, live meta, offs, fence, commits, end0')."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    SD = D if SD is None else SD
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    devlog = make_device_log(R, S, SB, batch=B, leader=leader, term=term,
+                             sharding=sh)
+    if fence_overrides:
+        f = np.array(devlog.fence)
+        for r, (g, t) in fence_overrides.items():
+            f[r] = (g, t)
+        devlog.fence = jax.device_put(f, sh)
+    if offs_overrides:
+        o = np.array(devlog.offs)
+        for r, end in offs_overrides.items():
+            o[r, OFF_END] = end
+        devlog.offs = jax.device_put(o, sh)
+    sdata = np.zeros((SD, R, B, SB), np.uint8)
+    smeta = np.zeros((SD, R, B, 4), np.int32)
+    for k in range(SD):
+        tag = k if distinct_batches else 0
+        reqs = [b"fused-%d-%d" % (tag, j) for j in range(B)]
+        bd, bm, _ = host_batch_to_device(reqs, SB, batch_size=B)
+        sdata[k, leader] = bd
+        smeta[k, leader] = bm
+    ssh = NamedSharding(mesh, P(None, "replica"))
+    sdata = jax.device_put(sdata, ssh)
+    smeta = jax.device_put(smeta, ssh)
+    cid = cid or Cid.initial(R)
+    ctrl = CommitControl.from_cid(cid, R, leader=leader, term=term,
+                                  end0=end0)
+    pipe = builder(mesh, R, S, SB, B, depth=D, staged_depth=SD)
+    devlog, commits, ctrl_out = pipe(devlog, sdata, smeta, ctrl)
+    return (np.asarray(devlog.data)[:, :S], np.asarray(devlog.meta)[:, :S],
+            np.asarray(devlog.offs), np.asarray(devlog.fence),
+            np.asarray(commits), int(ctrl_out.end0))
+
+
+_FUSED_SCENARIOS = {
+    "all_accept_shallow": dict(D=4, SD=4),
+    "all_accept_deep_sd1": dict(D=24, SD=1, S=64, distinct_batches=False),
+    "exact_ring_cover": dict(D=8, SD=8, S=64),       # D == S/B
+    "ring_wrap_multi": dict(D=20, SD=4, S=64),       # D*B >> S, SD cycles
+    "one_fenced": dict(D=4, SD=4, fence_overrides={1: (2, 5)}),
+    "one_behind": dict(D=4, SD=4, offs_overrides={2: 1},  # others at 9
+                       end0=9),
+    "quorum_fail": dict(D=4, SD=4,
+                        fence_overrides={1: (2, 5), 2: (2, 5), 3: (2, 5)}),
+    "transit_dual_majority": dict(D=4, SD=4, R=6,
+                                  cid=None),  # filled below
+    "unaligned_start": dict(D=6, SD=6, S=64, end0=17),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_FUSED_SCENARIOS))
+def test_fused_pipelined_matches_scan(name):
+    """The closed-form fused step is bit-identical to the scan step on
+    live ring rows, offsets, commits, and fence across scenarios."""
+    from apus_tpu.ops.commit import (build_pipelined_commit_step,
+                                     build_pipelined_commit_step_fused)
+
+    kw = dict(_FUSED_SCENARIOS[name])
+    if name == "transit_dual_majority":
+        base = Cid.initial(4)
+        kw["cid"] = base.extend(6).with_server(4).with_server(5).to_transit()
+    if name == "one_behind":
+        # all but replica 2 already at end=9 (one committed batch)
+        kw["offs_overrides"] = {0: 9, 1: 9, 3: 9, 2: 1}
+    a = _run_pipelined(build_pipelined_commit_step, **kw)
+    b = _run_pipelined(build_pipelined_commit_step_fused, **kw)
+    for x, y, what in zip(a, b, ("data", "meta", "offs", "fence",
+                                 "commits", "end0")):
+        assert np.array_equal(x, y), (name, what, x, y)
+
+
+def test_fused_rejects_whole_window_for_ahead_replica():
+    """A replica whose end is AHEAD of end0 (overlapping retransmit
+    window) rejects the entire fused dispatch — window alignment is a
+    driver invariant; the scan step would join mid-window instead.
+    The fused commit math must account it at its own end throughout."""
+    from apus_tpu.ops.commit import build_pipelined_commit_step_fused
+
+    R, B, S, D = 4, 8, 64, 4
+    # replica 3 is ahead by exactly one batch (end=9, end0=1)
+    data, meta, offs, fence, commits, end0 = _run_pipelined(
+        build_pipelined_commit_step_fused, R=R, B=B, S=S, D=D, SD=D,
+        offs_overrides={3: 9})
+    assert offs[3, OFF_END] == 9          # untouched for the whole window
+    assert offs[3, OFF_COMMIT] == 1
+    # rows beyond replica 3's own end never got this window's entries
+    assert (meta[3, 9:, META_IDX] == 0).all()
+    # quorum still reached via the 3 aligned replicas
+    assert list(commits) == [1 + (i + 1) * B for i in range(D)]
+    assert (offs[[0, 1, 2], OFF_END] == 1 + D * B).all()
