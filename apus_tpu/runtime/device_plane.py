@@ -88,6 +88,12 @@ class DeviceCommitRunner:
     #: program covering PIPE_DEPTH consecutive rounds, used by the
     #: driver when the backlog allows.
     PIPE_DEPTH = 4
+    #: Rounds per DEEP fused dispatch: the closed-form window step
+    #: (build_pipelined_commit_step_fused) used when the backlog covers
+    #: DEEP_DEPTH full batches.  The fused step rewrites the whole ring
+    #: once per dispatch, so it only pays off for deep windows; the
+    #: scan step keeps proportional writes for shallow ones.
+    DEEP_DEPTH = 16
 
     def __init__(self, n_replicas: int, n_slots: int = 4096,
                  slot_bytes: int = 4096, batch: int = 64,
@@ -186,20 +192,31 @@ class DeviceCommitRunner:
         # dare_ibv_rc.c:2552-2568).  The driver uses it whenever the
         # host backlog covers K full batches, cutting dispatch+sync
         # overhead per round by ~K.
-        from apus_tpu.ops.commit import build_pipelined_commit_step
+        from apus_tpu.ops.commit import (build_pipelined_commit_step,
+                                         build_pipelined_commit_step_fused)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from apus_tpu.ops.mesh import REPLICA_AXIS
         K = self.PIPE_DEPTH
-        self._pipe = build_pipelined_commit_step(
-            self._mesh, R, self.n_slots, SB, B, depth=K, staged_depth=K)
+        # Two pipelined programs keyed by window depth: the scan step
+        # (proportional slot writes, shallow windows) and the fused
+        # closed-form step (one bulk ring rewrite, deep windows).
+        self._pipes = {
+            K: build_pipelined_commit_step(
+                self._mesh, R, self.n_slots, SB, B, depth=K,
+                staged_depth=K),
+            self.DEEP_DEPTH: build_pipelined_commit_step_fused(
+                self._mesh, R, self.n_slots, SB, B, depth=self.DEEP_DEPTH,
+                staged_depth=self.DEEP_DEPTH),
+        }
         staged_sh = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
         self._staged_sharding = staged_sh
 
         def _expand_staged(bd, bm, leader):
-            data = jnp.zeros((K, R, B, SB), jnp.uint8) \
+            d = bd.shape[0]             # retraced per window depth
+            data = jnp.zeros((d, R, B, SB), jnp.uint8) \
                 .at[:, leader].set(bd)
-            meta = jnp.zeros((K, R, B, 4), jnp.int32) \
+            meta = jnp.zeros((d, R, B, 4), jnp.int32) \
                 .at[:, leader].set(bm)
             return data, meta
 
@@ -209,8 +226,9 @@ class DeviceCommitRunner:
         def _place_staged(bd, bm, leader):
             if self._use_device_expand:
                 return self._place_staged_dev(bd, bm, np.int32(leader))
-            data = np.zeros((K, R, B, SB), np.uint8)
-            meta = np.zeros((K, R, B, 4), np.int32)
+            d = bd.shape[0]
+            data = np.zeros((d, R, B, SB), np.uint8)
+            meta = np.zeros((d, R, B, 4), np.int32)
             data[:, leader] = bd
             meta[:, leader] = bm
             return (jax.device_put(data, staged_sh),
@@ -249,11 +267,12 @@ class DeviceCommitRunner:
         # would allocate+transfer another full shard set just to warm a
         # compile that only needs shapes/shardings.  (Rounds land in
         # scratch: the warm devlog's end is past ctrl.end0 — harmless.)
-        K = self.PIPE_DEPTH
-        sdata, smeta = self._place_staged(np.zeros((K, B, SB), np.uint8),
-                                          np.zeros((K, B, 4), np.int32), 0)
-        _, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
-        self._jax.block_until_ready(commits)
+        for depth, pipe in self._pipes.items():
+            sdata, smeta = self._place_staged(
+                np.zeros((depth, B, SB), np.uint8),
+                np.zeros((depth, B, 4), np.int32), 0)
+            devlog, commits, _ = pipe(devlog, sdata, smeta, ctrl)
+            self._jax.block_until_ready(commits)
 
     #: bytes of wire-codec overhead per slot payload (encode_entry
     #: header + optional cid, upper bound).  The authoritative gate is
@@ -358,14 +377,18 @@ class DeviceCommitRunner:
 
     def commit_rounds(self, gen: int, end0: int, entries: list[LogEntry],
                       cid, live: set[int]) -> Optional[int]:
-        """PIPE_DEPTH consecutive commit rounds in ONE dispatch
-        (lax.scan; the live analog of the reference's outstanding-WR
-        pipelining).  ``entries`` is exactly PIPE_DEPTH*batch entries,
-        idx-contiguous from ``end0``.  Returns the device commit index
-        after the last round, or None if ``gen`` is stale.  Same lock
-        discipline as commit_round."""
-        K, B = self.PIPE_DEPTH, self.batch
-        assert len(entries) == K * B, (len(entries), K, B)
+        """A multi-round window in ONE dispatch — PIPE_DEPTH rounds via
+        the lax.scan program or DEEP_DEPTH rounds via the fused
+        closed-form program, keyed by ``len(entries)`` (the live analog
+        of the reference's outstanding-WR pipelining).  ``entries`` is
+        depth*batch entries, idx-contiguous from ``end0``.  Returns the
+        device commit index after the last round, or None if ``gen`` is
+        stale.  Same lock discipline as commit_round."""
+        B = self.batch
+        K = len(entries) // B
+        assert K in self._pipes and len(entries) == K * B, \
+            (len(entries), K, B, sorted(self._pipes))
+        pipe = self._pipes[K]
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
@@ -383,13 +406,16 @@ class DeviceCommitRunner:
             if gen != self.generation or self._devlog is None:
                 return None            # reset raced the staging: discard
             assert end0 == self._next_end0, (end0, self._next_end0)
-            new_devlog, commits, _ = self._pipe(self._devlog, sdata,
-                                                smeta, ctrl)
+            new_devlog, commits, _ = pipe(self._devlog, sdata,
+                                          smeta, ctrl)
             self._devlog = new_devlog
             self._next_end0 = end0 + K * B
             self.stats["rounds"] += K
             self.stats["entries_devplane"] += K * B
             self.stats["pipelined_dispatches"] += 1
+            if K == self.DEEP_DEPTH:
+                self.stats["deep_dispatches"] = \
+                    self.stats.get("deep_dispatches", 0) + 1
         self._jax.block_until_ready(commits)
         commits_host = np.asarray(commits)
         # Per-round accounting (parity with the single-round path: a
@@ -662,21 +688,28 @@ class DevicePlaneDriver:
                 node.log.append(term, type=EntryType.NOOP)
             if (node.log.end - 1) % B != 0:
                 return False               # log full: wait for pruning
-        # Pipelined dispatch when the backlog covers K clean batches:
-        # K rounds ride one XLA program (runner.commit_rounds) instead
-        # of K dispatch+sync cycles.
-        K = self.runner.PIPE_DEPTH
+        # Pipelined dispatch when the backlog covers a window of clean
+        # batches: the deepest available window rides one XLA program
+        # (runner.commit_rounds) instead of K dispatch+sync cycles —
+        # DEEP_DEPTH (fused closed-form) under heavy backlog, else
+        # PIPE_DEPTH (lax.scan), else a single round.
         span_rounds = 1
-        if end - self._dev_next >= K * B:
+        entries = None
+        for K in (self.runner.DEEP_DEPTH, self.runner.PIPE_DEPTH):
+            if end - self._dev_next < K * B:
+                continue
             span = list(node.log.entries(self._dev_next,
                                          self._dev_next + K * B))
             if len(span) == K * B and not any(
                     len(wire.encode_entry(e)) > self.runner.slot_bytes
                     for e in span):
                 entries, span_rounds = span, K
-            else:
-                entries = span[:B] if len(span) >= B else []
-        else:
+                break
+            # This window is dirty (short span or an oversized entry
+            # inside it) — a SHALLOWER rung may still be clean; fall
+            # through and keep the single-batch prefix as the fallback.
+            entries = span[:B] if len(span) >= B else []
+        if entries is None:
             entries = list(node.log.entries(self._dev_next,
                                             self._dev_next + B))
         if span_rounds == 1:
@@ -700,7 +733,7 @@ class DevicePlaneDriver:
         # -- device dispatch outside the daemon lock --
         self.daemon.lock.release()
         try:
-            if span_rounds == K:
+            if span_rounds > 1:
                 dev_commit = self.runner.commit_rounds(gen, end0, entries,
                                                        cid, live)
                 res = None if dev_commit is None else ((), dev_commit)

@@ -220,3 +220,50 @@ def test_device_plane_pipelined_dispatch_under_burst():
         for d in c.live():
             assert d.node.sm.query(encode_get(b"bk%d" % (n - 1))) == b"bv"
         c.check_logs_consistent()
+
+
+def test_deep_fused_window_commits_and_is_readable():
+    """The DEEP_DEPTH fused window (closed-form program) commits a full
+    window in one dispatch, interoperates with the scan window and the
+    single-round step on the same device log, and its rows read back
+    through the same follower-drain path."""
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+
+    R, B = 3, 8
+    runner = DeviceCommitRunner(n_replicas=R, n_slots=256, slot_bytes=256,
+                                batch=B)
+    gen = runner.reset(leader=0, term=1, first_idx=1)
+    cid = Cid.initial(R)
+    live = set(range(R))
+
+    def batch_at(end0, n):
+        return [LogEntry(idx=end0 + j, term=1, type=EntryType.CSM,
+                         req_id=j + 1, clt_id=7,
+                         data=b"deep-%d" % (end0 + j))
+                for j in range(n)]
+
+    # single round, then deep fused window, then scan window — all
+    # against the same shards, end0 advancing contiguously.
+    end0 = 1
+    res = runner.commit_round(gen, end0, batch_at(end0, B), cid, live)
+    assert res is not None and res[1] == end0 + B
+    end0 += B
+    D = runner.DEEP_DEPTH
+    commit = runner.commit_rounds(gen, end0, batch_at(end0, D * B), cid,
+                                  live)
+    assert commit == end0 + D * B
+    assert runner.stats.get("deep_dispatches", 0) == 1
+    end0 += D * B
+    K = runner.PIPE_DEPTH
+    commit = runner.commit_rounds(gen, end0, batch_at(end0, K * B), cid,
+                                  live)
+    assert commit == end0 + K * B
+    # Follower-drain readback: rows from the middle of the fused window
+    # decode with the right idx/payload on a follower shard.
+    probe = 1 + B + (D // 2) * B
+    rows = runner.read_rows(1, gen, probe, probe + B)
+    assert rows is not None and len(rows) == B
+    assert rows[0].idx == probe and rows[0].data == b"deep-%d" % probe
