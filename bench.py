@@ -19,20 +19,25 @@ nodes.local.cfg) — for a 64-entry batched round, per-entry cost
 15/64 ~= 0.23 us.  vs_baseline = baseline_p50 / our_p50 (>1 is better
 than baseline).
 
-Robustness: this file is its own watchdog.  The parent process forks a
-child (same file, ``_APUS_BENCH_CHILD=1``) per backend attempt: TPU up to
-three times (the axon tunnel is intermittently degraded or wedged; a
-retry often lands in the fast state) under hard timeouts, then a
-forced-CPU fallback.  The child climbs a DEPTH LADDER (64 -> 256 -> 1024 rounds
-per dispatch), flushing a complete JSON headline after every depth — a
-watchdog kill mid-ladder still leaves the best completed number on
-stdout, and the parent takes the LAST JSON line.  Per-phase progress
+Robustness: this file is its own watchdog.  The parent process probes
+tunnel health cheaply (a 15 s trivial-jit child) and only spends a full
+attempt window (a watched child of this same file,
+``_APUS_BENCH_CHILD=1``) on a healthy probe, re-probing until the
+budget forces the forced-CPU fallback (the axon tunnel wedges for
+minutes at a time and clears on its own).  The child climbs a DEPTH
+LADDER (default 4096 -> 16384 -> 65536 rounds per dispatch on TPU),
+flushing a complete JSON headline after every depth — a watchdog kill
+mid-ladder still leaves the best completed number on stdout, and the
+parent takes the LAST JSON line.  A successful TPU result is recorded
+(with its git SHA) in BENCH_TPU_LAST.json; a CPU fallback attaches it
+as timestamped supplementary evidence only when the SHA still
+matches.  Per-phase progress
 goes to stderr so a timeout is diagnosable (backend init vs compile vs
 execute).  The JAX persistent compilation cache turns repeat compiles
 into disk hits.
 
-Env knobs: APUS_BENCH_DEPTHS (comma ladder, default "64,256,1024" TPU /
-"64" CPU), APUS_BENCH_BUDGET (total seconds, default 225),
+Env knobs: APUS_BENCH_DEPTHS (comma ladder, default "4096,16384,65536"
+TPU / "64,1024" CPU), APUS_BENCH_BUDGET (total seconds, default 225),
 APUS_BENCH_TPU_TIMEOUT (per-TPU-attempt watchdog, default 60),
 APUS_JAX_CACHE (compilation cache dir, default <repo>/.jax_cache).
 """
@@ -94,7 +99,7 @@ def _bench() -> None:
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
     depths = [int(d) for d in os.environ.get(
         "APUS_BENCH_DEPTHS",
-        "64,1024" if cpu else "1024,4096,16384").split(",")]
+        "64,1024" if cpu else "4096,16384,65536").split(",")]
     dispatches = 5 if cpu else 10
     single_iters = 10 if cpu else 20
     deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
@@ -306,6 +311,46 @@ def _parse_last_json(stdout: bytes | None) -> dict | None:
     return None
 
 
+_LAST_TPU = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_TPU_LAST.json")
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=10)
+        return out.stdout.decode().strip() if out.returncode == 0 else ""
+    except Exception:                            # noqa: BLE001
+        return ""
+
+
+def _tpu_probe(timeout_s: float) -> bool:
+    """Cheap tunnel-health probe: a trivial jit + scalar readback on the
+    default (axon) backend.  A wedged tunnel hangs here in ~the same way
+    it would hang the real attempt — failing fast (15 s) instead of
+    burning a whole 60 s attempt window, so the parent can keep
+    re-probing for a healthy window within its budget (wedges clear on
+    their own; a retry often lands in the fast state)."""
+    code = ("import jax; "
+            "print(int(jax.jit(lambda x: x + 1)(jax.numpy.int32(41)))); ")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("bench: tpu probe timed out", file=sys.stderr)
+        return False
+    except Exception:                            # noqa: BLE001
+        return False
+    ok = proc.returncode == 0 and b"42" in proc.stdout
+    if not ok:
+        print(f"bench: tpu probe failed rc={proc.returncode}",
+              file=sys.stderr)
+    return ok
+
+
 def main() -> None:
     if os.environ.get("_APUS_BENCH_CHILD"):
         _bench()
@@ -315,27 +360,44 @@ def main() -> None:
     budget = float(os.environ.get("APUS_BENCH_BUDGET", "225"))
     tpu_timeout = float(os.environ.get("APUS_BENCH_TPU_TIMEOUT", "60"))
 
-    attempts = []
-    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
-        # Three TPU attempts: the axon tunnel is intermittently wedged
-        # or degraded, and a fresh process often lands in the fast state
-        # (a healthy tunnel yields the depth-64 headline within ~15 s).
-        for _ in range(3):
-            attempts.append(({}, min(tpu_timeout, budget * 0.3)))
-    # CPU fallback: forced CPU backend (depth ladder is backend-keyed in
-    # the child: 64,256,1024 TPU / 64 CPU).
-    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
-    attempts.append((cpu_env, None))             # None = remaining budget
-
     result = None
-    for extra_env, t in attempts:
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        # Probe-guarded TPU attempts: probe the tunnel cheaply (15 s)
+        # and only spend a full attempt window on a healthy probe —
+        # wedges last minutes and clear on their own, so keep probing
+        # for a healthy window while the budget allows, reserving 45 s
+        # for the CPU fallback.
+        probe_deadline = t_start + budget - 45
+        while time.monotonic() < probe_deadline:
+            if not _tpu_probe(15):
+                time.sleep(4)
+                continue
+            remaining = budget - (time.monotonic() - t_start) - 45
+            if remaining < 20:
+                break
+            result = _run_child({}, min(tpu_timeout, remaining))
+            if result is not None:
+                break
+
+    if result is not None and result.get("detail", {}).get("backend") \
+            not in (None, "cpu", "none"):
+        # Record the successful TPU measurement for future fallbacks.
+        try:
+            with open(_LAST_TPU, "w") as f:
+                json.dump({"recorded_at_unix": int(time.time()),
+                           "git_sha": _git_sha(),
+                           "result": result}, f, indent=1)
+        except OSError:
+            pass
+
+    if result is None:
+        # CPU fallback: forced CPU backend (the depth ladder is
+        # backend-keyed in the child).
         remaining = budget - (time.monotonic() - t_start)
-        if remaining < 20:
-            break
-        timeout_s = min(t, remaining) if t is not None else remaining
-        result = _run_child(extra_env, timeout_s)
-        if result is not None:
-            break
+        if remaining >= 20:
+            result = _run_child(
+                {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+                remaining)
 
     if result is None:
         # Degraded but well-formed: never leave the driver with rc!=0.
@@ -348,6 +410,19 @@ def main() -> None:
                        "error": "all backend attempts failed or timed out",
                        "baseline_round_us": BASELINE_ROUND_US},
         }
+    if result.get("detail", {}).get("backend") in ("cpu", "none") \
+            and os.path.exists(_LAST_TPU):
+        # Supplementary evidence only (clearly timestamped): the fresh
+        # headline above remains the CPU measurement — this shows what
+        # the same program measured on the real chip when the tunnel
+        # was last healthy.
+        try:
+            with open(_LAST_TPU) as f:
+                prior = json.load(f)
+            if prior.get("git_sha") == _git_sha():
+                result["detail"]["prior_tpu_run"] = prior
+        except (OSError, json.JSONDecodeError):
+            pass
     print(json.dumps(result), flush=True)
 
 

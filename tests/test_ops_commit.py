@@ -350,3 +350,57 @@ def test_fused_rejects_whole_window_for_ahead_replica():
     # quorum still reached via the 3 aligned replicas
     assert list(commits) == [1 + (i + 1) * B for i in range(D)]
     assert (offs[[0, 1, 2], OFF_END] == 1 + D * B).all()
+
+
+def test_fused_pipelined_matches_scan_randomized():
+    """Randomized differential sweep: random geometry, staged depth,
+    fence/offset perturbations, and membership (STABLE or TRANSIT
+    dual-majority) per trial — the fused
+    closed-form step must stay bit-identical to the scan step whenever
+    replicas are aligned-or-behind (the fused contract; ahead replicas
+    are covered by the dedicated conservative-rejection test)."""
+    import random
+
+    from apus_tpu.ops.commit import (build_pipelined_commit_step,
+                                     build_pipelined_commit_step_fused)
+
+    rng = random.Random(20260730)
+    for trial in range(8):
+        R = rng.choice([2, 4, 8])
+        B = rng.choice([4, 8])
+        NB = rng.choice([4, 8])
+        S = NB * B
+        D = rng.choice([1, 3, NB, NB + 3, 2 * NB])
+        SD = rng.choice([1, D])
+        # end0 batch-aligned, somewhere into the ring's second lap.
+        end0 = 1 + B * rng.randrange(0, 2 * NB)
+        cid = None
+        if R >= 4 and rng.random() < 0.5:
+            # TRANSIT dual-majority membership
+            cid = Cid.initial(R - 2).extend(R)
+            for r in range(R - 2, R):
+                cid = cid.with_server(r)
+            cid = cid.to_transit()
+        fence_overrides = {}
+        offs_overrides = {}
+        for r in range(R):
+            roll = rng.random()
+            if roll < 0.2:
+                fence_overrides[r] = (rng.randrange(R), rng.randrange(1, 5))
+            elif roll < 0.4:
+                # behind by a whole number of batches (never ahead)
+                behind = B * rng.randrange(0, max(1, (end0 - 1) // B + 1))
+                offs_overrides[r] = max(1, end0 - behind)
+        # Align the un-overridden replicas' ends with end0 (the helper
+        # builds fresh logs at end=1).
+        base_offs = {r: end0 for r in range(R)}
+        base_offs.update(offs_overrides)
+        kw = dict(R=R, B=B, S=S, D=D, SD=SD, end0=end0, cid=cid,
+                  fence_overrides=fence_overrides or None,
+                  offs_overrides=base_offs,
+                  distinct_batches=(SD == D))
+        a = _run_pipelined(build_pipelined_commit_step, **kw)
+        b = _run_pipelined(build_pipelined_commit_step_fused, **kw)
+        for x, y, what in zip(a, b, ("data", "meta", "offs", "fence",
+                                     "commits", "end0")):
+            assert np.array_equal(x, y), (trial, kw, what)
