@@ -302,10 +302,38 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     return step
 
 
+def _pallas_ring_mode(mode: str, batch: int, slot_bytes: int,
+                      mesh: Mesh) -> str:
+    """Resolve the fused step's pallas knob to 'compiled', 'interpret',
+    or 'off'.  'auto' requires the MESH devices to be TPUs (the
+    in-place blocked ring kernel needs Mosaic; a CPU mesh in a
+    TPU-default process must not resolve to 'compiled') and then probes
+    once per process; elsewhere the XLA select path is used."""
+    if mode not in ("auto", "off", "interpret", "compiled"):
+        raise ValueError(f"bad pallas_mode {mode!r}")
+    from apus_tpu.ops import pallas_ring
+    if mode == "off" or not pallas_ring.geometry_supported(batch,
+                                                           slot_bytes):
+        return "off"
+    if mode in ("interpret", "compiled"):
+        return mode
+    platform = next(iter(mesh.devices.flat)).platform.lower()
+    if "tpu" not in platform and "axon" not in platform:
+        return "off"
+    global _PALLAS_PROBED
+    if _PALLAS_PROBED is None:
+        _PALLAS_PROBED = pallas_ring.probe(interpret=False)
+    return "compiled" if _PALLAS_PROBED else "off"
+
+
+_PALLAS_PROBED: bool | None = None
+
+
 def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
                                       n_slots: int, slot_bytes: int,
                                       batch: int, depth: int,
-                                      staged_depth: int | None = None):
+                                      staged_depth: int | None = None,
+                                      pallas_mode: str = "auto"):
     """Closed-form pipelined commit: same contract as
     ``build_pipelined_commit_step`` but the ``depth`` rounds are computed
     algebraically instead of sequentially scanned.
@@ -347,6 +375,7 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
     NB = S // B
     E = min(D, NB)          # rounds whose writes survive in the ring
     i0 = D - E              # first surviving round
+    pallas_mode = _pallas_ring_mode(pallas_mode, batch, slot_bytes, mesh)
     sharded = P(REPLICA_AXIS)
     staged = P(None, REPLICA_AXIS)
     repl = P()
@@ -399,11 +428,19 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
         rnd_of_b = i0 + e_of_b                              # [NB] round id
         src_of_b = rnd_of_b % SD                            # staged index
         if SD == 1:
-            new_blocks = jnp.broadcast_to(sd_l[0][None], (NB, B, SB))
             new_mcols = jnp.broadcast_to(sm_l[0][None], (NB, B, 4))
         else:
-            new_blocks = jnp.take(sd_l, src_of_b, axis=0)   # [NB,B,SB]
             new_mcols = jnp.take(sm_l, src_of_b, axis=0)    # [NB,B,4]
+
+        def _new_blocks():
+            # Ring-sized [NB,B,SB] data gather — only the XLA select
+            # path needs it materialized; on the pallas hot path it
+            # must stay out of the cond operands or every all-accept
+            # dispatch would pay the full ring-size HBM traffic the
+            # in-place kernel exists to avoid.
+            if SD == 1:
+                return jnp.broadcast_to(sd_l[0][None], (NB, B, SB))
+            return jnp.take(sd_l, src_of_b, axis=0)         # [NB,B,SB]
         j = jnp.arange(B, dtype=jnp.int32)
         idx_of_b = ctrl.end0 + rnd_of_b[:, None] * B + j[None, :]  # [NB,B]
         new_meta = jnp.stack([
@@ -414,14 +451,35 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
         ], axis=-1)                                         # [NB,B,6]
 
         sel = (accept[:, None] & written[None, :])[:, :, None, None]
-        live_d = log_data[:, :S].reshape(K, NB, B, SB)
         live_m = log_meta[:, :S].reshape(K, NB, B, META_COLS)
-        live_d = jnp.where(sel, new_blocks[None], live_d)
         live_m = jnp.where(sel, new_meta[None], live_m)
-        log_data = jnp.concatenate(
-            [live_d.reshape(K, S, SB), log_data[:, S:]], axis=1)
         log_meta = jnp.concatenate(
             [live_m.reshape(K, S, META_COLS), log_meta[:, S:]], axis=1)
+
+        def _data_select(ld):
+            live_d = ld[:, :S].reshape(K, NB, B, SB)
+            live_d = jnp.where(sel, _new_blocks()[None], live_d)
+            return jnp.concatenate(
+                [live_d.reshape(K, S, SB), ld[:, S:]], axis=1)
+
+        if pallas_mode == "off":
+            log_data = _data_select(log_data)
+        else:
+            # Hot path: every row accepts (the overwhelmingly common
+            # steady state) -> in-place blocked pallas write touching
+            # only the E written blocks; any rejection -> the whole-ring
+            # select, which preserves rejecting rows' live slots.
+            from apus_tpu.ops.pallas_ring import ring_write_all
+            e = jnp.arange(E, dtype=jnp.int32)
+            pos_e = (base + i0 + e) % NB
+            src_e = (i0 + e) % SD
+            log_data = lax.cond(
+                jnp.all(accept),
+                lambda ld: ring_write_all(
+                    ld, sd_l, pos_e, src_e,
+                    interpret=(pallas_mode == "interpret")),
+                _data_select,
+                log_data)
 
         # Final offsets (same clamp discipline as the scan body, folded
         # over the window: commits is nondecreasing, so the fold is just

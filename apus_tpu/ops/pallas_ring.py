@@ -1,0 +1,112 @@
+"""Pallas TPU kernel for the fused commit window's ring write.
+
+The fused pipelined commit step (ops.commit.build_pipelined_commit_step_fused)
+ends a depth-D window with one bulk ring update: the last ``E = min(D, S/B)``
+rounds' batches land in E consecutive slot-blocks (mod ring).  The pure-XLA
+realization is a whole-ring select (read old ring + write new ring, ~2x the
+ring size in HBM traffic).  This kernel does the same update **in place**:
+
+- grid = (K replica rows, E written blocks) — the grid *only visits blocks
+  that are actually written*; with the ring buffer aliased input->output,
+  untouched rows are never read or written (the RDMA analog: the reference
+  writes exactly the entry range, update_remote_logs dare_ibv_rc.c:1460-1644,
+  never the whole log buffer).
+- scalar-prefetched index vectors choose, per grid step, the destination
+  slot-block (``pos[e]``, ring position) and the source staged batch
+  (``src[e]``, which staged buffer round ``i0+e`` consumed) — the
+  PrefetchScalarGridSpec pattern: block index maps read the scalars.
+- the kernel body is a single VMEM copy ``out[:] = staged_block[:]``.
+
+It only covers the all-rows-accept case (every replica row passes the fence
++ contiguity check): the fused step wraps it in ``lax.cond`` and falls back
+to the whole-ring select when any row rejects — rejection means leadership
+churn or a lagging replica, both rare and host-visible, so the hot path
+stays minimal.
+
+TPU tiling: uint8 blocks need (32, 128) min tiles, so the kernel engages
+only when ``batch % 32 == 0 and slot_bytes % 128 == 0`` (the production
+geometry 64 x 4096 qualifies; tiny test geometries fall back to XLA).
+Tests run it in interpreter mode on the CPU mesh; on an unsupported
+backend the builder's probe falls back to the XLA path at build time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:                                             # pallas is optional at import
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:                                # noqa: BLE001
+    _HAVE_PALLAS = False
+
+
+def geometry_supported(batch: int, slot_bytes: int) -> bool:
+    """uint8 VMEM tiling constraint: (32, 128) min tile."""
+    return _HAVE_PALLAS and batch % 32 == 0 and slot_bytes % 128 == 0
+
+
+def ring_write_all(log_data, staged, pos, src, *, interpret: bool):
+    """In-place blocked ring write (all replica rows accept).
+
+    log_data [K, rows, SB] u8 (donated; rows >= S), staged [SD, B, SB] u8,
+    pos [E] i32 (destination slot-block per written block, in block units),
+    src [E] i32 (source staged index per written block).  Returns the
+    updated ring.
+    """
+    K, rows, SB = log_data.shape
+    SD, B, _ = staged.shape
+    E = pos.shape[0]
+
+    def kernel(pos_ref, src_ref, ring_ref, staged_ref, out_ref):
+        del pos_ref, src_ref, ring_ref          # consumed by the index maps
+        out_ref[:] = staged_ref[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                   # pos, src
+        grid=(K, E),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),     # ring: aliased, unread
+            pl.BlockSpec((1, B, SB),
+                         lambda k, e, pos, src: (src[e], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, B, SB),
+                               lambda k, e, pos, src: (k, pos[e], 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((K, rows, SB), log_data.dtype),
+        input_output_aliases={2: 0},             # ring (after 2 scalars) -> out
+        interpret=interpret,
+    )(pos, src, log_data, staged)
+
+
+def probe(interpret: bool) -> bool:
+    """Build-time self-check: run a tiny instance end to end and verify
+    the in-place semantics (written blocks replaced, others untouched).
+    Any failure means the backend can't run the kernel — callers fall
+    back to the XLA select path."""
+    if not _HAVE_PALLAS:
+        return False
+    try:
+        import numpy as np
+        K, NB, B, SB = 2, 4, 32, 128
+        ring = jnp.asarray(
+            np.arange(K * (NB * B + B) * SB, dtype=np.uint8).reshape(
+                K, NB * B + B, SB))
+        before = np.asarray(ring)
+        staged = jnp.asarray(
+            np.full((1, B, SB), 7, np.uint8))
+        pos = jnp.asarray(np.array([1, 2], np.int32))
+        src = jnp.asarray(np.array([0, 0], np.int32))
+        out = np.asarray(ring_write_all(ring, staged, pos, src,
+                                        interpret=interpret))
+        ok = ((out[:, B:3 * B] == 7).all()
+              and (out[:, :B] == before[:, :B]).all()
+              and (out[:, 3 * B:] == before[:, 3 * B:]).all())
+        return bool(ok)
+    except Exception:                            # noqa: BLE001
+        return False

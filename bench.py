@@ -29,9 +29,9 @@ LADDER (default 4096 -> 16384 -> 65536 rounds per dispatch on TPU),
 flushing a complete JSON headline after every depth — a watchdog kill
 mid-ladder still leaves the best completed number on stdout, and the
 parent takes the LAST JSON line.  A successful TPU result is recorded
-(with its git SHA) in BENCH_TPU_LAST.json; a CPU fallback attaches it
-as timestamped supplementary evidence only when the SHA still
-matches.  Per-phase progress
+(with a content fingerprint of the measured sources) in
+BENCH_TPU_LAST.json; a CPU fallback attaches it as timestamped
+supplementary evidence only while the fingerprint still matches.  Per-phase progress
 goes to stderr so a timeout is diagnosable (backend init vs compile vs
 execute).  The JAX persistent compilation cache turns repeat compiles
 into disk hits.
@@ -315,15 +315,24 @@ _LAST_TPU = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_TPU_LAST.json")
 
 
-def _git_sha() -> str:
-    try:
-        out = subprocess.run(
-            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
-             "rev-parse", "HEAD"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=10)
-        return out.stdout.decode().strip() if out.returncode == 0 else ""
-    except Exception:                            # noqa: BLE001
-        return ""
+def _code_fingerprint() -> str:
+    """Content hash of the measurement-relevant sources (this file and
+    the device data plane).  Robust where a git SHA is not: unrelated
+    commits don't invalidate recorded evidence, and uncommitted edits
+    to the measured code DO."""
+    import hashlib
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("bench.py", "apus_tpu/ops/commit.py",
+                "apus_tpu/ops/logplane.py", "apus_tpu/ops/mesh.py",
+                "apus_tpu/ops/pallas_ring.py"):
+        p = os.path.join(root, rel)
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<missing:%s>" % rel.encode())
+    return h.hexdigest()[:16]
 
 
 def _tpu_probe(timeout_s: float) -> bool:
@@ -385,7 +394,7 @@ def main() -> None:
         try:
             with open(_LAST_TPU, "w") as f:
                 json.dump({"recorded_at_unix": int(time.time()),
-                           "git_sha": _git_sha(),
+                           "code_fingerprint": _code_fingerprint(),
                            "result": result}, f, indent=1)
         except OSError:
             pass
@@ -419,7 +428,7 @@ def main() -> None:
         try:
             with open(_LAST_TPU) as f:
                 prior = json.load(f)
-            if prior.get("git_sha") == _git_sha():
+            if prior.get("code_fingerprint") == _code_fingerprint():
                 result["detail"]["prior_tpu_run"] = prior
         except (OSError, json.JSONDecodeError):
             pass

@@ -404,3 +404,29 @@ def test_fused_pipelined_matches_scan_randomized():
         for x, y, what in zip(a, b, ("data", "meta", "offs", "fence",
                                      "commits", "end0")):
             assert np.array_equal(x, y), (trial, kw, what)
+
+
+@pytest.mark.parametrize("scenario", ["all_accept", "one_fenced"])
+def test_fused_pallas_ring_matches_scan(scenario):
+    """The pallas in-place ring kernel (interpret mode on the CPU mesh)
+    keeps the fused step bit-identical to the scan step — both on the
+    all-accept hot path (kernel) and under rejection (lax.cond falls
+    back to the whole-ring select, preserving the rejecting row)."""
+    import functools
+
+    from apus_tpu.ops.commit import (build_pipelined_commit_step,
+                                     build_pipelined_commit_step_fused)
+
+    # pallas-supported geometry: B % 32 == 0, SB % 128 == 0
+    kw = dict(R=4, B=32, S=128, SB=128, D=6, SD=6, end0=33,
+              distinct_batches=True)
+    if scenario == "one_fenced":
+        kw["fence_overrides"] = {2: (3, 9)}
+    kw["offs_overrides"] = {r: 33 for r in range(4)}
+    a = _run_pipelined(build_pipelined_commit_step, **kw)
+    fused_pallas = functools.partial(build_pipelined_commit_step_fused,
+                                     pallas_mode="interpret")
+    b = _run_pipelined(fused_pallas, **kw)
+    for x, y, what in zip(a, b, ("data", "meta", "offs", "fence",
+                                 "commits", "end0")):
+        assert np.array_equal(x, y), (scenario, what)
