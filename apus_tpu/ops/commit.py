@@ -312,11 +312,18 @@ def _pallas_ring_mode(mode: str, batch: int, slot_bytes: int,
     if mode not in ("auto", "off", "interpret", "compiled"):
         raise ValueError(f"bad pallas_mode {mode!r}")
     from apus_tpu.ops import pallas_ring
-    if mode == "off" or not pallas_ring.geometry_supported(batch,
-                                                           slot_bytes):
-        return "off"
+    supported = pallas_ring.geometry_supported(batch, slot_bytes)
     if mode in ("interpret", "compiled"):
+        # An explicit request must never silently downgrade: a parity
+        # test would compare the XLA path against itself and a caller
+        # pinning the kernel would silently lose it.
+        if not supported:
+            raise ValueError(
+                f"pallas_mode={mode!r} but geometry ({batch}x{slot_bytes})"
+                " or backend does not support the ring kernel")
         return mode
+    if mode == "off" or not supported:
+        return "off"
     platform = next(iter(mesh.devices.flat)).platform.lower()
     if "tpu" not in platform and "axon" not in platform:
         return "off"

@@ -68,7 +68,7 @@ def ring_write_all(log_data, staged, pos, src, *, interpret: bool):
         num_scalar_prefetch=2,                   # pos, src
         grid=(K, E),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),     # ring: aliased, unread
+            pl.BlockSpec(memory_space=pl.ANY),        # ring: aliased, unread
             pl.BlockSpec((1, B, SB),
                          lambda k, e, pos, src: (src[e], 0, 0)),
         ],

@@ -114,6 +114,11 @@ class DeviceCommitRunner:
         self._built = False
         self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
                       "entries_devplane": 0, "pipelined_dispatches": 0}
+        #: dispatch-depth histogram {window_rounds: dispatches} — the
+        #: wrl_count_array analog (the reference histograms its commit
+        #: loop's iteration counts, dare_ibv_rc.c:1868-1937); this shows
+        #: how often traffic rode the single/scan/deep window shapes.
+        self.depth_histogram: dict[int, int] = {}
         # Build + compile eagerly: a lazy multi-second first compile
         # would hand the opening of every first leadership to the host
         # path (and leave the device cursor behind a pruned head).
@@ -351,6 +356,7 @@ class DeviceCommitRunner:
             self._next_end0 = end0 + B
             self.stats["rounds"] += 1
             self.stats["entries_devplane"] += B
+            self.depth_histogram[1] = self.depth_histogram.get(1, 0) + 1
         self._jax.block_until_ready(commit)
         acks_host = [int(a) for a in np.asarray(acks)]
         commit_host = int(commit)
@@ -413,6 +419,7 @@ class DeviceCommitRunner:
             self.stats["rounds"] += K
             self.stats["entries_devplane"] += K * B
             self.stats["pipelined_dispatches"] += 1
+            self.depth_histogram[K] = self.depth_histogram.get(K, 0) + 1
             if K == self.DEEP_DEPTH:
                 self.stats["deep_dispatches"] = \
                     self.stats.get("deep_dispatches", 0) + 1

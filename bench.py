@@ -325,7 +325,8 @@ def _code_fingerprint() -> str:
     h = hashlib.sha256()
     for rel in ("bench.py", "apus_tpu/ops/commit.py",
                 "apus_tpu/ops/logplane.py", "apus_tpu/ops/mesh.py",
-                "apus_tpu/ops/pallas_ring.py"):
+                "apus_tpu/ops/pallas_ring.py",
+                "apus_tpu/runtime/device_plane.py"):
         p = os.path.join(root, rel)
         try:
             with open(p, "rb") as f:
