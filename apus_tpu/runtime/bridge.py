@@ -117,9 +117,15 @@ class Replayer:
     #: app dirty and falling back to a full re-prime.
     MAX_RETRIES = 3
 
-    def __init__(self, app_host: str, app_port: int, logger=None):
+    def __init__(self, app_host: str, app_port: int, logger=None,
+                 req_log_path: str | None = None):
         self.app = (app_host, app_port)
         self.logger = logger
+        # Replayed-request log (the reference's req_log knob: every
+        # action replayed into the local app is appended to
+        # node-proxy-req.log, proxy.c:470-484, do_action_to_server
+        # :344-366).  Off unless ClusterSpec.req_log is set.
+        self._req_log = open(req_log_path, "a") if req_log_path else None
         self._q: "queue.Queue[Optional[tuple[int, int, bytes]]]" = \
             queue.Queue()
         self._conns: dict[int, socket.socket] = {}
@@ -157,6 +163,11 @@ class Replayer:
             except OSError:
                 pass
         self._conns.clear()
+        if self._req_log is not None:
+            try:
+                self._req_log.close()
+            except OSError:
+                pass
 
     def submit(self, action: int, conn_id: int, data: bytes) -> None:
         self._q.put((action, conn_id, data))
@@ -205,6 +216,10 @@ class Replayer:
                 self._reprime()
 
     def _replay(self, action: int, conn_id: int, data: bytes) -> None:
+        if self._req_log is not None:
+            self._req_log.write("%.6f %s conn=%x len=%d\n" % (
+                time.time(), ProxyAction(action).name, conn_id, len(data)))
+            self._req_log.flush()
         if action == ProxyAction.CONNECT:
             self._conns[conn_id] = self._connect()
         elif action == ProxyAction.SEND:
@@ -327,7 +342,12 @@ class Bridge:
 
         host = app_host if app_host is not None else daemon.spec.app_host
         port = app_port if app_port is not None else daemon.spec.app_port
-        self.replayer = Replayer(host, port, self.logger)
+        req_log_path = None
+        if getattr(daemon.spec, "req_log", False):
+            req_log_path = os.path.join(
+                workdir, f"node{self.idx}-proxy-req.log")
+        self.replayer = Replayer(host, port, self.logger,
+                                 req_log_path=req_log_path)
         self.replayer.reprime_source = self._reprime_records
         self._spin_timeouts_seen = 0
         # Record ranges whose reads the proxy FAILED (NACK frames):

@@ -396,3 +396,104 @@ def test_nack_index_eviction_falls_back_to_history_scan():
         # Indexed path (above the floor) replays too.
         bridge._handle_nack(base + 4, base + 4)
         assert wait_key("kept-one") == "v"
+
+
+def test_req_log_records_replayed_actions(tmp_path):
+    """ClusterSpec.req_log wires the reference's replayed-request log
+    (node-proxy-req.log, proxy.c:470-484): every action replayed into
+    the local app is appended with action/conn/len."""
+    import dataclasses
+    import os
+
+    from apus_tpu.runtime.appcluster import (PROXIED_SPEC, LineClient,
+                                             ProxiedCluster)
+
+    spec = dataclasses.replace(PROXIED_SPEC, req_log=True)
+    with ProxiedCluster(3, spec=spec) as pc:
+        leader = pc.leader_idx()
+        with LineClient(pc.app_addr(leader)) as c:
+            assert c.cmd("SET rq 1") == "OK"
+        follower = next(i for i in range(3) if i != leader)
+        path = os.path.join(pc.workdir,
+                            f"node{follower}-proxy-req.log")
+        deadline = time.monotonic() + 15
+        content = ""
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                content = open(path).read()
+                if "SEND" in content:
+                    break
+            time.sleep(0.1)
+        assert "CONNECT" in content and "SEND" in content, content
+
+
+def test_req_log_survives_reprime(tmp_path):
+    """A dirty-app re-prime must keep the request log usable: replays
+    during and after the rebuild still append (a closed log file would
+    kill the replay worker with ValueError, silently diverging the
+    replica)."""
+    from apus_tpu.core.types import ProxyAction
+    from apus_tpu.runtime.bridge import Replayer
+
+    import socket as socketlib
+    import threading
+
+    # Minimal line-sink app: accepts connections, echoes OK per line.
+    srv = socketlib.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def app():
+        srv.settimeout(0.2)
+        conns = []
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                conns.append(c)
+                c.settimeout(0.2)
+            except OSError:
+                pass
+            for c in conns:
+                try:
+                    if c.recv(4096):
+                        c.sendall(b"OK\n")
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=app, daemon=True)
+    t.start()
+    try:
+        log_path = str(tmp_path / "req.log")
+        r = Replayer("127.0.0.1", port, req_log_path=log_path)
+        r.connect_attempts = 3
+        r.reprime_source = lambda: [
+            (int(ProxyAction.CONNECT), 1, b""),
+            (int(ProxyAction.SEND), 1, b"SET rk 1\n"),
+        ]
+        r.start()
+        r.submit(int(ProxyAction.CONNECT), 1, b"")
+        r.submit(int(ProxyAction.SEND), 1, b"SET a 1\n")
+        deadline = time.monotonic() + 10
+        while r.replayed < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert r.replayed == 2
+        r.dirty = True                      # force the re-prime path
+        r.submit(int(ProxyAction.SEND), 1, b"SET b 2\n")  # triggers reprime
+        deadline = time.monotonic() + 10
+        while r.reprimes < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert r.reprimes == 1 and not r.dirty
+        # Replay AFTER the re-prime still works and still logs.
+        r.submit(int(ProxyAction.SEND), 1, b"SET c 3\n")
+        deadline = time.monotonic() + 10
+        while r.replayed < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert r.replayed >= 3
+        r.stop()
+        content = open(log_path).read()
+        assert content.count("SEND") >= 3, content
+    finally:
+        stop.set()
+        srv.close()
