@@ -1,0 +1,115 @@
+"""One-sided leader->followers log scatter over ICI (pallas remote DMA).
+
+The reference's replication data plane is one-sided RDMA: the leader
+writes the entry range directly into each follower's log memory and
+followers are passive on the critical path (update_remote_logs,
+dare_ibv_rc.c:1460-1644).  The production commit step re-expresses that
+fan-out as a ``pmax`` collective (XLA picks the ICI algorithm); THIS
+module is the explicit one-sided form of the same operation, built on
+``pltpu.make_async_remote_copy`` — the TPU instruction that IS an RDMA
+write over the interconnect.
+
+Topology: the reference posts one RDMA WRITE per follower because an IB
+fabric is all-to-all switched; a TPU torus is not — its native shape is
+the neighbor RING.  So the kernel pipelines the leader's window around
+the ring: every hop is a one-sided write into the RIGHT neighbor's
+landing buffer (double-buffered; no handshake beyond the DMA
+semaphores), and each replica captures the window into its output when
+the leader's bytes reach it (hop distance == (my - leader) mod N).
+Every device executes the identical DMA sequence — the structurally
+symmetric program a collective fabric wants (and the reason the naive
+asymmetric fan-out deadlocks: remote-copy rendezvous needs all
+participants).
+
+Scope: a demonstrated alternative data path, not the default.  On the
+single-chip bench topology there are no remote peers, so the pmax step
+remains the production scatter; this kernel runs on the multi-device
+mesh (interpret mode on the CPU test mesh, exercised by
+tests/test_ops_commit.py and __graft_entry__.dryrun_multichip; compiled
+on a real multi-chip TPU slice, where DeviceIdType.LOGICAL routes over
+ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apus_tpu.ops.mesh import REPLICA_AXIS
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:                                # noqa: BLE001
+    _HAVE_PALLAS = False
+
+
+def build_one_sided_scatter(mesh, batch: int, slot_bytes: int,
+                            interpret: bool = False):
+    """Returns ``scatter(local [N,B,SB] u8, leader i32) -> landed
+    [N,B,SB] u8``: every shard's landing buffer ends up holding the
+    LEADER shard's batch, delivered hop by hop by one-sided remote
+    copies.  One replica row per device (N = mesh axis size)."""
+    if not _HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    N = mesh.shape[REPLICA_AXIS]
+    B, SB = batch, slot_bytes
+
+    def kernel(local_ref, leader_ref, out_ref, comm, send_sem, recv_sem):
+        my = jax.lax.axis_index(REPLICA_AXIS)
+        right = jax.lax.rem(my + 1, jnp.int32(N))
+        dist = jax.lax.rem(my - leader_ref[0] + jnp.int32(N), jnp.int32(N))
+
+        comm[0] = local_ref[:]
+        for s in range(N):
+            slot = s % 2
+            # Capture when the leader's window has reached this hop
+            # (local predicated copy — no cross-device divergence).
+            @pl.when(jnp.int32(s) == dist)
+            def _():
+                out_ref[:] = comm[slot]
+            if s < N - 1:
+                # One-sided push of the current buffer into the right
+                # neighbor's OTHER slot (double buffering: the slot
+                # being sent is never the slot being landed into).
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=comm.at[slot],
+                    dst_ref=comm.at[1 - slot],
+                    send_sem=send_sem.at[slot],
+                    recv_sem=recv_sem.at[1 - slot],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                rdma.start()
+                rdma.wait()
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, SB), jnp.uint8),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),       # local batch
+            pl.BlockSpec(memory_space=pltpu.SMEM),       # leader scalar
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, B, SB), jnp.uint8),           # ring buffers
+            pltpu.SemaphoreType.DMA((2,)),               # per-slot send
+            pltpu.SemaphoreType.DMA((2,)),               # per-slot recv
+        ],
+        interpret=interpret,
+    )
+
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(REPLICA_AXIS), P()),
+                       out_specs=P(REPLICA_AXIS), check_vma=False)
+    def scatter(local, leader):
+        out = call(local[0], jnp.asarray([leader], jnp.int32))
+        return out[None]
+
+    return scatter

@@ -215,11 +215,22 @@ class Replayer:
                         self.MAX_RETRIES, e)
                 self._reprime()
 
-    def _replay(self, action: int, conn_id: int, data: bytes) -> None:
-        if self._req_log is not None:
+    def _req_log_write(self, action: int, conn_id: int,
+                       data: bytes) -> None:
+        """Observability only: a log-file failure (disk full, closed on
+        teardown) must never be confused with app divergence or kill
+        the replay worker — it just disables the log."""
+        if self._req_log is None:
+            return
+        try:
             self._req_log.write("%.6f %s conn=%x len=%d\n" % (
                 time.time(), ProxyAction(action).name, conn_id, len(data)))
             self._req_log.flush()
+        except Exception:                            # noqa: BLE001
+            self._req_log = None
+
+    def _replay(self, action: int, conn_id: int, data: bytes) -> None:
+        self._req_log_write(action, conn_id, data)
         if action == ProxyAction.CONNECT:
             self._conns[conn_id] = self._connect()
         elif action == ProxyAction.SEND:

@@ -154,6 +154,12 @@ class DeviceCommitRunner:
         # One replica's offsets row, as a NEW buffer: shard_end must not
         # hand out a view of the (donated) devlog arrays.
         self._offs_one = jax.jit(lambda o, r: o[r])
+        # Round-result packer: acks [R] + commit scalar fused into ONE
+        # [R+1] array so the leader round blocks on a single
+        # device->host transfer (two separate readbacks pay two relay
+        # round trips on a tunneled chip).
+        self._pack_result = jax.jit(
+            lambda acks, commit: jnp.concatenate([acks, commit[None]]))
         # Leader-row expansion ON DEVICE: the host ships only the
         # leader's [B,SB] batch; the [R,B,SB] leader-row-only layout the
         # step consumes (zeros elsewhere) is built by XLA.  Staging a
@@ -265,8 +271,8 @@ class DeviceCommitRunner:
         self._jax.block_until_ready(bdata)
         ctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
                                live=set(range(R)))
-        devlog, _, commit = self._step(devlog, bdata, bmeta, ctrl)
-        self._jax.block_until_ready(commit)
+        devlog, acks, commit = self._step(devlog, bdata, bmeta, ctrl)
+        self._jax.block_until_ready(self._pack_result(acks, commit))
         # Pipelined program too (compiled now, never mid-leadership),
         # reusing the step's returned devlog — a second make_device_log
         # would allocate+transfer another full shard set just to warm a
@@ -357,9 +363,18 @@ class DeviceCommitRunner:
             self.stats["rounds"] += 1
             self.stats["entries_devplane"] += B
             self.depth_histogram[1] = self.depth_histogram.get(1, 0) + 1
-        self._jax.block_until_ready(commit)
-        acks_host = [int(a) for a in np.asarray(acks)]
-        commit_host = int(commit)
+        if self._use_device_expand:
+            # One blocked device->host transfer per round (two separate
+            # readbacks pay two relay round trips on a tunneled chip).
+            packed = np.asarray(self._pack_result(acks, commit))
+            acks_host = [int(a) for a in packed[:-1]]
+            commit_host = int(packed[-1])
+        else:
+            # CPU backend: no relay to save; the extra pack dispatch
+            # costs more than the second host conversion (same rationale
+            # as _use_device_expand).
+            acks_host = [int(a) for a in np.asarray(acks)]
+            commit_host = int(np.asarray(commit))
         if commit_host < end0 + B:
             self.stats["quorum_fail_rounds"] += 1
         return acks_host, commit_host

@@ -430,3 +430,22 @@ def test_fused_pallas_ring_matches_scan(scenario):
     for x, y, what in zip(a, b, ("data", "meta", "offs", "fence",
                                  "commits", "end0")):
         assert np.array_equal(x, y), (scenario, what)
+
+
+def test_one_sided_scatter_lands_leader_batch_everywhere():
+    """Pallas remote-DMA ring broadcast (interpret mode on the CPU
+    mesh): the leader's batch lands in every replica's buffer via
+    one-sided neighbor writes — the explicit RDMA-write analog of the
+    production pmax scatter — for every leader position."""
+    from apus_tpu.ops.pallas_scatter import build_one_sided_scatter
+
+    N, B, SB = 4, 16, 256
+    mesh = replica_mesh(N)
+    scatter = build_one_sided_scatter(mesh, B, SB, interpret=True)
+    rng = np.random.default_rng(7)
+    local = rng.integers(0, 255, (N, B, SB), dtype=np.uint8)
+    for leader in range(N):
+        out = np.asarray(scatter(jax.numpy.asarray(local),
+                                 jax.numpy.int32(leader)))
+        for r in range(N):
+            assert np.array_equal(out[r], local[leader]), (leader, r)
