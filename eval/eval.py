@@ -97,6 +97,17 @@ def cmd_run(args) -> int:
                 _record(out, rec, replicas=n, bench="run_bench",
                         app="redis" if args.redis else "toyserver")
 
+        # 1b. Device-plane full stack (proxied app with commits carried
+        # by the jitted device plane on the virtual CPU mesh).
+        print("run_bench: 3 replicas (device plane)")
+        argv = [sys.executable,
+                os.path.join(REPO, "benchmarks", "run_bench.py"),
+                "--replicas", "3", "--requests", str(args.requests),
+                "--device-plane"]
+        for rec in _run_tool(argv, timeout=420):
+            _record(out, rec, replicas=3, bench="run_bench_devplane",
+                    app="toyserver+devplane")
+
         # 2. Leader failover at the production envelope (process-per-
         # replica; reconf_bench.sh FailLeader analog).
         print("reconf_bench --proc: leader failover")
