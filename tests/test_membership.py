@@ -164,3 +164,53 @@ def test_two_sequential_joins():
         c.wait_caught_up(d5.idx)
         _wait(lambda: _stores_equal(c, range(5)), msg="stores converge")
         c.check_logs_consistent()
+
+
+def test_resize_under_faults_converges():
+    """Elasticity UNDER failure: grow the group while a member is dead,
+    keep committing on the reduced quorum, then revive the dead member
+    — everyone (including the joiner and the returnee) converges on one
+    STABLE configuration and one store.  Composes reconf_bench.sh's
+    RemoveServer + AddServer scenarios (:120-180) instead of running
+    them in isolation.  auto_remove is off: the scenario under test is
+    a dead-but-configured member (the auto-remove + rejoin ladder has
+    its own test)."""
+    import dataclasses
+    spec = dataclasses.replace(SPEC, auto_remove=False)
+    with LocalCluster(3, spec=spec) as c:
+        for i in range(8):
+            c.submit(encode_put(b"pre%d" % i, b"v"))
+        leader = c.wait_for_leader()
+        victim = next(i for i in range(3)
+                      if i != leader.idx)
+        c.kill(victim)
+        # Quorum is still 2-of-3: writes continue while down a member.
+        c.submit(encode_put(b"during", b"down"))
+        d = c.add_replica()               # 3 -> 4 with one member dead
+        assert d.idx == 3
+
+        def stable4():
+            for dd in c.live():
+                with dd.lock:
+                    cid = dd.node.cid
+                    if not (cid.state == CidState.STABLE and cid.size == 4
+                            and cid.contains(3)):
+                        return False
+            return True
+        _wait(stable4, timeout=30, msg="STABLE size-4 under a dead member")
+        # 3-of-4 quorum holds with the victim still dead.
+        c.submit(encode_put(b"grown", b"3of4"))
+        # Revive: the returnee catches up into the NEW configuration.
+        c.restart(victim)
+        for i in range(4):
+            c.wait_caught_up(i, timeout=30.0)
+        _wait(lambda: _stores_equal(c, range(4)), timeout=30,
+              msg="all four stores converge")
+        c.check_logs_consistent()
+        for i in range(4):
+            dd = c.daemons[i]
+            with dd.lock:
+                assert dd.node.sm.store[b"during"] == b"down"
+                assert dd.node.sm.store[b"grown"] == b"3of4"
+                assert dd.node.cid.state == CidState.STABLE
+                assert dd.node.cid.size == 4
