@@ -37,7 +37,7 @@ execute).  The JAX persistent compilation cache turns repeat compiles
 into disk hits.
 
 Env knobs: APUS_BENCH_DEPTHS (comma ladder, default "4096,16384,65536"
-TPU / "64,1024" CPU), APUS_BENCH_BUDGET (total seconds, default 225),
+TPU / "64,1024,16384" CPU), APUS_BENCH_BUDGET (total seconds, default 225),
 APUS_BENCH_TPU_TIMEOUT (per-TPU-attempt watchdog, default 60),
 APUS_JAX_CACHE (compilation cache dir, default <repo>/.jax_cache).
 """
@@ -99,7 +99,7 @@ def _bench() -> None:
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
     depths = [int(d) for d in os.environ.get(
         "APUS_BENCH_DEPTHS",
-        "64,1024" if cpu else "4096,16384,65536").split(",")]
+        "64,1024,16384" if cpu else "4096,16384,65536").split(",")]
     dispatches = 5 if cpu else 10
     single_iters = 10 if cpu else 20
     deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))

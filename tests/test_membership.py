@@ -41,6 +41,19 @@ def _stores_equal(cluster, idxs):
     return all(s == stores[0] for s in stores)
 
 
+def _all_stable(cluster, size, must_contain=None):
+    """Every live replica sees a STABLE cid at ``size`` (optionally
+    containing ``must_contain``)."""
+    for dd in cluster.live():
+        with dd.lock:
+            cid = dd.node.cid
+            if not (cid.state == CidState.STABLE and cid.size == size):
+                return False
+            if must_contain is not None and not cid.contains(must_contain):
+                return False
+    return True
+
+
 def test_add_replica_upsize_to_stable():
     """3 -> 4 replicas: join admits, EXTENDED -> TRANSIT -> STABLE, and
     the joiner converges to the cluster state."""
@@ -51,15 +64,8 @@ def test_add_replica_upsize_to_stable():
         assert d.idx == 3
 
         # Ladder completes: every replica reaches STABLE at size 4.
-        def stable4():
-            for dd in c.live():
-                with dd.lock:
-                    cid = dd.node.cid
-                    if not (cid.state == CidState.STABLE and cid.size == 4
-                            and cid.contains(3)):
-                        return False
-            return True
-        _wait(stable4, msg="STABLE size-4 cid on all replicas")
+        _wait(lambda: _all_stable(c, 4, must_contain=3),
+              msg="STABLE size-4 cid on all replicas")
 
         c.wait_caught_up(3)
         _wait(lambda: _stores_equal(c, range(4)), msg="stores converge")
@@ -150,15 +156,7 @@ def test_two_sequential_joins():
         d5 = c.add_replica()
         c.wait_caught_up(d5.idx)
 
-        def stable5():
-            for dd in c.live():
-                with dd.lock:
-                    cid = dd.node.cid
-                    if not (cid.state == CidState.STABLE
-                            and cid.size == 5):
-                        return False
-            return True
-        _wait(stable5, msg="STABLE size-5")
+        _wait(lambda: _all_stable(c, 5), msg="STABLE size-5")
         c.submit(encode_put(b"b", b"2"))
         c.wait_caught_up(d4.idx)
         c.wait_caught_up(d5.idx)
@@ -189,15 +187,8 @@ def test_resize_under_faults_converges():
         d = c.add_replica()               # 3 -> 4 with one member dead
         assert d.idx == 3
 
-        def stable4():
-            for dd in c.live():
-                with dd.lock:
-                    cid = dd.node.cid
-                    if not (cid.state == CidState.STABLE and cid.size == 4
-                            and cid.contains(3)):
-                        return False
-            return True
-        _wait(stable4, timeout=30, msg="STABLE size-4 under a dead member")
+        _wait(lambda: _all_stable(c, 4, must_contain=3), timeout=30,
+              msg="STABLE size-4 under a dead member")
         # 3-of-4 quorum holds with the victim still dead.
         c.submit(encode_put(b"grown", b"3of4"))
         # Revive: the returnee catches up into the NEW configuration.
