@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import random
 
+import pytest
+
 from apus_tpu.models.kvs import KvsStateMachine, encode_get, encode_put
 from apus_tpu.parallel.sim import Cluster
 
@@ -23,9 +25,11 @@ def _write(c: Cluster, k: bytes, v: bytes, timeout: float = 20.0) -> None:
     c.submit(encode_put(k, v), timeout=timeout)
 
 
-def test_chaos_soak_crashes_partitions_loss():
-    rng = random.Random(1234)
-    c = Cluster(5, seed=77, sm_factory=KvsStateMachine, drop_rate=0.02,
+@pytest.mark.parametrize("schedule_seed,sim_seed",
+                         [(1234, 77), (31337, 5), (777, 900)])
+def test_chaos_soak_crashes_partitions_loss(schedule_seed, sim_seed):
+    rng = random.Random(schedule_seed)
+    c = Cluster(5, seed=sim_seed, sm_factory=KvsStateMachine, drop_rate=0.02,
                 auto_remove=False)
     c.wait_for_leader()
     acknowledged: dict[bytes, bytes] = {}
