@@ -302,6 +302,9 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     return step
 
 
+_PALLAS_PROBED: bool | None = None
+
+
 def _pallas_ring_mode(mode: str, batch: int, slot_bytes: int,
                       mesh: Mesh) -> str:
     """Resolve the fused step's pallas knob to 'compiled', 'interpret',
@@ -331,9 +334,6 @@ def _pallas_ring_mode(mode: str, batch: int, slot_bytes: int,
     if _PALLAS_PROBED is None:
         _PALLAS_PROBED = pallas_ring.probe(interpret=False)
     return "compiled" if _PALLAS_PROBED else "off"
-
-
-_PALLAS_PROBED: bool | None = None
 
 
 def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,

@@ -20,11 +20,11 @@ from apus_tpu.ops.logplane import (META_IDX, META_LEN, META_TERM, META_TYPE,
 from apus_tpu.ops.mesh import replica_mesh, replica_sharding
 
 
-def run_step(R=4, B=8, S=32, SB=64, leader=0, term=1, n_reqs=5,
-             fence_overrides=None, offs_overrides=None, cid=None,
-             devices=None, end0=1):
-    mesh = replica_mesh(R, devices=devices)
-    sh = replica_sharding(mesh)
+
+def _make_devlog(R, S, SB, B, leader, term, sh, fence_overrides=None,
+                 offs_overrides=None):
+    """Fresh device log with optional per-replica fence/end overrides
+    (shared by the single-step and pipelined test harnesses)."""
     devlog = make_device_log(R, S, SB, batch=B, leader=leader, term=term,
                              sharding=sh)
     if fence_overrides:
@@ -37,6 +37,15 @@ def run_step(R=4, B=8, S=32, SB=64, leader=0, term=1, n_reqs=5,
         for r, end in offs_overrides.items():
             o[r, OFF_END] = end
         devlog.offs = jax.device_put(o, sh)
+    return devlog
+
+def run_step(R=4, B=8, S=32, SB=64, leader=0, term=1, n_reqs=5,
+             fence_overrides=None, offs_overrides=None, cid=None,
+             devices=None, end0=1):
+    mesh = replica_mesh(R, devices=devices)
+    sh = replica_sharding(mesh)
+    devlog = _make_devlog(R, S, SB, B, leader, term, sh,
+                          fence_overrides, offs_overrides)
     step = build_commit_step(mesh, R, S, SB, B)
     reqs = [b"req-%d" % i for i in range(n_reqs)]
     bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
@@ -261,18 +270,8 @@ def _run_pipelined(builder, *, R=4, B=8, S=64, SB=64, D=4, SD=None,
     SD = D if SD is None else SD
     mesh = replica_mesh(R)
     sh = replica_sharding(mesh)
-    devlog = make_device_log(R, S, SB, batch=B, leader=leader, term=term,
-                             sharding=sh)
-    if fence_overrides:
-        f = np.array(devlog.fence)
-        for r, (g, t) in fence_overrides.items():
-            f[r] = (g, t)
-        devlog.fence = jax.device_put(f, sh)
-    if offs_overrides:
-        o = np.array(devlog.offs)
-        for r, end in offs_overrides.items():
-            o[r, OFF_END] = end
-        devlog.offs = jax.device_put(o, sh)
+    devlog = _make_devlog(R, S, SB, B, leader, term, sh,
+                          fence_overrides, offs_overrides)
     sdata = np.zeros((SD, R, B, SB), np.uint8)
     smeta = np.zeros((SD, R, B, 4), np.int32)
     for k in range(SD):
