@@ -267,3 +267,40 @@ def test_deep_fused_window_commits_and_is_readable():
     rows = runner.read_rows(1, gen, probe, probe + B)
     assert rows is not None and len(rows) == B
     assert rows[0].idx == probe and rows[0].data == b"deep-%d" % probe
+
+
+def test_deep_window_transit_dual_majority():
+    """The deep fused window enforces the TRANSIT dual-majority rule in
+    the live runner: with the new-config majority missing, no round of
+    the window commits; once present, the whole window commits."""
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+
+    R, B = 6, 8
+    runner = DeviceCommitRunner(n_replicas=R, n_slots=256, slot_bytes=256,
+                                batch=B)
+    gen = runner.reset(leader=0, term=1, first_idx=1)
+    cid = Cid.initial(4).extend(6).with_server(4).with_server(5).to_transit()
+    D = runner.DEEP_DEPTH
+
+    def batch_at(end0, n):
+        return [LogEntry(idx=end0 + j, term=1, type=EntryType.CSM,
+                         req_id=j + 1, clt_id=9, data=b"t%d" % (end0 + j))
+                for j in range(n)]
+
+    # New-config majority (4 of 6) not live: only 0..2 vote -> the old
+    # majority (3 of 4) holds but the new one (4 of 6) cannot.
+    commit = runner.commit_rounds(gen, 1, batch_at(1, D * B), cid,
+                                  live={0, 1, 2})
+    assert commit == 0                      # no round reached dual quorum
+    # (0 is the no-candidate sentinel; the driver only adopts
+    # dev_commit when it EXCEEDS the host commit, so no advance.)
+    assert runner.stats["quorum_fail_rounds"] >= D
+    # Full liveness: the next window satisfies both majorities, and its
+    # commit covers the earlier (replicated but uncommitted) window too.
+    end0 = 1 + D * B
+    commit = runner.commit_rounds(gen, end0, batch_at(end0, D * B), cid,
+                                  live=set(range(R)))
+    assert commit == end0 + D * B
