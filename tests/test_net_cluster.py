@@ -80,3 +80,40 @@ def test_leader_failover_live():
             with d.lock:
                 assert d.node.sm.store[b"before"] == b"1"
                 assert d.node.sm.store[b"after"] == b"2"
+
+
+def test_peer_server_survives_malicious_frames():
+    """Garbage on the peer port must not take a replica down: junk
+    bytes, truncated frames, oversized length prefixes, and unknown ops
+    are all shed per-connection (read_frame's 128 MB cap, _dispatch's
+    ST_ERROR) while consensus keeps committing."""
+    import socket
+    import struct
+
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        c.submit(encode_put(b"before", b"1"))
+        target = c.daemons[0].spec.peers[0]
+        host, port = target.rsplit(":", 1)
+        payloads = [
+            b"\xff" * 64,                                # junk, no framing
+            struct.pack("<I", 10) + b"sho",              # truncated frame
+            struct.pack("<I", 1 << 30),                  # oversized length
+            struct.pack("<I", 3) + b"\xfe\x01\x02",      # unknown op
+            struct.pack("<I", 1) + b"\x05",              # op w/o operands
+        ]
+        for p in payloads:
+            s = socket.create_connection((host, int(port)), timeout=5)
+            try:
+                s.sendall(p)
+                s.settimeout(0.5)
+                try:
+                    s.recv(64)
+                except OSError:
+                    pass
+            finally:
+                s.close()
+        # The replica is still alive and the cluster still commits.
+        c.submit(encode_put(b"after", b"2"))
+        assert wait(lambda: all(
+            d.node.sm.store.get(b"after") == b"2" for d in c.live()))
