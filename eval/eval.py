@@ -97,6 +97,18 @@ def cmd_run(args) -> int:
                 _record(out, rec, replicas=n, bench="run_bench",
                         app="redis" if args.redis else "toyserver")
 
+        # 1a2. SSDB 5-replica pass (BASELINE.json "SSDB 5-replica
+        # mixed" config), gated on the pinned build being available.
+        if getattr(args, "ssdb", False):
+            print("run_bench: 5 replicas (real ssdb)")
+            argv = [sys.executable,
+                    os.path.join(REPO, "benchmarks", "run_bench.py"),
+                    "--replicas", "5", "--requests", str(args.requests),
+                    "--ssdb"]
+            for rec in _run_tool(argv, timeout=420):
+                _record(out, rec, replicas=5, bench="run_bench",
+                        app="ssdb")
+
         # 1b. Device-plane full stack (proxied app with commits carried
         # by the jitted device plane on the virtual CPU mesh).
         print("run_bench: 3 replicas (device plane)")
@@ -330,6 +342,9 @@ def main() -> int:
         p.add_argument("--replicas", default="3,5,7",
                        help="comma list of group sizes")
         p.add_argument("--requests", type=int, default=2000)
+        p.add_argument("--ssdb", action="store_true",
+                       help="also run a 5-replica pass with the pinned "
+                            "real ssdb (BASELINE.json mixed config)")
         p.add_argument("--redis", action="store_true",
                        help="drive the pinned real redis instead of "
                             "toyserver")
