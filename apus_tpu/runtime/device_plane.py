@@ -88,11 +88,12 @@ class DeviceCommitRunner:
     #: program covering PIPE_DEPTH consecutive rounds, used by the
     #: driver when the backlog allows.
     PIPE_DEPTH = 4
-    #: Rounds per DEEP fused dispatch: the closed-form window step
-    #: (build_pipelined_commit_step_fused) used when the backlog covers
-    #: DEEP_DEPTH full batches.  The fused step rewrites the whole ring
-    #: once per dispatch, so it only pays off for deep windows; the
-    #: scan step keeps proportional writes for shallow ones.
+    #: Rounds per DEEP dispatch, used when the backlog covers
+    #: DEEP_DEPTH full batches.  On an accelerator this rung runs the
+    #: fused closed-form window step (build_pipelined_commit_step_fused,
+    #: whose ring-rewrite cost is invisible next to dispatch latency);
+    #: on the CPU backend it runs the scan step at the same depth —
+    #: see the builder selection in _build_locked.
     DEEP_DEPTH = 16
 
     def __init__(self, n_replicas: int, n_slots: int = 4096,
@@ -210,13 +211,23 @@ class DeviceCommitRunner:
         from apus_tpu.ops.mesh import REPLICA_AXIS
         K = self.PIPE_DEPTH
         # Two pipelined programs keyed by window depth: the scan step
-        # (proportional slot writes, shallow windows) and the fused
-        # closed-form step (one bulk ring rewrite, deep windows).
+        # (proportional slot writes, shallow windows) and a deep-window
+        # step.  The deep program is the fused closed-form step on an
+        # accelerator (per-dispatch cost ~= one ring update, invisible
+        # next to dispatch latency; the pallas in-place kernel makes it
+        # proportional again) — but on the CPU backend the fused ring
+        # rewrite costs ~25x the scan's proportional writes at this
+        # depth, so CPU keeps the scan shape for the deep rung too
+        # (same rationale as _use_device_expand; the two programs are
+        # differentially tested semantically identical).
+        deep_builder = (build_pipelined_commit_step_fused
+                        if jax.default_backend() != "cpu"
+                        else build_pipelined_commit_step)
         self._pipes = {
             K: build_pipelined_commit_step(
                 self._mesh, R, self.n_slots, SB, B, depth=K,
                 staged_depth=K),
-            self.DEEP_DEPTH: build_pipelined_commit_step_fused(
+            self.DEEP_DEPTH: deep_builder(
                 self._mesh, R, self.n_slots, SB, B, depth=self.DEEP_DEPTH,
                 staged_depth=self.DEEP_DEPTH),
         }
@@ -398,10 +409,10 @@ class DeviceCommitRunner:
 
     def commit_rounds(self, gen: int, end0: int, entries: list[LogEntry],
                       cid, live: set[int]) -> Optional[int]:
-        """A multi-round window in ONE dispatch — PIPE_DEPTH rounds via
-        the lax.scan program or DEEP_DEPTH rounds via the fused
-        closed-form program, keyed by ``len(entries)`` (the live analog
-        of the reference's outstanding-WR pipelining).  ``entries`` is
+        """A multi-round window in ONE dispatch — PIPE_DEPTH or
+        DEEP_DEPTH rounds, keyed by ``len(entries)`` (the live analog
+        of the reference's outstanding-WR pipelining; which program
+        backs the deep rung is a backend decision made in _build).  ``entries`` is
         depth*batch entries, idx-contiguous from ``end0``.  Returns the
         device commit index after the last round, or None if ``gen`` is
         stale.  Same lock discipline as commit_round."""
@@ -713,8 +724,8 @@ class DevicePlaneDriver:
         # Pipelined dispatch when the backlog covers a window of clean
         # batches: the deepest available window rides one XLA program
         # (runner.commit_rounds) instead of K dispatch+sync cycles —
-        # DEEP_DEPTH (fused closed-form) under heavy backlog, else
-        # PIPE_DEPTH (lax.scan), else a single round.
+        # DEEP_DEPTH under heavy backlog, else PIPE_DEPTH, else a
+        # single round.
         span_rounds = 1
         entries = None
         for K in (self.runner.DEEP_DEPTH, self.runner.PIPE_DEPTH):
