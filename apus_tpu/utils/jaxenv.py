@@ -1,0 +1,26 @@
+"""Backend-selection helper for CLIs and tests.
+
+The image's ``sitecustomize`` registers the axon TPU PJRT plugin at
+interpreter start and forces ``jax_platforms="axon,cpu"`` — so the
+``JAX_PLATFORMS=cpu`` environment variable alone does NOT keep a
+process off the (single, intermittently wedged) tunneled TPU chip.
+Every entry point that honors a CPU request must also set the config
+knob before any backend initializes.  One helper so the dance lives in
+one place for the CLIs (bench.py, meshcheck, loggp); tests/conftest.py
+keeps its own UNCONDITIONAL variant — it also forces the env vars
+before any import, which this opt-in helper deliberately does not."""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_cpu_request() -> bool:
+    """If the caller asked for CPU via ``JAX_PLATFORMS=cpu``, force the
+    jax config knob to match (must run before backend init).  Returns
+    True when CPU was requested."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        return False
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return True

@@ -76,13 +76,8 @@ def _bench() -> None:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        # The image's sitecustomize registers the axon (TPU) PJRT plugin
-        # and forces jax_platforms="axon,cpu" at interpreter start, so the
-        # env var alone doesn't keep us off a hung TPU tunnel — override
-        # the config knob before any backend initializes (same dance as
-        # tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
+    from apus_tpu.utils.jaxenv import respect_cpu_request
+    respect_cpu_request()         # env alone can't evade sitecustomize
 
     from apus_tpu.core.cid import Cid
     from apus_tpu.ops.commit import (CommitControl, build_commit_step,

@@ -88,6 +88,8 @@ def measure_dcn(payload_max: int) -> dict:
 
 
 def measure_device() -> dict:
+    from apus_tpu.utils.jaxenv import respect_cpu_request
+    respect_cpu_request()     # env alone can't evade sitecustomize
     import jax
 
     from apus_tpu.core.cid import Cid

@@ -47,6 +47,8 @@ def main() -> int:
 
     # 1. backend init
     try:
+        from apus_tpu.utils.jaxenv import respect_cpu_request
+        respect_cpu_request()     # env alone can't evade sitecustomize
         import jax
         import jax.numpy as jnp
         import numpy as np
