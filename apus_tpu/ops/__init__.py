@@ -23,7 +23,10 @@ advance — entirely inside XLA, no host round-trips mid-protocol.
 
 from apus_tpu.ops.mesh import replica_mesh
 from apus_tpu.ops.logplane import DeviceLog, make_device_log
-from apus_tpu.ops.commit import build_commit_step, CommitControl
+from apus_tpu.ops.commit import (CommitControl, build_commit_step,
+                                 build_pipelined_commit_step,
+                                 build_pipelined_commit_step_fused)
 
 __all__ = ["replica_mesh", "DeviceLog", "make_device_log",
-           "build_commit_step", "CommitControl"]
+           "build_commit_step", "build_pipelined_commit_step",
+           "build_pipelined_commit_step_fused", "CommitControl"]
