@@ -34,7 +34,7 @@ from apus_tpu.core.election import (AdaptiveTimeout, VoteRequest,
                                     random_election_timeout, should_grant)
 from apus_tpu.core.epdb import EndpointDB, PendingRead
 from apus_tpu.core.log import LogEntry, SlotLog
-from apus_tpu.core.quorum import have_majority
+from apus_tpu.core.quorum import have_majority, quorum_size
 from apus_tpu.core.sid import AtomicSid, Sid
 from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, MAX_SERVER_COUNT,
                                  PERMANENT_FAILURE, EntryType, Role)
@@ -1060,6 +1060,22 @@ class Node:
         n = self._fail_count.get(peer, 0) + 1
         self._fail_count[peer] = n
         if n >= PERMANENT_FAILURE and self.cid.contains(peer):
+            # Reference guards (check_failure_count): removal only from
+            # a STABLE configuration (dare_server.c:1202), and never so
+            # deep that the remaining member count drops below the
+            # quorum the unchanged ``size`` denominator demands —
+            # removal does not relax quorum (get_group_size returns the
+            # size field, wait_for_majority thresholds on size/2), so a
+            # config with fewer members than quorum_size(size) could
+            # never commit or elect again: a permanent wedge no heal or
+            # restart repairs.  The reference avoids it by dying at
+            # connections <= size/2 before appending such a removal
+            # (:1213-1217); refusing the removal keeps the same floor
+            # without the suicide.
+            if self.cid.state != CidState.STABLE:
+                return
+            if len(self.cid.members()) - 1 < quorum_size(self.cid.size):
+                return
             in_flight = any(e.type == EntryType.CONFIG
                             for e in self.log.entries(self.log.apply))
             if not in_flight and not self.log.near_full(1):

@@ -205,3 +205,42 @@ def test_resize_under_faults_converges():
                 assert dd.node.sm.store[b"grown"] == b"3of4"
                 assert dd.node.cid.state == CidState.STABLE
                 assert dd.node.cid.size == 4
+
+
+def test_auto_remove_never_shrinks_below_quorum_floor():
+    """Auto-removal must stop while the remaining member count still
+    meets quorum_size(size): the denominator never shrinks with the
+    bitmask (reference get_group_size returns the size field), so
+    removing deeper would leave a configuration that can never commit
+    or elect again — a permanent wedge no heal repairs.  Regression for
+    the 50-schedule fuzz finding: partitions once drove a 5-slot config
+    down to two members."""
+    from apus_tpu.core.quorum import quorum_size
+    from apus_tpu.parallel.sim import Cluster
+
+    c = Cluster(5, seed=11, sm_factory=KvsStateMachine, auto_remove=True)
+    c.wait_for_leader()
+    c.submit(encode_put(b"a", b"1"))
+    # Kill two members; the leader may remove both (3 >= quorum 3).
+    c.crash(3)
+    c.crash(4)
+    c.run(5.0)
+    # Kill nothing more, but partition a third member away long enough
+    # for failure counting to want it gone: the floor must refuse.
+    leader = c.wait_for_leader()
+    other = next(i for i in (0, 1, 2) if i != leader.idx)
+    c.transport.partition({other}, {0, 1, 2, 3, 4} - {other})
+    c.run(5.0)
+    c.transport.heal()
+    c.run(2.0)
+    for n in c.nodes:
+        if n.idx in c.transport.crashed:
+            continue
+        members = len(n.cid.members())
+        assert members >= quorum_size(n.cid.size), \
+            (n.idx, n.cid.bitmask, members)
+    # Liveness holds among the remaining quorum-floor members.
+    c.submit(encode_put(b"b", b"2"))
+    leader = c.wait_for_leader()
+    assert leader.sm.store[b"b"] == b"2"
+    c.check_logs_consistent()
