@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Randomized protocol fuzz: N fault schedules against the virtual-time
+simulator, safety + liveness checked every phase.
+
+Each schedule drives a 5-replica cluster through random crashes (up to
+2 concurrent), partitions, message loss, and recoveries, with client
+writes between faults.  Checked invariants:
+
+  - SAFETY: at most one leader per term; committed prefixes never
+    diverge (check_logs_consistent); every acknowledged write readable.
+  - LIVENESS: writes commit while a quorum is live; full convergence
+    once everyone recovers.
+
+Membership is FIXED by default: with --auto-remove the leader may
+evict dead members, and a removed member that later recovers can only
+rejoin through the runtime membership service, which the pure sim does
+not model — so auto-remove schedules report quorum-stall phases as
+EXPECTED_STALL rather than failures when the live member count of the
+current configuration is below its quorum.
+
+This tool found the auto-removal quorum-floor wedge fixed in
+core/node.py (_note_failure guards); keep it handy for protocol
+changes.  ~1s per schedule (virtual time).
+
+Usage: python benchmarks/fuzz.py [--trials N] [--seed-base K]
+                                 [--auto-remove]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.core.quorum import quorum_size  # noqa: E402
+from apus_tpu.models.kvs import KvsStateMachine, encode_put  # noqa: E402
+from apus_tpu.parallel.sim import Cluster  # noqa: E402
+
+
+def run_schedule(trial: int, seed_base: int, auto_remove: bool) -> str:
+    """Returns 'ok', 'expected_stall' or raises on a real violation."""
+    sched = random.Random(seed_base + trial)
+    c = Cluster(5, seed=trial, sm_factory=KvsStateMachine,
+                drop_rate=sched.choice([0.0, 0.02, 0.08]),
+                auto_remove=auto_remove)
+    c.wait_for_leader()
+    acked: dict[bytes, bytes] = {}
+    seq = 0
+
+    def config_quorum_live() -> bool:
+        # Quorum of the highest-epoch applied configuration among live
+        # nodes must be live for progress to be expected.
+        live = [n for n in c.nodes if n.idx not in c.transport.crashed]
+        cid = max((n.cid for n in live), key=lambda x: x.epoch)
+        members = set(cid.members())
+        alive = sum(1 for n in live if n.idx in members)
+        return alive >= quorum_size(cid.size)
+
+    for phase in range(6):
+        fault = sched.choice(["crash", "partition", "none", "crash2"])
+        if fault in ("crash", "crash2") and len(c.transport.crashed) < 2:
+            up = [n.idx for n in c.nodes
+                  if n.idx not in c.transport.crashed]
+            c.crash(sched.choice(up))
+            if fault == "crash2" and len(c.transport.crashed) < 2:
+                up = [n.idx for n in c.nodes
+                      if n.idx not in c.transport.crashed]
+                c.crash(sched.choice(up))
+        elif fault == "partition":
+            side = set(sched.sample(range(5), sched.choice([1, 2])))
+            c.transport.partition(side, set(range(5)) - side)
+            c.run(sched.uniform(0.2, 1.5))
+            c.transport.heal()
+        c.run(sched.uniform(0.3, 1.5))
+        if not config_quorum_live():
+            return "expected_stall"     # only reachable with auto-remove
+        for _ in range(3):
+            k, v = b"f%d" % seq, b"v%d" % seq
+            c.submit(encode_put(k, v), timeout=30)
+            acked[k] = v
+            seq += 1
+        by_term: dict[int, set] = {}
+        for n in c.nodes:
+            if n.idx not in c.transport.crashed and n.is_leader:
+                by_term.setdefault(n.current_term, set()).add(n.idx)
+        for t, who in by_term.items():
+            assert len(who) == 1, f"two leaders in term {t}: {who}"
+        c.check_logs_consistent()
+        if c.transport.crashed and sched.random() < 0.7:
+            c.recover(next(iter(c.transport.crashed)))
+            c.run(0.5)
+    for idx in list(c.transport.crashed):
+        c.recover(idx)
+    if not config_quorum_live():
+        return "expected_stall"
+    # Convergence is owed only to members of the authoritative (max-
+    # epoch) configuration: an evicted member is not replicated to and
+    # only rejoins via the runtime membership service (not modeled).
+    auth = max((n.cid for n in c.nodes), key=lambda x: x.epoch)
+    members = set(auth.members())
+    target = c.wait_for_leader().log.commit
+    assert c.run_until(lambda: all(
+        n.log.apply >= target
+        for n in c.nodes if n.idx in members), timeout=60), "convergence"
+    leader = c.wait_for_leader()
+    for k, v in acked.items():
+        assert leader.sm.store.get(k) == v, k
+    c.check_logs_consistent()
+    return "ok"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=50)
+    ap.add_argument("--seed-base", type=int, default=20_000)
+    ap.add_argument("--auto-remove", action="store_true")
+    args = ap.parse_args()
+    ok = stalls = 0
+    failures = []
+    for trial in range(args.trials):
+        try:
+            r = run_schedule(trial, args.seed_base, args.auto_remove)
+            if r == "ok":
+                ok += 1
+            else:
+                stalls += 1
+        except Exception as e:                   # noqa: BLE001
+            failures.append({"trial": trial, "error": repr(e)[:200]})
+            print(f"trial {trial}: FAIL {e!r}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "protocol_fuzz_schedules_clean",
+        "value": ok,
+        "unit": f"of {args.trials}",
+        "detail": {"expected_stalls": stalls, "failures": failures,
+                   "auto_remove": args.auto_remove,
+                   "seed_base": args.seed_base},
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,3 +123,19 @@ def test_chaos_with_segmentation_and_big_records():
             assert n.sm.store.get(k) == v, (n.idx, k)
         assert n.stats.get("seg_incomplete", 0) == 0
     c.check_logs_consistent()
+
+
+def test_fuzz_schedules_clean():
+    """A slice of the randomized-schedule campaign (benchmarks/fuzz.py;
+    50-schedule full runs are clean) as a CI canary: safety + liveness
+    over random crash/partition/loss schedules with fixed membership."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "apus_fuzz", os.path.join(os.path.dirname(__file__), "..",
+                                  "benchmarks", "fuzz.py"))
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    for trial in range(8):
+        assert fuzz.run_schedule(trial, 20_000, False) == "ok", trial
