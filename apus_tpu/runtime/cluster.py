@@ -133,11 +133,50 @@ class LocalCluster:
 
     def restart(self, idx: int) -> "ReplicaDaemon":
         """Restart a killed replica at its original endpoint (full
-        recovery path: durable-store replay + catch-up from peers)."""
+        recovery path: durable-store replay + catch-up from peers).
+
+        If a live leader's membership EXCLUDES this slot — the failure
+        detector auto-removed it while it was dead — the slot is first
+        re-admitted through the join protocol, mirroring the daemon
+        CLI's rejoin-on-exclusion (runtime.daemon main loop, which
+        re-execs itself in --join mode): without this, a removed thread
+        replica would restart into a group that never contacts it."""
         assert self.daemons[idx] is None, "kill before restart"
+        # The exclusion question needs a stable leader; wait briefly for
+        # one.  If none appears, proceed WITHOUT the rejoin: either the
+        # group still lists us (normal recovery works) or it cannot
+        # elect until this replica returns — and a removed replica
+        # cannot help elect anyway, so blocking the restart would only
+        # deepen the outage.
+        rejoin_cid = None
+        try:
+            ld = self.wait_for_leader(timeout=5.0)
+        except AssertionError:
+            ld = None
+        if ld is not None:
+            with ld.lock:
+                excluded = (ld.node.is_leader
+                            and not ld.node.cid.contains(idx))
+            if excluded:
+                addr = self.spec.peers[idx]
+                slot, rejoin_cid, _peers = request_join(
+                    [p for i, p in enumerate(self.spec.peers)
+                     if p and i != idx], addr)
+                if slot != idx:
+                    raise AssertionError(
+                        f"rejoin of {addr} assigned slot {slot}, not its "
+                        f"original {idx} (another slot was empty); the "
+                        f"thread rig keys identity by slot — restart is "
+                        f"not possible in this state")
+        kwargs = dict(self.daemon_kwargs)
+        if rejoin_cid is not None:
+            # Seed the re-admitted member with the configuration the
+            # join returned (parity with add_replica and the daemon
+            # CLI's --join path) instead of a stale epoch-0 full set.
+            kwargs["cid"] = rejoin_cid
         d = self.daemon_cls(idx, self.spec, sm=self.sm_factory(),
                             recovery_start=True, seed=self.seed,
-                            **self.daemon_kwargs)
+                            **kwargs)
         self.daemons[idx] = d
         d.start()
         return d

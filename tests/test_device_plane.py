@@ -304,3 +304,46 @@ def test_deep_window_transit_dual_majority():
     commit = runner.commit_rounds(gen, end0, batch_at(end0, D * B), cid,
                                   live=set(range(R)))
     assert commit == end0 + D * B
+
+
+def test_restart_after_auto_removal_rejoins_and_catches_up():
+    """kill -> auto-removal -> restart: LocalCluster.restart re-admits
+    the excluded slot through the join protocol (the thread-rig mirror
+    of the daemon CLI's rejoin-on-exclusion) and the returnee converges
+    — with the device plane carrying commits throughout.  Regression
+    for the device-plane fuzz finding: restarted removed replicas were
+    orphaned (never contacted, term frozen at 0)."""
+    from apus_tpu.utils.config import ClusterSpec
+
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150,
+                       auto_remove=True, fail_window=0.050)
+    with LocalCluster(3, spec=spec, device_plane=True) as c:
+        leader = c.wait_for_leader()
+        for i in range(40):
+            c.submit(encode_put(b"rk%d" % i, b"rv"))
+        victim = next(i for i in range(3) if i != leader.idx)
+        c.kill(victim)
+
+        # Keep committing until the failure detector evicts the victim.
+        def evicted():
+            ld = c.leader()
+            if ld is None:
+                return False
+            with ld.lock:
+                return not ld.node.cid.contains(victim)
+        deadline = time.time() + 30
+        i = 40
+        while not evicted() and time.time() < deadline:
+            c.submit(encode_put(b"rk%d" % i, b"rv"))
+            i += 1
+            time.sleep(0.01)
+        assert evicted(), "victim was never auto-removed"
+
+        c.restart(victim)                  # re-admission + recovery
+        c.wait_caught_up(victim, timeout=60)
+        d = c.daemons[victim]
+        with d.lock:
+            assert d.node.cid.contains(victim)
+            assert d.node.sm.query(encode_get(b"rk0")) == b"rv"
+        c.check_logs_consistent()
