@@ -5,7 +5,7 @@ This package is the TPU re-expression of the reference's RDMA data plane
 
 | reference (RDMA)                          | here (JAX/XLA on ICI)        |
 |-------------------------------------------|------------------------------|
-| leader RDMA WRITEs entries into followers'| masked psum broadcast of the |
+| leader RDMA WRITEs entries into followers'| pmax broadcast of the        |
 | logs (update_remote_logs :1460-1644)      | batch over the replica axis  |
 | followers poke 1-byte acks into the       | per-replica ack index,       |
 | leader's entry reply[] (:1828-1863)       | all_gather'ed                |
@@ -13,7 +13,7 @@ This package is the TPU re-expression of the reference's RDMA data plane
 | (:1650-1758, loop_for_commit :1883-1945)  | gathered ack vector — the    |
 |                                           | collective IS the barrier    |
 | QP-reset fencing (:2156-2255)             | in-step term/grant masking   |
-| LogGP microbenchmark (:3322-3749)         | ops.loggp step-param probe   |
+| LogGP microbenchmark (:3322-3749)         | benchmarks/loggp.py probe    |
 
 All state lives in HBM as fixed-width arrays sharded over a ``replica``
 mesh axis (ops.logplane).  One ``commit_step`` call performs: scatter of
