@@ -103,13 +103,29 @@ def _bench() -> None:
     cid = Cid.initial(R)
 
     # Redis-SET-shaped payloads (the run.sh benchmark shape: redis-benchmark
-    # -t set, benchmarks/run.sh:70-80).
-    reqs = [b"*3\r\n$3\r\nSET\r\n$16\r\nkey:%012d\r\n$64\r\n%s\r\n"
-            % (i, b"x" * 64) for i in range(B)]
-    bd, bm, nv = host_batch_to_device(reqs, SB, batch_size=B)
+    # -t set, benchmarks/run.sh:70-80).  SD distinct staged batches ride
+    # the pipeline (round i consumes batch i % SD): the steady state
+    # commits varied payloads, not one batch re-committed.
+    SD = 16
+    sd_np = np.zeros((SD, R, B, SB), np.uint8)
+    sm_np = np.zeros((SD, R, B, 4), np.int32)
+    reqs = bd = bm = None
+    for k in range(SD):
+        batch_reqs = [
+            b"*3\r\n$3\r\nSET\r\n$16\r\nkey:%012d\r\n$64\r\n%s\r\n"
+            % (k * B + i, bytes([97 + (k + i) % 26]) * 64)
+            for i in range(B)]
+        kd, km, _ = host_batch_to_device(batch_reqs, SB, batch_size=B)
+        sd_np[k, 0], sm_np[k, 0] = kd, km        # leader row 0 only
+        if k == 0:
+            reqs, bd, bm = batch_reqs, kd, km    # reused by later phases
     bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
-    sdata, smeta = bdata[None], bmeta[None]     # one resident staged batch
-    _mark("staged batch placed on device")
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+    from apus_tpu.ops.mesh import REPLICA_AXIS as _AX
+    ssh = NamedSharding(mesh, _P(None, _AX))
+    sdata = jax.device_put(sd_np, ssh)
+    smeta = jax.device_put(sm_np, ssh)
+    _mark(f"{SD} staged batches placed on device")
 
     best = None            # (round_p50, depth, wall_p50, walls)
     per_depth = {}
@@ -156,7 +172,7 @@ def _bench() -> None:
             break
         t_c = time.monotonic()
         pipe = build_pipelined_commit_step_fused(mesh, R, S, SB, B, depth=D,
-                                                 staged_depth=1)
+                                                 staged_depth=SD)
         devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
                                  sharding=sh)
         ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
