@@ -60,10 +60,12 @@ class NodeConfig:
     seed: int = 0
     # Failure detector: a dead peer is removed after PERMANENT_FAILURE
     # failures counted at most once per fail_window (the reference's
-    # CTRL-QP errors surface only after RDMA retry exhaustion, so its
-    # 2-strike rule is implicitly time-throttled too).
+    # CTRL-QP errors surface only after RDMA retry exhaustion —
+    # seconds — so its 2-strike rule means "continuously dead for a
+    # while", never "mid crash-restart cycle"; the default matches
+    # ClusterSpec.fail_window).
     auto_remove: bool = True
-    fail_window: float = 0.100
+    fail_window: float = 0.500
     # Adaptive failure detector (to_adjust_cb analog,
     # dare_server.c:763-817): grow hb_timeout from observed heartbeat
     # gaps until the false-positive rate is negligible, then freeze.
@@ -134,6 +136,12 @@ class Node:
 
         # timers
         self._last_hb_seen = 0.0
+        #: True once ANY group traffic reached us this incarnation (a
+        #: leader heartbeat or a candidate's vote round) — an evicted
+        #: replica receives neither, so the daemon's boot-time exclusion
+        #: probe keys off this instead of heartbeat AGE (whose initial
+        #: value is a future-stamped election grace).
+        self.group_contact = False
         self._hb_timeout = cfg.hb_timeout
         self._hb_adapt = (AdaptiveTimeout(cfg.hb_timeout)
                           if cfg.adaptive_timeout else None)
@@ -557,6 +565,7 @@ class Node:
         self.device_covered_from = None
         self._election_deadline = None
         self._last_hb_seen = now
+        self.group_contact = True
         self._pending.clear()
         self._inflight.clear()
         self._pending_reads.clear()    # clients retry against the new leader
@@ -637,6 +646,7 @@ class Node:
         self.role = Role.FOLLOWER
         self._known_leader = None
         self._last_hb_seen = now          # give the candidate time to win
+        self.group_contact = True
         self.stats["votes_granted"] += 1
         # Durable vote: replicate to a majority (rc_replicate_vote,
         # dare_ibv_rc.c:1049-1109).
@@ -744,6 +754,7 @@ class Node:
                 self._hb_timeout = max(self.cfg.hb_timeout,
                                        self._hb_adapt.timeout)
             self._last_hb_seen = now
+            self.group_contact = True
 
     # ------------------------------------------------------------------
     # leader

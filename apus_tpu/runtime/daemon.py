@@ -423,8 +423,9 @@ def main(argv: Optional[list] = None) -> int:
         # peer IS a leader whose membership excludes us, re-enter the
         # group through the join protocol at our own endpoint.
         last_progress = None
-        progress_t = time.monotonic()
+        start_t = progress_t = time.monotonic()
         last_probe = 0.0
+        heard_leader = False
         while not stop_evt.is_set():
             if app_proc is not None and app_proc.poll() is not None:
                 daemon.logger.error("app exited rc=%d; shutting down",
@@ -437,12 +438,22 @@ def main(argv: Optional[list] = None) -> int:
                 hb_age = now - daemon.node._last_hb_seen
             if progress != last_progress:
                 last_progress, progress_t = progress, now
+            with daemon.lock:
+                heard_leader = heard_leader or daemon.node.group_contact
             # "Stalled" keys off heartbeat age, not just state change:
             # an idle-but-led follower hears the leader every hb_period
-            # and must never start probing peers.
+            # and must never start probing peers.  BOOT is the urgent
+            # case: a restarted evicted replica hears nothing from the
+            # first tick, and every second before its rejoin is a
+            # window in which one more failure stalls the whole group
+            # (its slot still counts toward quorum_size) — so until a
+            # leader has been heard at all, probe after 0.5 s instead
+            # of the steady-state 3 s.
+            silent_boot = (not heard_leader and not progress[2]
+                           and now - start_t > 0.5)
             stalled = (not progress[2] and now - progress_t > 3.0
                        and hb_age > 3.0)
-            if stalled and now - last_probe > 0.5:
+            if (stalled or silent_boot) and now - last_probe > 0.5:
                 last_probe = now
                 if _excluded_by_live_leader(daemon, spec):
                     daemon.logger.error(

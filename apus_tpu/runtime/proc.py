@@ -38,8 +38,7 @@ from apus_tpu.utils.config import ClusterSpec
 #: elect=10-30 ms.  Viable here because each replica process owns its
 #: interpreter — the tick thread is never starved by sibling replicas.
 PROC_SPEC = ClusterSpec(hb_period=0.001, hb_timeout=0.010,
-                        elect_low=0.010, elect_high=0.030,
-                        fail_window=0.100)
+                        elect_low=0.010, elect_high=0.030)
 
 
 class ProcCluster:

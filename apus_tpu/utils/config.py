@@ -36,9 +36,16 @@ class ClusterSpec:
     max_batch: int = 64
     # failure detector: auto-remove dead members via CONFIG entries
     # (check_failure_count analog, dare_server.c:1189-1227); failures
-    # counted at most once per fail_window seconds
+    # counted at most once per fail_window seconds.  The default is
+    # sized to the reference's effective eviction delay: its 2-strike
+    # rule counts CTRL-QP work-completion errors, which only surface
+    # after RDMA retry exhaustion (seconds), so eviction means
+    # "continuously dead for ~1s+", never "mid crash-restart cycle" —
+    # an eviction during a quick restart forces the returnee through
+    # the join protocol, and until that join commits the group runs a
+    # member short (one more failure from a stall).
     auto_remove: bool = True
-    fail_window: float = 0.100
+    fail_window: float = 0.500
     # control plane endpoints, one per server idx ("host:port")
     peers: list[str] = dataclasses.field(default_factory=list)
     # proxied application endpoint (config-proxy.c:14-45)

@@ -177,3 +177,47 @@ def test_proc_cluster_join_grows_group(bare):
     else:
         raise AssertionError(
             f"joiner did not integrate: {pc.status(slot)}")
+
+
+def test_evicted_process_rejoins_promptly_on_restart(tmp_path):
+    """A replica evicted while dead must re-enter the group FAST on
+    restart: its daemon probes for exclusion from boot (node
+    group_contact flag) instead of waiting out the 3 s stall heuristic
+    — every second before the rejoin commits is a window in which one
+    more failure stalls the whole group (the evicted slot still counts
+    toward quorum_size).  Regression for the proc fault campaign."""
+    import dataclasses
+
+    from apus_tpu.runtime.proc import PROC_SPEC
+
+    spec = dataclasses.replace(PROC_SPEC, fail_window=0.050)
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"), spec=spec)
+    with pc:
+        with ApusClient(list(pc.spec.peers)) as c:
+            assert c.put(b"a", b"1") == b"OK"
+            leader = pc.leader_idx()
+            victim = next(i for i in range(3) if i != leader)
+            pc.kill(victim)
+
+            def members():
+                st = pc.status(pc.leader_idx())
+                return set() if st is None else set(st.get("members", []))
+
+            # Write until the failure detector evicts the victim.
+            deadline = time.monotonic() + 20
+            i = 0
+            while time.monotonic() < deadline and victim in members():
+                c.put(b"w%d" % i, b"x")
+                i += 1
+            assert victim not in members(), "victim never evicted"
+            t0 = time.monotonic()
+            pc.restart(victim)
+            # Prompt re-admission: the returnee is a member again well
+            # under the old stall heuristic's ~3.5 s floor + join time.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and victim not in members():
+                time.sleep(0.05)
+            took = time.monotonic() - t0
+            assert victim in members(), "victim never rejoined"
+            assert took < 10.0, f"rejoin took {took:.1f}s"
+            assert c.put(b"post", b"2") == b"OK"
