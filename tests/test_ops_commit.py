@@ -405,7 +405,8 @@ def test_fused_pipelined_matches_scan_randomized():
             assert np.array_equal(x, y), (trial, kw, what)
 
 
-@pytest.mark.parametrize("scenario", ["all_accept", "one_fenced"])
+@pytest.mark.parametrize("scenario", ["all_accept", "one_fenced",
+                                      "partial_window"])
 def test_fused_pallas_ring_matches_scan(scenario):
     """The pallas in-place ring kernel (interpret mode on the CPU mesh)
     keeps the fused step bit-identical to the scan step — both on the
@@ -421,6 +422,10 @@ def test_fused_pallas_ring_matches_scan(scenario):
               distinct_batches=True)
     if scenario == "one_fenced":
         kw["fence_overrides"] = {2: (3, 9)}
+    if scenario == "partial_window":
+        # D*B < S: the kernel's grid covers only the written blocks;
+        # aliasing must preserve every untouched ring row bit-for-bit.
+        kw["D"] = kw["SD"] = 2
     kw["offs_overrides"] = {r: 33 for r in range(4)}
     a = _run_pipelined(build_pipelined_commit_step, **kw)
     fused_pallas = functools.partial(build_pipelined_commit_step_fused,
