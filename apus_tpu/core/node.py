@@ -315,12 +315,22 @@ class Node:
         self._pending_reads.append(rr)
         return rr
 
-    def handle_join(self, addr: str) -> Optional[PendingJoin]:
+    def handle_join(self, addr: str,
+                    want_slot: Optional[int] = None) -> Optional[PendingJoin]:
         """Admit a new server (handle_server_join_request analog,
         dare_ibv_ud.c:972-1068): assign the lowest empty slot, or up-size
         the configuration STABLE -> EXTENDED when full.  Returns a handle
         that completes when the CONFIG entry applies; None when not
-        leader, mid-resize, or at capacity."""
+        leader, mid-resize, at capacity, or when ``want_slot`` cannot be
+        honored.
+
+        ``want_slot`` is SLOT AFFINITY for a recovered server re-joining
+        after eviction: identity (votes, acks, durable store, peer
+        table) is keyed by slot, so a re-joiner must get ITS slot back
+        or nothing — admitting it at a different empty slot would bind
+        its address to a foreign identity.  (The reference's joiner
+        likewise receives its idx in the CFG_REPLY and adopts it,
+        dare_ibv_ud.c:1070-1087.)"""
         if not self.is_leader:
             return None
         pj = self._pending_joins.get(addr)
@@ -340,6 +350,21 @@ class Node:
         if any(e.type == EntryType.CONFIG
                for e in self.log.entries(self.log.apply)):
             return None
+        if want_slot is not None:
+            if not (0 <= want_slot < self.cid.size) \
+                    or self.cid.contains(want_slot):
+                return None              # occupied/invalid: refuse
+            slot = want_slot
+            new_cid = dataclasses.replace(
+                self.cid.with_server(slot), epoch=self.cid.epoch + 1)
+            if self.log.near_full(1):
+                return None
+            pj = PendingJoin(addr=addr, slot=slot)
+            pj.entry_idx = self.log.append(
+                self.sid.sid.term, type=EntryType.CONFIG, cid=new_cid,
+                data=f"{slot} {addr}".encode())
+            self._pending_joins[addr] = pj
+            return pj
         slot = self.cid.empty_slot()
         if slot is not None:
             new_cid = dataclasses.replace(

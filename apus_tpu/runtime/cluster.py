@@ -159,15 +159,12 @@ class LocalCluster:
                             and not ld.node.cid.contains(idx))
             if excluded:
                 addr = self.spec.peers[idx]
+                # Slot affinity: admitted at this exact slot or refused
+                # (identity is keyed by slot).
                 slot, rejoin_cid, _peers = request_join(
                     [p for i, p in enumerate(self.spec.peers)
-                     if p and i != idx], addr)
-                if slot != idx:
-                    raise AssertionError(
-                        f"rejoin of {addr} assigned slot {slot}, not its "
-                        f"original {idx} (another slot was empty); the "
-                        f"thread rig keys identity by slot — restart is "
-                        f"not possible in this state")
+                     if p and i != idx], addr, want_slot=idx)
+                assert slot == idx, (slot, idx)
         kwargs = dict(self.daemon_kwargs)
         if rejoin_cid is not None:
             # Seed the re-admitted member with the configuration the

@@ -42,6 +42,13 @@ def _parse_peer(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def exclusion_silence(spec) -> float:
+    """How long a non-leader tolerates total group silence before
+    probing for eviction (shared by the in-place rejoin watchdog and,
+    with margin, the daemon CLI's full re-exec backstop)."""
+    return max(1.5, 20 * spec.hb_timeout)
+
+
 class ReplicaDaemon:
     """One replica of the group, live on the network."""
 
@@ -133,6 +140,7 @@ class ReplicaDaemon:
 
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
+        self._excl_thread: Optional[threading.Thread] = None
         self._last_role = None
         # Client-facing handlers wait on this instead of polling the
         # lock (K pollers at 0.2 ms would starve the tick thread).
@@ -156,6 +164,10 @@ class ReplicaDaemon:
                              daemon=True)
         t.start()
         self._tick_thread = t
+        w = threading.Thread(target=self._exclusion_watchdog,
+                             name=f"apus-excl-{self.idx}", daemon=True)
+        w.start()
+        self._excl_thread = w
         if self.device_driver is not None:
             self.device_driver.start()
         self.logger.info("daemon %d up at %s", self.idx, self.server.addr)
@@ -166,10 +178,63 @@ class ReplicaDaemon:
             self.device_driver.stop()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=2.0)
+        if self._excl_thread is not None:
+            self._excl_thread.join(timeout=2.0)
         self.server.stop()
         self.transport.close()
         if self.persistence is not None:
             self.persistence.close()
+
+    def _exclusion_watchdog(self) -> None:
+        """Self-rejoin after eviction, for EVERY deployment shape.
+
+        A replica the failure detector removed receives nothing ever
+        again (it is nobody's replication target and PreVote keeps it
+        from bumping terms) — and eviction can land at ANY time,
+        including moments after a restart passed its not-excluded
+        check.  This thread watches for sustained silence while not
+        leading, and when some live leader's membership excludes our
+        slot, re-enters the group IN PLACE through the join protocol:
+        the leader re-admits the slot (handle_join reuses it — lowest
+        empty bit), replication to us resumes, and applying the CONFIG
+        entries teaches us the new cid.  No restart needed.  The
+        daemon-CLI re-exec path (run loop) remains as the full-reset
+        backstop for process deployments."""
+        from apus_tpu.runtime.membership import request_join
+
+        silence = max(1.5, 20 * self.spec.hb_timeout)
+        last_try = 0.0
+        while not self._stop.is_set():
+            self._stop.wait(0.25)
+            now = time.monotonic()
+            with self.lock:
+                is_leader = self.node.is_leader
+                hb_age = now - self.node._last_hb_seen
+            # hb_age < 0 covers the future-stamped cold-start grace.
+            if is_leader or hb_age < silence or now - last_try < 2.0:
+                continue
+            last_try = now
+            if not _excluded_by_live_leader(self, self.spec):
+                continue
+            my_addr = self.spec.peers[self.idx] \
+                if self.idx < len(self.spec.peers) else ""
+            if not my_addr:
+                continue
+            self.logger.error(
+                "removed from the group (a live leader excludes slot "
+                "%d); re-joining in place at %s", self.idx, my_addr)
+            try:
+                slot, _cid, _peers = request_join(
+                    [p for i, p in enumerate(self.spec.peers)
+                     if p and i != self.idx], my_addr, timeout=5.0)
+                if slot != self.idx:
+                    self.logger.error(
+                        "rejoin assigned slot %d != ours (%d); leaving "
+                        "re-admission to the operator", slot, self.idx)
+                    return
+                self.logger.info("re-admitted at slot %d", slot)
+            except Exception as e:               # noqa: BLE001
+                self.logger.warning("rejoin attempt failed: %s", e)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -320,6 +385,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="with --join: bind this host:port instead of an "
                          "ephemeral one (a recovered server re-joining "
                          "at its original endpoint)")
+    ap.add_argument("--want-slot", type=int, default=None,
+                    help="with --join: slot affinity — admit at exactly "
+                         "this slot or keep retrying (recovered-server "
+                         "rejoin; identity is keyed by slot)")
     ap.add_argument("--db-dir", default=os.environ.get("APUS_DB_DIR"),
                     help="durable-store directory (restart recovery)")
     ap.add_argument("--log-file", default=env.log_file,
@@ -362,7 +431,8 @@ def main(argv: Optional[list] = None) -> int:
         host, port = sock.getsockname()
         my_addr = f"{host}:{port}"
         slot, cid, peers = request_join(
-            [p for p in spec.peers if p], my_addr)
+            [p for p in spec.peers if p], my_addr,
+            want_slot=args.want_slot)
         spec.peers = list(peers)
         while len(spec.peers) <= slot:
             spec.peers.append("")
@@ -447,12 +517,15 @@ def main(argv: Optional[list] = None) -> int:
             # first tick, and every second before its rejoin is a
             # window in which one more failure stalls the whole group
             # (its slot still counts toward quorum_size) — so until a
-            # leader has been heard at all, probe after 0.5 s instead
-            # of the steady-state 3 s.
+            # leader has been heard at all, probe after 0.5 s.  The
+            # steady-state re-exec threshold sits ABOVE the in-place
+            # rejoin watchdog's silence window, so the cheap in-place
+            # path always gets to act first.
+            reexec_after = exclusion_silence(spec) + 1.5
             silent_boot = (not heard_leader and not progress[2]
                            and now - start_t > 0.5)
-            stalled = (not progress[2] and now - progress_t > 3.0
-                       and hb_age > 3.0)
+            stalled = (not progress[2] and now - progress_t > reexec_after
+                       and hb_age > reexec_after)
             if (stalled or silent_boot) and now - last_probe > 0.5:
                 last_probe = now
                 if _excluded_by_live_leader(daemon, spec):
@@ -471,7 +544,8 @@ def main(argv: Optional[list] = None) -> int:
                     daemon.stop()
                     rejoin = [sys.executable, "-m",
                               "apus_tpu.runtime.daemon",
-                              "--join", "--join-addr", my_addr]
+                              "--join", "--join-addr", my_addr,
+                              "--want-slot", str(daemon.idx)]
                     for flag, val in [
                             ("--config", args.config),
                             ("--db-dir", args.db_dir),

@@ -34,8 +34,9 @@ def make_membership_ops(daemon) -> dict:
 
     def join(r: wire.Reader) -> bytes:
         addr = r.blob().decode()
+        want_slot = r.u8() if r.remaining else None
         with daemon.lock:
-            pj = daemon.node.handle_join(addr)
+            pj = daemon.node.handle_join(addr, want_slot=want_slot)
         if pj is None:
             return _not_leader(daemon)
         deadline = time.monotonic() + daemon.client_op_timeout
@@ -59,10 +60,15 @@ def make_membership_ops(daemon) -> dict:
 
 
 def request_join(peers: list[str], my_addr: str,
-                 timeout: float = 15.0) -> tuple[int, Cid, list[str]]:
+                 timeout: float = 15.0,
+                 want_slot: Optional[int] = None) -> tuple[int, Cid, list[str]]:
     """Joiner side: find the leader and request admission.  Returns
-    (slot, cid, full peer list).  Retries across redirects/elections."""
+    (slot, cid, full peer list).  Retries across redirects/elections.
+    ``want_slot`` requests slot affinity (recovered-server rejoin): the
+    leader admits at that exact slot or refuses."""
     payload = wire.u8(OP_JOIN) + wire.blob(my_addr.encode())
+    if want_slot is not None:
+        payload += wire.u8(want_slot)
     deadline = time.monotonic() + timeout
     candidates = list(peers)
     i = 0
