@@ -244,3 +244,36 @@ def test_auto_remove_never_shrinks_below_quorum_floor():
     leader = c.wait_for_leader()
     assert leader.sm.store[b"b"] == b"2"
     c.check_logs_consistent()
+
+
+def test_join_slot_affinity():
+    """want_slot semantics: a recovered server is admitted at exactly
+    its old slot; an occupied or out-of-range want_slot is refused
+    outright (identity is keyed by slot — a foreign binding would
+    corrupt membership)."""
+    import dataclasses as _dc
+
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.parallel.sim import Cluster
+
+    c = Cluster(3, seed=3, sm_factory=KvsStateMachine, auto_remove=False)
+    leader = c.wait_for_leader()
+    # Evict slot 1 via an explicit CONFIG (operator-style removal).
+    leader.log.append(leader.sid.sid.term, type=EntryType.CONFIG,
+                      cid=_dc.replace(leader.cid.without_server(1),
+                                      epoch=leader.cid.epoch + 1))
+    c.run(1.0)
+    assert not leader.cid.contains(1)
+    # Occupied slot refused.
+    assert leader.handle_join("10.0.0.9:1", want_slot=0) is None
+    # Out-of-range refused.
+    assert leader.handle_join("10.0.0.9:1", want_slot=7) is None
+    # The vacated slot is honored exactly.
+    pj = leader.handle_join("10.0.0.9:1", want_slot=1)
+    assert pj is not None and pj.slot == 1
+    c.run(1.0)
+    assert leader.cid.contains(1)
+    # And a fresh joiner without affinity still gets lowest-empty /
+    # upsize behavior (no regression).
+    pj2 = leader.handle_join("10.0.0.10:1")
+    assert pj2 is not None and pj2.slot == 3   # upsize: 3 slots full
