@@ -1,0 +1,107 @@
+/* Minimal libevent legacy-API compatibility header for building the
+ * pinned unmodified memcached 1.4.21 in an image that ships the
+ * libevent 2.1 RUNTIME (libevent_core-2.1.so.7) but not the dev
+ * headers, and has no egress to fetch them.
+ *
+ * This is NOT a reimplementation: every declaration below matches the
+ * public ABI of libevent 2.1 (event2/event_struct.h + the legacy
+ * event.h compat surface) so that memcached's objects link against and
+ * run on the system's real libevent_core.  struct event must be
+ * layout-identical to the 2.1 definition because memcached embeds it
+ * by value (memcached.h:411) and reads .ev_base (memcached.c:3889);
+ * the members below reproduce that documented public layout.
+ *
+ * Only the symbols memcached 1.4.21 actually uses are declared
+ * (event_init, event_set, event_base_set, event_add, event_del,
+ * event_base_loop, event_get_version, evtimer_*).
+ */
+#ifndef APUS_LIBEVENT_COMPAT_EVENT_H
+#define APUS_LIBEVENT_COMPAT_EVENT_H
+
+#include <sys/queue.h>
+#include <sys/time.h>
+#include <stdarg.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int evutil_socket_t;
+
+struct event;
+struct event_base;
+
+struct event_callback {
+    TAILQ_ENTRY(event_callback) evcb_active_next;
+    short evcb_flags;
+    unsigned char evcb_pri;
+    unsigned char evcb_closure;
+    union {
+        void (*evcb_callback)(evutil_socket_t, short, void *);
+        void (*evcb_selfcb)(struct event_callback *);
+        void (*evcb_evfinalize)(struct event *, void *);
+        void (*evcb_cbfinalize)(struct event_callback *, void *);
+    } evcb_cb_union;
+    void *evcb_arg;
+};
+
+struct event {
+    struct event_callback ev_evcallback;
+    /* for managing timeouts */
+    union {
+        TAILQ_ENTRY(event) ev_next_with_common_timeout;
+        int min_heap_idx;
+    } ev_timeout_pos;
+    evutil_socket_t ev_fd;
+    struct event_base *ev_base;
+    union {
+        /* used for io events */
+        struct {
+            LIST_ENTRY(event) ev_io_next;
+            struct timeval ev_timeout;
+        } ev_io;
+        /* used by signal events */
+        struct {
+            LIST_ENTRY(event) ev_signal_next;
+            short ev_ncalls;
+            short *ev_pncalls;
+        } ev_signal;
+    } ev_;
+    short ev_events;
+    short ev_res;          /* result passed to event callback */
+    struct timeval ev_timeout;
+};
+
+#define EV_TIMEOUT 0x01
+#define EV_READ    0x02
+#define EV_WRITE   0x04
+#define EV_SIGNAL  0x08
+#define EV_PERSIST 0x10
+
+#define EVLOOP_ONCE     0x01
+#define EVLOOP_NONBLOCK 0x02
+
+struct event_base *event_init(void);
+struct event_base *event_base_new(void);
+void event_base_free(struct event_base *);
+const char *event_get_version(void);
+
+void event_set(struct event *, evutil_socket_t, short,
+               void (*)(evutil_socket_t, short, void *), void *);
+int event_base_set(struct event_base *, struct event *);
+int event_add(struct event *, const struct timeval *);
+int event_del(struct event *);
+
+int event_base_loop(struct event_base *, int);
+int event_base_loopexit(struct event_base *, const struct timeval *);
+int event_loop(int);
+
+#define evtimer_set(ev, cb, arg) event_set((ev), -1, 0, (cb), (arg))
+#define evtimer_add(ev, tv)      event_add((ev), (tv))
+#define evtimer_del(ev)          event_del(ev)
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* APUS_LIBEVENT_COMPAT_EVENT_H */

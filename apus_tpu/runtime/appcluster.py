@@ -197,9 +197,23 @@ SSDB_SERVER = os.path.join(REPO_ROOT, "apps", "ssdb", "build",
 SSDB_TARBALL = os.environ.get(
     "APUS_SSDB_TARBALL", "/root/reference/apps/ssdb/master.tar.gz")
 
+#: Pinned unmodified memcached (the reference's second app,
+#: apps/memcached/mk,run) — built against the libevent compat shim
+#: when the image lacks libevent-dev (apps/memcached/compat).
+MEMCACHED_RUN = os.path.join(REPO_ROOT, "apps", "memcached", "run")
+MEMCACHED_SERVER = os.path.join(REPO_ROOT, "apps", "memcached", "build",
+                                "memcached-1.4.21", "memcached")
+MEMCACHED_TARBALL = os.environ.get(
+    "APUS_MEMCACHED_TARBALL",
+    "/root/reference/apps/memcached/memcached-1.4.21.tar.gz")
+
 
 def build_ssdb() -> bool:
     return _build_app(SSDB_SERVER, "ssdb", timeout=600)
+
+
+def build_memcached() -> bool:
+    return _build_app(MEMCACHED_SERVER, "memcached", timeout=300)
 
 
 def _build_app(server_path: str, app_dir: str, timeout: float) -> bool:
@@ -222,28 +236,22 @@ def build_redis() -> bool:
     return _build_app(REDIS_SERVER, "redis", timeout=300)
 
 
-class RespClient:
-    """Minimal RESP (redis protocol) client — the redis-benchmark stand-
-    in for driving SET/GET at a replicated redis (run.sh:70-80)."""
+class _CrlfClient:
+    """Shared buffered-TCP plumbing for the CRLF-framed app clients
+    (RESP and memcached text protocol)."""
+
+    proto = "app"
 
     def __init__(self, addr: tuple[str, int], timeout: float = 10.0):
         self.sock = socket.create_connection(addr, timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""
 
-    def cmd(self, *args: str | bytes):
-        out = [b"*%d\r\n" % len(args)]
-        for a in args:
-            b = a.encode() if isinstance(a, str) else a
-            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-        self.sock.sendall(b"".join(out))
-        return self._reply()
-
     def _line(self) -> bytes:
         while b"\r\n" not in self._buf:
             chunk = self.sock.recv(65536)
             if not chunk:
-                raise ConnectionError("redis closed connection")
+                raise ConnectionError(f"{self.proto} closed connection")
             self._buf += chunk
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
@@ -252,10 +260,34 @@ class RespClient:
         while len(self._buf) < n:
             chunk = self.sock.recv(65536)
             if not chunk:
-                raise ConnectionError("redis closed connection")
+                raise ConnectionError(f"{self.proto} closed connection")
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RespClient(_CrlfClient):
+    """Minimal RESP (redis protocol) client — the redis-benchmark stand-
+    in for driving SET/GET at a replicated redis (run.sh:70-80)."""
+
+    proto = "redis"
+
+    def cmd(self, *args: str | bytes):
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a.encode() if isinstance(a, str) else a
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(out))
+        return self._reply()
 
     def _reply(self):
         line = self._line()
@@ -277,14 +309,50 @@ class RespClient:
             return [self._reply() for _ in range(int(rest))]
         raise RuntimeError(f"bad RESP type byte {t!r}")
 
-    def close(self) -> None:
-        self.sock.close()
 
-    def __enter__(self) -> "RespClient":
-        return self
+class McClient(_CrlfClient):
+    """Minimal memcached text-protocol client — the memslap stand-in
+    for driving set/get at a replicated memcached (the reference
+    drives it with memslap --concurrency=10 --execute-number=5000,
+    apps/memcached/run:22-28)."""
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    proto = "memcached"
+
+    def set(self, key: str, value: str | bytes) -> bool:
+        v = value.encode() if isinstance(value, str) else value
+        self.sock.sendall(b"set %s 0 0 %d\r\n%s\r\n"
+                          % (key.encode(), len(v), v))
+        reply = self._line()
+        if reply.startswith((b"ERROR", b"CLIENT_ERROR", b"SERVER_ERROR")):
+            raise RuntimeError(reply.decode())
+        return reply == b"STORED"
+
+    def get(self, key: str) -> bytes | None:
+        self.sock.sendall(b"get %s\r\n" % key.encode())
+        line = self._line()
+        if line == b"END":
+            return None
+        if not line.startswith(b"VALUE "):
+            raise RuntimeError(f"bad get reply {line!r}")
+        n = int(line.rsplit(b" ", 1)[1])
+        data = self._exact(n)
+        self._exact(2)                           # trailing CRLF
+        end = self._line()
+        if end != b"END":
+            raise RuntimeError(f"bad get terminator {end!r}")
+        return data
+
+    def stat(self, name: str) -> int:
+        """One numeric field from ``stats`` (e.g. curr_items)."""
+        self.sock.sendall(b"stats\r\n")
+        value = -1
+        while True:
+            line = self._line()
+            if line == b"END":
+                return value
+            parts = line.split()
+            if len(parts) == 3 and parts[1] == name.encode():
+                value = int(parts[2])
 
 
 class LineClient:

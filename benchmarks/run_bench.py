@@ -28,7 +28,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from apus_tpu.runtime.appcluster import (LineClient,  # noqa: E402
-                                         ProxiedCluster, RespClient)
+                                         McClient, ProxiedCluster,
+                                         RespClient)
 
 
 def percentile(sorted_us: list[float], q: float) -> float:
@@ -72,6 +73,25 @@ class RespDriver:
     @staticmethod
     def count(c):
         return c.cmd("DBSIZE")
+
+
+class McDriver:
+    """memcached text protocol (the memslap shape,
+    apps/memcached/run:22-28 in the reference)."""
+
+    make = staticmethod(lambda addr: McClient(addr, timeout=30.0))
+
+    @staticmethod
+    def set(c, key, value):
+        return c.set(key, value)
+
+    @staticmethod
+    def get(c, key):
+        return c.get(key)
+
+    @staticmethod
+    def count(c):
+        return c.stat("curr_items")
 
 
 class SsdbDriver(RespDriver):
@@ -209,6 +229,10 @@ def main() -> int:
                     help="drive the pinned unmodified ssdb "
                          "(apps/ssdb/run; ssdb-bench shape, "
                          "run.sh:71-73)")
+    ap.add_argument("--memcached", action="store_true",
+                    help="drive the pinned unmodified memcached "
+                         "(apps/memcached/run; memslap shape, "
+                         "apps/memcached/run:22-28)")
     ap.add_argument("--pipeline", type=int, default=1,
                     help="redis-benchmark -P: commands per burst "
                          "(builds the backlog the device plane's "
@@ -246,6 +270,15 @@ def main() -> int:
             return 2
         app_argv = [SSDB_RUN]
         drv = SsdbDriver
+    elif args.memcached:
+        from apus_tpu.runtime.appcluster import (MEMCACHED_RUN,
+                                                 build_memcached)
+        if not build_memcached():
+            print("pinned memcached unavailable (no tarball / no "
+                  "libevent runtime)", file=sys.stderr)
+            return 2
+        app_argv = [MEMCACHED_RUN]
+        drv = McDriver
 
     if args.proc:
         from apus_tpu.runtime.proc import ProcCluster

@@ -109,6 +109,19 @@ def cmd_run(args) -> int:
                 _record(out, rec, replicas=5, bench="run_bench",
                         app="ssdb")
 
+        # 1a3. memcached 3-replica pass (BASELINE.json "memcached
+        # 3-replica" config), gated on the pinned build being available
+        # (in this image it builds against the libevent compat shim).
+        if getattr(args, "memcached", False):
+            print("run_bench: 3 replicas (real memcached)")
+            argv = [sys.executable,
+                    os.path.join(REPO, "benchmarks", "run_bench.py"),
+                    "--replicas", "3", "--requests", str(args.requests),
+                    "--memcached"]
+            for rec in _run_tool(argv, timeout=420):
+                _record(out, rec, replicas=3, bench="run_bench",
+                        app="memcached")
+
         # 1b. Device-plane full stack (proxied app with commits carried
         # by the jitted device plane on the virtual CPU mesh).
         print("run_bench: 3 replicas (device plane)")
@@ -345,6 +358,9 @@ def main() -> int:
         p.add_argument("--ssdb", action="store_true",
                        help="also run a 5-replica pass with the pinned "
                             "real ssdb (BASELINE.json mixed config)")
+        p.add_argument("--memcached", action="store_true",
+                       help="also run a 3-replica pass with the pinned "
+                            "real memcached (BASELINE.json config)")
         p.add_argument("--redis", action="store_true",
                        help="drive the pinned real redis instead of "
                             "toyserver")

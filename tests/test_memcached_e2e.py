@@ -1,0 +1,59 @@
+"""Real unmodified memcached made fault-tolerant via LD_PRELOAD.
+
+The reference's second replicated app (apps/memcached/mk,run; memslap
+drives it, apps/memcached/run:22-28).  In this image memcached builds
+against the libevent compat shim (apps/memcached/compat) and links the
+system libevent_core runtime.  Skipped when neither the pinned tarball
+nor a built binary is available.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from apus_tpu.runtime.appcluster import (MEMCACHED_RUN, MEMCACHED_SERVER,
+                                         MEMCACHED_TARBALL, McClient,
+                                         ProxiedCluster, build_memcached,
+                                         build_native)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(MEMCACHED_SERVER)
+         or os.path.exists(MEMCACHED_TARBALL)),
+    reason="pinned memcached unavailable (no tarball, no built binary)")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def native():
+    build_native()
+    if not build_memcached():
+        pytest.skip("pinned memcached failed to build (no libevent "
+                    "runtime?)")
+
+
+def test_memcached_replicates_to_followers():
+    with ProxiedCluster(3, app_argv=[MEMCACHED_RUN]) as pc:
+        leader = pc.leader_idx()
+        with McClient(pc.app_addr(leader)) as c:
+            for i in range(20):
+                assert c.set(f"mk:{i}", f"mv:{i}")
+            assert c.get("mk:7") == b"mv:7"
+        # GET-after-SET on every replica's memcached (run.sh's
+        # criterion, via each instance's own stats/get).
+        deadline = time.monotonic() + 20
+        for i in range(3):
+            if pc.apps[i] is None:
+                continue
+            last = None
+            while time.monotonic() < deadline:
+                with McClient(pc.app_addr(i)) as c:
+                    last = c.get("mk:19")
+                if last == b"mv:19":
+                    break
+                time.sleep(0.2)
+            assert last == b"mv:19", (i, last)
+            with McClient(pc.app_addr(i)) as c:
+                assert c.get("mk:0") == b"mv:0"
+                assert c.stat("curr_items") == 20
