@@ -257,6 +257,14 @@ class DeviceCommitRunner:
                     jax.device_put(meta, staged_sh))
 
         self._place_staged = _place_staged
+        #: Whether the driver should keep deep windows in flight
+        #: (commit_rounds_async) rather than resolving each before
+        #: staging the next.  Profitable only when the device computes
+        #: somewhere else (overlap hides host staging behind device
+        #: execution); on the CPU backend staging and compute contend
+        #: for the same cores and the measured async path is 2-6x
+        #: SLOWER than sync (same rationale as _use_device_expand).
+        self.use_async_windows = jax.default_backend() != "cpu"
         #: CommitControl template cache: all fields but ``end0`` are
         #: constant within (leader, term, cid, live) — rebuilding seven
         #: device scalars per round is measurable host overhead.
@@ -416,6 +424,22 @@ class DeviceCommitRunner:
         depth*batch entries, idx-contiguous from ``end0``.  Returns the
         device commit index after the last round, or None if ``gen`` is
         stale.  Same lock discipline as commit_round."""
+        h = self.commit_rounds_async(gen, end0, entries, cid, live)
+        return None if h is None else self.resolve_rounds(h)
+
+    def commit_rounds_async(self, gen: int, end0: int,
+                            entries: list[LogEntry], cid,
+                            live: set[int]) -> Optional["_WindowHandle"]:
+        """Enqueue a multi-round window WITHOUT waiting for its result —
+        the caller may stage and dispatch the next window while this
+        one executes, then collect via :meth:`resolve_rounds`.  This is
+        the sharper analog of the reference's outstanding-WR
+        pipelining: post_send keeps the NIC queue full and only
+        selectively signals (dare_ibv_rc.c:2552-2568); here the device
+        queue holds whole windows and the host blocks only at resolve.
+        Returns None if ``gen`` is stale.  Donation keeps device-side
+        ordering: window N+1's program consumes the devlog arrays
+        window N produced, whether or not N has been resolved."""
         B = self.batch
         K = len(entries) // B
         assert K in self._pipes and len(entries) == K * B, \
@@ -449,12 +473,24 @@ class DeviceCommitRunner:
             if K == self.DEEP_DEPTH:
                 self.stats["deep_dispatches"] = \
                     self.stats.get("deep_dispatches", 0) + 1
-        self._jax.block_until_ready(commits)
-        commits_host = np.asarray(commits)
-        # Per-round accounting (parity with the single-round path: a
-        # dispatch where all K rounds miss quorum counts K, not 1).
-        self.stats["quorum_fail_rounds"] += int(sum(
-            int(commits_host[k]) < end0 + (k + 1) * B for k in range(K)))
+        return _WindowHandle(gen, end0, K, commits)
+
+    def resolve_rounds(self, h: "_WindowHandle") -> Optional[int]:
+        """Block on an async window's result and return the device
+        commit index after its last round.  Returns None if the runner
+        has been reset since the window was enqueued — its device
+        result was computed against a generation whose quorum attests
+        the caller must no longer act on."""
+        commits_host = np.asarray(h.commits)        # device->host wait
+        B = self.batch
+        with self.lock:
+            if h.gen != self.generation:
+                return None
+            # Per-round accounting (parity with the single-round path:
+            # a dispatch where all K rounds miss quorum counts K, not 1).
+            self.stats["quorum_fail_rounds"] += int(sum(
+                int(commits_host[k]) < h.end0 + (k + 1) * B
+                for k in range(h.K)))
         return int(commits_host[-1])
 
     def _make_ctrl(self, cid, leader: int, term: int, end0: int,
@@ -558,8 +594,28 @@ class DeviceCommitRunner:
         return out
 
 
+class _WindowHandle:
+    """In-flight async window (commit_rounds_async): the device-side
+    ``commits`` vector plus the expectations needed to account for it
+    at resolve time."""
+
+    __slots__ = ("gen", "end0", "K", "commits")
+
+    def __init__(self, gen: int, end0: int, K: int, commits):
+        self.gen, self.end0, self.K, self.commits = gen, end0, K, commits
+
+
 class DevicePlaneDriver:
     """Per-daemon thread binding one replica to the shared runner."""
+
+    #: Deep windows kept in flight before the driver blocks on the
+    #: oldest one — the reference keeps its NIC send queue full the
+    #: same way (sized 2*ceil(retry/hb), selective signaling,
+    #: dare_ibv_rc.c:182-195, :2552-2568).  Depth 2 overlaps window
+    #: N+1's staging+dispatch with window N's execution+readback, which
+    #: is where the win is; deeper adds commit-release latency for no
+    #: extra overlap.
+    MAX_INFLIGHT = 2
 
     def __init__(self, daemon, runner: DeviceCommitRunner):
         self.daemon = daemon
@@ -573,6 +629,9 @@ class DevicePlaneDriver:
         self._dev_next = 0
         self._last_end_seen = 0
         self._last_commit_advance = 0.0
+        # In-flight async deep windows, oldest first (commit_rounds_
+        # async handles); dropped whenever _gen is invalidated.
+        self._inflight: list[_WindowHandle] = []
         # Follower-side: skip drain polling while nothing new happened
         # (keyed on (generation, rounds) at the last fruitless drain).
         self._drain_idle_key = None
@@ -647,6 +706,7 @@ class DevicePlaneDriver:
             self.daemon.node.external_commit = False
             self.daemon.node.device_covered_from = None
         self._gen = None
+        self._inflight.clear()
 
     def _step_once(self) -> bool:
         """One driver iteration.  Returns True if work was done (skip
@@ -657,6 +717,7 @@ class DevicePlaneDriver:
                 return self._leader_step(node)
             if self._gen is not None:
                 self._gen = None
+                self._inflight.clear()
                 node.external_commit = False
         return self._follower_step(node)
 
@@ -672,6 +733,7 @@ class DevicePlaneDriver:
             # commit until it fits again.
             if self._gen is not None:
                 self._gen = None
+                self._inflight.clear()
                 node.external_commit = False
                 node.device_covered_from = None
                 self.stats["fallbacks"] += 1
@@ -688,7 +750,18 @@ class DevicePlaneDriver:
         # re-attest it idempotently and catch up to the live edge.)
         if self._dev_next < node.log.head:
             self._gen = None
+            self._inflight.clear()
             return True
+
+        # Async pipeline policy: block on the oldest in-flight deep
+        # window once the pipeline is full, or as soon as the backlog
+        # can no longer fill another deep window (drain when traffic
+        # lightens so committed entries release their app threads).
+        if self._inflight:
+            deep_ready = (node.log.end - self._dev_next
+                          >= self.runner.DEEP_DEPTH * B)
+            if len(self._inflight) >= self.MAX_INFLIGHT or not deep_ready:
+                return self._resolve_oldest(node, term)
 
         # Re-arm device-owned commit once (a) the host quorum has
         # committed the prefix below the device base (safety argument
@@ -745,6 +818,12 @@ class DevicePlaneDriver:
         if entries is None:
             entries = list(node.log.entries(self._dev_next,
                                             self._dev_next + B))
+        if span_rounds != self.runner.DEEP_DEPTH and self._inflight:
+            # A dirty deep window downgraded this dispatch to a sync
+            # shape (or an oversize fallback): drain the pipeline first
+            # — the sync paths and the host-fallback handoff both
+            # assume no outstanding windows.
+            return self._resolve_oldest(node, term)
         if span_rounds == 1:
             if len(entries) != B:
                 return False
@@ -764,9 +843,18 @@ class DevicePlaneDriver:
         live = self._live_members(node)
 
         # -- device dispatch outside the daemon lock --
+        handle = None
         self.daemon.lock.release()
         try:
-            if span_rounds > 1:
+            if span_rounds == self.runner.DEEP_DEPTH \
+                    and self.runner.use_async_windows:
+                # Deep windows enqueue WITHOUT blocking on the result:
+                # up to MAX_INFLIGHT ride the device queue while the
+                # host stages the next (the outstanding-WR shape).
+                handle = self.runner.commit_rounds_async(
+                    gen, end0, entries, cid, live)
+                res = None if handle is None else ()
+            elif span_rounds > 1:
                 dev_commit = self.runner.commit_rounds(gen, end0, entries,
                                                        cid, live)
                 res = None if dev_commit is None else ((), dev_commit)
@@ -778,17 +866,55 @@ class DevicePlaneDriver:
 
         if res is None:                    # stale generation
             self._gen = None
+            self._inflight.clear()
             return True
-        acks, dev_commit = res
         self._dev_next = end0 + span_rounds * B
         self.stats["rounds"] += span_rounds
+        if handle is not None:
+            self._inflight.append(handle)
+            self.stats["async_windows"] = \
+                self.stats.get("async_windows", 0) + 1
+            return True
+        acks, dev_commit = res
         # Re-validate leadership before adopting the result: an election
         # (or our own daemon's death) may have happened while the lock
         # was released.
         if self._stop.is_set() \
                 or not (node.is_leader and node.current_term == term):
             self._gen = None
+            self._inflight.clear()
             return True
+        self._adopt_commit(node, dev_commit)
+        return True
+
+    def _resolve_oldest(self, node, term: int) -> bool:
+        """Block on the oldest in-flight async window (daemon lock
+        released during the wait) and adopt its quorum result after the
+        same re-validation as the sync paths.  Called under the daemon
+        lock; always consumes the handle."""
+        h = self._inflight[0]
+        self.daemon.lock.release()
+        try:
+            dev_commit = self.runner.resolve_rounds(h)
+        finally:
+            self.daemon.lock.acquire()
+        if self._inflight and self._inflight[0] is h:
+            self._inflight.pop(0)
+        if dev_commit is None:             # runner reset since enqueue
+            self._gen = None
+            self._inflight.clear()
+            return True
+        if self._stop.is_set() \
+                or not (node.is_leader and node.current_term == term):
+            self._gen = None
+            self._inflight.clear()
+            return True
+        self._adopt_commit(node, dev_commit)
+        return True
+
+    def _adopt_commit(self, node, dev_commit: int) -> None:
+        """Advance host commit from a device quorum result (under the
+        daemon lock, leadership already re-validated)."""
         if node.log.commit >= self._dev_base and dev_commit > node.log.commit:
             before = node.log.commit
             after = node.log.advance_commit(min(dev_commit, node.log.end))
@@ -798,13 +924,13 @@ class DevicePlaneDriver:
                 node.stats["devplane_commits"] = \
                     node.stats.get("devplane_commits", 0) + 1
                 self.daemon.commit_cond.notify_all()
-        return True
 
     def _reset_for_leadership(self, node, term: int) -> bool:
         """New leadership: choose the device base just past our current
         log end (guaranteeing a term-T entry sits below it — the blank
         entry from become_leader at minimum) and reset the shards."""
         B = self.runner.batch
+        self._inflight.clear()      # any survivors are stale post-reset
         while (node.log.end - 1) % B != 0 and not node.log.near_full(2):
             node.log.append(term, type=EntryType.NOOP)
         if (node.log.end - 1) % B != 0:
