@@ -25,8 +25,7 @@ attempt window (a watched child of this same file,
 ``_APUS_BENCH_CHILD=1``) on a healthy probe, re-probing until the
 budget forces the forced-CPU fallback (the axon tunnel wedges for
 minutes at a time and clears on its own).  The child climbs a DEPTH
-LADDER (default 4096 -> 16384 -> 65536 -> 262144 rounds per dispatch
-on TPU),
+LADDER (default 4096 -> ... -> 1048576 rounds per dispatch on TPU),
 flushing a complete JSON headline after every depth — a watchdog kill
 mid-ladder still leaves the best completed number on stdout, and the
 parent takes the LAST JSON line.  A successful TPU result is recorded
@@ -38,7 +37,7 @@ execute).  The JAX persistent compilation cache turns repeat compiles
 into disk hits.
 
 Env knobs: APUS_BENCH_DEPTHS (comma ladder, default
-"4096,16384,65536,262144" TPU / "64,1024,16384" CPU),
+"4096,16384,65536,262144,1048576" TPU / "64,1024,16384" CPU),
 APUS_BENCH_BUDGET (total seconds, default 225),
 APUS_BENCH_TPU_TIMEOUT (per-TPU-attempt watchdog, default 60),
 APUS_JAX_CACHE (compilation cache dir, default <repo>/.jax_cache).
@@ -101,7 +100,8 @@ def _bench() -> None:
     R, S, SB, B = 5, 4096, 4096, 64      # 5 replicas, 16 MB log each, 64-batch
     depths = [int(d) for d in os.environ.get(
         "APUS_BENCH_DEPTHS",
-        "64,1024,16384" if cpu else "4096,16384,65536,262144").split(",")]
+        "64,1024,16384" if cpu
+        else "4096,16384,65536,262144,1048576").split(",")]
     dispatches = 5 if cpu else 10
     single_iters = 10 if cpu else 20
     deadline = float(os.environ.get("_APUS_BENCH_DEADLINE", "0"))
