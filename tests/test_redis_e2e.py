@@ -130,3 +130,24 @@ def test_redis_through_device_plane():
             _wait_key(pc.app_addr(i), "dpk:39", b"dpv:39")
             with RespClient(pc.app_addr(i)) as c:
                 assert c.cmd("GET", "dpk:0") == b"dpv:0"
+
+
+def test_redis_large_value_replicates():
+    """A 64 KiB value — 16x the 4 KiB device slot width, inside the
+    87,380 B record envelope (message.h:7) — captured from real redis
+    reads, segmented through the pipeline, and served back by every
+    follower's redis byte-identically."""
+    with ProxiedCluster(3, app_argv=[REDIS_RUN]) as pc:
+        leader = pc.leader_idx()
+        big = bytes(bytearray((i * 131 + 7) % 256 for i in range(65536)))
+        with RespClient(pc.app_addr(leader)) as c:
+            assert c.cmd("SET", "bigk", big) == "OK"
+            assert c.cmd("GET", "bigk") == big
+            assert c.cmd("SET", "after-big", "ok") == "OK"
+        for i in range(3):
+            if pc.apps[i] is None:
+                continue
+            _wait_key(pc.app_addr(i), "after-big", b"ok", timeout=25)
+            with RespClient(pc.app_addr(i)) as c:
+                got = c.cmd("GET", "bigk")
+            assert got == big, (i, None if got is None else len(got))
