@@ -221,3 +221,4 @@ def test_evicted_process_rejoins_promptly_on_restart(tmp_path):
             assert victim in members(), "victim never rejoined"
             assert took < 10.0, f"rejoin took {took:.1f}s"
             assert c.put(b"post", b"2") == b"OK"
+

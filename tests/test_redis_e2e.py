@@ -100,6 +100,13 @@ def test_redis_leader_failover_promotes_follower(tmp_path):
             assert c.cmd("GET", "fk:3") == b"fv:3"
             assert c.cmd("SET", "post-failover", "yes") == "OK"
             assert c.cmd("GET", "post-failover") == b"yes"
+        # BOTH survivors converge on the post-failover write too (the
+        # reconf_bench.sh criterion after FailLeader: the shrunken
+        # group keeps replicating, not just answering).
+        for i in range(3):
+            if pc.procs[i] is not None:
+                _wait_key(pc.app_addr(i), "post-failover", b"yes",
+                          timeout=20)
 
 
 def test_redis_through_device_plane():
