@@ -459,13 +459,24 @@ def test_async_pipeline_survives_leader_kill_mid_flight():
               timeout=60, msg="an async window in flight")
         if not leader.is_leader:
             pytest.skip("leadership flapped before the kill")
-        # Writes ACKED before the kill (applied on the old leader) must
-        # survive it — the durability half of the docstring's claim.
+        # At least one early write must be ACKED (applied) pre-kill so
+        # the durability assertion below is never vacuous — with 8
+        # windows queued, the first resolves while later ones are still
+        # in flight, which is exactly the state the kill should hit.
+        _wait(lambda: any(p.reply is not None for p in prs[:B])
+              or not leader.is_leader,
+              timeout=60, msg="an acked write before the kill")
         acked = [i for i, p in enumerate(prs) if p.reply is not None]
+        if not acked:
+            pytest.skip("leadership flapped before any write was acked")
         resets_before = runner.stats["resets"]
         c.kill(leader.idx)
-        _wait(lambda: c.leader() is not None
-              and c.leader().idx != leader.idx, msg="new leader")
+
+        def _new_leader():
+            ld = c.leader()
+            return ld is not None and ld.idx != leader.idx
+
+        _wait(_new_leader, msg="new leader")
         # Traffic under the new leadership; the plane must re-base
         # (discarding the in-flight handles of the old generation).
         for i in range(2 * B):

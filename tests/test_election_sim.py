@@ -185,3 +185,32 @@ def test_node_hb_timeout_tracks_detector():
             continue
         assert n._hb_timeout >= n.cfg.hb_timeout
         assert n._hb_adapt is not None and n._hb_adapt.samples > 0
+
+
+def test_max_group_thirteen_replicas():
+    """MAX_SERVER_COUNT parity (dare.h:26): the reference caps groups
+    at 13 servers.  A 13-replica group elects one leader, commits, and
+    keeps committing with 6 of 13 crashed (the maximum failures a
+    13-group can absorb: quorum 7 survives)."""
+    from apus_tpu.models.kvs import (KvsStateMachine, encode_get,
+                                     encode_put)
+
+    c = Cluster(13, seed=5, sm_factory=KvsStateMachine)
+    c.wait_for_leader()
+    assert c.submit(encode_put(b"full", b"13")) is not None
+    c.run(0.5)
+    assert sum(1 for n in c.nodes if n.is_leader) == 1
+    # Crash 6 non-leader members; the surviving 7 are exactly quorum.
+    victims = [n.idx for n in c.nodes if not n.is_leader][:6]
+    for v in victims:
+        c.crash(v)
+    c.run(0.5)
+    assert c.wait_for_leader() is not None
+    assert c.submit(encode_put(b"after", b"ok")) is not None
+    c.run(0.5)
+    for n in c.nodes:
+        if n.idx in victims:
+            continue
+        assert n.sm.query(encode_get(b"full")) == b"13", n.idx
+        assert n.sm.query(encode_get(b"after")) == b"ok", n.idx
+    c.check_logs_consistent()
