@@ -113,17 +113,75 @@ def run_schedule(trial: int, seed_base: int, auto_remove: bool) -> str:
     return "ok"
 
 
+def run_devplane_schedule(trial: int, seed_base: int,
+                          force_async: bool) -> str:
+    """One randomized fault schedule against the LIVE device plane
+    (LocalCluster(3, device_plane=True), real time, commits through
+    the jitted step): submit bursts interleaved with leader/follower
+    kills and restarts, then require convergence, durability of every
+    acked write, and mutually consistent logs.  With ``force_async``
+    the driver keeps deep windows in flight (the accelerator path),
+    so kills land while windows are outstanding."""
+    import random
+    import time as _time
+
+    from apus_tpu.models.kvs import encode_get, encode_put
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    rng = random.Random(seed_base + trial)
+    acked: dict[bytes, bytes] = {}
+    seq = 0
+    with LocalCluster(3, device_plane=True) as c:
+        if force_async:
+            c.device_runner.use_async_windows = True
+        c.wait_for_leader()
+        for _ in range(rng.randint(2, 4)):
+            for _ in range(rng.randint(10, 150)):
+                k = b"f%d" % seq
+                v = b"v%d" % seq
+                seq += 1
+                c.submit(encode_put(k, v), timeout=30.0)
+                acked[k] = v
+            live = {d.idx for d in c.live()}
+            dead = [i for i in range(3) if i not in live]
+            # Coin-flip restarts so an outage can persist across the
+            # next burst (2-of-3 quorum keeps committing meanwhile).
+            if dead and rng.random() < 0.5:
+                c.restart(rng.choice(dead))
+            elif len(live) == 3:
+                c.kill(rng.choice(sorted(live)))
+            _time.sleep(rng.uniform(0.05, 0.3))
+        for i in range(3):
+            if all(d.idx != i for d in c.live()):
+                c.restart(i)
+        for i in range(3):
+            c.wait_caught_up(i, timeout=60.0)
+        for d in c.live():
+            for k, v in acked.items():
+                assert d.node.sm.query(encode_get(k)) == v, (d.idx, k)
+        c.check_logs_consistent()
+    return "ok"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=50)
     ap.add_argument("--seed-base", type=int, default=20_000)
     ap.add_argument("--auto-remove", action="store_true")
+    ap.add_argument("--device-plane", action="store_true",
+                    help="randomized fault schedules against the LIVE "
+                         "device plane (LocalCluster, jitted commits, "
+                         "async deep windows forced) instead of the "
+                         "virtual-time simulator")
     args = ap.parse_args()
     ok = stalls = 0
     failures = []
     for trial in range(args.trials):
         try:
-            r = run_schedule(trial, args.seed_base, args.auto_remove)
+            if args.device_plane:
+                r = run_devplane_schedule(trial, args.seed_base, True)
+            else:
+                r = run_schedule(trial, args.seed_base, args.auto_remove)
             if r == "ok":
                 ok += 1
             else:
@@ -132,12 +190,14 @@ def main() -> int:
             failures.append({"trial": trial, "error": repr(e)[:200]})
             print(f"trial {trial}: FAIL {e!r}", file=sys.stderr)
     print(json.dumps({
-        "metric": "protocol_fuzz_schedules_clean",
+        "metric": ("devplane_fuzz_schedules_clean" if args.device_plane
+                   else "protocol_fuzz_schedules_clean"),
         "value": ok,
         "unit": f"of {args.trials}",
         "detail": {"expected_stalls": stalls, "failures": failures,
                    "auto_remove": args.auto_remove,
-                   "seed_base": args.seed_base},
+                   "seed_base": args.seed_base,
+                   "device_plane": args.device_plane},
     }))
     return 1 if failures else 0
 

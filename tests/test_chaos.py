@@ -125,17 +125,38 @@ def test_chaos_with_segmentation_and_big_records():
     c.check_logs_consistent()
 
 
+def _load_fuzz():
+    """Load benchmarks/fuzz.py once per session (it is a CLI script,
+    not an importable package module)."""
+    global _FUZZ
+    if _FUZZ is None:
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "apus_fuzz", os.path.join(os.path.dirname(__file__), "..",
+                                      "benchmarks", "fuzz.py"))
+        _FUZZ = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_FUZZ)
+    return _FUZZ
+
+
+_FUZZ = None
+
+
 def test_fuzz_schedules_clean():
     """A slice of the randomized-schedule campaign (benchmarks/fuzz.py;
     50-schedule full runs are clean) as a CI canary: safety + liveness
     over random crash/partition/loss schedules with fixed membership."""
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "apus_fuzz", os.path.join(os.path.dirname(__file__), "..",
-                                  "benchmarks", "fuzz.py"))
-    fuzz = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(fuzz)
+    fuzz = _load_fuzz()
     for trial in range(8):
         assert fuzz.run_schedule(trial, 20_000, False) == "ok", trial
+
+
+def test_devplane_fuzz_slice():
+    """A slice of the LIVE device-plane fault campaign (benchmarks/
+    fuzz.py --device-plane; full runs are clean) as a CI canary:
+    kills and restarts land while async deep windows are in flight,
+    and every acked write survives with consistent logs."""
+    fuzz = _load_fuzz()
+    assert fuzz.run_devplane_schedule(1, 20_000, True) == "ok"
