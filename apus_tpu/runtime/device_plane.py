@@ -306,7 +306,7 @@ class DeviceCommitRunner:
 
     #: bytes of wire-codec overhead per slot payload (encode_entry
     #: header + optional cid, upper bound).  The authoritative gate is
-    #: ``len(wire.encode_entry(e)) <= slot_bytes`` (commit_round and the
+    #: ``wire.entry_wire_size(e) <= slot_bytes`` (commit_round and the
     #: driver's oversize check); max_data_bytes is the conservative
     #: sizing contract the segmentation layer cuts records against.
     WIRE_OVERHEAD = 64
@@ -398,21 +398,26 @@ class DeviceCommitRunner:
             self.stats["quorum_fail_rounds"] += 1
         return acks_host, commit_host
 
-    def _encode_batch(self, entries: list[LogEntry], end0: int):
-        """Wire-encode one idx-contiguous batch into slot rows."""
+    def _encode_batch(self, entries: list[LogEntry], end0: int,
+                      out_data=None, out_meta=None):
+        """Wire-encode one idx-contiguous batch into slot rows —
+        directly into ``out_data``/``out_meta`` when provided (window
+        staging encodes thousands of entries; in-place encoding is
+        ~4x the speed of per-entry bytes construction)."""
         B, SB = self.batch, self.slot_bytes
-        bdata = np.zeros((B, SB), np.uint8)
-        bmeta = np.zeros((B, 4), np.int32)
+        bdata = np.zeros((B, SB), np.uint8) if out_data is None else out_data
+        bmeta = np.zeros((B, 4), np.int32) if out_meta is None else out_meta
+        flat = memoryview(bdata.reshape(-1))
         for j, e in enumerate(entries):
             assert e.idx == end0 + j, (e.idx, end0, j)
-            blob = wire.encode_entry(e)
-            if len(blob) > SB:
+            size = wire.entry_wire_size(e)
+            if size > SB:
                 raise ValueError(
-                    f"entry {e.idx} wire size {len(blob)} > slot "
+                    f"entry {e.idx} wire size {size} > slot "
                     f"{SB}; segment upstream")
-            bdata[j, :len(blob)] = np.frombuffer(blob, np.uint8)
+            wire.encode_entry_into(e, flat, j * SB)
             bmeta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
-                        int(e.type), len(blob))
+                        int(e.type), size)
         return bdata, bmeta
 
     def commit_rounds(self, gen: int, end0: int, entries: list[LogEntry],
@@ -453,8 +458,8 @@ class DeviceCommitRunner:
         bd = np.zeros((K, B, self.slot_bytes), np.uint8)
         bm = np.zeros((K, B, 4), np.int32)
         for k in range(K):
-            bd[k], bm[k] = self._encode_batch(
-                entries[k * B:(k + 1) * B], end0 + k * B)
+            self._encode_batch(entries[k * B:(k + 1) * B], end0 + k * B,
+                               out_data=bd[k], out_meta=bm[k])
         sdata, smeta = self._place_staged(bd, bm, leader)
         ctrl = self._make_ctrl(cid, leader, term, end0, live)
         del bd, bm
@@ -807,7 +812,7 @@ class DevicePlaneDriver:
             span = list(node.log.entries(self._dev_next,
                                          self._dev_next + K * B))
             if len(span) == K * B and not any(
-                    len(wire.encode_entry(e)) > self.runner.slot_bytes
+                    wire.entry_wire_size(e) > self.runner.slot_bytes
                     for e in span):
                 entries, span_rounds = span, K
                 break
@@ -827,7 +832,7 @@ class DevicePlaneDriver:
         if span_rounds == 1:
             if len(entries) != B:
                 return False
-            if any(len(wire.encode_entry(e)) > self.runner.slot_bytes
+            if any(wire.entry_wire_size(e) > self.runner.slot_bytes
                    for e in entries):
                 # Oversized record: this span must commit via the host
                 # path; re-base the device plane past it once that

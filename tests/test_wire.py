@@ -53,3 +53,38 @@ def test_region_indices_stable():
     # The wire indexes regions positionally; adding regions must append.
     assert wire.REGION_LIST[0] == Region.VOTE_REQ
     assert wire.REGION_INDEX[Region.HB] == 2
+
+
+def test_entry_wire_size_and_encode_into_match_encode_entry():
+    """The no-encode size gate and the in-place encoder (the device
+    plane's staging fast path) must agree byte-for-byte with
+    encode_entry for every entry shape: with/without cid, empty and
+    large payloads."""
+    import numpy as np
+
+    from apus_tpu.core.cid import Cid, CidState
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+
+    cids = [None, Cid.initial(5),
+            Cid(epoch=9, state=CidState.TRANSIT, size=3, new_size=5,
+                bitmask=0b11111)]
+    datas = [b"", b"x", b"payload" * 11, bytes(range(256)) * 16]
+    entries = [
+        LogEntry(idx=i + 1, term=3, type=t, req_id=77 + i, clt_id=5,
+                 head=h, cid=c, data=d)
+        for i, (t, c, d, h) in enumerate(
+            (t, c, d, h)
+            for t in (EntryType.CSM, EntryType.NOOP, EntryType.CONFIG)
+            for c in cids for d in datas for h in (0, 12))]
+    for e in entries:
+        ref = wire.encode_entry(e)
+        assert wire.entry_wire_size(e) == len(ref), e
+        buf = np.zeros(len(ref) + 16, np.uint8)
+        flat = memoryview(buf)
+        n = wire.encode_entry_into(e, flat, 8)
+        assert n == len(ref)
+        assert buf[8:8 + n].tobytes() == ref, e
+        # round-trip through the normal decoder
+        got = wire.decode_entry(wire.Reader(buf[8:8 + n].tobytes()))
+        assert got == e
