@@ -257,14 +257,15 @@ class DeviceCommitRunner:
                     jax.device_put(meta, staged_sh))
 
         self._place_staged = _place_staged
-        #: Whether the driver should keep deep windows in flight
+        #: Whether the driver keeps deep windows in flight
         #: (commit_rounds_async) rather than resolving each before
-        #: staging the next.  Profitable only when the device computes
-        #: somewhere else (overlap hides host staging behind device
-        #: execution); on the CPU backend staging and compute contend
-        #: for the same cores and the measured async path is 2-6x
-        #: SLOWER than sync (same rationale as _use_device_expand).
-        self.use_async_windows = jax.default_backend() != "cpu"
+        #: staging the next.  With the in-place staging encoder the
+        #: async path measures faster on BOTH backends (it hides what
+        #: little host staging remains behind device execution; before
+        #: the encoder fast path, staging contended with compute on the
+        #: CPU backend and async lost 2-6x there) — bench.py's
+        #: live_async_round_mean_us tracks this.
+        self.use_async_windows = True
         #: CommitControl template cache: all fields but ``end0`` are
         #: constant within (leader, term, cid, live) — rebuilding seven
         #: device scalars per round is measurable host overhead.

@@ -401,9 +401,9 @@ def test_async_window_pipeline_live_driver():
     windows outstanding (stats['async_windows'] counts them) and the
     whole backlog still commits, applies, and replicates."""
     with LocalCluster(3, device_plane=True) as c:
-        # The CPU test backend disables async by default (staging and
-        # compute contend for the same cores); force it so the shipped
-        # accelerator path is what this test exercises.
+        # Async is the default on every backend; pin it explicitly so
+        # this test keeps exercising the in-flight path even if the
+        # default policy changes.
         c.device_runner.use_async_windows = True
         leader = c.wait_for_leader()
         _wait(lambda: leader.node.external_commit or not leader.is_leader,
