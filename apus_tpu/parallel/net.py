@@ -335,7 +335,15 @@ class NetTransport(Transport):
                    timeout: Optional[float] = None) -> Optional[bytes]:
         """Send one request frame, await the response frame.  Releases
         the daemon's node lock while blocked (see module docstring).
-        ``timeout`` overrides the per-op wire timeout (bulk transfers)."""
+        ``timeout`` overrides the per-op wire timeout (bulk transfers);
+        either way the wait scales with the payload (~1 s per 4 MB,
+        capped at 8 s): a multi-MB frame can take seconds to transfer
+        AND process on a loaded host, and a too-short wait makes the
+        sender misread success as DROPPED and resend — while the cap
+        bounds how long a tick-thread caller can stall on one peer."""
+        eff = (self.timeout if timeout is None else timeout) \
+            + len(payload) / 4e6
+        eff = min(8.0, eff)
         lock = self.yield_lock
         depth = 0
         if lock is not None:
@@ -349,8 +357,7 @@ class NetTransport(Transport):
                 if conn is None:
                     return None
                 try:
-                    if timeout is not None:
-                        conn.settimeout(timeout)
+                    conn.settimeout(eff)
                     conn.sendall(wire.frame(payload))
                     resp = wire.read_frame(conn)
                     if resp is None:
@@ -431,8 +438,9 @@ class NetTransport(Transport):
                    + wire.encode_cid(cid if cid is not None
                                      else Cid.initial(0))
                    + wire.encode_members(member_addrs or {}))
-        # Snapshots can be far larger than a control write: allow a
-        # proportionally longer wire timeout for this op.
+        # Snapshots get a 2 s floor on top of _roundtrip's generic
+        # payload scaling: the receiver persists the whole state before
+        # replying, which costs more than the transfer alone.
         resp = self._roundtrip(target, payload,
                                timeout=max(self.timeout, 2.0))
         if resp is None:

@@ -277,3 +277,34 @@ def test_join_slot_affinity():
     # upsize behavior (no regression).
     pj2 = leader.handle_join("10.0.0.10:1")
     assert pj2 is not None and pj2.slot == 3   # upsize: 3 slots full
+
+
+def test_large_state_snapshot_primes_joiner():
+    """A multi-megabyte SM state primes a joiner through the snapshot
+    push.  The reference preregisters a fixed 512 KB snapshot region
+    (dare_log.h:106) — the DCN push carries whatever the SM holds in
+    one frame (sanity cap 128 MB, wire.read_frame), so an 8 MB state
+    must arrive intact, with the joiner's store byte-identical."""
+    big = bytes(bytearray((i * 37) % 256 for i in range(32768)))
+    with LocalCluster(3, spec=SPEC) as c:
+        for i in range(256):
+            c.submit(encode_put(b"big%d" % i, big), timeout=30.0)
+
+        def pruned():
+            leader = c.leader()
+            if leader is None:
+                return False
+            with leader.lock:
+                return leader.node.log.head > 10
+        _wait(pruned, msg="leader log pruned")
+
+        d = c.add_replica()
+        c.wait_caught_up(d.idx, timeout=90.0)
+        # The authoritative evidence is on the INSTALLER: the pusher's
+        # own counter only ticks when the wire reply beats its timeout,
+        # which a multi-MB transfer on a loaded host may not.
+        with d.lock:
+            assert d.node.stats.get("snapshots_installed", 0) >= 1
+            assert d.node.sm.store[b"big0"] == big
+            assert d.node.sm.store[b"big255"] == big
+            assert len(d.node.sm.store) >= 256
