@@ -304,6 +304,15 @@ class DeviceCommitRunner:
                 np.zeros((depth, B, 4), np.int32), 0)
             devlog, commits, _ = pipe(devlog, sdata, smeta, ctrl)
             self._jax.block_until_ready(commits)
+        # Reader paths too (follower drain batch + window gathers,
+        # shard_end poll): their first use otherwise compiles
+        # mid-drain, stalling a live follower for seconds.
+        for n in (B, B * self.DEEP_DEPTH):
+            self._jax.block_until_ready(self._gather(
+                devlog.data, devlog.meta, np.int32(0),
+                np.zeros(n, np.int32)))
+        self._jax.block_until_ready(self._offs_one(devlog.offs,
+                                                   np.int32(0)))
 
     #: bytes of wire-codec overhead per slot payload (encode_entry
     #: header + optional cid, upper bound).  The authoritative gate is
@@ -556,20 +565,27 @@ class DeviceCommitRunner:
             row = self._offs_one(self._devlog.offs, np.int32(replica))
         return int(np.asarray(row)[OFF_END])
 
-    def read_rows(self, replica: int, gen: int, lo: int,
-                  hi: int) -> Optional[list[LogEntry]]:
-        """Decode rows [lo, hi) from ``replica``'s shard (at most one
-        batch).  Rows whose stored absolute index no longer matches
-        (ring overwritten, or not yet written) are cut off; the caller
-        appends what it gets and retries later."""
+    def read_rows(self, replica: int, gen: int, lo: int, hi: int,
+                  window: bool = False) -> Optional[list[LogEntry]]:
+        """Decode rows [lo, hi) from ``replica``'s shard — at most one
+        batch, or one DEEP window with ``window=True`` (the follower
+        drain's bulk shape: one gather dispatch instead of DEEP_DEPTH,
+        which on a tunneled chip is one round trip instead of 16; the
+        rc_recover_log analog bulk-reads the same way,
+        dare_ibv_rc.c:726-856).  Rows whose stored absolute index no
+        longer matches (ring overwritten, or not yet written) are cut
+        off; the caller appends what it gets and retries later."""
         from apus_tpu.ops.logplane import META_IDX, META_LEN, slot_of
         if not (0 <= replica < self.n_replicas):
             return None
-        hi = min(hi, lo + self.batch)
-        # Fixed-size [B] slot vector (static shape -> one compiled
-        # gather); rows past hi are fetched and discarded.
-        slots = np.array([slot_of(lo + j, self.n_slots)
-                          for j in range(self.batch)], np.int32)
+        cap = self.batch * (self.DEEP_DEPTH if window else 1)
+        hi = min(hi, lo + cap)
+        # Two static slot-vector shapes ([B] and [DEEP*B]) -> two
+        # compiled gathers (jit retraces per shape); rows past hi are
+        # fetched and discarded.
+        n = self.batch if hi - lo <= self.batch else cap
+        slots = slot_of(lo + np.arange(n, dtype=np.int64),
+                        self.n_slots).astype(np.int32)
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
@@ -1010,9 +1026,14 @@ class DevicePlaneDriver:
             shard_end = self.runner.shard_end(self.daemon.idx, gen)
             if shard_end is None or shard_end <= end:
                 return                 # shard fully absorbed
+            # Bulk shape (one gather per deep window, not per batch):
+            # this hook runs under the daemon lock pre-vote, so every
+            # saved device round trip directly shortens the election.
             rows = self.runner.read_rows(
                 self.daemon.idx, gen, end,
-                min(shard_end, end + self.runner.batch))
+                min(shard_end,
+                    end + self.runner.DEEP_DEPTH * self.runner.batch),
+                window=shard_end - end > self.runner.batch)
             if not rows:
                 return
             appended = 0
@@ -1056,9 +1077,14 @@ class DevicePlaneDriver:
         if shard_end is None or shard_end <= end:
             self._drain_idle_key = key
             return False
-        rows = self.runner.read_rows(self.daemon.idx, gen, end,
-                                     min(shard_end,
-                                         end + self.runner.batch))
+        # Bulk drain: one windowed gather when the backlog covers more
+        # than a batch (a deep dispatch lands DEEP_DEPTH*B rows at
+        # once; draining them one batch-gather at a time costs
+        # DEEP_DEPTH device round trips per window).
+        rows = self.runner.read_rows(
+            self.daemon.idx, gen, end,
+            min(shard_end, end + self.runner.DEEP_DEPTH * self.runner.batch),
+            window=shard_end - end > self.runner.batch)
         if not rows:
             self._drain_idle_key = key
             return False

@@ -496,3 +496,54 @@ def test_async_pipeline_survives_leader_kill_mid_flight():
                 assert d.node.sm.query(encode_get(b"kk%d" % i)) == b"kv", \
                     (d.idx, i)
         c.check_logs_consistent()
+
+
+def test_windowed_read_rows_bulk_drain_shape():
+    """read_rows(window=True) returns a whole deep window from ONE
+    gather: full-window reads decode every row, a partial window cuts
+    off exactly at shard_end, and sub-batch remainders fall back to the
+    [B] gather shape — all byte-identical to batch-at-a-time reads."""
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+
+    R, B = 3, 8
+    runner = DeviceCommitRunner(n_replicas=R, n_slots=512, slot_bytes=256,
+                                batch=B)
+    gen = runner.reset(leader=0, term=1, first_idx=1)
+    cid = Cid.initial(R)
+    live = set(range(R))
+    D = runner.DEEP_DEPTH
+
+    def batch_at(end0, m):
+        return [LogEntry(idx=end0 + j, term=1, type=EntryType.CSM,
+                         req_id=j + 1, clt_id=4,
+                         data=b"w-%d" % (end0 + j)) for j in range(m)]
+
+    # One deep window plus one extra batch on the shards.
+    assert runner.commit_rounds(gen, 1, batch_at(1, D * B), cid,
+                                live) == 1 + D * B
+    end = 1 + D * B
+    assert runner.commit_round(gen, end, batch_at(end, B), cid,
+                               live) is not None
+    shard_end = end + B
+
+    # Full deep window in one call.
+    rows = runner.read_rows(1, gen, 1, 1 + D * B, window=True)
+    assert rows is not None and len(rows) == D * B
+    assert [e.idx for e in rows] == list(range(1, 1 + D * B))
+    assert rows[-1].data == b"w-%d" % (D * B)
+    # Byte-identical to batch-at-a-time reads of the same span.
+    batched = []
+    for lo in range(1, 1 + D * B, B):
+        batched.extend(runner.read_rows(1, gen, lo, lo + B))
+    assert batched == rows
+    # Partial window: a window request past shard_end cuts off exactly
+    # there (rows beyond it were never written).
+    rows = runner.read_rows(2, gen, 1 + B, shard_end + 5 * B, window=True)
+    assert rows is not None
+    assert [e.idx for e in rows] == list(range(1 + B, shard_end))
+    # Sub-batch remainder without window: capped at one batch.
+    rows = runner.read_rows(0, gen, shard_end - B, shard_end + 99)
+    assert [e.idx for e in rows] == list(range(shard_end - B, shard_end))
