@@ -19,6 +19,7 @@ is fully present.  This module is that path over the DCN:
 from __future__ import annotations
 
 import os
+import secrets
 import socket
 import threading
 import time
@@ -146,15 +147,21 @@ def _not_leader(daemon) -> bytes:
 class ApusClient:
     """Cluster client: leader discovery, retries, exactly-once writes.
 
-    ``clt_id`` defaults to a pid/thread-derived id; req_ids are
-    per-client monotone, which the server-side dedup requires.
+    ``clt_id`` defaults to a fresh per-INSTANCE id (pid/thread mixed
+    with random bits): req_ids are per-client monotone from 1, and the
+    server-side dedup caches (clt_id, req_id) replies — two sequential
+    instances sharing a clt_id would have the second's early req_ids
+    swallowed by the first's cached replies (writes acked but never
+    applied).  Callers that pass an explicit clt_id own that
+    uniqueness themselves.
     """
 
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
                  timeout: float = 5.0):
         self.peers = [self._parse(p) for p in peers]
         self.clt_id = clt_id if clt_id is not None else (
-            (os.getpid() << 20) ^ threading.get_ident()) & ((1 << 63) - 1)
+            (os.getpid() << 20) ^ threading.get_ident()
+            ^ (secrets.randbits(40) << 23)) & ((1 << 63) - 1)
         self.timeout = timeout
         self._req_seq = 0
         self._leader: Optional[int] = None

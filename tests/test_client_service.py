@@ -128,3 +128,20 @@ def test_malformed_read_fails_read_not_replica():
                 pass
             # The replica survived and still serves.
             assert client.get(b"ok") == b"1"
+
+
+def test_sequential_clients_same_thread_all_writes_apply():
+    """Two ApusClient instances created back-to-back in one thread must
+    not share a clt_id: the server dedup caches (clt_id, req_id)
+    replies, so a shared id makes the second client's early req_ids
+    return the FIRST client's cached replies — acked but never applied.
+    Regression found by the proc fault campaign (fuzz.py --proc)."""
+    with LocalCluster(3) as cluster:
+        cluster.wait_for_leader()
+        with ApusClient(cluster.spec.peers) as c:
+            assert c.put(b"first", b"1") == b"OK"
+        with ApusClient(cluster.spec.peers) as c:
+            assert c.put(b"second", b"2") == b"OK"
+            assert c.get(b"second") == b"2", \
+                "second client's write was swallowed by dedup"
+            assert c.get(b"first") == b"1"
