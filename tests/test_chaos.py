@@ -160,3 +160,13 @@ def test_devplane_fuzz_slice():
     and every acked write survives with consistent logs."""
     fuzz = _load_fuzz()
     assert fuzz.run_devplane_schedule(1, 20_000, True) == "ok"
+
+
+def test_proc_fuzz_slice():
+    """A slice of the process-per-replica fault campaign (benchmarks/
+    fuzz.py --proc; full runs are clean) as a CI canary: real daemon
+    processes at the production envelope, kills/restarts between write
+    bursts, every acked write durable.  This campaign's first full run
+    caught the sequential-client clt_id dedup collision."""
+    fuzz = _load_fuzz()
+    assert fuzz.run_proc_schedule(0, 20_000) == "ok"
