@@ -84,26 +84,81 @@ class RelayStateMachine(StateMachine):
     vestigial under APUS, dare_server.c:265-274).  Applied records are
     retained so snapshots can rebuild a joiner's app by re-replay — the
     reference's snapshot likewise *is* the proxy's durable record dump
-    (proxy.c:300, stablestorage_dump_records)."""
+    (proxy.c:300, stablestorage_dump_records), which lives in
+    BerkeleyDB ON DISK (db-interface.c:21-51), not RAM.  With
+    ``spill_path`` this SM keeps the dump on disk the same way
+    (append-only, length-framed; a 20-minute endurance soak grew
+    daemon RSS without bound before this); the in-memory list remains
+    for pathless in-process clusters (tests)."""
 
-    def __init__(self) -> None:
+    def __init__(self, spill_path=None) -> None:
         self.records: list[bytes] = []
+        self.record_count = 0
+        self.record_bytes = 0
+        if spill_path:
+            os.makedirs(os.path.dirname(spill_path) or ".",
+                        exist_ok=True)
+            # wb+: recovery replays committed history back through
+            # apply(), so a restart starts the dump clean.
+            self._f = open(spill_path, "wb+")
+        else:
+            self._f = None
 
     def apply(self, idx: int, cmd: bytes) -> bytes:
-        self.records.append(cmd)
+        if self._f is not None:
+            self._f.write(struct.pack("<I", len(cmd)) + cmd)
+        else:
+            self.records.append(cmd)
+        self.record_count += 1
+        self.record_bytes += len(cmd)
         return b"OK"
 
+    def iter_records(self) -> list[bytes]:
+        """The full record dump, mode-independent — what the Bridge's
+        snapshot prime, dirty-app reprime, and deep-NACK fallback
+        consume (the dump_records analog, db-interface.c:98-128).  In
+        spill mode this reads the file (those paths are rare and
+        already O(history))."""
+        if self._f is None:
+            return list(self.records)
+        self._f.flush()
+        self._f.seek(0)
+        blob = self._f.read()
+        out: list[bytes] = []
+        off = 0
+        while off + 4 <= len(blob):
+            (n,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            out.append(blob[off:off + n])
+            off += n
+        return out
+
     def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
-        blob = b"".join(struct.pack("<I", len(r)) + r for r in self.records)
+        if self._f is not None:
+            self._f.flush()
+            self._f.seek(0)
+            blob = self._f.read()
+        else:
+            blob = b"".join(struct.pack("<I", len(r)) + r
+                            for r in self.records)
         return Snapshot(last_idx, last_term, blob)
 
     def apply_snapshot(self, snap: Snapshot) -> None:
         self.records = []
+        self.record_count = 0
+        self.record_bytes = 0
+        if self._f is not None:
+            self._f.seek(0)
+            self._f.truncate()
+            self._f.write(snap.data)
         off = 0
         while off < len(snap.data):
             (n,) = struct.unpack_from("<I", snap.data, off)
             off += 4
-            self.records.append(snap.data[off:off + n])
+            if self._f is None:
+                self.records.append(snap.data[off:off + n])
+            self.record_count += 1
+            self.record_bytes += n
             off += n
 
 
@@ -399,9 +454,12 @@ class Bridge:
         # (clt_id, req_id) of every record already routed to the local
         # app this incarnation (released or replayed): snapshot replay
         # must skip these or a live replica that falls behind the pruned
-        # head would re-execute its whole history (records are retained
-        # forever in the relay SM anyway, so the set adds O(1)/record).
-        self._routed: set[tuple[int, int]] = set()
+        # head would re-execute its whole history.  Per-clt rids route
+        # in MONOTONE order (the proxy's cur_rec fetch-add, in capture
+        # order; aborted rids never commit at all), so a per-clt
+        # frontier is exact — and O(#replicas) RAM instead of
+        # O(history) (a 20-minute soak grew the old set without bound).
+        self._routed_hi: dict[int, int] = {}
         # rid -> encoded record for OWN routed records, so _handle_nack
         # resolves a range in O(range) instead of scanning the whole
         # never-pruned relay history under the daemon lock (the values
@@ -561,7 +619,7 @@ class Bridge:
         (the abort sweep raced a commit the new leader preserved) must
         be replayed into our own app like a foreign record, or this
         app alone would miss a write every other replica applies.
-        Already-committed members replay now — marked in ``_routed``
+        Already-committed members replay now — marked in the routed frontier
         under the daemon lock so a racing ``_on_commit`` upcall can't
         replay them a second time; future ones at their _on_commit (the
         range is remembered)."""
@@ -569,8 +627,8 @@ class Bridge:
         with self.daemon.lock:
             self._nacked.append((lo, hi))
             # Replay only records whose commit upcall ALREADY ran
-            # (rid in _own_routed implies key in _routed — it saw no
-            # NACK then); ones still in the upcall queue will see the
+            # (rid in _own_routed implies the frontier passed it — it
+            # saw no NACK then); ones still in the upcall queue see the
             # range at _on_commit.  O(range) via the rid index; ranges
             # reaching below the index window scan the full history.
             if lo > self._own_routed_floor:
@@ -579,13 +637,13 @@ class Bridge:
                               if rid in self._own_routed]
             else:
                 candidates = []
-                for rec in getattr(self.daemon.node.sm, "records", []):
+                for rec in self._sm_records():
                     try:
                         _, _, _, clt, rid = decode_record(rec)
                     except Exception:                    # noqa: BLE001
                         continue
                     if clt == self.clt_id and lo <= rid <= hi \
-                            and (clt, rid) in self._routed:
+                            and self._routed_hi.get(clt, 0) >= rid:
                         candidates.append((rid, rec))
             for rid, rec in candidates:
                 key = (self.clt_id, rid)
@@ -683,7 +741,7 @@ class Bridge:
         itself when the capture was released) — the same skip set the
         snapshot prime uses (_on_snapshot)."""
         with self.daemon.lock:
-            records = list(getattr(self.daemon.node.sm, "records", []))
+            records = self._sm_records()
             self.daemon.node.stats["replay_reprimes"] = \
                 self.daemon.node.stats.get("replay_reprimes", 0) + 1
         out: list[tuple[int, int, bytes]] = []
@@ -700,6 +758,15 @@ class Bridge:
         return out
 
     # -- commit upcall ----------------------------------------------------
+
+    def _sm_records(self) -> list[bytes]:
+        """Full record dump from the relay SM, spill-mode aware
+        (iter_records); empty for non-relay SMs."""
+        sm = self.daemon.node.sm
+        it = getattr(sm, "iter_records", None)
+        if it is not None:
+            return it()
+        return list(getattr(sm, "records", []))
 
     def _index_own(self, rid: int, rec: bytes) -> None:
         """Index an own routed record for O(range) NACK resolution
@@ -719,9 +786,9 @@ class Bridge:
         incarnation captured live (req_id >= the boot base — the app
         executed the bytes itself when the capture was released), and
         non-bridge payloads (KVS client commands have no app to replay
-        into).  A fresh joiner's empty _routed set means full replay,
+        into).  A fresh joiner's empty routed frontier means full replay,
         matching the reference's proxy_apply_db_snapshot (proxy.c:306)."""
-        records = getattr(self.daemon.node.sm, "records", [])
+        records = self._sm_records()
         for rec in records:
             try:
                 action, conn_id, data, clt, rid = decode_record(rec)
@@ -729,10 +796,9 @@ class Bridge:
                 continue
             if not is_bridge_clt(clt):
                 continue
-            key = (clt, rid)
-            if key in self._routed:
+            if self._routed_hi.get(clt, 0) >= rid:
                 continue
-            self._routed.add(key)
+            self._routed_hi[clt] = rid
             if clt == self.clt_id:
                 self._index_own(rid, rec)
             if clt == self.clt_id and rid >= self._boot_base \
@@ -754,9 +820,9 @@ class Bridge:
         if e.type != EntryType.CSM or not is_bridge_clt(e.clt_id):
             return
         key = (e.clt_id, e.req_id)
-        if key in self._routed:
+        if self._routed_hi.get(e.clt_id, 0) >= e.req_id:
             return                    # already primed via snapshot replay
-        self._routed.add(key)
+        self._routed_hi[e.clt_id] = e.req_id
         if e.clt_id == self.clt_id:
             self._index_own(e.req_id, e.data)
             if self._is_nacked(e.req_id) and key not in self._nack_replayed:

@@ -105,6 +105,10 @@ def make_client_ops(daemon) -> dict:
                 "group_size": n.cid.size,
                 "members": [i for i in range(n.cid.extended_group_size)
                             if n.cid.contains(i)],
+                # Relay-SM record dump size (leak/ops gauge; the soak
+                # watches it) — absent for non-relay SMs.
+                "sm_records": getattr(n.sm, "record_count", None),
+                "sm_record_bytes": getattr(n.sm, "record_bytes", None),
             }
         return wire.u8(wire.ST_OK) + wire.blob(json.dumps(st).encode())
 
@@ -161,7 +165,7 @@ class ApusClient:
         self.peers = [self._parse(p) for p in peers]
         self.clt_id = clt_id if clt_id is not None else (
             (os.getpid() << 20) ^ threading.get_ident()
-            ^ (secrets.randbits(40) << 23)) & ((1 << 63) - 1)
+            ^ secrets.randbits(63)) & ((1 << 63) - 1)
         self.timeout = timeout
         self._req_seq = 0
         self._leader: Optional[int] = None

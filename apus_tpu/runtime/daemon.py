@@ -407,14 +407,19 @@ def main(argv: Optional[list] = None) -> int:
     args = ap.parse_args(argv)
 
     spec = load_config(args.config)
-    sm = None
     bridged = args.workdir is not None
-    if bridged:
+    if bridged and args.app and args.app_port is None:
+        from apus_tpu.runtime.appcluster import free_port
+        args.app_port = free_port()
+
+    def make_sm(replica_idx):
+        """Relay SM with a PER-REPLICA on-disk record dump (several
+        daemons on one host share --workdir, like proxy{idx}.log)."""
+        if not bridged:
+            return None
         from apus_tpu.runtime.bridge import RelayStateMachine
-        sm = RelayStateMachine()
-        if args.app and args.app_port is None:
-            from apus_tpu.runtime.appcluster import free_port
-            args.app_port = free_port()
+        return RelayStateMachine(spill_path=os.path.join(
+            args.workdir, f"records{replica_idx}.bin"))
 
     if args.join:
         import socket as _socket
@@ -437,12 +442,12 @@ def main(argv: Optional[list] = None) -> int:
         while len(spec.peers) <= slot:
             spec.peers.append("")
         spec.peers[slot] = my_addr
-        daemon = ReplicaDaemon(slot, spec, sm=sm, cid=cid,
+        daemon = ReplicaDaemon(slot, spec, sm=make_sm(slot), cid=cid,
                                listen_sock=sock, recovery_start=True,
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir)
     else:
-        daemon = ReplicaDaemon(args.idx, spec, sm=sm,
+        daemon = ReplicaDaemon(args.idx, spec, sm=make_sm(args.idx),
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir,
                                recovery_start=bool(

@@ -296,6 +296,32 @@ class ProcCluster:
         assert self.app_ports[idx] is not None
         return ("127.0.0.1", self.app_ports[idx])
 
+    def wait_converged(self, timeout: float = 30.0,
+                       idxs: Optional[list[int]] = None) -> None:
+        """Block until every live replica's apply has reached the
+        leader's commit (and something real committed).  The one wire-
+        visible convergence criterion, shared by tests and fault
+        campaigns instead of each hand-rolling the status poll."""
+        want = idxs if idxs is not None else [
+            i for i in range(len(self.spec.peers))
+            if self.procs[i] is not None]
+        deadline = time.monotonic() + timeout
+        sts: list = []
+        while time.monotonic() < deadline:
+            sts = [self.status(i) for i in want]
+            try:
+                # Short leader probe, retried by THIS loop: an election
+                # in flight is a transient, not a convergence failure.
+                lead = self.status(self.leader_idx(timeout=1.0))
+            except AssertionError:
+                lead = None
+            if all(s is not None for s in sts) and lead is not None \
+                    and all(s["apply"] >= lead["commit"] > 1
+                            for s in sts):
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"replicas did not converge: {sts}")
+
     def measure_failover(self, timeout: float = 15.0) -> float:
         """Kill the current leader and return seconds until a NEW leader
         is elected and answering status (reconf_bench.sh leader-failure

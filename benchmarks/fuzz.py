@@ -122,7 +122,6 @@ def run_devplane_schedule(trial: int, seed_base: int,
     acked write, and mutually consistent logs.  With ``force_async``
     the driver keeps deep windows in flight (the accelerator path),
     so kills land while windows are outstanding."""
-    import random
     import time as _time
 
     from apus_tpu.models.kvs import encode_get, encode_put
@@ -171,7 +170,6 @@ def run_proc_schedule(trial: int, seed_base: int) -> str:
     process groups) and restarts (durable-store replay + catch-up, or
     rejoin after auto-removal); at the end every acked write must be
     readable and all replicas converge."""
-    import random
     import tempfile
     import time as _time
 
@@ -205,19 +203,9 @@ def run_proc_schedule(trial: int, seed_base: int) -> str:
             for i in range(3):
                 if pc.procs[i] is None:
                     pc.restart(i)
-            # Convergence: every process's status reaches the leader's
-            # commit, and every acked write reads back.
-            deadline = _time.monotonic() + 30
-            while _time.monotonic() < deadline:
-                sts = [pc.status(i) for i in range(3)]
-                lead = pc.status(pc.leader_idx())
-                if all(s is not None for s in sts) and lead is not None \
-                        and all(s["apply"] >= lead["commit"] > 1
-                                for s in sts):
-                    break
-                _time.sleep(0.05)
-            else:
-                raise AssertionError(f"no convergence: {sts}")
+            # Convergence (shared wire-visible criterion), then every
+            # acked write reads back.
+            pc.wait_converged(timeout=30.0)
             with ApusClient(list(pc.spec.peers)) as c:
                 for k, v in acked.items():
                     got = c.get(k)
@@ -261,19 +249,22 @@ def main() -> int:
     # Percentage (new metric NAME so historical count-valued records
     # never average into the same row), over the trials that could
     # have been clean: expected stalls (quorum-floor schedules under
-    # --auto-remove, documented non-failures) don't depress it.
-    eligible = max(1, args.trials - stalls)
+    # --auto-remove, documented non-failures) don't depress it, and a
+    # run that was ALL expected stalls is vacuously 100% clean.
+    eligible = args.trials - stalls
+    pct = 100.0 if eligible <= 0 else round(100.0 * ok / eligible, 1)
     print(json.dumps({
         "metric": ("devplane_fuzz_clean_pct" if args.device_plane
                    else "proc_fuzz_clean_pct" if args.proc
                    else "protocol_fuzz_clean_pct"),
-        "value": round(100.0 * ok / eligible, 1),
+        "value": pct,
         "unit": "% clean",
         "detail": {"clean": ok, "trials": args.trials,
                    "expected_stalls": stalls, "failures": failures,
                    "auto_remove": args.auto_remove,
                    "seed_base": args.seed_base,
-                   "device_plane": args.device_plane},
+                   "device_plane": args.device_plane,
+                   "proc": args.proc},
     }))
     return 1 if failures else 0
 

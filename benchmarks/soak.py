@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Endurance soak: sustained replicated traffic for N minutes.
+
+Neither the reference nor its eval harness has an endurance story —
+runs last seconds.  This drives a process-per-replica cluster (real
+redis under the interposer by default) with continuous SET/GET traffic
+for ``--minutes``, injecting a leader kill every ``--failover-every``
+seconds, and reports: sustained ops, error count, failovers survived,
+per-daemon peak RSS (leak watch, read from /proc), and final
+GET-after-SET convergence on every replica.
+
+Output: one JSON line (eval/eval.py-compatible record shape).
+
+Usage: [cpu-env] python benchmarks/soak.py [--minutes 10]
+           [--replicas 3] [--toyserver] [--failover-every 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rss_kb(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--toyserver", action="store_true",
+                    help="drive the native toyserver instead of the "
+                         "pinned real redis")
+    ap.add_argument("--failover-every", type=float, default=120.0,
+                    help="kill the leader every N seconds (0 = never)")
+    args = ap.parse_args()
+
+    from apus_tpu.runtime.appcluster import RespClient, LineClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    if args.toyserver:
+        app_argv = "toyserver"
+        mk = lambda addr: LineClient(addr, timeout=15.0)  # noqa: E731
+        do_set = lambda c, k, v: c.cmd(f"SET {k} {v}") == "OK"  # noqa: E731
+        do_get = lambda c, k: (  # noqa: E731
+            lambda v: None if v == "NIL" else v)(c.cmd(f"GET {k}"))
+    else:
+        from apus_tpu.runtime.appcluster import REDIS_RUN, build_redis
+        if not build_redis():
+            print("pinned redis unavailable", file=sys.stderr)
+            return 2
+        app_argv = [REDIS_RUN]
+        mk = lambda addr: RespClient(addr, timeout=15.0)  # noqa: E731
+        do_set = lambda c, k, v: c.cmd("SET", k, v) == "OK"  # noqa: E731
+        do_get = lambda c, k: c.cmd("GET", k)  # noqa: E731
+
+    t_end = time.monotonic() + args.minutes * 60
+    next_failover = (time.monotonic() + args.failover_every
+                     if args.failover_every > 0 else float("inf"))
+    ops = errors = failovers = reconnects = 0
+    failover_ms: list[float] = []
+    peak_rss: dict[int, int] = {}
+    seq = 0
+    last_acked: str | None = None
+
+    with ProcCluster(args.replicas, app_argv=app_argv) as pc:
+        leader = pc.leader_idx()
+        client = mk(pc.app_addr(leader))
+        t0 = time.monotonic()
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now >= next_failover:
+                # Keep quorum: only kill when every replica is up.
+                if all(p is not None for p in pc.procs):
+                    try:
+                        client.close()
+                    except Exception:    # noqa: BLE001
+                        pass
+                    t = pc.measure_failover()
+                    failover_ms.append(t * 1e3)
+                    failovers += 1
+                    # Revive the victim so the NEXT failover stays safe.
+                    dead = next(i for i in range(args.replicas)
+                                if pc.procs[i] is None)
+                    pc.restart(dead)
+                    leader = pc.leader_idx()
+                    client = mk(pc.app_addr(leader))
+                next_failover = now + args.failover_every
+            k = f"soak:{seq}"
+            seq += 1
+            try:
+                if not do_set(client, k, "v" * 32):
+                    errors += 1
+                elif do_get(client, k) is None:
+                    errors += 1
+                else:
+                    ops += 2
+                    last_acked = k
+            except (OSError, ConnectionError, RuntimeError):
+                # Reconnect (leadership may have moved under us).
+                reconnects += 1
+                try:
+                    client.close()
+                except Exception:        # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+                try:
+                    leader = pc.leader_idx()
+                    client = mk(pc.app_addr(leader))
+                except Exception:        # noqa: BLE001
+                    time.sleep(0.5)
+            if seq % 200 == 0:
+                for i, p in enumerate(pc.procs):
+                    if p is not None:
+                        peak_rss[i] = max(peak_rss.get(i, 0),
+                                          _rss_kb(p.pid))
+        wall = time.monotonic() - t0
+        client.close()
+        # Final convergence on every replica's app — of the last key
+        # that was actually ACKED (the last attempted one may have
+        # died with a connection mid-reconnect).
+        want = last_acked or "soak:none"
+        converged = last_acked is not None
+        for i in range(args.replicas):
+            if pc.procs[i] is None:
+                continue
+            ok = False
+            deadline = time.monotonic() + 30      # per replica
+            while True:
+                try:
+                    with mk(pc.app_addr(i)) as c:
+                        if do_get(c, want):
+                            ok = True
+                            break
+                except (OSError, ConnectionError, RuntimeError):
+                    pass
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.5)
+            converged = converged and ok
+
+    print(json.dumps({
+        "metric": "soak_sustained_ops_per_sec",
+        "value": round(ops / max(wall, 1e-9), 1),
+        "unit": "ops/sec",
+        "detail": {
+            "minutes": round(wall / 60, 2),
+            "ops": ops, "errors": errors, "reconnects": reconnects,
+            "failovers": failovers,
+            "failover_ms": [round(v, 1) for v in failover_ms],
+            "peak_rss_kb": peak_rss,
+            "converged": converged,
+            "app": "toyserver" if args.toyserver else "redis",
+            "replicas": args.replicas,
+        },
+    }))
+    return 0 if converged and not errors else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

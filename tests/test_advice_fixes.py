@@ -326,13 +326,22 @@ def test_abort_release_and_nack_replay_semantics():
         bridge._on_commit(own_entry(base + 5, b"nack-then-commit"))
         assert wait_key("nack-then-commit") == "v"
         # (c) commit then NACK: the range scan replays it (the record
-        # is in the relay SM by apply time).
-        e2 = own_entry(base + 7, b"commit-then-nack")
+        # is in the relay SM by apply time).  The synthetic rid must
+        # sit ABOVE the live routed frontier: wait_key's polls are
+        # themselves proxied records, and per-clt rids arrive in
+        # monotone order in production (the invariant _handle_nack's
+        # lossless pruning documents) — a stale synthetic rid would be
+        # (correctly) treated as already routed.
+        rid_c = max(base + 7,
+                    bridge._routed_hi.get(bridge.clt_id, 0) + 2)
+        with bridge._shm_lock:
+            bridge._shm_set(_OFF_CUR_REC, rid_c + 1)
+        e2 = own_entry(rid_c, b"commit-then-nack")
         daemon = pc.cluster.daemons[leader]
         with daemon.lock:
             daemon.node.sm.records.append(e2.data)
         bridge._on_commit(e2)                      # not nacked yet
-        bridge._handle_nack(base + 7, base + 7)
+        bridge._handle_nack(rid_c, rid_c)
         assert wait_key("commit-then-nack") == "v"
         # Un-nacked committed own records are NOT replayed (the app
         # executed them itself at capture).

@@ -73,8 +73,10 @@ def test_device_plane_survives_failover():
               msg="new leader")
         for i in range(20):
             c.submit(encode_put(b"after%d" % i, b"x"))
-        assert c.device_runner.stats["resets"] > resets_before, \
-            "device plane did not re-base under the new leader"
+        # The driver thread re-bases asynchronously — under CI load it
+        # can lag the submits by a beat.
+        _wait(lambda: c.device_runner.stats["resets"] > resets_before,
+              msg="device plane re-basing under the new leader")
         new = c.leader()
         _wait(lambda: new.node.external_commit or not new.is_leader,
               msg="device plane re-owning commit after failover")

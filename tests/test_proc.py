@@ -35,17 +35,8 @@ def test_proc_cluster_write_failover_write(bare):
         assert c.put(b"k1", b"v1") == b"OK"
         assert c.get(b"k1") == b"v1"
 
-    # All replica processes converge (commit/apply equal across the
-    # wire-visible statuses).
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        sts = [pc.status(i) for i in range(3)]
-        if all(s is not None for s in sts) and \
-                len({(s["commit"], s["apply"]) for s in sts}) == 1:
-            break
-        time.sleep(0.05)
-    else:
-        raise AssertionError(f"replicas did not converge: {sts}")
+    # All replica processes converge (wire-visible statuses).
+    pc.wait_converged(timeout=10.0)
 
     # Kill the leader process group; at the production envelope the
     # new leader appears in tens of ms (assert a generous CI bound but
@@ -112,15 +103,7 @@ def test_proc_cluster_restart_recovers(bare):
     with ApusClient(list(pc.spec.peers)) as c:
         assert c.put(b"while-down", b"x") == b"OK"
     pc.restart(victim)
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        st = pc.status(victim)
-        lead_st = pc.status(pc.leader_idx())
-        if st and lead_st and st["apply"] >= lead_st["commit"] > 1:
-            break
-        time.sleep(0.05)
-    else:
-        raise AssertionError(f"restarted replica did not catch up: {st}")
+    pc.wait_converged(timeout=15.0, idxs=[victim])
 
 
 def test_slow_starting_member_not_auto_removed(tmp_path):
