@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import time
 from typing import Optional
 
 from apus_tpu.core.cid import Cid, CidState
@@ -916,13 +917,26 @@ class Node:
                 batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
             if not batch and self._commit_sent.get(peer, 0) >= self.log.commit:
                 continue   # nothing new and remote commit is current
-            res = self.t.log_write(peer, my, batch, self.log.commit)
+            res, acked_end = self.t.log_write(peer, my, batch,
+                                              self.log.commit)
             if res == WriteResult.OK:
                 if batch:
                     self._next_idx[peer] = batch[-1].idx + 1
                     self.stats["entries_replicated"] += len(batch)
                 self._commit_sent[peer] = self.log.commit
                 self._fail_count[peer] = 0
+                if acked_end is not None:
+                    # Synchronous ack (DCN transport): the reply carried
+                    # the peer's authoritative post-write log end, so
+                    # _advance_commit sees it THIS tick instead of after
+                    # a follower REP_ACK tick + our next tick (~2 tick
+                    # periods of commit latency at the production
+                    # envelope).  Plain overwrite, not max: after a
+                    # peer restart the smaller fresh end must land or
+                    # the stale-match watchdog never fires.
+                    self.regions.ctrl[Region.REP_ACK][peer] = acked_end
+                    self.regions.touch(Region.REP_ACK, peer,
+                                       time.monotonic())
             elif res == WriteResult.FENCED:
                 self._adjusted[peer] = False   # lost access: re-adjust later
             else:

@@ -189,7 +189,9 @@ class PeerServer:
             commit = r.u64()
             entries = wire.decode_entries(r)
             res = onesided.apply_log_write(node, writer, entries, commit)
-            return wire.u8(_ST_OF_RESULT[res])
+            # Reply carries our log end post-apply (read under the same
+            # lock): the writer's synchronous ack.
+            return wire.u8(_ST_OF_RESULT[res]) + wire.u64(node.log.end)
         if op == wire.OP_LOG_READ_STATE:
             state = onesided.apply_log_read_state(node)
             return wire.u8(wire.ST_OK) + wire.encode_log_state(state)
@@ -399,13 +401,21 @@ class NetTransport(Transport):
         return wire.decode_value(wire.Reader(resp[1:]))
 
     def log_write(self, target: int, writer_sid: Sid,
-                  entries: list[LogEntry], commit: int) -> WriteResult:
+                  entries: list[LogEntry], commit: int):
         payload = (wire.u8(wire.OP_LOG_WRITE) + wire.u64(writer_sid.word)
                    + wire.u64(commit) + wire.encode_entries(entries))
         resp = self._roundtrip(target, payload)
         if resp is None:
-            return WriteResult.DROPPED
-        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+            return WriteResult.DROPPED, None
+        res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+        # The reply's trailing u64 is the target's log end AFTER the
+        # write (applied under the server lock before responding): the
+        # authoritative ack, one round trip earlier than waiting for
+        # the follower's next REP_ACK tick.
+        end = None
+        if res == WriteResult.OK and len(resp) >= 9:
+            end = wire.Reader(resp[1:9]).u64()
+        return res, end
 
     def log_read_state(self, target: int) -> Optional[LogState]:
         resp = self._roundtrip(target, wire.u8(wire.OP_LOG_READ_STATE))

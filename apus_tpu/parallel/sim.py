@@ -88,11 +88,15 @@ class SimTransport(Transport):
         return onesided.apply_ctrl_read(self.nodes[target], region, slot)
 
     def log_write(self, target: int, writer_sid: Sid,
-                  entries: list[LogEntry], commit: int) -> WriteResult:
+                  entries: list[LogEntry], commit: int):
         if not self._reachable(target):
-            return WriteResult.DROPPED
+            return WriteResult.DROPPED, None
+        # acked_end stays None: the sim models the one-sided RDMA shape,
+        # where a WRITE completion carries no remote-CPU acknowledgment
+        # — acks arrive via the follower's own REP_ACK path, keeping the
+        # simulator's protocol timing reference-faithful.
         return onesided.apply_log_write(self.nodes[target], writer_sid,
-                                        entries, commit)
+                                        entries, commit), None
 
     def log_read_state(self, target: int) -> Optional[LogState]:
         if not self._reachable(target):

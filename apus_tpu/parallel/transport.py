@@ -131,9 +131,18 @@ class Transport:
 
     # log data plane ------------------------------------------------------
     def log_write(self, target: int, writer_sid: Sid,
-                  entries: list[LogEntry], commit: int) -> WriteResult:
+                  entries: list[LogEntry],
+                  commit: int) -> "tuple[WriteResult, Optional[int]]":
         """Replicate ``entries`` into target's log and advance its commit
-        (update_remote_logs analog, dare_ibv_rc.c:1460-1826)."""
+        (update_remote_logs analog, dare_ibv_rc.c:1460-1826).  Returns
+        (result, acked_end): ``acked_end`` is the target's authoritative
+        log end AFTER the write when the transport's reply carries it
+        (the synchronous DCN request/response does — the handler applies
+        under the server lock before replying), or None for transports
+        with true one-sided completion semantics (the simulator models
+        the RDMA shape, where a WRITE completion says nothing about the
+        remote log and acks arrive via the follower's own REP_ACK
+        writes, rc_send_entries_reply dare_ibv_rc.c:1828-1863)."""
         raise NotImplementedError
 
     def log_read_state(self, target: int) -> Optional[LogState]:

@@ -55,7 +55,8 @@ class ProcCluster:
                  workdir: Optional[str] = None,
                  spec: Optional[ClusterSpec] = None,
                  db: bool = True,
-                 spin_timeout_ms: int = 8000):
+                 spin_timeout_ms: int = 8000,
+                 tick_interval: Optional[float] = None):
         self.n = n
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
         os.makedirs(self.workdir, exist_ok=True)
@@ -74,6 +75,7 @@ class ProcCluster:
         self._app_argv = (list(app_argv)
                           if app_argv is not None else None)
         self._spin_timeout_ms = spin_timeout_ms
+        self._tick_interval = tick_interval
         self._db = db
         self.app_ports: list[Optional[int]] = [
             _free_port() if app_argv is not None else None
@@ -136,6 +138,8 @@ class ProcCluster:
                 "--log-file", os.path.join(self.workdir, f"srv{tag}.log"),
                 "--ready-file", self._ready_path(i)]
         argv += ["--join"] if join else ["--idx", str(i)]
+        if self._tick_interval is not None:
+            argv += ["--tick-interval", str(self._tick_interval)]
         if self._db:
             argv += ["--db-dir", os.path.join(self.workdir, "db")]
         if self._app_argv is not None:

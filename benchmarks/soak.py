@@ -46,6 +46,8 @@ def main() -> int:
                          "pinned real redis")
     ap.add_argument("--failover-every", type=float, default=120.0,
                     help="kill the leader every N seconds (0 = never)")
+    ap.add_argument("--tick-interval", type=float, default=None,
+                    help="daemon tick interval override (seconds)")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -76,7 +78,8 @@ def main() -> int:
     seq = 0
     last_acked: str | None = None
 
-    with ProcCluster(args.replicas, app_argv=app_argv) as pc:
+    with ProcCluster(args.replicas, app_argv=app_argv,
+                     tick_interval=args.tick_interval) as pc:
         leader = pc.leader_idx()
         client = mk(pc.app_addr(leader))
         t0 = time.monotonic()

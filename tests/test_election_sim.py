@@ -117,7 +117,7 @@ def test_deposed_leader_writes_are_fenced():
     from apus_tpu.parallel.transport import WriteResult
     stale_sid = leader.sid.sid
     if stale_sid.leader:   # still thinks it leads
-        res = c.transport.log_write(follower, stale_sid, [], 0)
+        res, _ = c.transport.log_write(follower, stale_sid, [], 0)
         assert res == WriteResult.FENCED
     c.run(2.0)
     c.check_logs_consistent()

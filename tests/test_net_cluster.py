@@ -117,3 +117,41 @@ def test_peer_server_survives_malicious_frames():
         c.submit(encode_put(b"after", b"2"))
         assert wait(lambda: all(
             d.node.sm.store.get(b"after") == b"2" for d in c.live()))
+
+
+def test_log_write_reply_carries_synchronous_ack():
+    """The DCN log_write reply returns the target's authoritative log
+    end post-apply (the synchronous ack the leader folds into its
+    REP_ACK mirror the same tick).  Exercised against a LIVE follower's
+    PeerServer: the parsed end must equal the follower's real log.end
+    both for an effective write and for an idempotent no-op re-write —
+    a framing regression here would feed garbage into the leader's
+    quorum math while the (deliberately ack-less) simulator stays
+    green."""
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        _, pr = c.submit(encode_put(b"sa", b"1"))
+        follower = next(d for d in c.live() if d.idx != leader.idx)
+        wait(lambda: follower.node.log.end > pr.idx)
+        with leader.lock:
+            my = leader.node.sid.sid
+            t = leader.node.t
+            res, end = t.log_write(follower.idx, my, [],
+                                   leader.node.log.commit)
+        assert res.name == "OK"
+        with follower.lock:
+            real_end = follower.node.log.end
+        assert end == real_end, (end, real_end)
+        # Idempotent re-write of an existing entry: end unchanged,
+        # still reported truthfully.
+        with follower.lock:
+            existing = follower.node.log.get(pr.idx)
+        with leader.lock:
+            res, end2 = t.log_write(follower.idx, my, [existing],
+                                    leader.node.log.commit)
+        assert res.name == "OK" and end2 == real_end
+        # The leader's REP_ACK mirror reflects the synchronous ack.
+        with leader.lock:
+            from apus_tpu.parallel.transport import Region
+            assert leader.node.regions.ctrl[Region.REP_ACK][
+                follower.idx] is not None
