@@ -406,8 +406,11 @@ def main(argv: Optional[list] = None) -> int:
                     help="write a JSON readiness record here once serving")
     args = ap.parse_args(argv)
 
-    spec = load_config(args.config)
     bridged = args.workdir is not None
+    if args.app and not bridged:
+        ap.error("--app requires --workdir (the bridge's unix socket, "
+                 "shm block, and record dump live there)")
+    spec = load_config(args.config)
     if bridged and args.app and args.app_port is None:
         from apus_tpu.runtime.appcluster import free_port
         args.app_port = free_port()

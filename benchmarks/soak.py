@@ -72,10 +72,11 @@ def main() -> int:
     t_end = time.monotonic() + args.minutes * 60
     next_failover = (time.monotonic() + args.failover_every
                      if args.failover_every > 0 else float("inf"))
-    ops = errors = failovers = reconnects = 0
+    ops = errors = failovers = reconnects = misdirected = 0
     failover_ms: list[float] = []
     peak_rss: dict[int, int] = {}
     seq = 0
+    ops_at_check = 0
     last_acked: str | None = None
 
     with ProcCluster(args.replicas, app_argv=app_argv,
@@ -130,6 +131,35 @@ def main() -> int:
                     if p is not None:
                         peak_rss[i] = max(peak_rss.get(i, 0),
                                           _rss_kb(p.pid))
+                # LEADER-AFFINITY CHECK: a follower's app serves
+                # clients at raw speed with capture disabled (writes
+                # execute locally, unreplicated — the reference shares
+                # this property: clients must locate the leader,
+                # run.sh FindLeader).  If leadership moved under our
+                # live connection, every op since is NOT a replicated
+                # op: reattach and count the incident so the measured
+                # ops/sec is honestly the replicated path.
+                try:
+                    real = pc.leader_idx(timeout=2.0)
+                except AssertionError:
+                    real = None
+                if real is not None and real != leader:
+                    misdirected += 1
+                    # Retract the ops counted since the last clean
+                    # check: they ran against a follower's raw app and
+                    # never went through the log.
+                    ops = ops_at_check
+                    try:
+                        client.close()
+                    except Exception:    # noqa: BLE001
+                        pass
+                    leader = real
+                    try:
+                        client = mk(pc.app_addr(leader))
+                    except OSError:
+                        time.sleep(0.2)   # next iteration's guarded
+                        continue          # error path recovers
+                ops_at_check = ops
         wall = time.monotonic() - t0
         client.close()
         # Final convergence on every replica's app — of the last key
@@ -162,6 +192,7 @@ def main() -> int:
         "detail": {
             "minutes": round(wall / 60, 2),
             "ops": ops, "errors": errors, "reconnects": reconnects,
+            "misdirected": misdirected,
             "failovers": failovers,
             "failover_ms": [round(v, 1) for v in failover_ms],
             "peak_rss_kb": peak_rss,
