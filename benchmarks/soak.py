@@ -48,6 +48,10 @@ def main() -> int:
                     help="kill the leader every N seconds (0 = never)")
     ap.add_argument("--tick-interval", type=float, default=None,
                     help="daemon tick interval override (seconds)")
+    ap.add_argument("--converge-timeout", type=float, default=120.0,
+                    help="final per-replica convergence wait (a replica "
+                         "revived late in a long run replays its whole "
+                         "durable store first)")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -78,11 +82,40 @@ def main() -> int:
     seq = 0
     ops_at_check = 0
     last_acked: str | None = None
+    acked_at_check: str | None = None
 
     with ProcCluster(args.replicas, app_argv=app_argv,
                      tick_interval=args.tick_interval) as pc:
         leader = pc.leader_idx()
         client = mk(pc.app_addr(leader))
+
+        def affinity_check():
+            """Confirm the live connection still points at the leader;
+            on a detected move, retract every op (and the acked-key
+            checkpoint) since the last POSITIVE confirmation and close
+            the client so the next op routes through the guarded
+            reconnect path.  Inconclusive probes (election in flight)
+            bless nothing."""
+            nonlocal ops, last_acked, ops_at_check, acked_at_check
+            nonlocal misdirected, leader, client
+            try:
+                real = pc.leader_idx(timeout=2.0)
+            except AssertionError:
+                return leader, client          # inconclusive
+            if real == leader:
+                ops_at_check = ops
+                acked_at_check = last_acked
+            else:
+                misdirected += 1
+                ops = ops_at_check
+                last_acked = acked_at_check
+                leader = real
+                try:
+                    client.close()
+                except Exception:            # noqa: BLE001
+                    pass
+            return leader, client
+
         t0 = time.monotonic()
         while time.monotonic() < t_end:
             now = time.monotonic()
@@ -137,29 +170,11 @@ def main() -> int:
                 # this property: clients must locate the leader,
                 # run.sh FindLeader).  If leadership moved under our
                 # live connection, every op since is NOT a replicated
-                # op: reattach and count the incident so the measured
-                # ops/sec is honestly the replicated path.
-                try:
-                    real = pc.leader_idx(timeout=2.0)
-                except AssertionError:
-                    real = None
-                if real is not None and real != leader:
-                    misdirected += 1
-                    # Retract the ops counted since the last clean
-                    # check: they ran against a follower's raw app and
-                    # never went through the log.
-                    ops = ops_at_check
-                    try:
-                        client.close()
-                    except Exception:    # noqa: BLE001
-                        pass
-                    leader = real
-                    try:
-                        client = mk(pc.app_addr(leader))
-                    except OSError:
-                        time.sleep(0.2)   # next iteration's guarded
-                        continue          # error path recovers
-                ops_at_check = ops
+                # op: retract them and reattach.
+                leader, client = affinity_check()
+        # One final check covers the tail window (ops since the last
+        # multiple-of-200 checkpoint are unverified otherwise).
+        affinity_check()
         wall = time.monotonic() - t0
         client.close()
         # Final convergence on every replica's app — of the last key
@@ -171,7 +186,7 @@ def main() -> int:
             if pc.procs[i] is None:
                 continue
             ok = False
-            deadline = time.monotonic() + 30      # per replica
+            deadline = time.monotonic() + args.converge_timeout
             while True:
                 try:
                     with mk(pc.app_addr(i)) as c:
