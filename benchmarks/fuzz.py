@@ -216,11 +216,45 @@ def run_proc_schedule(trial: int, seed_base: int) -> str:
     return "ok"
 
 
+def _devplane_trial_subprocess(trial: int, seed_base: int,
+                               timeout_s: float = 900.0) -> str:
+    """Run one device-plane schedule in a CHILD process.  Each trial
+    builds its own DeviceCommitRunner (compiled programs + HBM-shaped
+    log shards); tens of them accumulating in ONE interpreter starve
+    late trials into spurious catch-up stalls (~2% of long campaigns,
+    never reproducible in isolation).  A fresh process per trial keeps
+    every schedule honest; the persistent JAX compile cache keeps the
+    per-child cost to a few seconds."""
+    import subprocess
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--one-devplane-trial", str(trial),
+            "--seed-base", str(seed_base)]
+    try:
+        proc = subprocess.run(argv, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise AssertionError(f"trial subprocess timed out ({timeout_s}s)")
+    # Sentinel-prefixed verdict (robust to stray library output on
+    # stdout); only "ok" is a legitimate devplane verdict.
+    verdict = ""
+    for line in proc.stdout.decode(errors="replace").splitlines():
+        if line.startswith("APUS_FUZZ_VERDICT: "):
+            verdict = line.split(": ", 1)[1].strip()
+    if proc.returncode != 0 or verdict != "ok":
+        tail = proc.stderr.decode(errors="replace")[-600:]
+        raise AssertionError(
+            f"trial subprocess rc={proc.returncode} "
+            f"verdict={verdict!r} stderr tail: {tail}")
+    return verdict
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=50)
     ap.add_argument("--seed-base", type=int, default=20_000)
     ap.add_argument("--auto-remove", action="store_true")
+    ap.add_argument("--one-devplane-trial", type=int, default=None,
+                    help=argparse.SUPPRESS)   # child-process entry
     ap.add_argument("--device-plane", action="store_true",
                     help="randomized fault schedules against the LIVE "
                          "device plane (LocalCluster, jitted commits, "
@@ -232,12 +266,17 @@ def main() -> int:
                          "production envelope (kills, restarts, "
                          "durable-store recovery)")
     args = ap.parse_args()
+    if args.one_devplane_trial is not None:
+        verdict = run_devplane_schedule(args.one_devplane_trial,
+                                        args.seed_base, True)
+        print(f"APUS_FUZZ_VERDICT: {verdict}", flush=True)
+        return 0
     ok = stalls = 0
     failures = []
     for trial in range(args.trials):
         try:
             if args.device_plane:
-                r = run_devplane_schedule(trial, args.seed_base, True)
+                r = _devplane_trial_subprocess(trial, args.seed_base)
             elif args.proc:
                 r = run_proc_schedule(trial, args.seed_base)
             else:
