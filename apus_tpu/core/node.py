@@ -194,6 +194,7 @@ class Node:
         # (snap, ep_dump, cid, member_addrs) — valid while snap.last_idx+1
         # >= log.head (see make_snapshot).
         self._snap_cache: Optional[tuple[Snapshot, list, Cid, dict]] = None
+        self._snap_stream_cache: Optional[tuple] = None
         # Determinant of the last applied entry — the snapshot anchor
         # (snapshot_t.last_entry analog, dare_log.h:107-112); survives
         # pruning, unlike log.get(apply-1).
@@ -416,6 +417,40 @@ class Node:
                             dict(self._member_addrs))
         return self._snap_cache
 
+    #: Stream (chunked) snapshot pushes instead of one-blob pushes when
+    #: the SM's on-disk dump exceeds this.  The one-blob path holds the
+    #: whole dump resident on the leader (the _snap_cache blob) for the
+    #: life of the head window; at deep history the resulting GC pauses
+    #: exceed the production heartbeat timeout and wobble elections.
+    SNAP_STREAM_THRESHOLD = 4 << 20
+
+    def make_snapshot_stream_meta(self):
+        """Streaming counterpart of make_snapshot: everything EXCEPT the
+        data blob — (meta_snap, ep_dump, cid, members, total) — for SMs
+        exposing an on-disk dump (snapshot_stream_size /
+        read_snapshot_chunk).  Returns None when the SM can't stream or
+        the dump is below SNAP_STREAM_THRESHOLD.  Captured atomically
+        under the caller's lock: the dump file is append-only and
+        appends happen under the same lock, so the [0, total) prefix is
+        exactly the state at (last_idx, last_term) and stays immutable
+        while chunks are read.  Cached like _snap_cache (tiny: no
+        blob)."""
+        size_of = getattr(self.sm, "snapshot_stream_size", None)
+        if size_of is None:
+            return None
+        if self._snap_stream_cache is not None and \
+                self._snap_stream_cache[0].last_idx + 1 >= self.log.head:
+            return self._snap_stream_cache
+        total = size_of()
+        if total is None or total < self.SNAP_STREAM_THRESHOLD:
+            return None
+        last_idx, last_term = self._applied_det
+        meta = Snapshot(last_idx, last_term, b"", seg=self._seg.dump())
+        gen = getattr(self.sm, "dump_generation", 0)
+        self._snap_stream_cache = (meta, self.epdb.dump(), self.cid,
+                                   dict(self._member_addrs), total, gen)
+        return self._snap_stream_cache
+
     def install_snapshot(self, snap: Snapshot, ep_dump: list,
                          cid: Optional[Cid] = None,
                          member_addrs: Optional[dict] = None) -> bool:
@@ -435,6 +470,7 @@ class Node:
         self.log.reset(snap.last_idx + 1)
         self._applied_det = (snap.last_idx, snap.last_term)
         self._snap_cache = None
+        self._snap_stream_cache = None
         if cid is not None and cid.epoch >= self.cid.epoch:
             self.cid = cid
             for addr, slot in (member_addrs or {}).items():
@@ -891,12 +927,40 @@ class Node:
                 # Peer is behind our pruned head: push a snapshot
                 # (leader-driven form of rc_recover_sm, the reference's
                 # joiner instead RDMA-reads it, dare_ibv_rc.c:603-689),
-                # then resume log replication just past it.
-                snap, ep_dump, snap_cid, members = self.make_snapshot()
-                res = self.t.snap_push(peer, my, snap, ep_dump,
-                                       snap_cid, members)
+                # then resume log replication just past it.  Large
+                # on-disk dumps stream in chunks (the pusher holds one
+                # chunk, not the whole history); small/in-memory dumps
+                # take the one-blob push.
+                stream = (self.make_snapshot_stream_meta()
+                          if hasattr(self.t, "snap_push_stream") else None)
+                if stream is not None:
+                    meta, ep_dump, snap_cid, members, total, gen = stream
+
+                    def read_chunk(off, n, _gen=gen):
+                        # Frozen-prefix fence: the dump is append-only
+                        # UNLESS apply_snapshot replaced it (we were
+                        # deposed and re-primed mid-stream) — then the
+                        # prefix no longer matches the captured meta
+                        # and the stream must abort, not ship bytes of
+                        # someone else's history.
+                        if getattr(self.sm, "dump_generation", 0) != _gen:
+                            return b""
+                        return self.sm.read_snapshot_chunk(off, n)
+
+                    res = self.t.snap_push_stream(
+                        peer, my, meta, ep_dump, snap_cid, members,
+                        total, read_chunk)
+                    pushed_last_idx = meta.last_idx
+                    if res == WriteResult.OK:
+                        self.stats["snapshots_streamed"] = \
+                            self.stats.get("snapshots_streamed", 0) + 1
+                else:
+                    snap, ep_dump, snap_cid, members = self.make_snapshot()
+                    res = self.t.snap_push(peer, my, snap, ep_dump,
+                                           snap_cid, members)
+                    pushed_last_idx = snap.last_idx
                 if res == WriteResult.OK:
-                    self._next_idx[peer] = snap.last_idx + 1
+                    self._next_idx[peer] = pushed_last_idx + 1
                     self.stats["snapshots_pushed"] = \
                         self.stats.get("snapshots_pushed", 0) + 1
                 elif res in (WriteResult.FENCED, WriteResult.REFUSED):

@@ -214,6 +214,27 @@ class PeerServer:
                 node, writer, snap, ep_dump,
                 cid if cid.size > 0 else None, members)
             return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_SNAP_BEGIN:
+            writer = Sid.unpack(r.u64())
+            total = r.u64()
+            meta = wire.decode_value(r)
+            ep_dump = wire.decode_ep_dump(r)
+            cid = wire.decode_cid(r)
+            members = wire.decode_members(r)
+            res = onesided.apply_snap_begin(
+                node, writer, total, meta, ep_dump,
+                cid if cid.size > 0 else None, members)
+            return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_SNAP_CHUNK:
+            writer = Sid.unpack(r.u64())
+            off = r.u64()
+            data = r.blob()
+            res = onesided.apply_snap_chunk(node, writer, off, data)
+            return wire.u8(_ST_OF_RESULT[res])
+        if op == wire.OP_SNAP_END:
+            writer = Sid.unpack(r.u64())
+            res = onesided.apply_snap_end(node, writer)
+            return wire.u8(_ST_OF_RESULT[res])
         return wire.u8(wire.ST_ERROR)
 
 
@@ -334,18 +355,19 @@ class NetTransport(Transport):
                 pass
 
     def _roundtrip(self, target: int, payload: bytes,
-                   timeout: Optional[float] = None) -> Optional[bytes]:
+                   timeout: Optional[float] = None,
+                   cap_s: float = 8.0) -> Optional[bytes]:
         """Send one request frame, await the response frame.  Releases
         the daemon's node lock while blocked (see module docstring).
         ``timeout`` overrides the per-op wire timeout (bulk transfers);
         either way the wait scales with the payload (~1 s per 4 MB,
-        capped at 8 s): a multi-MB frame can take seconds to transfer
+        capped at ``cap_s``, default 8 s): a multi-MB frame can take seconds to transfer
         AND process on a loaded host, and a too-short wait makes the
         sender misread success as DROPPED and resend — while the cap
         bounds how long a tick-thread caller can stall on one peer."""
         eff = (self.timeout if timeout is None else timeout) \
             + len(payload) / 4e6
-        eff = min(8.0, eff)
+        eff = min(cap_s, eff)
         lock = self.yield_lock
         depth = 0
         if lock is not None:
@@ -453,6 +475,61 @@ class NetTransport(Transport):
         # replying, which costs more than the transfer alone.
         resp = self._roundtrip(target, payload,
                                timeout=max(self.timeout, 2.0))
+        if resp is None:
+            return WriteResult.DROPPED
+        return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+
+    #: bytes per SNAP_CHUNK frame — the pusher's resident snapshot
+    #: footprint during a stream.
+    SNAP_CHUNK_BYTES = 1 << 20
+
+    def snap_push_stream(self, target: int, writer_sid: Sid, meta_snap,
+                         ep_dump: list, cid, member_addrs, total: int,
+                         read_chunk) -> WriteResult:
+        """Chunked form of snap_push for large dumps: BEGIN (metadata)
+        -> N x CHUNK (read_chunk(off, n) supplies bytes, typically a
+        pread of the SM's on-disk record dump) -> END (installs with
+        snap_push's exact fence/staleness semantics).  The pusher never
+        holds more than one chunk in RAM — the whole-blob snap_push
+        materializes O(history) on the leader, whose GC pauses then
+        wobble elections at deep history."""
+        payload = (wire.u8(wire.OP_SNAP_BEGIN) + wire.u64(writer_sid.word)
+                   + wire.u64(total) + wire.encode_value(meta_snap)
+                   + wire.encode_ep_dump(ep_dump)
+                   + wire.encode_cid(cid if cid is not None
+                                     else Cid.initial(0))
+                   + wire.encode_members(member_addrs or {}))
+        resp = self._roundtrip(target, payload,
+                               timeout=max(self.timeout, 2.0))
+        if resp is None:
+            return WriteResult.DROPPED
+        res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+        if res != WriteResult.OK:
+            return res
+        off = 0
+        while off < total:
+            n = min(self.SNAP_CHUNK_BYTES, total - off)
+            data = read_chunk(off, n)
+            if len(data) != n:           # dump shrank?! protocol bug
+                return WriteResult.DROPPED
+            payload = (wire.u8(wire.OP_SNAP_CHUNK)
+                       + wire.u64(writer_sid.word) + wire.u64(off)
+                       + wire.blob(data))
+            resp = self._roundtrip(target, payload)
+            if resp is None:
+                return WriteResult.DROPPED
+            res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
+            if res != WriteResult.OK:
+                return res
+            off += n
+        # END: the receiver reads, installs, and persists the whole
+        # assembled state before replying — allow well beyond the
+        # normal cap (heartbeats pause for the duration on the pusher's
+        # tick thread; an async install on the receiver is the named
+        # next step for multi-GB dumps).
+        resp = self._roundtrip(
+            target, wire.u8(wire.OP_SNAP_END) + wire.u64(writer_sid.word),
+            timeout=max(self.timeout, 2.0 + total / 2e6), cap_s=30.0)
         if resp is None:
             return WriteResult.DROPPED
         return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)

@@ -93,3 +93,76 @@ def apply_snap_push(node: Node, writer_sid: Sid, snap: Any,
         # assuming we now sit at snap.last_idx.
         return WriteResult.REFUSED
     return WriteResult.OK
+
+
+# -- chunked snapshot stream (OP_SNAP_BEGIN/CHUNK/END) --------------------
+# One in-flight assembly per node; a new BEGIN replaces a stale session
+# (the pusher serializes its own stream, and a leadership change mid-
+# stream surfaces as FENCED on the next chunk/end).  The blob assembles
+# into a temp file so the receiver too holds at most one chunk in RAM
+# until install time.
+
+def _snap_session_drop(node: Node) -> None:
+    sess = getattr(node, "_snap_stream_in", None)
+    if sess is not None:
+        try:
+            sess["f"].close()
+        except OSError:
+            pass
+        try:
+            import os
+            os.unlink(sess["path"])
+        except OSError:
+            pass
+    node._snap_stream_in = None
+
+
+def apply_snap_begin(node: Node, writer_sid: Sid, total: int,
+                     meta_snap: Any, ep_dump: list, cid: Any,
+                     member_addrs: dict | None) -> WriteResult:
+    """Open an assembly session.  Same fence gate as SNAP_PUSH — a
+    deposed leader cannot even begin a stream."""
+    import tempfile
+
+    if not node.regions.log_write_allowed(writer_sid):
+        return WriteResult.FENCED
+    _snap_session_drop(node)
+    f = tempfile.NamedTemporaryFile(prefix="apus-snap-in-", delete=False)
+    node._snap_stream_in = {
+        "sid": writer_sid.word, "total": total, "got": 0,
+        "meta": meta_snap, "ep_dump": ep_dump, "cid": cid,
+        "members": member_addrs, "f": f, "path": f.name,
+    }
+    return WriteResult.OK
+
+
+def apply_snap_chunk(node: Node, writer_sid: Sid, off: int,
+                     data: bytes) -> WriteResult:
+    if not node.regions.log_write_allowed(writer_sid):
+        _snap_session_drop(node)
+        return WriteResult.FENCED
+    sess = getattr(node, "_snap_stream_in", None)
+    if sess is None or sess["sid"] != writer_sid.word \
+            or off != sess["got"] or off + len(data) > sess["total"]:
+        _snap_session_drop(node)
+        return WriteResult.REFUSED          # no/foreign/torn session
+    sess["f"].write(data)
+    sess["got"] += len(data)
+    return WriteResult.OK
+
+
+def apply_snap_end(node: Node, writer_sid: Sid) -> WriteResult:
+    sess = getattr(node, "_snap_stream_in", None)
+    if sess is None or sess["sid"] != writer_sid.word \
+            or sess["got"] != sess["total"]:
+        _snap_session_drop(node)
+        return WriteResult.REFUSED
+    sess["f"].flush()
+    sess["f"].seek(0)
+    data = sess["f"].read()
+    meta = sess["meta"]
+    snap = dataclasses.replace(meta, data=data)
+    res = apply_snap_push(node, writer_sid, snap, sess["ep_dump"],
+                          sess["cid"], sess["members"])
+    _snap_session_drop(node)
+    return res

@@ -43,6 +43,14 @@ OP_LOG_BULK_READ = 6
 OP_JOIN = 7          # membership join request (ud_join_cluster analog)
 OP_SNAP_FETCH = 8    # snapshot fetch for recovery (rc_recover_sm analog)
 OP_SNAP_PUSH = 9     # leader-pushed snapshot install (lagging peer/joiner)
+# Chunked snapshot stream (large dumps): BEGIN carries the metadata of
+# a SNAP_PUSH minus the blob; CHUNKs carry the blob; END installs with
+# SNAP_PUSH's exact fence/staleness semantics.  Bounds the pusher's RAM
+# to one chunk — the whole-blob SNAP_PUSH materializes O(history) on
+# the leader, whose GC pauses then wobble elections at deep history.
+OP_SNAP_BEGIN = 10
+OP_SNAP_CHUNK = 11
+OP_SNAP_END = 12
 
 # -- response status ------------------------------------------------------
 ST_OK = 0

@@ -95,6 +95,12 @@ class RelayStateMachine(StateMachine):
         self.records: list[bytes] = []
         self.record_count = 0
         self.record_bytes = 0
+        #: Bumped whenever the dump is REPLACED (apply_snapshot): the
+        #: streaming pusher fences each chunk read on this, because the
+        #: append-only/frozen-prefix invariant breaks exactly when a
+        #: deposed leader's own dump gets rewritten by the new
+        #: leader's snapshot push mid-stream.
+        self.dump_generation = 0
         if spill_path:
             os.makedirs(os.path.dirname(spill_path) or ".",
                         exist_ok=True)
@@ -112,6 +118,24 @@ class RelayStateMachine(StateMachine):
         self.record_count += 1
         self.record_bytes += len(cmd)
         return b"OK"
+
+    def snapshot_stream_size(self):
+        """Size of the on-disk record dump, or None when the dump is
+        in-memory (streaming would buy nothing there).  Captured under
+        the daemon lock at snapshot-meta creation: the spill file is
+        append-only and appends happen under the same lock, so the
+        prefix [0, size) is immutable afterwards — it IS the dump at
+        that apply point."""
+        if self._f is None:
+            return None
+        self._f.flush()
+        return os.fstat(self._f.fileno()).st_size
+
+    def read_snapshot_chunk(self, off: int, n: int) -> bytes:
+        """pread of the frozen dump prefix (no shared seek state with
+        the append path)."""
+        assert self._f is not None
+        return os.pread(self._f.fileno(), n, off)
 
     def iter_records(self) -> list[bytes]:
         """The full record dump, mode-independent — what the Bridge's
@@ -147,6 +171,7 @@ class RelayStateMachine(StateMachine):
         self.records = []
         self.record_count = 0
         self.record_bytes = 0
+        self.dump_generation += 1
         if self._f is not None:
             self._f.seek(0)
             self._f.truncate()

@@ -184,6 +184,11 @@ class ReplicaDaemon:
         self.transport.close()
         if self.persistence is not None:
             self.persistence.close()
+        # Drop any half-assembled inbound snapshot stream (fd + temp
+        # file) — an abandoned session would otherwise outlive us on
+        # disk.
+        from apus_tpu.parallel.onesided import _snap_session_drop
+        _snap_session_drop(self.node)
 
     def _exclusion_watchdog(self) -> None:
         """Self-rejoin after eviction, for EVERY deployment shape.

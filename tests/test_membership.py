@@ -308,3 +308,53 @@ def test_large_state_snapshot_primes_joiner():
             assert d.node.sm.store[b"big0"] == big
             assert d.node.sm.store[b"big255"] == big
             assert len(d.node.sm.store) >= 256
+
+
+def test_large_dump_streams_to_joiner(tmp_path, monkeypatch):
+    """Above SNAP_STREAM_THRESHOLD, a joiner primes through the CHUNKED
+    snapshot stream (SNAP_BEGIN/CHUNK/END): the pusher reads straight
+    from its on-disk record dump instead of materializing the blob
+    (the O(history) resident set whose GC pauses wobble elections at
+    deep history), and the joiner's dump comes out byte-identical."""
+    from apus_tpu.core.node import Node
+    from apus_tpu.runtime.bridge import RelayStateMachine
+
+    monkeypatch.setattr(Node, "SNAP_STREAM_THRESHOLD", 64 << 10)
+    made = [0]
+
+    def sm_factory():
+        made[0] += 1
+        return RelayStateMachine(
+            spill_path=str(tmp_path / f"dump{made[0]}.bin"))
+
+    with LocalCluster(3, spec=SPEC, sm_factory=sm_factory) as c:
+        payload = b"R" * 2048
+        for i in range(120):                # ~250 KB of dump
+            c.submit(b"rec-%03d-" % i + payload)
+
+        def pruned():
+            leader = c.leader()
+            if leader is None:
+                return False
+            with leader.lock:
+                return leader.node.log.head > 10
+        _wait(pruned, msg="leader log pruned")
+
+        d = c.add_replica()
+        c.wait_caught_up(d.idx, timeout=60.0)
+        streamed = 0
+        for dm in c.live():
+            with dm.lock:
+                streamed += dm.node.stats.get("snapshots_streamed", 0)
+        assert streamed >= 1, "prime should have used the chunked stream"
+        with d.lock:
+            assert d.node.stats.get("snapshots_installed", 0) >= 1
+            got = d.node.sm.iter_records()
+        leader = c.wait_for_leader()
+        with leader.lock:
+            want = leader.node.sm.iter_records()
+        # The joiner's dump is a prefix-consistent copy: every record
+        # the leader had at the snapshot point, in order.
+        assert len(got) >= 120
+        assert got == want[:len(got)]
+        assert got[0].startswith(b"rec-000-")
