@@ -989,7 +989,9 @@ class Node:
                     self.stats["entries_replicated"] += len(batch)
                 self._commit_sent[peer] = self.log.commit
                 self._fail_count[peer] = 0
-                if acked_end is not None:
+                if acked_end is not None and self.is_leader \
+                        and self.current_term == my.term \
+                        and self.cid.contains(peer):
                     # Synchronous ack (DCN transport): the reply carried
                     # the peer's authoritative post-write log end, so
                     # _advance_commit sees it THIS tick instead of after
@@ -997,7 +999,14 @@ class Node:
                     # periods of commit latency at the production
                     # envelope).  Plain overwrite, not max: after a
                     # peer restart the smaller fresh end must land or
-                    # the stale-match watchdog never fires.
+                    # the stale-match watchdog never fires.  Guarded on
+                    # still-leader-at-my-term AND peer-still-a-member:
+                    # the roundtrip released the node lock for up to the
+                    # wire cap, during which a CONFIG apply may have
+                    # cleared this slot (a removed member's REP_ACK must
+                    # not be repopulated with the old occupant's end —
+                    # a joiner reusing the slot would inherit a phantom
+                    # ack) or leadership may have moved.
                     self.regions.ctrl[Region.REP_ACK][peer] = acked_end
                     self.regions.touch(Region.REP_ACK, peer,
                                        time.monotonic())

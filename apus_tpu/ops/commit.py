@@ -92,7 +92,7 @@ class CommitControl:
 
 
 def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
-                 *, batch: int, n_slots: int):
+                 *, batch: int, n_slots: int, verify_round: bool = False):
     """Per-shard body.  Shapes: log_data [K,S+B,SB], log_meta [K,S+B,6],
     offs [K,4], fence [K,2], bdata [K,B,SB], bmeta [K,B,4].
 
@@ -101,7 +101,21 @@ def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
     so the write is ONE contiguous dynamic_update_slice per array;
     replicas that reject the batch (fence/contiguity) redirect the slice
     into the scratch rows [S, S+B) instead of predicating per-row —
-    see ops.logplane docstring for why this matters on TPU."""
+    see ops.logplane docstring for why this matters on TPU.
+
+    ``verify_round``: in MULTI-CONTROLLER deployments (one process per
+    replica, apus_tpu.runtime.mesh_plane) each process supplies its own
+    ``ctrl`` from a descriptor it received over the control plane.  If a
+    deposed leader and a new leader dispatch concurrently, the backend
+    pairs their (byte-identical) programs by arrival order, so one
+    collective can mix two different logical rounds — the broadcast
+    payload would then be an elementwise max of two leaders' batches.
+    The round-identity check all-gathers each participant's claimed
+    (term, leader, end0) and refuses the write everywhere unless all
+    agree — the in-step analog of the QP-reset fencing the reference
+    uses to physically block a deposed leader's RDMA writes
+    (dare_ibv_rc.c:2156-2255).  Single-controller callers pass one ctrl
+    to every shard, so the check is vacuous there (default off)."""
     K, rows, SB = log_data.shape
     S, B = n_slots, batch
     a = lax.axis_index(REPLICA_AXIS)
@@ -123,6 +137,14 @@ def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
     own_end = offs[:, OFF_END]                              # [K]
     contig = own_end == ctrl.end0
     do_write = fence_ok & contig                            # [K]
+    if verify_round:
+        # Round-identity agreement (see docstring): every participant
+        # must claim the same (term, leader, end0) or nobody writes and
+        # the round decides nothing (commit sentinel 0).
+        ident = jnp.stack([ctrl.term, ctrl.leader, ctrl.end0])   # [3]
+        idents = lax.all_gather(ident, REPLICA_AXIS)       # [axis,3]
+        coherent = jnp.all(idents == ident[None])
+        do_write = do_write & coherent
 
     # (3) slot writes: one contiguous span per replica row; rejected
     # writes land in the scratch region.
@@ -156,6 +178,8 @@ def _commit_body(log_data, log_meta, offs, fence, bdata, bmeta, ctrl,
     ok = (n_old >= ctrl.q_old) & ((ctrl.q_new == 0) | (n_new >= ctrl.q_new))
     member_any = (ctrl.mask_old | ctrl.mask_new) == 1
     commit_global = jnp.max(jnp.where(ok & member_any, cand, 0))
+    if verify_round:
+        commit_global = jnp.where(coherent, commit_global, 0)
 
     # (5) advance offsets (monotone; clamped to own end).  A replica only
     # advances commit if it ACCEPTED this batch: the Raft clamp
@@ -190,7 +214,8 @@ def _assert_devlog_geometry(devlog: DeviceLog, n_slots: int,
 
 
 def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
-                      slot_bytes: int, batch: int, auto_advance: bool = False):
+                      slot_bytes: int, batch: int, auto_advance: bool = False,
+                      verify_round: bool = False):
     """Compile-ready commit step bound to a mesh + static geometry.
 
     Returns ``step(devlog, batch_data [R,B,SB] u8, batch_meta [R,B,4] i32,
@@ -205,9 +230,14 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     With ``auto_advance=True`` the step additionally returns a rolled-
     forward control block (``end0 += B``) so a steady-state pipeline can
     loop device-side values without host reconstruction.
+
+    ``verify_round=True`` adds the multi-controller round-identity check
+    (see ``_commit_body``) — required whenever different processes
+    supply their own ``ctrl`` (runtime.mesh_plane).
     """
     _check_geometry(mesh, n_replicas, n_slots, batch)
-    body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
+    body = functools.partial(_commit_body, batch=batch, n_slots=n_slots,
+                             verify_round=verify_round)
     sharded = P(REPLICA_AXIS)
     repl = P()
     ctrl_specs = CommitControl(*([repl] * 7))
@@ -235,7 +265,8 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
 
 def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
                                 slot_bytes: int, batch: int, depth: int,
-                                staged_depth: int | None = None):
+                                staged_depth: int | None = None,
+                                verify_round: bool = False):
     """Device-resident pipelined commit: ``depth`` consecutive commit
     rounds execute inside ONE XLA program (a ``lax.scan`` over staged
     batches), so host dispatch cost is paid once per ``depth`` rounds.
@@ -260,13 +291,31 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     """
     staged_depth = depth if staged_depth is None else staged_depth
     _check_geometry(mesh, n_replicas, n_slots, batch)
+    # The identity check is loop-invariant, so it is hoisted out of the
+    # scan: one tiny all_gather per WINDOW (rounds share the dispatch's
+    # descriptor).  On incoherence, leader=-2 fails both the is_leader
+    # and fence tests on every shard (no row writes anywhere), AND the
+    # per-round commit outputs are zeroed — the ack gather mixes devlog
+    # generations in a mismatched pairing, so its quorum boundary is
+    # meaningless and must not be adopted.
     body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
+
+    def _round_coherent(ctrl):
+        ident = jnp.stack([ctrl.term, ctrl.leader, ctrl.end0])
+        idents = lax.all_gather(ident, REPLICA_AXIS)
+        return jnp.all(idents == ident[None])
+
     sharded = P(REPLICA_AXIS)
     staged = P(None, REPLICA_AXIS)
     repl = P()
     ctrl_specs = CommitControl(*([repl] * 7))
 
     def pipe(log_data, log_meta, offs, fence, sdata, smeta, ctrl):
+        if verify_round:
+            coherent = _round_coherent(ctrl)
+            ctrl = dataclasses.replace(
+                ctrl, leader=jnp.where(coherent, ctrl.leader, jnp.int32(-2)))
+
         def one(carry, i):
             log_data, log_meta, offs, fence, ctrl = carry
             bdata = lax.dynamic_index_in_dim(sdata, i % staged_depth,
@@ -280,6 +329,8 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
         (log_data, log_meta, offs, fence, ctrl), commits = lax.scan(
             one, (log_data, log_meta, offs, fence, ctrl),
             jnp.arange(depth, dtype=jnp.int32))
+        if verify_round:
+            commits = jnp.where(coherent, commits, 0)
         return log_data, log_meta, offs, fence, commits, ctrl
 
     fn = jax.shard_map(
@@ -340,7 +391,8 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
                                       n_slots: int, slot_bytes: int,
                                       batch: int, depth: int,
                                       staged_depth: int | None = None,
-                                      pallas_mode: str = "auto"):
+                                      pallas_mode: str = "auto",
+                                      verify_round: bool = False):
     """Closed-form pipelined commit: same contract as
     ``build_pipelined_commit_step`` but the ``depth`` rounds are computed
     algebraically instead of sequentially scanned.
@@ -404,6 +456,15 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
                     & (ctrl.term >= fence[:, FENCE_TERM])) | is_leader
         own_end = offs[:, OFF_END]
         accept = fence_ok & (own_end == ctrl.end0)          # [K]
+        if verify_round:
+            # Multi-controller round-identity check (see _commit_body):
+            # on any disagreement nobody writes and the window decides
+            # nothing — the ack gather below would mix devlog
+            # generations, so its quorum boundary must not be adopted.
+            ident = jnp.stack([ctrl.term, ctrl.leader, ctrl.end0])
+            idents = lax.all_gather(ident, REPLICA_AXIS)
+            coherent = jnp.all(idents == ident[None])
+            accept = accept & coherent
 
         # Closed-form per-round commits.  acks[i, r]: an accepting
         # replica's end after round i is end0+(i+1)B; a rejecting one
@@ -423,6 +484,8 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
         member_any = (ctrl.mask_old | ctrl.mask_new)[None, :] == 1
         commits = jnp.max(jnp.where(ok & member_any, cand, 0),
                           axis=1)                           # [D]
+        if verify_round:
+            commits = jnp.where(coherent, commits, 0)
 
         # Final ring state.  Block b of the ring was last written by
         # surviving round i0 + e_of_b[b] (an arithmetic progression of
@@ -519,6 +582,10 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
                                        staged_data, staged_meta, ctrl)
         return DeviceLog(d, m, o, f), commits, ctrl
 
+    # Which data path the ring rewrite takes ('compiled' pallas kernel,
+    # 'interpret', or the XLA whole-ring select 'off') — recorded by
+    # bench.py so published numbers are attributable to a kernel.
+    step.pallas_mode = pallas_mode
     return step
 
 

@@ -88,13 +88,24 @@ class DeviceCommitRunner:
     #: program covering PIPE_DEPTH consecutive rounds, used by the
     #: driver when the backlog allows.
     PIPE_DEPTH = 4
-    #: Rounds per DEEP dispatch, used when the backlog covers
+    #: Rounds per base DEEP dispatch, used when the backlog covers
     #: DEEP_DEPTH full batches.  On an accelerator this rung runs the
     #: fused closed-form window step (build_pipelined_commit_step_fused,
     #: whose ring-rewrite cost is invisible next to dispatch latency);
     #: on the CPU backend it runs the scan step at the same depth —
-    #: see the builder selection in _build_locked.
+    #: see the builder selection in _build_locked.  DEEP_DEPTH is also
+    #: the unit of the follower drain's bulk gather (read_rows window).
     DEEP_DEPTH = 16
+    #: Backlog-adaptive deep ladder (accelerator backends only): the
+    #: driver dispatches the DEEPEST rung the host backlog covers, so a
+    #: tunnel/dispatch-latency-dominated deployment amortizes one
+    #: dispatch over up to 256 rounds — the live-path counterpart of
+    #: the bench's depth ladder, and the reference's "keep the NIC
+    #: queue full" discipline (dare_ibv_rc.c:2552-2568).  On the CPU
+    #: backend the ladder stays at (DEEP_DEPTH,): there is no dispatch
+    #: round trip worth amortizing, and each extra rung costs a
+    #: compile in every runner build (the test suite builds many).
+    DEEP_DEPTHS = (16, 64, 256)
 
     def __init__(self, n_replicas: int, n_slots: int = 4096,
                  slot_bytes: int = 4096, batch: int = 64,
@@ -223,14 +234,24 @@ class DeviceCommitRunner:
         deep_builder = (build_pipelined_commit_step_fused
                         if jax.default_backend() != "cpu"
                         else build_pipelined_commit_step)
+        deep_depths = (self.DEEP_DEPTHS if jax.default_backend() != "cpu"
+                       else (self.DEEP_DEPTH,))
         self._pipes = {
             K: build_pipelined_commit_step(
                 self._mesh, R, self.n_slots, SB, B, depth=K,
                 staged_depth=K),
-            self.DEEP_DEPTH: deep_builder(
-                self._mesh, R, self.n_slots, SB, B, depth=self.DEEP_DEPTH,
-                staged_depth=self.DEEP_DEPTH),
         }
+        for D in deep_depths:
+            self._pipes[D] = deep_builder(
+                self._mesh, R, self.n_slots, SB, B, depth=D,
+                staged_depth=D)
+        #: pipe depths descending — the driver's window-selection order.
+        self.window_depths = sorted(self._pipes, reverse=True)
+        #: which ring-rewrite path each fused rung compiled to
+        #: ('compiled' pallas / 'off' XLA select; None = scan step) —
+        #: surfaced in bench detail so numbers are attributable.
+        self.pallas_modes = {K: getattr(p, "pallas_mode", None)
+                             for K, p in self._pipes.items()}
         staged_sh = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
         self._staged_sharding = staged_sh
 
@@ -485,7 +506,7 @@ class DeviceCommitRunner:
             self.stats["entries_devplane"] += K * B
             self.stats["pipelined_dispatches"] += 1
             self.depth_histogram[K] = self.depth_histogram.get(K, 0) + 1
-            if K == self.DEEP_DEPTH:
+            if K >= self.DEEP_DEPTH:
                 self.stats["deep_dispatches"] = \
                     self.stats.get("deep_dispatches", 0) + 1
         return _WindowHandle(gen, end0, K, commits)
@@ -633,11 +654,13 @@ class DevicePlaneDriver:
     #: Deep windows kept in flight before the driver blocks on the
     #: oldest one — the reference keeps its NIC send queue full the
     #: same way (sized 2*ceil(retry/hb), selective signaling,
-    #: dare_ibv_rc.c:182-195, :2552-2568).  Depth 2 overlaps window
-    #: N+1's staging+dispatch with window N's execution+readback, which
-    #: is where the win is; deeper adds commit-release latency for no
-    #: extra overlap.
-    MAX_INFLIGHT = 2
+    #: dare_ibv_rc.c:182-195, :2552-2568).  Two in flight overlaps
+    #: window N+1's staging+dispatch with window N's execution; the
+    #: third absorbs submission jitter on a relay-tunneled chip (where
+    #: dispatch RTT >> execution, an empty device queue between
+    #: resolves is pure dead time).  Deeper than that only adds
+    #: commit-release latency.
+    MAX_INFLIGHT = 3
 
     def __init__(self, daemon, runner: DeviceCommitRunner):
         self.daemon = daemon
@@ -819,12 +842,20 @@ class DevicePlaneDriver:
         # Pipelined dispatch when the backlog covers a window of clean
         # batches: the deepest available window rides one XLA program
         # (runner.commit_rounds) instead of K dispatch+sync cycles —
-        # DEEP_DEPTH under heavy backlog, else PIPE_DEPTH, else a
-        # single round.
+        # the deepest ladder rung the backlog covers, else PIPE_DEPTH,
+        # else a single round.
         span_rounds = 1
         entries = None
-        for K in (self.runner.DEEP_DEPTH, self.runner.PIPE_DEPTH):
+        inflight_rounds = sum(h.K for h in self._inflight)
+        for K in self.runner.window_depths:
             if end - self._dev_next < K * B:
+                continue
+            # Ring-capacity gate: everything in flight (plus this
+            # window) must fit in the live ring, or followers could
+            # never drain the overwritten spans from their shards (the
+            # TCP repair path would carry them instead — safe, but the
+            # device transport would be hauling bytes nobody can read).
+            if (inflight_rounds + K) * B > self.runner.n_slots:
                 continue
             span = list(node.log.entries(self._dev_next,
                                          self._dev_next + K * B))
@@ -840,7 +871,7 @@ class DevicePlaneDriver:
         if entries is None:
             entries = list(node.log.entries(self._dev_next,
                                             self._dev_next + B))
-        if span_rounds != self.runner.DEEP_DEPTH and self._inflight:
+        if span_rounds < self.runner.DEEP_DEPTH and self._inflight:
             # A dirty deep window downgraded this dispatch to a sync
             # shape (or an oversize fallback): drain the pipeline first
             # — the sync paths and the host-fallback handoff both
@@ -868,7 +899,7 @@ class DevicePlaneDriver:
         handle = None
         self.daemon.lock.release()
         try:
-            if span_rounds == self.runner.DEEP_DEPTH \
+            if span_rounds >= self.runner.DEEP_DEPTH \
                     and self.runner.use_async_windows:
                 # Deep windows enqueue WITHOUT blocking on the result:
                 # up to MAX_INFLIGHT ride the device queue while the

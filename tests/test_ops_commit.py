@@ -453,3 +453,44 @@ def test_one_sided_scatter_lands_leader_batch_everywhere():
                                  jax.numpy.int32(leader)))
         for r in range(N):
             assert np.array_equal(out[r], local[leader]), (leader, r)
+
+
+def test_verify_round_coherent_noop():
+    """verify_round=True must be a no-op when every shard carries the
+    same ctrl (the single-controller case): identical acks/commit as
+    the unverified program, across single-step, scan, and fused
+    builders.  (The incoherent case needs one process per replica —
+    exercised by the mesh-plane tests.)"""
+    from apus_tpu.ops.commit import (build_pipelined_commit_step,
+                                     build_pipelined_commit_step_fused)
+    R, S, SB, B = 4, 32, 64, 8
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    reqs = [b"vreq-%d" % i for i in range(B)]
+    bd, bm, _ = host_batch_to_device(reqs, SB, batch_size=B)
+    bdata, bmeta = place_batch(mesh, R, 0, bd, bm)
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, leader=0, term=1,
+                                  end0=1)
+    outs = {}
+    for name, vr in (("off", False), ("on", True)):
+        devlog = _make_devlog(R, S, SB, B, 0, 1, sh)
+        step = build_commit_step(mesh, R, S, SB, B, verify_round=vr)
+        devlog, acks, commit = step(devlog, bdata, bmeta, ctrl)
+        outs[name] = (np.asarray(acks).tolist(), int(commit),
+                      np.asarray(devlog.offs).tolist())
+    assert outs["on"] == outs["off"]
+    assert outs["on"][1] == 1 + B
+
+    for builder in (build_pipelined_commit_step,
+                    build_pipelined_commit_step_fused):
+        couts = {}
+        for vr in (False, True):
+            devlog = _make_devlog(R, S, SB, B, 0, 1, sh)
+            pipe = builder(mesh, R, S, SB, B, depth=3, staged_depth=1,
+                           verify_round=vr)
+            devlog, commits, _ = pipe(devlog, bdata[None], bmeta[None],
+                                      ctrl)
+            couts[vr] = (np.asarray(commits).tolist(),
+                         np.asarray(devlog.offs).tolist())
+        assert couts[True] == couts[False]
+        assert couts[True][0][-1] == 1 + 3 * B
