@@ -539,8 +539,14 @@ class Node:
 
     def start_election(self, now: float) -> None:
         """start_election analog (dare_server.c:1264-1322)."""
-        if self.pre_election_hook is not None:
-            self.pre_election_hook()
+        if self.pre_election_hook is not None \
+                and self.pre_election_hook() is False:
+            # Hook veto: device-plane windows this replica dispatched
+            # are not yet executed+absorbed, so its log cannot yet
+            # speak for everything its shard may have acked (mesh_plane
+            # election safety).  Campaigning is DEFERRED a tick — never
+            # blocked in place, which would wedge the whole daemon.
+            return
         my = self.sid.sid
         new = Sid(my.term + 1, False, self.idx)
         self.sid.update(new.word)
@@ -677,8 +683,12 @@ class Node:
                                           self.idx, r.sid.term)
         if not reqs:
             return
-        if self.pre_election_hook is not None:
-            self.pre_election_hook()       # shard -> host log before voting
+        if self.pre_election_hook is not None \
+                and self.pre_election_hook() is False:
+            # Hook veto (see start_election): refuse to vote THIS tick
+            # rather than wedge the daemon; the candidate re-sends its
+            # request every retry period.
+            return
         best = best_vote_request(reqs)
         my = self.sid.sid
         # A higher term demotes a leader/candidate to follower BEFORE the

@@ -110,6 +110,23 @@ def make_client_ops(daemon) -> dict:
                 "sm_records": getattr(n.sm, "record_count", None),
                 "sm_record_bytes": getattr(n.sm, "record_bytes", None),
             }
+            # Device-plane observability (in-process or mesh): did
+            # commits ride the device quorum, and is the plane alive?
+            drv = daemon.device_driver
+            if drv is not None:
+                runner = drv.runner
+                st["devplane"] = {
+                    "ready": getattr(runner, "ready", True),
+                    "dead": getattr(runner, "dead", False),
+                    "death_reason": getattr(runner, "death_reason", None),
+                    "rounds": runner.stats.get("rounds", 0),
+                    "resets": runner.stats.get("resets", 0),
+                    "poisoned": runner.stats.get("poisoned_rounds", 0),
+                    "drained": drv.stats.get("drained", 0),
+                    "fallbacks": drv.stats.get("fallbacks", 0),
+                    "commits": n.stats.get("devplane_commits", 0),
+                    "owns_commit": n.external_commit,
+                }
         return wire.u8(wire.ST_OK) + wire.blob(json.dumps(st).encode())
 
     return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read,

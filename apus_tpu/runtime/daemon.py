@@ -83,9 +83,14 @@ class ReplicaDaemon:
             fail_window=spec.fail_window, recovery_start=recovery_start,
             seed=seed,
             # Segment oversized records so every entry stays device-
-            # eligible (slot_bytes minus wire-codec + envelope headroom;
-            # DeviceCommitRunner.max_data_bytes is the contract).
-            seg_chunk=max(0, spec.slot_bytes - 128))
+            # eligible (slot width minus wire-codec + envelope headroom;
+            # DeviceCommitRunner.max_data_bytes is the contract).  With
+            # the multi-controller mesh plane enabled, its slot width
+            # governs too — entries must fit the NARROWEST device slot.
+            seg_chunk=max(0, min(spec.slot_bytes,
+                                 spec.mesh_slot_bytes
+                                 if spec.mesh_n > 0 else spec.slot_bytes)
+                          - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
         # Fresh-start grace: randomize the first election timeout so a
@@ -132,10 +137,19 @@ class ReplicaDaemon:
         # Device plane (runtime.device_plane): the jitted commit step as
         # the primary replication/quorum engine, host TCP as control
         # plane + catch-up (the RC-data/UD-control split of the
-        # reference, SURVEY §5.8).
+        # reference, SURVEY §5.8).  A multi-controller runner
+        # (runtime.mesh_plane) additionally binds to this daemon for
+        # term checks and registers its descriptor op on the peer
+        # server.
         self.device_driver = None
         if device_runner is not None:
             from apus_tpu.runtime.device_plane import DevicePlaneDriver
+            if hasattr(device_runner, "attach"):
+                device_runner.attach(self)
+            if hasattr(device_runner, "on_descriptor"):
+                from apus_tpu.runtime.mesh_plane import OP_MESH
+                self.server._extra_ops[OP_MESH] = \
+                    device_runner.on_descriptor
             self.device_driver = DevicePlaneDriver(self, device_runner)
 
         self._stop = threading.Event()
@@ -176,6 +190,8 @@ class ReplicaDaemon:
         self._stop.set()
         if self.device_driver is not None:
             self.device_driver.stop()
+            if hasattr(self.device_driver.runner, "stop"):
+                self.device_driver.runner.stop()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=2.0)
         if self._excl_thread is not None:
@@ -409,6 +425,10 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--tick-interval", type=float, default=0.0005)
     ap.add_argument("--ready-file", default=None,
                     help="write a JSON readiness record here once serving")
+    ap.add_argument("--no-device-plane", action="store_true",
+                    default=os.environ.get("APUS_DEVICE_PLANE") == "0",
+                    help="run TCP-only even when the config enables the "
+                         "multi-controller mesh plane")
     args = ap.parse_args(argv)
 
     bridged = args.workdir is not None
@@ -455,9 +475,27 @@ def main(argv: Optional[list] = None) -> int:
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir)
     else:
+        # Multi-controller mesh plane (runtime.mesh_plane): static
+        # members 0..mesh_n-1 each own one device of the global mesh.
+        # The build (jax.distributed rendezvous + compile) runs in the
+        # background; TCP consensus serves immediately and the driver
+        # engages once the plane is ready.  Joiners stay TCP-only: the
+        # device geometry is fixed at cluster launch, like a TPU slice.
+        mesh_runner = None
+        if (spec.mesh_coordinator and spec.mesh_n > 0
+                and 0 <= args.idx < spec.mesh_n
+                and not args.no_device_plane
+                and _mesh_incarnation_fresh(args, spec)):
+            from apus_tpu.runtime.mesh_plane import MeshCommitRunner
+            from apus_tpu.utils.debug import make_logger
+            mesh_runner = MeshCommitRunner(
+                spec, args.idx,
+                logger=make_logger(f"apus.mesh{args.idx}", args.log_file))
+            mesh_runner.start()
         daemon = ReplicaDaemon(args.idx, spec, sm=make_sm(args.idx),
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir,
+                               device_runner=mesh_runner,
                                recovery_start=bool(
                                    args.db_dir
                                    and daemon_store_exists(args.db_dir,
@@ -598,6 +636,38 @@ def daemon_store_exists(db_dir: str, idx: int) -> bool:
 
     from apus_tpu.runtime.persist import daemon_store_path
     return os.path.exists(daemon_store_path(db_dir, idx))
+
+
+def _mesh_incarnation_fresh(args, spec) -> bool:
+    """Mesh membership is PER-INCARNATION: a crashed-and-restarted
+    replica must NOT reconnect to the coordination service — the
+    service rejects the new incarnation (ABORTED) and the runtime's
+    error polling then LOG(FATAL)-terminates every HEALTHY member
+    (observed empirically), turning a routine restart into a total
+    outage.  A durable marker keyed by the coordinator address records
+    "this slot already joined this mesh epoch"; seeing it, the restarted
+    daemon stays TCP-only (the plane on survivors already degraded when
+    this process died — a TPU slice needs a full restart the same way).
+    A NEW mesh epoch (fresh coordinator address, e.g. a whole-cluster
+    restart) writes a fresh marker and participates normally."""
+    import os
+    mdir = args.db_dir or args.workdir or (
+        os.path.dirname(args.ready_file) if args.ready_file else None)
+    if mdir is None:
+        return True          # nowhere to remember: best effort
+    os.makedirs(mdir, exist_ok=True)
+    marker = os.path.join(mdir, f"mesh-incarnation-{args.idx}")
+    try:
+        with open(marker) as f:
+            if f.read().strip() == spec.mesh_coordinator:
+                return False            # restart within the same epoch
+    except OSError:
+        pass
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(spec.mesh_coordinator)
+    os.replace(tmp, marker)
+    return True
 
 
 def _excluded_by_live_leader(daemon: "ReplicaDaemon", spec) -> bool:

@@ -757,6 +757,17 @@ class DevicePlaneDriver:
         """One driver iteration.  Returns True if work was done (skip
         the idle sleep)."""
         node = self.daemon.node
+        # Multi-controller runners (runtime.mesh_plane) build in the
+        # background and can die (degrade to TCP) at any point.  A dead
+        # plane dispatches nothing, but the FOLLOWER DRAIN continues:
+        # completed windows' rows in our local shard must still reach
+        # the host log (mesh_plane._die).
+        if getattr(self.runner, "dead", False):
+            if self._gen is not None or node.external_commit:
+                self._deactivate()
+            return self._follower_step(node)
+        if not getattr(self.runner, "ready", True):
+            return False
         with self.daemon.lock:
             if node.is_leader:
                 return self._leader_step(node)
@@ -821,24 +832,33 @@ class DevicePlaneDriver:
             self.logger.info("device plane owns commit from idx %d",
                              self._dev_base)
 
+        # A fixed-shape runner (runtime.mesh_plane) dispatches ONE window
+        # shape only — the dispatch unit is FIXED_WINDOW batches, and
+        # padding/micro-batching work at that granularity.
+        fixed = getattr(self.runner, "FIXED_WINDOW", None)
+        unit = (fixed or 1) * B
         end = node.log.end
         if end <= self._dev_next:
             return False
-        # Micro-batching: take a partial batch only once arrivals pause
+        # Micro-batching: take a partial unit only once arrivals pause
         # (one poll of delay), so bursts fill rounds instead of padding.
-        if end - self._dev_next < B and end != self._last_end_seen:
+        if end - self._dev_next < unit and end != self._last_end_seen:
             self._last_end_seen = end
             return False
         self._last_end_seen = end
-        # Pad a PARTIAL tail to the round boundary with NOOPs (partial
-        # batches arrive NOOP-padded by contract; the reference appends
-        # NOOPs too, dare_log.h:22).  A backlog >= B needs no padding —
-        # the round takes B real entries from dev_next.
-        if end - self._dev_next < B:
-            while (node.log.end - 1) % B != 0 and not node.log.near_full(2):
+        # Pad a PARTIAL tail to the dispatch boundary with NOOPs
+        # (partial batches arrive NOOP-padded by contract; the reference
+        # appends NOOPs too, dare_log.h:22).  A backlog >= unit needs no
+        # padding — the rounds take real entries from dev_next.
+        # (dev_next is B-aligned, so unit-relative padding preserves the
+        # global (end0-1) % B == 0 invariant.)
+        if end - self._dev_next < unit:
+            while (node.log.end - self._dev_next) % unit != 0 \
+                    and not node.log.near_full(2):
                 node.log.append(term, type=EntryType.NOOP)
-            if (node.log.end - 1) % B != 0:
+            if (node.log.end - self._dev_next) % unit != 0:
                 return False               # log full: wait for pruning
+            end = node.log.end
         # Pipelined dispatch when the backlog covers a window of clean
         # batches: the deepest available window rides one XLA program
         # (runner.commit_rounds) instead of K dispatch+sync cycles —
@@ -877,6 +897,17 @@ class DevicePlaneDriver:
             # — the sync paths and the host-fallback handoff both
             # assume no outstanding windows.
             return self._resolve_oldest(node, term)
+        if fixed is not None and span_rounds != fixed:
+            # Fixed-shape runner but the only full window is dirty (an
+            # oversized entry inside it): there is no shallower shape to
+            # dispatch, so the host path owns this span; re-base past it
+            # once the host quorum has committed it through.
+            self.stats["holes"] += 1
+            if node.external_commit:
+                node.external_commit = False
+            if node.log.commit >= self._dev_next + unit:
+                self._gen = None           # re-base next iteration
+            return False
         if span_rounds == 1:
             if len(entries) != B:
                 return False
@@ -1045,6 +1076,15 @@ class DevicePlaneDriver:
         node = self.daemon.node
         if not (0 <= self.daemon.idx < self.runner.n_replicas):
             return
+        # Multi-controller runner: every window this process dispatched
+        # must finish executing BEFORE the vote below, or shard acks
+        # could commit entries the election never covered (mesh_plane
+        # docstring, election safety).  Unready windows VETO the vote
+        # (return False -> node defers a tick) rather than block the
+        # daemon here.
+        quiesce = getattr(self.runner, "quiesce_ready", None)
+        if quiesce is not None and not quiesce():
+            return False
         while True:
             gen = self.runner.generation
             if gen == 0:

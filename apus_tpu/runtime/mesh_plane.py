@@ -1,0 +1,938 @@
+"""Multi-controller device plane: process-per-replica commit over a
+global ``jax.distributed`` mesh.
+
+The reference's one-sided data plane runs INSIDE every server process —
+each machine's DARE thread posts RDMA writes from its own address space
+(``rc_write_remote_logs`` called from the server's commit loop,
+dare_ibv_rc.c:1870-1948).  The in-process ``DeviceCommitRunner``
+(runtime.device_plane) gives that shape to daemons sharing ONE process;
+THIS module gives it to the production deployment: one OS process per
+replica (runtime.proc / runtime.daemon), each owning one device of a
+global ``jax.sharding.Mesh`` glued together by ``jax.distributed`` —
+exactly how a multi-host TPU pod runs one JAX program per host.
+
+How a round works (multi-controller SPMD):
+
+- Every process dispatches the SAME compiled program (the pipelined
+  commit step of ops.commit with ``verify_round=True``).  The leader's
+  process stages its window into ITS local input shard; followers stage
+  zeros.  The in-step ``pmax`` broadcast then moves the batch
+  device-to-device over the interconnect — followers' HOST code never
+  touches the payload, which is precisely the reference's one-sided
+  write semantics (followers passive on the replication path).
+- Followers learn WHAT to dispatch from a round DESCRIPTOR the leader
+  sends over the TCP control plane (a PeerServer extra op, OP_MESH) —
+  control metadata (term, end0, masks), never entry payload.  This
+  mirrors the reference's UD-control/RC-data split.
+- Each process reads results from its OWN addressable shard — no
+  collective on the read path (the rc_recover_log analog of reading
+  back the memory the RDMA writes landed in).
+
+Global program order (the multi-controller invariant): the backend
+pairs collectives across processes by dispatch order, so every process
+must issue the identical sequence of identical-shaped programs.  Three
+rules enforce it:
+
+1. ONE window shape.  Every dispatch is ``spec.mesh_depth`` rounds of
+   one batch (partial backlog is NOOP-padded by the driver), so
+   mismatched-shape pairings are structurally impossible.
+2. ONE dispatch authority per process — the worker thread — consuming
+   an ordered queue fed locally (leader) and by descriptor arrivals
+   (followers).
+3. NEVER drop, always POISON.  A descriptor that is stale (old
+   generation, or a term below the daemon's current term) is still
+   dispatched — pairing! — but with a poisoned round identity, so the
+   in-step ``verify_round`` check refuses the write EVERYWHERE and the
+   round decides nothing.  This is the in-step form of QP-reset
+   fencing (dare_ibv_rc.c:2156-2255): the deposed leader's write
+   executes against the fabric but cannot land or mint a commit.
+
+Election safety (why device acks may count toward commit at all): a
+follower's vote must cover every entry its shard ever acked, or a
+deposed leader could commit through shard acks the new leader's
+election never saw.  Two mechanisms close this:
+
+- The worker dispatches UNDER THE DAEMON LOCK with a term check — any
+  round at a term below the daemon's is poisoned (a voter that moved
+  to term T+1 refuses T-rounds *in the collective itself*).
+- ``quiesce_ready()`` — consulted by the driver's pre-election hook
+  before ANY vote is granted or campaign starts.  While a window this
+  process dispatched is still executing, the vote is VETOED (deferred
+  a tick — never blocked in place, which would wedge the daemon while
+  e.g. a dead leader's half-dispatched collective takes seconds to
+  error out); once all windows are executed, the shard drain absorbs
+  the landed rows into the host log and the vote proceeds.  Every
+  round is therefore either (a) executed + drained before the vote
+  (counted in the vote's log-up-to-dateness, standard Raft
+  intersection), or (b) dispatched after it, hence poisoned by the
+  term check.  Windows merely QUEUED at hook time dispatch after the
+  vote, i.e. (b).  Liveness cost: after a leader dies with windows in
+  flight, elections wait for the backend to surface the error (~1-5 s
+  observed) — the same order as the reference waiting out RDMA retry
+  exhaustion before a QP error frees its voters.
+
+Failure semantics (the ICI-slice model): the distributed runtime is
+brought up with effectively-infinite coordination heartbeats — the
+default behavior (terminating every process ~100 s after one dies;
+probed empirically on jaxlib 0.9) would turn a single replica crash
+into a total outage.  Member death is detected the way the data plane
+itself sees it: the collective errors out promptly and CATCHABLY
+(connection reset), the worker deactivates the plane, and the daemon
+continues on the TCP plane — the reference degrades the same way when
+a NIC dies and its QPs error out (WC error taxonomy,
+dare_ibv_rc.c:3202-3314).  A degraded mesh plane stays down until the
+cluster restarts (a TPU slice behaves the same way); consensus never
+depends on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import socket
+import threading
+from typing import Optional
+
+import numpy as np
+
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.quorum import quorum_size
+from apus_tpu.parallel import wire
+
+#: PeerServer extra-op for mesh-plane descriptors (leader -> follower).
+OP_MESH = 13
+_SUB_RESET = 0
+_SUB_ROUND = 1
+
+#: Effectively-infinite coordination heartbeat (seconds): liveness is
+#: the consensus layer's job; the device plane learns of death from
+#: collective errors (see module docstring).
+_NO_HEARTBEAT = 10 ** 7
+
+
+def serve_coordinator(addr: str, n_processes: int) -> None:
+    """Host the jax.distributed coordination service and nothing else.
+
+    The service lives in its OWN process, outside every replica: a
+    replica that hosted it would couple the whole mesh's fate to its
+    own — the runtime's error-polling treats "coordination service
+    unreachable" as LOG(FATAL) and terminates every member (observed
+    empirically), turning one replica crash into a total outage.  A
+    dedicated coordinator is never a fault-injection target, exactly
+    like the reference's IB subnet manager is not one of the replicas.
+    Blocks forever (run it under a supervisor)."""
+    from jax._src.lib import _jax
+    svc = _jax.get_distributed_runtime_service(
+        addr, n_processes,
+        heartbeat_timeout=_NO_HEARTBEAT, shutdown_timeout=5)
+    import time as _time
+    print(f"APUS-MESH-COORDINATOR ready at {addr} for {n_processes} "
+          f"processes", flush=True)
+    try:
+        while True:
+            _time.sleep(3600)
+    finally:
+        del svc
+
+
+def init_distributed(coordinator: str, n_processes: int, process_id: int,
+                     platform: str = "cpu",
+                     init_timeout: int = 120,
+                     host_service: bool = False) -> None:
+    """Bring up ``jax.distributed`` with consensus-friendly failure
+    semantics (no heartbeat-triggered process termination, no exit-time
+    shutdown barrier).  Must run before the first jax backend
+    initialization in this process.  ``platform='cpu'`` pins the CPU
+    backend (gloo collectives) for CPU deployments/tests; '' leaves the
+    platform alone (real TPU pods).  ``host_service`` embeds the
+    coordination service in process 0 — ONLY for hermetic harnesses
+    (dryrun); deployments run ``serve_coordinator`` in its own process
+    (see its docstring for why)."""
+    import os
+
+    import jax
+
+    if platform:
+        os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+        # Exactly ONE local device per process: shard r must live on
+        # process r.  A virtual multi-device flag inherited from a test
+        # environment (xla_force_host_platform_device_count) would give
+        # every process N local devices and put the whole mesh's first
+        # N shards on process 0.
+        flags = os.environ.get("XLA_FLAGS", "")
+        scrubbed = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f)
+        if scrubbed != flags:
+            os.environ["XLA_FLAGS"] = scrubbed
+        try:
+            jax.config.update("jax_platforms", platform)
+            if platform == "cpu":
+                jax.config.update("jax_num_cpu_devices", 1)
+        except RuntimeError:
+            pass                        # backend already up: caller's bed
+    from jax._src import distributed
+    from jax._src.lib import _jax
+
+    state = distributed.global_state
+    if state.client is not None:
+        return                          # already initialized
+    if host_service and process_id == 0:
+        state.service = _jax.get_distributed_runtime_service(
+            coordinator, n_processes,
+            heartbeat_timeout=_NO_HEARTBEAT, shutdown_timeout=5)
+    state.client = _jax.get_distributed_runtime_client(
+        coordinator, process_id, init_timeout=init_timeout,
+        heartbeat_timeout=_NO_HEARTBEAT, shutdown_on_destruction=False,
+        use_compression=True)
+    state.client.connect()
+    state.process_id = process_id
+    state.num_processes = n_processes
+    state.coordinator_address = coordinator
+
+
+@dataclasses.dataclass
+class _RoundDesc:
+    """Everything a follower needs to dispatch the identical program."""
+
+    gen: int
+    seq: int
+    leader: int
+    term: int
+    end0: int
+    mask_old: list
+    mask_new: list
+    q_old: int
+    q_new: int
+
+    def encode(self) -> bytes:
+        return (wire.u8(OP_MESH) + wire.u8(_SUB_ROUND)
+                + wire.u64(self.gen) + wire.u64(self.seq)
+                + wire.u8(self.leader) + wire.u64(self.term)
+                + wire.u64(self.end0) + wire.u8(self.q_old)
+                + wire.u8(self.q_new)
+                + wire.blob(bytes(self.mask_old))
+                + wire.blob(bytes(self.mask_new)))
+
+    @staticmethod
+    def decode(r: wire.Reader) -> "_RoundDesc":
+        gen, seq = r.u64(), r.u64()
+        leader, term, end0 = r.u8(), r.u64(), r.u64()
+        q_old, q_new = r.u8(), r.u8()
+        mask_old = list(r.blob())
+        mask_new = list(r.blob())
+        return _RoundDesc(gen, seq, leader, term, end0,
+                          mask_old, mask_new, q_old, q_new)
+
+
+class _PeerFeed:
+    """Per-peer FIFO descriptor sender: one dedicated TCP connection to
+    the peer's PeerServer, one thread draining a queue of frames.  Any
+    send/ack failure marks the feed dead and trips the runner's
+    deactivation — a follower that misses one descriptor can never
+    rejoin the dispatch sequence (module docstring rule 3 covers
+    orderings, not losses)."""
+
+    def __init__(self, addr: tuple, on_dead, timeout: float = 2.0):
+        self.addr = addr
+        self.on_dead = on_dead
+        self.timeout = timeout
+        self.q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self.dead = False
+        self._sock: Optional[socket.socket] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def send(self, payload: bytes) -> None:
+        if not self.dead:
+            self.q.put(payload)
+
+    def close(self) -> None:
+        self.q.put(None)
+
+    def _run(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is None:
+                break
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.addr, timeout=self.timeout)
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                    self._sock.settimeout(self.timeout)
+                self._sock.sendall(wire.frame(item))
+                resp = wire.read_frame(self._sock)
+                if resp is None or resp[:1] != bytes([wire.ST_OK]):
+                    raise ConnectionError(f"mesh feed nack {resp!r}")
+            except Exception as e:                    # noqa: BLE001
+                self.dead = True
+                self.on_dead(self.addr, e)
+                break
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+
+
+class MeshWindowHandle:
+    """In-flight window handle (device-side commits vector + the
+    expectations to account for it at resolve time)."""
+
+    __slots__ = ("gen", "end0", "K", "commits", "poisoned")
+
+    def __init__(self, gen: int, end0: int, K: int, commits,
+                 poisoned: bool = False):
+        self.gen, self.end0, self.K = gen, end0, K
+        self.commits, self.poisoned = commits, poisoned
+
+
+class MeshCommitRunner:
+    """Driver-facing runner whose shards live one-per-process on a
+    global mesh.  Exposes the DeviceCommitRunner surface the
+    DevicePlaneDriver consumes, plus ``FIXED_WINDOW`` (the single
+    window shape every dispatch uses)."""
+
+    WIRE_OVERHEAD = 64
+
+    def __init__(self, spec, idx: int, logger=None):
+        self.spec = spec
+        self.idx = idx
+        self.logger = logger
+        self.n_replicas = spec.mesh_n
+        self.batch = spec.max_batch
+        K = spec.mesh_depth
+        self.FIXED_WINDOW = K
+        # Driver compatibility: every rung IS the fixed window.
+        self.PIPE_DEPTH = K
+        self.DEEP_DEPTH = K
+        self.window_depths = [K]
+        self.use_async_windows = True
+        self.slot_bytes = spec.mesh_slot_bytes
+        # Ring sized for the deployable async shape by default:
+        # MAX_INFLIGHT windows in flight plus one staging must fit
+        # ((inflight+K)*B <= S, the driver's capacity gate).
+        self.n_slots = spec.mesh_slots or 4 * K * self.batch
+        self.lock = threading.Lock()
+        self.generation = 0
+        self._worker_gen = 0            # generation of the worker's arrays
+        self._term = 0
+        self._leader: Optional[int] = None
+        self._next_end0: Optional[int] = None
+        self._seq = 0                   # leader-side descriptor ordinal
+        self._expect_seq = 0            # follower-side ordinal (per gen)
+        self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
+                      "entries_devplane": 0, "pipelined_dispatches": 0,
+                      "poisoned_rounds": 0}
+        self.depth_histogram: dict[int, int] = {}
+        self.pallas_modes: dict[int, Optional[str]] = {K: None}
+        self.ready = False
+        self.dead = False
+        self.death_reason: Optional[str] = None
+        self._devlog = None
+        self._q: "queue.Queue" = queue.Queue()
+        #: every dispatched-but-unresolved window (leader AND follower
+        #: sides) — quiesce_ready() gates votes on all of them.
+        self._outstanding: list[MeshWindowHandle] = []
+        self._quiesce_since = None      # unready-window stopwatch
+        self._feeds: dict[int, _PeerFeed] = {}
+        self._daemon = None             # attach() target
+        self._stop = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def attach(self, daemon) -> None:
+        """Bind the (single) local daemon: the worker's term checks and
+        dispatch ordering are serialized through its lock."""
+        self._daemon = daemon
+
+    def start(self) -> None:
+        """Kick off the (blocking, collective) distributed bring-up in
+        the background; the daemon serves TCP consensus immediately and
+        the driver engages once ``ready``."""
+        t = threading.Thread(target=self._build, daemon=True,
+                             name=f"apus-mesh-build-{self.idx}")
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        for f in self._feeds.values():
+            f.close()
+
+    def max_data_bytes(self) -> int:
+        return self.slot_bytes - self.WIRE_OVERHEAD
+
+    # -- build (background thread; rendezvous with every process) ---------
+
+    def _build(self) -> None:
+        try:
+            import jax
+
+            init_distributed(self.spec.mesh_coordinator, self.n_replicas,
+                             self.idx, platform=self.spec.mesh_platform)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from apus_tpu.ops.commit import build_pipelined_commit_step
+            from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+
+            devices = jax.devices()
+            if len(devices) < self.n_replicas:
+                raise RuntimeError(
+                    f"mesh plane needs {self.n_replicas} global devices, "
+                    f"have {len(devices)}")
+            self._mesh = replica_mesh(self.n_replicas,
+                                      devices=devices[:self.n_replicas])
+            # Shard r must live on process r: the local-shard read path
+            # and the leader's local staging both assume it.
+            for r, d in enumerate(self._mesh.devices.flat):
+                if d.process_index != r:
+                    raise RuntimeError(
+                        f"mesh device order: shard {r} on process "
+                        f"{d.process_index}")
+            self._sharding = NamedSharding(self._mesh, P(REPLICA_AXIS))
+            self._staged_sharding = NamedSharding(self._mesh,
+                                                  P(None, REPLICA_AXIS))
+            K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
+            self._pipe = build_pipelined_commit_step(
+                self._mesh, self.n_replicas, self.n_slots, SB, B,
+                depth=K, staged_depth=K, verify_round=True)
+            self._jax = jax
+            self._np_staged_zero = np.zeros((K, 1, B, SB), np.uint8)
+            self._np_meta_zero = np.zeros((K, 1, B, 4), np.int32)
+            self._warmup()
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"apus-mesh-worker-{self.idx}").start()
+            self.ready = True
+            if self.logger is not None:
+                self.logger.info(
+                    "mesh plane ready: %d processes, window=%dx%d, "
+                    "ring=%d slots", self.n_replicas, K, B, self.n_slots)
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"mesh build failed: {e!r}")
+
+    def _warmup(self) -> None:
+        """All processes run the identical warmup (fresh arrays + one
+        window) — the first cross-process rendezvous, paying compile
+        before any leadership depends on it."""
+        devlog = self._fresh_devlog(first_idx=1, leader=0, term=0)
+        sdata, smeta = self._stage_local(None)
+        ctrl = self._ctrl(0, 0, 1, [1] * self.n_replicas,
+                          [0] * self.n_replicas,
+                          quorum_size(self.n_replicas), 0)
+        devlog, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
+        np.asarray(commits)             # block: every process arrived
+        # Warm the local-shard read path too (first .addressable_shards
+        # readback can trigger a transfer-compile on some backends).
+        np.asarray(devlog.offs.addressable_shards[0].data)
+        del devlog
+
+    def _fresh_devlog(self, first_idx: int, leader: int, term: int):
+        from apus_tpu.ops.logplane import make_device_log
+        return make_device_log(
+            self.n_replicas, self.n_slots, self.slot_bytes,
+            batch=self.batch, first_idx=first_idx, leader=leader,
+            term=term, sharding=self._sharding)
+
+    def _stage_local(self, encoded):
+        """Build the global staged arrays from THIS process's local
+        shard only: the leader passes (data, meta) [K,B,SB]/[K,B,4];
+        followers pass None (zeros).  No cross-process communication —
+        the in-step pmax moves the payload."""
+        jax = self._jax
+        K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
+        R = self.n_replicas
+        if encoded is None:
+            ld, lm = self._np_staged_zero, self._np_meta_zero
+        else:
+            ld = encoded[0].reshape(K, 1, B, SB)
+            lm = encoded[1].reshape(K, 1, B, 4)
+        data = jax.make_array_from_process_local_data(
+            self._staged_sharding, ld, (K, R, B, SB))
+        meta = jax.make_array_from_process_local_data(
+            self._staged_sharding, lm, (K, R, B, 4))
+        return data, meta
+
+    def _ctrl(self, leader, term, end0, mask_old, mask_new, q_old, q_new):
+        import jax.numpy as jnp
+
+        from apus_tpu.ops.commit import CommitControl
+        i32 = lambda v: jnp.asarray(v, jnp.int32)     # noqa: E731
+        return CommitControl(
+            i32(leader), i32(term), i32(end0),
+            jnp.asarray(np.array(mask_old, np.int32)),
+            jnp.asarray(np.array(mask_new, np.int32)),
+            i32(q_old), i32(q_new))
+
+    def _die(self, reason: str) -> None:
+        """Degrade to TCP: block all DISPATCH paths, but keep the shard
+        arrays READABLE.  A follower's pre-vote drain must still be able
+        to absorb rows that completed windows landed in its shard —
+        discarding them here would let an election proceed without
+        entries the dead leader may have acked to clients (they are
+        nowhere else yet when the mesh carries the entry transport).
+        Reads stay local (no collective), so a live process can always
+        attempt them; if the LAST window errored mid-execution its
+        donated buffers are poisoned and the read itself fails — that
+        residual (≤ one window of undrained rows lost with the plane)
+        is the device plane's shared failure domain, exactly as a TPU
+        slice loss takes in-flight HBM state with it."""
+        with self.lock:
+            if self.dead:
+                return
+            self.dead = True
+            self.death_reason = reason
+            self._outstanding.clear()
+        if self.logger is not None:
+            self.logger.error("mesh plane DEAD: %s (TCP plane continues)",
+                              reason)
+        for f in self._feeds.values():
+            f.close()
+        # Fail every caller still parked on a queued round's result —
+        # the worker will dispatch nothing further.
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item and item[0] == "round" and item[3] is not None:
+                    item[3].put(None)
+        except queue.Empty:
+            pass
+
+    def _feed_dead(self, addr, exc) -> None:
+        self._die(f"descriptor feed to {addr} failed: {exc!r}")
+
+    # -- the single dispatch authority ------------------------------------
+
+    def _worker_loop(self) -> None:
+        """The ONLY thread that dispatches device programs in this
+        process — the global program order is the descriptor order,
+        identical on every process by construction (rule 2/3)."""
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                if item[0] == "reset":
+                    self._do_reset(*item[1:])
+                else:
+                    self._do_round(*item[1:])
+            except Exception as e:                    # noqa: BLE001
+                self._die(f"worker dispatch failed: {e!r}")
+                if item[0] == "round" and item[3] is not None:
+                    item[3].put(None)
+                return
+
+    def _do_reset(self, gen: int, leader: int, term: int,
+                  first_idx: int) -> None:
+        with self.lock:
+            if term < self._term or gen <= self._worker_gen:
+                return                  # stale leadership's reset
+        devlog = self._fresh_devlog(first_idx, leader, term)
+        with self.lock:
+            self._devlog = devlog
+            self._worker_gen = gen
+            self.generation = max(self.generation, gen)
+            self._leader, self._term = leader, term
+            if self.idx != leader:
+                # Leader-side _next_end0 was set synchronously in
+                # reset() and may already have advanced past first_idx
+                # by the time this queue item runs — never clobber it.
+                self._next_end0 = first_idx
+            self._expect_seq = 0
+            self.stats["resets"] += 1
+        if self.logger is not None:
+            self.logger.info("mesh plane reset: gen=%d leader=%d term=%d "
+                             "base=%d", gen, leader, term, first_idx)
+
+    def _do_round(self, desc: _RoundDesc, encoded, result_q) -> None:
+        """Dispatch one window.  ``encoded`` is the leader's staged
+        window or None (follower).  ``result_q`` (leader only) receives
+        the window handle.  ALWAYS dispatches (rule 3) unless the
+        plane is dead."""
+        sdata, smeta = self._stage_local(encoded)
+        daemon = self._daemon
+        lock = daemon.lock if daemon is not None else threading.RLock()
+        with lock:
+            with self.lock:
+                if self._devlog is None:
+                    raise RuntimeError("round before any reset/warmup")
+                poisoned = desc.gen != self._worker_gen
+                if not poisoned and desc.seq != self._expect_seq:
+                    # A gap in the CURRENT generation's stream means a
+                    # descriptor was lost: pairing can't be maintained.
+                    raise RuntimeError(
+                        f"descriptor gap: seq {desc.seq} != "
+                        f"{self._expect_seq}")
+                if not poisoned:
+                    self._expect_seq = desc.seq + 1
+                devlog = self._devlog
+            # Term check under the DAEMON lock (election safety): a
+            # round below our daemon's current term is poisoned — the
+            # in-collective vote fence.
+            node_term = (daemon.node.current_term
+                         if daemon is not None else desc.term)
+            if desc.term < node_term:
+                poisoned = True
+            if poisoned:
+                ctrl = self._ctrl(-3, max(node_term, desc.term) + 1,
+                                  desc.end0, desc.mask_old, desc.mask_new,
+                                  desc.q_old, desc.q_new)
+            else:
+                ctrl = self._ctrl(desc.leader, desc.term, desc.end0,
+                                  desc.mask_old, desc.mask_new,
+                                  desc.q_old, desc.q_new)
+            import time as _time
+            _t0 = _time.monotonic()
+            new_devlog, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
+            _ms = (_time.monotonic() - _t0) * 1e3
+            self.stats["max_dispatch_ms"] = max(
+                self.stats.get("max_dispatch_ms", 0.0), _ms)
+            if _ms > 50.0 and self.logger is not None:
+                self.logger.warning("mesh dispatch blocked %.0f ms "
+                                    "(seq=%d, daemon lock held)",
+                                    _ms, desc.seq)
+            with self.lock:
+                self._devlog = new_devlog
+                K = self.FIXED_WINDOW
+                if poisoned:
+                    self.stats["poisoned_rounds"] += 1
+                else:
+                    self.stats["rounds"] += K
+                    self.stats["entries_devplane"] += K * self.batch
+                    self.stats["pipelined_dispatches"] += 1
+                    self.depth_histogram[K] = \
+                        self.depth_histogram.get(K, 0) + 1
+                h = MeshWindowHandle(desc.gen, desc.end0,
+                                     self.FIXED_WINDOW, commits,
+                                     poisoned=poisoned)
+                self._outstanding.append(h)
+        if result_q is not None:
+            result_q.put(h)
+        # Follower pacing: bound the dispatched-unresolved pipeline so a
+        # backend failure surfaces promptly here (deactivating the
+        # plane) instead of silently poisoning the donated-array chain.
+        self._prune_outstanding(limit=4)
+
+    #: How long any blocking wait on a window may take before the plane
+    #: is declared dead.  The backend gives NO deadline of its own: a
+    #: collective missing one participant blocks until that process
+    #: EXITS (probed empirically — 400 s with both ends alive), so
+    #: every wait polls is_ready() against this budget instead of
+    #: parking forever.  Normal windows complete in milliseconds; this
+    #: only trips when a descriptor was lost or a peer wedged, both of
+    #: which already mean the plane must degrade to TCP.
+    WAIT_BUDGET_S = 10.0
+
+    def _wait_window(self, h: "MeshWindowHandle", what: str):
+        """Readiness-polled wait; returns the commits ndarray or None
+        after killing the plane (timeout or collective error)."""
+        import time as _time
+        deadline = _time.monotonic() + self.WAIT_BUDGET_S
+        try:
+            while not h.commits.is_ready():
+                if _time.monotonic() > deadline:
+                    self._die(f"{what}: window never completed "
+                              f"(missing participant?)")
+                    return None
+                if self._stop.is_set():
+                    return None
+                _time.sleep(0.0005)
+            return np.asarray(h.commits)
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"{what} failed: {e!r}")
+            return None
+
+    def _prune_outstanding(self, limit: int) -> None:
+        while True:
+            with self.lock:
+                if len(self._outstanding) <= limit:
+                    return
+                h = self._outstanding[0]
+            if self._wait_window(h, "window") is None:
+                return
+            with self.lock:
+                if self._outstanding and self._outstanding[0] is h:
+                    self._outstanding.pop(0)
+
+    def quiesce_ready(self) -> bool:
+        """Non-blocking pre-vote coverage check (module docstring,
+        election safety): True iff every window this process has
+        DISPATCHED is executed (its writes are in the shard, ready for
+        the pre-vote drain) or the plane is dead (a dead plane's
+        unresolved windows never produced a commit anyone adopted).
+
+        Returns False — VOTE VETO — while windows are still executing:
+        the election layer defers a tick instead of blocking, so the
+        daemon keeps ticking/serving while e.g. a dead leader's
+        half-dispatched collective takes seconds to error out.  A
+        window that stays unready past WAIT_BUDGET_S kills the plane
+        (the backend itself never times out; probed empirically)."""
+        import time as _time
+        if self.dead:
+            return True
+        with self.lock:
+            outstanding = list(self._outstanding)
+        for h in outstanding:
+            try:
+                ready = h.commits.is_ready()
+            except Exception as e:                    # noqa: BLE001
+                self._die(f"quiesce: window failed: {e!r}")
+                return True
+            if not ready:
+                now = _time.monotonic()
+                if self._quiesce_since is None:
+                    self._quiesce_since = now
+                elif now - self._quiesce_since > self.WAIT_BUDGET_S:
+                    self._die("quiesce: window never completed "
+                              "(missing participant?)")
+                    return True
+                return False
+        self._quiesce_since = None
+        with self.lock:
+            self._outstanding = [h for h in self._outstanding
+                                 if h not in outstanding]
+        return True
+
+    # -- leader-facing surface (DevicePlaneDriver) ------------------------
+
+    def reset(self, leader: int, term: int,
+              first_idx: int) -> Optional[int]:
+        """New leadership: fence the descriptor stream + fresh shards on
+        every process.  Only meaningful on the leader's process
+        (leader == self.idx)."""
+        if self.dead or not self.ready:
+            return None
+        assert leader == self.idx, (leader, self.idx)
+        with self.lock:
+            if term < self._term:
+                return None
+            gen = self.generation + 1
+            self.generation = gen
+            self._term = term
+            self._leader = leader
+            self._next_end0 = first_idx
+            self._seq = 0
+        payload = (wire.u8(OP_MESH) + wire.u8(_SUB_RESET) + wire.u64(gen)
+                   + wire.u8(leader) + wire.u64(term)
+                   + wire.u64(first_idx))
+        self._broadcast(payload)
+        self._q.put(("reset", gen, leader, term, first_idx))
+        if self.dead:
+            return None
+        return gen
+
+    def _broadcast(self, payload: bytes) -> None:
+        for r in range(self.n_replicas):
+            if r == self.idx:
+                continue
+            feed = self._feeds.get(r)
+            if feed is None or feed.dead:
+                addr = self._peer_addr(r)
+                if addr is None:
+                    self._die(f"no control endpoint for mesh peer {r}")
+                    return
+                feed = self._feeds[r] = _PeerFeed(addr, self._feed_dead)
+            feed.send(payload)
+
+    def _peer_addr(self, r: int) -> Optional[tuple]:
+        peers = self.spec.peers
+        if r >= len(peers) or not peers[r]:
+            return None
+        host, port = peers[r].rsplit(":", 1)
+        return host, int(port)
+
+    def commit_rounds_async(self, gen: int, end0: int,
+                            entries: list[LogEntry], cid,
+                            live: set[int]) -> Optional[MeshWindowHandle]:
+        """Stage + describe + dispatch one fixed window without waiting
+        for its result (collect via resolve_rounds).  ``entries`` must
+        be exactly FIXED_WINDOW * batch, idx-contiguous from end0."""
+        if self.dead or not self.ready:
+            return None
+        K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
+        assert len(entries) == K * B, (len(entries), K, B)
+        with self.lock:
+            if gen != self.generation:
+                return None
+            if end0 != self._next_end0:
+                return None
+            term = self._term
+            seq = self._seq
+            self._seq += 1
+            self._next_end0 = end0 + K * B
+        bd = np.zeros((K, B, SB), np.uint8)
+        bm = np.zeros((K, B, 4), np.int32)
+        for k in range(K):
+            self._encode_batch(entries[k * B:(k + 1) * B], end0 + k * B,
+                               bd[k], bm[k])
+        from apus_tpu.core.cid import CidState
+        R = self.n_replicas
+        mask_old = [1 if (cid.contains(i) and i < cid.size) else 0
+                    for i in range(R)]
+        if cid.state == CidState.TRANSIT:
+            mask_new = [1 if (cid.contains(i) and i < cid.new_size) else 0
+                        for i in range(R)]
+            q_new = quorum_size(cid.new_size)
+        else:
+            mask_new, q_new = [0] * R, 0
+        desc = _RoundDesc(gen, seq, self.idx, term, end0, mask_old,
+                          mask_new, quorum_size(cid.size), q_new)
+        self._broadcast(desc.encode())
+        if self.dead:
+            return None
+        result_q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._q.put(("round", desc, (bd, bm), result_q))
+        # Blocks only for the worker's ENQUEUE of the program (it
+        # dispatches promptly), not for execution.  Dead-aware wait: if
+        # the worker died on an EARLIER queue item, our item may never
+        # be serviced (the _die drain and this poll race; either way
+        # the caller must not park forever).
+        while True:
+            try:
+                h = result_q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if self.dead:
+                    return None
+        if h is not None and h.poisoned:
+            return None
+        return h
+
+    def _encode_batch(self, entries, end0, out_data, out_meta) -> None:
+        SB = self.slot_bytes
+        flat = memoryview(out_data.reshape(-1))
+        for j, e in enumerate(entries):
+            assert e.idx == end0 + j, (e.idx, end0, j)
+            size = wire.entry_wire_size(e)
+            if size > SB:
+                raise ValueError(f"entry {e.idx} wire size {size} > slot "
+                                 f"{SB}; segment upstream")
+            wire.encode_entry_into(e, flat, j * SB)
+            out_meta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
+                           int(e.type), size)
+
+    def commit_rounds(self, gen: int, end0: int, entries, cid,
+                      live) -> Optional[int]:
+        h = self.commit_rounds_async(gen, end0, entries, cid, live)
+        return None if h is None else self.resolve_rounds(h)
+
+    def commit_round(self, gen, end0, entries, cid, live):
+        raise NotImplementedError(
+            "mesh plane dispatches fixed windows only (FIXED_WINDOW)")
+
+    def resolve_rounds(self, h: MeshWindowHandle) -> Optional[int]:
+        commits_host = self._wait_window(h, "resolve")
+        if commits_host is None:
+            return None
+        B = self.batch
+        with self.lock:
+            if self._outstanding and h in self._outstanding:
+                self._outstanding.remove(h)
+            if h.gen != self.generation or h.poisoned:
+                return None
+            self.stats["quorum_fail_rounds"] += int(sum(
+                int(commits_host[k]) < h.end0 + (k + 1) * B
+                for k in range(h.K)))
+        return int(commits_host[-1])
+
+    # -- descriptor receive path (PeerServer extra op) --------------------
+
+    def on_descriptor(self, r: wire.Reader) -> bytes:
+        """Runs on a PeerServer connection thread (no node lock)."""
+        if self.dead:
+            return wire.u8(wire.ST_ERROR)
+        if not self.ready:
+            # Descriptors can only flow once every process passed the
+            # warmup rendezvous, so "not ready" means OUR build thread
+            # hasn't finished bookkeeping while a peer's has — refuse
+            # (the leader deactivates rather than desync).
+            return wire.u8(wire.ST_ERROR)
+        sub = r.u8()
+        if sub == _SUB_RESET:
+            gen = r.u64()
+            leader, term, first_idx = r.u8(), r.u64(), r.u64()
+            self._q.put(("reset", gen, leader, term, first_idx))
+            return wire.u8(wire.ST_OK)
+        if sub == _SUB_ROUND:
+            desc = _RoundDesc.decode(r)
+            self._q.put(("round", desc, None, None))
+            return wire.u8(wire.ST_OK)
+        return wire.u8(wire.ST_ERROR)
+
+    # -- local shard readback ---------------------------------------------
+
+    def _local_shard(self, arr):
+        shards = arr.addressable_shards
+        assert len(shards) == 1, len(shards)
+        return shards[0].data            # [1, ...] on our device
+
+    def shard_end(self, replica: int, gen: int) -> Optional[int]:
+        """Reads stay LOCAL and remain available even when the plane is
+        dead — the follower drain (and the pre-vote drain) must still
+        absorb rows completed windows landed in our shard (see _die)."""
+        from apus_tpu.ops.logplane import OFF_END
+        if replica != self.idx:
+            return None                 # only our own shard is local
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            offs = self._devlog.offs
+        try:
+            row = np.asarray(self._local_shard(offs))
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"shard read failed: {e!r}")
+            return None
+        return int(row[0, OFF_END])
+
+    def read_rows(self, replica: int, gen: int, lo: int, hi: int,
+                  window: bool = False) -> Optional[list[LogEntry]]:
+        from apus_tpu.ops.logplane import META_IDX, META_LEN, slot_of
+        if replica != self.idx:
+            return None
+        cap = self.batch * (self.FIXED_WINDOW if window else 1)
+        hi = min(hi, lo + cap)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            if hi <= lo:
+                return []
+            data_arr, meta_arr = self._devlog.data, self._devlog.meta
+        slots = slot_of(lo + np.arange(hi - lo, dtype=np.int64),
+                        self.n_slots).astype(np.int32)
+        try:
+            data = np.asarray(self._local_shard(data_arr))[0][slots]
+            meta = np.asarray(self._local_shard(meta_arr))[0][slots]
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"shard read failed: {e!r}")
+            return None
+        out: list[LogEntry] = []
+        for j, idx in enumerate(range(lo, hi)):
+            if int(meta[j, META_IDX]) != idx:
+                break
+            n = int(meta[j, META_LEN])
+            blob = data[j, :n].tobytes()
+            try:
+                e = wire.decode_entry(wire.Reader(blob))
+            except Exception:                         # noqa: BLE001
+                break
+            if e.idx != idx:
+                break
+            out.append(e)
+        return out
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.runtime.mesh_plane",
+        description="Host the mesh-plane coordination service "
+                    "(one per cluster, outside every replica).")
+    ap.add_argument("--serve-coordinator", required=True, metavar="ADDR",
+                    help="host:port to bind the coordination service on")
+    ap.add_argument("--n", type=int, required=True,
+                    help="number of mesh processes (replicas)")
+    a = ap.parse_args()
+    serve_coordinator(a.serve_coordinator, a.n)

@@ -40,6 +40,25 @@ from apus_tpu.utils.config import ClusterSpec
 PROC_SPEC = ClusterSpec(hb_period=0.001, hb_timeout=0.010,
                         elect_low=0.010, elect_high=0.030)
 
+#: Relaxed envelope for MESH-PLANE deployments on small boxes: the
+#: bring-up (jax import + compile x N processes) monopolizes the host
+#: for tens of seconds and would starve PROC_SPEC's 1 ms ticks into
+#: election churn.  Shared by the mesh e2e tests and fuzz campaign so
+#: both exercise the same deployable timing.
+MESH_PROC_SPEC = ClusterSpec(hb_period=0.010, hb_timeout=0.060,
+                             elect_low=0.150, elect_high=0.400)
+
+
+def _repo_env() -> dict:
+    """Child env with the repo root on PYTHONPATH (daemons AND the
+    mesh coordinator must resolve apus_tpu identically)."""
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in [root, env.get("PYTHONPATH")] if p])
+    return env
+
 
 class ProcCluster:
     """N replica processes on this host (the run.sh:23-31 analog).
@@ -56,13 +75,24 @@ class ProcCluster:
                  spec: Optional[ClusterSpec] = None,
                  db: bool = True,
                  spin_timeout_ms: int = 8000,
-                 tick_interval: Optional[float] = None):
+                 tick_interval: Optional[float] = None,
+                 device_plane: bool = False,
+                 mesh_depth: int = 4):
         self.n = n
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
         os.makedirs(self.workdir, exist_ok=True)
         base = dataclasses.replace(spec or PROC_SPEC)
         base.group_size = n
         base.peers = [f"127.0.0.1:{_free_port()}" for _ in range(n)]
+        if device_plane:
+            # Multi-controller mesh plane: each replica process owns one
+            # device of a jax.distributed CPU mesh (runtime.mesh_plane);
+            # replica 0 hosts the coordination service.
+            base.mesh_coordinator = f"127.0.0.1:{_free_port()}"
+            base.mesh_n = n
+            base.mesh_depth = mesh_depth
+            base.mesh_platform = "cpu"
+        self.device_plane = device_plane
         self.spec = base
         self.config_path = os.path.join(self.workdir, "cluster.json")
         with open(self.config_path, "w") as f:
@@ -82,6 +112,8 @@ class ProcCluster:
             for _ in range(n)]
         self.procs: list[Optional[subprocess.Popen]] = [None] * n
         self._logs: list = [None] * n
+        self._coord: Optional[subprocess.Popen] = None
+        self._coord_log = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -91,6 +123,17 @@ class ProcCluster:
         # full retry with fresh ports covers that rare loss.
         for attempt in (0, 1):
             try:
+                if self.device_plane:
+                    # Fresh coordinator address on EVERY cluster start:
+                    # each start is a new mesh epoch, so daemons'
+                    # per-incarnation markers (daemon._mesh_incarnation_
+                    # fresh) never suppress a legitimately fresh mesh.
+                    self.spec.mesh_coordinator = \
+                        f"127.0.0.1:{_free_port()}"
+                    with open(self.config_path, "w") as f:
+                        json.dump(dataclasses.asdict(self.spec), f,
+                                  indent=1)
+                    self._spawn_coordinator()
                 for i in range(self.n):
                     self._spawn(i)
                 deadline = time.monotonic() + timeout
@@ -105,6 +148,9 @@ class ProcCluster:
                 self.stop()
                 self.spec.peers = [f"127.0.0.1:{_free_port()}"
                                    for _ in range(self.n)]
+                if self.device_plane:
+                    self.spec.mesh_coordinator = \
+                        f"127.0.0.1:{_free_port()}"
                 self.app_ports = [
                     _free_port() if self._app_argv is not None else None
                     for _ in range(self.n)]
@@ -150,11 +196,7 @@ class ProcCluster:
         if self._logs[i] is None:
             self._logs[i] = open(
                 os.path.join(self.workdir, f"proc{tag}.out"), "ab")
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in [os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))),
-                env.get("PYTHONPATH")] if p])
+        env = _repo_env()
         # A stale ready file (unclean previous run in a reused workdir,
         # or a restart) would make _wait_ready return before the daemon
         # is actually up.
@@ -167,6 +209,32 @@ class ProcCluster:
         self.procs[i] = subprocess.Popen(
             argv, env=env, stdout=self._logs[i], stderr=subprocess.STDOUT,
             start_new_session=True)
+
+    def _spawn_coordinator(self) -> None:
+        """The mesh coordination service in its OWN process — outside
+        every replica, so fault injection on members can never trip the
+        runtime's fatal coordinator-unreachable path (mesh_plane.
+        serve_coordinator docstring)."""
+        self._stop_coordinator()
+        if self._coord_log is None:
+            self._coord_log = open(
+                os.path.join(self.workdir, "coordinator.out"), "ab")
+        env = _repo_env()
+        self._coord = subprocess.Popen(
+            [sys.executable, "-m", "apus_tpu.runtime.mesh_plane",
+             "--serve-coordinator", self.spec.mesh_coordinator,
+             "--n", str(self.n)],
+            env=env, stdout=self._coord_log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+
+    def _stop_coordinator(self) -> None:
+        if self._coord is not None and self._coord.poll() is None:
+            self._coord.terminate()
+            try:
+                self._coord.wait(timeout=3.0)
+            except subprocess.TimeoutExpired:
+                self._coord.kill()
+        self._coord = None
 
     def _ready_path(self, i: int) -> str:
         return os.path.join(self.workdir, f"ready{i}.json")
@@ -204,10 +272,14 @@ class ProcCluster:
                     p.kill()
                 p.wait(timeout=3.0)
             self.procs[i] = None
+        self._stop_coordinator()
         for i, f in enumerate(self._logs):
             if f is not None:
                 f.close()
                 self._logs[i] = None
+        if self._coord_log is not None:
+            self._coord_log.close()
+            self._coord_log = None
 
     def __enter__(self) -> "ProcCluster":
         self.start()

@@ -51,6 +51,19 @@ class ClusterSpec:
     # proxied application endpoint (config-proxy.c:14-45)
     app_host: str = "127.0.0.1"
     app_port: int = 8888
+    # multi-controller device plane (runtime.mesh_plane): one process
+    # per replica glued into a global jax.distributed mesh.  Enabled
+    # when mesh_coordinator AND mesh_n are set; replicas 0..mesh_n-1
+    # each own one device.  mesh_depth = rounds per fixed window;
+    # mesh_slots 0 = derive the deployable default from the window
+    # shape; mesh_platform "cpu" pins the CPU backend (gloo) for
+    # CPU deployments/tests ('' = leave alone on real TPU pods).
+    mesh_coordinator: str = ""
+    mesh_n: int = 0
+    mesh_depth: int = 4
+    mesh_slots: int = 0
+    mesh_slot_bytes: int = 2048
+    mesh_platform: str = "cpu"
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False

@@ -165,30 +165,82 @@ def run_devplane_schedule(trial: int, seed_base: int,
     return "ok"
 
 
-def run_proc_schedule(trial: int, seed_base: int) -> str:
+def run_proc_schedule(trial: int, seed_base: int,
+                      device_plane: bool = False) -> str:
     """One randomized fault schedule against the DEPLOYMENT shape: one
     daemon OS process per replica at the production timing envelope
     (hb=1 ms, elect=10-30 ms), real durable stores.  Client writes
     interleave with process kills (leader or follower, via SIGKILL'd
     process groups) and restarts (durable-store replay + catch-up, or
     rejoin after auto-removal); at the end every acked write must be
-    readable and all replicas converge."""
+    readable and all replicas converge.
+
+    ``device_plane=True`` runs the MULTI-CONTROLLER mesh deployment
+    (runtime.mesh_plane): each replica process owns one device of a
+    global jax.distributed mesh, and the schedule first PROVES commits
+    ride the device quorum before injecting any fault.  Kills then
+    degrade the plane to TCP (the ICI-slice model) — the campaign's
+    assertions (exactly-once, convergence) must hold through the
+    degradation, and restarted members catch up TCP-only."""
     import tempfile
     import time as _time
 
     from apus_tpu.runtime.client import ApusClient
     from apus_tpu.runtime.proc import ProcCluster
+    from apus_tpu.utils.config import ClusterSpec
 
     rng = random.Random(seed_base + trial)
     acked: dict[bytes, bytes] = {}
     seq = 0
+    # The mesh build (jax import + compile x N processes) starves the
+    # 1 ms envelope on a small box; use a relaxed one there.  Client
+    # timeout is widened too: after a leader dies with device windows
+    # in flight, elections legitimately wait out the backend error
+    # (~1-5 s; mesh_plane docstring) — the campaign asserts
+    # exactly-once and convergence, not failover latency.
+    # auto_remove stays OFF in the mesh campaign: its phases run slower
+    # (mesh bring-up + wider timeouts), giving the failure detector
+    # time to EVICT a killed member before its restart — after which a
+    # second kill inside the shrunken config is a legitimate quorum
+    # stall (puts cannot commit), which the simulator campaign already
+    # exercises with expected-stall bookkeeping.  This campaign's
+    # subject is the mesh plane's degradation semantics, not eviction.
+    import dataclasses as _dc
+
+    from apus_tpu.runtime.proc import MESH_PROC_SPEC
+    spec = _dc.replace(MESH_PROC_SPEC, auto_remove=False) \
+        if device_plane else None
+    ct = 15.0 if device_plane else 5.0
     with tempfile.TemporaryDirectory(prefix="apus-fuzz-proc") as td:
-        with ProcCluster(3, workdir=td) as pc:
-            with ApusClient(list(pc.spec.peers)) as c:
+        with ProcCluster(3, workdir=td, spec=spec,
+                         device_plane=device_plane) as pc:
+            with ApusClient(list(pc.spec.peers), timeout=ct) as c:
                 assert c.put(b"warm", b"w") == b"OK"
                 acked[b"warm"] = b"w"
+                if device_plane:
+                    # Fault-free preamble: the plane must be READY and
+                    # OWN commit before the schedule may degrade it —
+                    # otherwise the trial never exercised the mesh.
+                    deadline = _time.monotonic() + 120.0
+                    while _time.monotonic() < deadline:
+                        k, v = b"mw%d" % seq, b"mv%d" % seq
+                        seq += 1
+                        assert c.put(k, v) == b"OK"
+                        acked[k] = v
+                        st = pc.status(pc.leader_idx(timeout=10.0),
+                                       timeout=1.0)
+                        d = (st or {}).get("devplane") or {}
+                        if d.get("commits", 0) > 0:
+                            break
+                        if d.get("dead"):
+                            raise AssertionError(
+                                f"mesh died before any fault: {d}")
+                        _time.sleep(0.2)
+                    else:
+                        raise AssertionError(
+                            "device plane never owned commit pre-fault")
             for _ in range(rng.randint(2, 4)):
-                with ApusClient(list(pc.spec.peers)) as c:
+                with ApusClient(list(pc.spec.peers), timeout=ct) as c:
                     for _ in range(rng.randint(5, 30)):
                         k, v = b"p%d" % seq, b"pv%d" % seq
                         seq += 1
@@ -209,7 +261,7 @@ def run_proc_schedule(trial: int, seed_base: int) -> str:
             # Convergence (shared wire-visible criterion), then every
             # acked write reads back.
             pc.wait_converged(timeout=30.0)
-            with ApusClient(list(pc.spec.peers)) as c:
+            with ApusClient(list(pc.spec.peers), timeout=ct) as c:
                 for k, v in acked.items():
                     got = c.get(k)
                     assert got == v, (k, got, v)
@@ -275,10 +327,11 @@ def main() -> int:
     failures = []
     for trial in range(args.trials):
         try:
-            if args.device_plane:
+            if args.proc:
+                r = run_proc_schedule(trial, args.seed_base,
+                                      device_plane=args.device_plane)
+            elif args.device_plane:
                 r = _devplane_trial_subprocess(trial, args.seed_base)
-            elif args.proc:
-                r = run_proc_schedule(trial, args.seed_base)
             else:
                 r = run_schedule(trial, args.seed_base, args.auto_remove)
             if r == "ok":
@@ -296,7 +349,9 @@ def main() -> int:
     eligible = args.trials - stalls
     pct = 100.0 if eligible <= 0 else round(100.0 * ok / eligible, 1)
     print(json.dumps({
-        "metric": ("devplane_fuzz_clean_pct" if args.device_plane
+        "metric": ("proc_devplane_fuzz_clean_pct"
+                   if args.proc and args.device_plane
+                   else "devplane_fuzz_clean_pct" if args.device_plane
                    else "proc_fuzz_clean_pct" if args.proc
                    else "protocol_fuzz_clean_pct"),
         "value": pct,

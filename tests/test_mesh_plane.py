@@ -1,0 +1,192 @@
+"""Multi-controller device plane (runtime.mesh_plane) e2e tests.
+
+These spawn REAL replica processes (ProcCluster) glued into a global
+jax.distributed CPU mesh — one device per process, gloo collectives —
+and assert that commits actually ride the device quorum in the
+process-per-replica deployment shape, and that member death degrades
+the plane to TCP without hurting consensus.
+
+Slower than the in-process tests (each daemon imports jax and the
+group pays one compile rendezvous), so the timing envelope here is the
+DEBUG-ish one, not PROC_SPEC: three jax processes on a small CI box
+starve each other's tick threads during the build.
+"""
+
+import time
+
+import pytest
+
+from apus_tpu.runtime.client import ApusClient
+from apus_tpu.runtime.proc import MESH_PROC_SPEC as MESH_SPEC, ProcCluster
+
+pytestmark = pytest.mark.mesh
+
+
+def _wait_mesh_ready(pc: ProcCluster, timeout: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        sts = [pc.status(i, timeout=1.0) for i in range(pc.n)]
+        last = [s.get("devplane") if s else None for s in sts]
+        if all(d and d.get("dead") is False and d.get("ready")
+               for d in last):
+            return
+        for d in last:
+            if d and d.get("dead"):
+                raise AssertionError(f"mesh died during bring-up: {d}")
+        time.sleep(0.5)
+    raise AssertionError(f"mesh plane never ready: {last}")
+
+
+def _devplane(pc: ProcCluster, i: int) -> dict:
+    st = pc.status(i, timeout=1.0)
+    assert st is not None, f"replica {i} unreachable"
+    return st.get("devplane") or {}
+
+
+def _pump_until(pc: ProcCluster, pred, c: ApusClient, timeout: float,
+                tag: bytes) -> int:
+    """Write through the cluster until ``pred()`` holds; returns how
+    many writes were issued.  Fails the test on timeout."""
+    deadline = time.monotonic() + timeout
+    n = 0
+    while time.monotonic() < deadline:
+        c.put(b"%s-%d" % (tag, n), b"v%d" % n)
+        n += 1
+        if pred():
+            return n
+    raise AssertionError(f"condition not reached after {n} writes")
+
+
+def test_mesh_plane_commits_ride_device_quorum(tmp_path):
+    """The headline deployment shape: N processes, each one device of
+    the global mesh; the leader's commits are decided by the device
+    quorum (node.external_commit -> devplane commits), and followers
+    DRAIN entries out of their own shards (the device plane IS the
+    entry transport for them)."""
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"), spec=MESH_SPEC,
+                     device_plane=True, db=False)
+    pc.start(timeout=60.0)
+    try:
+        _wait_mesh_ready(pc)
+        lead = pc.leader_idx(timeout=30.0)
+        with ApusClient(list(pc.spec.peers)) as c:
+            writes = _pump_until(
+                pc, lambda: _devplane(pc, pc.leader_idx(timeout=5.0))
+                .get("commits", 0) > 0, c, timeout=60.0, tag=b"mk")
+            # Consistency through the device-owned path.
+            assert c.put(b"mesh-final", b"ok") == b"OK"
+            assert c.get(b"mesh-final") == b"ok"
+        lead = pc.leader_idx(timeout=10.0)
+        dl = _devplane(pc, lead)
+        assert dl["commits"] > 0, dl
+        assert dl["rounds"] > 0, dl
+        assert dl["dead"] is False, dl
+        # Followers drained rows from their own device shards.
+        pc.wait_converged(timeout=30.0)
+        drained = [_devplane(pc, i).get("drained", 0)
+                   for i in range(3) if i != lead]
+        assert any(d > 0 for d in drained), (lead, drained, writes)
+    finally:
+        pc.stop()
+
+
+def test_mesh_plane_member_death_degrades_to_tcp(tmp_path):
+    """ICI-slice failure semantics: killing one replica process makes
+    the collective error out on the survivors; the plane deactivates
+    (dead=True, commit ownership back to the host path) and consensus
+    continues over TCP — including a leader failover afterwards."""
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"), spec=MESH_SPEC,
+                     device_plane=True, db=False)
+    pc.start(timeout=60.0)
+    try:
+        _wait_mesh_ready(pc)
+        lead = pc.leader_idx(timeout=30.0)
+        with ApusClient(list(pc.spec.peers)) as c:
+            _pump_until(pc, lambda: _devplane(pc, lead)
+                        .get("commits", 0) > 0, c, timeout=60.0, tag=b"dk")
+            victim = next(i for i in range(3) if i != lead)
+            pc.kill(victim)
+            # Writes must keep succeeding throughout the degradation
+            # (the client retries internally; exactly-once holds).
+            for i in range(30):
+                assert c.put(b"deg-%d" % i, b"x") == b"OK"
+            # The survivors' plane must have deactivated (a 2-member
+            # gloo clique can't include the dead process) OR have
+            # stopped being used; either way commits keep flowing.
+            assert c.get(b"deg-29") == b"x"
+            st = pc.status(pc.leader_idx(timeout=10.0), timeout=1.0)
+            assert st["commit"] > 0
+        # Restart the victim: it catches up TCP-only (the mesh slice
+        # does not re-admit members — its build can't rejoin the gen-0
+        # rendezvous — exactly like a TPU slice needing a restart).
+        pc.restart(victim, timeout=60.0)
+        pc.wait_converged(timeout=30.0)
+        # And a failover on top of the degraded plane still works.
+        t = pc.measure_failover(timeout=30.0)
+        assert t < 10.0, f"failover took {t:.1f}s"
+        with ApusClient(list(pc.spec.peers)) as c:
+            assert c.get(b"deg-29") == b"x"
+            assert c.put(b"post-failover", b"y") == b"OK"
+    finally:
+        pc.stop()
+
+
+def test_mesh_plane_replicates_real_redis(tmp_path):
+    """The VERDICT headline done-criterion: real unmodified redis in
+    the process-per-replica deployment, with commit owned by the
+    multi-controller device mesh — every replica process one device,
+    entries moving shard-to-shard, follower replay into each local
+    redis."""
+    import os
+
+    from apus_tpu.runtime.appcluster import (REDIS_RUN, REDIS_SERVER,
+                                             REDIS_TARBALL, RespClient,
+                                             build_native, build_redis)
+    if not (os.path.exists(REDIS_SERVER) or os.path.exists(REDIS_TARBALL)):
+        pytest.skip("pinned redis unavailable")
+    build_native()
+    if not build_redis():
+        pytest.skip("pinned redis failed to build")
+
+    def _wait_key(addr, key, want, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            with RespClient(addr) as c:
+                last = c.cmd("GET", key)
+            if last == want:
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"GET {key} = {last!r}, want {want!r}")
+
+    pc = ProcCluster(3, app_argv=[REDIS_RUN], workdir=str(tmp_path / "c"),
+                     spec=MESH_SPEC, device_plane=True)
+    pc.start(timeout=90.0)
+    try:
+        _wait_mesh_ready(pc)
+        leader = pc.leader_idx(timeout=30.0)
+        # Wait until the device plane owns commit on the leader.
+        deadline = time.monotonic() + 60
+        with RespClient(pc.app_addr(leader)) as c:
+            i = 0
+            while time.monotonic() < deadline:
+                assert c.cmd("SET", f"mrk:{i}", f"mrv:{i}") == "OK"
+                i += 1
+                d = _devplane(pc, leader)
+                if d.get("commits", 0) > 0 and d.get("owns_commit"):
+                    break
+            else:
+                raise AssertionError(
+                    f"device plane never owned commit: {_devplane(pc, leader)}")
+            assert c.cmd("SET", "mrk:last", "mrv:last") == "OK"
+        # Every replica's LOCAL redis converges via follower replay of
+        # device-drained entries.
+        for r in range(3):
+            _wait_key(pc.app_addr(r), "mrk:last", b"mrv:last")
+            with RespClient(pc.app_addr(r)) as c:
+                assert c.cmd("GET", "mrk:0") == b"mrv:0"
+        d = _devplane(pc, leader)
+        assert d["commits"] > 0 and d["dead"] is False, d
+    finally:
+        pc.stop()
