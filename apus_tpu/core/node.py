@@ -195,6 +195,18 @@ class Node:
         # >= log.head (see make_snapshot).
         self._snap_cache: Optional[tuple[Snapshot, list, Cid, dict]] = None
         self._snap_stream_cache: Optional[tuple] = None
+        # Background snapshot streaming (runtime deployments set
+        # async_snap_push=True): a chunked push takes seconds at deep
+        # history, and running it inline would hold THIS replica's tick
+        # thread — heartbeats included — for the duration.  A push
+        # thread per target peer runs the stream (the transport is
+        # peer-locked and the chunk reads are generation-fenced preads,
+        # both thread-safe); the tick loop consumes completions.  The
+        # sim keeps the inline path (deterministic, no threads).
+        self.async_snap_push = False
+        self._snap_pushing: set[int] = set()
+        #: peer -> (term_at_start, result, pushed_last_idx)
+        self._snap_push_done: dict[int, tuple] = {}
         # Determinant of the last applied entry — the snapshot anchor
         # (snapshot_t.last_entry analog, dare_log.h:107-112); survives
         # pruning, unlike log.get(apply-1).
@@ -453,16 +465,46 @@ class Node:
 
     def install_snapshot(self, snap: Snapshot, ep_dump: list,
                          cid: Optional[Cid] = None,
-                         member_addrs: Optional[dict] = None) -> bool:
+                         member_addrs: Optional[dict] = None,
+                         data_path: Optional[str] = None,
+                         adopt: bool = False) -> bool:
         """Install a snapshot pushed by the leader (rc_recover_sm analog,
         dare_ibv_rc.c:603-689): replaces SM + dedup state, re-bases the
         log just past the snapshot, and adopts the snapshot-point
         configuration (synthetic CONFIG upcalls let the runtime learn
         the peer table it would have built from the skipped entries).
-        Rejected when stale."""
+        Rejected when stale.
+
+        ``data_path`` installs from a FILE instead of ``snap.data``
+        (the streamed-receive path): the SM may ADOPT the file
+        (``adopt=True`` — rename, no copy, nothing materialized), and
+        the upcall snapshot carries (path, immutable-prefix length,
+        dump generation) so persistence can stream its copy later
+        (the prefix stays valid until another install bumps the
+        generation)."""
         if snap.last_idx < self.log.commit:
             return False                     # we already have more
-        self.sm.apply_snapshot(snap)
+        if data_path is not None:
+            import os as _os
+            stable = self.sm.apply_snapshot_file(snap, data_path,
+                                                 adopt=adopt)
+            if stable is None:
+                # SM without a stable dump file (materializing
+                # fallback — small states by construction): carry the
+                # blob in the upcall so persistence still records the
+                # full install; the caller's temp file is about to be
+                # unlinked and must NOT be referenced.
+                with open(data_path, "rb") as f:
+                    snap = dataclasses.replace(snap, data=f.read())
+            else:
+                snap = dataclasses.replace(
+                    snap, data=b"", data_path=stable,
+                    data_len=_os.path.getsize(stable),
+                    data_gen=getattr(self.sm, "dump_generation", 0))
+            self.stats["snapshots_file_installed"] = \
+                self.stats.get("snapshots_file_installed", 0) + 1
+        else:
+            self.sm.apply_snapshot(snap)
         self.epdb.load(ep_dump)
         # Adopt the snapshot point's partial chunk groups: finals
         # applying above the snapshot find their early chunks here.
@@ -894,6 +936,22 @@ class Node:
             # heartbeat timeout, the match state is stale: re-adjust.
             # (The reference re-reads follower state on every commit
             # loop instead, rc_write_remote_logs dare_ibv_rc.c:1883-1945.)
+            # Consume a background snapshot-push completion FIRST: once
+            # the peer installed, its acks fast-forward next_idx past
+            # our head and the push branch below never runs again for
+            # it — the completion (stats + cursor/failure bookkeeping)
+            # must not strand.  Stale-term completions are dropped.
+            done = self._snap_push_done.pop(peer, None)
+            if done is not None and done[0] == my.term:
+                self._finish_snap_push(peer, done[1], done[2], now,
+                                       streamed=True)
+            if peer in self._snap_pushing:
+                # Background stream in flight: the tick thread must not
+                # touch this peer AT ALL — its per-peer transport lock
+                # is held frame-by-frame by the push thread, so even a
+                # watchdog log_read_state here would park heartbeats
+                # behind a (up to SNAP_END-cap) wire wait.
+                continue
             ack = self.regions.ctrl[Region.REP_ACK][peer]
             if (self._adjusted.get(peer, False) and ack is not None
                     and ack < self._next_idx.get(peer, 0)):
@@ -957,29 +1015,77 @@ class Node:
                             return b""
                         return self.sm.read_snapshot_chunk(off, n)
 
+                    if self.async_snap_push:
+                        # Off-tick streaming: BEGIN/CHUNK.../END run on
+                        # a dedicated thread so this tick thread (and
+                        # its heartbeats) never waits on a multi-second
+                        # transfer OR the receiver's install.
+                        #
+                        # Concurrency safety of the chunk reads: the
+                        # generation check alone is NOT atomic with the
+                        # pread once they run off-tick — an install
+                        # could replace the dump between them.  So the
+                        # thread reads through a fd DUPLICATED NOW
+                        # (under the lock, generation verified):
+                        # installs give the dump a fresh inode
+                        # (RelayStateMachine replace-never-truncate),
+                        # so the pinned fd serves the immutable
+                        # captured prefix forever; the generation check
+                        # remains only as an early-abort optimization.
+                        if getattr(self.sm, "dump_generation", 0) != gen:
+                            self._snap_stream_cache = None
+                            continue       # stale meta: retry next pass
+                        dupper = getattr(self.sm, "dup_dump_fd", None)
+                        dup_fd = dupper() if dupper is not None else None
+                        self._snap_pushing.add(peer)
+                        import os as _os
+                        import threading as _threading
+
+                        def _read_pinned(off, n, _gen=gen, _fd=dup_fd):
+                            if getattr(self.sm, "dump_generation",
+                                       0) != _gen:
+                                return b""        # early abort
+                            if _fd is not None:
+                                return _os.pread(_fd, n, off)
+                            return self.sm.read_snapshot_chunk(off, n)
+
+                        def _push(peer=peer, my=my, meta=meta,
+                                  ep_dump=ep_dump, snap_cid=snap_cid,
+                                  members=members, total=total,
+                                  read_chunk=_read_pinned,
+                                  dup_fd=dup_fd):
+                            try:
+                                r = self.t.snap_push_stream(
+                                    peer, my, meta, ep_dump, snap_cid,
+                                    members, total, read_chunk)
+                            except Exception:        # noqa: BLE001
+                                r = WriteResult.DROPPED
+                            finally:
+                                if dup_fd is not None:
+                                    try:
+                                        _os.close(dup_fd)
+                                    except OSError:
+                                        pass
+                            self._snap_push_done[peer] = \
+                                (my.term, r, meta.last_idx)
+                            self._snap_pushing.discard(peer)
+
+                        _threading.Thread(
+                            target=_push, daemon=True,
+                            name=f"apus-snappush-{self.idx}-{peer}"
+                        ).start()
+                        continue
                     res = self.t.snap_push_stream(
                         peer, my, meta, ep_dump, snap_cid, members,
                         total, read_chunk)
                     pushed_last_idx = meta.last_idx
-                    if res == WriteResult.OK:
-                        self.stats["snapshots_streamed"] = \
-                            self.stats.get("snapshots_streamed", 0) + 1
                 else:
                     snap, ep_dump, snap_cid, members = self.make_snapshot()
                     res = self.t.snap_push(peer, my, snap, ep_dump,
                                            snap_cid, members)
                     pushed_last_idx = snap.last_idx
-                if res == WriteResult.OK:
-                    self._next_idx[peer] = pushed_last_idx + 1
-                    self.stats["snapshots_pushed"] = \
-                        self.stats.get("snapshots_pushed", 0) + 1
-                elif res in (WriteResult.FENCED, WriteResult.REFUSED):
-                    # REFUSED: the peer's commit is already past the
-                    # snapshot (our view of it was stale) — re-read its
-                    # real log state instead of assuming the push landed.
-                    self._adjusted[peer] = False
-                else:
-                    self._note_failure(peer, now)
+                self._finish_snap_push(peer, res, pushed_last_idx, now,
+                                       streamed=stream is not None)
                 continue
             covered = (self.external_commit
                        and self.device_covered_from is not None
@@ -1024,6 +1130,27 @@ class Node:
                 self._adjusted[peer] = False   # lost access: re-adjust later
             else:
                 self._note_failure(peer, now)
+
+    def _finish_snap_push(self, peer: int, res: "WriteResult",
+                          pushed_last_idx: int, now: float,
+                          streamed: bool = False) -> None:
+        """Common completion bookkeeping for snapshot pushes, inline or
+        background (the async thread only records its result; all state
+        mutation happens here, on the tick thread, under the lock)."""
+        if res == WriteResult.OK:
+            if streamed:
+                self.stats["snapshots_streamed"] = \
+                    self.stats.get("snapshots_streamed", 0) + 1
+            self._next_idx[peer] = pushed_last_idx + 1
+            self.stats["snapshots_pushed"] = \
+                self.stats.get("snapshots_pushed", 0) + 1
+        elif res in (WriteResult.FENCED, WriteResult.REFUSED):
+            # REFUSED: the peer's commit is already past the snapshot
+            # (our view of it was stale) — re-read its real log state
+            # instead of assuming the push landed.
+            self._adjusted[peer] = False
+        else:
+            self._note_failure(peer, now)
 
     def _drain_stalled(self, peer: int, ack: Optional[int],
                        now: float) -> bool:

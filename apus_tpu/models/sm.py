@@ -20,6 +20,17 @@ class Snapshot:
     last_term: int
     data: bytes
     seg: bytes = b""
+    #: LOCAL-ONLY fields for file-backed installs (never wire-encoded —
+    #: wire.encode_value serializes the four fields above only).  A
+    #: streamed install sets ``data_path``/``data_len``/``data_gen`` so
+    #: downstream consumers (persistence) can stream the immutable
+    #: [0, data_len) prefix of that file instead of a blob that was
+    #: never materialized; ``data_gen`` is the SM dump generation at
+    #: install time — a later install replaces the file, and consumers
+    #: must skip a stale capture (its successor's record covers).
+    data_path: str | None = None
+    data_len: int = 0
+    data_gen: int = 0
 
 
 class StateMachine:
@@ -39,6 +50,27 @@ class StateMachine:
 
     def apply_snapshot(self, snap: Snapshot) -> None:
         raise NotImplementedError
+
+    def apply_snapshot_file(self, snap: Snapshot, path: str,
+                            adopt: bool = False) -> str | None:
+        """Install a snapshot whose data lives in a FILE (the receiver
+        half of the chunked snapshot stream; the reference installs
+        from its disk-backed BDB dump the same way, proxy.c:306-339).
+        Returns a STABLE path downstream consumers (persistence) may
+        stream the dump from after this call — one that outlives the
+        caller's temp file — or None if the SM keeps no such file (the
+        caller must then fall back to the in-memory blob for
+        persistence).  ``adopt=True`` offers ownership of ``path``: an
+        adopting SM renames instead of copying, so a multi-GB dump is
+        installed without materializing OR duplicating it.
+
+        Default: materialize and delegate to ``apply_snapshot`` — fine
+        for SMs whose states are small by construction (KVS); SMs with
+        on-disk dumps (RelayStateMachine) override with true adoption."""
+        with open(path, "rb") as f:
+            data = f.read()
+        self.apply_snapshot(dataclasses.replace(snap, data=data))
+        return None
 
 
 class RecordingStateMachine(StateMachine):

@@ -152,17 +152,26 @@ def apply_snap_chunk(node: Node, writer_sid: Sid, off: int,
 
 
 def apply_snap_end(node: Node, writer_sid: Sid) -> WriteResult:
+    """Close the stream and install FROM THE FILE: the assembled dump
+    is handed to the SM for adoption (RelayStateMachine renames it into
+    place and scans it chunk-buffered), so the receiver never holds
+    more than one chunk resident — completing what the pusher-side
+    streaming started.  The reference installs from its disk-backed
+    BDB dump the same way (proxy.c:306-339)."""
     sess = getattr(node, "_snap_stream_in", None)
     if sess is None or sess["sid"] != writer_sid.word \
             or sess["got"] != sess["total"]:
         _snap_session_drop(node)
         return WriteResult.REFUSED
+    if not node.regions.log_write_allowed(writer_sid):
+        _snap_session_drop(node)
+        return WriteResult.FENCED
     sess["f"].flush()
-    sess["f"].seek(0)
-    data = sess["f"].read()
-    meta = sess["meta"]
-    snap = dataclasses.replace(meta, data=data)
-    res = apply_snap_push(node, writer_sid, snap, sess["ep_dump"],
-                          sess["cid"], sess["members"])
+    sess["f"].close()
+    ok = node.install_snapshot(sess["meta"], sess["ep_dump"],
+                               sess["cid"], sess["members"],
+                               data_path=sess["path"], adopt=True)
+    # _snap_session_drop's unlink is a no-op if the SM adopted (renamed)
+    # the file, and the needed cleanup otherwise.
     _snap_session_drop(node)
-    return res
+    return WriteResult.OK if ok else WriteResult.REFUSED

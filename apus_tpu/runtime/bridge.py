@@ -137,6 +137,15 @@ class RelayStateMachine(StateMachine):
         assert self._f is not None
         return os.pread(self._f.fileno(), n, off)
 
+    def dup_dump_fd(self) -> int:
+        """Duplicate fd of the CURRENT dump file, for a background
+        snapshot stream: installs replace the file (fresh inode — see
+        apply_snapshot), so this fd pins the immutable captured dump
+        for the stream's lifetime regardless of concurrent installs.
+        Caller closes it."""
+        assert self._f is not None
+        return os.dup(self._f.fileno())
+
     def iter_records(self) -> list[bytes]:
         """The full record dump, mode-independent — what the Bridge's
         snapshot prime, dirty-app reprime, and deep-NACK fallback
@@ -173,9 +182,19 @@ class RelayStateMachine(StateMachine):
         self.record_bytes = 0
         self.dump_generation += 1
         if self._f is not None:
-            self._f.seek(0)
-            self._f.truncate()
-            self._f.write(snap.data)
+            # Replace, NEVER truncate in place: a background snapshot
+            # stream may hold a dup'd fd of the old dump (dup_dump_fd)
+            # — replacing gives the file a fresh inode, so the pinned
+            # fd keeps reading the immutable OLD content instead of a
+            # torn mix of two histories.
+            spill = self._f.name
+            self._f.close()
+            tmp = spill + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(snap.data)
+            os.replace(tmp, spill)
+            self._f = open(spill, "rb+")
+            self._f.seek(0, os.SEEK_END)
         off = 0
         while off < len(snap.data):
             (n,) = struct.unpack_from("<I", snap.data, off)
@@ -185,6 +204,77 @@ class RelayStateMachine(StateMachine):
             self.record_count += 1
             self.record_bytes += n
             off += n
+
+    #: chunk size for file adoption/scan (one chunk resident, ever)
+    _SNAP_IO_CHUNK = 1 << 20
+
+    def apply_snapshot_file(self, snap: Snapshot, path: str,
+                            adopt: bool = False) -> str | None:
+        """Install from a disk file WITHOUT materializing the dump —
+        the receiver half of the chunked snapshot stream.  The
+        reference's snapshot *is* its disk-backed BDB record dump
+        (proxy.c:306-339); ours is the same length-framed record dump,
+        so installation is (a) make the file BE the spill
+        (``adopt=True``: one rename; else a chunked copy), then (b)
+        one buffered scan to rebuild the record gauges.  Peak resident
+        footprint: one 1 MB chunk, for any dump size — this is the
+        half the pusher-side streaming left open (the whole-blob
+        ``apply_snapshot`` re-materialized O(history) on the
+        receiver)."""
+        if self._f is None:
+            # In-memory mode (pathless test clusters): nothing to
+            # adopt into; fall back to the materializing path.
+            return super().apply_snapshot_file(snap, path, adopt)
+        self.records = []
+        self.record_count = 0
+        self.record_bytes = 0
+        self.dump_generation += 1
+        spill = self._f.name
+        self._f.close()
+        if adopt:
+            os.replace(path, spill)
+        else:
+            # tmp + replace (fresh inode) for the same dup-fd pinning
+            # reason as apply_snapshot.
+            tmp = spill + ".install-tmp"
+            with open(path, "rb") as src, open(tmp, "wb") as dst:
+                while True:
+                    chunk = src.read(self._SNAP_IO_CHUNK)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            os.replace(tmp, spill)
+        # Reopen positioned at the end: apply() appends, the pusher's
+        # read_snapshot_chunk preads (no shared seek state).
+        self._f = open(spill, "rb+")
+        self._f.seek(0, os.SEEK_END)
+        # Buffered frame scan (headers + skips, one chunk resident):
+        # rebuilds record_count/record_bytes — the soak's leak gauges.
+        with open(spill, "rb") as f:
+            buf = b""
+            off = 0
+            while True:
+                while len(buf) - off < 4:
+                    more = f.read(self._SNAP_IO_CHUNK)
+                    if not more:
+                        if len(buf) - off not in (0,):
+                            raise ValueError(
+                                f"torn record header at tail of {spill}")
+                        return spill
+                    buf = buf[off:] + more
+                    off = 0
+                (n,) = struct.unpack_from("<I", buf, off)
+                off += 4
+                self.record_count += 1
+                self.record_bytes += n
+                # Skip the payload, buffered or beyond.
+                avail = len(buf) - off
+                if n <= avail:
+                    off += n
+                else:
+                    f.seek(n - avail, os.SEEK_CUR)
+                    buf = b""
+                    off = 0
 
 
 class Replayer:

@@ -93,6 +93,10 @@ class ReplicaDaemon:
                           - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
+        # Live deployments stream snapshots off-tick (a multi-second
+        # chunked push inline would pause this replica's heartbeats);
+        # the deterministic sim keeps the inline path.
+        self.node.async_snap_push = True
         # Fresh-start grace: randomize the first election timeout so a
         # cold cluster elects cleanly (dare_server.c:1237).
         self.node._last_hb_seen = (time.monotonic()
@@ -280,6 +284,14 @@ class ReplicaDaemon:
             snaps, self.node.snapshot_upcalls = \
                 self.node.snapshot_upcalls, []
             for snap, ep_dump in snaps:
+                # A FILE-backed capture is only streamable while the
+                # SM's dump generation still matches (another install
+                # replaced the file otherwise) — stale captures are
+                # dropped; the superseding install's own upcall follows
+                # later in this same ordered list.
+                if snap.data_path is not None and snap.data_gen != \
+                        getattr(self.node.sm, "dump_generation", 0):
+                    continue
                 for cb in self.on_snapshot:
                     cb(snap, ep_dump)
         if self.node.config_upcalls:

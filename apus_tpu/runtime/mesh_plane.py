@@ -841,13 +841,19 @@ class MeshCommitRunner:
 
     def on_descriptor(self, r: wire.Reader) -> bytes:
         """Runs on a PeerServer connection thread (no node lock)."""
-        if self.dead:
-            return wire.u8(wire.ST_ERROR)
-        if not self.ready:
+        if not self.ready and not self.dead:
             # Descriptors can only flow once every process passed the
-            # warmup rendezvous, so "not ready" means OUR build thread
-            # hasn't finished bookkeeping while a peer's has — refuse
-            # (the leader deactivates rather than desync).
+            # warmup RENDEZVOUS — so "not ready" here means our build
+            # thread is in its last milliseconds of bookkeeping while a
+            # faster peer's already dispatched.  Wait it out briefly (a
+            # nack would kill the whole plane over a thread-scheduling
+            # race); a build that really failed flips ``dead``.
+            import time as _time
+            deadline = _time.monotonic() + 30.0
+            while not self.ready and not self.dead \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.005)
+        if self.dead or not self.ready:
             return wire.u8(wire.ST_ERROR)
         sub = r.u8()
         if sub == _SUB_RESET:

@@ -31,6 +31,10 @@ from apus_tpu.utils.store import open_store
 #: dev format with u32 clt_id; APR2 widened it.)
 RECORD_MAGIC = b"APR2"     # one applied log entry
 SNAP_MAGIC = b"APS2"       # an installed snapshot (SM blob + epdb dump)
+SNAPFILE_MAGIC = b"APF1"   # an installed snapshot whose dump lives in a
+                           # SIDECAR file next to the store (streamed
+                           # installs never materialize the blob, so the
+                           # store record carries a filename, not data)
 
 
 class Persistence:
@@ -43,16 +47,66 @@ class Persistence:
     def on_commit(self, e: LogEntry) -> None:
         self.store.append(RECORD_MAGIC + wire.encode_entry(e))
 
+    #: copy-chunk size for sidecar creation (one chunk resident, ever)
+    _SNAP_IO_CHUNK = 1 << 20
+
     def on_snapshot(self, snap: Snapshot, ep_dump: list) -> None:
         """Record a leader-pushed snapshot install (without it, restart
         replay would rebuild from a store missing the snapshot prefix).
         The partial-chunk-group buffer (snap.seg) is part of the
         snapshot point: a restart must resume those groups or finals
-        delivered during catch-up would reassemble incomplete."""
+        delivered during catch-up would reassemble incomplete.
+
+        FILE-BACKED installs (snap.data_path, the streamed-receive
+        path) stream the dump's immutable [0, data_len) prefix into a
+        sidecar file next to the store and record only its name — the
+        multi-GB dump is never materialized here either.  The prefix
+        is valid while the SM's dump generation matches snap.data_gen
+        (the install captured it); the upcall drain already discards
+        stale captures (daemon._drain_upcalls order guarantees a
+        superseding install's record follows)."""
+        if snap.data_path is None:
+            self.store.append(
+                SNAP_MAGIC + struct.pack("<QQ", snap.last_idx,
+                                         snap.last_term)
+                + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
+                + wire.blob(snap.seg))
+            return
+        name = f"apus_snap.{snap.last_idx}.{snap.data_gen}.bin"
+        side_dir = os.path.dirname(self.store.path) or "."
+        sidecar = os.path.join(side_dir, name)
+        tmp = sidecar + ".tmp"
+        # Kernel-side copy (sendfile/copy_file_range via shutil) — this
+        # runs on the daemon's tick thread, so it must be as fast as
+        # the disk allows; the truncate pins the captured immutable
+        # prefix (appends may have grown the live dump since install).
+        import shutil
+        shutil.copyfile(snap.data_path, tmp)
+        if os.path.getsize(tmp) < snap.data_len:
+            raise OSError(
+                f"snapshot dump {snap.data_path} shorter than captured "
+                f"length {snap.data_len}")
+        with open(tmp, "r+b") as f:
+            f.truncate(snap.data_len)
+        os.replace(tmp, sidecar)
+        # Record AFTER the sidecar is durable-named: a crash in between
+        # leaves an orphan sidecar (harmless), never a dangling record.
         self.store.append(
-            SNAP_MAGIC + struct.pack("<QQ", snap.last_idx, snap.last_term)
-            + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
+            SNAPFILE_MAGIC + struct.pack("<QQQ", snap.last_idx,
+                                         snap.last_term, snap.data_len)
+            + wire.blob(name.encode()) + wire.encode_ep_dump(ep_dump)
             + wire.blob(snap.seg))
+        # GC superseded sidecars: replay only ever consults the LAST
+        # snapshot record (see replay_into), so earlier dumps are dead
+        # weight — without this, every streamed install would leave a
+        # full-dump-size file behind forever.
+        for old in os.listdir(side_dir):
+            if old.startswith("apus_snap.") and old != name \
+                    and not old.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(side_dir, old))
+                except OSError:
+                    pass
 
     # -- recovery ---------------------------------------------------------
 
@@ -63,8 +117,19 @@ class Persistence:
         ``node``, a replayed snapshot's partial-chunk-group buffer is
         restored into the node's reassembler (catch-up may deliver
         finals whose early chunks predate the snapshot)."""
+        recs = self.store.records()
+        # A snapshot record is the FULL state at its point, so replay
+        # starts at the LAST one (cheap magic scan): everything before
+        # it — entries and earlier snapshots alike — is superseded.
+        # This also makes the sidecar GC in on_snapshot sound (earlier
+        # snapfile records' sidecars are never consulted) and keeps
+        # deep-history restarts O(tail), not O(lifetime).
+        start = 0
+        for i, rec in enumerate(recs):
+            if rec[:4] in (SNAP_MAGIC, SNAPFILE_MAGIC):
+                start = i
         nxt = 1
-        for rec in self.store.records():
+        for rec in recs[start:]:
             kind, payload = decode_record(rec)
             if kind == "entry":
                 reply = sm.apply(payload.idx, payload.data)
@@ -73,7 +138,15 @@ class Persistence:
                 nxt = payload.idx + 1
             else:
                 snap, ep_dump = payload
-                sm.apply_snapshot(snap)
+                if kind == "snapfile":
+                    sidecar = os.path.join(
+                        os.path.dirname(self.store.path) or ".",
+                        snap.data_path)
+                    # Never adopt: the sidecar must survive for the
+                    # NEXT restart too (the SM copies chunk-wise).
+                    sm.apply_snapshot_file(snap, sidecar, adopt=False)
+                else:
+                    sm.apply_snapshot(snap)
                 epdb.load(ep_dump)
                 if node is not None:
                     from apus_tpu.core.segment import Reassembler
@@ -86,7 +159,8 @@ class Persistence:
 
 
 def decode_record(rec: bytes):
-    """-> ("entry", LogEntry) | ("snapshot", (Snapshot, ep_dump))."""
+    """-> ("entry", LogEntry) | ("snapshot", (Snapshot, ep_dump))
+    | ("snapfile", (Snapshot-with-data_path=sidecar-name, ep_dump))."""
     magic = rec[:4]
     if magic == RECORD_MAGIC:
         return "entry", wire.decode_entry(wire.Reader(rec[4:]))
@@ -98,9 +172,19 @@ def decode_record(rec: bytes):
         seg = r.blob() if r.remaining else b""
         return "snapshot", (Snapshot(last_idx, last_term, data, seg=seg),
                             ep_dump)
+    if magic == SNAPFILE_MAGIC:
+        last_idx, last_term, data_len = struct.unpack_from("<QQQ", rec, 4)
+        r = wire.Reader(rec[28:])
+        name = r.blob().decode()
+        ep_dump = wire.decode_ep_dump(r)
+        seg = r.blob() if r.remaining else b""
+        return "snapfile", (Snapshot(last_idx, last_term, b"", seg=seg,
+                                     data_path=name, data_len=data_len),
+                            ep_dump)
     raise ValueError(
-        f"unsupported store record format {magic!r} "
-        f"(expected {RECORD_MAGIC!r} or {SNAP_MAGIC!r}); refusing to decode")
+        f"unsupported store record format {magic!r} (expected "
+        f"{RECORD_MAGIC!r}, {SNAP_MAGIC!r} or {SNAPFILE_MAGIC!r}); "
+        f"refusing to decode")
 
 
 def daemon_store_path(db_dir: str, idx: int) -> str:

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Peak-RSS check of the streamed-snapshot RECEIVE path.
+
+Drives the real receiver code (onesided.apply_snap_begin/chunk/end
+against a Node with a spill-backed RelayStateMachine) with a synthetic
+multi-GB dump and reports the process's VmHWM.  The r3 receiver
+materialized the assembled blob (O(history) RSS spike at install); the
+r4 receiver adopts the file (rename + chunk-buffered scan), so peak
+RSS stays at the interpreter baseline for ANY dump size.
+
+    python benchmarks/snapstream_rss.py [size_mb]   # default 1500
+
+Recorded result (this image, 2026-07-31): dump=1574MB records=384000
+installed; peak RSS 22 MB total, install delta +0.4 MB (with the
+baseline jax import suppressed via PALLAS_AXON_POOL_IPS=); a 210 MB
+install measured +44 kB delta.  The r3 path's delta was ~2x the dump.
+"""
+import os
+import struct
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.core.cid import Cid                      # noqa: E402
+from apus_tpu.core.node import Node, NodeConfig        # noqa: E402
+from apus_tpu.core.sid import Sid                      # noqa: E402
+from apus_tpu.models.sm import Snapshot                # noqa: E402
+from apus_tpu.parallel import onesided                 # noqa: E402
+from apus_tpu.parallel.transport import (Transport,    # noqa: E402
+                                         WriteResult)
+from apus_tpu.runtime.bridge import RelayStateMachine  # noqa: E402
+
+
+class _NullTransport(Transport):
+    def ctrl_write(self, *a): return WriteResult.OK
+    def ctrl_read(self, *a): return None
+    def log_write(self, *a): return WriteResult.OK, None
+    def log_read_state(self, *a): return None
+    def log_set_end(self, *a): return WriteResult.OK
+    def log_bulk_read(self, *a): return None
+    def snap_push(self, *a, **k): return WriteResult.OK
+
+
+def main() -> None:
+    size_mb = int(sys.argv[1]) if len(sys.argv) > 1 else 1500
+    td = tempfile.mkdtemp(prefix="snaprss-")
+    sm = RelayStateMachine(spill_path=os.path.join(td, "spill.bin"))
+    node = Node(NodeConfig(idx=1), Cid.initial(3), sm, _NullTransport())
+    leader_sid = Sid(term=1, leader=True, idx=0)
+    node.sid.update(leader_sid.word)
+    node.regions.grant_log_access(0, 1)
+
+    rec = struct.pack("<I", 4096) + b"r" * 4096
+    chunk = rec * 256                          # ~1 MB per chunk
+    total = size_mb * len(chunk)
+    def rss_kb() -> int:
+        for ln in open("/proc/self/status"):
+            if ln.startswith("VmHWM"):
+                return int(ln.split()[1])
+        return 0
+
+    base = rss_kb()
+    meta = Snapshot(last_idx=10_000_000, last_term=1, data=b"")
+    assert onesided.apply_snap_begin(node, leader_sid, total, meta, [],
+                                     None, None) == WriteResult.OK
+    off = 0
+    while off < total:
+        assert onesided.apply_snap_chunk(node, leader_sid, off,
+                                         chunk) == WriteResult.OK
+        off += len(chunk)
+    assert onesided.apply_snap_end(node, leader_sid) == WriteResult.OK
+    assert sm.record_count == size_mb * 256, sm.record_count
+    print(f"dump={total / 1e6:.0f}MB records={sm.record_count} "
+          f"installed; peak RSS {rss_kb()} kB "
+          f"(install delta +{rss_kb() - base} kB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -347,6 +347,13 @@ def test_large_dump_streams_to_joiner(tmp_path, monkeypatch):
             with dm.lock:
                 streamed += dm.node.stats.get("snapshots_streamed", 0)
         assert streamed >= 1, "prime should have used the chunked stream"
+        # RECEIVER half: the joiner must have installed FROM THE FILE
+        # (RelayStateMachine adoption — rename + chunk-buffered scan),
+        # never materializing the dump (the r3 receiver read the whole
+        # assembled blob into RAM before install).
+        with d.lock:
+            assert d.node.stats.get("snapshots_file_installed", 0) >= 1, \
+                d.node.stats
         with d.lock:
             assert d.node.stats.get("snapshots_installed", 0) >= 1
             got = d.node.sm.iter_records()

@@ -166,26 +166,31 @@ def test_mesh_plane_replicates_real_redis(tmp_path):
     try:
         _wait_mesh_ready(pc)
         leader = pc.leader_idx(timeout=30.0)
-        # Wait until the device plane owns commit on the leader.
-        deadline = time.monotonic() + 60
+        # Wait until the device plane owns commit on the CURRENT leader
+        # (re-resolved each pass: bring-up load can flap leadership on
+        # a small box, and the old leader would never own anything).
+        deadline = time.monotonic() + 120
+        owned = False
+        while time.monotonic() < deadline and not owned:
+            leader = pc.leader_idx(timeout=15.0)
+            with RespClient(pc.app_addr(leader)) as c:
+                for i in range(20):
+                    assert c.cmd("SET", f"mrk:{leader}:{i}",
+                                 f"mrv:{i}") == "OK"
+                    d = _devplane(pc, leader)
+                    if d.get("commits", 0) > 0 and d.get("owns_commit"):
+                        owned = True
+                        break
+        assert owned, \
+            f"device plane never owned commit: {_devplane(pc, leader)}"
         with RespClient(pc.app_addr(leader)) as c:
-            i = 0
-            while time.monotonic() < deadline:
-                assert c.cmd("SET", f"mrk:{i}", f"mrv:{i}") == "OK"
-                i += 1
-                d = _devplane(pc, leader)
-                if d.get("commits", 0) > 0 and d.get("owns_commit"):
-                    break
-            else:
-                raise AssertionError(
-                    f"device plane never owned commit: {_devplane(pc, leader)}")
             assert c.cmd("SET", "mrk:last", "mrv:last") == "OK"
         # Every replica's LOCAL redis converges via follower replay of
         # device-drained entries.
         for r in range(3):
             _wait_key(pc.app_addr(r), "mrk:last", b"mrv:last")
             with RespClient(pc.app_addr(r)) as c:
-                assert c.cmd("GET", "mrk:0") == b"mrv:0"
+                assert c.cmd("GET", f"mrk:{leader}:0") == b"mrv:0"
         d = _devplane(pc, leader)
         assert d["commits"] > 0 and d["dead"] is False, d
     finally:

@@ -123,3 +123,87 @@ def test_proxied_cluster_leader_failover():
             return True
         _wait(converged, timeout=15.0, msg="surviving apps converge")
         pc.cluster.check_logs_consistent()
+
+
+def test_persist_snapfile_sidecar_roundtrip(tmp_path):
+    """FILE-backed snapshot persistence: a streamed install's dump is
+    recorded as a SIDECAR next to the store (never materialized), and
+    restart replay rebuilds the SM from it chunk-buffered — the
+    receiver-side completion of the chunked snapshot stream."""
+    import struct
+
+    from apus_tpu.core.epdb import EndpointDB
+    from apus_tpu.models.sm import Snapshot
+    from apus_tpu.runtime.bridge import RelayStateMachine
+    from apus_tpu.runtime.persist import Persistence
+
+    # A spill-file dump of 300 length-framed records (~600 KB).
+    dump_path = str(tmp_path / "dump.bin")
+    recs = [b"record-%03d-" % i + b"x" * 2000 for i in range(300)]
+    with open(dump_path, "wb") as f:
+        for r in recs:
+            f.write(struct.pack("<I", len(r)) + r)
+    size = sum(4 + len(r) for r in recs)
+
+    snap = Snapshot(last_idx=300, last_term=2, data=b"",
+                    data_path=dump_path, data_len=size, data_gen=1)
+    store_path = str(tmp_path / "store.db")
+    p = Persistence(store_path)
+    p.on_snapshot(snap, ep_dump=[(7, 3, 300, b"OK")])
+    p.close()
+    # Sidecar exists; the store record carries a NAME, not the blob.
+    import os
+    sidecars = [n for n in os.listdir(tmp_path)
+                if n.startswith("apus_snap.")]
+    assert sidecars, os.listdir(tmp_path)
+    assert os.path.getsize(str(tmp_path / sidecars[0])) == size
+
+    # Restart replay: fresh SM + epdb rebuilt from the store.
+    sm = RelayStateMachine(spill_path=str(tmp_path / "spill2.bin"))
+    epdb = EndpointDB()
+    p2 = Persistence(store_path)
+    nxt = p2.replay_into(sm, epdb)
+    p2.close()
+    assert nxt == 301
+    assert sm.record_count == 300
+    assert sm.record_bytes == sum(len(r) for r in recs)
+    # Byte-identical dump content after the chunked copy.
+    with open(str(tmp_path / "spill2.bin"), "rb") as f:
+        got = f.read()
+    with open(dump_path, "rb") as f:
+        assert got == f.read()
+    # Exactly-once state traveled too.
+    assert epdb.duplicate_of_applied(7, 3) is not None
+
+
+def test_persist_snapfile_prefix_capture(tmp_path):
+    """on_snapshot copies only the captured [0, data_len) prefix: new
+    records appended to the live dump AFTER the install (but before the
+    upcall drained) must not leak into the persisted snapshot — replay
+    would otherwise apply them twice."""
+    import struct
+
+    from apus_tpu.core.epdb import EndpointDB
+    from apus_tpu.models.sm import Snapshot
+    from apus_tpu.runtime.bridge import RelayStateMachine
+    from apus_tpu.runtime.persist import Persistence
+
+    dump_path = str(tmp_path / "dump.bin")
+    rec = b"pre-install-record"
+    with open(dump_path, "wb") as f:
+        f.write(struct.pack("<I", len(rec)) + rec)
+    size = 4 + len(rec)
+    # Post-install append (a newly applied entry) grows the file.
+    with open(dump_path, "ab") as f:
+        late = b"post-install-record"
+        f.write(struct.pack("<I", len(late)) + late)
+
+    snap = Snapshot(last_idx=1, last_term=1, data=b"",
+                    data_path=dump_path, data_len=size, data_gen=1)
+    p = Persistence(str(tmp_path / "store.db"))
+    p.on_snapshot(snap, ep_dump=[])
+    sm = RelayStateMachine(spill_path=str(tmp_path / "spill2.bin"))
+    p.replay_into(sm, EndpointDB())
+    p.close()
+    assert sm.record_count == 1
+    assert sm.record_bytes == len(rec)
