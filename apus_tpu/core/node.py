@@ -936,22 +936,26 @@ class Node:
             # heartbeat timeout, the match state is stale: re-adjust.
             # (The reference re-reads follower state on every commit
             # loop instead, rc_write_remote_logs dare_ibv_rc.c:1883-1945.)
-            # Consume a background snapshot-push completion FIRST: once
-            # the peer installed, its acks fast-forward next_idx past
-            # our head and the push branch below never runs again for
-            # it — the completion (stats + cursor/failure bookkeeping)
-            # must not strand.  Stale-term completions are dropped.
+            # Background stream in flight: the tick thread must not
+            # touch this peer AT ALL — its per-peer transport lock is
+            # held frame-by-frame by the push thread, so even a
+            # watchdog log_read_state here would park heartbeats behind
+            # a (up to SNAP_END-cap) wire wait.  Checked BEFORE the
+            # completion pop: the push thread writes _snap_push_done
+            # and THEN leaves _snap_pushing, so passing this check
+            # guarantees any completion is fully recorded — popping
+            # first could miss both and launch a duplicate full push.
+            if peer in self._snap_pushing:
+                continue
+            # Consume a background snapshot-push completion: once the
+            # peer installed, its acks fast-forward next_idx past our
+            # head and the push branch below never runs again for it —
+            # the completion (stats + cursor/failure bookkeeping) must
+            # not strand.  Stale-term completions are dropped.
             done = self._snap_push_done.pop(peer, None)
             if done is not None and done[0] == my.term:
                 self._finish_snap_push(peer, done[1], done[2], now,
                                        streamed=True)
-            if peer in self._snap_pushing:
-                # Background stream in flight: the tick thread must not
-                # touch this peer AT ALL — its per-peer transport lock
-                # is held frame-by-frame by the push thread, so even a
-                # watchdog log_read_state here would park heartbeats
-                # behind a (up to SNAP_END-cap) wire wait.
-                continue
             ack = self.regions.ctrl[Region.REP_ACK][peer]
             if (self._adjusted.get(peer, False) and ack is not None
                     and ack < self._next_idx.get(peer, 0)):

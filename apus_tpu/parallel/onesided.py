@@ -127,7 +127,17 @@ def apply_snap_begin(node: Node, writer_sid: Sid, total: int,
     if not node.regions.log_write_allowed(writer_sid):
         return WriteResult.FENCED
     _snap_session_drop(node)
-    f = tempfile.NamedTemporaryFile(prefix="apus-snap-in-", delete=False)
+    # Assemble NEXT TO the SM's own dump when it has one: adoption is
+    # then a same-filesystem rename (os.replace raises EXDEV across
+    # filesystems — the default TMPDIR is commonly tmpfs while the
+    # spill lives on disk, and assembling a multi-GB dump on tmpfs
+    # would also re-consume the RAM the streaming avoids).
+    spool_dir = None
+    spool = getattr(node.sm, "snapshot_spool_dir", None)
+    if spool is not None:
+        spool_dir = spool()
+    f = tempfile.NamedTemporaryFile(prefix="apus-snap-in-", delete=False,
+                                    dir=spool_dir)
     node._snap_stream_in = {
         "sid": writer_sid.word, "total": total, "got": 0,
         "meta": meta_snap, "ep_dump": ep_dump, "cid": cid,

@@ -52,7 +52,8 @@ class ProxiedCluster:
 
     def __init__(self, n: int, app_argv: Optional[Sequence[str]] = None,
                  workdir: Optional[str] = None, spin_timeout_ms: int = 8000,
-                 device_plane: bool = False, **cluster_kwargs):
+                 device_plane: bool = False, follower_reads: bool = True,
+                 **cluster_kwargs):
         build_native()
         if device_plane:
             cluster_kwargs["device_plane"] = True
@@ -62,6 +63,13 @@ class ProxiedCluster:
         self._app_argv = app_argv       # None -> toyserver
         self._spin_timeout_ms = spin_timeout_ms
         cluster_kwargs.setdefault("spec", PROXIED_SPEC)
+        # Hermetic test rig: replica-state verification reads follower
+        # apps directly, so stale follower reads default ON here; the
+        # production deployments (ProcCluster/daemon CLI) default to
+        # the REFUSE posture (ClusterSpec.follower_reads).
+        import dataclasses as _dc
+        cluster_kwargs["spec"] = _dc.replace(
+            cluster_kwargs["spec"], follower_reads=follower_reads)
         self.cluster = LocalCluster(n, sm_factory=RelayStateMachine,
                                     **cluster_kwargs)
         self.bridges: list[Optional[Bridge]] = [

@@ -34,8 +34,8 @@ from apus_tpu.core.types import EntryType, ProxyAction
 from apus_tpu.models.sm import Snapshot, StateMachine
 
 # -- shm layout (native/apus_wire.h parity) -------------------------------
-SHM_MAGIC = b"APUSSHM1"
-SHM_SIZE = 64
+SHM_MAGIC = b"APUSSHM2"
+SHM_SIZE = 80
 _OFF_HIGHEST = 8
 _OFF_IS_LEADER = 16
 _OFF_TERM = 24
@@ -43,6 +43,8 @@ _OFF_CUR_REC = 32
 _OFF_ABORTED = 40
 _OFF_SPIN_TIMEOUTS = 48
 _OFF_ABORT_FLOOR = 56
+_OFF_FOLLOWER_READS = 64
+_OFF_MISDIRECT_REFUSALS = 72
 
 # proxy -> daemon frame body: u8 action | u64 conn_id | u64 cur_rec | data
 _HDR = struct.Struct("<BQQ")
@@ -136,6 +138,14 @@ class RelayStateMachine(StateMachine):
         the append path)."""
         assert self._f is not None
         return os.pread(self._f.fileno(), n, off)
+
+    def snapshot_spool_dir(self) -> str | None:
+        """Directory for assembling an INBOUND snapshot stream: the
+        spill's own directory, so adoption is a same-filesystem rename
+        (see onesided.apply_snap_begin)."""
+        if self._f is None:
+            return None
+        return os.path.dirname(self._f.name) or "."
 
     def dup_dump_fd(self) -> int:
         """Duplicate fd of the CURRENT dump file, for a background
@@ -232,8 +242,13 @@ class RelayStateMachine(StateMachine):
         spill = self._f.name
         self._f.close()
         if adopt:
-            os.replace(path, spill)
-        else:
+            try:
+                os.replace(path, spill)
+            except OSError:
+                # Cross-filesystem rename (EXDEV): the spool-dir hint
+                # normally prevents this; fall back to the chunked copy.
+                adopt = False
+        if not adopt:
             # tmp + replace (fresh inode) for the same dup-fd pinning
             # reason as apply_snapshot.
             tmp = spill + ".install-tmp"
@@ -564,6 +579,17 @@ class Bridge:
                    (ep.last_req_id + 1) if ep is not None else 0)
         self._shm_set(_OFF_CUR_REC, base)
         self._shm_set(_OFF_HIGHEST, base)
+        # Misdirection gate (apus_wire.h follower_reads): by default a
+        # NON-leader's proxy REFUSES client bytes — a client attached
+        # to a demoted/never-leader replica reconnects instead of
+        # silently talking to unreplicated state.  Verification and
+        # maintenance harnesses opt into stale follower reads via
+        # spec.follower_reads or the runtime setter (wire op).
+        self._shm_set(_OFF_FOLLOWER_READS,
+                      1 if getattr(daemon.spec, "follower_reads", False)
+                      else 0)
+        daemon.follower_reads_setter = self.set_follower_reads
+        daemon.misdirect_refusals =             lambda: self._shm_get(_OFF_MISDIRECT_REFUSALS)
         self._last_submitted = base
         self._boot_base = base
         # (clt_id, req_id) of every record already routed to the local
@@ -643,6 +669,12 @@ class Bridge:
                 os.unlink(p)
             except OSError:
                 pass
+
+    def set_follower_reads(self, allow: bool) -> None:
+        """Runtime maintenance switch (wire op OP_MAINT_READS): allow or
+        refuse stale client reads on this replica's raw app while it is
+        not the leader."""
+        self._shm_set(_OFF_FOLLOWER_READS, 1 if allow else 0)
 
     # -- shm accessors ----------------------------------------------------
 

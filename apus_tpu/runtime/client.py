@@ -32,6 +32,7 @@ ST_ERROR = wire.ST_ERROR
 OP_CLT_WRITE = 16
 OP_CLT_READ = 17
 OP_STATUS = 18
+OP_MAINT_READS = 19   # flip the proxy's stale-follower-reads gate
 
 ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
@@ -110,6 +111,11 @@ def make_client_ops(daemon) -> dict:
                 "sm_records": getattr(n.sm, "record_count", None),
                 "sm_record_bytes": getattr(n.sm, "record_bytes", None),
             }
+            # Misdirection-gate observability (bridged replicas): how
+            # many non-leader client reads the proxy refused.
+            refusals = getattr(daemon, "misdirect_refusals", None)
+            if refusals is not None:
+                st["misdirect_refusals"] = refusals()
             # Device-plane observability (in-process or mesh): did
             # commits ride the device quorum, and is the plane alive?
             drv = daemon.device_driver
@@ -129,8 +135,37 @@ def make_client_ops(daemon) -> dict:
                 }
         return wire.u8(wire.ST_OK) + wire.blob(json.dumps(st).encode())
 
+    def maint_reads(r: wire.Reader) -> bytes:
+        """Maintenance switch: allow/refuse stale client reads on this
+        replica's raw app while it is not the leader (the proxy's
+        misdirection gate, apus_wire.h follower_reads).  Verification
+        harnesses flip it AFTER traffic ends to inspect replica state."""
+        allow = r.u8() != 0
+        setter = getattr(daemon, "follower_reads_setter", None)
+        if setter is None:
+            return wire.u8(wire.ST_ERROR)    # no bridge on this daemon
+        setter(allow)
+        return wire.u8(wire.ST_OK)
+
     return {OP_CLT_WRITE: clt_write, OP_CLT_READ: clt_read,
-            OP_STATUS: status}
+            OP_STATUS: status, OP_MAINT_READS: maint_reads}
+
+
+def set_follower_reads(addr: str, allow: bool,
+                       timeout: float = 2.0) -> bool:
+    """Flip one daemon's stale-follower-reads maintenance gate (see
+    make_client_ops.maint_reads).  Returns True on success."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(wire.frame(wire.u8(OP_MAINT_READS)
+                                    + wire.u8(1 if allow else 0)))
+            resp = wire.read_frame(conn)
+    except (OSError, ConnectionError, ValueError):
+        return False
+    return bool(resp) and resp[0] == wire.ST_OK
 
 
 def probe_status(addr: str, timeout: float = 0.5) -> Optional[dict]:

@@ -72,7 +72,13 @@ class Persistence:
                 + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
                 + wire.blob(snap.seg))
             return
-        name = f"apus_snap.{snap.last_idx}.{snap.data_gen}.bin"
+        # Sidecar names are STORE-scoped (several daemons share a
+        # db_dir in the local process deployment — proc.py passes one
+        # --db-dir to every replica): deriving the prefix from this
+        # store's filename keeps replica A's GC from deleting replica
+        # B's restart state.
+        prefix = os.path.basename(self.store.path) + ".snap."
+        name = f"{prefix}{snap.last_idx}.{snap.data_gen}.bin"
         side_dir = os.path.dirname(self.store.path) or "."
         sidecar = os.path.join(side_dir, name)
         tmp = sidecar + ".tmp"
@@ -96,12 +102,12 @@ class Persistence:
                                          snap.last_term, snap.data_len)
             + wire.blob(name.encode()) + wire.encode_ep_dump(ep_dump)
             + wire.blob(snap.seg))
-        # GC superseded sidecars: replay only ever consults the LAST
-        # snapshot record (see replay_into), so earlier dumps are dead
-        # weight — without this, every streamed install would leave a
-        # full-dump-size file behind forever.
+        # GC superseded sidecars OF THIS STORE ONLY: replay only ever
+        # consults the LAST snapshot record (see replay_into), so
+        # earlier dumps are dead weight — without this, every streamed
+        # install would leave a full-dump-size file behind forever.
         for old in os.listdir(side_dir):
-            if old.startswith("apus_snap.") and old != name \
+            if old.startswith(prefix) and old != name \
                     and not old.endswith(".tmp"):
                 try:
                     os.unlink(os.path.join(side_dir, old))

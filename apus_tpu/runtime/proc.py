@@ -77,11 +77,14 @@ class ProcCluster:
                  spin_timeout_ms: int = 8000,
                  tick_interval: Optional[float] = None,
                  device_plane: bool = False,
-                 mesh_depth: int = 4):
+                 mesh_depth: int = 4,
+                 follower_reads: Optional[bool] = None):
         self.n = n
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
         os.makedirs(self.workdir, exist_ok=True)
         base = dataclasses.replace(spec or PROC_SPEC)
+        if follower_reads is not None:
+            base.follower_reads = follower_reads
         base.group_size = n
         base.peers = [f"127.0.0.1:{_free_port()}" for _ in range(n)]
         if device_plane:

@@ -67,6 +67,13 @@ class ClusterSpec:
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False
+    # Misdirection gate: False (default) = a non-leader's proxy REFUSES
+    # client bytes to its raw app (the client reconnects and finds the
+    # leader — structurally no unreplicated reads/writes; beyond the
+    # reference, whose clients must FindLeader themselves).  True =
+    # allow stale follower reads (verification harnesses, maintenance).
+    # Runtime-flippable per daemon via the OP_MAINT_READS wire op.
+    follower_reads: bool = False
 
     @staticmethod
     def from_dict(d: dict) -> "ClusterSpec":

@@ -283,7 +283,8 @@ def main() -> int:
     if args.proc:
         from apus_tpu.runtime.proc import ProcCluster
         cluster = ProcCluster(args.replicas,
-                              app_argv=app_argv or "toyserver")
+                              app_argv=app_argv or "toyserver",
+                              follower_reads=True)
     else:
         cluster = ProxiedCluster(args.replicas, app_argv=app_argv,
                                  device_plane=args.device_plane)

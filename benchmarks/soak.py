@@ -177,6 +177,15 @@ def main() -> int:
         affinity_check()
         wall = time.monotonic() - t0
         client.close()
+        # Traffic ran with the misdirection gate at the PRODUCTION
+        # posture (non-leaders REFUSE client bytes — misdirected can
+        # only ever count leadership moves the gate itself already
+        # cured); now flip maintenance reads ON so the convergence
+        # check below may inspect follower state directly.
+        from apus_tpu.runtime.client import set_follower_reads
+        for i in range(args.replicas):
+            if pc.procs[i] is not None:
+                set_follower_reads(pc.spec.peers[i], True)
         # Final convergence on every replica's app — of the last key
         # that was actually ACKED (the last attempted one may have
         # died with a connection mid-reconnect).

@@ -43,8 +43,8 @@ struct apus_bridge_hdr {
 // -- shared-memory control block -----------------------------------------
 // The daemon creates and owns the file; the proxy mmaps it.  All fields
 // are 8-byte aligned; cross-process visibility via __atomic builtins.
-#define APUS_SHM_MAGIC "APUSSHM1"
-#define APUS_SHM_SIZE 64
+#define APUS_SHM_MAGIC "APUSSHM2"
+#define APUS_SHM_SIZE 80
 
 struct apus_shm {
   char magic[8];
@@ -69,6 +69,21 @@ struct apus_shm {
                                     // anyway.  (The reference lets the
                                     // app reply on aborts — a false
                                     // ack the client cannot detect.)
+  volatile uint64_t follower_reads;   // 1 = serve client bytes on a
+                                      // NON-leader's raw app (stale
+                                      // follower reads / verification
+                                      // harness mode; daemon writes).
+                                      // 0 (default) = REFUSE them: a
+                                      // client attached to a demoted
+                                      // or never-leader replica gets
+                                      // ECONNRESET instead of silently
+                                      // talking to unreplicated state
+                                      // — the misdirection cure the
+                                      // reference lacks (its clients
+                                      // must FindLeader themselves,
+                                      // run.sh:46-68).
+  volatile uint64_t misdirect_refusals;  // reads refused by that gate
+                                         // (proxy writes; observability)
 };
 
 // Max raw request record (TCP rcvbuf-sized, message.h:7 parity).

@@ -318,10 +318,21 @@ static int capture_read(int fd, const struct iovec* iov, int iovcnt,
   bool numbered_skip = false;
   if (it != g.conns.end() && it->second != kExcluded) {
     if (!leader_now) {
-      // A numbered connection only exists on an app that captured as
-      // leader: its reads must not execute unreplicated after a
-      // demotion — fail them (client reconnects and re-discovers).
-      numbered_skip = (it->second != 0);
+      // NON-leader refusal (beyond-reference misdirection cure).  A
+      // NUMBERED connection captured under our leadership must never
+      // execute unreplicated after a demotion; an UN-numbered client
+      // connection on a follower would silently talk to the raw,
+      // unreplicated app — the soak's "misdirected" failure mode (the
+      // reference shares it: clients must FindLeader, run.sh:46-68).
+      // Both are refused (client reconnects and re-discovers) unless
+      // the operator enabled stale follower reads (shm flag,
+      // verification/maintenance harnesses).
+      numbered_skip =
+          (it->second != 0) ||
+          __atomic_load_n(&g.shm->follower_reads, __ATOMIC_ACQUIRE) == 0;
+      if (numbered_skip && it->second == 0)
+        __atomic_add_fetch(&g.shm->misdirect_refusals, 1,
+                           __ATOMIC_ACQ_REL);
     } else {
       if (it->second == 0) {
         // First leader-side read: number the connection now (pid-salted

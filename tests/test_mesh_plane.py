@@ -161,7 +161,8 @@ def test_mesh_plane_replicates_real_redis(tmp_path):
         raise AssertionError(f"GET {key} = {last!r}, want {want!r}")
 
     pc = ProcCluster(3, app_argv=[REDIS_RUN], workdir=str(tmp_path / "c"),
-                     spec=MESH_SPEC, device_plane=True)
+                     spec=MESH_SPEC, device_plane=True,
+                     follower_reads=True)
     pc.start(timeout=90.0)
     try:
         _wait_mesh_ready(pc)

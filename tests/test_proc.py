@@ -51,7 +51,8 @@ def test_proc_cluster_write_failover_write(bare):
 
 
 def test_proc_cluster_proxied_apps_replicate(tmp_path):
-    pc = ProcCluster(3, app_argv="toyserver", workdir=str(tmp_path / "c"))
+    pc = ProcCluster(3, app_argv="toyserver", workdir=str(tmp_path / "c"),
+                     follower_reads=True)
     with pc:
         # Under full-suite CPU contention the first leadership can flap
         # between leader_idx() and the writes (production-envelope

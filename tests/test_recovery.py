@@ -154,7 +154,7 @@ def test_persist_snapfile_sidecar_roundtrip(tmp_path):
     # Sidecar exists; the store record carries a NAME, not the blob.
     import os
     sidecars = [n for n in os.listdir(tmp_path)
-                if n.startswith("apus_snap.")]
+                if ".snap." in n and n.endswith(".bin")]
     assert sidecars, os.listdir(tmp_path)
     assert os.path.getsize(str(tmp_path / sidecars[0])) == size
 

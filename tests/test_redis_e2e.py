@@ -80,7 +80,8 @@ def test_redis_leader_failover_promotes_follower(tmp_path):
     """Process-per-replica redis (the run.sh deployment shape): kill
     the leader's whole process group; a follower's redis serves the
     replicated data and accepts new writes."""
-    pc = ProcCluster(3, app_argv=[REDIS_RUN], workdir=str(tmp_path / "c"))
+    pc = ProcCluster(3, app_argv=[REDIS_RUN], workdir=str(tmp_path / "c"),
+                     follower_reads=True)
     with pc:
         leader = pc.leader_idx()
         with RespClient(pc.app_addr(leader)) as c:
@@ -158,3 +159,83 @@ def test_redis_large_value_replicates():
             with RespClient(pc.app_addr(i)) as c:
                 got = c.cmd("GET", "bigk")
             assert got == big, (i, None if got is None else len(got))
+
+
+def test_non_leader_refuses_misdirected_clients(tmp_path):
+    """Beyond-reference misdirection cure, end to end at the PRODUCTION
+    posture (ClusterSpec.follower_reads default False): a client that
+    attaches to a non-leader's redis — fresh connection or a live one
+    that survived a leader kill — is REFUSED instead of silently served
+    raw, unreplicated state (the reference's clients must FindLeader
+    themselves, run.sh:46-68, and a mistake there goes undetected).
+    After reattaching to the real leader, every acked write is present;
+    the maintenance switch re-enables stale follower reads for
+    inspection."""
+    from apus_tpu.runtime.client import probe_status, set_follower_reads
+
+    pc = ProcCluster(3, app_argv=[REDIS_RUN], workdir=str(tmp_path / "c"))
+    with pc:
+        leader = pc.leader_idx()
+        follower = next(i for i in range(3) if i != leader)
+        # Writes through the leader replicate normally.
+        with RespClient(pc.app_addr(leader)) as c:
+            for i in range(10):
+                assert c.cmd("SET", f"md:{i}", f"mv:{i}") == "OK"
+        # A client (mis)attaching to a FOLLOWER's app is refused — the
+        # read gate fails its first command instead of executing it
+        # against the raw local redis.
+        refused = False
+        try:
+            with RespClient(pc.app_addr(follower)) as c:
+                got = c.cmd("SET", "rogue", "x")
+                refused = got is None
+        except (OSError, ConnectionError, RuntimeError):
+            refused = True
+        assert refused, "follower served a client write unreplicated"
+        st = probe_status(pc.spec.peers[follower], timeout=1.0)
+        assert st and st.get("misdirect_refusals", 0) >= 1, st
+
+        # Leader killed UNDER a live client: the connection dies with
+        # it; reattaching to a non-leader is refused the same way, so
+        # the only path back is the real new leader — where every acked
+        # write is present.
+        live = RespClient(pc.app_addr(leader))
+        live.cmd("SET", "md:last", "mv:last")
+        pc.kill(leader)
+        new_leader = pc.leader_idx(timeout=15.0)
+        try:
+            live.cmd("GET", "md:last")
+            live_ok = True
+        except (OSError, ConnectionError, RuntimeError):
+            live_ok = False
+        live.close()
+        assert not live_ok, "dead leader's app still served the client"
+        for i in range(3):
+            if i == new_leader or pc.procs[i] is None:
+                continue
+            try:
+                with RespClient(pc.app_addr(i)) as c:
+                    assert c.cmd("GET", "md:0") is None, \
+                        "non-leader served a read at production posture"
+            except (OSError, ConnectionError, RuntimeError):
+                pass                        # refusal surfaces as reset
+        with RespClient(pc.app_addr(new_leader)) as c:
+            assert c.cmd("GET", "md:0") == b"mv:0"
+            assert c.cmd("GET", "md:last") == b"mv:last"
+            assert c.cmd("SET", "post", "y") == "OK"
+        # Maintenance switch: stale follower reads by explicit choice.
+        other = next(i for i in range(3)
+                     if i != new_leader and pc.procs[i] is not None)
+        assert set_follower_reads(pc.spec.peers[other], True)
+        deadline = time.monotonic() + 20
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                with RespClient(pc.app_addr(other)) as c:
+                    got = c.cmd("GET", "md:0")
+                if got == b"mv:0":
+                    break
+            except (OSError, ConnectionError, RuntimeError):
+                pass
+            time.sleep(0.2)
+        assert got == b"mv:0", got
