@@ -34,9 +34,12 @@ TOYSERVER = os.path.join(NATIVE_BUILD, "toyserver")
 
 
 def build_native() -> None:
-    """Ensure the native artifacts exist (make -C native)."""
-    if os.path.exists(TOYSERVER) and os.path.exists(INTERPOSE_SO):
-        return
+    """Ensure the native artifacts exist AND are current: always run
+    make (its dependency tracking makes the up-to-date case a no-op).
+    An exists-only check once let a stale interpose.so (built before an
+    shm layout bump) fail the magic check at runtime and silently
+    deactivate the proxy — every app would then serve raw, unreplicated
+    traffic while the benchmarks read plausible-looking numbers."""
     subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "native")],
                    check=True, capture_output=True, timeout=180)
 
@@ -52,7 +55,8 @@ class ProxiedCluster:
 
     def __init__(self, n: int, app_argv: Optional[Sequence[str]] = None,
                  workdir: Optional[str] = None, spin_timeout_ms: int = 8000,
-                 device_plane: bool = False, follower_reads: bool = True,
+                 device_plane: bool = False,
+                 follower_reads: Optional[bool] = True,
                  **cluster_kwargs):
         build_native()
         if device_plane:
@@ -66,10 +70,12 @@ class ProxiedCluster:
         # Hermetic test rig: replica-state verification reads follower
         # apps directly, so stale follower reads default ON here; the
         # production deployments (ProcCluster/daemon CLI) default to
-        # the REFUSE posture (ClusterSpec.follower_reads).
-        import dataclasses as _dc
-        cluster_kwargs["spec"] = _dc.replace(
-            cluster_kwargs["spec"], follower_reads=follower_reads)
+        # the REFUSE posture (ClusterSpec.follower_reads).  Pass
+        # follower_reads=None to keep the supplied spec's own setting.
+        if follower_reads is not None:
+            import dataclasses as _dc
+            cluster_kwargs["spec"] = _dc.replace(
+                cluster_kwargs["spec"], follower_reads=follower_reads)
         self.cluster = LocalCluster(n, sm_factory=RelayStateMachine,
                                     **cluster_kwargs)
         self.bridges: list[Optional[Bridge]] = [
@@ -209,6 +215,10 @@ SSDB_TARBALL = os.environ.get(
 #: apps/memcached/mk,run) — built against the libevent compat shim
 #: when the image lacks libevent-dev (apps/memcached/compat).
 MEMCACHED_RUN = os.path.join(REPO_ROOT, "apps", "memcached", "run")
+#: Stock load generator (apps/memcached/run:22-28 parity), built from
+#: the vendored libmemcached tarball by apps/memcached/mk.
+MEMSLAP = os.path.join(REPO_ROOT, "apps", "memcached", "build",
+                       "libmemcached-1.0.18", "clients", "memslap")
 MEMCACHED_SERVER = os.path.join(REPO_ROOT, "apps", "memcached", "build",
                                 "memcached-1.4.21", "memcached")
 MEMCACHED_TARBALL = os.environ.get(
@@ -221,6 +231,18 @@ def build_ssdb() -> bool:
 
 
 def build_memcached() -> bool:
+    # memslap (the stock benchmark client) is built by the same mk; a
+    # tree where only the server exists (pre-memslap build, or a failed
+    # clients build) must re-run mk or the stock-client rung silently
+    # never executes.  The mk's own early-exit keeps the rebuilt case
+    # cheap, and memslap stays best-effort (server presence decides).
+    if os.path.exists(MEMCACHED_SERVER) and not os.path.exists(MEMSLAP):
+        mk = os.path.join(REPO_ROOT, "apps", "memcached", "mk")
+        try:
+            subprocess.run([mk], check=False, capture_output=True,
+                           timeout=600)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
     return _build_app(MEMCACHED_SERVER, "memcached", timeout=300)
 
 

@@ -658,6 +658,12 @@ class Bridge:
                 self.daemon.on_commit.remove(self._on_commit)
             if self._on_snapshot in self.daemon.on_snapshot:
                 self.daemon.on_snapshot.remove(self._on_snapshot)
+            # Symmetric with the hooks above: a late OP_STATUS /
+            # OP_MAINT_READS must not dereference the closed mmap.
+            if getattr(self.daemon, "follower_reads_setter", None) \
+                    is self.set_follower_reads:
+                self.daemon.follower_reads_setter = None
+                self.daemon.misdirect_refusals = None
         for t in self._threads:
             t.join(timeout=2.0)
         self.replayer.stop()

@@ -414,6 +414,13 @@ def main(argv: Optional[list] = None) -> int:
                     help="join a RUNNING cluster instead of starting as "
                          "a static member (env APUS_SERVER_TYPE=join); "
                          "--idx is ignored, the leader assigns the slot")
+    ap.add_argument("--seed", default=os.environ.get("APUS_SEED"),
+                    help="discovery bootstrap (implies --join): ONE "
+                         "host:port of ANY live member — no config file "
+                         "needed; the admission reply carries the peer "
+                         "table and cluster spec (the mcast-JOIN "
+                         "analog, dare_ibv_ud.c:952-1068).  Comma-"
+                         "separate for multiple seeds")
     ap.add_argument("--join-addr", default=None,
                     help="with --join: bind this host:port instead of an "
                          "ephemeral one (a recovered server re-joining "
@@ -447,7 +454,17 @@ def main(argv: Optional[list] = None) -> int:
     if args.app and not bridged:
         ap.error("--app requires --workdir (the bridge's unix socket, "
                  "shm block, and record dump live there)")
-    spec = load_config(args.config)
+    if args.seed:
+        args.join = True
+    if args.config:
+        spec = load_config(args.config)
+    elif args.seed:
+        # Seed bootstrap: everything else arrives in the admission
+        # reply (peer table + cluster spec).
+        from apus_tpu.utils.config import ClusterSpec
+        spec = ClusterSpec(peers=[])
+    else:
+        ap.error("need --config, or --seed for discovery bootstrap")
     if bridged and args.app and args.app_port is None:
         from apus_tpu.runtime.appcluster import free_port
         args.app_port = free_port()
@@ -465,7 +482,8 @@ def main(argv: Optional[list] = None) -> int:
         import socket as _socket
 
         from apus_tpu.parallel.net import PeerServer
-        from apus_tpu.runtime.membership import request_join
+        from apus_tpu.runtime.membership import request_join_spec
+        from apus_tpu.utils.config import ClusterSpec
         if args.join_addr:
             host, port_s = args.join_addr.rsplit(":", 1)
             sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
@@ -475,9 +493,16 @@ def main(argv: Optional[list] = None) -> int:
             sock = PeerServer.reserve()
         host, port = sock.getsockname()
         my_addr = f"{host}:{port}"
-        slot, cid, peers = request_join(
-            [p for p in spec.peers if p], my_addr,
-            want_slot=args.want_slot)
+        seeds = ([s.strip() for s in args.seed.split(",") if s.strip()]
+                 if args.seed else [p for p in spec.peers if p])
+        slot, cid, peers, spec_dict = request_join_spec(
+            seeds, my_addr, want_slot=args.want_slot)
+        if spec_dict is not None:
+            # Adopt the CLUSTER's spec (timing envelope etc.) — a
+            # seed-bootstrapped joiner has no config of its own, and a
+            # config-bearing one must not run a different envelope than
+            # the group.
+            spec = ClusterSpec.from_dict(spec_dict)
         spec.peers = list(peers)
         while len(spec.peers) <= slot:
             spec.peers.append("")
@@ -609,6 +634,12 @@ def main(argv: Optional[list] = None) -> int:
                               "apus_tpu.runtime.daemon",
                               "--join", "--join-addr", my_addr,
                               "--want-slot", str(daemon.idx)]
+                    if not args.config:
+                        # Seed-bootstrapped daemon: re-seed from the
+                        # peers learned via the admission reply.
+                        rejoin += ["--seed", ",".join(
+                            p for i, p in enumerate(spec.peers)
+                            if p and i != daemon.idx)]
                     for flag, val in [
                             ("--config", args.config),
                             ("--db-dir", args.db_dir),

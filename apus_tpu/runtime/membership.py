@@ -45,10 +45,20 @@ def make_membership_ops(daemon) -> dict:
                 if pj.done:
                     daemon.logger.info("JOIN %s -> slot %d (%r)", addr,
                                        pj.slot, daemon.node.cid)
+                    # The reply carries the full peer table AND the
+                    # cluster spec: a seed-bootstrapped joiner (daemon
+                    # --seed host:port, no config file) learns the
+                    # timing envelope and everything else it needs from
+                    # this one message — the discovery role the
+                    # reference's mcast CFG_REPLY plays
+                    # (dare_ibv_ud.c:1451-1498).
+                    import dataclasses as _dc
                     return (wire.u8(wire.ST_OK) + wire.u8(pj.slot)
                             + wire.encode_cid(daemon.node.cid)
                             + wire.blob(json.dumps(
-                                daemon.spec.peers).encode()))
+                                daemon.spec.peers).encode())
+                            + wire.blob(json.dumps(
+                                _dc.asdict(daemon.spec)).encode()))
                 if not daemon.node.is_leader:
                     return _not_leader(daemon)
                 left = deadline - time.monotonic()
@@ -65,7 +75,24 @@ def request_join(peers: list[str], my_addr: str,
     """Joiner side: find the leader and request admission.  Returns
     (slot, cid, full peer list).  Retries across redirects/elections.
     ``want_slot`` requests slot affinity (recovered-server rejoin): the
-    leader admits at that exact slot or refuses."""
+    leader admits at that exact slot or refuses.
+
+    ``peers`` may be a SINGLE seed address (discovery bootstrap, the
+    mcast-JOIN analog, dare_ibv_ud.c:952-1068): a non-leader seed
+    redirects via the NOT_LEADER hint, and the admission reply carries
+    the full peer table — the joiner needs nothing else up front.  Use
+    :func:`request_join_spec` to also receive the cluster spec."""
+    slot, cid, full_peers, _ = request_join_spec(peers, my_addr,
+                                                 timeout, want_slot)
+    return slot, cid, full_peers
+
+
+def request_join_spec(peers: list[str], my_addr: str,
+                      timeout: float = 15.0,
+                      want_slot: Optional[int] = None
+                      ) -> tuple[int, Cid, list[str], Optional[dict]]:
+    """request_join returning additionally the cluster-spec dict the
+    leader serialized into the reply (None from pre-spec leaders)."""
     payload = wire.u8(OP_JOIN) + wire.blob(my_addr.encode())
     if want_slot is not None:
         payload += wire.u8(want_slot)
@@ -85,7 +112,9 @@ def request_join(peers: list[str], my_addr: str,
             slot = r.u8()
             cid = wire.decode_cid(r)
             full_peers = json.loads(r.blob().decode())
-            return slot, cid, full_peers
+            spec_dict = (json.loads(r.blob().decode())
+                         if r.remaining else None)
+            return slot, cid, full_peers, spec_dict
         if st == ST_NOT_LEADER:
             hint = wire.Reader(resp[1:]).blob().decode() \
                 if len(resp) > 1 else ""

@@ -620,10 +620,13 @@ class MeshCommitRunner:
     #: collective missing one participant blocks until that process
     #: EXITS (probed empirically — 400 s with both ends alive), so
     #: every wait polls is_ready() against this budget instead of
-    #: parking forever.  Normal windows complete in milliseconds; this
-    #: only trips when a descriptor was lost or a peer wedged, both of
-    #: which already mean the plane must degrade to TCP.
-    WAIT_BUDGET_S = 10.0
+    #: parking forever.  Normal windows complete in milliseconds; the
+    #: budget only trips when a descriptor was lost or a peer wedged,
+    #: both of which already mean the plane must degrade to TCP.  Sized
+    #: WELL above worst-case scheduling stalls on an oversubscribed
+    #: box (a saturated 1-core host showed 10 s was trippable by CPU
+    #: starvation alone, killing healthy planes).
+    WAIT_BUDGET_S = 45.0
 
     def _wait_window(self, h: "MeshWindowHandle", what: str):
         """Readiness-polled wait; returns the commits ndarray or None

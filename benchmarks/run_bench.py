@@ -104,6 +104,60 @@ class SsdbDriver(RespDriver):
         return len(c.cmd("keys", "", "", "1000000000"))
 
 
+def memslap_benchmark(pc, concurrency: int,
+                      execute_number: int) -> dict | None:
+    """Drive the STOCK memslap client (built from the reference's
+    vendored libmemcached tarball) at the leader's replicated memcached
+    — the verbatim apps/memcached/run:22-28 measurement, completing
+    stock-client parity for the app trio (redis-benchmark and
+    ssdb-bench shape the other two)."""
+    import subprocess
+
+    from apus_tpu.runtime.appcluster import MEMSLAP
+    if not os.path.exists(MEMSLAP):
+        print("memslap not built (apps/memcached/mk builds it from the "
+              "vendored libmemcached tarball); skipping the stock-"
+              "client rung", file=sys.stderr)
+        return None
+    host, port = pc.app_addr(pc.leader_idx())
+    try:
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [MEMSLAP, "-s", f"{host}:{port}",
+             f"--concurrency={concurrency}",
+             f"--execute-number={execute_number}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=600)
+        wall = time.monotonic() - t0
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print(f"memslap failed: {e}", file=sys.stderr)
+        return None
+    secs = None
+    for line in proc.stdout.splitlines():
+        # "\tTook 0.038 seconds to load data"
+        if "seconds to load data" in line:
+            try:
+                secs = float(line.split("Took", 1)[1].split()[0])
+            except (ValueError, IndexError):
+                pass
+    if proc.returncode != 0 or secs is None:
+        print(f"memslap rc={proc.returncode}; output: "
+              f"{proc.stdout[-300:]!r}", file=sys.stderr)
+        return None
+    total = concurrency * execute_number
+    return {
+        "metric": "memslap_ops_per_sec",
+        "value": round(total / max(secs, 1e-9), 1),
+        "unit": "ops/sec",
+        "detail": {"concurrency": concurrency,
+                   "execute_number": execute_number,
+                   "total_ops": total,
+                   "memslap_seconds": secs,
+                   "wall_seconds": round(wall, 3),
+                   "tool": "memslap (libmemcached 1.0.18, stock)"},
+    }
+
+
 def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
           value: str) -> dict:
     """C client threads, each issuing requests/C ops at the leader app."""
@@ -303,6 +357,15 @@ def main() -> int:
             # alongside the pinned server by apps/redis/mk.
             r = redis_benchmark(pc, args.requests, args.clients,
                                 args.value_bytes, pipeline=args.pipeline)
+            if r is not None:
+                results.append(r)
+
+        if args.memcached:
+            # Stock-client parity for the trio: the reference's own
+            # memslap invocation shape (apps/memcached/run:22-28).
+            r = memslap_benchmark(
+                pc, concurrency=args.clients,
+                execute_number=max(1, args.requests // args.clients))
             if r is not None:
                 results.append(r)
 

@@ -7,6 +7,7 @@ join path of §3.4 (SURVEY.md), and the EXTENDED->TRANSIT->STABLE ladder
 
 from __future__ import annotations
 
+import os
 import time
 
 import pytest
@@ -343,17 +344,20 @@ def test_large_dump_streams_to_joiner(tmp_path, monkeypatch):
         d = c.add_replica()
         c.wait_caught_up(d.idx, timeout=60.0)
         streamed = 0
+        stats_by_idx = {}
         for dm in c.live():
             with dm.lock:
+                stats_by_idx[dm.idx] = dict(dm.node.stats)
                 streamed += dm.node.stats.get("snapshots_streamed", 0)
-        assert streamed >= 1, "prime should have used the chunked stream"
+        assert streamed >= 1, \
+            f"prime should have used the chunked stream; {stats_by_idx}"
         # RECEIVER half: the joiner must have installed FROM THE FILE
         # (RelayStateMachine adoption — rename + chunk-buffered scan),
         # never materializing the dump (the r3 receiver read the whole
         # assembled blob into RAM before install).
         with d.lock:
             assert d.node.stats.get("snapshots_file_installed", 0) >= 1, \
-                d.node.stats
+                (d.node.stats, stats_by_idx)
         with d.lock:
             assert d.node.stats.get("snapshots_installed", 0) >= 1
             got = d.node.sm.iter_records()
@@ -365,3 +369,76 @@ def test_large_dump_streams_to_joiner(tmp_path, monkeypatch):
         assert len(got) >= 120
         assert got == want[:len(got)]
         assert got[0].startswith(b"rec-000-")
+
+
+def test_seed_bootstrap_join(tmp_path):
+    """Discovery bootstrap (the mcast-JOIN analog, dare_ibv_ud.c:952-
+    1068): a joiner process knowing ONE seed address — a FOLLOWER's, to
+    exercise the redirect — and nothing else (no config file) is
+    admitted, adopts the cluster's spec/peer table from the admission
+    reply, and participates in replication."""
+    import subprocess
+    import sys
+
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.proc import ProcCluster, _repo_env
+
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"))
+    with pc:
+        leader = pc.leader_idx()
+        follower_addr = pc.spec.peers[next(i for i in range(3)
+                                           if i != leader)]
+        ready = str(tmp_path / "seedready.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "apus_tpu.runtime.daemon",
+             "--seed", follower_addr,
+             "--log-file", str(tmp_path / "seed.log"),
+             "--ready-file", ready],
+            env=_repo_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        try:
+            deadline = time.monotonic() + 30
+            info = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    raise AssertionError(f"seed joiner died: {out[-800:]}")
+                if os.path.exists(ready):
+                    import json as _json
+                    with open(ready) as f:
+                        info = _json.load(f)
+                    break
+                time.sleep(0.1)
+            assert info is not None, "seed joiner never became ready"
+            slot = info["idx"]
+            assert slot == 3, info
+            # The group admitted it (leader's membership view) and the
+            # joiner itself is serving status at the group's term.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                lead_st = pc.status(pc.leader_idx(timeout=5.0))
+                join_st = probe_status(info["addr"], timeout=0.5)
+                if (lead_st and slot in lead_st.get("members", [])
+                        and join_st
+                        and join_st["term"] == lead_st["term"]):
+                    break
+                time.sleep(0.1)
+            assert lead_st and slot in lead_st["members"], lead_st
+            assert join_st and join_st["term"] == lead_st["term"], join_st
+            # Replication reaches the seeded joiner.
+            with ApusClient(list(pc.spec.peers)) as c:
+                assert c.put(b"seeded", b"yes") == b"OK"
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                join_st = probe_status(info["addr"], timeout=0.5)
+                if join_st and join_st["apply"] >= 2:
+                    break
+                time.sleep(0.1)
+            assert join_st and join_st["apply"] >= 2, join_st
+        finally:
+            import signal as _signal
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+            proc.wait(timeout=5)
