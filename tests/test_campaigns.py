@@ -1,0 +1,105 @@
+"""Default-run slices of the fault campaigns and the endurance soak.
+
+The full campaigns (benchmarks/fuzz.py: 50-500 trials; benchmarks/
+soak.py: 10-30 minutes) found real bugs in rounds 2-3 — the clt_id
+dedup collision, two unbounded-RAM retentions, the follower
+misdirection, the auto-remove quorum-floor wedge — but were run by
+hand, so a regression in exactly-once or leak behavior could land
+without re-running them.  These tests pin ONE slice of each campaign
+altitude into the default suite: small enough to keep the suite's
+runtime sane, real enough that the invariants the campaigns check
+(every acked write readable, convergence, bounded memory, zero
+misdirection) turn the suite red on regression.
+
+Full campaigns remain the pre-release bar:
+    python benchmarks/fuzz.py --trials 50 [--auto-remove]
+    python benchmarks/fuzz.py --device-plane --trials 10
+    python benchmarks/fuzz.py --proc [--device-plane] --trials 10
+    python benchmarks/soak.py --minutes 10
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fuzz():
+    spec = importlib.util.spec_from_file_location(
+        "apus_fuzz_campaign", os.path.join(REPO, "benchmarks", "fuzz.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sim_fuzz_slice():
+    """Six randomized schedules against the virtual-time simulator
+    (crashes, partitions, message loss) — safety (single leader per
+    term, consistent committed prefixes, every acked write readable)
+    and liveness (convergence) checked every phase.  Seeds are FRESH
+    per run body (seed_base differs from the manual campaigns') so CI
+    keeps exploring rather than replaying one greased path."""
+    fuzz = _fuzz()
+    for trial in range(6):
+        assert fuzz.run_schedule(trial, seed_base=31_000,
+                                 auto_remove=False) == "ok"
+    # One auto-remove schedule too (the quorum-floor ladder).
+    r = fuzz.run_schedule(0, seed_base=32_000, auto_remove=True)
+    assert r in ("ok", "expected_stall")
+
+
+def test_devplane_fuzz_slice():
+    """One live device-plane schedule (jitted commits, async deep
+    windows in flight, kills + restarts) in a fresh subprocess — the
+    altitude that exercises generation fencing and the election drain
+    under fire."""
+    fuzz = _fuzz()
+    assert fuzz._devplane_trial_subprocess(0, seed_base=33_000) == "ok"
+
+
+def test_proc_fuzz_slice():
+    """One process-per-replica schedule at the production envelope
+    (SIGKILL'd process groups, durable-store recovery, catch-up):
+    every acked write must survive and all replicas converge."""
+    fuzz = _fuzz()
+    assert fuzz.run_proc_schedule(0, seed_base=34_000) == "ok"
+
+
+@pytest.mark.mesh
+def test_proc_devplane_fuzz_slice():
+    """One multi-controller mesh schedule: commits proven to ride the
+    device quorum BEFORE the first fault, then kills degrade the plane
+    to TCP with exactly-once intact."""
+    fuzz = _fuzz()
+    assert fuzz.run_proc_schedule(0, seed_base=35_000,
+                                  device_plane=True) == "ok"
+
+
+def test_soak_slice():
+    """A 45-second endurance slice of the soak (real redis under
+    sustained replicated traffic at the production misdirection
+    posture): zero errors, zero misdirection, bounded RSS implied by
+    the soak's own leak gauges, final convergence on every replica."""
+    from apus_tpu.runtime.appcluster import (REDIS_SERVER, REDIS_TARBALL)
+    if not (os.path.exists(REDIS_SERVER) or os.path.exists(REDIS_TARBALL)):
+        pytest.skip("pinned redis unavailable")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "soak.py"),
+         "--minutes", "0.75"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    result = json.loads(line)
+    d = result["detail"]
+    assert d["errors"] == 0, d
+    assert d["misdirected"] == 0, d
+    assert d["converged"] is True, d
+    assert result["value"] > 50, d          # sustained replicated ops/s
