@@ -8,6 +8,7 @@ portable monotonic clock.  Scoped timers mirror TIMER_INIT/START/STOP/INFO
 
 from __future__ import annotations
 
+import math
 import time
 
 
@@ -17,6 +18,18 @@ def now() -> float:
 
 def now_ns() -> int:
     return time.perf_counter_ns()
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) of an ASCENDING list.
+
+    The one shared convention for benchmark percentile rows (the
+    harnesses used to hand-roll three slightly different ranks)."""
+    if not sorted_vals:
+        return float("nan")
+    k = min(len(sorted_vals) - 1,
+            max(0, math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[k]
 
 
 class ScopedTimer:

@@ -33,9 +33,9 @@ from apus_tpu.runtime.appcluster import (LineClient,  # noqa: E402
 
 
 def percentile(sorted_us: list[float], q: float) -> float:
-    if not sorted_us:
-        return float("nan")
-    return sorted_us[min(len(sorted_us) - 1, int(len(sorted_us) * q))]
+    """q in [0, 1]; nearest-rank via the shared helper."""
+    from apus_tpu.utils.timer import percentile as _p
+    return _p(sorted_us, q * 100.0)
 
 
 class LineDriver:

@@ -134,15 +134,36 @@ def cmd_run(args) -> int:
                     app="toyserver+devplane")
 
         # 2. Leader failover at the production envelope (process-per-
-        # replica; reconf_bench.sh FailLeader analog).
-        print("reconf_bench --proc: leader failover")
-        for rec in _run_tool(
-                [sys.executable,
-                 os.path.join(REPO, "benchmarks", "reconf_bench.py"),
-                 "--proc", "--replicas", str(max(replica_counts))],
-                timeout=240):
-            _record(out, rec, replicas=max(replica_counts),
-                    bench="reconf_bench")
+        # replica; reconf_bench.sh FailLeader analog).  With
+        # --failover-series N, one long kill/restart series per group
+        # size so the report can carry p50/p95/p99 over n>=N trials
+        # instead of a thin mean.
+        if args.failover_series > 0:
+            for n in replica_counts:
+                if n < 3:
+                    continue
+                print(f"reconf_bench --proc --series "
+                      f"{args.failover_series}: {n} replicas")
+                for rec in _run_tool(
+                        [sys.executable,
+                         os.path.join(REPO, "benchmarks",
+                                      "reconf_bench.py"),
+                         "--proc", "--replicas", str(n),
+                         "--series", str(args.failover_series)],
+                        # Worst-case legitimate trial on a loaded box is
+                        # ~75 s (failover probe + restart + converge);
+                        # a timeout kill would discard the WHOLE series.
+                        timeout=300 + 90 * args.failover_series):
+                    _record(out, rec, replicas=n, bench="reconf_bench")
+        else:
+            print("reconf_bench --proc: leader failover")
+            for rec in _run_tool(
+                    [sys.executable,
+                     os.path.join(REPO, "benchmarks", "reconf_bench.py"),
+                     "--proc", "--replicas", str(max(replica_counts))],
+                    timeout=240):
+                _record(out, rec, replicas=max(replica_counts),
+                        bench="reconf_bench")
 
         # 3. Device-plane pipelined commit round (bench.py; tries the
         # real TPU first, falls back to CPU under its own watchdog).
@@ -217,12 +238,15 @@ def cmd_report(args) -> int:
         vals = [r["value"] for r in recs
                 if isinstance(r.get("value"), (int, float))]
         st = _stats(vals)
-        p50 = _stats([r["detail"]["p50_us"] for r in recs
-                      if "p50_us" in r.get("detail", {})])
-        p95 = _stats([r["detail"]["p95_us"] for r in recs
-                      if "p95_us" in r.get("detail", {})])
-        p99 = _stats([r["detail"]["p99_us"] for r in recs
-                      if "p99_us" in r.get("detail", {})])
+        def _pct(q: int):
+            # Latency rows carry p{q}_us; failover-series rows carry
+            # p{q}_ms (the row's own unit column disambiguates).
+            return _stats([r["detail"].get(f"p{q}_us",
+                                           r["detail"].get(f"p{q}_ms"))
+                           for r in recs
+                           if f"p{q}_us" in r.get("detail", {})
+                           or f"p{q}_ms" in r.get("detail", {})])
+        p50, p95, p99 = _pct(50), _pct(95), _pct(99)
         unit = recs[-1].get("unit", "")
         lines.append(
             f"| {metric} | {n} | {app} | {st.get('n', 0)} "
@@ -248,7 +272,21 @@ def cmd_report(args) -> int:
             f"vs_baseline {last.get('vs_baseline')}")
     fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
           and isinstance(r.get("value"), (int, float))]
-    if fo:
+    ser = {}
+    for r in fo:                      # latest series record per group size
+        if r.get("detail", {}).get("series"):
+            ser[r.get("replicas")] = r
+    if ser:
+        for n, r in sorted(ser.items()):
+            d = r["detail"]
+            lines.append(
+                f"- leader failover @ {n} replicas (production envelope, "
+                f"process-per-replica, n={d['series']}): "
+                f"p50 {_fmt(d['p50_ms'])} ms, p95 {_fmt(d['p95_ms'])} ms, "
+                f"p99 {_fmt(d['p99_ms'])} ms "
+                f"(min {_fmt(d['min_ms'])}, max {_fmt(d['max_ms'])}); "
+                f"first commit p50 {_fmt(d['first_commit_p50_ms'])} ms")
+    elif fo:
         st = _stats([r["value"] for r in fo])
         lines.append(f"- leader failover (production envelope, process-"
                      f"per-replica): {_fmt(st['mean'])} ms "
@@ -364,6 +402,9 @@ def main() -> int:
         p.add_argument("--redis", action="store_true",
                        help="drive the pinned real redis instead of "
                             "toyserver")
+        p.add_argument("--failover-series", type=int, default=0,
+                       help="run a kill/restart failover series of this "
+                            "length per group size (p50/p95/p99 rows)")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",

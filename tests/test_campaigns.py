@@ -93,7 +93,10 @@ def test_soak_slice():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "soak.py"),
          "--minutes", "0.75"],
-        capture_output=True, text=True, timeout=420, cwd=REPO)
+        # Budget covers the build-from-tarball path the skip guard
+        # admits (build_redis alone is capped at 300 s) plus boot,
+        # 45 s of traffic, and the 120 s convergence window.
+        capture_output=True, text=True, timeout=900, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-800:]
     line = [ln for ln in proc.stdout.splitlines()
             if ln.startswith("{")][-1]
