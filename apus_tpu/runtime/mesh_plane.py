@@ -567,7 +567,6 @@ class MeshCommitRunner:
                         f"{self._expect_seq}")
                 if not poisoned:
                     self._expect_seq = desc.seq + 1
-                devlog = self._devlog
             # Term check under the DAEMON lock (election safety): a
             # round below our daemon's current term is poisoned — the
             # in-collective vote fence.
@@ -585,7 +584,21 @@ class MeshCommitRunner:
                                   desc.q_old, desc.q_new)
             import time as _time
             _t0 = _time.monotonic()
-            new_devlog, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
+            with self.lock:
+                # Dispatch AND swap under self.lock: the jit call
+                # donates the old devlog's buffers the moment it
+                # returns, so a shard reader that grabbed self._devlog
+                # between dispatch and swap would materialize a
+                # DELETED array (this killed follower planes under
+                # sustained traffic — the drain's shard_end raced one
+                # round dispatch per ~2k ops).  Readers take the same
+                # lock around their np.asarray, so they see either the
+                # pre-dispatch buffers (still valid) or the swapped-in
+                # new ones — never the donated carcass.
+                devlog = self._devlog
+                new_devlog, commits, _ = self._pipe(devlog, sdata,
+                                                    smeta, ctrl)
+                self._devlog = new_devlog
             _ms = (_time.monotonic() - _t0) * 1e3
             self.stats["max_dispatch_ms"] = max(
                 self.stats.get("max_dispatch_ms", 0.0), _ms)
@@ -594,7 +607,6 @@ class MeshCommitRunner:
                                     "(seq=%d, daemon lock held)",
                                     _ms, desc.seq)
             with self.lock:
-                self._devlog = new_devlog
                 K = self.FIXED_WINDOW
                 if poisoned:
                     self.stats["poisoned_rounds"] += 1
@@ -884,14 +896,19 @@ class MeshCommitRunner:
         from apus_tpu.ops.logplane import OFF_END
         if replica != self.idx:
             return None                 # only our own shard is local
+        err = None
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
-            offs = self._devlog.offs
-        try:
-            row = np.asarray(self._local_shard(offs))
-        except Exception as e:                        # noqa: BLE001
-            self._die(f"shard read failed: {e!r}")
+            # Materialize UNDER the lock: _do_round's dispatch+swap
+            # holds it, so the buffers we copy can't be donated away
+            # mid-read (see the donation note in _do_round).
+            try:
+                row = np.asarray(self._local_shard(self._devlog.offs))
+            except Exception as e:                    # noqa: BLE001
+                err = e
+        if err is not None:            # _die retakes self.lock
+            self._die(f"shard read failed: {err!r}")
             return None
         return int(row[0, OFF_END])
 
@@ -902,19 +919,25 @@ class MeshCommitRunner:
             return None
         cap = self.batch * (self.FIXED_WINDOW if window else 1)
         hi = min(hi, lo + cap)
+        slots = slot_of(lo + np.arange(hi - lo, dtype=np.int64),
+                        self.n_slots).astype(np.int32)
+        err = None
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
             if hi <= lo:
                 return []
-            data_arr, meta_arr = self._devlog.data, self._devlog.meta
-        slots = slot_of(lo + np.arange(hi - lo, dtype=np.int64),
-                        self.n_slots).astype(np.int32)
-        try:
-            data = np.asarray(self._local_shard(data_arr))[0][slots]
-            meta = np.asarray(self._local_shard(meta_arr))[0][slots]
-        except Exception as e:                        # noqa: BLE001
-            self._die(f"shard read failed: {e!r}")
+            # Materialize UNDER the lock — same donation race as
+            # shard_end (see _do_round).
+            try:
+                data = np.asarray(
+                    self._local_shard(self._devlog.data))[0][slots]
+                meta = np.asarray(
+                    self._local_shard(self._devlog.meta))[0][slots]
+            except Exception as e:                    # noqa: BLE001
+                err = e
+        if err is not None:            # _die retakes self.lock
+            self._die(f"shard read failed: {err!r}")
             return None
         out: list[LogEntry] = []
         for j, idx in enumerate(range(lo, hi)):

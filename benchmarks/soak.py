@@ -52,6 +52,13 @@ def main() -> int:
                     help="final per-replica convergence wait (a replica "
                          "revived late in a long run replays its whole "
                          "durable store first)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run on the multi-controller MESH device plane "
+                         "(one jax.distributed device per replica "
+                         "process): device-owned commits until the "
+                         "first kill degrades the ICI slice, then "
+                         "sustained TCP service — the endurance story "
+                         "for the production deployment shape")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -71,7 +78,9 @@ def main() -> int:
         app_argv = [REDIS_RUN]
         mk = lambda addr: RespClient(addr, timeout=15.0)  # noqa: E731
         do_set = lambda c, k, v: c.cmd("SET", k, v) == "OK"  # noqa: E731
-        do_get = lambda c, k: c.cmd("GET", k)  # noqa: E731
+        do_get = lambda c, k: (  # noqa: E731  (RESP bulk replies are bytes)
+            lambda r: r.decode() if isinstance(r, bytes) else r)(
+                c.cmd("GET", k))
 
     t_end = time.monotonic() + args.minutes * 60
     next_failover = (time.monotonic() + args.failover_every
@@ -81,13 +90,39 @@ def main() -> int:
     peak_rss: dict[int, int] = {}
     seq = 0
     ops_at_check = 0
-    last_acked: str | None = None
-    acked_at_check: str | None = None
+    last_acked: tuple[str, str] | None = None     # (key, expected value)
+    acked_at_check: tuple[str, str] | None = None
+
+    mesh_spec = None
+    if args.mesh:
+        import dataclasses as _dc
+        from apus_tpu.runtime.proc import MESH_PROC_SPEC
+        # auto_remove off: a degraded-then-revived member must not be
+        # evicted mid-soak (the fuzz mesh campaign runs the same way —
+        # eviction semantics are the simulator campaign's subject).
+        mesh_spec = _dc.replace(MESH_PROC_SPEC, auto_remove=False)
+    mesh_commits = 0            # high-water device-owned commit count
+    mesh_dead = False
+    mesh_degraded_after_ops = None
 
     with ProcCluster(args.replicas, app_argv=app_argv,
+                     spec=mesh_spec, device_plane=args.mesh,
                      tick_interval=args.tick_interval) as pc:
         leader = pc.leader_idx()
         client = mk(pc.app_addr(leader))
+
+        def mesh_check():
+            """Track the mesh plane's device-owned commit high-water
+            mark and the op count at which the ICI slice degraded."""
+            nonlocal mesh_commits, mesh_dead, mesh_degraded_after_ops
+            if not args.mesh:
+                return
+            st = pc.status(leader, timeout=1.0)
+            d = (st or {}).get("devplane") or {}
+            mesh_commits = max(mesh_commits, d.get("commits", 0))
+            if d.get("dead") and not mesh_dead:
+                mesh_dead = True
+                mesh_degraded_after_ops = ops
 
         def affinity_check():
             """Confirm the live connection still points at the leader;
@@ -122,6 +157,7 @@ def main() -> int:
             if now >= next_failover:
                 # Keep quorum: only kill when every replica is up.
                 if all(p is not None for p in pc.procs):
+                    mesh_check()     # commit high-water BEFORE the kill
                     try:
                         client.close()
                     except Exception:    # noqa: BLE001
@@ -136,16 +172,23 @@ def main() -> int:
                     leader = pc.leader_idx()
                     client = mk(pc.app_addr(leader))
                 next_failover = now + args.failover_every
-            k = f"soak:{seq}"
+            # Bounded keyspace (4000 < toyserver's fixed 4096-slot
+            # table, native/toyserver.c MAX_KEYS), seq-unique values:
+            # GET-after-SET stays an exact read-your-write check while
+            # the app's resident key count is capped — unbounded
+            # unique keys turn every SET into ERR once the toy table
+            # fills; redis just grows without bound.
+            k = f"soak:{seq % 4000}"
+            v = f"v{seq}".ljust(32, "x")
             seq += 1
             try:
-                if not do_set(client, k, "v" * 32):
+                if not do_set(client, k, v):
                     errors += 1
-                elif do_get(client, k) is None:
+                elif do_get(client, k) != v:
                     errors += 1
                 else:
                     ops += 2
-                    last_acked = k
+                    last_acked = (k, v)
             except (OSError, ConnectionError, RuntimeError):
                 # Reconnect (leadership may have moved under us).
                 reconnects += 1
@@ -172,9 +215,11 @@ def main() -> int:
                 # live connection, every op since is NOT a replicated
                 # op: retract them and reattach.
                 leader, client = affinity_check()
+                mesh_check()
         # One final check covers the tail window (ops since the last
         # multiple-of-200 checkpoint are unverified otherwise).
         affinity_check()
+        mesh_check()
         wall = time.monotonic() - t0
         client.close()
         # Traffic ran with the misdirection gate at the PRODUCTION
@@ -189,7 +234,7 @@ def main() -> int:
         # Final convergence on every replica's app — of the last key
         # that was actually ACKED (the last attempted one may have
         # died with a connection mid-reconnect).
-        want = last_acked or "soak:none"
+        wk, wv = last_acked or ("soak:none", "")
         converged = last_acked is not None
         for i in range(args.replicas):
             if pc.procs[i] is None:
@@ -199,7 +244,7 @@ def main() -> int:
             while True:
                 try:
                     with mk(pc.app_addr(i)) as c:
-                        if do_get(c, want):
+                        if do_get(c, wk) == wv:
                             ok = True
                             break
                 except (OSError, ConnectionError, RuntimeError):
@@ -223,6 +268,11 @@ def main() -> int:
             "converged": converged,
             "app": "toyserver" if args.toyserver else "redis",
             "replicas": args.replicas,
+            **({"mesh": {
+                "device_commits": mesh_commits,
+                "degraded": mesh_dead,
+                "degraded_after_ops": mesh_degraded_after_ops,
+            }} if args.mesh else {}),
         },
     }))
     return 0 if converged and not errors else 1
