@@ -266,7 +266,8 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
 def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
                                 slot_bytes: int, batch: int, depth: int,
                                 staged_depth: int | None = None,
-                                verify_round: bool = False):
+                                verify_round: bool = False,
+                                donate: bool = True):
     """Device-resident pipelined commit: ``depth`` consecutive commit
     rounds execute inside ONE XLA program (a ``lax.scan`` over staged
     batches), so host dispatch cost is paid once per ``depth`` rounds.
@@ -288,6 +289,13 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     batches are provided; round i consumes batch ``i % SD``.  SD=1 with
     a large depth is the steady-state throughput shape: one resident
     batch re-committed round after round with no staging cost.
+
+    ``donate=False`` keeps the input devlog's buffers VALID after the
+    call (one extra ring resident transiently).  Multi-threaded
+    drivers whose shard readers run concurrently with dispatch need
+    this: with donation, a reader must either risk materializing a
+    deleted buffer or hold the driver lock across an unbounded device
+    sync (runtime.mesh_plane).
     """
     staged_depth = depth if staged_depth is None else staged_depth
     _check_geometry(mesh, n_replicas, n_slots, batch)
@@ -340,7 +348,8 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
         out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs),
         check_vma=False)
 
-    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(jax.jit,
+                       **({"donate_argnums": 0} if donate else {}))
     def step(devlog: DeviceLog, staged_data, staged_meta,
              ctrl: CommitControl):
         _assert_devlog_geometry(devlog, n_slots, slot_bytes, batch)

@@ -396,9 +396,18 @@ class MeshCommitRunner:
             self._staged_sharding = NamedSharding(self._mesh,
                                                   P(None, REPLICA_AXIS))
             K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
+            # donate=False is LIVENESS here, not a perf choice: shard
+            # readers (follower drain, pre-vote drain) materialize
+            # host copies concurrently with dispatch.  With donation
+            # they must either race a deleted buffer or hold self.lock
+            # across an unbounded device sync — which would also wedge
+            # _die/quiesce/_do_round (daemon lock) behind a stuck
+            # collective, defeating the WAIT_BUDGET_S degrade path.
+            # Cost: one extra ring resident transiently per process.
             self._pipe = build_pipelined_commit_step(
                 self._mesh, self.n_replicas, self.n_slots, SB, B,
-                depth=K, staged_depth=K, verify_round=True)
+                depth=K, staged_depth=K, verify_round=True,
+                donate=False)
             self._jax = jax
             self._np_staged_zero = np.zeros((K, 1, B, SB), np.uint8)
             self._np_meta_zero = np.zeros((K, 1, B, 4), np.int32)
@@ -584,20 +593,20 @@ class MeshCommitRunner:
                                   desc.q_old, desc.q_new)
             import time as _time
             _t0 = _time.monotonic()
+            # The pipe does NOT donate (see _build), so the previous
+            # devlog's buffers stay valid after dispatch: a shard
+            # reader that grabbed self._devlog concurrently reads
+            # stale-but-valid data, never a deleted buffer.  (The
+            # donating variant killed follower planes under sustained
+            # traffic — the drain's shard_end raced one dispatch per
+            # ~2k ops and materialized a deleted array; and holding
+            # self.lock across dispatch+materialize instead would
+            # park _die/quiesce/_do_round behind a stuck collective.)
             with self.lock:
-                # Dispatch AND swap under self.lock: the jit call
-                # donates the old devlog's buffers the moment it
-                # returns, so a shard reader that grabbed self._devlog
-                # between dispatch and swap would materialize a
-                # DELETED array (this killed follower planes under
-                # sustained traffic — the drain's shard_end raced one
-                # round dispatch per ~2k ops).  Readers take the same
-                # lock around their np.asarray, so they see either the
-                # pre-dispatch buffers (still valid) or the swapped-in
-                # new ones — never the donated carcass.
                 devlog = self._devlog
-                new_devlog, commits, _ = self._pipe(devlog, sdata,
-                                                    smeta, ctrl)
+            new_devlog, commits, _ = self._pipe(devlog, sdata,
+                                                smeta, ctrl)
+            with self.lock:
                 self._devlog = new_devlog
             _ms = (_time.monotonic() - _t0) * 1e3
             self.stats["max_dispatch_ms"] = max(
@@ -896,19 +905,18 @@ class MeshCommitRunner:
         from apus_tpu.ops.logplane import OFF_END
         if replica != self.idx:
             return None                 # only our own shard is local
-        err = None
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
-            # Materialize UNDER the lock: _do_round's dispatch+swap
-            # holds it, so the buffers we copy can't be donated away
-            # mid-read (see the donation note in _do_round).
-            try:
-                row = np.asarray(self._local_shard(self._devlog.offs))
-            except Exception as e:                    # noqa: BLE001
-                err = e
-        if err is not None:            # _die retakes self.lock
-            self._die(f"shard read failed: {err!r}")
+            offs = self._devlog.offs
+        # Materialize OUTSIDE the lock: the pipe does not donate (see
+        # _build), so this reference stays valid even if a new round
+        # dispatches+swaps concurrently; the sync here parks only THIS
+        # reader until the producing round completes.
+        try:
+            row = np.asarray(self._local_shard(offs))
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"shard read failed: {e!r}")
             return None
         return int(row[0, OFF_END])
 
@@ -921,23 +929,21 @@ class MeshCommitRunner:
         hi = min(hi, lo + cap)
         slots = slot_of(lo + np.arange(hi - lo, dtype=np.int64),
                         self.n_slots).astype(np.int32)
-        err = None
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
             if hi <= lo:
                 return []
-            # Materialize UNDER the lock — same donation race as
-            # shard_end (see _do_round).
-            try:
-                data = np.asarray(
-                    self._local_shard(self._devlog.data))[0][slots]
-                meta = np.asarray(
-                    self._local_shard(self._devlog.meta))[0][slots]
-            except Exception as e:                    # noqa: BLE001
-                err = e
-        if err is not None:            # _die retakes self.lock
-            self._die(f"shard read failed: {err!r}")
+            data_arr, meta_arr = self._devlog.data, self._devlog.meta
+        # Bulk copy OUTSIDE the lock — non-donated buffers stay valid
+        # (see shard_end); holding self.lock across a whole-shard
+        # device sync would serialize _do_round (which waits on it
+        # while holding the daemon lock) behind every drain.
+        try:
+            data = np.asarray(self._local_shard(data_arr))[0][slots]
+            meta = np.asarray(self._local_shard(meta_arr))[0][slots]
+        except Exception as e:                        # noqa: BLE001
+            self._die(f"shard read failed: {e!r}")
             return None
         out: list[LogEntry] = []
         for j, idx in enumerate(range(lo, hi)):

@@ -103,7 +103,7 @@ def main() -> int:
         mesh_spec = _dc.replace(MESH_PROC_SPEC, auto_remove=False)
     mesh_commits = 0            # high-water device-owned commit count
     mesh_dead = False
-    mesh_degraded_after_ops = None
+    mesh_degraded_at_write = None
 
     with ProcCluster(args.replicas, app_argv=app_argv,
                      spec=mesh_spec, device_plane=args.mesh,
@@ -114,7 +114,7 @@ def main() -> int:
         def mesh_check():
             """Track the mesh plane's device-owned commit high-water
             mark and the op count at which the ICI slice degraded."""
-            nonlocal mesh_commits, mesh_dead, mesh_degraded_after_ops
+            nonlocal mesh_commits, mesh_dead, mesh_degraded_at_write
             if not args.mesh:
                 return
             st = pc.status(leader, timeout=1.0)
@@ -122,7 +122,11 @@ def main() -> int:
             mesh_commits = max(mesh_commits, d.get("commits", 0))
             if d.get("dead") and not mesh_dead:
                 mesh_dead = True
-                mesh_degraded_after_ops = ops
+                # seq, not ops: a later affinity retraction rolls
+                # ops back, which could leave this marker exceeding
+                # the final count.  seq (attempted writes) is
+                # monotonic.
+                mesh_degraded_at_write = seq
 
         def affinity_check():
             """Confirm the live connection still points at the leader;
@@ -237,8 +241,10 @@ def main() -> int:
         wk, wv = last_acked or ("soak:none", "")
         converged = last_acked is not None
         for i in range(args.replicas):
-            if pc.procs[i] is None:
-                continue
+            if pc.procs[i] is None or last_acked is None:
+                continue        # nothing acked: already False, don't
+                                # poll an unmatchable sentinel for
+                                # replicas * converge_timeout
             ok = False
             deadline = time.monotonic() + args.converge_timeout
             while True:
@@ -271,7 +277,7 @@ def main() -> int:
             **({"mesh": {
                 "device_commits": mesh_commits,
                 "degraded": mesh_dead,
-                "degraded_after_ops": mesh_degraded_after_ops,
+                "degraded_at_write": mesh_degraded_at_write,
             }} if args.mesh else {}),
         },
     }))

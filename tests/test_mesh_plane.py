@@ -204,3 +204,44 @@ def test_mesh_plane_replicates_real_redis(tmp_path):
                   f"({d['death_reason']}) — replication stayed correct")
     finally:
         pc.stop()
+
+
+def test_mesh_plane_survives_sustained_traffic(tmp_path):
+    """Regression: the devlog donation race.  _do_round used to
+    dispatch the jitted window (donating the old devlog's buffers)
+    OUTSIDE self.lock, so a follower drain's shard_end in the
+    dispatch->swap gap materialized a deleted array and killed its
+    plane within ~2k ops of continuous traffic; the leader's next
+    descriptor feed then took the whole plane down.  Dispatch+swap and
+    shard reads now serialize on self.lock — sustained traffic must
+    leave the plane alive and owning commit.
+
+    Distinct from the campaign slice: fuzz trials inject faults and
+    stop quickly; this drives FAULT-FREE continuous writes long enough
+    (~40 s, hundreds of rounds) that the pre-fix race fired reliably."""
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"), spec=MESH_SPEC,
+                     device_plane=True, db=False)
+    pc.start(timeout=60.0)
+    try:
+        _wait_mesh_ready(pc)
+        with ApusClient(list(pc.spec.peers)) as c:
+            _pump_until(
+                pc, lambda: _devplane(pc, pc.leader_idx(timeout=5.0))
+                .get("commits", 0) > 0, c, timeout=90.0, tag=b"st")
+            t_end = time.monotonic() + 40.0
+            n = 0
+            while time.monotonic() < t_end:
+                assert c.put(b"st-%d" % n, b"v%d" % n) == b"OK"
+                n += 1
+            lead = pc.leader_idx(timeout=10.0)
+            for i in range(3):
+                d = _devplane(pc, i)
+                assert not d.get("dead"), \
+                    f"plane died under sustained traffic on {i}: " \
+                    f"{d.get('death_reason')}"
+            dl = _devplane(pc, lead)
+            assert dl.get("owns_commit"), dl
+            assert c.get(b"st-%d" % (n - 1)) == b"v%d" % (n - 1)
+        pc.wait_converged(timeout=30.0)
+    finally:
+        pc.stop()
