@@ -401,6 +401,36 @@ class ProcCluster:
             time.sleep(0.05)
         raise AssertionError(f"replicas did not converge: {sts}")
 
+    def wait_mesh_ready(self, timeout: float = 120.0,
+                        tolerate_dead: bool = False) -> list:
+        """Block until every live replica's mesh plane reports ready
+        (the bring-up rendezvous — compile + gloo clique — finished).
+        The ONE shared readiness criterion: tests/benches used to
+        hand-roll subtly different status polls.  Returns the final
+        per-replica devplane dicts.  A plane that died during bring-up
+        raises unless ``tolerate_dead`` (callers that measure
+        degradation semantics pass True and inspect the result).
+        Leader probes are deliberately NOT part of the criterion:
+        election churn while N JAX runtimes compile on a small box is
+        expected and irrelevant to plane readiness."""
+        deadline = time.monotonic() + timeout
+        last: list = []
+        while time.monotonic() < deadline:
+            sts = [self.status(i, timeout=1.0)
+                   for i in range(len(self.spec.peers))
+                   if self.procs[i] is not None]
+            last = [(s or {}).get("devplane") for s in sts]
+            dead = [d for d in last if d and d.get("dead")]
+            if dead:
+                if tolerate_dead:
+                    return last
+                raise AssertionError(f"mesh died during bring-up: "
+                                     f"{dead[0]}")
+            if last and all(d and d.get("ready") for d in last):
+                return last
+            time.sleep(0.5)
+        raise AssertionError(f"mesh plane never ready: {last}")
+
     def measure_failover(self, timeout: float = 15.0) -> float:
         """Kill the current leader and return seconds until a NEW leader
         is elected and answering status (reconf_bench.sh leader-failure

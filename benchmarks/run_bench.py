@@ -300,10 +300,6 @@ def main() -> int:
                          "timing envelope (run.sh deployment shape) "
                          "instead of the in-process thread cluster")
     args = ap.parse_args()
-    if args.proc and args.device_plane:
-        print("--proc and --device-plane are mutually exclusive (the "
-              "device runner shares one in-process mesh)", file=sys.stderr)
-        return 2
 
     value = "x" * args.value_bytes
     app_argv = args.app.split() if args.app else None
@@ -336,8 +332,20 @@ def main() -> int:
 
     if args.proc:
         from apus_tpu.runtime.proc import ProcCluster
+        mesh_spec = None
+        if args.device_plane:
+            # --proc --device-plane = the MULTI-CONTROLLER mesh plane:
+            # one OS process per replica, each one device of a global
+            # jax.distributed mesh (runtime.mesh_plane) — the
+            # production shape with device-owned commit.
+            import dataclasses as _dc
+
+            from apus_tpu.runtime.proc import MESH_PROC_SPEC
+            mesh_spec = _dc.replace(MESH_PROC_SPEC, auto_remove=False)
         cluster = ProcCluster(args.replicas,
                               app_argv=app_argv or "toyserver",
+                              spec=mesh_spec,
+                              device_plane=args.device_plane,
                               follower_reads=True)
     else:
         cluster = ProxiedCluster(args.replicas, app_argv=app_argv,
@@ -348,6 +356,17 @@ def main() -> int:
             is not None
 
     with cluster as pc:
+        if args.proc and args.device_plane:
+            # Let the mesh finish its bring-up rendezvous (compile +
+            # gloo clique, ~tens of seconds on a small box) so the
+            # bench measures device-owned commit, not the TCP warmup.
+            # A plane that degraded (or never readied) is reported by
+            # the mesh_plane_rounds row, not hidden by a crash here.
+            try:
+                pc.wait_mesh_ready(timeout=120.0, tolerate_dead=True)
+            except AssertionError as e:
+                print(f"mesh bring-up incomplete, proceeding on the "
+                      f"TCP plane: {e}", file=sys.stderr)
         results = [drive(pc, drv, "set", args.requests, args.clients, value),
                    drive(pc, drv, "get", args.requests, args.clients, value)]
 
@@ -392,7 +411,28 @@ def main() -> int:
             "value": 1 if replicated else 0, "unit": "bool",
             "detail": {"leader_count": want, "counts": counts},
         })
-        if args.device_plane and pc.cluster.device_runner is not None:
+        if args.device_plane and args.proc:
+            # Mesh-plane stats ride the wire status op (the runner
+            # lives inside each replica process, not in this one).  A
+            # failed probe must be visibly missing, never a zero row
+            # (the redis_benchmark helper follows the same rule).
+            d = None
+            for _ in range(10):
+                st = pc.status(leader, timeout=2.0)
+                if st is not None and st.get("devplane") is not None:
+                    d = st["devplane"]
+                    break
+                time.sleep(0.5)
+            if d is None:
+                print("mesh stats probe failed; omitting "
+                      "mesh_plane_rounds", file=sys.stderr)
+            else:
+                results.append({
+                    "metric": "mesh_plane_rounds",
+                    "value": d.get("rounds", 0), "unit": "rounds",
+                    "detail": d,
+                })
+        elif args.device_plane and pc.cluster.device_runner is not None:
             r = pc.cluster.device_runner
             ld = pc.cluster.daemons[leader]
             results.append({

@@ -133,6 +133,23 @@ def cmd_run(args) -> int:
             _record(out, rec, replicas=3, bench="run_bench_devplane",
                     app="toyserver+devplane")
 
+        # 1c. MULTI-CONTROLLER mesh plane full stack (the production
+        # deployment shape: one OS process per replica, one device
+        # each on a global jax.distributed mesh, device-owned commit).
+        # On this 1-core host three JAX runtimes timeshare one core,
+        # so the absolute throughput is a floor, not the shape's
+        # capability; the row's value is the mesh evidence
+        # (owns_commit, rounds, zero quorum failures).
+        print("run_bench: 3 replicas (multi-controller mesh)")
+        argv = [sys.executable,
+                os.path.join(REPO, "benchmarks", "run_bench.py"),
+                "--replicas", "3",
+                "--requests", str(min(args.requests, 1000)),
+                "--proc", "--device-plane"]
+        for rec in _run_tool(argv, timeout=600):
+            _record(out, rec, replicas=3, bench="run_bench_mesh",
+                    app="toyserver+mesh")
+
         # 2. Leader failover at the production envelope (process-per-
         # replica; reconf_bench.sh FailLeader analog).  With
         # --failover-series N, one long kill/restart series per group

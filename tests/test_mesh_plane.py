@@ -23,19 +23,7 @@ pytestmark = pytest.mark.mesh
 
 
 def _wait_mesh_ready(pc: ProcCluster, timeout: float = 120.0) -> None:
-    deadline = time.monotonic() + timeout
-    last = None
-    while time.monotonic() < deadline:
-        sts = [pc.status(i, timeout=1.0) for i in range(pc.n)]
-        last = [s.get("devplane") if s else None for s in sts]
-        if all(d and d.get("dead") is False and d.get("ready")
-               for d in last):
-            return
-        for d in last:
-            if d and d.get("dead"):
-                raise AssertionError(f"mesh died during bring-up: {d}")
-        time.sleep(0.5)
-    raise AssertionError(f"mesh plane never ready: {last}")
+    pc.wait_mesh_ready(timeout=timeout)     # shared readiness criterion
 
 
 def _devplane(pc: ProcCluster, i: int) -> dict:
