@@ -584,7 +584,31 @@ def main(argv: Optional[list] = None) -> int:
         start_t = progress_t = time.monotonic()
         last_probe = 0.0
         heard_leader = False
+        # Orphan watchdog (harness-launched daemons only): a test or
+        # benchmark harness killed by a timeout never runs
+        # ProcCluster.stop(), and its replicas — in their own process
+        # groups by design — would run forever, thrashing evict/rejoin
+        # cycles and starving every later harness on the box (observed:
+        # a timeout-killed mesh bench left a 3-replica cluster churning
+        # for 9+ minutes, failing a concurrent soak's election probe).
+        # The env var carries the HARNESS pid (not a boolean): capturing
+        # getppid() here instead would race startup — a harness that
+        # dies while this daemon is still in daemon.start() has already
+        # reparented us, and we would record the reaper's pid and never
+        # fire.  Comparing against the spawn-time harness pid detects
+        # that window too.  Unset (or unparseable/non-positive) =
+        # disabled, so manually-launched daemons whose shell
+        # legitimately exits are unaffected.
+        try:
+            harness_pid = int(os.environ.get("APUS_EXIT_IF_ORPHANED", ""))
+        except ValueError:
+            harness_pid = 0
         while not stop_evt.is_set():
+            if harness_pid > 0 and os.getppid() != harness_pid:
+                daemon.logger.error(
+                    "harness (pid %d) gone; exiting "
+                    "(APUS_EXIT_IF_ORPHANED)", harness_pid)
+                return 0
             if app_proc is not None and app_proc.poll() is not None:
                 daemon.logger.error("app exited rc=%d; shutting down",
                                     app_proc.returncode)

@@ -88,6 +88,7 @@ depends on it.
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import socket
 import threading
@@ -128,9 +129,21 @@ def serve_coordinator(addr: str, n_processes: int) -> None:
     import time as _time
     print(f"APUS-MESH-COORDINATOR ready at {addr} for {n_processes} "
           f"processes", flush=True)
+    # Orphan watchdog (same contract as the replica daemon's, see
+    # daemon.py main loop): the env var carries the HARNESS pid; when
+    # our parent is no longer that pid the harness died without
+    # stop() — exit instead of serving a dead mesh forever.
+    try:
+        harness_pid = int(os.environ.get("APUS_EXIT_IF_ORPHANED", ""))
+    except ValueError:
+        harness_pid = 0
     try:
         while True:
-            _time.sleep(3600)
+            if harness_pid > 0 and os.getppid() != harness_pid:
+                print("harness gone; coordinator exiting "
+                      "(APUS_EXIT_IF_ORPHANED)", flush=True)
+                return
+            _time.sleep(2.0)
     finally:
         del svc
 

@@ -200,6 +200,12 @@ class ProcCluster:
             self._logs[i] = open(
                 os.path.join(self.workdir, f"proc{tag}.out"), "ab")
         env = _repo_env()
+        # Orphan watchdog: if THIS harness process dies without stop()
+        # (timeout-killed by a parent), the daemon self-exits when its
+        # parent is no longer this pid (daemon.py main loop) — the pid
+        # in the var (not a flag) closes the spawn-time race where the
+        # harness dies before the child reaches its watchdog init.
+        env["APUS_EXIT_IF_ORPHANED"] = str(os.getpid())
         # A stale ready file (unclean previous run in a reused workdir,
         # or a restart) would make _wait_ready return before the daemon
         # is actually up.
@@ -223,6 +229,7 @@ class ProcCluster:
             self._coord_log = open(
                 os.path.join(self.workdir, "coordinator.out"), "ab")
         env = _repo_env()
+        env["APUS_EXIT_IF_ORPHANED"] = str(os.getpid())  # see _spawn
         self._coord = subprocess.Popen(
             [sys.executable, "-m", "apus_tpu.runtime.mesh_plane",
              "--serve-coordinator", self.spec.mesh_coordinator,

@@ -206,3 +206,66 @@ def test_evicted_process_rejoins_promptly_on_restart(tmp_path):
             assert took < 10.0, f"rejoin took {took:.1f}s"
             assert c.put(b"post", b"2") == b"OK"
 
+
+
+def test_orphaned_daemons_self_exit(tmp_path):
+    """Orphan watchdog: a harness killed WITHOUT stop() (the shape a
+    parent's subprocess timeout produces — SIGKILL, no __exit__) must
+    not leave replica daemons running forever.  Observed pre-fix: a
+    timeout-killed mesh bench left a 3-replica cluster churning
+    evict/rejoin cycles for 9+ minutes, starving a concurrent soak
+    into a failed election probe.  ProcCluster-spawned daemons carry
+    APUS_EXIT_IF_ORPHANED and exit on reparent."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from apus_tpu.runtime.proc import ProcCluster\n"
+        f"pc = ProcCluster(3, workdir={str(tmp_path / 'c')!r}, db=False)\n"
+        "pc.start(timeout=45.0)\n"
+        "print('PIDS', ' '.join(str(p.pid) for p in pc.procs), flush=True)\n"
+        "time.sleep(300)\n"
+    )
+    harness = subprocess.Popen([sys.executable, "-c", code],
+                               stdout=subprocess.PIPE, text=True)
+    try:
+        line = harness.stdout.readline()
+        assert line.startswith("PIDS "), line
+        pids = [int(x) for x in line.split()[1:]]
+        assert len(pids) == 3
+        # The harness dies as a timeout kill would: SIGKILL, no stop().
+        harness.kill()
+        harness.wait(timeout=5.0)
+        deadline = time.monotonic() + 20.0
+        alive = list(pids)
+        while time.monotonic() < deadline and alive:
+            alive = [p for p in alive if _pid_alive(p)]
+            time.sleep(0.2)
+        assert not alive, f"daemons survived harness death: {alive}"
+    finally:
+        if harness.poll() is None:
+            harness.kill()
+        # If the watchdog REGRESSED, the leaked daemons would starve
+        # every later test in this session — reap their process
+        # groups unconditionally (no-op when the watchdog worked).
+        for p in (pids if "pids" in locals() else []):
+            try:
+                os.killpg(p, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+
+
+def _pid_alive(pid: int) -> bool:
+    import os
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
