@@ -1318,6 +1318,19 @@ class Node:
             # slow-booting replicas (first dial + backoff can outlast
             # PERMANENT_FAILURE * fail_window on process launch).
             return
+        if self.t.peer_failure_was_timeout(peer):
+            # Timeout on an established connection: the peer's process
+            # is alive (it holds the connection open) but busy — e.g.
+            # installing a multi-second snapshot after a deep-history
+            # restart.  The reference's counter only sees WC errors,
+            # which require connection-level death; a busy-but-connected
+            # peer is never auto-removed (dare_ibv_rc.c:3202-3314).
+            # Counting these here produced an evict/rejoin LIVELOCK: the
+            # leader evicted a joiner mid-install, it rejoined still
+            # behind, the next install blocked it again (observed in a
+            # 30-minute soak, epochs climbing 2 per ~4 s until a kill
+            # during the churn stalled the group).
+            return
         if now - self._fail_last.get(peer, -1e9) < self.cfg.fail_window:
             return
         self._fail_last[peer] = now

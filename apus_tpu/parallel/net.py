@@ -271,6 +271,14 @@ class NetTransport(Transport):
         self._established: set[int] = set()
         self._first_dial: dict[int, float] = {}
         self.establish_grace = 10.0
+        #: peer -> monotonic time of the last TIMEOUT-kind failure
+        #: (established connection, peer busy); consulted by
+        #: peer_failure_was_timeout immediately after a failed op.
+        #: The freshness window must outlast one backoff+redial+
+        #: retimeout cycle — while the peer stays busy, the hint is
+        #: only refreshed when an op reaches it and times out again.
+        self._timeout_hint: dict[int, float] = {}
+        self._timeout_hint_window = max(2.0, 2.0 * backoff + timeout)
 
     def peer_established(self, target: int) -> bool:
         if target in self._established:
@@ -278,6 +286,16 @@ class NetTransport(Transport):
         first = self._first_dial.get(target)
         return (first is not None
                 and time.monotonic() - first > self.establish_grace)
+
+    def peer_failure_was_timeout(self, target: int) -> bool:
+        """True when the failure being reported RIGHT NOW (callers
+        consult this immediately after a failed op) was a timeout on an
+        established connection — peer alive, event loop busy.  The
+        freshness window only needs to cover the gap between the op
+        and the failure-detector's check on the same tick."""
+        hint = self._timeout_hint.get(target)
+        return (hint is not None and
+                time.monotonic() - hint < self._timeout_hint_window)
 
     def set_peer(self, idx: int, addr: tuple[str, int]) -> None:
         """Register/replace a peer endpoint (membership change)."""
@@ -340,6 +358,12 @@ class NetTransport(Transport):
                 else:
                     self._conns[target] = conn
                     self._established.add(target)
+        except ConnectionRefusedError:
+            # Positive evidence of DEATH (no listener at the address):
+            # clears any busy-peer timeout hint so the failure detector
+            # resumes counting.
+            self._timeout_hint.pop(target, None)
+            self._down_until[target] = time.monotonic() + self.backoff
         except OSError:
             self._down_until[target] = time.monotonic() + self.backoff
         finally:
@@ -379,6 +403,14 @@ class NetTransport(Transport):
             with self._peer_lock(target):
                 conn = self._connect(target)
                 if conn is None:
+                    # No connection (dial in flight / backoff): leave
+                    # any busy-peer timeout hint in place — a conn
+                    # dropped BECAUSE of a timeout alternates with this
+                    # path while the peer is still busy, and clearing
+                    # here would let every other tick's failure count.
+                    # The hint is cleared by evidence instead: op
+                    # success, an in-op connection error, or a dial
+                    # REFUSED (death) in _dial.
                     return None
                 try:
                     conn.settimeout(eff)
@@ -386,8 +418,26 @@ class NetTransport(Transport):
                     resp = wire.read_frame(conn)
                     if resp is None:
                         raise ConnectionError("peer closed")
+                    self._timeout_hint.pop(target, None)
                     return resp
+                except TimeoutError:
+                    # Timeout on an ESTABLISHED connection: the peer's
+                    # process holds the socket open but its event loop
+                    # is busy (e.g. a multi-second snapshot install).
+                    # Record the kind so the failure detector can skip
+                    # it (Transport.peer_failure_was_timeout) — the
+                    # reference's WC-error counter never sees a
+                    # busy-but-connected peer, and counting these
+                    # evicted mid-install joiners in an endless
+                    # evict/rejoin livelock (observed in a 30-min soak
+                    # at deep history).
+                    self._timeout_hint[target] = time.monotonic()
+                    self._drop_conn(target)
+                    self._down_until[target] = \
+                        time.monotonic() + self.backoff
+                    return None
                 except (OSError, ConnectionError, ValueError):
+                    self._timeout_hint.pop(target, None)
                     self._drop_conn(target)
                     self._down_until[target] = \
                         time.monotonic() + self.backoff

@@ -121,6 +121,18 @@ class Transport:
         has not come up yet must not be auto-removed as "failed"."""
         return True
 
+    def peer_failure_was_timeout(self, target: int) -> bool:
+        """Whether the MOST RECENT failed op to ``target`` was a timeout
+        on an ESTABLISHED connection — the peer's process is alive (it
+        holds the TCP connection open) but its event loop is busy, e.g.
+        installing a multi-second snapshot.  The reference's failure
+        counter only sees WC errors, which require connection-level
+        death (dare_ibv_rc.c:3202-3314 classifies them off the QP) — a
+        busy-but-connected peer generates none, so it is never
+        auto-removed.  Transports that cannot distinguish return False
+        (every failure counts, the pre-r4 behavior)."""
+        return False
+
     # control plane -------------------------------------------------------
     def ctrl_write(self, target: int, region: Region, slot: int,
                    value: Any) -> WriteResult:

@@ -155,3 +155,79 @@ def test_log_write_reply_carries_synchronous_ack():
             from apus_tpu.parallel.transport import Region
             assert leader.node.regions.ctrl[Region.REP_ACK][
                 follower.idx] is not None
+
+
+def test_busy_peer_timeout_not_counted_as_failure():
+    """Failure-kind classification (the evict/rejoin livelock fix): a
+    timeout on an ESTABLISHED connection means the peer's process is
+    alive but its event loop is busy (a deep-history snapshot install
+    blocks it for many seconds) — the reference's WC-error counter
+    never sees such a peer, so ours must not count it either
+    (dare_ibv_rc.c:3202-3314).  A dead peer (refused/reset) still
+    counts.  Observed pre-fix: a 30-minute soak's leader evicted a
+    restarting replica mid-install every ~4 s, epochs climbing until a
+    kill during the churn stalled the whole group."""
+    import socket
+    import threading
+
+    from apus_tpu.parallel.net import NetTransport
+    from apus_tpu.parallel.transport import Region
+
+    # A "busy" wire server: accepts and reads, never replies.
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def busy_server():
+        conns = []
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                conns.append(c)         # hold open, never answer
+            except TimeoutError:
+                continue
+            except OSError:
+                break
+        for c in conns:
+            c.close()
+
+    th = threading.Thread(target=busy_server, daemon=True)
+    th.start()
+    srv_addr = srv.getsockname()
+    try:
+        t = NetTransport({1: srv_addr}, timeout=0.2)
+        # First op dials in the background; wait for establishment.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            t.ctrl_write(1, Region.HB, 0, 1)
+            if t.peer_established(1):
+                break
+            time.sleep(0.05)
+        assert t.peer_established(1)
+        # An op that times out on the held-open connection: classified
+        # as a busy-peer timeout, not a death.
+        res = t.ctrl_write(1, Region.HB, 0, 1)
+        assert res.name == "DROPPED"
+        assert t.peer_failure_was_timeout(1)
+    finally:
+        stop.set()
+        srv.close()
+        th.join(timeout=2.0)
+
+    # Dead peer: the same op against a closed port is refused — the
+    # hint entry itself must be CLEARED by the refused dial (asserting
+    # on peer_failure_was_timeout alone would pass vacuously once the
+    # freshness window expires).
+    t2 = NetTransport({1: srv_addr}, timeout=0.2)
+    t2._established.add(1)              # pretend bootstrap reached it
+    t2._timeout_hint[1] = time.monotonic()   # stale hint from earlier
+    deadline = time.monotonic() + 1.5        # << freshness window
+    while time.monotonic() < deadline:
+        t2.ctrl_write(1, Region.HB, 0, 1)    # kicks a background dial
+        if 1 not in t2._timeout_hint:
+            break
+        time.sleep(0.05)
+    assert 1 not in t2._timeout_hint, "refused dial did not clear hint"
+    assert not t2.peer_failure_was_timeout(1)
