@@ -231,3 +231,49 @@ def test_busy_peer_timeout_not_counted_as_failure():
         time.sleep(0.05)
     assert 1 not in t2._timeout_hint, "refused dial did not clear hint"
     assert not t2.peer_failure_was_timeout(1)
+
+
+def test_busy_follower_survives_dead_follower_evicted():
+    """Protocol-level pin of the livelock fix: with auto_remove ON, a
+    follower whose event loop is BLOCKED for many fail_windows (the
+    deep-history snapshot-install shape — its wire server holds the
+    daemon lock, so every op to it times out on an established
+    connection) must stay a member; a follower whose process is
+    actually GONE (connections refused) must still be evicted."""
+    from apus_tpu.utils.config import ClusterSpec
+
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150,
+                       auto_remove=True, fail_window=0.050)
+    with LocalCluster(3, spec=spec) as c:
+        leader = c.wait_for_leader()
+        _, pr = c.submit(encode_put(b"k", b"v"))
+        assert wait(lambda: all_applied(c, pr.idx))
+        follower = next(d for d in c.live() if d.idx != leader.idx)
+
+        # Phase 1: BUSY.  Hold the follower's daemon lock well past
+        # PERMANENT_FAILURE * fail_window while the leader keeps
+        # heartbeating/replicating at it.
+        with follower.lock:
+            time.sleep(0.8)             # 16 fail_windows of timeouts
+        with leader.lock:
+            still_member = leader.node.cid.contains(follower.idx)
+        assert still_member, \
+            "busy-but-alive follower was evicted (livelock regression)"
+        # And it recovers: new writes reach it.
+        _, pr2 = c.submit(encode_put(b"k2", b"v2"))
+        assert wait(lambda: all_applied(c, pr2.idx))
+
+        # Phase 2: DEAD.  Stop the follower's daemon (its listener
+        # closes -> dials refused) and the leader must evict it.
+        c.kill(follower.idx)
+        deadline = time.monotonic() + 10.0
+        evicted = False
+        while time.monotonic() < deadline:
+            c.submit(encode_put(b"fill", b"x"))
+            with leader.lock:
+                evicted = not leader.node.cid.contains(follower.idx)
+            if evicted:
+                break
+            time.sleep(0.05)
+        assert evicted, "dead follower never evicted"
