@@ -132,6 +132,19 @@ def make_client_ops(daemon) -> dict:
                     "fallbacks": drv.stats.get("fallbacks", 0),
                     "commits": n.stats.get("devplane_commits", 0),
                     "owns_commit": n.external_commit,
+                    # Re-formation observability (mesh runners): the
+                    # plane epoch this process last joined, its clique,
+                    # whether a rebuild is in flight, and how many
+                    # epochs this process has joined.
+                    "epoch": getattr(runner, "epoch", None),
+                    "members": list(getattr(runner, "members", []))
+                    or None,
+                    "building": getattr(runner, "building", False),
+                    "build_target": (getattr(runner, "_build_target", -1)
+                                     if getattr(runner, "building", False)
+                                     or getattr(runner, "_build_target",
+                                                -1) >= 0 else None),
+                    "reforms": runner.stats.get("reforms", 0),
                 }
         return wire.u8(wire.ST_OK) + wire.blob(json.dumps(st).encode())
 

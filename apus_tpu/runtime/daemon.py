@@ -507,27 +507,33 @@ def main(argv: Optional[list] = None) -> int:
         while len(spec.peers) <= slot:
             spec.peers.append("")
         spec.peers[slot] = my_addr
+        # Mesh-capable joiners carry a DETACHED runner: the leader's
+        # reformer re-admits the slot into the device clique at the
+        # next plane epoch (the RC re-handshake-on-rejoin analog).
+        mesh_runner = _make_mesh_runner(args, spec, slot, joined=True)
+        if mesh_runner is not None:
+            mesh_runner.start()
         daemon = ReplicaDaemon(slot, spec, sm=make_sm(slot), cid=cid,
                                listen_sock=sock, recovery_start=True,
                                tick_interval=args.tick_interval,
-                               log_file=args.log_file, db_dir=args.db_dir)
+                               log_file=args.log_file, db_dir=args.db_dir,
+                               device_runner=mesh_runner)
     else:
         # Multi-controller mesh plane (runtime.mesh_plane): static
         # members 0..mesh_n-1 each own one device of the global mesh.
         # The build (jax.distributed rendezvous + compile) runs in the
         # background; TCP consensus serves immediately and the driver
-        # engages once the plane is ready.  Joiners stay TCP-only: the
-        # device geometry is fixed at cluster launch, like a TPU slice.
-        mesh_runner = None
-        if (spec.mesh_coordinator and spec.mesh_n > 0
-                and 0 <= args.idx < spec.mesh_n
-                and not args.no_device_plane
-                and _mesh_incarnation_fresh(args, spec)):
-            from apus_tpu.runtime.mesh_plane import MeshCommitRunner
-            from apus_tpu.utils.debug import make_logger
-            mesh_runner = MeshCommitRunner(
-                spec, args.idx,
-                logger=make_logger(f"apus.mesh{args.idx}", args.log_file))
+        # engages once the plane is ready.  A restarted incarnation
+        # starts DETACHED (the per-epoch incarnation rule) and rejoins
+        # at the next plane epoch the leader's reformer assigns —
+        # re-formation replaces the old "degraded until cluster
+        # restart" semantics (RC re-handshake analog,
+        # dare_ibv_ud.c:1098-1416).  Joiners beyond mesh_n stay
+        # TCP-only: the device-capable slot set is fixed at cluster
+        # launch, like a TPU slice's chip count.
+        mesh_runner = _make_mesh_runner(args, spec, args.idx,
+                                        joined=False)
+        if mesh_runner is not None:
             mesh_runner.start()
         daemon = ReplicaDaemon(args.idx, spec, sm=make_sm(args.idx),
                                tick_interval=args.tick_interval,
@@ -549,6 +555,15 @@ def main(argv: Optional[list] = None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
 
     daemon.start()
+    # Re-formation orchestrator (active only while this daemon leads):
+    # rebuilds the device clique under the next plane epoch once
+    # membership re-stabilizes after a death/rejoin.
+    reformer = None
+    if getattr(daemon, "device_driver", None) is not None and \
+            hasattr(daemon.device_driver.runner, "request_reform"):
+        from apus_tpu.runtime.mesh_plane import MeshReformer
+        reformer = MeshReformer(daemon, daemon.device_driver.runner, spec)
+        reformer.start()
     try:
         if bridged:
             from apus_tpu.runtime.bridge import Bridge, proxy_env
@@ -682,6 +697,8 @@ def main(argv: Optional[list] = None) -> int:
             stop_evt.wait(0.2)
         return 0
     finally:
+        if reformer is not None:
+            reformer.stop()
         _stop_app(app_proc)
         if bridge is not None:
             bridge.stop()
@@ -705,36 +722,77 @@ def daemon_store_exists(db_dir: str, idx: int) -> bool:
     return os.path.exists(daemon_store_path(db_dir, idx))
 
 
-def _mesh_incarnation_fresh(args, spec) -> bool:
-    """Mesh membership is PER-INCARNATION: a crashed-and-restarted
-    replica must NOT reconnect to the coordination service — the
-    service rejects the new incarnation (ABORTED) and the runtime's
-    error polling then LOG(FATAL)-terminates every HEALTHY member
-    (observed empirically), turning a routine restart into a total
-    outage.  A durable marker keyed by the coordinator address records
-    "this slot already joined this mesh epoch"; seeing it, the restarted
-    daemon stays TCP-only (the plane on survivors already degraded when
-    this process died — a TPU slice needs a full restart the same way).
-    A NEW mesh epoch (fresh coordinator address, e.g. a whole-cluster
-    restart) writes a fresh marker and participates normally."""
+def _mesh_marker_path(args, spec, idx: int):
     import os
     mdir = args.db_dir or args.workdir or (
         os.path.dirname(args.ready_file) if args.ready_file else None)
     if mdir is None:
-        return True          # nowhere to remember: best effort
+        return None          # nowhere to remember: best effort
     os.makedirs(mdir, exist_ok=True)
-    marker = os.path.join(mdir, f"mesh-incarnation-{args.idx}")
+    return os.path.join(mdir, f"mesh-incarnation-{idx}")
+
+
+def _mesh_marker_read(args, spec, idx: int):
+    """Mesh membership is PER-INCARNATION-PER-EPOCH: a crashed-and-
+    restarted replica must NOT reconnect to a coordination-service
+    instance its dead incarnation was part of — the service rejects the
+    new incarnation (ABORTED) and the runtime's error polling then
+    LOG(FATAL)-terminates every HEALTHY member (observed empirically),
+    turning a routine restart into a total outage.  The durable marker
+    records (coordinator address, last epoch this slot joined).
+    Returns that epoch when the marker matches the current coordinator
+    — the restarted daemon then starts DETACHED and only participates
+    from epoch+1 on (assigned by the leader's reformer) — or None for
+    a fresh slot / a new coordinator (whole-cluster restart)."""
+    marker = _mesh_marker_path(args, spec, idx)
+    if marker is None:
+        return None
     try:
         with open(marker) as f:
-            if f.read().strip() == spec.mesh_coordinator:
-                return False            # restart within the same epoch
-    except OSError:
+            lines = f.read().splitlines()
+        if lines and lines[0].strip() == spec.mesh_coordinator:
+            return int(lines[1]) if len(lines) > 1 else 0
+    except (OSError, ValueError):
         pass
+    return None
+
+
+def _mesh_marker_write(args, spec, idx: int, epoch: int) -> None:
+    """Record "this incarnation joined plane epoch E" BEFORE connecting
+    to E's coordination service (MeshCommitRunner.on_epoch_join)."""
+    import os
+    marker = _mesh_marker_path(args, spec, idx)
+    if marker is None:
+        return
     tmp = marker + ".tmp"
     with open(tmp, "w") as f:
-        f.write(spec.mesh_coordinator)
+        f.write(f"{spec.mesh_coordinator}\n{epoch}\n")
     os.replace(tmp, marker)
-    return True
+
+
+def _make_mesh_runner(args, spec, idx: int, joined: bool):
+    """Mesh runner for slot ``idx`` when the config enables the
+    multi-controller plane and the slot is mesh-capable; None
+    otherwise.  ``joined=True`` (join-protocol entry — a recovered or
+    fresh member admitted by the leader) always starts DETACHED: this
+    incarnation may never re-enter an epoch an earlier incarnation of
+    the slot was part of, so it waits for the leader's reformer to
+    assign the next one."""
+    if not (spec.mesh_coordinator and spec.mesh_n > 0
+            and 0 <= idx < spec.mesh_n and not args.no_device_plane):
+        return None
+    from apus_tpu.runtime.mesh_plane import MeshCommitRunner
+    from apus_tpu.utils.debug import make_logger
+    detached_epoch = _mesh_marker_read(args, spec, idx)
+    if joined and detached_epoch is None:
+        detached_epoch = -1             # fresh joiner: detached, no past
+    runner = MeshCommitRunner(
+        spec, idx,
+        logger=make_logger(f"apus.mesh{idx}", args.log_file),
+        detached_epoch=detached_epoch)
+    runner.on_epoch_join = \
+        lambda e: _mesh_marker_write(args, spec, idx, e)
+    return runner
 
 
 def _excluded_by_live_leader(daemon: "ReplicaDaemon", spec) -> bool:

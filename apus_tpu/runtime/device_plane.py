@@ -345,6 +345,19 @@ class DeviceCommitRunner:
     def max_data_bytes(self) -> int:
         return self.slot_bytes - self.WIRE_OVERHEAD
 
+    def covers_replica(self, slot: int) -> bool:
+        """Whether ``slot``'s shard exists in the device geometry (the
+        in-process runner's geometry is the static 0..n_replicas-1; a
+        joiner beyond it has no shard)."""
+        return 0 <= slot < self.n_replicas
+
+    def quorum_coverable(self, cid) -> bool:
+        """Whether the device geometry can own commit for ``cid``
+        (every configured member must have a shard here — the
+        in-process runner has no clique notion; the mesh runner
+        overrides with clique-quorum coverage)."""
+        return cid.extended_group_size <= self.n_replicas
+
     # -- lifecycle of a leadership ---------------------------------------
 
     def reset(self, leader: int, term: int, first_idx: int) -> Optional[int]:
@@ -784,9 +797,10 @@ class DevicePlaneDriver:
         runs with the lock RELEASED; results are re-validated after."""
         term = node.current_term
         B = self.runner.batch
-        if node.cid.extended_group_size > self.runner.n_replicas:
-            # Configuration outgrew the device geometry: host path owns
-            # commit until it fits again.
+        if not self.runner.quorum_coverable(node.cid):
+            # The device geometry/clique cannot own quorum for this
+            # configuration (outgrown it, or too few clique members):
+            # host path owns commit until it can again.
             if self._gen is not None:
                 self._gen = None
                 self._inflight.clear()
@@ -1074,7 +1088,7 @@ class DevicePlaneDriver:
         _follower_step; loops until shard_end is absorbed or a guard
         fails (tail not at current term, decode hole, full log)."""
         node = self.daemon.node
-        if not (0 <= self.daemon.idx < self.runner.n_replicas):
+        if not self.runner.covers_replica(self.daemon.idx):
             return
         # Multi-controller runner: every window this process dispatched
         # must finish executing BEFORE the vote below, or shard acks
@@ -1128,8 +1142,8 @@ class DevicePlaneDriver:
         """Drain device rows from our shard into the host log (safety
         argument 2: only on top of a current-term entry).  Never touches
         commit — that arrives via the leader's TCP writes."""
-        if not (0 <= self.daemon.idx < self.runner.n_replicas):
-            return False       # outside the device geometry (joiner)
+        if not self.runner.covers_replica(self.daemon.idx):
+            return False       # outside the device geometry/clique
         gen = self.runner.generation
         if gen == 0:
             return False

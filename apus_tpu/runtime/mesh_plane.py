@@ -1,5 +1,5 @@
 """Multi-controller device plane: process-per-replica commit over a
-global ``jax.distributed`` mesh.
+global ``jax.distributed`` mesh, with epoch-based RE-FORMATION.
 
 The reference's one-sided data plane runs INSIDE every server process —
 each machine's DARE thread posts RDMA writes from its own address space
@@ -39,37 +39,80 @@ rules enforce it:
 2. ONE dispatch authority per process — the worker thread — consuming
    an ordered queue fed locally (leader) and by descriptor arrivals
    (followers).
-3. NEVER drop, always POISON.  A descriptor that is stale (old
-   generation, or a term below the daemon's current term) is still
-   dispatched — pairing! — but with a poisoned round identity, so the
-   in-step ``verify_round`` check refuses the write EVERYWHERE and the
-   round decides nothing.  This is the in-step form of QP-reset
-   fencing (dare_ibv_rc.c:2156-2255): the deposed leader's write
-   executes against the fabric but cannot land or mint a commit.
+3. NEVER drop, always POISON — within an epoch.  A descriptor that is
+   stale (old generation, or a term below the daemon's current term)
+   is still dispatched — pairing! — but with a poisoned round
+   identity, so the in-step ``verify_round`` check refuses the write
+   EVERYWHERE and the round decides nothing.  This is the in-step
+   form of QP-reset fencing (dare_ibv_rc.c:2156-2255): the deposed
+   leader's write executes against the fabric but cannot land or mint
+   a commit.  ACROSS epochs the rule inverts: a descriptor from
+   another plane epoch is NACKed (its clique is globally defunct — a
+   member only reforms once the old plane is dead everywhere, so
+   there is no live collective left to pair with), which promptly
+   kills the stale sender's feed and forces it through re-formation.
+
+RE-FORMATION (plane epochs) — the capability the reference gets from
+its RC re-handshake (a restarted server re-runs RC_SYN/SYNACK/ACK and
+the leader resumes one-sided replication to it, dare_ibv_ud.c:1098-1416,
+QPs re-granted dare_ibv_rc.c:2195-2255):
+
+- A *plane epoch* is one ``jax.distributed`` clique lifetime.  Epoch 0
+  is the initial bring-up.  When the plane degrades (member death,
+  wedge, election-budget poisoning) and the consensus membership
+  re-stabilizes — dead member evicted, or rejoined and caught up — the
+  LEADER rebuilds the clique under a new epoch: a fresh coordination-
+  service instance (``MeshCoordinator.prepare``), a fresh gloo
+  rendezvous, fresh shards, a fresh worker thread.
+- The clique is the sorted list of live mesh-capable slots; mesh row r
+  is ``members[r]``, so a shrunk clique {0,2} of group {0,1,2} still
+  owns commit (2-of-3 quorum rides the device; the third member
+  catches up over the TCP plane — the reference's RDMA-to-live-
+  followers shape).  Quorum *thresholds* stay derived from the full
+  configuration sizes (masking shrinks only the numerator).
+- Teardown is validated-empirical (jaxlib 0.9, probed): drop array +
+  executable refs, ``jax.clear_caches()``, shut down the distributed
+  client (stops its error poller — the client of a deleted service
+  otherwise LOG(FATAL)s the process), ``xla_bridge._clear_backends()``,
+  then re-init.  A collective STUCK in the old backend (wedged peer)
+  does not block this: the old client lingers ref-held by its stuck
+  execution and is reaped when gloo times out; the stuck worker thread
+  is abandoned (each epoch has its own worker + queue).
+- The incarnation rule (a crashed replica's NEW process must never
+  re-join a service instance its dead incarnation was part of — the
+  service rejects it and the runtime terminates the healthy members)
+  becomes per-epoch: the durable marker records the last epoch this
+  slot joined; a restarted daemon comes up DETACHED and participates
+  only from the next epoch on, which the leader's reformer assigns.
 
 Election safety (why device acks may count toward commit at all): a
 follower's vote must cover every entry its shard ever acked, or a
 deposed leader could commit through shard acks the new leader's
 election never saw.  Two mechanisms close this:
 
-- The worker dispatches UNDER THE DAEMON LOCK with a term check — any
-  round at a term below the daemon's is poisoned (a voter that moved
-  to term T+1 refuses T-rounds *in the collective itself*).
+- The worker decides poisoning UNDER THE DAEMON LOCK with a term check
+  and registers the window handle in ``_outstanding`` *before*
+  releasing it; the dispatch itself then runs OUTSIDE the daemon lock
+  (a dispatch can block for minutes inside a wedged collective —
+  holding the lock there would wedge the daemon's tick thread and
+  take the replica's TCP consensus down with the plane).  Any vote is
+  serialized against this by the same lock: either the vote's term
+  bump happens first (the worker then poisons the round), or the
+  handle is registered first (the vote is vetoed until it resolves
+  and the drain absorbs its rows).
 - ``quiesce_ready()`` — consulted by the driver's pre-election hook
   before ANY vote is granted or campaign starts.  While a window this
   process dispatched is still executing, the vote is VETOED (deferred
-  a tick — never blocked in place, which would wedge the daemon while
-  e.g. a dead leader's half-dispatched collective takes seconds to
-  error out); once all windows are executed, the shard drain absorbs
-  the landed rows into the host log and the vote proceeds.  Every
-  round is therefore either (a) executed + drained before the vote
-  (counted in the vote's log-up-to-dateness, standard Raft
-  intersection), or (b) dispatched after it, hence poisoned by the
-  term check.  Windows merely QUEUED at hook time dispatch after the
-  vote, i.e. (b).  Liveness cost: after a leader dies with windows in
-  flight, elections wait for the backend to surface the error (~1-5 s
-  observed) — the same order as the reference waiting out RDMA retry
-  exhaustion before a QP error frees its voters.
+  a tick — never blocked in place); once all windows are executed,
+  the shard drain absorbs the landed rows into the host log and the
+  vote proceeds.  The veto is BOUNDED: past
+  ``spec.mesh_election_budget`` (~100 ms) the plane is POISONED —
+  declared dead, vote proceeds, re-formation restores the plane later
+  — the immediate-revocation analog of QP reset
+  (dare_ibv_rc.c:2156-2189), affordable now that a poisoned plane is
+  not permanently lost.  (Pre-re-formation this wait rode the
+  backend's own error surfacing, ~0.5-5 s — the mesh-envelope
+  failover inflation VERDICT r4 flagged.)
 
 Failure semantics (the ICI-slice model): the distributed runtime is
 brought up with effectively-infinite coordination heartbeats — the
@@ -80,9 +123,9 @@ itself sees it: the collective errors out promptly and CATCHABLY
 (connection reset), the worker deactivates the plane, and the daemon
 continues on the TCP plane — the reference degrades the same way when
 a NIC dies and its QPs error out (WC error taxonomy,
-dare_ibv_rc.c:3202-3314).  A degraded mesh plane stays down until the
-cluster restarts (a TPU slice behaves the same way); consensus never
-depends on it.
+dare_ibv_rc.c:3202-3314).  A degraded plane no longer stays down for
+the cluster's lifetime: the reformer brings it back under the next
+epoch once membership re-stabilizes.
 """
 
 from __future__ import annotations
@@ -92,7 +135,8 @@ import os
 import queue
 import socket
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -104,6 +148,10 @@ from apus_tpu.parallel import wire
 OP_MESH = 13
 _SUB_RESET = 0
 _SUB_ROUND = 1
+_SUB_REFORM = 2
+
+#: MeshCoordinator control ops.
+_COORD_PREPARE = 1
 
 #: Effectively-infinite coordination heartbeat (seconds): liveness is
 #: the consensus layer's job; the device plane learns of death from
@@ -111,41 +159,181 @@ _SUB_ROUND = 1
 _NO_HEARTBEAT = 10 ** 7
 
 
-def serve_coordinator(addr: str, n_processes: int) -> None:
-    """Host the jax.distributed coordination service and nothing else.
+# -- coordinator ------------------------------------------------------------
 
-    The service lives in its OWN process, outside every replica: a
-    replica that hosted it would couple the whole mesh's fate to its
-    own — the runtime's error-polling treats "coordination service
-    unreachable" as LOG(FATAL) and terminates every member (observed
-    empirically), turning one replica crash into a total outage.  A
-    dedicated coordinator is never a fault-injection target, exactly
-    like the reference's IB subnet manager is not one of the replicas.
-    Blocks forever (run it under a supervisor)."""
-    from jax._src.lib import _jax
-    svc = _jax.get_distributed_runtime_service(
-        addr, n_processes,
-        heartbeat_timeout=_NO_HEARTBEAT, shutdown_timeout=5)
-    import time as _time
-    print(f"APUS-MESH-COORDINATOR ready at {addr} for {n_processes} "
-          f"processes", flush=True)
-    # Orphan watchdog (same contract as the replica daemon's, see
-    # daemon.py main loop): the env var carries the HARNESS pid; when
-    # our parent is no longer that pid the harness died without
-    # stop() — exit instead of serving a dead mesh forever.
-    try:
-        harness_pid = int(os.environ.get("APUS_EXIT_IF_ORPHANED", ""))
-    except ValueError:
-        harness_pid = 0
-    try:
-        while True:
-            if harness_pid > 0 and os.getppid() != harness_pid:
-                print("harness gone; coordinator exiting "
-                      "(APUS_EXIT_IF_ORPHANED)", flush=True)
+
+class MeshCoordinator:
+    """Plane-epoch control server + coordination-service factory.
+
+    Lives in its OWN process, outside every replica: a replica that
+    hosted the coordination service would couple the whole mesh's fate
+    to its own — the runtime's error-polling treats "coordination
+    service unreachable" as LOG(FATAL) and terminates every member
+    (observed empirically), turning one replica crash into a total
+    outage.  A dedicated coordinator is never a fault-injection
+    target, exactly like the reference's IB subnet manager is not one
+    of the replicas.
+
+    Protocol (wire-framed over TCP at ``addr``):
+      PREPARE(epoch u64, n u8) -> ST_OK + blob(service host:port)
+        Idempotent per epoch: the first call creates a fresh
+        ``jax.distributed`` service instance for ``n`` processes on an
+        ephemeral port; repeats return the same address (every clique
+        member PREPAREs epoch 0 independently at bring-up; later
+        epochs are PREPAREd by the leader's reformer).  A repeat with
+        a DIFFERENT n is refused — a half-joined service instance
+        cannot change size.
+
+    Old service instances are kept alive until ``keep`` newer epochs
+    exist (probed: deleting a service whose clients haven't detached
+    LOG(FATAL)s them; by ``keep`` epochs later any straggler is a
+    wedged, already-evicted incarnation whose termination is the slice
+    reset it needs anyway)."""
+
+    def __init__(self, addr: str, keep: int = 4):
+        host, port = addr.rsplit(":", 1)
+        self.host = host
+        self.keep = keep
+        self._lock = threading.Lock()
+        #: epoch -> (service, n, "host:port")
+        self._epochs: dict[int, tuple] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(32)
+        self._stop = threading.Event()
+
+    @property
+    def addr(self) -> str:
+        h, p = self._sock.getsockname()
+        return f"{h}:{p}"
+
+    def _prepare(self, epoch: int, n: int) -> Optional[str]:
+        from jax._src.lib import _jax
+        with self._lock:
+            have = self._epochs.get(epoch)
+            if have is not None:
+                return have[2] if have[1] == n else None
+            # Ephemeral port, bind-then-close reservation (free_port
+            # shape): the service API needs an explicit port.
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind((self.host, 0))
+            port = s.getsockname()[1]
+            s.close()
+            addr = f"{self.host}:{port}"
+            svc = _jax.get_distributed_runtime_service(
+                addr, n, heartbeat_timeout=_NO_HEARTBEAT,
+                shutdown_timeout=5)
+            self._epochs[epoch] = (svc, n, addr)
+            print(f"APUS-MESH-COORDINATOR epoch {epoch} at {addr} for "
+                  f"{n} processes", flush=True)
+            # GC epochs more than `keep` behind the newest.
+            newest = max(self._epochs)
+            for e in [e for e in self._epochs if e <= newest - self.keep]:
+                del self._epochs[e]
+            return addr
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5.0)
+            while True:
+                payload = wire.read_frame(conn)
+                if payload is None:
+                    return
+                r = wire.Reader(payload)
+                if r.u8() != _COORD_PREPARE:
+                    conn.sendall(wire.frame(wire.u8(wire.ST_ERROR)))
+                    continue
+                epoch, n = r.u64(), r.u8()
+                addr = self._prepare(epoch, n)
+                if addr is None:
+                    conn.sendall(wire.frame(wire.u8(wire.ST_ERROR)))
+                else:
+                    conn.sendall(wire.frame(
+                        wire.u8(wire.ST_OK) + wire.blob(addr.encode())))
+        except Exception:                             # noqa: BLE001
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> None:
+        print(f"APUS-MESH-COORDINATOR ready at {self.addr}", flush=True)
+        # Orphan watchdog (same contract as the replica daemon's): the
+        # env var carries the HARNESS pid; when our parent is no longer
+        # that pid the harness died without stop() — exit instead of
+        # serving a dead mesh forever.
+        try:
+            harness_pid = int(os.environ.get("APUS_EXIT_IF_ORPHANED", ""))
+        except ValueError:
+            harness_pid = 0
+        if harness_pid > 0:
+            def _watch():
+                while not self._stop.is_set():
+                    if os.getppid() != harness_pid:
+                        print("harness gone; coordinator exiting "
+                              "(APUS_EXIT_IF_ORPHANED)", flush=True)
+                        os._exit(0)
+                    time.sleep(2.0)
+            threading.Thread(target=_watch, daemon=True).start()
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
                 return
-            _time.sleep(2.0)
-    finally:
-        del svc
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def serve_coordinator(addr: str, n_processes: int) -> None:
+    """Host the mesh coordination control server (one per cluster,
+    outside every replica).  ``n_processes`` is advisory — each
+    epoch's size arrives in its PREPARE.  Blocks forever (run it under
+    a supervisor)."""
+    del n_processes
+    MeshCoordinator(addr).serve_forever()
+
+
+def prepare_epoch(coordinator: str, epoch: int, n: int,
+                  timeout: float = 5.0, retry_for: float = 0.0) -> str:
+    """Ask the coordinator for epoch ``epoch``'s coordination-service
+    address (creating the service if this is the first ask).
+    ``retry_for`` > 0 retries connection failures for that many seconds
+    — replica daemons and the coordinator launch concurrently, so the
+    first PREPARE can race the coordinator's bind."""
+    host, port = coordinator.rsplit(":", 1)
+    deadline = time.monotonic() + retry_for
+    while True:
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout) as s:
+                s.settimeout(timeout)
+                s.sendall(wire.frame(wire.u8(_COORD_PREPARE)
+                                     + wire.u64(epoch) + wire.u8(n)))
+                resp = wire.read_frame(s)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.25)
+    if resp is None:
+        raise ConnectionError("coordinator hung up")
+    r = wire.Reader(resp)
+    if r.u8() != wire.ST_OK:
+        raise RuntimeError(f"coordinator refused epoch {epoch} (n={n})")
+    return r.blob().decode()
+
+
+# -- distributed runtime bring-up/teardown ----------------------------------
 
 
 def init_distributed(coordinator: str, n_processes: int, process_id: int,
@@ -155,12 +343,13 @@ def init_distributed(coordinator: str, n_processes: int, process_id: int,
     """Bring up ``jax.distributed`` with consensus-friendly failure
     semantics (no heartbeat-triggered process termination, no exit-time
     shutdown barrier).  Must run before the first jax backend
-    initialization in this process.  ``platform='cpu'`` pins the CPU
-    backend (gloo collectives) for CPU deployments/tests; '' leaves the
-    platform alone (real TPU pods).  ``host_service`` embeds the
-    coordination service in process 0 — ONLY for hermetic harnesses
-    (dryrun); deployments run ``serve_coordinator`` in its own process
-    (see its docstring for why)."""
+    initialization in this process — or after :func:`teardown_
+    distributed`.  ``platform='cpu'`` pins the CPU backend (gloo
+    collectives) for CPU deployments/tests; '' leaves the platform
+    alone (real TPU pods).  ``host_service`` embeds the coordination
+    service in process 0 — ONLY for hermetic harnesses (dryrun);
+    deployments run a ``MeshCoordinator`` in its own process (see its
+    docstring for why)."""
     import os
 
     import jax
@@ -204,10 +393,44 @@ def init_distributed(coordinator: str, n_processes: int, process_id: int,
     state.coordinator_address = coordinator
 
 
+def teardown_distributed() -> None:
+    """Tear down this process's ``jax.distributed`` client + backend so
+    :func:`init_distributed` can re-rendezvous under a new plane epoch.
+    Validated empirically (jaxlib 0.9): non-blocking even with a
+    collective STUCK in flight — the old PJRT client stays ref-held by
+    its stuck execution and is reaped when gloo times out; the explicit
+    ``client.shutdown()`` stops the coordination error poller (whose
+    survival past service deletion otherwise LOG(FATAL)s the
+    process)."""
+    import jax
+    from jax._src import distributed, xla_bridge
+
+    jax.clear_caches()
+    state = distributed.global_state
+    client = state.client
+    state.client = None
+    state.process_id = 0
+    state.num_processes = 1
+    state.coordinator_address = None
+    if client is not None:
+        try:
+            client.shutdown()
+        except Exception:                             # noqa: BLE001
+            pass
+        del client
+    xla_bridge._clear_backends()
+
+
+# -- wire payloads ----------------------------------------------------------
+
+
 @dataclasses.dataclass
 class _RoundDesc:
-    """Everything a follower needs to dispatch the identical program."""
+    """Everything a follower needs to dispatch the identical program.
+    ``leader`` is the leader's mesh ROW (clique-relative); masks are in
+    row space."""
 
+    epoch: int
     gen: int
     seq: int
     leader: int
@@ -220,6 +443,7 @@ class _RoundDesc:
 
     def encode(self) -> bytes:
         return (wire.u8(OP_MESH) + wire.u8(_SUB_ROUND)
+                + wire.u64(self.epoch)
                 + wire.u64(self.gen) + wire.u64(self.seq)
                 + wire.u8(self.leader) + wire.u64(self.term)
                 + wire.u64(self.end0) + wire.u8(self.q_old)
@@ -229,13 +453,20 @@ class _RoundDesc:
 
     @staticmethod
     def decode(r: wire.Reader) -> "_RoundDesc":
-        gen, seq = r.u64(), r.u64()
+        epoch, gen, seq = r.u64(), r.u64(), r.u64()
         leader, term, end0 = r.u8(), r.u64(), r.u64()
         q_old, q_new = r.u8(), r.u8()
         mask_old = list(r.blob())
         mask_new = list(r.blob())
-        return _RoundDesc(gen, seq, leader, term, end0,
+        return _RoundDesc(epoch, gen, seq, leader, term, end0,
                           mask_old, mask_new, q_old, q_new)
+
+
+def encode_reform(epoch: int, members: list[int], svc_addr: str,
+                  term: int) -> bytes:
+    return (wire.u8(OP_MESH) + wire.u8(_SUB_REFORM) + wire.u64(epoch)
+            + wire.u64(term) + wire.blob(bytes(members))
+            + wire.blob(svc_addr.encode()))
 
 
 class _PeerFeed:
@@ -291,14 +522,15 @@ class _PeerFeed:
 
 
 class MeshWindowHandle:
-    """In-flight window handle (device-side commits vector + the
-    expectations to account for it at resolve time)."""
+    """In-flight window handle.  ``commits`` is None from registration
+    (pre-dispatch, under the daemon lock) until the dispatch returns —
+    observers (quiesce, waits) treat that as not-ready."""
 
-    __slots__ = ("gen", "end0", "K", "commits", "poisoned")
+    __slots__ = ("epoch", "gen", "end0", "K", "commits", "poisoned")
 
-    def __init__(self, gen: int, end0: int, K: int, commits,
-                 poisoned: bool = False):
-        self.gen, self.end0, self.K = gen, end0, K
+    def __init__(self, epoch: int, gen: int, end0: int, K: int,
+                 commits=None, poisoned: bool = False):
+        self.epoch, self.gen, self.end0, self.K = epoch, gen, end0, K
         self.commits, self.poisoned = commits, poisoned
 
 
@@ -306,15 +538,21 @@ class MeshCommitRunner:
     """Driver-facing runner whose shards live one-per-process on a
     global mesh.  Exposes the DeviceCommitRunner surface the
     DevicePlaneDriver consumes, plus ``FIXED_WINDOW`` (the single
-    window shape every dispatch uses)."""
+    window shape every dispatch uses).
+
+    Epoch lifecycle: ``start()`` builds epoch ``min_epoch`` (0 for a
+    fresh slot) unless constructed DETACHED (restarted incarnation —
+    waits for the leader's reformer to assign the next epoch);
+    ``request_reform`` tears the old clique down and rebuilds under a
+    new epoch (module docstring, RE-FORMATION)."""
 
     WIRE_OVERHEAD = 64
 
-    def __init__(self, spec, idx: int, logger=None):
+    def __init__(self, spec, idx: int, logger=None,
+                 detached_epoch: Optional[int] = None):
         self.spec = spec
         self.idx = idx
         self.logger = logger
-        self.n_replicas = spec.mesh_n
         self.batch = spec.max_batch
         K = spec.mesh_depth
         self.FIXED_WINDOW = K
@@ -329,21 +567,43 @@ class MeshCommitRunner:
         # ((inflight+K)*B <= S, the driver's capacity gate).
         self.n_slots = spec.mesh_slots or 4 * K * self.batch
         self.lock = threading.Lock()
+        #: Plane epoch this process last JOINED (-1 = never); members =
+        #: that epoch's clique (slot list, row-ordered).  n_replicas
+        #: tracks len(members) for driver/status compatibility.
+        if detached_epoch is not None:
+            self.epoch = detached_epoch
+            self.min_epoch = detached_epoch + 1
+            self._detached_start = True
+        else:
+            self.epoch = -1
+            self.min_epoch = 0
+            self._detached_start = False
+        self.members: list[int] = []
+        self.n_replicas = spec.mesh_n
+        self._row = -1
+        self.building = False
+        self._build_target = -1
+        self._R = spec.mesh_n           # geometry of the built arrays
         self.generation = 0
         self._worker_gen = 0            # generation of the worker's arrays
         self._term = 0
-        self._leader: Optional[int] = None
+        self._leader: Optional[int] = None   # leader SLOT
         self._next_end0: Optional[int] = None
         self._seq = 0                   # leader-side descriptor ordinal
         self._expect_seq = 0            # follower-side ordinal (per gen)
         self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
                       "entries_devplane": 0, "pipelined_dispatches": 0,
-                      "poisoned_rounds": 0}
+                      "poisoned_rounds": 0, "reforms": 0}
         self.depth_histogram: dict[int, int] = {}
         self.pallas_modes: dict[int, Optional[str]] = {K: None}
         self.ready = False
         self.dead = False
         self.death_reason: Optional[str] = None
+        #: Marker callback: invoked with the epoch JUST BEFORE this
+        #: process connects to its coordination service (the durable
+        #: "this incarnation joined epoch E" record the restart logic
+        #: keys on — daemon._mesh_marker_write).
+        self.on_epoch_join: Optional[Callable[[int], None]] = None
         self._devlog = None
         self._q: "queue.Queue" = queue.Queue()
         #: every dispatched-but-unresolved window (leader AND follower
@@ -364,10 +624,25 @@ class MeshCommitRunner:
     def start(self) -> None:
         """Kick off the (blocking, collective) distributed bring-up in
         the background; the daemon serves TCP consensus immediately and
-        the driver engages once ``ready``."""
-        t = threading.Thread(target=self._build, daemon=True,
-                             name=f"apus-mesh-build-{self.idx}")
-        t.start()
+        the driver engages once ``ready``.  A DETACHED start (restarted
+        incarnation) builds nothing: the old incarnation's epoch cannot
+        be re-joined, so this slot waits for the leader's reformer to
+        assign the next one."""
+        if self._detached_start:
+            with self.lock:
+                self.dead = True
+                self.death_reason = ("restarted incarnation: awaiting "
+                                     "re-formation (next epoch >= "
+                                     f"{self.min_epoch})")
+            if self.logger is not None:
+                self.logger.info("mesh plane detached: %s",
+                                 self.death_reason)
+            return
+        err = self.request_reform(self.min_epoch,
+                                  list(range(self.spec.mesh_n)),
+                                  svc_addr=None, term=0)
+        if err is not None:
+            self._die(f"initial mesh build refused: {err}")
 
     def stop(self) -> None:
         self._stop.set()
@@ -378,26 +653,107 @@ class MeshCommitRunner:
     def max_data_bytes(self) -> int:
         return self.slot_bytes - self.WIRE_OVERHEAD
 
-    # -- build (background thread; rendezvous with every process) ---------
+    # -- driver surface: geometry/coverage --------------------------------
 
-    def _build(self) -> None:
+    def covers_replica(self, slot: int) -> bool:
+        """Whether ``slot``'s shard exists in the CURRENT clique (drain
+        and election-absorb paths; a dead plane keeps covering so its
+        landed rows stay drainable)."""
+        return slot in self.members
+
+    def quorum_coverable(self, cid) -> bool:
+        """Whether the CURRENT clique can reach quorum for ``cid`` (see
+        quorum_coverable_for)."""
+        return self.quorum_coverable_for(self.members, cid)
+
+    def quorum_coverable_for(self, clique: list[int], cid) -> bool:
+        """Whether ``clique`` can own commit for ``cid``: the leader
+        must be a clique member (it stages locally) and the clique must
+        contain a majority of each active configuration.  Members
+        outside the clique still receive entries over the TCP plane
+        (the reference replicates to live RC peers the same way)."""
+        from apus_tpu.core.cid import CidState
+        if self.idx not in clique:
+            return False
+        old = sum(1 for s in clique if cid.contains(s) and s < cid.size)
+        if old < quorum_size(cid.size):
+            return False
+        if cid.state == CidState.TRANSIT:
+            new = sum(1 for s in clique
+                      if cid.contains(s) and s < cid.new_size)
+            if new < quorum_size(cid.new_size):
+                return False
+        return True
+
+    # -- re-formation -----------------------------------------------------
+
+    def request_reform(self, epoch: int, members: list[int],
+                       svc_addr: Optional[str],
+                       term: int) -> Optional[str]:
+        """Begin (re)building this process's plane membership for
+        ``epoch`` with clique ``members`` (sorted slots).  Returns None
+        on acceptance (build proceeds in the background) or a refusal
+        reason.  Idempotent for the epoch already being built."""
+        del term                        # authenticated by epoch ordering
+        members = sorted(members)
+        with self.lock:
+            if self._stop.is_set():
+                return "stopped"
+            if self.building:
+                return (None if epoch == self._build_target
+                        else f"building epoch {self._build_target}")
+            if epoch < self.min_epoch:
+                return (f"epoch {epoch} < min {self.min_epoch} "
+                        f"(incarnation rule)")
+            if epoch <= self.epoch:
+                return f"epoch {epoch} <= current {self.epoch}"
+            if self.idx not in members:
+                return f"slot {self.idx} not in clique {members}"
+            self.building = True
+            self._build_target = epoch
+        threading.Thread(
+            target=self._build_epoch, args=(epoch, members, svc_addr),
+            daemon=True, name=f"apus-mesh-build-{self.idx}-e{epoch}"
+        ).start()
+        return None
+
+    def _build_epoch(self, epoch: int, members: list[int],
+                     svc_addr: Optional[str]) -> None:
         try:
-            import jax
+            if svc_addr is None:
+                # Epoch-0 bring-up races the coordinator's own launch.
+                svc_addr = prepare_epoch(self.spec.mesh_coordinator,
+                                         epoch, len(members),
+                                         retry_for=30.0)
+            self._pre_reform_grace(epoch)
+            if self.on_epoch_join is not None:
+                self.on_epoch_join(epoch)
+            self._teardown_jax()
 
-            init_distributed(self.spec.mesh_coordinator, self.n_replicas,
-                             self.idx, platform=self.spec.mesh_platform)
+            import jax
+            # Rendezvous budget well under mesh_build_timeout: members
+            # are told simultaneously, so a healthy clique connects in
+            # seconds — a long hang means the fan-out partially failed
+            # and the epoch is burned; failing FAST frees this member
+            # for the next attempt (compile time is paid after
+            # connect and is not under this budget).
+            init_distributed(
+                svc_addr, len(members), members.index(self.idx),
+                platform=self.spec.mesh_platform,
+                init_timeout=max(15,
+                                 int(self.spec.mesh_build_timeout) // 6))
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from apus_tpu.ops.commit import build_pipelined_commit_step
             from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
 
+            R = len(members)
             devices = jax.devices()
-            if len(devices) < self.n_replicas:
+            if len(devices) < R:
                 raise RuntimeError(
-                    f"mesh plane needs {self.n_replicas} global devices, "
+                    f"mesh plane needs {R} global devices, "
                     f"have {len(devices)}")
-            self._mesh = replica_mesh(self.n_replicas,
-                                      devices=devices[:self.n_replicas])
+            self._mesh = replica_mesh(R, devices=devices[:R])
             # Shard r must live on process r: the local-shard read path
             # and the leader's local staging both assume it.
             for r, d in enumerate(self._mesh.devices.flat):
@@ -408,6 +764,10 @@ class MeshCommitRunner:
             self._sharding = NamedSharding(self._mesh, P(REPLICA_AXIS))
             self._staged_sharding = NamedSharding(self._mesh,
                                                   P(None, REPLICA_AXIS))
+            #: geometry of the arrays being built (self.members still
+            #: holds the OLD clique until the swap below) — the array
+            #: constructors key on this, never on members.
+            self._R = R
             K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
             # donate=False is LIVENESS here, not a perf choice: shard
             # readers (follower drain, pre-vote drain) materialize
@@ -415,35 +775,125 @@ class MeshCommitRunner:
             # they must either race a deleted buffer or hold self.lock
             # across an unbounded device sync — which would also wedge
             # _die/quiesce/_do_round (daemon lock) behind a stuck
-            # collective, defeating the WAIT_BUDGET_S degrade path.
+            # collective, defeating the degrade path.
             # Cost: one extra ring resident transiently per process.
             self._pipe = build_pipelined_commit_step(
-                self._mesh, self.n_replicas, self.n_slots, SB, B,
+                self._mesh, R, self.n_slots, SB, B,
                 depth=K, staged_depth=K, verify_round=True,
                 donate=False)
             self._jax = jax
             self._np_staged_zero = np.zeros((K, 1, B, SB), np.uint8)
             self._np_meta_zero = np.zeros((K, 1, B, 4), np.int32)
-            self._warmup()
-            threading.Thread(target=self._worker_loop, daemon=True,
-                             name=f"apus-mesh-worker-{self.idx}").start()
-            self.ready = True
+            self._warmup(R)
+            q: "queue.Queue" = queue.Queue()
+            with self.lock:
+                self.members = members
+                self.n_replicas = R
+                self._row = members.index(self.idx)
+                self.epoch = epoch
+                self.min_epoch = epoch + 1
+                self.generation = 0
+                self._worker_gen = 0
+                self._term = 0
+                self._leader = None
+                self._next_end0 = None
+                self._seq = 0
+                self._expect_seq = 0
+                self._devlog = None
+                self._outstanding = []
+                self._quiesce_since = None
+                self._q = q
+                self.stats["reforms"] += 1
+                self.dead = False
+                self.death_reason = None
+                self.building = False
+                self.ready = True
+            threading.Thread(
+                target=self._worker_loop, args=(q, epoch), daemon=True,
+                name=f"apus-mesh-worker-{self.idx}-e{epoch}").start()
             if self.logger is not None:
                 self.logger.info(
-                    "mesh plane ready: %d processes, window=%dx%d, "
-                    "ring=%d slots", self.n_replicas, K, B, self.n_slots)
+                    "mesh plane ready: epoch=%d clique=%s row=%d "
+                    "window=%dx%d ring=%d slots", epoch, members,
+                    members.index(self.idx), K, B, self.n_slots)
         except Exception as e:                        # noqa: BLE001
-            self._die(f"mesh build failed: {e!r}")
+            with self.lock:
+                self.building = False
+                self.min_epoch = max(self.min_epoch, epoch + 1)
+            # Log unconditionally (an already-dead plane makes _die a
+            # no-op, which would swallow the reason).
+            if self.logger is not None:
+                self.logger.exception("mesh build epoch %d failed", epoch)
+            self._die(f"mesh build epoch {epoch} failed: {e!r}")
 
-    def _warmup(self) -> None:
+    def _pre_reform_grace(self, epoch: int) -> None:
+        """Retire a live plane before teardown: mark it dead (stops
+        dispatches, keeps shards readable) and give the driver's drain
+        a short grace to absorb landed rows — committed entries are
+        safe regardless (they reached the leader's host log before
+        dispatch and replicate over TCP); this grace narrows the
+        accepted ≤-one-window loss of UNcommitted shard tails (the
+        slice-loss failure domain, see _die)."""
+        was_alive = False
+        with self.lock:
+            if not self.dead and self.ready:
+                was_alive = True
+        if was_alive:
+            self._die(f"superseded by re-formation epoch {epoch}")
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if not self._own_drain_pending():
+                return
+            time.sleep(0.05)
+
+    def _own_drain_pending(self) -> bool:
+        """Best-effort: does our shard hold rows beyond the host log's
+        end (i.e. the driver's drain hasn't caught up)?"""
+        from apus_tpu.ops.logplane import OFF_END
+        daemon = self._daemon
+        with self.lock:
+            devlog = self._devlog
+        if devlog is None or daemon is None:
+            return False
+        try:
+            row = np.asarray(devlog.offs.addressable_shards[0].data)
+            shard_end = int(row[0, OFF_END])
+        except Exception:                             # noqa: BLE001
+            return False
+        with daemon.lock:
+            return shard_end > daemon.node.log.end
+
+    def _teardown_jax(self) -> None:
+        """Detach from the old epoch: orphan the old worker + queue +
+        feeds, drop array/executable refs, tear down the distributed
+        client + backend (teardown_distributed).  Non-blocking even
+        with a stuck collective (module docstring)."""
+        with self.lock:
+            self._devlog = None
+            old_q = self._q
+            self._q = queue.Queue()     # never consumed: parks new items
+            feeds = list(self._feeds.values())
+            self._feeds.clear()
+            self._outstanding = []
+            self._pipe = None
+            self._mesh = None
+            self._sharding = None
+            self._staged_sharding = None
+        old_q.put(None)
+        for f in feeds:
+            f.close()
+        # First build: nothing to tear down (client is None; the call
+        # is a no-op beyond cache clearing).
+        teardown_distributed()
+
+    def _warmup(self, R: int) -> None:
         """All processes run the identical warmup (fresh arrays + one
         window) — the first cross-process rendezvous, paying compile
         before any leadership depends on it."""
-        devlog = self._fresh_devlog(first_idx=1, leader=0, term=0)
+        devlog = self._fresh_devlog(first_idx=1, leader_row=0, term=0)
         sdata, smeta = self._stage_local(None)
-        ctrl = self._ctrl(0, 0, 1, [1] * self.n_replicas,
-                          [0] * self.n_replicas,
-                          quorum_size(self.n_replicas), 0)
+        ctrl = self._ctrl(0, 0, 1, [1] * R, [0] * R,
+                          quorum_size(R), 0)
         devlog, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
         np.asarray(commits)             # block: every process arrived
         # Warm the local-shard read path too (first .addressable_shards
@@ -451,12 +901,12 @@ class MeshCommitRunner:
         np.asarray(devlog.offs.addressable_shards[0].data)
         del devlog
 
-    def _fresh_devlog(self, first_idx: int, leader: int, term: int):
+    def _fresh_devlog(self, first_idx: int, leader_row: int, term: int):
         from apus_tpu.ops.logplane import make_device_log
         return make_device_log(
-            self.n_replicas, self.n_slots, self.slot_bytes,
-            batch=self.batch, first_idx=first_idx, leader=leader,
-            term=term, sharding=self._sharding)
+            self._R, self.n_slots,
+            self.slot_bytes, batch=self.batch, first_idx=first_idx,
+            leader=leader_row, term=term, sharding=self._sharding)
 
     def _stage_local(self, encoded):
         """Build the global staged arrays from THIS process's local
@@ -465,7 +915,7 @@ class MeshCommitRunner:
         the in-step pmax moves the payload."""
         jax = self._jax
         K, B, SB = self.FIXED_WINDOW, self.batch, self.slot_bytes
-        R = self.n_replicas
+        R = self._R
         if encoded is None:
             ld, lm = self._np_staged_zero, self._np_meta_zero
         else:
@@ -477,13 +927,14 @@ class MeshCommitRunner:
             self._staged_sharding, lm, (K, R, B, 4))
         return data, meta
 
-    def _ctrl(self, leader, term, end0, mask_old, mask_new, q_old, q_new):
+    def _ctrl(self, leader_row, term, end0, mask_old, mask_new,
+              q_old, q_new):
         import jax.numpy as jnp
 
         from apus_tpu.ops.commit import CommitControl
         i32 = lambda v: jnp.asarray(v, jnp.int32)     # noqa: E731
         return CommitControl(
-            i32(leader), i32(term), i32(end0),
+            i32(leader_row), i32(term), i32(end0),
             jnp.asarray(np.array(mask_old, np.int32)),
             jnp.asarray(np.array(mask_new, np.int32)),
             i32(q_old), i32(q_new))
@@ -497,10 +948,11 @@ class MeshCommitRunner:
         nowhere else yet when the mesh carries the entry transport).
         Reads stay local (no collective), so a live process can always
         attempt them; if the LAST window errored mid-execution its
-        donated buffers are poisoned and the read itself fails — that
-        residual (≤ one window of undrained rows lost with the plane)
-        is the device plane's shared failure domain, exactly as a TPU
-        slice loss takes in-flight HBM state with it."""
+        buffers are poisoned and the read itself fails — that residual
+        (≤ one window of undrained rows lost with the plane) is the
+        device plane's shared failure domain, exactly as a TPU slice
+        loss takes in-flight HBM state with it.  No longer permanent:
+        the reformer rebuilds under the next epoch."""
         with self.lock:
             if self.dead:
                 return
@@ -508,8 +960,8 @@ class MeshCommitRunner:
             self.death_reason = reason
             self._outstanding.clear()
         if self.logger is not None:
-            self.logger.error("mesh plane DEAD: %s (TCP plane continues)",
-                              reason)
+            self.logger.error("mesh plane DEAD: %s (TCP plane continues; "
+                              "re-formation will follow)", reason)
         for f in self._feeds.values():
             f.close()
         # Fail every caller still parked on a queued round's result —
@@ -525,15 +977,29 @@ class MeshCommitRunner:
     def _feed_dead(self, addr, exc) -> None:
         self._die(f"descriptor feed to {addr} failed: {exc!r}")
 
+    def _die_if_epoch(self, epoch: int, reason: str) -> None:
+        """_die, but only when ``epoch`` is still the live one — a
+        STALE worker/handle erroring after a re-formation swapped a
+        fresh plane in must not kill the fresh plane."""
+        with self.lock:
+            if self.epoch != epoch or self.building:
+                return
+        self._die(reason)
+
     # -- the single dispatch authority ------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, q: "queue.Queue", epoch: int) -> None:
         """The ONLY thread that dispatches device programs in this
         process — the global program order is the descriptor order,
-        identical on every process by construction (rule 2/3)."""
+        identical on every process by construction (rule 2/3).  One
+        worker per epoch: a worker whose queue was orphaned by a
+        reform exits; one stuck inside a wedged collective is simply
+        abandoned (it holds no locks across the dispatch).  Its death
+        throes are epoch-guarded so they can never kill a successor
+        plane."""
         while not self._stop.is_set():
-            item = self._q.get()
-            if item is None:
+            item = q.get()
+            if item is None or self._q is not q:
                 return
             try:
                 if item[0] == "reset":
@@ -541,23 +1007,31 @@ class MeshCommitRunner:
                 else:
                     self._do_round(*item[1:])
             except Exception as e:                    # noqa: BLE001
-                self._die(f"worker dispatch failed: {e!r}")
+                self._die_if_epoch(epoch, f"worker dispatch failed: {e!r}")
                 if item[0] == "round" and item[3] is not None:
                     item[3].put(None)
                 return
 
-    def _do_reset(self, gen: int, leader: int, term: int,
+    def _do_reset(self, epoch: int, gen: int, leader_slot: int, term: int,
                   first_idx: int) -> None:
         with self.lock:
+            if epoch != self.epoch:
+                return                  # cross-epoch: defunct stream
             if term < self._term or gen <= self._worker_gen:
                 return                  # stale leadership's reset
-        devlog = self._fresh_devlog(first_idx, leader, term)
+            try:
+                leader_row = self.members.index(leader_slot)
+            except ValueError:
+                return                  # leader outside our clique
+        devlog = self._fresh_devlog(first_idx, leader_row, term)
         with self.lock:
+            if epoch != self.epoch:
+                return
             self._devlog = devlog
             self._worker_gen = gen
             self.generation = max(self.generation, gen)
-            self._leader, self._term = leader, term
-            if self.idx != leader:
+            self._leader, self._term = leader_slot, term
+            if self.idx != leader_slot:
                 # Leader-side _next_end0 was set synchronously in
                 # reset() and may already have advanced past first_idx
                 # by the time this queue item runs — never clobber it.
@@ -565,21 +1039,32 @@ class MeshCommitRunner:
             self._expect_seq = 0
             self.stats["resets"] += 1
         if self.logger is not None:
-            self.logger.info("mesh plane reset: gen=%d leader=%d term=%d "
-                             "base=%d", gen, leader, term, first_idx)
+            self.logger.info("mesh plane reset: epoch=%d gen=%d leader=%d "
+                             "term=%d base=%d", epoch, gen, leader_slot,
+                             term, first_idx)
 
     def _do_round(self, desc: _RoundDesc, encoded, result_q) -> None:
         """Dispatch one window.  ``encoded`` is the leader's staged
         window or None (follower).  ``result_q`` (leader only) receives
         the window handle.  ALWAYS dispatches (rule 3) unless the
-        plane is dead."""
+        plane is dead or the descriptor is cross-epoch.
+
+        Lock protocol (election safety, module docstring): poisoning
+        decision + handle registration happen UNDER the daemon lock;
+        the dispatch itself runs OUTSIDE it — it can block for minutes
+        inside a wedged collective, and holding the daemon lock there
+        would wedge the tick thread (no ticking, no voting, the whole
+        replica down with the plane).  The pre-registered handle keeps
+        the vote-veto invariant instead."""
         sdata, smeta = self._stage_local(encoded)
         daemon = self._daemon
-        lock = daemon.lock if daemon is not None else threading.RLock()
-        with lock:
+        dlock = daemon.lock if daemon is not None else threading.RLock()
+        with dlock:
             with self.lock:
-                if self._devlog is None:
-                    raise RuntimeError("round before any reset/warmup")
+                if desc.epoch != self.epoch or self._devlog is None:
+                    if result_q is not None:
+                        result_q.put(None)
+                    return
                 poisoned = desc.gen != self._worker_gen
                 if not poisoned and desc.seq != self._expect_seq:
                     # A gap in the CURRENT generation's stream means a
@@ -604,81 +1089,84 @@ class MeshCommitRunner:
                 ctrl = self._ctrl(desc.leader, desc.term, desc.end0,
                                   desc.mask_old, desc.mask_new,
                                   desc.q_old, desc.q_new)
-            import time as _time
-            _t0 = _time.monotonic()
-            # The pipe does NOT donate (see _build), so the previous
-            # devlog's buffers stay valid after dispatch: a shard
-            # reader that grabbed self._devlog concurrently reads
-            # stale-but-valid data, never a deleted buffer.  (The
-            # donating variant killed follower planes under sustained
-            # traffic — the drain's shard_end raced one dispatch per
-            # ~2k ops and materialized a deleted array; and holding
-            # self.lock across dispatch+materialize instead would
-            # park _die/quiesce/_do_round behind a stuck collective.)
+            h = MeshWindowHandle(desc.epoch, desc.gen, desc.end0,
+                                 self.FIXED_WINDOW, commits=None,
+                                 poisoned=poisoned)
             with self.lock:
-                devlog = self._devlog
-            new_devlog, commits, _ = self._pipe(devlog, sdata,
-                                                smeta, ctrl)
-            with self.lock:
-                self._devlog = new_devlog
-            _ms = (_time.monotonic() - _t0) * 1e3
-            self.stats["max_dispatch_ms"] = max(
-                self.stats.get("max_dispatch_ms", 0.0), _ms)
-            if _ms > 50.0 and self.logger is not None:
-                self.logger.warning("mesh dispatch blocked %.0f ms "
-                                    "(seq=%d, daemon lock held)",
-                                    _ms, desc.seq)
-            with self.lock:
-                K = self.FIXED_WINDOW
-                if poisoned:
-                    self.stats["poisoned_rounds"] += 1
-                else:
-                    self.stats["rounds"] += K
-                    self.stats["entries_devplane"] += K * self.batch
-                    self.stats["pipelined_dispatches"] += 1
-                    self.depth_histogram[K] = \
-                        self.depth_histogram.get(K, 0) + 1
-                h = MeshWindowHandle(desc.gen, desc.end0,
-                                     self.FIXED_WINDOW, commits,
-                                     poisoned=poisoned)
                 self._outstanding.append(h)
+        # -- dispatch, DAEMON LOCK RELEASED --
+        t0 = time.monotonic()
+        # The pipe does NOT donate (see _build_epoch), so the previous
+        # devlog's buffers stay valid after dispatch: a shard reader
+        # that grabbed self._devlog concurrently reads stale-but-valid
+        # data, never a deleted buffer.  (The donating variant killed
+        # follower planes under sustained traffic — the drain's
+        # shard_end raced one dispatch per ~2k ops and materialized a
+        # deleted array; and holding self.lock across
+        # dispatch+materialize instead would park _die/quiesce behind
+        # a stuck collective.)
+        with self.lock:
+            devlog = self._devlog
+        new_devlog, commits, _ = self._pipe(devlog, sdata, smeta, ctrl)
+        h.commits = commits
+        with self.lock:
+            if desc.epoch == self.epoch:
+                self._devlog = new_devlog
+        ms = (time.monotonic() - t0) * 1e3
+        self.stats["max_dispatch_ms"] = max(
+            self.stats.get("max_dispatch_ms", 0.0), ms)
+        with self.lock:
+            K = self.FIXED_WINDOW
+            if poisoned:
+                self.stats["poisoned_rounds"] += 1
+            else:
+                self.stats["rounds"] += K
+                self.stats["entries_devplane"] += K * self.batch
+                self.stats["pipelined_dispatches"] += 1
+                self.depth_histogram[K] = \
+                    self.depth_histogram.get(K, 0) + 1
         if result_q is not None:
             result_q.put(h)
         # Follower pacing: bound the dispatched-unresolved pipeline so a
         # backend failure surfaces promptly here (deactivating the
-        # plane) instead of silently poisoning the donated-array chain.
+        # plane) instead of silently extending the unresolved chain.
         self._prune_outstanding(limit=4)
 
     #: How long any blocking wait on a window may take before the plane
     #: is declared dead.  The backend gives NO deadline of its own: a
     #: collective missing one participant blocks until that process
-    #: EXITS (probed empirically — 400 s with both ends alive), so
+    #: EXITS or gloo times out (probed empirically — up to ~300 s), so
     #: every wait polls is_ready() against this budget instead of
     #: parking forever.  Normal windows complete in milliseconds; the
     #: budget only trips when a descriptor was lost or a peer wedged,
-    #: both of which already mean the plane must degrade to TCP.  Sized
-    #: WELL above worst-case scheduling stalls on an oversubscribed
-    #: box (a saturated 1-core host showed 10 s was trippable by CPU
-    #: starvation alone, killing healthy planes).
+    #: both of which already mean the plane must degrade (and later
+    #: re-form).  Sized WELL above worst-case scheduling stalls on an
+    #: oversubscribed box (a saturated 1-core host showed 10 s was
+    #: trippable by CPU starvation alone, killing healthy planes).
     WAIT_BUDGET_S = 45.0
 
     def _wait_window(self, h: "MeshWindowHandle", what: str):
         """Readiness-polled wait; returns the commits ndarray or None
-        after killing the plane (timeout or collective error)."""
-        import time as _time
-        deadline = _time.monotonic() + self.WAIT_BUDGET_S
+        after killing the plane (timeout or collective error).
+        ``h.commits`` may still be None for a handle registered but not
+        yet dispatched (worker between registration and dispatch) —
+        counted as not-ready."""
+        deadline = time.monotonic() + self.WAIT_BUDGET_S
         try:
-            while not h.commits.is_ready():
-                if _time.monotonic() > deadline:
-                    self._die(f"{what}: window never completed "
-                              f"(missing participant?)")
+            while h.commits is None or not h.commits.is_ready():
+                if time.monotonic() > deadline:
+                    self._die_if_epoch(
+                        h.epoch, f"{what}: window never completed "
+                        f"(missing participant?)")
                     return None
                 if self._stop.is_set():
                     return None
-                _time.sleep(0.0005)
+                if h.epoch != self.epoch:
+                    return None         # superseded by a re-formation
+                time.sleep(0.0005)
             return np.asarray(h.commits)
         except Exception as e:                        # noqa: BLE001
-            self._die(f"{what} failed: {e!r}")
+            self._die_if_epoch(h.epoch, f"{what} failed: {e!r}")
             return None
 
     def _prune_outstanding(self, limit: int) -> None:
@@ -702,28 +1190,49 @@ class MeshCommitRunner:
 
         Returns False — VOTE VETO — while windows are still executing:
         the election layer defers a tick instead of blocking, so the
-        daemon keeps ticking/serving while e.g. a dead leader's
-        half-dispatched collective takes seconds to error out.  A
-        window that stays unready past WAIT_BUDGET_S kills the plane
-        (the backend itself never times out; probed empirically)."""
-        import time as _time
+        daemon keeps ticking/serving.  The veto is BOUNDED by
+        ``spec.mesh_election_budget``: past it the plane is POISONED
+        (declared dead — immediate revocation, QP-reset analog,
+        dare_ibv_rc.c:2156-2189) and the vote proceeds; re-formation
+        restores the plane once the new leadership stabilizes.
+
+        Why the bounded poison is safe: every round is an allreduce
+        over ALL clique ranks, so a window whose program has not fed
+        our rank's final-round contribution CANNOT complete on any
+        rank — no commit can be minted from it, and voting past it
+        loses nothing (the common case: our rank starved, or the
+        leader's rank died mid-exchange).  The residual exposure is
+        the post-contribution EPILOGUE sliver: our rank already fed
+        the final reduce (so the leader may resolve and adopt) but our
+        local output had not finalized when the budget expired —
+        microseconds of device work, stretchable only by a scheduler
+        preemption that freezes the backend threadpool while this
+        Python thread keeps running.  The budget is sized to dominate
+        that sliver with margin (config.py mesh_election_budget); the
+        reference closes the same race PHYSICALLY by resetting QPs
+        before voting (poll_vote_requests revokes log access,
+        dare_server.c:1591-1652), which a dispatched collective has no
+        analog for (SURVEY §7 hard parts)."""
         if self.dead:
             return True
+        budget = getattr(self.spec, "mesh_election_budget", 0.10)
         with self.lock:
             outstanding = list(self._outstanding)
         for h in outstanding:
             try:
-                ready = h.commits.is_ready()
+                ready = (h.commits is not None and h.commits.is_ready())
             except Exception as e:                    # noqa: BLE001
                 self._die(f"quiesce: window failed: {e!r}")
                 return True
             if not ready:
-                now = _time.monotonic()
+                now = time.monotonic()
                 if self._quiesce_since is None:
                     self._quiesce_since = now
-                elif now - self._quiesce_since > self.WAIT_BUDGET_S:
-                    self._die("quiesce: window never completed "
-                              "(missing participant?)")
+                elif now - self._quiesce_since > budget:
+                    self._die("election pending past the "
+                              f"{budget * 1e3:.0f} ms veto budget with "
+                              "unresolved windows: plane poisoned "
+                              "(re-formation will follow)")
                     return True
                 return False
         self._quiesce_since = None
@@ -743,41 +1252,43 @@ class MeshCommitRunner:
             return None
         assert leader == self.idx, (leader, self.idx)
         with self.lock:
-            if term < self._term:
+            if term < self._term or self.idx not in self.members:
                 return None
+            epoch = self.epoch
             gen = self.generation + 1
             self.generation = gen
             self._term = term
             self._leader = leader
             self._next_end0 = first_idx
             self._seq = 0
-        payload = (wire.u8(OP_MESH) + wire.u8(_SUB_RESET) + wire.u64(gen)
+        payload = (wire.u8(OP_MESH) + wire.u8(_SUB_RESET)
+                   + wire.u64(epoch) + wire.u64(gen)
                    + wire.u8(leader) + wire.u64(term)
                    + wire.u64(first_idx))
         self._broadcast(payload)
-        self._q.put(("reset", gen, leader, term, first_idx))
+        self._q.put(("reset", epoch, gen, leader, term, first_idx))
         if self.dead:
             return None
         return gen
 
     def _broadcast(self, payload: bytes) -> None:
-        for r in range(self.n_replicas):
-            if r == self.idx:
+        for s in self.members:
+            if s == self.idx:
                 continue
-            feed = self._feeds.get(r)
+            feed = self._feeds.get(s)
             if feed is None or feed.dead:
-                addr = self._peer_addr(r)
+                addr = self._peer_addr(s)
                 if addr is None:
-                    self._die(f"no control endpoint for mesh peer {r}")
+                    self._die(f"no control endpoint for mesh peer {s}")
                     return
-                feed = self._feeds[r] = _PeerFeed(addr, self._feed_dead)
+                feed = self._feeds[s] = _PeerFeed(addr, self._feed_dead)
             feed.send(payload)
 
-    def _peer_addr(self, r: int) -> Optional[tuple]:
+    def _peer_addr(self, s: int) -> Optional[tuple]:
         peers = self.spec.peers
-        if r >= len(peers) or not peers[r]:
+        if s >= len(peers) or not peers[s]:
             return None
-        host, port = peers[r].rsplit(":", 1)
+        host, port = peers[s].rsplit(":", 1)
         return host, int(port)
 
     def commit_rounds_async(self, gen: int, end0: int,
@@ -795,6 +1306,9 @@ class MeshCommitRunner:
                 return None
             if end0 != self._next_end0:
                 return None
+            epoch = self.epoch
+            members = self.members
+            row = self._row
             term = self._term
             seq = self._seq
             self._seq += 1
@@ -805,27 +1319,30 @@ class MeshCommitRunner:
             self._encode_batch(entries[k * B:(k + 1) * B], end0 + k * B,
                                bd[k], bm[k])
         from apus_tpu.core.cid import CidState
-        R = self.n_replicas
-        mask_old = [1 if (cid.contains(i) and i < cid.size) else 0
-                    for i in range(R)]
+        # Masks in ROW space over the clique (slot -> row translation;
+        # quorum thresholds stay full-configuration sizes — masking
+        # shrinks only the numerator, VERDICT-safe coverage is gated by
+        # quorum_coverable upstream).
+        mask_old = [1 if (cid.contains(s) and s < cid.size) else 0
+                    for s in members]
         if cid.state == CidState.TRANSIT:
-            mask_new = [1 if (cid.contains(i) and i < cid.new_size) else 0
-                        for i in range(R)]
+            mask_new = [1 if (cid.contains(s) and s < cid.new_size) else 0
+                        for s in members]
             q_new = quorum_size(cid.new_size)
         else:
-            mask_new, q_new = [0] * R, 0
-        desc = _RoundDesc(gen, seq, self.idx, term, end0, mask_old,
+            mask_new, q_new = [0] * len(members), 0
+        desc = _RoundDesc(epoch, gen, seq, row, term, end0, mask_old,
                           mask_new, quorum_size(cid.size), q_new)
         self._broadcast(desc.encode())
         if self.dead:
             return None
         result_q: "queue.Queue" = queue.Queue(maxsize=1)
         self._q.put(("round", desc, (bd, bm), result_q))
-        # Blocks only for the worker's ENQUEUE of the program (it
-        # dispatches promptly), not for execution.  Dead-aware wait: if
-        # the worker died on an EARLIER queue item, our item may never
-        # be serviced (the _die drain and this poll race; either way
-        # the caller must not park forever).
+        # Blocks only for the worker's handling of the program (it
+        # registers + dispatches promptly), not for execution.
+        # Dead-aware wait: if the worker died on an EARLIER queue item,
+        # our item may never be serviced (the _die drain and this poll
+        # race; either way the caller must not park forever).
         while True:
             try:
                 h = result_q.get(timeout=0.5)
@@ -867,7 +1384,8 @@ class MeshCommitRunner:
         with self.lock:
             if self._outstanding and h in self._outstanding:
                 self._outstanding.remove(h)
-            if h.gen != self.generation or h.poisoned:
+            if h.epoch != self.epoch or h.gen != self.generation \
+                    or h.poisoned:
                 return None
             self.stats["quorum_fail_rounds"] += int(sum(
                 int(commits_host[k]) < h.end0 + (k + 1) * B
@@ -878,31 +1396,58 @@ class MeshCommitRunner:
 
     def on_descriptor(self, r: wire.Reader) -> bytes:
         """Runs on a PeerServer connection thread (no node lock)."""
-        if not self.ready and not self.dead:
-            # Descriptors can only flow once every process passed the
-            # warmup RENDEZVOUS — so "not ready" here means our build
-            # thread is in its last milliseconds of bookkeeping while a
-            # faster peer's already dispatched.  Wait it out briefly (a
-            # nack would kill the whole plane over a thread-scheduling
-            # race); a build that really failed flips ``dead``.
-            import time as _time
-            deadline = _time.monotonic() + 30.0
-            while not self.ready and not self.dead \
-                    and _time.monotonic() < deadline:
-                _time.sleep(0.005)
-        if self.dead or not self.ready:
-            return wire.u8(wire.ST_ERROR)
         sub = r.u8()
+        if sub == _SUB_REFORM:
+            epoch = r.u64()
+            term = r.u64()
+            members = list(r.blob())
+            svc_addr = r.blob().decode()
+            err = self.request_reform(epoch, members, svc_addr, term)
+            if err is not None:
+                if self.logger is not None:
+                    self.logger.warning("REFORM epoch %d refused: %s",
+                                        epoch, err)
+                return wire.u8(wire.ST_ERROR) + wire.blob(err.encode())
+            return wire.u8(wire.ST_OK)
         if sub == _SUB_RESET:
+            epoch = r.u64()
             gen = r.u64()
             leader, term, first_idx = r.u8(), r.u64(), r.u64()
-            self._q.put(("reset", gen, leader, term, first_idx))
-            return wire.u8(wire.ST_OK)
-        if sub == _SUB_ROUND:
+        elif sub == _SUB_ROUND:
             desc = _RoundDesc.decode(r)
+            epoch = desc.epoch
+        else:
+            return wire.u8(wire.ST_ERROR)
+        if not self._await_epoch(epoch):
+            # Cross-epoch or dead: NACK — the sender's feed dies, its
+            # plane degrades, re-formation reconciles (module
+            # docstring rule 3, across-epochs case).
+            return wire.u8(wire.ST_ERROR)
+        if sub == _SUB_RESET:
+            self._q.put(("reset", epoch, gen, leader, term, first_idx))
+        else:
             self._q.put(("round", desc, None, None))
-            return wire.u8(wire.ST_OK)
-        return wire.u8(wire.ST_ERROR)
+        return wire.u8(wire.ST_OK)
+
+    def _await_epoch(self, epoch: int) -> bool:
+        """Descriptors can only flow once every process passed the
+        warmup RENDEZVOUS — so a descriptor for an epoch we haven't
+        finished building means our build thread is in its last
+        moments of bookkeeping while a faster peer's already
+        dispatched.  Wait it out briefly (a nack would kill the whole
+        plane over a thread-scheduling race); a build that really
+        failed flips ``dead``/bumps min_epoch."""
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self.lock:
+                if self.ready and not self.dead and self.epoch == epoch:
+                    return True
+                if self.epoch > epoch or epoch < self.min_epoch:
+                    return False        # stale stream: NACK now
+                if not self.building and (self.dead or not self.ready):
+                    return False
+            time.sleep(0.005)
+        return False
 
     # -- local shard readback ---------------------------------------------
 
@@ -923,9 +1468,9 @@ class MeshCommitRunner:
                 return None
             offs = self._devlog.offs
         # Materialize OUTSIDE the lock: the pipe does not donate (see
-        # _build), so this reference stays valid even if a new round
-        # dispatches+swaps concurrently; the sync here parks only THIS
-        # reader until the producing round completes.
+        # _build_epoch), so this reference stays valid even if a new
+        # round dispatches+swaps concurrently; the sync here parks only
+        # THIS reader until the producing round completes.
         try:
             row = np.asarray(self._local_shard(offs))
         except Exception as e:                        # noqa: BLE001
@@ -950,8 +1495,8 @@ class MeshCommitRunner:
             data_arr, meta_arr = self._devlog.data, self._devlog.meta
         # Bulk copy OUTSIDE the lock — non-donated buffers stay valid
         # (see shard_end); holding self.lock across a whole-shard
-        # device sync would serialize _do_round (which waits on it
-        # while holding the daemon lock) behind every drain.
+        # device sync would serialize _do_round (which waits on it)
+        # behind every drain.
         try:
             data = np.asarray(self._local_shard(data_arr))[0][slots]
             meta = np.asarray(self._local_shard(meta_arr))[0][slots]
@@ -974,16 +1519,229 @@ class MeshCommitRunner:
         return out
 
 
+# -- reformer ---------------------------------------------------------------
+
+
+def _send_reform(addr: str, payload: bytes,
+                 timeout: float = 5.0) -> Optional[str]:
+    """One-shot REFORM send to a peer's PeerServer.  Returns None on
+    ST_OK, else a reason string."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(wire.frame(payload))
+            resp = wire.read_frame(s)
+    except OSError as e:
+        return f"unreachable: {e}"
+    if resp is None:
+        return "hung up"
+    if resp[:1] != bytes([wire.ST_OK]):
+        try:
+            return wire.Reader(resp[1:]).blob().decode()
+        except Exception:                             # noqa: BLE001
+            return "refused"
+    return None
+
+
+class MeshReformer:
+    """Leader-side re-formation orchestrator (one thread per daemon,
+    active only while this daemon leads).
+
+    The reference analog: the leader re-establishes its RC data plane
+    to a returning server (RC_SYN/SYNACK/ACK re-handshake,
+    dare_ibv_ud.c:1098-1416; QPs re-granted dare_ibv_rc.c:2195-2255).
+    Here the whole clique re-rendezvouses under a fresh epoch, because
+    a gloo/ICI clique — like a TPU slice — is rebuilt as a unit.
+
+    Trigger: this daemon is leader, the target clique (live mesh-
+    capable members) could own quorum, the clique has been STABLE for
+    ``spec.mesh_reform_stable`` seconds, and the local plane is not
+    healthy-for-this-clique.  All clique members must be reachable and
+    not mid-build; the next epoch is one past the maximum epoch any of
+    them ever joined (incarnation rule).  The coordination service is
+    PREPAREd first, then REFORM fans out over the TCP control plane;
+    the build outcome is awaited (bounded by spec.mesh_build_timeout)
+    before another attempt — a failed attempt burns its epoch and
+    retries with the next."""
+
+    def __init__(self, daemon, runner: MeshCommitRunner, spec):
+        self.daemon = daemon
+        self.runner = runner
+        self.spec = spec
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stable_key = None
+        self._stable_since = 0.0
+        self.stats = {"reforms_started": 0, "reforms_ok": 0,
+                      "reforms_failed": 0}
+
+    def start(self) -> None:
+        if not getattr(self.spec, "mesh_reform", True):
+            return
+        t = threading.Thread(target=self._run, daemon=True,
+                             name=f"apus-mesh-reform-{self.daemon.idx}")
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._scan()
+            except Exception:                         # noqa: BLE001
+                if self.daemon.logger is not None:
+                    self.daemon.logger.exception("mesh reformer scan")
+            self._stop.wait(0.25)
+
+    def _target_clique(self) -> Optional[tuple[list[int], int]]:
+        """(clique, term) when this daemon leads and the clique could
+        own quorum; None otherwise."""
+        node = self.daemon.node
+        with self.daemon.lock:
+            if not node.is_leader:
+                return None
+            term = node.current_term
+            cid = node.cid
+            members = sorted(cid.members())
+        spec = self.spec
+        clique = [s for s in members
+                  if s < spec.mesh_n and s < len(spec.peers)
+                  and spec.peers[s]]
+        if self.daemon.idx not in clique:
+            return None
+        with self.daemon.lock:
+            if not self.runner.quorum_coverable_for(clique,
+                                                    self.daemon.node.cid):
+                return None
+        return clique, term
+
+    def _scan(self) -> None:
+        from apus_tpu.runtime.client import probe_status
+        runner = self.runner
+        tc = self._target_clique()
+        if tc is None:
+            self._stable_key = None
+            return
+        clique, term = tc
+        if runner.building:
+            return
+        healthy = (runner.ready and not runner.dead
+                   and runner.members == clique)
+        if healthy:
+            self._stable_key = None
+            return
+        # Stability window: the clique+term must hold unchanged for
+        # mesh_reform_stable before acting (no reforming mid-churn).
+        key = (term, tuple(clique))
+        now = time.monotonic()
+        if key != self._stable_key:
+            self._stable_key = key
+            self._stable_since = now
+            return
+        if now - self._stable_since < getattr(self.spec,
+                                              "mesh_reform_stable", 2.0):
+            return
+        # Collect member plane states: all reachable, none mid-build.
+        # A member that answers status but has NO device plane at all
+        # (--no-device-plane operator choice) is structurally TCP-only:
+        # drop it from the clique rather than blocking re-formation
+        # forever — but a probe FAILURE is a transient, retried later.
+        last_epochs = [runner.epoch]
+        tcp_only = []
+        for s in clique:
+            if s == self.daemon.idx:
+                continue
+            st = probe_status(self.spec.peers[s], timeout=1.0)
+            if st is None:
+                return
+            dp = st.get("devplane")
+            if dp is None:
+                tcp_only.append(s)
+                continue
+            if dp.get("building"):
+                return
+            ep = dp.get("epoch")
+            last_epochs.append(-1 if ep is None else ep)
+            # An epoch someone STARTED building (even if it failed or
+            # is in flight elsewhere) is burned for proposals too.
+            bt = dp.get("build_target")
+            if bt is not None:
+                last_epochs.append(bt)
+        if tcp_only:
+            clique = [s for s in clique if s not in tcp_only]
+            with self.daemon.lock:
+                coverable = runner.quorum_coverable_for(
+                    clique, self.daemon.node.cid)
+            if not coverable:
+                return
+        next_epoch = max(max(last_epochs), runner.min_epoch - 1) + 1
+        try:
+            svc = prepare_epoch(self.spec.mesh_coordinator, next_epoch,
+                                len(clique))
+        except Exception as e:                        # noqa: BLE001
+            self.daemon.logger.warning(
+                "mesh reform: coordinator PREPARE(%d) failed: %s",
+                next_epoch, e)
+            return
+        self.daemon.logger.info(
+            "mesh reform: epoch %d clique=%s svc=%s", next_epoch,
+            clique, svc)
+        self.stats["reforms_started"] += 1
+        payload = encode_reform(next_epoch, clique, svc, term)
+        local_err = None
+        for s in clique:
+            if s == self.daemon.idx:
+                err = local_err = runner.request_reform(
+                    next_epoch, clique, svc, term)
+            else:
+                err = _send_reform(self.spec.peers[s], payload)
+            if err is not None:
+                # The epoch is burned (some members may already be
+                # building it); their builds fail at init_timeout and
+                # the next scan retries with a fresh epoch.
+                self.daemon.logger.warning(
+                    "mesh reform: member %d refused epoch %d: %s",
+                    s, next_epoch, err)
+        if local_err is not None:
+            # Without a local build there is no outcome to await —
+            # re-evaluate on the next scan instead of idling here.
+            self.stats["reforms_failed"] += 1
+            self._stable_key = None
+            return
+        # Await OUR build outcome (bounded); member readiness is
+        # observable via status and gates the driver naturally.
+        deadline = now + getattr(self.spec, "mesh_build_timeout", 120.0)
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            if runner.ready and not runner.dead \
+                    and runner.epoch == next_epoch:
+                self.stats["reforms_ok"] += 1
+                self.daemon.logger.info(
+                    "mesh reform: epoch %d LIVE (clique %s)",
+                    next_epoch, clique)
+                return
+            if not runner.building and runner.min_epoch > next_epoch \
+                    and runner.epoch != next_epoch:
+                break                   # build failed; epoch burned
+            self._stop.wait(0.25)
+        self.stats["reforms_failed"] += 1
+        self._stable_key = None         # restart the stability window
+
+
 if __name__ == "__main__":
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="python -m apus_tpu.runtime.mesh_plane",
-        description="Host the mesh-plane coordination service "
+        description="Host the mesh-plane coordination control server "
                     "(one per cluster, outside every replica).")
     ap.add_argument("--serve-coordinator", required=True, metavar="ADDR",
-                    help="host:port to bind the coordination service on")
-    ap.add_argument("--n", type=int, required=True,
-                    help="number of mesh processes (replicas)")
+                    help="host:port to bind the control server on")
+    ap.add_argument("--n", type=int, required=False, default=0,
+                    help="advisory process count (sizes arrive per "
+                         "epoch in PREPARE)")
     a = ap.parse_args()
     serve_coordinator(a.serve_coordinator, a.n)

@@ -64,6 +64,29 @@ class ClusterSpec:
     mesh_slots: int = 0
     mesh_slot_bytes: int = 2048
     mesh_platform: str = "cpu"
+    # Mesh-plane RE-FORMATION (runtime.mesh_plane re-formation section):
+    # the leader rebuilds the device clique under a new plane epoch when
+    # membership re-stabilizes after a death/rejoin (the RC re-handshake
+    # analog, dare_ibv_ud.c:1098-1416).  mesh_reform_stable = how long
+    # the target clique must be stable (and the plane unhealthy) before
+    # the leader acts; mesh_build_timeout = per-epoch rendezvous+compile
+    # budget before the attempt is abandoned (epoch burned, retried).
+    mesh_reform: bool = True
+    mesh_reform_stable: float = 2.0
+    mesh_build_timeout: float = 120.0
+    # Bounded vote-veto (election-pending quiesce): while an election
+    # wants to proceed, an unresolved dispatched window may veto the
+    # vote for at most this long before the plane is POISONED (declared
+    # dead, degrading to TCP) and the vote proceeds — the immediate-
+    # revocation analog of QP reset (dare_ibv_rc.c:2156-2189).  Cheap
+    # now that re-formation restores a poisoned plane.  Sizing (see
+    # quiesce_ready's safety analysis): early poisoning is
+    # unconditionally safe while OUR rank hasn't fed the window's final
+    # reduce (the quorum cannot complete without it); the budget only
+    # needs to dominate the post-contribution EPILOGUE sliver
+    # (receive+finalize, microseconds of work) with a generous
+    # oversubscription margin — NOT whole-window execution.
+    mesh_election_budget: float = 0.35
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False

@@ -181,7 +181,12 @@ def run_proc_schedule(trial: int, seed_base: int,
     ride the device quorum before injecting any fault.  Kills then
     degrade the plane to TCP (the ICI-slice model) — the campaign's
     assertions (exactly-once, convergence) must hold through the
-    degradation, and restarted members catch up TCP-only."""
+    degradation.  EPILOGUE (the re-formation pin, VERDICT r4 #1): once
+    every member is back and converged, the leader's reformer must
+    rebuild the clique under a new plane epoch and device-owned commit
+    must RETURN (owns_commit with the full clique) — degradation is no
+    longer permanent (RC re-handshake analog,
+    dare_ibv_ud.c:1098-1416)."""
     import tempfile
     import time as _time
 
@@ -265,6 +270,37 @@ def run_proc_schedule(trial: int, seed_base: int,
                 for k, v in acked.items():
                     got = c.get(k)
                     assert got == v, (k, got, v)
+                if device_plane:
+                    # RE-FORMATION PIN: with all members back, device-
+                    # owned commit must return under a (possibly new)
+                    # plane epoch with the FULL clique.  Writes keep
+                    # flowing while we wait — ownership arms under
+                    # traffic.
+                    # Budget spans several burned-epoch retry cycles
+                    # (each bounded by the rendezvous init timeout) on
+                    # an oversubscribed 1-core box.
+                    deadline = _time.monotonic() + 360.0
+                    d = {}
+                    while _time.monotonic() < deadline:
+                        k, v = b"rf%d" % seq, b"rv%d" % seq
+                        seq += 1
+                        assert c.put(k, v) == b"OK"
+                        acked[k] = v
+                        try:
+                            lead = pc.leader_idx(timeout=5.0)
+                        except AssertionError:
+                            continue
+                        st = pc.status(lead, timeout=1.0)
+                        d = (st or {}).get("devplane") or {}
+                        if (d.get("owns_commit") and d.get("ready")
+                                and not d.get("dead")
+                                and d.get("members") == [0, 1, 2]):
+                            break
+                        _time.sleep(0.2)
+                    else:
+                        raise AssertionError(
+                            f"device-owned commit never returned after "
+                            f"recovery (re-formation): {d}")
     return "ok"
 
 
