@@ -35,7 +35,7 @@ from apus_tpu.models.sm import Snapshot, StateMachine
 
 # -- shm layout (native/apus_wire.h parity) -------------------------------
 SHM_MAGIC = b"APUSSHM2"
-SHM_SIZE = 80
+SHM_SIZE = 88
 _OFF_HIGHEST = 8
 _OFF_IS_LEADER = 16
 _OFF_TERM = 24
@@ -45,6 +45,7 @@ _OFF_SPIN_TIMEOUTS = 48
 _OFF_ABORT_FLOOR = 56
 _OFF_FOLLOWER_READS = 64
 _OFF_MISDIRECT_REFUSALS = 72
+_OFF_LEADER_HINT = 80       # leader slot + 1; 0 = unknown (FindLeader)
 
 # proxy -> daemon frame body: u8 action | u64 conn_id | u64 cur_rec | data
 _HDR = struct.Struct("<BQQ")
@@ -867,6 +868,11 @@ class Bridge:
         node = self.daemon.node
         self._shm_set(_OFF_IS_LEADER, 1 if node.is_leader else 0)
         self._shm_set(_OFF_TERM, node.current_term)
+        # FindLeader hint (leader slot + 1; 0 = unknown): a refused
+        # misdirected client's operator reads where leadership went
+        # straight out of shm instead of grepping logs (run.sh:46-68).
+        hint = node.idx if node.is_leader else node.leader_hint
+        self._shm_set(_OFF_LEADER_HINT, 0 if hint is None else hint + 1)
         # Surface proxy-side spin timeouts (proxy.cpp wait_released):
         # each one is a reply the app sent for a record consensus never
         # released — invisible divergence unless accounted here.

@@ -99,6 +99,17 @@ def make_client_ops(daemon) -> dict:
                 "is_leader": n.is_leader,
                 "term": n.current_term,
                 "leader_hint": n.leader_hint,
+                # Actionable FindLeader answer (run.sh:46-68 greps
+                # logs; here ANY replica's status names the leader's
+                # control endpoint): clients/harnesses reattach from
+                # the hint instead of scanning the whole peer table.
+                "leader_addr": (
+                    daemon.spec.peers[n.idx] if n.is_leader
+                    and n.idx < len(daemon.spec.peers)
+                    else daemon.spec.peers[n.leader_hint]
+                    if n.leader_hint is not None
+                    and n.leader_hint < len(daemon.spec.peers)
+                    else None),
                 "commit": n.log.commit,
                 "apply": n.log.apply,
                 "end": n.log.end,
@@ -200,6 +211,38 @@ def probe_status(addr: str, timeout: float = 0.5) -> Optional[dict]:
         return json.loads(wire.Reader(resp[1:]).blob().decode())
     except (ValueError, KeyError):
         return None
+
+
+def find_leader(peers: list[str], timeout: float = 5.0,
+                probe_timeout: float = 0.5) -> Optional[tuple[int, str]]:
+    """The FindLeader analog as a framework API (the reference greps
+    server logs for the highest "[T<term>] LEADER" banner,
+    run.sh:46-68).  Probes the peer table, FOLLOWING leader hints: a
+    single reachable replica — leader or not — usually answers in one
+    hop with ``leader_addr``.  Returns (slot, control addr) of the
+    current leader, or None within ``timeout``.  App clients map the
+    slot to the leader's application endpoint (fixed app port per host
+    in the reference's deployment, run.sh:72)."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        # One pass: direct answers first, else chase the best hint.
+        hint = None
+        for addr in [a for a in peers if a]:
+            st = probe_status(addr, timeout=probe_timeout)
+            if st is None:
+                continue
+            if st.get("is_leader"):
+                return st["idx"], addr
+            la = st.get("leader_addr")
+            if la:
+                hint = la
+        if hint is not None:
+            st = probe_status(hint, timeout=probe_timeout)
+            if st is not None and st.get("is_leader"):
+                return st["idx"], hint
+        time.sleep(0.05)
+    return None
 
 
 def _not_leader(daemon) -> bytes:

@@ -193,6 +193,93 @@ def proc_failover_series(n: int, series: int) -> dict:
     }
 
 
+def proc_upsize(n: int, writes: int) -> dict:
+    """UPSIZE at the production envelope: the group is FULL (all n
+    slots live), so a joiner forces the size itself to grow n -> n+1
+    through the joint-consensus ladder EXTENDED -> TRANSIT -> STABLE
+    (the reference's Upsize scenario grows group_size by 2 when full,
+    reconf_bench.sh:147-180; CID transitions dare_ibv_ud.c:1024-1037).
+    Timed: admission (join reply) and full catch-up (every replica's
+    apply at the leader's commit) over ``writes`` of prior history."""
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with ProcCluster(n) as pc:
+        with ApusClient(list(pc.spec.peers)) as c:
+            for i in range(writes):
+                assert c.put(b"up:%d" % i, b"v%d" % i) == b"OK"
+        st0 = pc.status(pc.leader_idx(), timeout=2.0) or {}
+        t0 = time.perf_counter()
+        slot = pc.add_replica(timeout=60.0)
+        t_admit = time.perf_counter() - t0
+        pc.wait_converged(timeout=60.0)
+        t_caught = time.perf_counter() - t0
+        st1 = pc.status(pc.leader_idx(), timeout=2.0) or {}
+        assert slot >= n, (slot, n)     # full group: a NEW slot grew
+        return {
+            "metric": "proc_upsize_catch_up_time",
+            "value": round(t_caught * 1e3, 1), "unit": "ms",
+            "detail": {
+                "envelope": "production hb=1ms elect=10-30ms "
+                            "(nodes.local.cfg:22-37)",
+                "admission_ms": round(t_admit * 1e3, 1),
+                "new_slot": slot, "prior_writes": writes,
+                "group_size": [st0.get("group_size"),
+                               st1.get("group_size")],
+                "epoch": [st0.get("epoch"), st1.get("epoch")],
+            },
+        }
+
+
+def proc_add_server(n: int, writes: int) -> dict:
+    """ADD-SERVER (slot reuse) at the production envelope: kill a
+    follower, let the failure detector EVICT it (CONFIG entry,
+    check_failure_count analog dare_server.c:1189-1227), then admit a
+    fresh process — the leader reuses the freed slot (AddServer after
+    RemoveServer, reconf_bench.sh:120-180).  Timed: admission and full
+    catch-up over ``writes`` of history the joiner must replicate."""
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with ProcCluster(n) as pc:
+        with ApusClient(list(pc.spec.peers)) as c:
+            for i in range(writes):
+                assert c.put(b"ad:%d" % i, b"v%d" % i) == b"OK"
+            leader = pc.leader_idx()
+            victim = next(i for i in range(n) if i != leader)
+            pc.kill(victim)
+            # Eviction: membership no longer lists the victim.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st = pc.status(pc.leader_idx(timeout=10.0), timeout=2.0)
+                if st and victim not in st.get("members", [victim]):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("victim never evicted")
+            # Traffic continues while the group runs one short.
+            for i in range(writes):
+                assert c.put(b"ad2:%d" % i, b"v%d" % i) == b"OK"
+        t0 = time.perf_counter()
+        slot = pc.add_replica(timeout=60.0)
+        t_admit = time.perf_counter() - t0
+        live = [i for i in range(len(pc.procs))
+                if pc.procs[i] is not None]
+        pc.wait_converged(timeout=60.0, idxs=live)
+        t_caught = time.perf_counter() - t0
+        assert slot == victim, (slot, victim)   # freed slot reused
+        return {
+            "metric": "proc_add_server_catch_up_time",
+            "value": round(t_caught * 1e3, 1), "unit": "ms",
+            "detail": {
+                "envelope": "production hb=1ms elect=10-30ms "
+                            "(nodes.local.cfg:22-37)",
+                "admission_ms": round(t_admit * 1e3, 1),
+                "reused_slot": slot, "prior_writes": 2 * writes,
+            },
+        }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=3)
@@ -203,7 +290,26 @@ def main() -> int:
     ap.add_argument("--series", type=int, default=0,
                     help="with --proc: run N kill/restart trials on one "
                          "cluster boot and report p50/p95/p99")
+    ap.add_argument("--reconf", action="store_true",
+                    help="with --proc: run the reconfiguration "
+                         "scenarios (Upsize: grow a FULL group's size "
+                         "through EXTENDED->TRANSIT->STABLE; AddServer: "
+                         "evict a killed follower, admit a fresh "
+                         "process into the freed slot) with timed "
+                         "admission/catch-up rows "
+                         "(reconf_bench.sh:147-180)")
     args = ap.parse_args()
+
+    if args.proc and args.reconf:
+        n = max(args.replicas, 3)
+        results = [proc_upsize(n, args.writes),
+                   proc_add_server(n, args.writes)]
+        for r in results:
+            print(f"{r['metric']:<36}{r['value']:>10}  {r['unit']}  "
+                  f"(admission {r['detail']['admission_ms']} ms)")
+        for r in results:
+            print(json.dumps(r))
+        return 0
 
     if args.proc:
         n = args.replicas

@@ -158,6 +158,56 @@ def memslap_benchmark(pc, concurrency: int,
     }
 
 
+class RawApp:
+    """ONE bare app process — no interposer, no daemon, no replication.
+    The reference's methodology drives the stock client against the raw
+    app the same way (benchmarks/run.sh:70-80 minus the LD_PRELOAD
+    line); this is the DENOMINATOR for the interposition+replication
+    overhead ratio (--raw).  Exposes the pc surface drive()/the stock
+    client rungs consume (leader_idx/app_addr)."""
+
+    def __init__(self, app_argv: list, port: int | None = None):
+        from apus_tpu.runtime.appcluster import free_port
+        self.argv = list(app_argv)
+        self.port = port or free_port()
+        self.proc = None
+
+    def __enter__(self) -> "RawApp":
+        import socket
+        import subprocess
+        self.proc = subprocess.Popen(
+            self.argv + [str(self.port)], stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"raw app exited rc={self.proc.returncode}")
+            try:
+                with socket.create_connection(("127.0.0.1", self.port),
+                                              timeout=0.5):
+                    return self
+            except OSError:
+                time.sleep(0.05)
+        raise AssertionError("raw app did not come up")
+
+    def __exit__(self, *exc) -> None:
+        import os as _os
+        import signal as _signal
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                _os.killpg(self.proc.pid, _signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                self.proc.kill()
+            self.proc.wait(timeout=5.0)
+
+    def leader_idx(self, timeout: float = 0.0) -> int:
+        return 0
+
+    def app_addr(self, i: int) -> tuple:
+        return ("127.0.0.1", self.port)
+
+
 def drive(pc: ProxiedCluster, drv, op: str, requests: int, clients: int,
           value: str) -> dict:
     """C client threads, each issuing requests/C ops at the leader app."""
@@ -299,6 +349,13 @@ def main() -> int:
                     help="one replica per OS process at the production "
                          "timing envelope (run.sh deployment shape) "
                          "instead of the in-process thread cluster")
+    ap.add_argument("--raw", action="store_true",
+                    help="UNREPLICATED baseline: drive the same "
+                         "workload at ONE bare app process (no "
+                         "interposer, no consensus) — the denominator "
+                         "for the replication overhead ratio "
+                         "(run.sh:70-80 methodology without the "
+                         "LD_PRELOAD line)")
     args = ap.parse_args()
 
     value = "x" * args.value_bytes
@@ -329,6 +386,33 @@ def main() -> int:
             return 2
         app_argv = [MEMCACHED_RUN]
         drv = McDriver
+
+    if args.raw:
+        if app_argv is None:
+            from apus_tpu.runtime.appcluster import TOYSERVER, build_native
+            build_native()
+            app_argv = [TOYSERVER]
+        with RawApp(app_argv) as ra:
+            results = [
+                drive(ra, drv, "set", args.requests, args.clients, value),
+                drive(ra, drv, "get", args.requests, args.clients, value)]
+            if args.redis:
+                r = redis_benchmark(ra, args.requests, args.clients,
+                                    args.value_bytes,
+                                    pipeline=args.pipeline)
+                if r is not None:
+                    results.append(r)
+            if args.memcached:
+                r = memslap_benchmark(
+                    ra, concurrency=args.clients,
+                    execute_number=max(1, args.requests // args.clients))
+                if r is not None:
+                    results.append(r)
+        for rec in results:
+            rec["metric"] = "raw_" + rec["metric"].removeprefix("proxied_")
+            rec["detail"]["raw"] = True
+            print(json.dumps(rec))
+        return 0
 
     if args.proc:
         from apus_tpu.runtime.proc import ProcCluster

@@ -37,6 +37,17 @@ def _rss_kb(pid: int) -> int:
     return 0
 
 
+def _find_leader_slot(pc) -> int:
+    """Leader slot via the framework's hint-following find_leader (the
+    FindLeader-as-API path a real client uses), not the harness's
+    all-status scan."""
+    from apus_tpu.runtime.client import find_leader
+    fl = find_leader(list(pc.spec.peers), timeout=15.0)
+    if fl is None:
+        raise AssertionError("find_leader: no leader within timeout")
+    return fl[0]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=10.0)
@@ -104,6 +115,19 @@ def main() -> int:
     mesh_commits = 0            # high-water device-owned commit count
     mesh_dead = False
     mesh_degraded_at_write = None
+    # Per-INTER-KILL-interval re-formation ledger (VERDICT r4 #1 done
+    # criterion): device-owned commit must RETURN in every interval
+    # between kills, not just before the first one.  Each record:
+    # owned (did owns_commit hold at some sample), commit delta, the
+    # highest plane epoch seen.
+    mesh_interkill: list[dict] = []
+    mesh_iv_owned = False
+    mesh_iv_commits = 0
+    mesh_iv_epoch = -1
+    # devplane_commits is a PER-DAEMON counter and the leader moves at
+    # every kill: attribute increments per leader slot, or post-kill
+    # intervals under a fresh leader would always read 0.
+    mesh_seen_commits: dict[int, int] = {}
 
     with ProcCluster(args.replicas, app_argv=app_argv,
                      spec=mesh_spec, device_plane=args.mesh,
@@ -113,13 +137,25 @@ def main() -> int:
 
         def mesh_check():
             """Track the mesh plane's device-owned commit high-water
-            mark and the op count at which the ICI slice degraded."""
+            mark, the op count at which the ICI slice FIRST degraded,
+            and per-inter-kill ownership (re-formation evidence)."""
             nonlocal mesh_commits, mesh_dead, mesh_degraded_at_write
+            nonlocal mesh_iv_owned, mesh_iv_epoch, mesh_iv_commits
             if not args.mesh:
                 return
             st = pc.status(leader, timeout=1.0)
             d = (st or {}).get("devplane") or {}
-            mesh_commits = max(mesh_commits, d.get("commits", 0))
+            cur = d.get("commits", 0)
+            seen = mesh_seen_commits.get(leader, 0)
+            if cur > seen:
+                mesh_iv_commits += cur - seen
+                mesh_commits += cur - seen
+            mesh_seen_commits[leader] = cur
+            if d.get("owns_commit"):
+                mesh_iv_owned = True
+            ep = d.get("epoch")
+            if ep is not None:
+                mesh_iv_epoch = max(mesh_iv_epoch, ep)
             if d.get("dead") and not mesh_dead:
                 mesh_dead = True
                 # seq, not ops: a later affinity retraction rolls
@@ -127,6 +163,19 @@ def main() -> int:
                 # the final count.  seq (attempted writes) is
                 # monotonic.
                 mesh_degraded_at_write = seq
+
+        def mesh_interval_close():
+            """Seal the current inter-kill interval's ledger record."""
+            nonlocal mesh_iv_owned, mesh_iv_commits, mesh_iv_epoch
+            if not args.mesh:
+                return
+            mesh_interkill.append({
+                "owned": mesh_iv_owned,
+                "device_commits": mesh_iv_commits,
+                "plane_epoch": mesh_iv_epoch,
+            })
+            mesh_iv_owned = False
+            mesh_iv_commits = 0
 
         def affinity_check():
             """Confirm the live connection still points at the leader;
@@ -162,6 +211,7 @@ def main() -> int:
                 # Keep quorum: only kill when every replica is up.
                 if all(p is not None for p in pc.procs):
                     mesh_check()     # commit high-water BEFORE the kill
+                    mesh_interval_close()
                     try:
                         client.close()
                     except Exception:    # noqa: BLE001
@@ -173,7 +223,7 @@ def main() -> int:
                     dead = next(i for i in range(args.replicas)
                                 if pc.procs[i] is None)
                     pc.restart(dead)
-                    leader = pc.leader_idx()
+                    leader = _find_leader_slot(pc)
                     client = mk(pc.app_addr(leader))
                 next_failover = now + args.failover_every
             # Bounded keyspace (4000 < toyserver's fixed 4096-slot
@@ -202,7 +252,12 @@ def main() -> int:
                     pass
                 time.sleep(0.2)
                 try:
-                    leader = pc.leader_idx()
+                    # Reattach FROM THE HINT (find_leader, the
+                    # FindLeader-as-API path): one reachable replica
+                    # names the leader; a wrong/stale answer is
+                    # harmless — the misdirection gate refuses it and
+                    # we land back here.
+                    leader = _find_leader_slot(pc)
                     client = mk(pc.app_addr(leader))
                 except Exception:        # noqa: BLE001
                     time.sleep(0.5)
@@ -224,6 +279,7 @@ def main() -> int:
         # multiple-of-200 checkpoint are unverified otherwise).
         affinity_check()
         mesh_check()
+        mesh_interval_close()
         wall = time.monotonic() - t0
         client.close()
         # Traffic ran with the misdirection gate at the PRODUCTION
@@ -278,6 +334,13 @@ def main() -> int:
                 "device_commits": mesh_commits,
                 "degraded": mesh_dead,
                 "degraded_at_write": mesh_degraded_at_write,
+                # Re-formation evidence: one record per inter-kill
+                # interval; "owned" must be true in EVERY interval for
+                # the plane to count as recovering, not just degrading.
+                "interkill": mesh_interkill,
+                "interkill_owned": "%d/%d" % (
+                    sum(1 for r in mesh_interkill if r["owned"]),
+                    len(mesh_interkill)),
             }} if args.mesh else {}),
         },
     }))

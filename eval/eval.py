@@ -122,6 +122,30 @@ def cmd_run(args) -> int:
                 _record(out, rec, replicas=3, bench="run_bench",
                         app="memcached")
 
+        # 1a4. RAW (unreplicated) app baselines — the reference's own
+        # methodology drives the stock client against the raw app
+        # (run.sh:70-80 without the LD_PRELOAD line); these rows are
+        # the DENOMINATOR for the interposition+replication overhead
+        # ratio reported in BASELINE.md.  Caveat carried in the rows:
+        # on this 1-core host the replicated numerator timeshares the
+        # core across all replicas+apps+clients, so the ratio is an
+        # upper bound on true replication overhead.
+        raw_flags = [("toyserver", [])]
+        if args.redis:
+            raw_flags.append(("redis", ["--redis"]))
+        if getattr(args, "ssdb", False):
+            raw_flags.append(("ssdb", ["--ssdb"]))
+        if getattr(args, "memcached", False):
+            raw_flags.append(("memcached", ["--memcached"]))
+        for app_name, flags in raw_flags:
+            print(f"run_bench --raw ({app_name})")
+            argv = [sys.executable,
+                    os.path.join(REPO, "benchmarks", "run_bench.py"),
+                    "--raw", "--requests", str(args.requests)] + flags
+            for rec in _run_tool(argv, timeout=300):
+                _record(out, rec, replicas=1, bench="run_bench_raw",
+                        app=app_name + "(raw)")
+
         # 1b. Device-plane full stack (proxied app with commits carried
         # by the jitted device plane on the virtual CPU mesh).
         print("run_bench: 3 replicas (device plane)")
@@ -181,6 +205,19 @@ def cmd_run(args) -> int:
                     timeout=240):
                 _record(out, rec, replicas=max(replica_counts),
                         bench="reconf_bench")
+
+        # 2b. Reconfiguration at the production envelope (Upsize: grow
+        # a FULL group EXTENDED->TRANSIT->STABLE; AddServer: evict a
+        # killed follower, admit a fresh process into the freed slot) —
+        # the reconf_bench.sh:147-180 scenarios, timed.
+        for n in [x for x in replica_counts if x in (3, 5)]:
+            print(f"reconf_bench --proc --reconf: {n} replicas")
+            for rec in _run_tool(
+                    [sys.executable,
+                     os.path.join(REPO, "benchmarks", "reconf_bench.py"),
+                     "--proc", "--reconf", "--replicas", str(n)],
+                    timeout=420):
+                _record(out, rec, replicas=n, bench="reconf_bench_reconf")
 
         # 3. Device-plane pipelined commit round (bench.py; tries the
         # real TPU first, falls back to CPU under its own watchdog).

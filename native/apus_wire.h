@@ -44,7 +44,7 @@ struct apus_bridge_hdr {
 // The daemon creates and owns the file; the proxy mmaps it.  All fields
 // are 8-byte aligned; cross-process visibility via __atomic builtins.
 #define APUS_SHM_MAGIC "APUSSHM2"
-#define APUS_SHM_SIZE 80
+#define APUS_SHM_SIZE 88
 
 struct apus_shm {
   char magic[8];
@@ -84,6 +84,16 @@ struct apus_shm {
                                       // run.sh:46-68).
   volatile uint64_t misdirect_refusals;  // reads refused by that gate
                                          // (proxy writes; observability)
+  volatile uint64_t leader_hint;  // current leader slot + 1 (0 =
+                                  // unknown; daemon writes).  The
+                                  // FindLeader answer (run.sh:46-68
+                                  // greps logs for it; here it is a
+                                  // queryable field): a refused
+                                  // client's operator — or the wire
+                                  // status op, which serves the same
+                                  // hint as "leader_addr" — learns
+                                  // where the leadership went without
+                                  // scanning every replica.
 };
 
 // Max raw request record (TCP rcvbuf-sized, message.h:7 parity).

@@ -207,6 +207,27 @@ def test_non_leader_refuses_misdirected_clients(tmp_path):
         st = probe_status(pc.spec.peers[follower], timeout=1.0)
         assert st and st.get("misdirect_refusals", 0) >= 1, st
 
+        # REFUSAL -> HINT -> REATTACH (the FindLeader answer as
+        # framework behavior, not harness scanning): the refusing
+        # follower's own status names the leader's endpoint, and
+        # find_leader() resolves it in one hop; reattaching there
+        # serves the acked writes.
+        from apus_tpu.runtime.client import find_leader
+        assert st.get("leader_addr") == pc.spec.peers[leader], st
+        fl = find_leader(list(pc.spec.peers), timeout=10.0)
+        assert fl is not None
+        hint_slot, hint_addr = fl
+        assert hint_slot == leader and hint_addr == pc.spec.peers[leader]
+        with RespClient(pc.app_addr(hint_slot)) as c:
+            assert c.cmd("GET", "md:0") == b"mv:0"
+        # The hint is also mirrored into the proxy's shm block
+        # (leader_hint = slot + 1), readable without any wire op.
+        import struct as _struct
+        with open(f"{pc.workdir}/bridge{follower}.shm", "rb") as f:
+            blob = f.read()
+        (shm_hint,) = _struct.unpack_from("<Q", blob, 80)
+        assert shm_hint == leader + 1, shm_hint
+
         # Leader killed UNDER a live client: the connection dies with
         # it; reattaching to a non-leader is refused the same way, so
         # the only path back is the real new leader — where every acked
