@@ -51,7 +51,7 @@ from apus_tpu.core.cid import Cid, CidState
 from apus_tpu.core.quorum import quorum_size
 from apus_tpu.ops.logplane import (FENCE_GRANTED, FENCE_TERM, META_COLS,
                                    OFF_COMMIT, OFF_END, DeviceLog)
-from apus_tpu.ops.mesh import REPLICA_AXIS
+from apus_tpu.ops.mesh import REPLICA_AXIS, shard_map
 
 
 @jax.tree_util.register_dataclass
@@ -241,12 +241,11 @@ def build_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     sharded = P(REPLICA_AXIS)
     repl = P()
     ctrl_specs = CommitControl(*([repl] * 7))
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, sharded, sharded,
                   ctrl_specs),
-        out_specs=(sharded, sharded, sharded, sharded, repl, repl),
-        check_vma=False)
+        out_specs=(sharded, sharded, sharded, sharded, repl, repl))
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(devlog: DeviceLog, batch_data, batch_meta, ctrl: CommitControl):
@@ -341,12 +340,11 @@ def build_pipelined_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
             commits = jnp.where(coherent, commits, 0)
         return log_data, log_meta, offs, fence, commits, ctrl
 
-    fn = jax.shard_map(
+    fn = shard_map(
         pipe, mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, staged, staged,
                   ctrl_specs),
-        out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs),
-        check_vma=False)
+        out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs))
 
     @functools.partial(jax.jit,
                        **({"donate_argnums": 0} if donate else {}))
@@ -574,12 +572,11 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
         ctrl = dataclasses.replace(ctrl, end0=ctrl.end0 + D * B)
         return log_data, log_meta, offs, fence, commits, ctrl
 
-    fn = jax.shard_map(
+    fn = shard_map(
         pipe, mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, staged, staged,
                   ctrl_specs),
-        out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs),
-        check_vma=False)
+        out_specs=(sharded, sharded, sharded, sharded, repl, ctrl_specs))
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(devlog: DeviceLog, staged_data, staged_meta,
@@ -595,6 +592,133 @@ def build_pipelined_commit_step_fused(mesh: Mesh, n_replicas: int,
     # 'interpret', or the XLA whole-ring select 'off') — recorded by
     # bench.py so published numbers are attributable to a kernel.
     step.pallas_mode = pallas_mode
+    return step
+
+
+def build_windowed_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
+                               slot_bytes: int, batch: int, max_depth: int,
+                               verify_round: bool = False,
+                               donate: bool = True,
+                               donate_ctrl: bool = True):
+    """Single-window latency engine: ONE compiled program that carries a
+    whole small window of up to ``max_depth`` commit rounds per dispatch,
+    with a DYNAMIC round count and device-side early exit.
+
+    This is the un-amortized counterpart of the deep pipelined steps: a
+    single client request must not pay one host dispatch per round (the
+    69 ms single-dispatch wall the r05 bench recorded is pure dispatch
+    RTT on a tunneled chip), nor one recompile per window shape.  The
+    engine is a ``lax.while_loop`` whose trip count is the RUNTIME
+    scalar ``n_rounds`` — depth-1 and depth-4 windows ride the same
+    executable — and whose body is exactly ``_commit_body``, so one
+    dispatch replicates, fences, votes, and advances commit for every
+    staged round, stopping the moment the outcome is decided:
+
+    - the window's staged rounds have all cleared their quorum vote
+      (``i == n_rounds``): the padding capacity up to ``max_depth`` is
+      never executed, or
+    - a round's vote FAILS to clear (``halt_on_fail != 0``): later
+      rounds cannot extend commit past the failed one inside this
+      dispatch (fence/offs state cannot change mid-program), so the
+      engine returns control to the host immediately instead of
+      burning the rest of the window — the device-resident analog of
+      the reference's commit loop exiting to its adjust path
+      (loop_for_commit, dare_ibv_rc.c:1870-1948).  ``halt_on_fail=0``
+      reproduces the scan pipeline's run-all-rounds semantics.
+
+    Buffer donation is threaded through BOTH state operands: the devlog
+    (ring data/meta, the ``offs`` log-tail and ``fence`` fence-mask
+    arrays) and — with ``donate_ctrl`` — the CommitControl pytree, whose
+    ``mask_old``/``mask_new`` vote-mask arrays pass through unchanged
+    and alias input to output, so a steady-state caller loops entirely
+    on device-resident buffers with zero per-round HBM copies.  A
+    caller that donates ctrl must treat the INPUT ctrl as consumed and
+    carry the returned one (DeviceCommitRunner refreshes its ctrl
+    cache this way).
+
+    Returns ``step(devlog, staged_data [MD,R,B,SB] u8, staged_meta
+    [MD,R,B,4] i32, ctrl, n_rounds i32, halt_on_fail i32) -> (devlog',
+    commits [MD] i32, rounds_run i32, ctrl')`` where ``commits[i]`` is
+    the global commit index after round i (0 for rounds never
+    executed), ``rounds_run`` is the number of rounds the loop actually
+    ran, and ``ctrl'`` has ``end0`` advanced by ``rounds_run * B``
+    (feed it straight back).  Round i consumes staged batch i.
+    """
+    _check_geometry(mesh, n_replicas, n_slots, batch)
+    MD, B = max_depth, batch
+    body = functools.partial(_commit_body, batch=batch, n_slots=n_slots)
+    sharded = P(REPLICA_AXIS)
+    staged = P(None, REPLICA_AXIS)
+    repl = P()
+    ctrl_specs = CommitControl(*([repl] * 7))
+
+    def pipe(log_data, log_meta, offs, fence, sdata, smeta, ctrl,
+             n_rounds, halt):
+        if verify_round:
+            # Hoisted round-identity check (same rationale as the
+            # pipelined step): one tiny all_gather per WINDOW; on
+            # incoherence leader=-2 blocks every write and the commit
+            # outputs are zeroed below.
+            ident = jnp.stack([ctrl.term, ctrl.leader, ctrl.end0])
+            idents = lax.all_gather(ident, REPLICA_AXIS)
+            coherent = jnp.all(idents == ident[None])
+            ctrl = dataclasses.replace(
+                ctrl, leader=jnp.where(coherent, ctrl.leader,
+                                       jnp.int32(-2)))
+        commits0 = jnp.zeros((MD,), jnp.int32)
+
+        def cond(carry):
+            i, ok = carry[0], carry[1]
+            return (i < n_rounds) & ok
+
+        def one(carry):
+            i, ok, log_data, log_meta, offs, fence, ctrl, commits = carry
+            bdata = lax.dynamic_index_in_dim(sdata, i, axis=0,
+                                             keepdims=False)
+            bmeta = lax.dynamic_index_in_dim(smeta, i, axis=0,
+                                             keepdims=False)
+            log_data, log_meta, offs, fence, _, commit = body(
+                log_data, log_meta, offs, fence, bdata, bmeta, ctrl)
+            commits = lax.dynamic_update_index_in_dim(
+                commits, commit, i, axis=0)
+            # The vote cleared iff the whole batch reached quorum
+            # (cand is clamped to the leader ack, so commit can never
+            # exceed end0 + B).
+            cleared = commit >= ctrl.end0 + B
+            ctrl = dataclasses.replace(ctrl, end0=ctrl.end0 + B)
+            return (i + 1, cleared | (halt == 0), log_data, log_meta,
+                    offs, fence, ctrl, commits)
+
+        (i, _, log_data, log_meta, offs, fence, ctrl, commits) = \
+            lax.while_loop(cond, one,
+                           (jnp.int32(0), jnp.bool_(True), log_data,
+                            log_meta, offs, fence, ctrl, commits0))
+        if verify_round:
+            commits = jnp.where(coherent, commits, 0)
+        return log_data, log_meta, offs, fence, commits, i, ctrl
+
+    fn = shard_map(
+        pipe, mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, staged, staged,
+                  ctrl_specs, repl, repl),
+        out_specs=(sharded, sharded, sharded, sharded, repl, repl,
+                   ctrl_specs))
+
+    donate_argnums = (() if not donate else (0,)) + \
+        (() if not donate_ctrl else (3,))
+
+    @functools.partial(jax.jit, donate_argnums=donate_argnums)
+    def step(devlog: DeviceLog, staged_data, staged_meta,
+             ctrl: CommitControl, n_rounds, halt_on_fail):
+        _assert_devlog_geometry(devlog, n_slots, slot_bytes, batch)
+        assert staged_data.shape[0] == MD
+        d, m, o, f, commits, rounds_run, ctrl = fn(
+            devlog.data, devlog.meta, devlog.offs, devlog.fence,
+            staged_data, staged_meta, ctrl,
+            jnp.asarray(n_rounds, jnp.int32),
+            jnp.asarray(halt_on_fail, jnp.int32))
+        return DeviceLog(d, m, o, f), commits, rounds_run, ctrl
+
     return step
 
 

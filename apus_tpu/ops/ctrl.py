@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apus_tpu.ops.logplane import META_IDX, META_TERM, OFF_END
-from apus_tpu.ops.mesh import REPLICA_AXIS
+from apus_tpu.ops.mesh import REPLICA_AXIS, shard_map
 
 VS_TERM, VS_FOR, VS_FENCE = range(3)
 HB_TERM, HB_COUNT = range(2)
@@ -115,8 +115,8 @@ def build_vote_step(mesh: Mesh, n_replicas: int, n_slots: int):
     assert n_replicas % axis == 0
     body = functools.partial(_vote_body, n_slots=n_slots)
     s, r = P(REPLICA_AXIS), P()
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(s, s, s, r),
-                       out_specs=(s, r, r), check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(s, s, s, r),
+                   out_specs=(s, r, r))
     return jax.jit(fn)
 
 
@@ -125,6 +125,6 @@ def build_hb_step(mesh: Mesh, n_replicas: int):
     assert n_replicas % axis == 0
     body = _hb_body
     s, r = P(REPLICA_AXIS), P()
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(s, r), out_specs=(s, r),
-                       check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(s, r),
+                   out_specs=(s, r))
     return jax.jit(fn)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -98,6 +99,80 @@ def make_device_log(n_replicas: int,
     if sharding is not None:
         fence = jax.device_put(fence, sharding)
     return DeviceLog(data=data, meta=meta, offs=offs, fence=fence)
+
+
+class HostStagingRing:
+    """Double-buffered host staging for window encoding (the pinned
+    send-buffer ring of the reference's RDMA path, re-expressed for the
+    host->device transfer edge).
+
+    The old staging path allocated fresh ``np.zeros`` window buffers
+    per dispatch and implicitly serialized host packing behind the
+    transfer consuming the previous window.  This ring keeps ``nbuf``
+    (default two) REUSABLE pinned buffer pairs per window depth:
+    ``acquire`` hands out the next pair, blocking ONLY on the consumer
+    edge — ``jax.block_until_ready`` of the device arrays staged from
+    that same pair ``nbuf`` windows ago — so host-side slot packing
+    for window N+1 overlaps device execution of window N.  ``staged``
+    records the device arrays a pair was consumed into.
+
+    Slot order is preserved by construction: pairs are handed out
+    round-robin and a pair is never rewritten until the transfer that
+    read it has completed, so a slow consumer (device executing a deep
+    window) delays reuse instead of corrupting in-flight bytes.
+
+    Not re-entrant beyond ``nbuf`` concurrent un-staged acquisitions
+    per depth (the drivers are single-dispatcher; the bench loops are
+    single-threaded)."""
+
+    def __init__(self, batch: int, slot_bytes: int, nbuf: int = 2):
+        self.batch = batch
+        self.slot_bytes = slot_bytes
+        self.nbuf = nbuf
+        self._lock = threading.Lock()
+        self._pools: dict[int, list] = {}     # depth -> [_StageSlot]
+        self._cursor: dict[int, int] = {}
+
+    class _StageSlot:
+        __slots__ = ("data", "meta", "inflight")
+
+        def __init__(self, depth, batch, slot_bytes):
+            self.data = np.zeros((depth, batch, slot_bytes), np.uint8)
+            self.meta = np.zeros((depth, batch, 4), np.int32)
+            self.inflight = None      # device arrays staged from here
+
+    def acquire(self, depth: int) -> "HostStagingRing._StageSlot":
+        """Next reusable buffer pair for a ``depth``-round window,
+        zeroed, with the consumer edge (the device transfer that last
+        read it) already awaited."""
+        with self._lock:
+            pool = self._pools.get(depth)
+            if pool is None:
+                pool = self._pools[depth] = [
+                    self._StageSlot(depth, self.batch, self.slot_bytes)
+                    for _ in range(self.nbuf)]
+                self._cursor[depth] = 0
+            slot = pool[self._cursor[depth]]
+            self._cursor[depth] = (self._cursor[depth] + 1) % self.nbuf
+        if slot.inflight is not None:
+            # Consumer edge: the ONLY blocking point of the pipeline.
+            # Ready outputs of the staging transfer imply the host
+            # buffer's bytes have been read; rewriting before that
+            # would corrupt the in-flight window.
+            jax.block_until_ready(slot.inflight)
+            slot.inflight = None
+        # memset, not realloc: encoders only write each entry's wire
+        # bytes, so stale tail bytes from the last window must be
+        # cleared (zero rows are the NOOP/non-leader contract).
+        slot.data.fill(0)
+        slot.meta.fill(0)
+        return slot
+
+    def staged(self, slot: "HostStagingRing._StageSlot",
+               device_arrays) -> None:
+        """Record the device arrays ``slot`` was consumed into; the
+        pair becomes reusable once they are ready."""
+        slot.inflight = device_arrays
 
 
 def host_batch_to_device(requests: list[bytes], slot_bytes: int,

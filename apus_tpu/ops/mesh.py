@@ -17,6 +17,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 REPLICA_AXIS = "replica"
 
 
+def shard_map(body, *, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the JAX versions this repo runs on.
+
+    Newer releases expose ``jax.shard_map`` (replication checking via
+    ``check_vma``); older ones (<= 0.4.x) only have
+    ``jax.experimental.shard_map.shard_map`` with the same semantics
+    under ``check_rep``.  Every shard_map in the data plane goes
+    through here so the ops layer keeps one call shape.  Replication
+    checking is disabled either way: the commit-step bodies mix
+    replicated control scalars with sharded state, and the checker's
+    inference rejects the (correct) mixed returns."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 def replica_mesh(n_replicas: int, devices=None) -> Mesh:
     """A 1-D mesh with ``n_replicas`` entries along the replica axis.
 

@@ -37,7 +37,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from apus_tpu.ops.mesh import REPLICA_AXIS
+from apus_tpu.ops.mesh import REPLICA_AXIS, shard_map
 
 try:
     from jax.experimental import pallas as pl
@@ -105,9 +105,9 @@ def build_one_sided_scatter(mesh, batch: int, slot_bytes: int,
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(REPLICA_AXIS), P()),
-                       out_specs=P(REPLICA_AXIS), check_vma=False)
+                       out_specs=P(REPLICA_AXIS))
     def scatter(local, leader):
         out = call(local[0], jnp.asarray([leader], jnp.int32))
         return out[None]

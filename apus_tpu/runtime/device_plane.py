@@ -210,48 +210,57 @@ class DeviceCommitRunner:
         self._place = _place
 
         # Pipelined dispatch: K consecutive rounds inside ONE XLA
-        # program (lax.scan) — the live form of the reference's many-
-        # outstanding-WRs pipelining (post_send selective signaling,
+        # program — the live form of the reference's many-outstanding-
+        # WRs pipelining (post_send selective signaling,
         # dare_ibv_rc.c:2552-2568).  The driver uses it whenever the
         # host backlog covers K full batches, cutting dispatch+sync
         # overhead per round by ~K.
         from apus_tpu.ops.commit import (build_pipelined_commit_step,
-                                         build_pipelined_commit_step_fused)
+                                         build_pipelined_commit_step_fused,
+                                         build_windowed_commit_step)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from apus_tpu.ops.mesh import REPLICA_AXIS
         K = self.PIPE_DEPTH
-        # Two pipelined programs keyed by window depth: the scan step
-        # (proportional slot writes, shallow windows) and a deep-window
-        # step.  The deep program is the fused closed-form step on an
-        # accelerator (per-dispatch cost ~= one ring update, invisible
-        # next to dispatch latency; the pallas in-place kernel makes it
-        # proportional again) — but on the CPU backend the fused ring
-        # rewrite costs ~25x the scan's proportional writes at this
-        # depth, so CPU keeps the scan shape for the deep rung too
-        # (same rationale as _use_device_expand; the two programs are
-        # differentially tested semantically identical).
+        # SHALLOW windows (1..PIPE_DEPTH rounds) ride the single-window
+        # latency engine: ONE compiled program with a runtime round
+        # count and device-side early exit, donating both the devlog
+        # and the CommitControl (vote-mask) buffers.  This replaces the
+        # per-depth scan compile the old shallow rung paid, and lets a
+        # depth-1 and a depth-4 window share one executable — the
+        # un-amortized single-dispatch path the bench's --single-window
+        # mode measures.
+        self._window = build_windowed_commit_step(
+            self._mesh, R, self.n_slots, SB, B, max_depth=K)
+        # DEEP rungs stay per-depth programs: the fused closed-form
+        # step on an accelerator (per-dispatch cost ~= one ring update,
+        # invisible next to dispatch latency; the pallas in-place
+        # kernel makes it proportional again) — but on the CPU backend
+        # the fused ring rewrite costs ~25x the scan's proportional
+        # writes at this depth, so CPU keeps the scan shape for the
+        # deep rung (same rationale as _use_device_expand; the two
+        # programs are differentially tested semantically identical).
         deep_builder = (build_pipelined_commit_step_fused
                         if jax.default_backend() != "cpu"
                         else build_pipelined_commit_step)
         deep_depths = (self.DEEP_DEPTHS if jax.default_backend() != "cpu"
                        else (self.DEEP_DEPTH,))
-        self._pipes = {
-            K: build_pipelined_commit_step(
-                self._mesh, R, self.n_slots, SB, B, depth=K,
-                staged_depth=K),
-        }
+        self._pipes = {}
         for D in deep_depths:
             self._pipes[D] = deep_builder(
                 self._mesh, R, self.n_slots, SB, B, depth=D,
                 staged_depth=D)
-        #: pipe depths descending — the driver's window-selection order.
-        self.window_depths = sorted(self._pipes, reverse=True)
+        #: dispatchable window depths descending — the driver's
+        #: window-selection order (deep pipes + the shallow engine's
+        #: max; depths below PIPE_DEPTH ride the same engine with a
+        #: smaller runtime round count).
+        self.window_depths = sorted(set(self._pipes) | {K}, reverse=True)
         #: which ring-rewrite path each fused rung compiled to
-        #: ('compiled' pallas / 'off' XLA select; None = scan step) —
-        #: surfaced in bench detail so numbers are attributable.
+        #: ('compiled' pallas / 'off' XLA select; None = scan/windowed
+        #: step) — surfaced in bench detail so numbers are attributable.
         self.pallas_modes = {K: getattr(p, "pallas_mode", None)
                              for K, p in self._pipes.items()}
+        self.pallas_modes.setdefault(K, None)
         staged_sh = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
         self._staged_sharding = staged_sh
 
@@ -278,6 +287,12 @@ class DeviceCommitRunner:
                     jax.device_put(meta, staged_sh))
 
         self._place_staged = _place_staged
+        # Double-buffered reusable host staging (ops.logplane): window
+        # encoding for dispatch N+1 overlaps the device's execution of
+        # window N; acquire() blocks only on the consumer edge (the
+        # transfer that read the buffer two windows ago).
+        from apus_tpu.ops.logplane import HostStagingRing
+        self._staging = HostStagingRing(B, SB)
         #: Whether the driver keeps deep windows in flight
         #: (commit_rounds_async) rather than resolving each before
         #: staging the next.  With the in-place staging encoder the
@@ -325,6 +340,19 @@ class DeviceCommitRunner:
                 np.zeros((depth, B, 4), np.int32), 0)
             devlog, commits, _ = pipe(devlog, sdata, smeta, ctrl)
             self._jax.block_until_ready(commits)
+        # Windowed (single-window latency) engine: round count and the
+        # halt policy are runtime scalars, so ONE warm dispatch compiles
+        # the program every shallow depth shares.  ctrl is donated —
+        # rebuild a throwaway one for the warm call.
+        sdata, smeta = self._place_staged(
+            np.zeros((self.PIPE_DEPTH, B, SB), np.uint8),
+            np.zeros((self.PIPE_DEPTH, B, 4), np.int32), 0)
+        wctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
+                                live=set(range(R)))
+        self._ctrl_cache = None          # warm ctrl is throwaway
+        devlog, commits, rounds_run, _ = self._window(
+            devlog, sdata, smeta, wctrl, self.PIPE_DEPTH, 1)
+        self._jax.block_until_ready(self._pack_result(commits, rounds_run))
         # Reader paths too (follower drain batch + window gathers,
         # shard_end poll): their first use otherwise compiles
         # mid-drain, stalling a live follower for seconds.
@@ -476,6 +504,73 @@ class DeviceCommitRunner:
         h = self.commit_rounds_async(gen, end0, entries, cid, live)
         return None if h is None else self.resolve_rounds(h)
 
+    def commit_window(self, gen: int, end0: int, entries: list[LogEntry],
+                      cid, live: set[int]) -> Optional[tuple[int, int]]:
+        """The single-window latency path: 1..PIPE_DEPTH rounds in ONE
+        dispatch of the windowed engine with ``halt_on_fail=1`` — the
+        device exits the moment the outcome is decided (all staged
+        votes cleared, or a vote failed and the host must intervene).
+        Returns ``(device_commit, rounds_run)`` or None if ``gen`` is
+        stale.  On a quorum failure ``rounds_run < n`` and the runner's
+        cursor is rewound to the device's true end (entries past the
+        failed round were never written anywhere); the caller must
+        mirror its own cursor from ``rounds_run``.
+
+        Sync by contract (it reads ``rounds_run`` back); the deep/async
+        paths stay on commit_rounds/commit_rounds_async.  Same lock
+        discipline as commit_round: enqueues under the runner lock,
+        blocking waits outside it."""
+        B, W = self.batch, self.PIPE_DEPTH
+        n = len(entries) // B
+        assert 1 <= n <= W and len(entries) == n * B, (len(entries), n, B)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            leader, term = self._leader, self._term
+        slot = self._staging.acquire(W)
+        bd, bm = slot.data, slot.meta
+        for k in range(n):
+            self._encode_batch(entries[k * B:(k + 1) * B], end0 + k * B,
+                               out_data=bd[k], out_meta=bm[k])
+        sdata, smeta = self._place_staged(bd, bm, leader)
+        self._staging.staged(slot, (sdata, smeta))
+        ctrl = self._make_ctrl(cid, leader, term, end0, live)
+        with self.lock:
+            if gen != self.generation or self._devlog is None:
+                return None            # reset raced the staging: discard
+            assert end0 == self._next_end0, (end0, self._next_end0)
+            new_devlog, commits, rounds_run, ctrl2 = self._window(
+                self._devlog, sdata, smeta, ctrl, n, 1)
+            self._devlog = new_devlog
+            if self._ctrl_cache is not None:   # donated masks (see async)
+                self._ctrl_cache = (self._ctrl_cache[0], ctrl2)
+            # Optimistic cursor: early exit only diverges on quorum
+            # failure; corrected below once rounds_run is known (this
+            # runner has a single dispatcher, so no window can slip in
+            # between at the stale cursor).
+            self._next_end0 = end0 + n * B
+            self.stats["window_dispatches"] = \
+                self.stats.get("window_dispatches", 0) + 1
+            self.depth_histogram[n] = self.depth_histogram.get(n, 0) + 1
+        packed = np.asarray(self._pack_result(commits, rounds_run))
+        commits_host, rr = packed[:-1], int(packed[-1])
+        commit_host = int(commits_host[max(rr - 1, 0)])
+        with self.lock:
+            if gen != self.generation:
+                return None
+            self.stats["rounds"] += rr
+            self.stats["entries_devplane"] += rr * B
+            self.stats["quorum_fail_rounds"] += int(sum(
+                int(commits_host[k]) < end0 + (k + 1) * B
+                for k in range(rr)))
+            if rr < n and self._next_end0 == end0 + n * B:
+                # Quorum failed at round rr-1: rounds rr..n-1 never
+                # executed anywhere — rewind the contiguity cursor to
+                # the device's true end.
+                self._next_end0 = end0 + rr * B
+        return commit_host, rr
+
     def commit_rounds_async(self, gen: int, end0: int,
                             entries: list[LogEntry], cid,
                             live: set[int]) -> Optional["_WindowHandle"]:
@@ -491,28 +586,48 @@ class DeviceCommitRunner:
         window N produced, whether or not N has been resolved."""
         B = self.batch
         K = len(entries) // B
-        assert K in self._pipes and len(entries) == K * B, \
+        # Deep rungs ride their per-depth pipelined programs; shallow
+        # depths (<= PIPE_DEPTH) ride the single-window engine with a
+        # runtime round count (halt_on_fail=0 preserves the pipelined
+        # contract: all K rounds always run).
+        use_window = K not in self._pipes
+        assert len(entries) == K * B and \
+            (not use_window or 1 <= K <= self.PIPE_DEPTH), \
             (len(entries), K, B, sorted(self._pipes))
-        pipe = self._pipes[K]
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
             assert end0 == self._next_end0, (end0, self._next_end0)
             leader, term = self._leader, self._term
-        bd = np.zeros((K, B, self.slot_bytes), np.uint8)
-        bm = np.zeros((K, B, 4), np.int32)
+        # Host-side window encoding into a REUSABLE double-buffered
+        # staging pair (ops.logplane.HostStagingRing): packing window
+        # N+1 overlaps the device executing window N; acquire blocks
+        # only on the consumer edge of this pair's previous transfer.
+        slot = self._staging.acquire(self.PIPE_DEPTH if use_window else K)
+        bd, bm = slot.data, slot.meta
         for k in range(K):
             self._encode_batch(entries[k * B:(k + 1) * B], end0 + k * B,
                                out_data=bd[k], out_meta=bm[k])
         sdata, smeta = self._place_staged(bd, bm, leader)
+        self._staging.staged(slot, (sdata, smeta))
         ctrl = self._make_ctrl(cid, leader, term, end0, live)
         del bd, bm
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None            # reset raced the staging: discard
             assert end0 == self._next_end0, (end0, self._next_end0)
-            new_devlog, commits, _ = pipe(self._devlog, sdata,
-                                          smeta, ctrl)
+            if use_window:
+                new_devlog, commits, _rr, ctrl2 = self._window(
+                    self._devlog, sdata, smeta, ctrl, K, 0)
+                # The engine DONATES ctrl (vote-mask buffers alias
+                # input->output): the cached ctrl's masks now live in
+                # ctrl2 — refresh the cache so the next _make_ctrl hit
+                # replaces end0 on live buffers, not donated ones.
+                if self._ctrl_cache is not None:
+                    self._ctrl_cache = (self._ctrl_cache[0], ctrl2)
+            else:
+                new_devlog, commits, _ = self._pipes[K](
+                    self._devlog, sdata, smeta, ctrl)
             self._devlog = new_devlog
             self._next_end0 = end0 + K * B
             self.stats["rounds"] += K
@@ -540,7 +655,9 @@ class DeviceCommitRunner:
             self.stats["quorum_fail_rounds"] += int(sum(
                 int(commits_host[k]) < h.end0 + (k + 1) * B
                 for k in range(h.K)))
-        return int(commits_host[-1])
+        # Index by round count, not -1: the shallow windowed engine
+        # returns a max_depth-padded commits vector.
+        return int(commits_host[h.K - 1])
 
     def _make_ctrl(self, cid, leader: int, term: int, end0: int,
                    live: set[int]):
@@ -936,12 +1053,34 @@ class DevicePlaneDriver:
                 if node.log.commit >= self._dev_next + B:
                     self._gen = None       # re-base next iteration
                 return False
+        # Shallow spans ride the single-window engine (one compiled
+        # program, runtime round count, quorum-fail early exit) on
+        # runners that expose it; the fixed-shape mesh runner and the
+        # deep rungs keep their paths.
+        use_window = (fixed is None
+                      and span_rounds <= self.runner.PIPE_DEPTH
+                      and hasattr(self.runner, "commit_window"))
+        if use_window and span_rounds == 1:
+            # Widen to every clean full batch the backlog holds (the
+            # ladder above only probed the fixed rungs): 2..W rounds
+            # cost the same dispatch as 1.
+            n_max = min((end - self._dev_next) // B,
+                        self.runner.PIPE_DEPTH)
+            for n in range(n_max, 1, -1):
+                span = list(node.log.entries(self._dev_next,
+                                             self._dev_next + n * B))
+                if len(span) == n * B and not any(
+                        wire.entry_wire_size(e) > self.runner.slot_bytes
+                        for e in span):
+                    entries, span_rounds = span, n
+                    break
         gen, end0 = self._gen, self._dev_next
         cid = node.cid
         live = self._live_members(node)
 
         # -- device dispatch outside the daemon lock --
         handle = None
+        win = None
         self.daemon.lock.release()
         try:
             if span_rounds >= self.runner.DEEP_DEPTH \
@@ -952,6 +1091,10 @@ class DevicePlaneDriver:
                 handle = self.runner.commit_rounds_async(
                     gen, end0, entries, cid, live)
                 res = None if handle is None else ()
+            elif use_window:
+                win = self.runner.commit_window(gen, end0, entries, cid,
+                                                live)
+                res = None if win is None else ()
             elif span_rounds > 1:
                 dev_commit = self.runner.commit_rounds(gen, end0, entries,
                                                        cid, live)
@@ -965,6 +1108,19 @@ class DevicePlaneDriver:
         if res is None:                    # stale generation
             self._gen = None
             self._inflight.clear()
+            return True
+        if win is not None:
+            # The engine may have early-exited on a quorum failure:
+            # mirror the runner's rewound cursor from rounds_run.
+            dev_commit, rounds_run = win
+            self._dev_next = end0 + rounds_run * B
+            self.stats["rounds"] += rounds_run
+            if self._stop.is_set() \
+                    or not (node.is_leader and node.current_term == term):
+                self._gen = None
+                self._inflight.clear()
+                return True
+            self._adopt_commit(node, dev_commit)
             return True
         self._dev_next = end0 + span_rounds * B
         self.stats["rounds"] += span_rounds

@@ -159,6 +159,41 @@ _COORD_PREPARE = 1
 _NO_HEARTBEAT = 10 ** 7
 
 
+def _make_runtime_service(addr: str, n: int):
+    """jaxlib's distributed-runtime service across jax versions:
+    >= 0.5 exposes the C API as ``jax._src.lib._jax`` and takes
+    ``heartbeat_timeout``; <= 0.4.x names the module ``xla_extension``
+    and splits the knob into ``heartbeat_interval`` (x a default
+    missing-count).  Either spelling of 10^7 s means the same thing
+    here: never evict on heartbeat."""
+    try:
+        from jax._src.lib import _jax
+        return _jax.get_distributed_runtime_service(
+            addr, n, heartbeat_timeout=_NO_HEARTBEAT, shutdown_timeout=5)
+    except ImportError:
+        from jax._src.lib import xla_extension
+        return xla_extension.get_distributed_runtime_service(
+            addr, n, heartbeat_interval=_NO_HEARTBEAT, shutdown_timeout=5)
+
+
+def _make_runtime_client(coordinator: str, process_id: int,
+                         init_timeout: int):
+    """Client half of :func:`_make_runtime_service` (same version
+    split)."""
+    try:
+        from jax._src.lib import _jax
+        return _jax.get_distributed_runtime_client(
+            coordinator, process_id, init_timeout=init_timeout,
+            heartbeat_timeout=_NO_HEARTBEAT,
+            shutdown_on_destruction=False, use_compression=True)
+    except ImportError:
+        from jax._src.lib import xla_extension
+        return xla_extension.get_distributed_runtime_client(
+            coordinator, process_id, init_timeout=init_timeout,
+            heartbeat_interval=_NO_HEARTBEAT,
+            shutdown_on_destruction=False, use_compression=True)
+
+
 # -- coordinator ------------------------------------------------------------
 
 
@@ -209,7 +244,6 @@ class MeshCoordinator:
         return f"{h}:{p}"
 
     def _prepare(self, epoch: int, n: int) -> Optional[str]:
-        from jax._src.lib import _jax
         with self._lock:
             have = self._epochs.get(epoch)
             if have is not None:
@@ -221,9 +255,7 @@ class MeshCoordinator:
             port = s.getsockname()[1]
             s.close()
             addr = f"{self.host}:{port}"
-            svc = _jax.get_distributed_runtime_service(
-                addr, n, heartbeat_timeout=_NO_HEARTBEAT,
-                shutdown_timeout=5)
+            svc = _make_runtime_service(addr, n)
             self._epochs[epoch] = (svc, n, addr)
             print(f"APUS-MESH-COORDINATOR epoch {epoch} at {addr} for "
                   f"{n} processes", flush=True)
@@ -370,23 +402,32 @@ def init_distributed(coordinator: str, n_processes: int, process_id: int,
         try:
             jax.config.update("jax_platforms", platform)
             if platform == "cpu":
-                jax.config.update("jax_num_cpu_devices", 1)
+                try:
+                    jax.config.update("jax_num_cpu_devices", 1)
+                except AttributeError:
+                    # jax <= 0.4.x has no such option; with the
+                    # device-count flag scrubbed above the CPU backend
+                    # defaults to one local device anyway.
+                    pass
+                try:
+                    # Cross-process CPU collectives must be gloo; on
+                    # jax <= 0.4.x the flag defaults to 'none' and the
+                    # backend refuses multiprocess computations.
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except AttributeError:
+                    pass
         except RuntimeError:
             pass                        # backend already up: caller's bed
     from jax._src import distributed
-    from jax._src.lib import _jax
 
     state = distributed.global_state
     if state.client is not None:
         return                          # already initialized
     if host_service and process_id == 0:
-        state.service = _jax.get_distributed_runtime_service(
-            coordinator, n_processes,
-            heartbeat_timeout=_NO_HEARTBEAT, shutdown_timeout=5)
-    state.client = _jax.get_distributed_runtime_client(
-        coordinator, process_id, init_timeout=init_timeout,
-        heartbeat_timeout=_NO_HEARTBEAT, shutdown_on_destruction=False,
-        use_compression=True)
+        state.service = _make_runtime_service(coordinator, n_processes)
+    state.client = _make_runtime_client(coordinator, process_id,
+                                        init_timeout)
     state.client.connect()
     state.process_id = process_id
     state.num_processes = n_processes
@@ -974,6 +1015,39 @@ class MeshCommitRunner:
         except queue.Empty:
             pass
 
+    def _poison_physical(self, reason: str) -> None:
+        """Election-budget poison, made PHYSICAL.  ``_die`` alone only
+        stops OUR dispatches: the already-dispatched collective keeps
+        executing in backend/gloo threads, so a term-T window fed by
+        every rank could still complete AFTER the vote below and mint
+        a commit through shard acks the election never covered (the
+        Raft log-intersection violation ADVICE r5 flagged).  The
+        reference closes this race physically — poll_vote_requests
+        resets the QPs BEFORE any vote is granted (dare_server.c:1591-
+        1652) — and the collective analog is tearing down this rank's
+        distributed client + backend: every round is an allreduce over
+        ALL clique ranks, so with our gloo transport gone the in-flight
+        window can never complete on ANY rank.  The devlog refs go
+        with the backend, so up to one window of undrained shard rows
+        is lost with the plane — the ≤-one-window slice-loss failure
+        domain ``_die`` already accepts; re-formation rebuilds the
+        plane under the next epoch."""
+        self._die(reason)
+        with self.lock:
+            if self.building:
+                # A newer epoch's build owns the process backend right
+                # now (its _teardown_jax already retired the old
+                # clique's transport); ripping the backend out from
+                # under its init would kill the successor plane.
+                return
+            self._devlog = None
+            self._pipe = None
+        try:
+            teardown_distributed()
+        except Exception:                             # noqa: BLE001
+            pass          # best-effort revocation: the plane is dead
+                          # either way, and re-formation re-inits
+
     def _feed_dead(self, addr, exc) -> None:
         self._die(f"descriptor feed to {addr} failed: {exc!r}")
 
@@ -1229,10 +1303,11 @@ class MeshCommitRunner:
                 if self._quiesce_since is None:
                     self._quiesce_since = now
                 elif now - self._quiesce_since > budget:
-                    self._die("election pending past the "
-                              f"{budget * 1e3:.0f} ms veto budget with "
-                              "unresolved windows: plane poisoned "
-                              "(re-formation will follow)")
+                    self._poison_physical(
+                        "election pending past the "
+                        f"{budget * 1e3:.0f} ms veto budget with "
+                        "unresolved windows: plane poisoned "
+                        "(re-formation will follow)")
                     return True
                 return False
         self._quiesce_since = None
@@ -1402,6 +1477,26 @@ class MeshCommitRunner:
             term = r.u64()
             members = list(r.blob())
             svc_addr = r.blob().decode()
+            # Term gate (ADVICE r5 low): a deposed leader that has not
+            # yet learned of the higher term must not tear down a
+            # healthy plane on every member and rebuild a stale clique
+            # — each such cycle costs the whole clique a rendezvous +
+            # compile.  Epoch ordering authenticates the BUILD; the
+            # daemon's term authenticates the SENDER's right to
+            # initiate one.  (term 0 = bootstrap builds, which carry
+            # no leadership claim.)
+            daemon = self._daemon
+            if term > 0 and daemon is not None:
+                with daemon.lock:
+                    cur = daemon.node.current_term
+                if term < cur:
+                    reason = (f"REFORM term {term} below current "
+                              f"term {cur}: deposed sender")
+                    if self.logger is not None:
+                        self.logger.warning("REFORM epoch %d refused: %s",
+                                            epoch, reason)
+                    return (wire.u8(wire.ST_ERROR)
+                            + wire.blob(reason.encode()))
             err = self.request_reform(epoch, members, svc_addr, term)
             if err is not None:
                 if self.logger is not None:
@@ -1574,8 +1669,13 @@ class MeshReformer:
         self._thread: Optional[threading.Thread] = None
         self._stable_key = None
         self._stable_since = 0.0
+        #: highest epoch the coordinator REFUSED to PREPARE (a crashed
+        #: leader's half-joined service instance of another size sits
+        #: there) — proposals must skip past it or the scan recomputes
+        #: the same refused epoch forever (ADVICE r5 livelock).
+        self._burned_epoch = -1
         self.stats = {"reforms_started": 0, "reforms_ok": 0,
-                      "reforms_failed": 0}
+                      "reforms_failed": 0, "epochs_burned": 0}
 
     def start(self) -> None:
         if not getattr(self.spec, "mesh_reform", True):
@@ -1618,6 +1718,41 @@ class MeshReformer:
                                                     self.daemon.node.cid):
                 return None
         return clique, term
+
+    def _acquire_epoch(self, next_epoch: int,
+                       n: int) -> Optional[tuple[int, str]]:
+        """PREPARE ``next_epoch`` for an ``n``-process clique at the
+        coordinator, treating a REFUSED epoch as burned: a leader that
+        crashed between its own PREPARE(E, n') and the REFORM fan-out
+        leaves a half-joined service instance at E that can never
+        change size, so the coordinator refuses PREPARE(E, n) forever.
+        Pre-fix the scan recomputed the same E every pass and
+        re-formation livelocked (plane stuck TCP-only) until the clique
+        happened to regain size n'; now each refusal records the burned
+        epoch and retries with the next one (bounded per scan).
+        Returns (epoch, service_addr) or None (transport failure, or
+        every attempt refused — the next scan resumes past the burn
+        mark)."""
+        for _ in range(8):
+            try:
+                svc = prepare_epoch(self.spec.mesh_coordinator,
+                                    next_epoch, n)
+                return next_epoch, svc
+            except RuntimeError:
+                # ST_ERROR from the coordinator: refusal, not outage.
+                self._burned_epoch = max(self._burned_epoch, next_epoch)
+                self.stats["epochs_burned"] += 1
+                self.daemon.logger.warning(
+                    "mesh reform: epoch %d burned (half-joined service "
+                    "instance of another size); retrying with %d",
+                    next_epoch, next_epoch + 1)
+                next_epoch += 1
+            except Exception as e:                    # noqa: BLE001
+                self.daemon.logger.warning(
+                    "mesh reform: coordinator PREPARE(%d) failed: %s",
+                    next_epoch, e)
+                return None
+        return None
 
     def _scan(self) -> None:
         from apus_tpu.runtime.client import probe_status
@@ -1678,15 +1813,12 @@ class MeshReformer:
                     clique, self.daemon.node.cid)
             if not coverable:
                 return
-        next_epoch = max(max(last_epochs), runner.min_epoch - 1) + 1
-        try:
-            svc = prepare_epoch(self.spec.mesh_coordinator, next_epoch,
-                                len(clique))
-        except Exception as e:                        # noqa: BLE001
-            self.daemon.logger.warning(
-                "mesh reform: coordinator PREPARE(%d) failed: %s",
-                next_epoch, e)
+        next_epoch = max(max(last_epochs), runner.min_epoch - 1,
+                         self._burned_epoch) + 1
+        acquired = self._acquire_epoch(next_epoch, len(clique))
+        if acquired is None:
             return
+        next_epoch, svc = acquired
         self.daemon.logger.info(
             "mesh reform: epoch %d clique=%s svc=%s", next_epoch,
             clique, svc)

@@ -41,6 +41,18 @@ Env knobs: APUS_BENCH_DEPTHS (comma ladder, default
 APUS_BENCH_BUDGET (total seconds, default 225),
 APUS_BENCH_TPU_TIMEOUT (per-TPU-attempt watchdog, default 60),
 APUS_JAX_CACHE (compilation cache dir, default <repo>/.jax_cache).
+
+--single-window: the UN-AMORTIZED latency mode.  Instead of the depth
+ladder it dispatches the windowed commit engine
+(ops.commit.build_windowed_commit_step — ONE compiled program, runtime
+round count, early exit on the quorum vote) for depth-1 and depth-4
+windows and reports, per depth, the WALL p50 a client-facing request
+would see AND a profiler-derived DEVICE-time figure (jax.profiler
+trace parsing): wall is RTT-dominated on a tunneled chip (the r05
+single_dispatch_round_p50_us of 69 ms was pure dispatch RTT), so
+device time is the number the north star's "p50 commit latency"
+actually names.  Same watchdog/fallback scaffolding as the default
+mode.
 """
 
 from __future__ import annotations
@@ -54,6 +66,10 @@ import time
 import numpy as np
 
 BASELINE_ROUND_US = 15.0        # RDMA commit-round envelope (see docstring)
+#: BENCH_r05.json single_dispatch_round_p50_us — the 69 ms wall one
+#: un-amortized dispatch paid on the tunneled TPU; the --single-window
+#: mode's baseline (ISSUE 1).
+R05_SINGLE_DISPATCH_US = 69374.63
 _T0 = time.monotonic()
 
 
@@ -385,6 +401,241 @@ def _bench() -> None:
          live_async_depth=D_async)
 
 
+def _trace_device_time(trace_dir: str):
+    """Parse a ``jax.profiler`` trace directory into TOTAL on-device
+    busy time in us (plus the signal it came from).
+
+    The profiler drops gzipped Chrome-trace JSON next to the xplane
+    protos, so this needs no tensorboard/tensorflow dependency.  Two
+    signals, best first:
+
+    Both signals are per-thread interval UNIONS of complete events —
+    nested op events must not be double-counted, and gaps between
+    program launches must not be billed as device time:
+
+    - a ``/device:``-named process (TPU/GPU): every thread on that
+      track is device execution;
+    - the CPU backend has no device track: its compute runs on the
+      ``tf_XLATfrtCpuClient`` threadpool threads of the host process,
+      so union over those (NOT ``TfrtCpuExecutable::ExecuteHelper`` —
+      the thunk executor dispatches asynchronously, and the helper
+      span covers only the enqueue on a warm pipeline).
+
+    Returns ``(total_us, n_events, source)`` or ``None`` when no trace
+    was written / neither signal exists (e.g. a tunnel that doesn't
+    forward device profiling) — callers report the miss, never a 0."""
+    import glob
+    import gzip
+
+    events = []
+    for f in glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                       recursive=True):
+        try:
+            with gzip.open(f) as fh:
+                t = json.load(fh)
+        except (OSError, json.JSONDecodeError, EOFError):
+            continue
+        events.extend(t.get("traceEvents", []) if isinstance(t, dict)
+                      else t)
+    if not events:
+        return None
+    pid_names = {e["pid"]: e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tid_names = {(e["pid"], e["tid"]): e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    xs = [e for e in events
+          if e.get("ph") == "X" and "dur" in e and "ts" in e]
+
+    def union_us(evs):
+        by_thread: dict[tuple, list] = {}
+        for e in evs:
+            by_thread.setdefault((e["pid"], e.get("tid")), []).append(
+                (float(e["ts"]), float(e["ts"]) + float(e["dur"])))
+        total = 0.0
+        for ivs in by_thread.values():
+            ivs.sort()
+            cs, ce = ivs[0]
+            for s, t1 in ivs[1:]:
+                if s > ce:
+                    total += ce - cs
+                    cs, ce = s, t1
+                else:
+                    ce = max(ce, t1)
+            total += ce - cs
+        return total
+
+    dev_pids = {p for p, n in pid_names.items() if "/device:" in n}
+    dev = [e for e in xs if e.get("pid") in dev_pids]
+    if dev:
+        return union_us(dev), len(dev), "device-track"
+    cpu_tids = {k for k, n in tid_names.items() if "XLATfrtCpuClient" in n}
+    cpu = [e for e in xs if (e.get("pid"), e.get("tid")) in cpu_tids]
+    if cpu:
+        return union_us(cpu), len(cpu), "xla-cpu-threadpool"
+    return None
+
+
+def _bench_single_window() -> None:
+    """Child process, --single-window mode: depth-1 and depth-4 windows
+    through the windowed commit engine, wall p50 + profiler device
+    time per depth.  Prints a JSON headline after each depth (the
+    parent keeps the LAST line, same salvage contract as the ladder)."""
+    _mark("importing jax")
+    import tempfile
+
+    import jax
+
+    from apus_tpu.utils.jaxenv import respect_cpu_request
+    respect_cpu_request()
+
+    cache = os.environ.get(
+        "APUS_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    if cache:
+        jax.config.update("jax_compilation_cache_dir",
+                          f"{cache}-{jax.default_backend()}")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.ops.commit import (CommitControl,
+                                     build_windowed_commit_step)
+    from apus_tpu.ops.logplane import host_batch_to_device, make_device_log
+    from apus_tpu.ops.mesh import (REPLICA_AXIS, replica_mesh,
+                                   replica_sharding)
+
+    _mark("initializing backend")
+    backend = jax.default_backend()
+    devices = jax.devices()
+    _mark(f"backend={backend} devices={devices}")
+    cpu = backend == "cpu"
+    R, S, SB, B, MD = 5, 4096, 4096, 64, 4    # geometry of the r05 run
+    iters = 30 if cpu else 15
+    prof_iters = 10 if cpu else 5
+    mesh = replica_mesh(R, devices=devices[:1])
+    sh = replica_sharding(mesh)
+    cid = Cid.initial(R)
+
+    # MD distinct redis-SET-shaped staged batches (round i consumes
+    # batch i): the window commits varied payloads, same shape the
+    # ladder headline uses.
+    sd_np = np.zeros((MD, R, B, SB), np.uint8)
+    sm_np = np.zeros((MD, R, B, 4), np.int32)
+    for k in range(MD):
+        batch_reqs = [
+            b"*3\r\n$3\r\nSET\r\n$16\r\nkey:%012d\r\n$64\r\n%s\r\n"
+            % (k * B + i, bytes([97 + (k + i) % 26]) * 64)
+            for i in range(B)]
+        kd, km, _ = host_batch_to_device(batch_reqs, SB, batch_size=B)
+        sd_np[k, 0], sm_np[k, 0] = kd, km
+    ssh = NamedSharding(mesh, P(None, REPLICA_AXIS))
+    sdata = jax.device_put(sd_np, ssh)
+    smeta = jax.device_put(sm_np, ssh)
+    _mark(f"{MD} staged batches placed on device")
+
+    t_c = time.monotonic()
+    step = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD)
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                             sharding=sh)
+    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+    end0 = 1
+    # Compile + one chained warm dispatch (device-resident donated
+    # feedback re-specializes once, same as the ladder).  depth-1 and
+    # depth-4 ride this SAME executable: the round count is a runtime
+    # scalar, so no per-depth compile is timed below.
+    for _ in range(2):
+        devlog, commits, rounds_run, ctrl = step(devlog, sdata, smeta,
+                                                 ctrl, MD, 1)
+        assert int(commits[MD - 1]) == end0 + MD * B
+        end0 += MD * B
+    _mark(f"windowed engine compiled+warm in {time.monotonic() - t_c:.1f}s")
+
+    windows: dict[str, dict] = {}
+    wall1_p50 = None
+    for depth in (1, 4):
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            devlog, commits, rounds_run, ctrl = step(devlog, sdata, smeta,
+                                                     ctrl, depth, 1)
+            # Single-scalar readback: the leader host releases the
+            # client on the window's final commit index — part of the
+            # round, and what keeps the timing honest on an async
+            # tunnel.
+            got = int(commits[depth - 1])
+            walls.append((time.perf_counter_ns() - t0) / 1e3)
+            assert got == end0 + depth * B, (got, end0, depth)
+            end0 += depth * B
+        walls.sort()
+        wall_p50 = walls[len(walls) // 2]
+        # Profiler pass: the device-time figure.  block_until_ready
+        # (not a scalar readback) serializes dispatches here so the
+        # trace holds ONLY the engine's executions — an indexing
+        # readback would add its own tiny executable to the trace and
+        # pollute the per-execution attribution.
+        trace_dir = tempfile.mkdtemp(prefix=f"apus-sw{depth}-")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(prof_iters):
+                devlog, commits, rounds_run, ctrl = step(
+                    devlog, sdata, smeta, ctrl, depth, 1)
+                jax.block_until_ready(commits)
+        end0 += prof_iters * depth * B
+        parsed = _trace_device_time(trace_dir)
+        if parsed is None:
+            dev_us, n_ev, src = None, 0, None
+            _mark(f"depth={depth}: profiler trace had no usable device "
+                  "signal")
+        else:
+            total_us, n_ev, src = parsed
+            dev_us = total_us / prof_iters
+        windows[str(depth)] = {
+            "wall_p50_us": round(wall_p50, 2),
+            "wall_min_us": round(walls[0], 2),
+            "wall_per_round_p50_us": round(wall_p50 / depth, 2),
+            "device_time_per_dispatch_us":
+                None if dev_us is None else round(dev_us, 2),
+            "device_time_per_round_us":
+                None if dev_us is None else round(dev_us / depth, 2),
+            "device_time_source": src,
+            "profiled_dispatches": prof_iters,
+            "profiled_events": n_ev,
+        }
+        dev_txt = "n/a" if dev_us is None else f"{dev_us:.1f}us"
+        _mark(f"depth={depth}: wall p50 {wall_p50:.1f}us, "
+              f"device {dev_txt} [{src}]")
+        if depth == 1:
+            wall1_p50 = wall_p50
+        # r05's single-dispatch figure is the baseline this mode
+        # exists to beat; report the ratio even when the target is
+        # missed (and honestly: cross-backend when this run fell back
+        # to CPU while r05 rode the tunnel).
+        result = {
+            "metric": "single_window_commit_p50_latency_batch64_5rep",
+            "value": round(wall1_p50, 2),
+            "unit": "us",
+            "vs_baseline": round(R05_SINGLE_DISPATCH_US / wall1_p50, 2),
+            "detail": {
+                "backend": backend,
+                "mode": "single_window",
+                "engine": "build_windowed_commit_step",
+                "max_depth": MD,
+                "windows": windows,
+                "r05_single_dispatch_round_p50_us": R05_SINGLE_DISPATCH_US,
+                "r05_backend": "tpu(axon-tunnel)",
+                "speedup_vs_r05_single_dispatch":
+                    round(R05_SINGLE_DISPATCH_US / wall1_p50, 2),
+                "batch": B, "replicas": R, "slot_bytes": SB,
+                "n_slots": S,
+                "baseline_round_us": BASELINE_ROUND_US,
+            },
+        }
+        print(json.dumps(result), flush=True)
+
+
 def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
     """Run the measurement in a watched subprocess; return the parsed
     JSON result or None on failure/timeout (stderr passes through)."""
@@ -482,8 +733,13 @@ def _tpu_probe(timeout_s: float) -> bool:
 
 
 def main() -> None:
+    single_window = "--single-window" in sys.argv[1:] \
+        or os.environ.get("_APUS_BENCH_MODE") == "single_window"
+    if single_window:
+        # Children re-exec this file without argv; the mode rides env.
+        os.environ["_APUS_BENCH_MODE"] = "single_window"
     if os.environ.get("_APUS_BENCH_CHILD"):
-        _bench()
+        (_bench_single_window if single_window else _bench)()
         return
 
     t_start = time.monotonic()
@@ -509,11 +765,16 @@ def main() -> None:
             if result is not None:
                 break
 
+    # Mode-keyed evidence file: a single-window TPU record must not
+    # masquerade as the pipelined-ladder headline (different metric).
+    last_tpu = _LAST_TPU.replace(".json", "_SW.json") if single_window \
+        else _LAST_TPU
+
     if result is not None and result.get("detail", {}).get("backend") \
             not in (None, "cpu", "none"):
         # Record the successful TPU measurement for future fallbacks.
         try:
-            with open(_LAST_TPU, "w") as f:
+            with open(last_tpu, "w") as f:
                 json.dump({"recorded_at_unix": int(time.time()),
                            "code_fingerprint": _code_fingerprint(),
                            "result": result}, f, indent=1)
@@ -532,7 +793,9 @@ def main() -> None:
     if result is None:
         # Degraded but well-formed: never leave the driver with rc!=0.
         result = {
-            "metric": "commit_round_p50_latency_batch64_5rep_pipelined",
+            "metric": "single_window_commit_p50_latency_batch64_5rep"
+                      if single_window else
+                      "commit_round_p50_latency_batch64_5rep_pipelined",
             "value": None,
             "unit": "us",
             "vs_baseline": 0.0,
@@ -541,13 +804,13 @@ def main() -> None:
                        "baseline_round_us": BASELINE_ROUND_US},
         }
     if result.get("detail", {}).get("backend") in ("cpu", "none") \
-            and os.path.exists(_LAST_TPU):
+            and os.path.exists(last_tpu):
         # Supplementary evidence only (clearly timestamped): the fresh
         # headline above remains the CPU measurement — this shows what
         # the same program measured on the real chip when the tunnel
         # was last healthy.
         try:
-            with open(_LAST_TPU) as f:
+            with open(last_tpu) as f:
                 prior = json.load(f)
             if prior.get("code_fingerprint") == _code_fingerprint():
                 result["detail"]["prior_tpu_run"] = prior

@@ -81,10 +81,11 @@ def main() -> int:
         x = np.zeros((n, 64), np.int32)
         x[0] = np.arange(64)
         xd = jax.device_put(x, sh)
-        f = jax.jit(jax.shard_map(
+        from apus_tpu.ops.mesh import shard_map as _shard_map
+        f = jax.jit(_shard_map(
             lambda a: lax.pmax(jnp.max(a, axis=0), REPLICA_AXIS)[None],
-            mesh=mesh, in_specs=P(REPLICA_AXIS), out_specs=P(REPLICA_AXIS),
-            check_vma=False))
+            mesh=mesh, in_specs=P(REPLICA_AXIS),
+            out_specs=P(REPLICA_AXIS)))
         out = np.asarray(f(xd))
         assert (out == np.arange(64)).all(), out[:, :4]
         _mark("PASS", "pmax-broadcast",
@@ -97,11 +98,12 @@ def main() -> int:
     try:
         t = time.monotonic()
         ids = jax.device_put(np.arange(n, dtype=np.int32)[:, None], sh)
-        g = jax.jit(jax.shard_map(
+        from apus_tpu.ops.mesh import shard_map as _shard_map
+        g = jax.jit(_shard_map(
             lambda a: lax.all_gather(a[:, 0], REPLICA_AXIS)
             .reshape(1, -1),
-            mesh=mesh, in_specs=P(REPLICA_AXIS), out_specs=P(REPLICA_AXIS),
-            check_vma=False))
+            mesh=mesh, in_specs=P(REPLICA_AXIS),
+            out_specs=P(REPLICA_AXIS)))
         out = np.asarray(g(ids))
         assert (out == np.arange(n)).all(), out
         _mark("PASS", "all-gather", f"{(time.monotonic() - t) * 1e3:.0f} ms")

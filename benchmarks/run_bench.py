@@ -356,7 +356,30 @@ def main() -> int:
                          "for the replication overhead ratio "
                          "(run.sh:70-80 methodology without the "
                          "LD_PRELOAD line)")
+    ap.add_argument("--single-window", action="store_true",
+                    help="un-amortized single-window latency microbench "
+                         "(bench.py --single-window): depth-1/depth-4 "
+                         "windows through the windowed commit engine, "
+                         "wall p50 + profiler-derived device time; no "
+                         "app cluster is started")
     args = ap.parse_args()
+
+    if args.single_window:
+        # The measurement lives in bench.py (one implementation, one
+        # watchdog); this flag only makes it reachable from the bench
+        # harness entrypoint.  Run it as a child so ITS parent/child
+        # backend probing works unchanged, and pass its JSON lines
+        # through on stdout.
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))), "bench.py"),
+             "--single-window"],
+            stdout=subprocess.PIPE, stderr=sys.stderr)
+        sys.stdout.buffer.write(proc.stdout)
+        sys.stdout.flush()
+        return proc.returncode
 
     value = "x" * args.value_bytes
     app_argv = args.app.split() if args.app else None

@@ -147,6 +147,15 @@ def main() -> int:
             d = (st or {}).get("devplane") or {}
             cur = d.get("commits", 0)
             seen = mesh_seen_commits.get(leader, 0)
+            if cur < seen:
+                # Counter regression: this slot's daemon was killed and
+                # restarted, so its per-daemon commits counter restarted
+                # from 0.  Rebase the per-slot baseline to the fresh
+                # counter before computing the delta — otherwise
+                # cur > seen stays false until the new counter re-passes
+                # the old high-water mark and the inter-kill ledger
+                # undercounts device commits for those intervals.
+                seen = cur
             if cur > seen:
                 mesh_iv_commits += cur - seen
                 mesh_commits += cur - seen

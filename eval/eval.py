@@ -79,10 +79,28 @@ def _run_tool(argv: list[str], timeout: float, env_extra=CPU_ENV):
     return _json_lines(proc.stdout)
 
 
+def _run_single_window(out) -> None:
+    """Single-window (un-amortized) latency: depth-1/depth-4 windows
+    through the windowed commit engine, wall p50 + profiler-derived
+    device time per window (bench.py --single-window; tries the real
+    TPU first under its own watchdog, falls back to CPU)."""
+    print("bench.py --single-window: un-amortized window latency")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--single-window"],
+                         timeout=300, env_extra={}):
+        _record(out, rec, replicas=5, bench="bench_single_window")
+
+
 def cmd_run(args) -> int:
     os.makedirs(RESULTS, exist_ok=True)
     replica_counts = [int(x) for x in args.replicas.split(",")]
     with open(RUNS, "a") as out:
+        if getattr(args, "single_window_only", False):
+            # Fast latency-path re-measure: skip the cluster suite.
+            _run_single_window(out)
+            print(f"results appended to {RUNS}")
+            return 0
         # 1. Proxied app SET/GET + replication across replica counts
         # (run.sh analog; --redis drives the pinned real redis).
         for n in replica_counts:
@@ -226,6 +244,10 @@ def cmd_run(args) -> int:
                               os.path.join(REPO, "bench.py")],
                              timeout=300, env_extra={}):
             _record(out, rec, replicas=5, bench="bench")
+
+        # 3b. The un-amortized single-window counterpart (ISSUE 1
+        # headline: wall p50 + device time for depth-1/depth-4).
+        _run_single_window(out)
     print(f"results appended to {RUNS}")
     return 0
 
@@ -324,6 +346,21 @@ def cmd_report(args) -> int:
             f"{_fmt(last['detail'].get('commits_per_sec'))} commits/sec, "
             f"{_fmt(last['detail'].get('entries_per_sec'))} entries/sec, "
             f"vs_baseline {last.get('vs_baseline')}")
+    sw = [r for r in runs if r.get("bench") == "bench_single_window"
+          and isinstance(r.get("value"), (int, float))]
+    if sw:
+        last = sw[-1]
+        w = last["detail"].get("windows", {})
+        d1, d4 = w.get("1", {}), w.get("4", {})
+        lines.append(
+            f"- single-window commit (un-amortized, depth-1): wall p50 "
+            f"{_fmt(last['value'], 1)} us, device "
+            f"{_fmt(d1.get('device_time_per_dispatch_us'), 1)} us "
+            f"[{last['detail'].get('backend')}]; depth-4: wall p50 "
+            f"{_fmt(d4.get('wall_p50_us'), 1)} us, device "
+            f"{_fmt(d4.get('device_time_per_dispatch_us'), 1)} us; "
+            f"{last['detail'].get('speedup_vs_r05_single_dispatch')}x vs "
+            f"the r05 single-dispatch wall")
     fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
           and isinstance(r.get("value"), (int, float))]
     ser = {}
@@ -459,6 +496,10 @@ def main() -> int:
         p.add_argument("--failover-series", type=int, default=0,
                        help="run a kill/restart failover series of this "
                             "length per group size (p50/p95/p99 rows)")
+        p.add_argument("--single-window-only", action="store_true",
+                       help="run ONLY the single-window latency "
+                            "microbench (fast latency-path re-measure; "
+                            "skips the cluster suite)")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",

@@ -506,3 +506,161 @@ def test_req_log_survives_reprime(tmp_path):
     finally:
         stop.set()
         srv.close()
+
+
+# -- mesh plane: ADVICE r5 findings ----------------------------------------
+# Unit-level regressions (the full mesh cluster needs a working
+# jax.distributed rendezvous, which not every test box has; the gates
+# under test are pure host-side control flow).
+
+def _reformer_with(monkeypatch, prepare):
+    """A MeshReformer wired to a stub daemon/spec and a monkeypatched
+    coordinator PREPARE."""
+    import logging
+    import types
+
+    from apus_tpu.runtime import mesh_plane
+
+    monkeypatch.setattr(mesh_plane, "prepare_epoch", prepare)
+    daemon = types.SimpleNamespace(idx=0,
+                                   logger=logging.getLogger("test-reform"))
+    spec = types.SimpleNamespace(mesh_coordinator="127.0.0.1:0",
+                                 mesh_reform=True)
+    return mesh_plane.MeshReformer(daemon, None, spec)
+
+
+def test_reformer_burned_epoch_retries_next(monkeypatch):
+    """ADVICE r5 (high): a coordinator that refuses PREPARE(E, n) — a
+    crashed leader's half-joined service instance of another size sits
+    at E — must BURN the epoch and retry with E+1, not recompute the
+    same refused epoch forever (re-formation livelock, plane stuck
+    TCP-only)."""
+    calls = []
+
+    def prepare(coord, epoch, n, **kw):
+        calls.append(epoch)
+        if epoch == 7:
+            raise RuntimeError("epoch 7 already prepared for n=2")
+        return "127.0.0.1:9999"
+
+    r = _reformer_with(monkeypatch, prepare)
+    got = r._acquire_epoch(7, 3)
+    assert got == (8, "127.0.0.1:9999")
+    assert calls == [7, 8]
+    assert r._burned_epoch == 7
+    assert r.stats["epochs_burned"] == 1
+    # The next scan's proposal must start past the burn mark even when
+    # every peer still reports the stale epoch (the pre-fix livelock:
+    # max(last_epochs) + 1 == 7 forever).
+    assert max(6, r._burned_epoch) + 1 == 8
+
+
+def test_reformer_all_refused_returns_none(monkeypatch):
+    """Refusals are bounded per scan: every attempt refused -> None,
+    and the burn mark still advances so the NEXT scan resumes past the
+    whole refused range instead of replaying it."""
+    def prepare(coord, epoch, n, **kw):
+        raise RuntimeError("refused")
+
+    r = _reformer_with(monkeypatch, prepare)
+    assert r._acquire_epoch(3, 3) is None
+    assert r._burned_epoch >= 3
+    assert r.stats["epochs_burned"] >= 1
+
+
+def test_reformer_transport_failure_does_not_burn(monkeypatch):
+    """A coordinator OUTAGE (connection error) is not a refusal: the
+    epoch must stay un-burned so the same number is retried once the
+    coordinator returns."""
+    def prepare(coord, epoch, n, **kw):
+        raise ConnectionError("coordinator down")
+
+    r = _reformer_with(monkeypatch, prepare)
+    assert r._acquire_epoch(5, 3) is None
+    assert r._burned_epoch == -1
+    assert r.stats["epochs_burned"] == 0
+
+
+def _reform_descriptor(epoch, term, members=(0, 1, 2),
+                       svc="127.0.0.1:9999"):
+    from apus_tpu.parallel import wire
+    from apus_tpu.runtime.mesh_plane import _SUB_REFORM
+    payload = (wire.u8(_SUB_REFORM) + wire.u64(epoch) + wire.u64(term)
+               + wire.blob(bytes(members)) + wire.blob(svc.encode()))
+    return wire.Reader(payload)
+
+
+def test_reform_descriptor_refuses_stale_term():
+    """ADVICE r5 (low): a deposed leader (term below the receiver's
+    current term) must not be able to churn a healthy plane with
+    REFORM fan-outs; a current-or-newer term passes the gate."""
+    import threading
+    import types
+
+    from apus_tpu.parallel import wire
+    from apus_tpu.runtime.mesh_plane import MeshCommitRunner
+
+    runner = MeshCommitRunner.__new__(MeshCommitRunner)
+    runner.logger = None
+    runner._daemon = types.SimpleNamespace(
+        lock=threading.Lock(),
+        node=types.SimpleNamespace(current_term=9))
+    granted = []
+    runner.request_reform = \
+        lambda epoch, members, svc, term: granted.append(epoch) or None
+
+    resp = runner.on_descriptor(_reform_descriptor(epoch=4, term=5))
+    assert resp[0] == wire.ST_ERROR
+    assert b"deposed" in resp
+    assert granted == []
+
+    resp = runner.on_descriptor(_reform_descriptor(epoch=4, term=9))
+    assert resp[0] == wire.ST_OK
+    assert granted == [4]
+    # term 0 = bootstrap build: carries no leadership claim, passes.
+    resp = runner.on_descriptor(_reform_descriptor(epoch=5, term=0))
+    assert resp[0] == wire.ST_OK
+    assert granted == [4, 5]
+
+
+def test_poison_physical_tears_down_transport(monkeypatch):
+    """ADVICE r5 (high): the election-veto poison must be PHYSICAL —
+    _die alone only stops OUR dispatches while the already-dispatched
+    collective keeps executing in backend threads, so a term-T window
+    could still mint a commit after the vote.  Poison must tear down
+    the gloo transport/distributed client (the revoke-before-vote of
+    dare_server.c) — except while a newer epoch's build owns the
+    process backend."""
+    import threading
+
+    from apus_tpu.runtime import mesh_plane
+
+    torn = []
+    monkeypatch.setattr(mesh_plane, "teardown_distributed",
+                        lambda: torn.append(True))
+    runner = mesh_plane.MeshCommitRunner.__new__(mesh_plane.MeshCommitRunner)
+    runner.lock = threading.Lock()
+    runner.building = False
+    runner._devlog = object()
+    runner._pipe = object()
+    died = []
+    runner._die = lambda reason: died.append(reason)
+
+    runner._poison_physical("veto budget exceeded")
+    assert died == ["veto budget exceeded"]
+    assert torn == [True]
+    assert runner._devlog is None and runner._pipe is None
+
+    # A newer epoch's build owns the process backend: poison must NOT
+    # rip it out from under the successor plane's init.
+    runner2 = mesh_plane.MeshCommitRunner.__new__(
+        mesh_plane.MeshCommitRunner)
+    runner2.lock = threading.Lock()
+    runner2.building = True
+    runner2._devlog = sentinel = object()
+    runner2._pipe = object()
+    runner2._die = lambda reason: None
+    torn.clear()
+    runner2._poison_physical("late poison during rebuild")
+    assert torn == []
+    assert runner2._devlog is sentinel

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apus_tpu.core.cid import Cid
 from apus_tpu.ops.commit import CommitControl, build_commit_step, place_batch
@@ -243,10 +244,10 @@ def test_pipelined_matches_sequential():
     pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=D)
     sdata = jax.device_put(
         np.stack([np.asarray(b[0]) for b in batches]),
-        jax.NamedSharding(mesh, jax.P(None, "replica")))
+        NamedSharding(mesh, P(None, "replica")))
     smeta = jax.device_put(
         np.stack([np.asarray(b[1]) for b in batches]),
-        jax.NamedSharding(mesh, jax.P(None, "replica")))
+        NamedSharding(mesh, P(None, "replica")))
     ctrl0 = CommitControl.from_cid(cid, R, leader=0, term=1, end0=1)
     devlog2, commits, ctrl_out = pipe(devlog2, sdata, smeta, ctrl0)
     assert list(np.asarray(commits)) == seq_commits

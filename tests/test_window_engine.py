@@ -1,0 +1,244 @@
+"""Single-window commit engine tests (ops.commit.build_windowed_commit_step
++ the device_plane staging/commit_window wiring) on the virtual CPU mesh.
+
+The engine is the un-amortized latency path: one compiled program
+carries 1..max_depth commit rounds per dispatch (runtime round count),
+early-exits once the staged rounds' quorum votes have cleared (or the
+moment one fails), and donates BOTH state operands — the devlog (ring +
+``offs`` log-tail + ``fence`` fence-mask) and the CommitControl
+vote-mask arrays — so a steady-state caller loops on device-resident
+buffers.  These tests pin the early-exit semantics, the
+donation-aliased feedback loop against an undonated reference, and the
+double-buffered host staging ring's slot-order guarantee under a slow
+consumer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.ops.commit import (CommitControl, build_commit_step,
+                                 build_pipelined_commit_step,
+                                 build_windowed_commit_step, place_batch)
+from apus_tpu.ops.logplane import (META_IDX, OFF_COMMIT, OFF_END,
+                                   HostStagingRing, host_batch_to_device,
+                                   make_device_log)
+from apus_tpu.ops.mesh import replica_mesh, replica_sharding
+
+R, S, SB, B, MD = 4, 32, 64, 8, 4
+
+
+def _staged(mesh, payload_tag=b"w"):
+    """MD distinct leader-row-only staged batches [MD,R,B,SB]/[MD,R,B,4]."""
+    sd = np.zeros((MD, R, B, SB), np.uint8)
+    sm = np.zeros((MD, R, B, 4), np.int32)
+    for k in range(MD):
+        reqs = [payload_tag + b"%d-%d" % (k, j) for j in range(B - 2)]
+        bd, bm, _ = host_batch_to_device(reqs, SB, batch_size=B)
+        sd[k, 0], sm[k, 0] = bd, bm
+    ssh = NamedSharding(mesh, P(None, "replica"))
+    return jax.device_put(sd, ssh), jax.device_put(sm, ssh)
+
+
+def _fresh(mesh, sh, **kw):
+    return make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                           sharding=sh, **kw)
+
+
+def test_windowed_early_exit_skips_unstaged_rounds():
+    """Quorum clears for every staged round mid-window -> the engine
+    stops at n_rounds: padding capacity is never executed, its ring
+    slots stay untouched, and offsets advance exactly n_rounds*B."""
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    step = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD)
+    sdata, smeta = _staged(mesh)
+    devlog = _fresh(mesh, sh)
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, 0, 1, 1)
+    devlog, commits, rounds_run, ctrl = step(devlog, sdata, smeta, ctrl,
+                                             2, 1)
+    assert int(rounds_run) == 2
+    assert list(np.asarray(commits)) == [1 + B, 1 + 2 * B, 0, 0]
+    assert int(ctrl.end0) == 1 + 2 * B
+    offs = np.asarray(devlog.offs)
+    assert (offs[:, OFF_END] == 1 + 2 * B).all()
+    assert (offs[:, OFF_COMMIT] == 1 + 2 * B).all()
+    meta = np.asarray(devlog.meta)
+    # Rounds 0..1 wrote idx 1..16 into slots 0..15; rounds 2..3 never
+    # ran: their slot spans (16..31) hold the fresh log's zeros.
+    for r in range(R):
+        assert meta[r, 0, META_IDX] == 1
+        assert meta[r, 2 * B - 1, META_IDX] == 2 * B
+        assert (meta[r, 2 * B:S, META_IDX] == 0).all()
+
+
+def test_windowed_early_exit_on_quorum_failure():
+    """A failed vote halts the engine (halt_on_fail=1): later rounds
+    cannot extend commit inside the dispatch, so control returns to
+    the host after ONE round; halt_on_fail=0 reproduces the pipelined
+    run-all-rounds semantics on the identical inputs."""
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    step = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD)
+    sdata, smeta = _staged(mesh)
+
+    def fenced_devlog():
+        devlog = _fresh(mesh, sh)
+        f = np.array(devlog.fence)
+        for r in (1, 2, 3):          # granted to another leader: no quorum
+            f[r] = (2, 5)
+        devlog.fence = jax.device_put(f, sh)
+        return devlog
+
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, 0, 1, 1)
+    devlog, commits, rounds_run, _ = step(fenced_devlog(), sdata, smeta,
+                                          ctrl, MD, 1)
+    assert int(rounds_run) == 1          # decided after the first vote
+    assert list(np.asarray(commits)) == [1, 0, 0, 0]
+    offs = np.asarray(devlog.offs)
+    assert offs[0, OFF_END] == 1 + B     # leader accepted its own write
+    assert (offs[1:, OFF_END] == 1).all()
+    # halt_on_fail=0: all MD rounds run (scan-pipeline semantics).
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, 0, 1, 1)
+    devlog, commits, rounds_run, _ = step(fenced_devlog(), sdata, smeta,
+                                          ctrl, MD, 0)
+    assert int(rounds_run) == MD
+    assert list(np.asarray(commits)) == [1, 1, 1, 1]
+
+
+def test_windowed_matches_pipelined_scan():
+    """Differential: a full-depth windowed dispatch produces the
+    identical ring, offsets, and per-round commits as the lax.scan
+    pipelined step on the same staged inputs."""
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    sdata, smeta = _staged(mesh)
+    win = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD,
+                                     donate=False, donate_ctrl=False)
+    pipe = build_pipelined_commit_step(mesh, R, S, SB, B, depth=MD,
+                                       staged_depth=MD, donate=False)
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, 0, 1, 1)
+    dl_w, commits_w, rounds_run, ctrl_w = win(_fresh(mesh, sh), sdata,
+                                              smeta, ctrl, MD, 0)
+    dl_p, commits_p, ctrl_p = pipe(_fresh(mesh, sh), sdata, smeta, ctrl)
+    assert int(rounds_run) == MD
+    assert list(np.asarray(commits_w)) == list(np.asarray(commits_p))
+    assert int(ctrl_w.end0) == int(ctrl_p.end0)
+    np.testing.assert_array_equal(np.asarray(dl_w.data),
+                                  np.asarray(dl_p.data))
+    np.testing.assert_array_equal(np.asarray(dl_w.meta),
+                                  np.asarray(dl_p.meta))
+    np.testing.assert_array_equal(np.asarray(dl_w.offs),
+                                  np.asarray(dl_p.offs))
+
+
+def test_windowed_donation_feedback_does_not_corrupt_ring():
+    """The donation-aliased steady-state loop (devlog AND ctrl fed
+    straight back, input buffers consumed) yields the identical ring
+    and commit trajectory as an undonated single-round reference; the
+    vote-mask arrays survive the aliasing round over round."""
+    mesh = replica_mesh(R)
+    sh = replica_sharding(mesh)
+    sdata, smeta = _staged(mesh)
+    win = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD,
+                                     donate=True, donate_ctrl=True)
+    cid = Cid.initial(R)
+    devlog = _fresh(mesh, sh)
+    ctrl = CommitControl.from_cid(cid, R, 0, 1, 1)
+    mask_before = list(np.asarray(ctrl.mask_old))
+    windows = 3
+    for _ in range(windows):
+        devlog, commits, rounds_run, ctrl = win(devlog, sdata, smeta,
+                                                ctrl, MD, 1)
+        assert int(rounds_run) == MD
+    assert int(ctrl.end0) == 1 + windows * MD * B
+    assert list(np.asarray(ctrl.mask_old)) == mask_before
+    # Undonated reference: the same 12 rounds through the single step.
+    step = build_commit_step(mesh, R, S, SB, B)
+    ref = _fresh(mesh, sh)
+    sd_host = np.asarray(sdata)
+    sm_host = np.asarray(smeta)
+    end0 = 1
+    for w in range(windows):
+        for k in range(MD):
+            bd, bm = place_batch(mesh, R, 0, sd_host[k, 0], sm_host[k, 0])
+            c = CommitControl.from_cid(cid, R, 0, 1, end0)
+            ref, acks, commit = step(ref, bd, bm, c)
+            assert int(commit) == end0 + B
+            end0 += B
+    np.testing.assert_array_equal(np.asarray(devlog.data),
+                                  np.asarray(ref.data))
+    np.testing.assert_array_equal(np.asarray(devlog.meta),
+                                  np.asarray(ref.meta))
+    np.testing.assert_array_equal(np.asarray(devlog.offs),
+                                  np.asarray(ref.offs))
+
+
+def test_staging_ring_round_robin_and_consumer_edge():
+    """HostStagingRing hands pairs out round-robin, zeroes on reuse,
+    and a pair's bytes reach the device BEFORE the pair is rewritten —
+    so rewriting slot 0 for window N+2 cannot corrupt window N."""
+    ring = HostStagingRing(B, SB, nbuf=2)
+    s0 = ring.acquire(2)
+    s0.data[0, 0, :4] = (1, 2, 3, 4)
+    dev0 = jax.device_put(s0.data.copy())
+    ring.staged(s0, dev0)
+    s1 = ring.acquire(2)
+    assert s1 is not s0                  # double-buffered
+    s1.data[0, 0, :4] = (5, 6, 7, 8)
+    ring.staged(s1, jax.device_put(s1.data.copy()))
+    s2 = ring.acquire(2)                 # wraps to s0: consumer awaited,
+    assert s2 is s0                      # buffer zeroed for reuse
+    assert (s2.data == 0).all() and (s2.meta == 0).all()
+    assert list(np.asarray(dev0)[0, 0, :4]) == [1, 2, 3, 4]
+
+
+def test_async_windows_slow_consumer_preserves_slot_order():
+    """Three deep windows with DISTINCT payloads enqueue back-to-back
+    through the reusable staging ring while the consumer (resolve) is
+    withheld — more windows in flight than staging pairs, so pair 0 is
+    rewritten for window 3 while window 1 may still be executing.  All
+    rows must land in idx order with the payload of THEIR window, on a
+    follower shard (buffer reuse must never leak window N+2's bytes
+    into window N)."""
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+
+    runner = DeviceCommitRunner(n_replicas=3, n_slots=4096, slot_bytes=256,
+                                batch=B)
+    gen = runner.reset(leader=0, term=1, first_idx=1)
+    cid = Cid.initial(3)
+    live = {0, 1, 2}
+    D = runner.DEEP_DEPTH
+
+    def window_at(e0, tag):
+        return [LogEntry(idx=e0 + j, term=1, type=EntryType.CSM,
+                         req_id=j + 1, clt_id=1,
+                         data=b"win%d-%d" % (tag, e0 + j))
+                for j in range(D * B)]
+
+    handles = []
+    e0 = 1
+    for w in range(3):                   # > nbuf staging pairs
+        h = runner.commit_rounds_async(gen, e0, window_at(e0, w), cid,
+                                       live)
+        assert h is not None
+        handles.append((h, e0, w))
+        e0 += D * B
+    # Slow consumer: nothing resolved until every window was staged.
+    for h, we0, w in handles:
+        assert runner.resolve_rounds(h) == we0 + D * B
+    # Every window's rows read back with ITS payload, in idx order.
+    for h, we0, w in handles:
+        lo = we0 + (D // 2) * B          # probe the window's middle
+        rows = runner.read_rows(1, gen, lo, lo + B)
+        assert rows is not None and len(rows) == B
+        for j, e in enumerate(rows):
+            assert e.idx == lo + j
+            assert e.data == b"win%d-%d" % (w, lo + j), (w, lo + j)
