@@ -1,0 +1,666 @@
+"""Live-stack fault plane: deterministic fault injection on the REAL
+transport.
+
+The reference proves fault tolerance physically: QPs are revoked before
+votes (dare_ibv_rc.c:2156-2255) and every reconfiguration scenario is
+benchmarked on live hardware (benchmarks/reconf_bench.sh).  Our
+virtual-time simulator (apus_tpu.parallel.sim.SimTransport) covers the
+consensus core the same way, but the LIVE stack — ``NetTransport``,
+the mesh plane's descriptor channel, the daemon/bridge path — has its
+own failure modes (half-open sockets, dial backoff, busy-peer
+timeouts, feed death) that a memory-access simulator cannot reach.
+
+``FaultPlane`` wraps any live :class:`~apus_tpu.parallel.transport.
+Transport` initiator with seeded, schedule-driven fault injection
+applied ABOVE the socket layer, so every op still exercises the real
+wire codec, the real peer locks, and the real failure-detector
+plumbing underneath:
+
+- per-peer **drop** probability (the WC-error analog: the op never
+  reaches the wire, the caller sees DROPPED/None exactly as it would
+  for a lost datagram);
+- per-peer **delay** (uniform extra latency per op, drawn from the
+  seeded RNG) and **throttle** (fixed pre-op stall — a slow peer whose
+  event loop is starved, not dead);
+- per-peer **duplicate** probability (the op is applied twice at the
+  target; one-sided region ops are idempotent by design and client ops
+  are deduped by the endpoint DB — duplication makes both claims
+  testable on the live wire);
+- per-peer **reorder** probability (the op is HELD until the next op
+  to the same peer completes — the delivery inversion a multi-path
+  fabric produces);
+- **asymmetric partitions**: ``block(peers)`` severs this initiator's
+  OUTBOUND direction only.  A bidirectional partition is composed from
+  both sides' planes (each daemon owns one), which is exactly how real
+  partitions decompose — and lets tests express one-way loss the
+  simulator's pair-blocking cannot.
+- **crash/restart hooks**: ``crash()`` fails every op and fires
+  registered callbacks (tests park a daemon's outbound plane without
+  killing the process — a zombie whose sockets are up but whose ops
+  all die); ``restart()`` clears it.
+
+Determinism: every probabilistic draw comes from one seeded
+``random.Random``; with a fixed seed and a single driving thread the
+fault sequence is bit-identical across runs.  Concurrent callers
+(tick thread + client handlers) still share the seeded stream — the
+per-op draw ORDER then depends on thread interleaving, so campaigns
+that need exact replay drive faults from schedules (below) or
+per-peer knobs rather than global probabilities.
+
+Schedules: a list of timed steps, each ``{"at": seconds, "cmd": ...}``
+relative to :meth:`FaultPlane.arm`, executed by a timer thread.  The
+same JSON shape travels over the wire (OP_FAULT, ``make_fault_ops``)
+so tests can script faults INTO live daemon processes (ProcCluster)
+— the live-stack analog of the simulator's in-process knobs.
+
+Configuration (utils/config.py ``fault_plane``/``fault_seed``/
+``fault_schedule``, or ``APUS_FAULT_*`` environment):
+
+    APUS_FAULT_PLANE=1          enable the wrap (implied by any other
+                                APUS_FAULT_* var)
+    APUS_FAULT_SEED=42          RNG seed
+    APUS_FAULT_DROP=0.05        global drop probability, or per-peer
+                                "1:0.2,*:0.02"
+    APUS_FAULT_DELAY=0.001:0.01 uniform delay range (s); per-peer
+                                "2:0.001:0.01"
+    APUS_FAULT_DUP=0.1          duplicate probability (global/per-peer)
+    APUS_FAULT_REORDER=0.1      reorder probability (global/per-peer)
+    APUS_FAULT_THROTTLE=1:0.05  per-peer fixed pre-op stall (s)
+    APUS_FAULT_PARTITION=1,2    peers blocked outbound from the start
+    APUS_FAULT_SCHEDULE=...     inline JSON schedule, or @/path/to.json
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from apus_tpu.parallel.transport import (LogState, Region, Transport,
+                                         WriteResult)
+
+#: PeerServer extra-op for remote fault scripting (tests -> daemon).
+OP_FAULT = 20
+
+_WILDCARD = -1        # "every peer" key in the per-peer knob tables
+
+
+@dataclasses.dataclass
+class PeerFaults:
+    """Per-peer fault knobs (the ``*`` row holds the defaults)."""
+
+    drop: float = 0.0
+    delay_lo: float = 0.0
+    delay_hi: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    throttle: float = 0.0
+    blocked: bool = False
+
+    def any_active(self) -> bool:
+        return (self.drop > 0 or self.delay_hi > 0 or self.dup > 0
+                or self.reorder > 0 or self.throttle > 0 or self.blocked)
+
+
+class FaultPlane(Transport):
+    """Seeded fault-injecting wrapper around a live ``Transport``.
+
+    All Transport ops delegate to ``inner`` after passing through the
+    fault pipeline; non-op surface (``set_peer``, ``close``, stats,
+    ``peers`` ...) delegates transparently, so a wrapped NetTransport
+    is drop-in for the daemon."""
+
+    #: cap on how long a reorder hold may park an op (a held op must
+    #: never outlive the caller's patience; the next op usually
+    #: releases it far sooner).
+    REORDER_HOLD_S = 0.05
+
+    def __init__(self, inner: Transport, seed: int = 0, logger=None):
+        self.inner = inner
+        self.seed = seed
+        self.logger = logger
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._peer: dict[int, PeerFaults] = {}
+        self._crashed = False
+        self.crash_hooks: list[Callable[[], None]] = []
+        self.restart_hooks: list[Callable[[], None]] = []
+        #: injected-fault counters (observability + test assertions)
+        self.stats = {"drops": 0, "delays": 0, "dups": 0, "reorders": 0,
+                      "blocked": 0, "throttles": 0, "inbound_drops": 0,
+                      "inbound_delays": 0}
+        # reorder holds: peer -> Event released by the next op
+        self._holds: dict[int, threading.Event] = {}
+        self._schedule: list[dict] = []
+        self._sched_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- knob scripting ---------------------------------------------------
+
+    def _state(self, peer: int, create: bool = False) -> PeerFaults:
+        st = self._peer.get(peer)
+        if st is None:
+            if create:
+                st = self._peer.setdefault(peer, PeerFaults())
+            else:
+                st = self._peer.get(_WILDCARD)
+                if st is None:
+                    st = self._peer.setdefault(_WILDCARD, PeerFaults())
+        return st
+
+    @staticmethod
+    def _key(peer) -> int:
+        return _WILDCARD if peer in ("*", None, _WILDCARD) else int(peer)
+
+    def set_drop(self, peer, p: float) -> None:
+        with self._lock:
+            self._state(self._key(peer), create=True).drop = float(p)
+
+    def set_delay(self, peer, lo: float, hi: Optional[float] = None) -> None:
+        with self._lock:
+            st = self._state(self._key(peer), create=True)
+            st.delay_lo = float(lo)
+            st.delay_hi = float(hi if hi is not None else lo)
+
+    def set_dup(self, peer, p: float) -> None:
+        with self._lock:
+            self._state(self._key(peer), create=True).dup = float(p)
+
+    def set_reorder(self, peer, p: float) -> None:
+        with self._lock:
+            self._state(self._key(peer), create=True).reorder = float(p)
+
+    def set_throttle(self, peer, seconds: float) -> None:
+        with self._lock:
+            self._state(self._key(peer), create=True).throttle = \
+                float(seconds)
+
+    def block(self, peers) -> None:
+        """Sever the OUTBOUND direction to ``peers`` (asymmetric
+        partition: the reverse direction is the remote plane's call)."""
+        with self._lock:
+            for p in peers:
+                self._state(self._key(p), create=True).blocked = True
+
+    def unblock(self, peers) -> None:
+        with self._lock:
+            for p in peers:
+                self._state(self._key(p), create=True).blocked = False
+
+    def heal(self) -> None:
+        """Clear EVERY fault (partitions, probabilities, throttles) and
+        any crash — the 'network recovered' step of a schedule."""
+        with self._lock:
+            self._peer.clear()
+            was_crashed, self._crashed = self._crashed, False
+        if was_crashed:
+            for cb in list(self.restart_hooks):
+                cb()
+
+    def crash(self) -> None:
+        """Fail every op from now on and fire crash hooks — the
+        outbound half of a process crash, without killing the process
+        (its PeerServer stays up; inbound behavior is the remote
+        planes' drop knobs or a real kill)."""
+        with self._lock:
+            if self._crashed:
+                return
+            self._crashed = True
+        for cb in list(self.crash_hooks):
+            cb()
+
+    def restart(self) -> None:
+        with self._lock:
+            if not self._crashed:
+                return
+            self._crashed = False
+        for cb in list(self.restart_hooks):
+            cb()
+
+    # -- schedule ---------------------------------------------------------
+
+    def load_schedule(self, schedule: list[dict]) -> None:
+        """Install (but do not start) a timed fault schedule: a list of
+        ``{"at": seconds, "cmd": <name>, ...args}`` steps, sorted by
+        ``at`` relative to :meth:`arm`.  Commands are exactly the wire
+        commands of :func:`apply_command`."""
+        self._schedule = sorted(schedule, key=lambda s: s.get("at", 0.0))
+
+    def arm(self) -> None:
+        """Start executing the loaded schedule on a daemon thread."""
+        if not self._schedule or self._sched_thread is not None:
+            return
+        t = threading.Thread(target=self._run_schedule,
+                             name="apus-faultplane-sched", daemon=True)
+        t.start()
+        self._sched_thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run_schedule(self) -> None:
+        t0 = time.monotonic()
+        for step in self._schedule:
+            delay = step.get("at", 0.0) - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            try:
+                apply_command(self, step)
+            except Exception:                         # noqa: BLE001
+                if self.logger is not None:
+                    self.logger.exception("fault schedule step %r", step)
+
+    # -- the fault pipeline ----------------------------------------------
+
+    def _sleep_yielding(self, seconds: float) -> None:
+        """Sleep with the daemon's node lock RELEASED (when the inner
+        transport carries one): injected latency models the wire, and
+        NetTransport releases the lock while on the wire — an injected
+        delay that held it would stall the whole daemon instead of one
+        op, which is a different fault than the one being modeled."""
+        lock = getattr(self.inner, "yield_lock", None)
+        depth = 0
+        if lock is not None:
+            while lock._is_owned():     # type: ignore[attr-defined]
+                lock.release()
+                depth += 1
+        try:
+            time.sleep(seconds)
+        finally:
+            for _ in range(depth):
+                lock.acquire()          # type: ignore[union-attr]
+
+    def _pre(self, target: int) -> bool:
+        """Run the pre-op stages.  Returns False when the op must be
+        dropped (blocked / crashed / drop draw)."""
+        with self._lock:
+            if self._crashed:
+                self.stats["blocked"] += 1
+                return False
+            st = self._state(target)
+            if st.blocked:
+                self.stats["blocked"] += 1
+                return False
+            throttle = st.throttle
+            delay = (self.rng.uniform(st.delay_lo, st.delay_hi)
+                     if st.delay_hi > 0 else 0.0)
+            dropped = st.drop > 0 and self.rng.random() < st.drop
+            reorder = (not dropped and st.reorder > 0
+                       and self.rng.random() < st.reorder)
+            hold = None
+            release = self._holds.pop(target, None)
+            if reorder:
+                hold = self._holds[target] = threading.Event()
+                self.stats["reorders"] += 1
+        # Sleeps OUTSIDE the lock (concurrent peers must not serialize).
+        if release is not None:
+            release.set()               # we are the "next op": release
+        if throttle > 0:
+            self.stats["throttles"] += 1
+            self._sleep_yielding(throttle)
+        if delay > 0:
+            self.stats["delays"] += 1
+            self._sleep_yielding(delay)
+        if hold is not None:
+            # Park until the NEXT op to this peer passes _pre (which
+            # pops + sets our event), or the cap expires.  Same lock
+            # yield as the sleeps: a held op is an op on the wire.
+            lock = getattr(self.inner, "yield_lock", None)
+            depth = 0
+            if lock is not None:
+                while lock._is_owned():   # type: ignore[attr-defined]
+                    lock.release()
+                    depth += 1
+            try:
+                hold.wait(self.REORDER_HOLD_S)
+            finally:
+                for _ in range(depth):
+                    lock.acquire()        # type: ignore[union-attr]
+            with self._lock:
+                if self._holds.get(target) is hold:
+                    del self._holds[target]
+        if dropped:
+            self.stats["drops"] += 1
+            return False
+        return True
+
+    def _dup_draw(self, target: int) -> bool:
+        with self._lock:
+            st = self._state(target)
+            if st.dup > 0 and self.rng.random() < st.dup:
+                self.stats["dups"] += 1
+                return True
+        return False
+
+    # -- Transport surface -------------------------------------------------
+
+    def peer_established(self, target: int) -> bool:
+        return self.inner.peer_established(target)
+
+    def peer_failure_was_timeout(self, target: int) -> bool:
+        return self.inner.peer_failure_was_timeout(target)
+
+    def ctrl_write(self, target: int, region: Region, slot: int,
+                   value: Any) -> WriteResult:
+        if not self._pre(target):
+            return WriteResult.DROPPED
+        res = self.inner.ctrl_write(target, region, slot, value)
+        if self._dup_draw(target):
+            self.inner.ctrl_write(target, region, slot, value)
+        return res
+
+    def ctrl_read(self, target: int, region: Region, slot: int) -> Any:
+        if not self._pre(target):
+            return None
+        return self.inner.ctrl_read(target, region, slot)
+
+    def log_write(self, target: int, writer_sid, entries, commit):
+        if not self._pre(target):
+            return WriteResult.DROPPED, None
+        res = self.inner.log_write(target, writer_sid, entries, commit)
+        if self._dup_draw(target):
+            self.inner.log_write(target, writer_sid, entries, commit)
+        return res
+
+    def log_read_state(self, target: int) -> Optional[LogState]:
+        if not self._pre(target):
+            return None
+        return self.inner.log_read_state(target)
+
+    def log_set_end(self, target: int, writer_sid,
+                    new_end: int) -> WriteResult:
+        if not self._pre(target):
+            return WriteResult.DROPPED
+        return self.inner.log_set_end(target, writer_sid, new_end)
+
+    def log_bulk_read(self, target: int, start: int, stop: int):
+        if not self._pre(target):
+            return None
+        return self.inner.log_bulk_read(target, start, stop)
+
+    def snap_push(self, target: int, writer_sid, snap, ep_dump,
+                  cid=None, member_addrs=None) -> WriteResult:
+        if not self._pre(target):
+            return WriteResult.DROPPED
+        return self.inner.snap_push(target, writer_sid, snap, ep_dump,
+                                    cid, member_addrs)
+
+    def snap_push_stream(self, target: int, *args, **kwargs):
+        if not self._pre(target):
+            return WriteResult.DROPPED
+        return self.inner.snap_push_stream(target, *args, **kwargs)
+
+    def request(self, target: int, payload: bytes) -> Optional[bytes]:
+        if not self._pre(target):
+            return None
+        resp = self.inner.request(target, payload)
+        if self._dup_draw(target):
+            self.inner.request(target, payload)
+        return resp
+
+    # -- non-op delegation (set_peer, close, peers, stats, ...) -----------
+
+    def __getattr__(self, name: str):
+        # Only reached for attributes not defined on FaultPlane.
+        return getattr(self.inner, name)
+
+    # -- inbound handler wrapping (mesh descriptor channel etc.) ----------
+
+    def wrap_handler(self, tag: str, handler):
+        """Wrap a PeerServer extra-op handler with INBOUND faults,
+        keyed by the wildcard row's drop/delay knobs via the dedicated
+        ``inbound`` peer key (-2).  A dropped inbound message returns
+        ST_ERROR — for the mesh descriptor channel that is a NACK,
+        which kills the sender's feed and deterministically exercises
+        plane degradation + re-formation."""
+        from apus_tpu.parallel import wire
+
+        def wrapped(r):
+            with self._lock:
+                st = self._peer.get(_INBOUND)
+                drop = (st is not None and st.drop > 0
+                        and self.rng.random() < st.drop)
+                delay = (self.rng.uniform(st.delay_lo, st.delay_hi)
+                         if st is not None and st.delay_hi > 0 else 0.0)
+            if delay > 0:
+                self.stats["inbound_delays"] += 1
+                time.sleep(delay)
+            if drop:
+                self.stats["inbound_drops"] += 1
+                if self.logger is not None:
+                    self.logger.warning("faultplane: dropping inbound "
+                                        "%s message", tag)
+                return wire.u8(wire.ST_ERROR)
+            return handler(r)
+
+        return wrapped
+
+    def set_inbound_drop(self, p: float) -> None:
+        with self._lock:
+            st = self._peer.setdefault(_INBOUND, PeerFaults())
+            st.drop = float(p)
+
+    def set_inbound_delay(self, lo: float, hi: Optional[float] = None) \
+            -> None:
+        with self._lock:
+            st = self._peer.setdefault(_INBOUND, PeerFaults())
+            st.delay_lo = float(lo)
+            st.delay_hi = float(hi if hi is not None else lo)
+
+
+_INBOUND = -2         # inbound-handler knob row (wrap_handler)
+
+
+# -- wire scripting (OP_FAULT) ----------------------------------------------
+
+
+def apply_command(plane: FaultPlane, cmd: dict) -> dict:
+    """Apply one scripting command (shared by wire op + schedules).
+    Returns a result dict (counters for ``stats``)."""
+    c = cmd.get("cmd")
+    if c == "drop":
+        plane.set_drop(cmd.get("peer", "*"), cmd["p"])
+    elif c == "delay":
+        plane.set_delay(cmd.get("peer", "*"), cmd["lo"],
+                        cmd.get("hi"))
+    elif c == "dup":
+        plane.set_dup(cmd.get("peer", "*"), cmd["p"])
+    elif c == "reorder":
+        plane.set_reorder(cmd.get("peer", "*"), cmd["p"])
+    elif c == "throttle":
+        plane.set_throttle(cmd.get("peer", "*"), cmd["seconds"])
+    elif c == "block":
+        plane.block(cmd["peers"])
+    elif c == "unblock":
+        plane.unblock(cmd["peers"])
+    elif c == "heal":
+        plane.heal()
+    elif c == "crash":
+        plane.crash()
+    elif c == "restart":
+        plane.restart()
+    elif c == "inbound_drop":
+        plane.set_inbound_drop(cmd["p"])
+    elif c == "inbound_delay":
+        plane.set_inbound_delay(cmd["lo"], cmd.get("hi"))
+    elif c == "stats":
+        pass                            # stats ride every reply
+    else:
+        raise ValueError(f"unknown fault command {c!r}")
+    with plane._lock:
+        return dict(plane.stats)
+
+
+def make_fault_ops(daemon) -> dict:
+    """PeerServer extra op: remote fault scripting against a live
+    daemon (ProcCluster tests compose cluster-wide partitions by
+    scripting each member's plane).  Only registered when the daemon's
+    transport IS a FaultPlane — a production daemon without the wrap
+    exposes nothing."""
+    from apus_tpu.parallel import wire
+
+    def fault_op(r) -> bytes:
+        plane = daemon.transport
+        if not isinstance(plane, FaultPlane):
+            return wire.u8(wire.ST_ERROR)
+        try:
+            cmd = json.loads(r.blob().decode())
+            stats = apply_command(plane, cmd)
+        except (ValueError, KeyError) as e:
+            return wire.u8(wire.ST_ERROR) + wire.blob(repr(e).encode())
+        return wire.u8(wire.ST_OK) + wire.blob(
+            json.dumps(stats).encode())
+
+    return {OP_FAULT: fault_op}
+
+
+def send_fault(addr: str, cmd: dict,
+               timeout: float = 2.0) -> Optional[dict]:
+    """Script one fault command into a live daemon (test-side client of
+    ``make_fault_ops``).  Returns the plane's fault counters, or None
+    if the daemon is unreachable / has no fault plane."""
+    import socket
+
+    from apus_tpu.parallel import wire
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(wire.frame(
+                wire.u8(OP_FAULT) + wire.blob(json.dumps(cmd).encode())))
+            resp = wire.read_frame(s)
+    except (OSError, ConnectionError, ValueError):
+        return None
+    if not resp or resp[0] != wire.ST_OK:
+        return None
+    try:
+        return json.loads(wire.Reader(resp[1:]).blob().decode())
+    except (ValueError, KeyError):
+        return None
+
+
+def isolate(peers: list[str], victim: int,
+            timeout: float = 2.0) -> bool:
+    """Bidirectionally partition ``victim`` from every other member by
+    scripting BOTH directions (victim's outbound + each peer's
+    outbound-to-victim).  Client connections are untouched — exactly
+    the interesting scenario (an isolated leader still reachable by
+    its clients must not ack unreplicatable writes)."""
+    ok = True
+    others = [i for i, a in enumerate(peers) if a and i != victim]
+    ok &= send_fault(peers[victim], {"cmd": "block", "peers": others},
+                     timeout=timeout) is not None
+    for i in others:
+        ok &= send_fault(peers[i], {"cmd": "block", "peers": [victim]},
+                         timeout=timeout) is not None
+    return bool(ok)
+
+
+def heal_all(peers: list[str], timeout: float = 2.0) -> bool:
+    ok = True
+    for a in peers:
+        if a:
+            ok &= send_fault(a, {"cmd": "heal"},
+                             timeout=timeout) is not None
+    return bool(ok)
+
+
+# -- env / config parsing ----------------------------------------------------
+
+
+def _parse_per_peer(s: str, arity: int) -> list[tuple]:
+    """Parse "<peer>:v[,...]" (or bare "v" = wildcard).  ``arity`` is
+    how many numeric fields follow the optional peer key."""
+    out = []
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) == arity:          # no peer key: wildcard
+            out.append(("*", *[float(b) for b in bits]))
+        else:
+            out.append((bits[0], *[float(b) for b in bits[1:]]))
+    return out
+
+
+def config_from_env(env: Optional[dict] = None) -> Optional[dict]:
+    """Collect APUS_FAULT_* settings into a config dict, or None when
+    no fault-plane variable is set."""
+    e = os.environ if env is None else env
+    keys = [k for k in e if k.startswith("APUS_FAULT_")]
+    if not keys:
+        return None
+    cfg: dict = {"seed": int(e.get("APUS_FAULT_SEED", "0") or 0)}
+    if e.get("APUS_FAULT_DROP"):
+        cfg["drop"] = _parse_per_peer(e["APUS_FAULT_DROP"], 1)
+    if e.get("APUS_FAULT_DELAY"):
+        cfg["delay"] = _parse_per_peer(e["APUS_FAULT_DELAY"], 2)
+    if e.get("APUS_FAULT_DUP"):
+        cfg["dup"] = _parse_per_peer(e["APUS_FAULT_DUP"], 1)
+    if e.get("APUS_FAULT_REORDER"):
+        cfg["reorder"] = _parse_per_peer(e["APUS_FAULT_REORDER"], 1)
+    if e.get("APUS_FAULT_THROTTLE"):
+        cfg["throttle"] = _parse_per_peer(e["APUS_FAULT_THROTTLE"], 1)
+    if e.get("APUS_FAULT_PARTITION"):
+        cfg["partition"] = [int(p) for p in
+                            e["APUS_FAULT_PARTITION"].split(",") if p]
+    sched = e.get("APUS_FAULT_SCHEDULE", "")
+    if sched:
+        if sched.startswith("@"):
+            with open(sched[1:]) as f:
+                cfg["schedule"] = json.load(f)
+        else:
+            cfg["schedule"] = json.loads(sched)
+    return cfg
+
+
+def build_plane(inner: Transport, cfg: dict, logger=None) -> FaultPlane:
+    """Construct + configure a FaultPlane from a config dict (the
+    ``config_from_env`` / ClusterSpec shape).  The schedule is loaded
+    but NOT armed — the daemon arms it once it serves."""
+    plane = FaultPlane(inner, seed=int(cfg.get("seed", 0)), logger=logger)
+    for peer, p in cfg.get("drop", []):
+        plane.set_drop(peer, p)
+    for peer, lo, hi in cfg.get("delay", []):
+        plane.set_delay(peer, lo, hi)
+    for peer, p in cfg.get("dup", []):
+        plane.set_dup(peer, p)
+    for peer, p in cfg.get("reorder", []):
+        plane.set_reorder(peer, p)
+    for peer, s in cfg.get("throttle", []):
+        plane.set_throttle(peer, s)
+    if cfg.get("partition"):
+        plane.block(cfg["partition"])
+    if cfg.get("schedule"):
+        plane.load_schedule(cfg["schedule"])
+    return plane
+
+
+def maybe_wrap(inner: Transport, spec=None, logger=None,
+               env: Optional[dict] = None) -> Transport:
+    """The daemon's single integration point: wrap ``inner`` when the
+    fault plane is enabled by spec (``fault_plane=True``) or any
+    APUS_FAULT_* env var; otherwise return ``inner`` untouched (zero
+    overhead for production daemons)."""
+    cfg = config_from_env(env)
+    spec_on = bool(getattr(spec, "fault_plane", False))
+    if cfg is None and not spec_on:
+        return inner
+    if cfg is None:
+        cfg = {}
+    if spec is not None:
+        cfg.setdefault("seed", getattr(spec, "fault_seed", 0))
+        sched = getattr(spec, "fault_schedule", "")
+        if sched and "schedule" not in cfg:
+            if sched.startswith("@"):
+                with open(sched[1:]) as f:
+                    cfg["schedule"] = json.load(f)
+            else:
+                cfg["schedule"] = json.loads(sched)
+    return build_plane(inner, cfg, logger=logger)

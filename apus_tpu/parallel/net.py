@@ -26,6 +26,7 @@ between two daemons writing to each other simultaneously.
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
@@ -243,11 +244,29 @@ class NetTransport(Transport):
 
     def __init__(self, peers: dict[int, tuple[str, int]],
                  timeout: float = 0.2, backoff: float = 0.5,
-                 yield_lock: Optional[threading.RLock] = None):
+                 yield_lock: Optional[threading.RLock] = None,
+                 retries: int = 1):
         self.peers = dict(peers)
         self.timeout = timeout
         self.backoff = backoff
         self.yield_lock = yield_lock
+        #: Bounded in-op retry for CONNECTION faults on an established
+        #: peer (RST mid-exchange, listener restarted): up to
+        #: ``retries`` jittered-backoff redial+resend cycles before the
+        #: op surfaces as DROPPED.  Pre-fix a flaky-but-alive peer was
+        #: timeout-or-nothing: every transient socket error cost a full
+        #: dial-backoff window of DROPPED ops, which the failure
+        #: detector counts — enough flakes and a live peer gets
+        #: evicted.  TIMEOUTS are never retried (the peer is busy, not
+        #: flaky — a retry would double the stall), and a peer with no
+        #: established connection fails fast as before (the background
+        #: dial owns reconnection).  One-sided ops are idempotent by
+        #: design (region writes are last-write-wins, log writes are
+        #: fence+idx checked), so a resend after a lost-reply error is
+        #: safe.
+        self.retries = retries
+        self._retry_rng = random.Random(0x5EED ^ len(peers))
+        self.stats = {"retries": 0, "retries_ok": 0}
         self._conns: dict[int, socket.socket] = {}
         self._down_until: dict[int, float] = {}
         self._peer_locks: dict[int, threading.Lock] = {}
@@ -370,6 +389,20 @@ class NetTransport(Transport):
             with self._dial_lock:
                 self._dialing.discard(target)
 
+    def _dial_inline(self, target: int) -> bool:
+        """Synchronous redial for the in-op retry path (the caller
+        holds the peer lock and wants to resend NOW).  Reuses _dial's
+        install-under-dial-lock protocol; returns True when a fresh
+        connection is installed.  A concurrent background dial for the
+        same target means someone is already on it — don't stack."""
+        with self._dial_lock:
+            if self._closed or target in self._dialing \
+                    or target not in self.peers:
+                return False
+            self._dialing.add(target)
+        self._dial(target)
+        return self._conns.get(target) is not None
+
     def _drop_conn(self, target: int) -> None:
         conn = self._conns.pop(target, None)
         if conn is not None:
@@ -401,53 +434,75 @@ class NetTransport(Transport):
                 depth += 1
         try:
             with self._peer_lock(target):
-                conn = self._connect(target)
-                if conn is None:
-                    # No connection (dial in flight / backoff): leave
-                    # any busy-peer timeout hint in place — a conn
-                    # dropped BECAUSE of a timeout alternates with this
-                    # path while the peer is still busy, and clearing
-                    # here would let every other tick's failure count.
-                    # The hint is cleared by evidence instead: op
-                    # success, an in-op connection error, or a dial
-                    # REFUSED (death) in _dial.
-                    return None
-                try:
-                    conn.settimeout(eff)
-                    conn.sendall(wire.frame(payload))
-                    resp = wire.read_frame(conn)
-                    if resp is None:
-                        raise ConnectionError("peer closed")
-                    self._timeout_hint.pop(target, None)
-                    return resp
-                except TimeoutError:
-                    # Timeout on an ESTABLISHED connection: the peer's
-                    # process holds the socket open but its event loop
-                    # is busy (e.g. a multi-second snapshot install).
-                    # Record the kind so the failure detector can skip
-                    # it (Transport.peer_failure_was_timeout) — the
-                    # reference's WC-error counter never sees a
-                    # busy-but-connected peer, and counting these
-                    # evicted mid-install joiners in an endless
-                    # evict/rejoin livelock (observed in a 30-min soak
-                    # at deep history).
-                    self._timeout_hint[target] = time.monotonic()
-                    self._drop_conn(target)
-                    self._down_until[target] = \
-                        time.monotonic() + self.backoff
-                    return None
-                except (OSError, ConnectionError, ValueError):
-                    self._timeout_hint.pop(target, None)
-                    self._drop_conn(target)
-                    self._down_until[target] = \
-                        time.monotonic() + self.backoff
-                    return None
-                finally:
-                    if timeout is not None:
-                        try:
-                            conn.settimeout(self.timeout)
-                        except OSError:
-                            pass
+                for attempt in range(1 + max(0, self.retries)):
+                    conn = self._connect(target)
+                    if conn is None:
+                        # No connection (dial in flight / backoff):
+                        # leave any busy-peer timeout hint in place — a
+                        # conn dropped BECAUSE of a timeout alternates
+                        # with this path while the peer is still busy,
+                        # and clearing here would let every other
+                        # tick's failure count.  The hint is cleared by
+                        # evidence instead: op success, an in-op
+                        # connection error, or a dial REFUSED (death)
+                        # in _dial.  No retry either — the background
+                        # dial owns reconnection from cold.
+                        return None
+                    try:
+                        conn.settimeout(eff)
+                        conn.sendall(wire.frame(payload))
+                        resp = wire.read_frame(conn)
+                        if resp is None:
+                            raise ConnectionError("peer closed")
+                        self._timeout_hint.pop(target, None)
+                        if attempt > 0:
+                            self.stats["retries_ok"] += 1
+                        return resp
+                    except TimeoutError:
+                        # Timeout on an ESTABLISHED connection: the
+                        # peer's process holds the socket open but its
+                        # event loop is busy (e.g. a multi-second
+                        # snapshot install).  Record the kind so the
+                        # failure detector can skip it (Transport.
+                        # peer_failure_was_timeout) — the reference's
+                        # WC-error counter never sees a busy-but-
+                        # connected peer, and counting these evicted
+                        # mid-install joiners in an endless evict/
+                        # rejoin livelock (observed in a 30-min soak at
+                        # deep history).  Never retried: the peer is
+                        # busy, not flaky, and a resend would double
+                        # the caller's stall.
+                        self._timeout_hint[target] = time.monotonic()
+                        self._drop_conn(target)
+                        self._down_until[target] = \
+                            time.monotonic() + self.backoff
+                        return None
+                    except (OSError, ConnectionError, ValueError):
+                        self._timeout_hint.pop(target, None)
+                        self._drop_conn(target)
+                        if attempt < self.retries and not self._closed:
+                            # Transient connection fault on a peer we
+                            # HAD reached: jittered backoff, then one
+                            # inline redial+resend before giving up —
+                            # bounded (a fraction of one dial backoff),
+                            # and safe because one-sided ops are
+                            # idempotent (module docstring).
+                            self.stats["retries"] += 1
+                            time.sleep(
+                                self._retry_rng.uniform(0.25, 0.75)
+                                * min(self.backoff, 0.05))
+                            if self._dial_inline(target):
+                                continue
+                        self._down_until[target] = \
+                            time.monotonic() + self.backoff
+                        return None
+                    finally:
+                        if timeout is not None:
+                            try:
+                                conn.settimeout(self.timeout)
+                            except OSError:
+                                pass
+                return None
         finally:
             for _ in range(depth):
                 lock.acquire()     # type: ignore[union-attr]

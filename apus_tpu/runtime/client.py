@@ -49,7 +49,7 @@ def make_client_ops(daemon) -> dict:
         with daemon.lock:
             pr = daemon.node.submit(req_id, clt_id, data)
         if pr is None:
-            return _not_leader(daemon)
+            return _not_leader(daemon, req_id)
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
@@ -57,12 +57,13 @@ def make_client_ops(daemon) -> dict:
                 # entry applied) — apply position alone can be satisfied
                 # by a different entry after truncation.
                 if pr.reply is not None:
-                    return wire.u8(wire.ST_OK) + wire.blob(pr.reply)
+                    return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                            + wire.blob(pr.reply))
                 if not daemon.node.is_leader:
-                    return _not_leader(daemon)
+                    return _not_leader(daemon, req_id)
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return wire.u8(ST_TIMEOUT)
+                    return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
                 daemon.commit_cond.wait(min(left, 0.05))
 
     def clt_read(r: wire.Reader) -> bytes:
@@ -71,19 +72,20 @@ def make_client_ops(daemon) -> dict:
         with daemon.lock:
             rr = daemon.node.read(req_id, clt_id, data)
         if rr is None:
-            return _not_leader(daemon)
+            return _not_leader(daemon, req_id)
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
                 if rr.done:
                     if rr.error:
-                        return wire.u8(wire.ST_ERROR)
-                    return wire.u8(wire.ST_OK) + wire.blob(rr.reply or b"")
+                        return wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+                    return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                            + wire.blob(rr.reply or b""))
                 if not daemon.node.is_leader:
-                    return _not_leader(daemon)
+                    return _not_leader(daemon, req_id)
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return wire.u8(ST_TIMEOUT)
+                    return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
                 daemon.commit_cond.wait(min(left, 0.05))
 
     def status(r: wire.Reader) -> bytes:
@@ -245,15 +247,19 @@ def find_leader(peers: list[str], timeout: float = 5.0,
     return None
 
 
-def _not_leader(daemon) -> bytes:
+def _not_leader(daemon, req_id: Optional[int] = None) -> bytes:
     """NOT_LEADER + the leader's address (not its index: the client's
     peer list may be partial or reordered, so an index is meaningless to
-    it).  Empty hint = unknown."""
+    it).  Empty hint = unknown.  Client ops (clt_write/clt_read) echo
+    the request's ``req_id`` after the status byte — the client matches
+    it to pair replies under transport-level duplication/reordering;
+    the JOIN op (no req_id) omits the echo."""
     hint = daemon.leader_hint
     addr = b""
     if hint is not None and hint < len(daemon.spec.peers):
         addr = daemon.spec.peers[hint].encode()
-    return wire.u8(ST_NOT_LEADER) + wire.blob(addr)
+    echo = b"" if req_id is None else wire.u64(req_id)
+    return wire.u8(ST_NOT_LEADER) + echo + wire.blob(addr)
 
 
 class ApusClient:
@@ -269,15 +275,27 @@ class ApusClient:
     """
 
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, attempt_timeout: float = 2.0):
         self.peers = [self._parse(p) for p in peers]
         self.clt_id = clt_id if clt_id is not None else (
             (os.getpid() << 20) ^ threading.get_ident()
             ^ secrets.randbits(63)) & ((1 << 63) - 1)
         self.timeout = timeout
+        #: Per-ATTEMPT wait cap (the overall ``timeout`` still bounds
+        #: the op).  A leader that accepts a write but cannot commit it
+        #: — isolated from its quorum but still reachable by clients —
+        #: holds the connection for the server-side op timeout; without
+        #: a per-attempt cap the client burned its whole budget waiting
+        #: on that one stuck peer instead of failing over.  Safe to cut
+        #: short: the retry reuses the same req_id and the server-side
+        #: dedup (epdb) makes it exactly-once wherever it lands.
+        self.attempt_timeout = attempt_timeout
         self._req_seq = 0
         self._leader: Optional[int] = None
         self._conns: dict[int, socket.socket] = {}
+        #: client-side fault observability (stale_replies = discarded
+        #: duplicated/reordered reply frames)
+        self.stats: dict[str, int] = {}
 
     @staticmethod
     def _parse(addr: str) -> tuple[str, int]:
@@ -334,23 +352,32 @@ class ApusClient:
                 target = self._probe_any(deadline)
                 if target is None:
                     continue
-            resp = self._roundtrip(target, payload, deadline)
+            resp = self._roundtrip(target, payload, deadline, req_id)
             if resp is None:
                 target = self._next(target)
                 continue
             st = resp[0]
+            # Replies echo req_id after the status byte (reply pairing
+            # under duplication/reordering; _roundtrip already matched
+            # it) — the body starts at offset 9.
             if st == wire.ST_OK:
                 self._leader = target
-                return wire.Reader(resp[1:]).blob()
+                return wire.Reader(resp[9:]).blob()
             if st == ST_NOT_LEADER:
-                hint = wire.Reader(resp[1:]).blob().decode() if \
-                    len(resp) > 1 else ""
+                hint = wire.Reader(resp[9:]).blob().decode() if \
+                    len(resp) > 9 else ""
                 target = self._peer_index(hint) if hint \
                     else self._next(target)
                 time.sleep(0.01)
                 continue
             if st == ST_TIMEOUT:
-                continue                  # same req_id: dedup makes it safe
+                # The peer led but could not commit within its window
+                # (quorum loss / partition): ROTATE instead of retrying
+                # the same stuck leader until our own deadline — the
+                # same req_id is exactly-once wherever it lands, and a
+                # healthy majority may be one hop away.
+                target = self._next(target)
+                continue
             raise RuntimeError(f"server error (status {st})")
         raise TimeoutError(f"request {req_id} not served in {self.timeout}s")
 
@@ -391,15 +418,31 @@ class ApusClient:
         except OSError:
             return None
 
-    def _roundtrip(self, target: int, payload: bytes,
-                   deadline: float) -> Optional[bytes]:
+    def _roundtrip(self, target: int, payload: bytes, deadline: float,
+                   req_id: int) -> Optional[bytes]:
+        """One request/response exchange, paired by the reply's echoed
+        req_id: frames whose echo doesn't match are STALE — duplicated
+        or reordered replies to an earlier request on this (reused)
+        connection — and are discarded, not misread as this request's
+        answer.  Pre-fix a duplicated reply desynchronized the
+        connection's request/reply pairing for every later op."""
         conn = self._connect(target, deadline)
         if conn is None:
             return None
         try:
-            conn.settimeout(max(0.05, deadline - time.monotonic()))
+            conn.settimeout(max(0.05, min(deadline - time.monotonic(),
+                                          self.attempt_timeout)))
             conn.sendall(wire.frame(payload))
-            return wire.read_frame(conn)
+            while True:
+                resp = wire.read_frame(conn)
+                if resp is None:
+                    raise ConnectionError("peer closed")
+                if len(resp) >= 9 and \
+                        wire.Reader(resp[1:9]).u64() != req_id:
+                    self.stats["stale_replies"] = \
+                        self.stats.get("stale_replies", 0) + 1
+                    continue
+                return resp
         except (OSError, ConnectionError, ValueError):
             self._drop(target)
             return None

@@ -75,6 +75,12 @@ class ReplicaDaemon:
         self.transport = NetTransport(
             peers, yield_lock=self.lock,
             backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)))
+        # Live-stack fault plane (parallel.faults): only wraps when the
+        # spec or APUS_FAULT_* env enables it — a production daemon's
+        # transport is untouched.
+        from apus_tpu.parallel.faults import maybe_wrap
+        self.transport = maybe_wrap(self.transport, spec=spec,
+                                    logger=self.logger)
         cfg = NodeConfig(
             idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
@@ -151,9 +157,16 @@ class ReplicaDaemon:
             if hasattr(device_runner, "attach"):
                 device_runner.attach(self)
             if hasattr(device_runner, "on_descriptor"):
+                from apus_tpu.parallel.faults import FaultPlane
                 from apus_tpu.runtime.mesh_plane import OP_MESH
-                self.server._extra_ops[OP_MESH] = \
-                    device_runner.on_descriptor
+                handler = device_runner.on_descriptor
+                if isinstance(self.transport, FaultPlane):
+                    # Mesh descriptor channel rides the fault plane
+                    # too: a dropped descriptor NACKs the leader's
+                    # feed, deterministically exercising plane
+                    # degradation + re-formation.
+                    handler = self.transport.wrap_handler("mesh", handler)
+                self.server._extra_ops[OP_MESH] = handler
             self.device_driver = DevicePlaneDriver(self, device_runner)
 
         self._stop = threading.Event()
@@ -170,9 +183,15 @@ class ReplicaDaemon:
     client_op_timeout: float = 5.0
 
     def _extra_ops(self) -> dict:
+        from apus_tpu.parallel.faults import FaultPlane, make_fault_ops
         from apus_tpu.runtime.client import make_client_ops
         from apus_tpu.runtime.membership import make_membership_ops
-        return {**make_client_ops(self), **make_membership_ops(self)}
+        ops = {**make_client_ops(self), **make_membership_ops(self)}
+        if isinstance(self.transport, FaultPlane):
+            # Remote fault scripting: tests compose cluster-wide
+            # partitions by scripting each member's plane over the wire.
+            ops.update(make_fault_ops(self))
+        return ops
 
     # -- lifecycle --------------------------------------------------------
 
@@ -188,6 +207,10 @@ class ReplicaDaemon:
         self._excl_thread = w
         if self.device_driver is not None:
             self.device_driver.start()
+        # Arm any loaded fault schedule now that the daemon serves —
+        # schedule time 0 is "daemon up", not "object constructed".
+        if hasattr(self.transport, "arm"):
+            self.transport.arm()
         self.logger.info("daemon %d up at %s", self.idx, self.server.addr)
 
     def stop(self) -> None:
@@ -201,6 +224,8 @@ class ReplicaDaemon:
         if self._excl_thread is not None:
             self._excl_thread.join(timeout=2.0)
         self.server.stop()
+        if hasattr(self.transport, "stop"):
+            self.transport.stop()       # fault-plane schedule thread
         self.transport.close()
         if self.persistence is not None:
             self.persistence.close()

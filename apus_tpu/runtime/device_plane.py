@@ -553,7 +553,11 @@ class DeviceCommitRunner:
             self.stats["window_dispatches"] = \
                 self.stats.get("window_dispatches", 0) + 1
             self.depth_histogram[n] = self.depth_histogram.get(n, 0) + 1
+        t0 = time.monotonic()
         packed = np.asarray(self._pack_result(commits, rounds_run))
+        self.stats["max_dispatch_ms"] = max(
+            self.stats.get("max_dispatch_ms", 0.0),
+            (time.monotonic() - t0) * 1e3)
         commits_host, rr = packed[:-1], int(packed[-1])
         commit_host = int(commits_host[max(rr - 1, 0)])
         with self.lock:
@@ -645,7 +649,11 @@ class DeviceCommitRunner:
         has been reset since the window was enqueued — its device
         result was computed against a generation whose quorum attests
         the caller must no longer act on."""
+        t0 = time.monotonic()
         commits_host = np.asarray(h.commits)        # device->host wait
+        self.stats["max_dispatch_ms"] = max(
+            self.stats.get("max_dispatch_ms", 0.0),
+            (time.monotonic() - t0) * 1e3)
         B = self.batch
         with self.lock:
             if h.gen != self.generation:
@@ -814,6 +822,16 @@ class DevicePlaneDriver:
         # ownership may not be re-armed until this deadline passes AND
         # the cursor has caught up (prevents a 0.5 s own/stall flap).
         self._cooldown_until = 0.0
+        # Quorum-fail timeout (partial-partition hardening): when
+        # dispatched windows keep missing quorum — the live mask was
+        # stale, or peers ack on TCP but their shard acks stopped —
+        # the streak is bounded by the watchdog window; past it the
+        # host path takes commit back and dispatch PAUSES instead of
+        # hot-looping guaranteed-failing windows (each one burns a
+        # device dispatch and rewinds the cursor it just advanced).
+        self._qfail_since: Optional[float] = None
+        self._qfail_pause_until = 0.0
+        self._gate_since: Optional[float] = None
         self.stats = {"rounds": 0, "drained": 0, "holes": 0,
                       "fallbacks": 0}
 
@@ -855,6 +873,15 @@ class DevicePlaneDriver:
         if not (node.is_leader and node.external_commit):
             return
         window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+        # Scale to OBSERVED dispatch latency: on an oversubscribed host
+        # a healthy dispatch can exceed the static floor, and flipping
+        # ownership on every slow-but-completing window just flaps
+        # commit between the paths.  A genuinely wedged dispatch never
+        # updates max_dispatch_ms, so the real stall case still trips
+        # at the static window.
+        md_ms = self.runner.stats.get("max_dispatch_ms")
+        if md_ms:
+            window = max(window, 2.5 * md_ms / 1e3)
         if node.log.end > node.log.commit and \
                 time.monotonic() - self._last_commit_advance > window:
             node.external_commit = False
@@ -959,9 +986,54 @@ class DevicePlaneDriver:
                 and time.monotonic() >= self._cooldown_until \
                 and self._dev_next >= node.log.commit:
             node.external_commit = True
-            self._last_commit_advance = time.monotonic()
+            # Future-stamp by one watchdog window: freshly-armed
+            # ownership gets a doubled first stall check — the first
+            # window after arming legitimately covers staging + the
+            # first dispatch on a loaded host, and tripping there just
+            # flaps ownership straight back off.
+            self._last_commit_advance = time.monotonic() + \
+                max(4 * self.daemon.spec.hb_timeout, 0.5)
             self.logger.info("device plane owns commit from idx %d",
                              self._dev_base)
+
+        # Partial-partition gate: the quorum vote is masked to members
+        # whose control-plane writes were recently observed (safety
+        # argument 3), so a window dispatched while the live mask
+        # cannot cover quorum is a GUARANTEED quorum-fail round.  An
+        # injected partial partition (FaultPlane blocking peers) used
+        # to hot-loop exactly that: dispatch, fail, rewind, redispatch
+        # — device churn with zero progress.  Gate dispatch instead:
+        # drain the pipeline, hand commit to the host path, and wait
+        # for the failure detector to see the peers again.
+        live_now = self._live_members(node)
+        if not self._live_covers_quorum(node.cid, live_now):
+            if self._inflight:
+                return self._resolve_oldest(node, term)
+            self.stats["quorum_gated"] = \
+                self.stats.get("quorum_gated", 0) + 1
+            now = time.monotonic()
+            window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+            if self._gate_since is None:
+                # Brief shortfalls are scheduler noise (a starved
+                # follower's REP_ACK a few ms late), not partitions:
+                # skip THIS dispatch but keep commit ownership until
+                # the shortfall persists a full watchdog window.
+                self._gate_since = now
+            elif now - self._gate_since > window and \
+                    node.external_commit:
+                node.external_commit = False
+                self._cooldown_until = now + window
+                self.stats["fallbacks"] += 1
+                self.logger.warning(
+                    "device plane: live members %s below quorum of %r; "
+                    "host commit path re-enabled", sorted(live_now),
+                    node.cid)
+            return False
+        self._gate_since = None
+        # Quorum-fail pause (see __init__): bounded stand-down after a
+        # sustained streak of quorum-failing windows.
+        if time.monotonic() < self._qfail_pause_until:
+            return False
 
         # A fixed-shape runner (runtime.mesh_plane) dispatches ONE window
         # shape only — the dispatch unit is FIXED_WINDOW batches, and
@@ -1076,7 +1148,7 @@ class DevicePlaneDriver:
                     break
         gen, end0 = self._gen, self._dev_next
         cid = node.cid
-        live = self._live_members(node)
+        live = live_now
 
         # -- device dispatch outside the daemon lock --
         handle = None
@@ -1121,6 +1193,7 @@ class DevicePlaneDriver:
                 self._inflight.clear()
                 return True
             self._adopt_commit(node, dev_commit)
+            self._note_quorum_result(node, dev_commit > end0)
             return True
         self._dev_next = end0 + span_rounds * B
         self.stats["rounds"] += span_rounds
@@ -1139,6 +1212,7 @@ class DevicePlaneDriver:
             self._inflight.clear()
             return True
         self._adopt_commit(node, dev_commit)
+        self._note_quorum_result(node, dev_commit > end0)
         return True
 
     def _resolve_oldest(self, node, term: int) -> bool:
@@ -1203,7 +1277,9 @@ class DevicePlaneDriver:
         self._dev_base = base
         self._dev_next = base
         self._last_end_seen = 0
-        self._last_commit_advance = time.monotonic()
+        # Same doubled first-check grace as the re-arm path.
+        self._last_commit_advance = time.monotonic() + \
+            max(4 * self.daemon.spec.hb_timeout, 0.5)
         # Host ack quorum owns commit until it has covered the prefix
         # below the device base; under load that may already be true by
         # the time the shards are rebuilt — take over immediately then,
@@ -1214,10 +1290,58 @@ class DevicePlaneDriver:
             self.logger.info("device plane owns commit from idx %d", base)
         return True
 
+    def _live_covers_quorum(self, cid, live: set[int]) -> bool:
+        """Whether the live-mask can still clear the device quorum vote
+        for ``cid`` (thresholds stay full-configuration sizes — masking
+        shrinks only the numerator, safety argument 3)."""
+        from apus_tpu.core.cid import CidState
+        old = sum(1 for m in live if cid.contains(m) and m < cid.size)
+        if old < quorum_size(cid.size):
+            return False
+        if cid.state == CidState.TRANSIT:
+            new = sum(1 for m in live
+                      if cid.contains(m) and m < cid.new_size)
+            if new < quorum_size(cid.new_size):
+                return False
+        return True
+
+    def _note_quorum_result(self, node, advanced: bool) -> None:
+        """Track the quorum-fail streak across dispatched windows
+        (called under the daemon lock with the result of each resolved
+        window).  A streak longer than the watchdog window trips the
+        quorum-fail timeout: commit back to the host path, dispatch
+        paused for one window — the cursor was already rewound by the
+        engine, so the span redispatches cleanly after the pause."""
+        if advanced:
+            self._qfail_since = None
+            return
+        now = time.monotonic()
+        if self._qfail_since is None:
+            self._qfail_since = now
+            return
+        window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+        if now - self._qfail_since > window:
+            self._qfail_since = None
+            self._qfail_pause_until = now + window
+            if node.external_commit:
+                node.external_commit = False
+                self.stats["fallbacks"] += 1
+            self._cooldown_until = max(self._cooldown_until, now + window)
+            self.stats["qfail_timeouts"] = \
+                self.stats.get("qfail_timeouts", 0) + 1
+            self.logger.warning(
+                "device plane: quorum-fail streak past %.2f s; host "
+                "commit path re-enabled, dispatch paused", window)
+
     def _live_members(self, node) -> set[int]:
         """Members whose control-plane writes were recently observed
-        (plus ourselves).  Window = the failure-detector timeout."""
-        window = max(node._hb_timeout, 4 * self.daemon.spec.hb_period)
+        (plus ourselves).  Window = the failure-detector timeout, with
+        a 0.25 s floor: the reference trusts RDMA acks until retry
+        exhaustion (~seconds), and a tighter floor makes in-process
+        clusters (one GIL, follower ticks starved for hundreds of ms
+        by a sibling's dispatch) flap the mask on scheduler noise."""
+        window = max(node._hb_timeout, 4 * self.daemon.spec.hb_period,
+                     0.25)
         now = time.monotonic()
         live = {node.idx}
         touched = node.regions.touched

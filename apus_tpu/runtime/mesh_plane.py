@@ -460,6 +460,19 @@ def teardown_distributed() -> None:
             pass
         del client
     xla_bridge._clear_backends()
+    # _clear_backends drops the backend but NOT every topology cache:
+    # process_count/local_devices are @lru_cache'd (jax 0.4.x) and keep
+    # answering with the OLD clique's geometry.  A shrunk-clique
+    # rebuild then dies inside device_put's multihost assert_equal
+    # ("cannot reshape array of size R' into (R, 1)") — every epoch
+    # fails identically in ~300 ms and the reformer burns epochs until
+    # the test budget expires (the 2 residual tier-1 failures).
+    for mod in (jax, xla_bridge):
+        for name in ("process_count", "local_devices", "device_count",
+                     "process_index"):
+            fn = getattr(mod, name, None)
+            if fn is not None and hasattr(fn, "cache_clear"):
+                fn.cache_clear()
 
 
 # -- wire payloads ----------------------------------------------------------
@@ -769,7 +782,9 @@ class MeshCommitRunner:
             self._pre_reform_grace(epoch)
             if self.on_epoch_join is not None:
                 self.on_epoch_join(epoch)
+            self._log_build(epoch, "teardown")
             self._teardown_jax()
+            self._log_build(epoch, "init")
 
             import jax
             # Rendezvous budget well under mesh_build_timeout: members
@@ -778,15 +793,45 @@ class MeshCommitRunner:
             # and the epoch is burned; failing FAST frees this member
             # for the next attempt (compile time is paid after
             # connect and is not under this budget).
+            # Rendezvous budget scaled to OVERSUBSCRIPTION: on a box
+            # with fewer cores than clique members the teardown +
+            # re-init + compile of every member serializes on the same
+            # CPUs, so the 1/6th-of-build-timeout floor that is ample
+            # on a real pod starves a 1-core CI host into init_timeout
+            # churn (each miss burns an epoch).
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = os.cpu_count() or 1
+            over = max(1, -(-len(members) // max(1, cores)))  # ceil
+            init_timeout = min(
+                int(self.spec.mesh_build_timeout),
+                max(15, int(self.spec.mesh_build_timeout) // 6) * over)
             init_distributed(
                 svc_addr, len(members), members.index(self.idx),
                 platform=self.spec.mesh_platform,
-                init_timeout=max(15,
-                                 int(self.spec.mesh_build_timeout) // 6))
-            from jax.sharding import NamedSharding, PartitionSpec as P
+                init_timeout=init_timeout)
+            self._log_build(epoch, "warmup")
+            # Import under retry: CPython's import machinery has a rare
+            # concurrent-import race (KeyError('apus_tpu.ops') out of
+            # _find_and_load_unlocked) when another daemon thread is
+            # mid-import of the same package — observed killing an
+            # epoch-0 build on a loaded 1-core box.  One short retry
+            # heals it (the other thread's import completes).
+            for _attempt in (0, 1, 2):
+                try:
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
 
-            from apus_tpu.ops.commit import build_pipelined_commit_step
-            from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+                    from apus_tpu.ops.commit import \
+                        build_pipelined_commit_step
+                    from apus_tpu.ops.mesh import (REPLICA_AXIS,
+                                                   replica_mesh)
+                    break
+                except KeyError:
+                    if _attempt == 2:
+                        raise
+                    time.sleep(0.1)
 
             R = len(members)
             devices = jax.devices()
@@ -867,6 +912,14 @@ class MeshCommitRunner:
                 self.logger.exception("mesh build epoch %d failed", epoch)
             self._die(f"mesh build epoch {epoch} failed: {e!r}")
 
+    def _log_build(self, epoch: int, phase: str) -> None:
+        """Build-phase breadcrumbs: a stuck rebuild (wedged collective
+        holding the old backend) is diagnosable only by which phase the
+        thread never left."""
+        if self.logger is not None:
+            self.logger.info("mesh build epoch %d: phase=%s", epoch,
+                             phase)
+
     def _pre_reform_grace(self, epoch: int) -> None:
         """Retire a live plane before teardown: mark it dead (stops
         dispatches, keeps shards readable) and give the driver's drain
@@ -881,10 +934,32 @@ class MeshCommitRunner:
                 was_alive = True
         if was_alive:
             self._die(f"superseded by re-formation epoch {epoch}")
+        # The drain probe reads our shard — a DEVICE SYNC that parks on
+        # the producing round.  When the plane died mid-round with the
+        # collective WEDGED (feed death with every process alive — no
+        # RST to error it out), that sync blocks for gloo's timeout
+        # (~60 s), and a build thread stuck here enters the epoch
+        # rendezvous a minute after its peers, whose init_timeout then
+        # expires: every epoch burns from the skew alone.  Probe from a
+        # side thread with a hard answer deadline instead — an
+        # unanswered probe means the shard is wedged, and wedged rows
+        # are lost with the plane anyway (the ≤-one-window slice-loss
+        # failure domain _die accepts).
         deadline = time.monotonic() + 3.0
         while time.monotonic() < deadline and not self._stop.is_set():
-            if not self._own_drain_pending():
-                return
+            answer: list = []
+
+            def _probe():
+                try:
+                    answer.append(self._own_drain_pending())
+                except Exception:                     # noqa: BLE001
+                    answer.append(False)
+
+            t = threading.Thread(target=_probe, daemon=True)
+            t.start()
+            t.join(timeout=0.75)
+            if not answer or not answer[0]:
+                return                  # drained, failed, or wedged
             time.sleep(0.05)
 
     def _own_drain_pending(self) -> bool:
@@ -1674,6 +1749,15 @@ class MeshReformer:
         #: there) — proposals must skip past it or the scan recomputes
         #: the same refused epoch forever (ADVICE r5 livelock).
         self._burned_epoch = -1
+        #: Adaptive retry backoff: consecutive FAILED re-formations
+        #: double the pause before the next attempt (capped below).  A
+        #: fixed 0.25 s scan cadence burned one epoch every ~2.5 s when
+        #: builds failed deterministically — on a starved 1-core box
+        #: the storm of teardown+re-init cycles itself kept the builds
+        #: failing (the 2 residual tier-1 failures rode this).  Success
+        #: resets the backoff.
+        self._consec_failures = 0
+        self._backoff_until = 0.0
         self.stats = {"reforms_started": 0, "reforms_ok": 0,
                       "reforms_failed": 0, "epochs_burned": 0}
 
@@ -1754,9 +1838,23 @@ class MeshReformer:
                 return None
         return None
 
+    def _fail_backoff(self) -> None:
+        """Record a failed attempt and schedule the next one with
+        exponential backoff (base = the stability window, capped)."""
+        self.stats["reforms_failed"] += 1
+        self._consec_failures += 1
+        base = getattr(self.spec, "mesh_reform_stable", 2.0)
+        pause = min(30.0, base * (2 ** min(self._consec_failures, 6)))
+        self._backoff_until = time.monotonic() + pause
+        self.daemon.logger.warning(
+            "mesh reform: attempt %d failed; backing off %.1f s",
+            self._consec_failures, pause)
+
     def _scan(self) -> None:
         from apus_tpu.runtime.client import probe_status
         runner = self.runner
+        if time.monotonic() < self._backoff_until:
+            return
         tc = self._target_clique()
         if tc is None:
             self._stable_key = None
@@ -1841,7 +1939,7 @@ class MeshReformer:
         if local_err is not None:
             # Without a local build there is no outcome to await —
             # re-evaluate on the next scan instead of idling here.
-            self.stats["reforms_failed"] += 1
+            self._fail_backoff()
             self._stable_key = None
             return
         # Await OUR build outcome (bounded); member readiness is
@@ -1851,6 +1949,8 @@ class MeshReformer:
             if runner.ready and not runner.dead \
                     and runner.epoch == next_epoch:
                 self.stats["reforms_ok"] += 1
+                self._consec_failures = 0
+                self._backoff_until = 0.0
                 self.daemon.logger.info(
                     "mesh reform: epoch %d LIVE (clique %s)",
                     next_epoch, clique)
@@ -1859,7 +1959,7 @@ class MeshReformer:
                     and runner.epoch != next_epoch:
                 break                   # build failed; epoch burned
             self._stop.wait(0.25)
-        self.stats["reforms_failed"] += 1
+        self._fail_backoff()
         self._stable_key = None         # restart the stability window
 
 

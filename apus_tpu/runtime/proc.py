@@ -78,13 +78,21 @@ class ProcCluster:
                  tick_interval: Optional[float] = None,
                  device_plane: bool = False,
                  mesh_depth: int = 4,
-                 follower_reads: Optional[bool] = None):
+                 follower_reads: Optional[bool] = None,
+                 fault_plane: bool = False,
+                 fault_seed: int = 0):
         self.n = n
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
         os.makedirs(self.workdir, exist_ok=True)
         base = dataclasses.replace(spec or PROC_SPEC)
         if follower_reads is not None:
             base.follower_reads = follower_reads
+        if fault_plane:
+            # Live-stack fault plane on every daemon (parallel.faults):
+            # tests script drops/partitions into the running processes
+            # over the wire (faults.send_fault / isolate / heal_all).
+            base.fault_plane = True
+            base.fault_seed = fault_seed
         base.group_size = n
         base.peers = [f"127.0.0.1:{_free_port()}" for _ in range(n)]
         if device_plane:

@@ -90,6 +90,16 @@ class ClusterSpec:
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False
+    # Live-stack fault plane (apus_tpu.parallel.faults): wrap every
+    # daemon's transport with seeded, schedule-driven fault injection
+    # (drop/delay/duplicate/reorder, asymmetric partitions, throttles,
+    # crash hooks).  Off by default — a production daemon pays zero
+    # overhead.  fault_schedule is inline JSON or "@/path/to.json";
+    # APUS_FAULT_* env vars override/extend (see faults module
+    # docstring for the full knob list).
+    fault_plane: bool = False
+    fault_seed: int = 0
+    fault_schedule: str = ""
     # Misdirection gate: False (default) = a non-leader's proxy REFUSES
     # client bytes to its raw app (the client reconnects and finds the
     # leader — structurally no unreplicated reads/writes; beyond the

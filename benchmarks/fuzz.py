@@ -41,10 +41,13 @@ from apus_tpu.models.kvs import KvsStateMachine, encode_put  # noqa: E402
 from apus_tpu.parallel.sim import Cluster  # noqa: E402
 
 
-def run_schedule(trial: int, seed_base: int, auto_remove: bool) -> str:
-    """Returns 'ok', 'expected_stall' or raises on a real violation."""
-    sched = random.Random(seed_base + trial)
-    c = Cluster(5, seed=trial, sm_factory=KvsStateMachine,
+def run_schedule(fault_seed: int, auto_remove: bool) -> str:
+    """Returns 'ok', 'expected_stall' or raises on a real violation.
+    ``fault_seed`` fully determines the schedule AND the cluster's
+    protocol RNG, so a failure reproduces with exactly
+    ``--fault-seed <seed>`` (printed by main on any failure)."""
+    sched = random.Random(fault_seed)
+    c = Cluster(5, seed=fault_seed, sm_factory=KvsStateMachine,
                 drop_rate=sched.choice([0.0, 0.02, 0.08]),
                 auto_remove=auto_remove)
     c.wait_for_leader()
@@ -113,8 +116,7 @@ def run_schedule(trial: int, seed_base: int, auto_remove: bool) -> str:
     return "ok"
 
 
-def run_devplane_schedule(trial: int, seed_base: int,
-                          force_async: bool) -> str:
+def run_devplane_schedule(fault_seed: int, force_async: bool) -> str:
     """One randomized fault schedule against the LIVE device plane
     (LocalCluster(3, device_plane=True), real time, commits through
     the jitted step): submit bursts interleaved with leader/follower
@@ -127,7 +129,7 @@ def run_devplane_schedule(trial: int, seed_base: int,
     from apus_tpu.models.kvs import encode_get, encode_put
     from apus_tpu.runtime.cluster import LocalCluster
 
-    rng = random.Random(seed_base + trial)
+    rng = random.Random(fault_seed)
     acked: dict[bytes, bytes] = {}
     seq = 0
     with LocalCluster(3, device_plane=True) as c:
@@ -165,7 +167,7 @@ def run_devplane_schedule(trial: int, seed_base: int,
     return "ok"
 
 
-def run_proc_schedule(trial: int, seed_base: int,
+def run_proc_schedule(fault_seed: int,
                       device_plane: bool = False) -> str:
     """One randomized fault schedule against the DEPLOYMENT shape: one
     daemon OS process per replica at the production timing envelope
@@ -194,7 +196,7 @@ def run_proc_schedule(trial: int, seed_base: int,
     from apus_tpu.runtime.proc import ProcCluster
     from apus_tpu.utils.config import ClusterSpec
 
-    rng = random.Random(seed_base + trial)
+    rng = random.Random(fault_seed)
     acked: dict[bytes, bytes] = {}
     seq = 0
     # The mesh build (jax import + compile x N processes) starves the
@@ -304,7 +306,7 @@ def run_proc_schedule(trial: int, seed_base: int,
     return "ok"
 
 
-def _devplane_trial_subprocess(trial: int, seed_base: int,
+def _devplane_trial_subprocess(fault_seed: int,
                                timeout_s: float = 900.0) -> str:
     """Run one device-plane schedule in a CHILD process.  Each trial
     builds its own DeviceCommitRunner (compiled programs + HBM-shaped
@@ -315,8 +317,7 @@ def _devplane_trial_subprocess(trial: int, seed_base: int,
     per-child cost to a few seconds."""
     import subprocess
     argv = [sys.executable, os.path.abspath(__file__),
-            "--one-devplane-trial", str(trial),
-            "--seed-base", str(seed_base)]
+            "--one-devplane-trial", str(fault_seed)]
     try:
         proc = subprocess.run(argv, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, timeout=timeout_s)
@@ -340,9 +341,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=50)
     ap.add_argument("--seed-base", type=int, default=20_000)
+    ap.add_argument("--fault-seed", type=int, default=None,
+                    help="run EXACTLY ONE schedule with this seed — the "
+                         "one-command repro of a failed trial (every "
+                         "failure prints its fault seed + repro line)")
     ap.add_argument("--auto-remove", action="store_true")
     ap.add_argument("--one-devplane-trial", type=int, default=None,
-                    help=argparse.SUPPRESS)   # child-process entry
+                    help=argparse.SUPPRESS)   # child entry: fault seed
     ap.add_argument("--device-plane", action="store_true",
                     help="randomized fault schedules against the LIVE "
                          "device plane (LocalCluster, jitted commits, "
@@ -355,34 +360,44 @@ def main() -> int:
                          "durable-store recovery)")
     args = ap.parse_args()
     if args.one_devplane_trial is not None:
-        verdict = run_devplane_schedule(args.one_devplane_trial,
-                                        args.seed_base, True)
+        verdict = run_devplane_schedule(args.one_devplane_trial, True)
         print(f"APUS_FUZZ_VERDICT: {verdict}", flush=True)
         return 0
+    mode_flags = (["--proc"] if args.proc else []) \
+        + (["--device-plane"] if args.device_plane else []) \
+        + (["--auto-remove"] if args.auto_remove else [])
+    if args.fault_seed is not None:
+        seeds = [args.fault_seed]
+    else:
+        seeds = [args.seed_base + t for t in range(args.trials)]
     ok = stalls = 0
     failures = []
-    for trial in range(args.trials):
+    for trial, fault_seed in enumerate(seeds):
         try:
             if args.proc:
-                r = run_proc_schedule(trial, args.seed_base,
+                r = run_proc_schedule(fault_seed,
                                       device_plane=args.device_plane)
             elif args.device_plane:
-                r = _devplane_trial_subprocess(trial, args.seed_base)
+                r = _devplane_trial_subprocess(fault_seed)
             else:
-                r = run_schedule(trial, args.seed_base, args.auto_remove)
+                r = run_schedule(fault_seed, args.auto_remove)
             if r == "ok":
                 ok += 1
             else:
                 stalls += 1
         except Exception as e:                   # noqa: BLE001
-            failures.append({"trial": trial, "error": repr(e)[:200]})
-            print(f"trial {trial}: FAIL {e!r}", file=sys.stderr)
+            failures.append({"trial": trial, "fault_seed": fault_seed,
+                             "error": repr(e)[:200]})
+            print(f"trial {trial}: FAIL (FAULT_SEED={fault_seed}) {e!r}\n"
+                  f"  repro: python benchmarks/fuzz.py "
+                  f"--fault-seed {fault_seed} "
+                  + " ".join(mode_flags), file=sys.stderr)
     # Percentage (new metric NAME so historical count-valued records
     # never average into the same row), over the trials that could
     # have been clean: expected stalls (quorum-floor schedules under
     # --auto-remove, documented non-failures) don't depress it, and a
     # run that was ALL expected stalls is vacuously 100% clean.
-    eligible = args.trials - stalls
+    eligible = len(seeds) - stalls
     pct = 100.0 if eligible <= 0 else round(100.0 * ok / eligible, 1)
     print(json.dumps({
         "metric": ("proc_devplane_fuzz_clean_pct"
@@ -392,10 +407,11 @@ def main() -> int:
                    else "protocol_fuzz_clean_pct"),
         "value": pct,
         "unit": "% clean",
-        "detail": {"clean": ok, "trials": args.trials,
+        "detail": {"clean": ok, "trials": len(seeds),
                    "expected_stalls": stalls, "failures": failures,
                    "auto_remove": args.auto_remove,
                    "seed_base": args.seed_base,
+                   "fault_seed": args.fault_seed,
                    "device_plane": args.device_plane,
                    "proc": args.proc},
     }))

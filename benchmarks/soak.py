@@ -70,6 +70,16 @@ def main() -> int:
                          "first kill degrades the ICI slice, then "
                          "sustained TCP service — the endurance story "
                          "for the production deployment shape")
+    ap.add_argument("--fault-seed", type=int, default=None,
+                    help="enable the live-stack fault plane "
+                         "(apus_tpu.parallel.faults) on every replica "
+                         "and inject a SEEDED stream of transient "
+                         "drop/delay bursts over the wire during the "
+                         "soak; the seed is printed on any failure for "
+                         "one-command repro")
+    ap.add_argument("--fault-every", type=float, default=30.0,
+                    help="with --fault-seed: seconds between injected "
+                         "fault bursts")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -112,6 +122,26 @@ def main() -> int:
         # evicted mid-soak (the fuzz mesh campaign runs the same way —
         # eviction semantics are the simulator campaign's subject).
         mesh_spec = _dc.replace(MESH_PROC_SPEC, auto_remove=False)
+
+    # Seeded transient-fault injection (parallel.faults): every
+    # --fault-every seconds, one random replica's plane gets a drop or
+    # delay burst (scripted over the wire), healed a few seconds later.
+    # Deterministic per seed; kills/partitions stay the failover loop's
+    # and the e2e tests' job — the soak measures sustained service
+    # under CONTINUOUS low-grade network misbehavior.
+    import random as _random
+    fault_rng = _random.Random(args.fault_seed)
+    next_fault = (time.monotonic() + args.fault_every
+                  if args.fault_seed is not None else float("inf"))
+    fault_heal_at = None
+    fault_victim = None
+    faults_injected = 0
+    if args.fault_seed is not None:
+        import dataclasses as _dc
+        from apus_tpu.runtime.proc import PROC_SPEC
+        base = mesh_spec if mesh_spec is not None else PROC_SPEC
+        mesh_spec = _dc.replace(base, fault_plane=True,
+                                fault_seed=args.fault_seed)
     mesh_commits = 0            # high-water device-owned commit count
     mesh_dead = False
     mesh_degraded_at_write = None
@@ -216,6 +246,29 @@ def main() -> int:
         t0 = time.monotonic()
         while time.monotonic() < t_end:
             now = time.monotonic()
+            if fault_heal_at is not None and now >= fault_heal_at:
+                from apus_tpu.parallel.faults import send_fault
+                send_fault(pc.spec.peers[fault_victim], {"cmd": "heal"})
+                fault_heal_at = fault_victim = None
+            if now >= next_fault and fault_heal_at is None:
+                from apus_tpu.parallel.faults import send_fault
+                fault_victim = fault_rng.randrange(args.replicas)
+                if pc.procs[fault_victim] is not None:
+                    cmd = fault_rng.choice([
+                        {"cmd": "drop", "peer": "*",
+                         "p": round(fault_rng.uniform(0.02, 0.2), 3)},
+                        {"cmd": "delay", "lo": 0.0,
+                         "hi": round(fault_rng.uniform(0.002, 0.02), 4)},
+                    ])
+                    if send_fault(pc.spec.peers[fault_victim],
+                                  cmd) is not None:
+                        faults_injected += 1
+                        fault_heal_at = now + fault_rng.uniform(2.0, 8.0)
+                    else:
+                        fault_victim = None
+                else:
+                    fault_victim = None
+                next_fault = now + args.fault_every
             if now >= next_failover:
                 # Keep quorum: only kill when every replica is up.
                 if all(p is not None for p in pc.procs):
@@ -339,6 +392,9 @@ def main() -> int:
             "converged": converged,
             "app": "toyserver" if args.toyserver else "redis",
             "replicas": args.replicas,
+            **({"fault_seed": args.fault_seed,
+                "faults_injected": faults_injected}
+               if args.fault_seed is not None else {}),
             **({"mesh": {
                 "device_commits": mesh_commits,
                 "degraded": mesh_dead,
@@ -353,7 +409,16 @@ def main() -> int:
             }} if args.mesh else {}),
         },
     }))
-    return 0 if converged and not errors else 1
+    ok = converged and not errors
+    if not ok and args.fault_seed is not None:
+        print(f"SOAK FAIL (FAULT_SEED={args.fault_seed})\n"
+              f"  repro: python benchmarks/soak.py --minutes "
+              f"{args.minutes} --failover-every {args.failover_every} "
+              f"--fault-seed {args.fault_seed}"
+              + (" --mesh" if args.mesh else "")
+              + (" --toyserver" if args.toyserver else ""),
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

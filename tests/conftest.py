@@ -32,3 +32,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "mesh: multi-controller mesh-plane e2e (spawns N jax processes)")
+    config.addinivalue_line(
+        "markers",
+        "faultplane: live-stack fault-injection suite "
+        "(apus_tpu.parallel.faults) — deterministic faults on the real "
+        "transport; selectable with -m faultplane")

@@ -48,10 +48,10 @@ def test_sim_fuzz_slice():
     keeps exploring rather than replaying one greased path."""
     fuzz = _fuzz()
     for trial in range(6):
-        assert fuzz.run_schedule(trial, seed_base=31_000,
+        assert fuzz.run_schedule(31_000 + trial,
                                  auto_remove=False) == "ok"
     # One auto-remove schedule too (the quorum-floor ladder).
-    r = fuzz.run_schedule(0, seed_base=32_000, auto_remove=True)
+    r = fuzz.run_schedule(32_000, auto_remove=True)
     assert r in ("ok", "expected_stall")
 
 
@@ -61,7 +61,7 @@ def test_devplane_fuzz_slice():
     altitude that exercises generation fencing and the election drain
     under fire."""
     fuzz = _fuzz()
-    assert fuzz._devplane_trial_subprocess(0, seed_base=33_000) == "ok"
+    assert fuzz._devplane_trial_subprocess(33_000) == "ok"
 
 
 def test_proc_fuzz_slice():
@@ -69,7 +69,7 @@ def test_proc_fuzz_slice():
     (SIGKILL'd process groups, durable-store recovery, catch-up):
     every acked write must survive and all replicas converge."""
     fuzz = _fuzz()
-    assert fuzz.run_proc_schedule(0, seed_base=34_000) == "ok"
+    assert fuzz.run_proc_schedule(34_000) == "ok"
 
 
 @pytest.mark.mesh
@@ -78,7 +78,7 @@ def test_proc_devplane_fuzz_slice():
     device quorum BEFORE the first fault, then kills degrade the plane
     to TCP with exactly-once intact."""
     fuzz = _fuzz()
-    assert fuzz.run_proc_schedule(0, seed_base=35_000,
+    assert fuzz.run_proc_schedule(35_000,
                                   device_plane=True) == "ok"
 
 

@@ -150,7 +150,7 @@ def test_fuzz_schedules_clean():
     over random crash/partition/loss schedules with fixed membership."""
     fuzz = _load_fuzz()
     for trial in range(8):
-        assert fuzz.run_schedule(trial, 20_000, False) == "ok", trial
+        assert fuzz.run_schedule(20_000 + trial, False) == "ok", trial
 
 
 def test_devplane_fuzz_slice():
@@ -159,7 +159,7 @@ def test_devplane_fuzz_slice():
     kills and restarts land while async deep windows are in flight,
     and every acked write survives with consistent logs."""
     fuzz = _load_fuzz()
-    assert fuzz.run_devplane_schedule(1, 20_000, True) == "ok"
+    assert fuzz.run_devplane_schedule(20_001, True) == "ok"
 
 
 def test_proc_fuzz_slice():
@@ -169,4 +169,4 @@ def test_proc_fuzz_slice():
     bursts, every acked write durable.  This campaign's first full run
     caught the sequential-client clt_id dedup collision."""
     fuzz = _load_fuzz()
-    assert fuzz.run_proc_schedule(0, 20_000) == "ok"
+    assert fuzz.run_proc_schedule(20_000) == "ok"

@@ -1,0 +1,578 @@
+"""Live-stack fault plane (apus_tpu.parallel.faults) tests.
+
+Unit layer: the FaultPlane pipeline itself — seeded determinism, each
+fault kind, schedules, env parsing — against a recording dummy
+transport.
+
+Integration layer: the REAL stack under injected faults —
+- client reply pairing under duplicated + reordered replies
+  (runtime.client echo matching) and server-side exactly-once dedup
+  under duplicated requests (core.epdb through the live wire);
+- the partition/heal e2e the reference can only demonstrate with a
+  hardware testbed: leader isolated on live sockets -> new leader
+  elected -> heal -> deposed leader rejoins -> no acknowledged write
+  lost.  Deterministic: faults are scripted (block/heal), the only
+  randomness is election jitter, and the assertions hold on every
+  outcome path.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from apus_tpu.parallel import wire
+from apus_tpu.parallel.faults import (FaultPlane, apply_command,
+                                      build_plane, config_from_env,
+                                      heal_all, isolate, send_fault)
+from apus_tpu.parallel.transport import Region, Transport, WriteResult
+
+pytestmark = pytest.mark.faultplane
+
+
+class DummyTransport(Transport):
+    """Records every op; always succeeds."""
+
+    def __init__(self):
+        self.calls: list[tuple] = []
+
+    def ctrl_write(self, target, region, slot, value):
+        self.calls.append(("ctrl_write", target, region, slot, value))
+        return WriteResult.OK
+
+    def ctrl_read(self, target, region, slot):
+        self.calls.append(("ctrl_read", target, region, slot))
+        return 42
+
+    def log_write(self, target, writer_sid, entries, commit):
+        self.calls.append(("log_write", target, commit))
+        return WriteResult.OK, 7
+
+    def log_read_state(self, target):
+        self.calls.append(("log_read_state", target))
+        return None
+
+    def request(self, target, payload):
+        self.calls.append(("request", target, payload))
+        return b"\x00ok"
+
+
+def _wr(plane, target=1):
+    return plane.ctrl_write(target, Region.HB, 0, 1)
+
+
+# -- unit: pipeline ---------------------------------------------------------
+
+
+def test_inert_plane_passes_through():
+    inner = DummyTransport()
+    plane = FaultPlane(inner, seed=1)
+    assert _wr(plane) == WriteResult.OK
+    assert plane.ctrl_read(1, Region.HB, 0) == 42
+    assert plane.log_write(1, None, [], 0) == (WriteResult.OK, 7)
+    assert plane.request(1, b"x") == b"\x00ok"
+    assert len(inner.calls) == 4
+    assert plane.stats["drops"] == 0
+
+
+def test_seeded_drop_deterministic():
+    def run(seed):
+        plane = FaultPlane(DummyTransport(), seed=seed)
+        plane.set_drop("*", 0.5)
+        return [_wr(plane, t) for t in range(20)]
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must give the same fault sequence"
+    assert WriteResult.DROPPED in a and WriteResult.OK in a
+    c = run(8)
+    assert c != a, "different seed should diverge (p=2^-20 collision)"
+
+
+def test_per_peer_drop_overrides_wildcard():
+    plane = FaultPlane(DummyTransport(), seed=3)
+    plane.set_drop("*", 0.0)
+    plane.set_drop(2, 1.0)
+    assert _wr(plane, 1) == WriteResult.OK
+    assert _wr(plane, 2) == WriteResult.DROPPED
+    assert plane.stats["drops"] == 1
+
+
+def test_block_heal_partition():
+    inner = DummyTransport()
+    plane = FaultPlane(inner, seed=0)
+    plane.block([1, 2])
+    assert _wr(plane, 1) == WriteResult.DROPPED
+    assert _wr(plane, 2) == WriteResult.DROPPED
+    assert _wr(plane, 3) == WriteResult.OK      # asymmetric: 3 untouched
+    assert plane.ctrl_read(1, Region.HB, 0) is None
+    assert plane.log_read_state(1) is None
+    plane.heal()
+    assert _wr(plane, 1) == WriteResult.OK
+    # blocked ops never reached the inner transport
+    assert all(c[1] == 3 or c == ("ctrl_write", 1, Region.HB, 0, 1)
+               for c in inner.calls)
+
+
+def test_duplicate_applies_twice():
+    inner = DummyTransport()
+    plane = FaultPlane(inner, seed=0)
+    plane.set_dup(1, 1.0)
+    assert _wr(plane, 1) == WriteResult.OK
+    assert len([c for c in inner.calls if c[0] == "ctrl_write"]) == 2
+    assert plane.stats["dups"] == 1
+
+
+def test_throttle_and_delay_stall_the_op():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    plane.set_throttle(1, 0.05)
+    t0 = time.monotonic()
+    assert _wr(plane, 1) == WriteResult.OK
+    assert time.monotonic() - t0 >= 0.05
+    plane.heal()
+    plane.set_delay(1, 0.03, 0.03)
+    t0 = time.monotonic()
+    assert _wr(plane, 1) == WriteResult.OK
+    assert time.monotonic() - t0 >= 0.03
+    assert plane.stats["delays"] == 1
+
+
+def test_reorder_holds_until_next_op():
+    inner = DummyTransport()
+    plane = FaultPlane(inner, seed=0)
+    plane.set_reorder(1, 1.0)
+    plane.REORDER_HOLD_S = 5.0          # only the next-op release path
+    order = []
+
+    def first():
+        plane.ctrl_write(1, Region.HB, 0, "first")
+        order.append("first")
+
+    t = threading.Thread(target=first)
+    t.start()
+    time.sleep(0.05)                    # the hold is parked
+    assert not order, "held op completed before the next op released it"
+    plane.set_reorder(1, 0.0)           # the second op must not hold too
+    plane.ctrl_write(1, Region.HB, 1, "second")
+    order.append("second-done")
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+    # The held (first) op was applied AFTER the second passed _pre.
+    applied = [c[4] for c in inner.calls if c[0] == "ctrl_write"]
+    assert applied == ["second", "first"]
+
+
+def test_crash_restart_hooks_fire():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    fired = []
+    plane.crash_hooks.append(lambda: fired.append("crash"))
+    plane.restart_hooks.append(lambda: fired.append("restart"))
+    plane.crash()
+    assert _wr(plane, 1) == WriteResult.DROPPED
+    assert plane.request(1, b"x") is None
+    plane.crash()                        # idempotent: no double fire
+    plane.restart()
+    assert _wr(plane, 1) == WriteResult.OK
+    assert fired == ["crash", "restart"]
+
+
+def test_heal_clears_crash_and_fires_restart_hooks():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    fired = []
+    plane.restart_hooks.append(lambda: fired.append("restart"))
+    plane.crash()
+    plane.heal()
+    assert fired == ["restart"]
+    assert _wr(plane, 1) == WriteResult.OK
+
+
+def test_schedule_applies_steps():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    plane.load_schedule([
+        {"at": 0.0, "cmd": "block", "peers": [1]},
+        {"at": 0.05, "cmd": "heal"},
+    ])
+    plane.arm()
+    deadline = time.monotonic() + 2.0
+    while _wr(plane, 1) != WriteResult.DROPPED:
+        assert time.monotonic() < deadline, "block step never applied"
+        time.sleep(0.005)
+    while _wr(plane, 1) != WriteResult.OK:
+        assert time.monotonic() < deadline, "heal step never applied"
+        time.sleep(0.005)
+    plane.stop()
+
+
+def test_apply_command_full_surface():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    for cmd in [{"cmd": "drop", "peer": 1, "p": 0.5},
+                {"cmd": "delay", "lo": 0.001, "hi": 0.002},
+                {"cmd": "dup", "p": 0.1},
+                {"cmd": "reorder", "p": 0.1},
+                {"cmd": "throttle", "peer": 2, "seconds": 0.01},
+                {"cmd": "block", "peers": [1]},
+                {"cmd": "unblock", "peers": [1]},
+                {"cmd": "inbound_drop", "p": 0.5},
+                {"cmd": "inbound_delay", "lo": 0.001},
+                {"cmd": "crash"}, {"cmd": "restart"},
+                {"cmd": "heal"}, {"cmd": "stats"}]:
+        stats = apply_command(plane, cmd)
+        assert isinstance(stats, dict)
+    with pytest.raises(ValueError):
+        apply_command(plane, {"cmd": "nope"})
+
+
+def test_env_config_and_build():
+    env = {"APUS_FAULT_SEED": "9",
+           "APUS_FAULT_DROP": "1:0.25,*:0.05",
+           "APUS_FAULT_DELAY": "0.001:0.002",
+           "APUS_FAULT_PARTITION": "2",
+           "APUS_FAULT_THROTTLE": "0:0.01"}
+    cfg = config_from_env(env)
+    assert cfg["seed"] == 9
+    plane = build_plane(DummyTransport(), cfg)
+    assert plane.seed == 9
+    assert plane._state(1).drop == 0.25
+    assert plane._state(5).drop == 0.05          # wildcard fallback
+    assert plane._state(2).blocked
+    assert plane._state(0).throttle == 0.01
+    assert config_from_env({}) is None
+
+
+def test_wrap_handler_inbound_drop_nacks():
+    plane = FaultPlane(DummyTransport(), seed=0)
+    seen = []
+
+    def handler(r):
+        seen.append(r)
+        return wire.u8(wire.ST_OK)
+
+    wrapped = plane.wrap_handler("mesh", handler)
+    assert wrapped(None) == wire.u8(wire.ST_OK)
+    plane.set_inbound_drop(1.0)
+    assert wrapped(None) == wire.u8(wire.ST_ERROR)
+    assert plane.stats["inbound_drops"] == 1
+    assert len(seen) == 1                # the dropped one never reached it
+
+
+# -- integration: client reply pairing under dup/reorder --------------------
+
+
+OP_CLT_WRITE = 16
+
+
+def _clt_reply(st: int, req_id: int, body: bytes = b"") -> bytes:
+    return wire.u8(st) + wire.u64(req_id) + wire.blob(body)
+
+
+def test_client_discards_duplicated_and_reordered_replies():
+    """A server whose connection carries STALE frames (duplicated
+    replies to earlier req_ids, delivered late/reordered) before the
+    real answer: the client must discard them by echo mismatch instead
+    of misreading them as the current reply."""
+    from apus_tpu.runtime.client import ApusClient
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    addr = f"127.0.0.1:{srv.getsockname()[1]}"
+
+    def serve():
+        conn, _ = srv.accept()
+        with conn:
+            while True:
+                try:
+                    req = wire.read_frame(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if req is None:
+                    return
+                r = wire.Reader(req)
+                assert r.u8() == OP_CLT_WRITE
+                req_id = r.u64()
+                # STALE frames first: a duplicated reply to an older
+                # req and one to a future-looking bogus id.
+                conn.sendall(wire.frame(_clt_reply(
+                    wire.ST_OK, req_id - 1, b"stale-older")))
+                conn.sendall(wire.frame(_clt_reply(
+                    wire.ST_OK, req_id + 1000, b"stale-weird")))
+                # Then the real, matching reply.
+                conn.sendall(wire.frame(_clt_reply(
+                    wire.ST_OK, req_id, b"real-%d" % req_id)))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with ApusClient([addr], timeout=5.0) as c:
+            assert c.write(b"w1") == b"real-1"
+            assert c.write(b"w2") == b"real-2"
+            assert c.stats.get("stale_replies", 0) == 4
+    finally:
+        srv.close()
+
+
+def test_duplicated_request_applies_exactly_once():
+    """The SAME clt-op frame sent twice over the live wire (transport
+    duplication): the server's endpoint DB dedups — one log entry, the
+    duplicate answered from the cached reply."""
+    from apus_tpu.models.kvs import encode_put
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3) as c:
+        leader = c.wait_for_leader()
+        host, port = leader.server.addr
+        payload = (wire.u8(OP_CLT_WRITE) + wire.u64(1) + wire.u64(777)
+                   + wire.blob(encode_put(b"k", b"v")))
+        replies = []
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.settimeout(10.0)
+            for _ in range(2):
+                s.sendall(wire.frame(payload))
+                resp = wire.read_frame(s)
+                assert resp is not None and resp[0] == wire.ST_OK, resp
+                assert wire.Reader(resp[1:9]).u64() == 1   # echo
+                replies.append(wire.Reader(resp[9:]).blob())
+        assert replies[0] == replies[1], "dup must get the cached reply"
+        with leader.lock:
+            hits = [e for e in leader.node.log.entries(0)
+                    if e.clt_id == 777 and e.req_id == 1]
+        assert len(hits) == 1, f"duplicate appended {len(hits)} entries"
+
+
+# -- integration: live-socket partition/heal e2e ----------------------------
+
+
+FAULT_SEED = 1234
+
+
+def _put(c, k: bytes, v: bytes) -> bool:
+    from apus_tpu.models.kvs import encode_put
+    try:
+        return c.write(encode_put(k, v)) == b"OK"
+    except (TimeoutError, RuntimeError):
+        return False
+
+
+def test_partition_heal_no_acked_write_lost():
+    """THE live-stack recovery scenario, on real sockets, fault seed
+    fixed: leader isolated (both directions scripted) -> survivors
+    elect a new leader -> writes keep being acked -> heal -> the
+    deposed leader rejoins as follower and converges -> EVERY
+    acknowledged write is readable; writes acked by the deposed leader
+    during the partition do not exist (it cannot commit without
+    quorum, so nothing was acked there to lose)."""
+    from apus_tpu.models.kvs import encode_get
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.utils.config import ClusterSpec
+
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150,
+                       fault_plane=True, fault_seed=FAULT_SEED,
+                       auto_remove=False)
+    acked: dict[bytes, bytes] = {}
+    with LocalCluster(3, spec=spec) as c:
+        for d in c.daemons:
+            assert isinstance(d.transport, FaultPlane)
+        old = c.wait_for_leader()
+        with ApusClient(list(c.spec.peers), timeout=10.0) as cl:
+            assert _put(cl, b"pre", b"1")
+            acked[b"pre"] = b"1"
+
+            # Isolate the leader on the LIVE sockets: its outbound
+            # blocked, and every survivor's outbound to it blocked.
+            others = [d for d in c.daemons if d.idx != old.idx]
+            old.transport.block([d.idx for d in others])
+            for d in others:
+                d.transport.block([old.idx])
+
+            # Survivors elect a new leader (PreVote + election over the
+            # un-blocked pair) and acked writes continue.
+            deadline = time.monotonic() + 20.0
+            new = None
+            while time.monotonic() < deadline:
+                leaders = [d for d in others if d.is_leader]
+                if leaders:
+                    new = leaders[0]
+                    break
+                time.sleep(0.01)
+            assert new is not None, "no new leader during the partition"
+            for i in range(10):
+                k, v = b"part-%d" % i, b"pv%d" % i
+                if _put(cl, k, v):
+                    acked[k] = v
+            assert any(k.startswith(b"part-") for k in acked), \
+                "no write acked during the partition"
+
+            # The isolated ex-leader must not have committed anything
+            # past the pre-partition frontier: no quorum reachable.
+            with old.lock:
+                old_commit = old.node.log.commit
+
+            # HEAL both directions; the deposed leader rejoins.
+            for d in c.daemons:
+                d.transport.heal()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with old.lock:
+                    caught = (not old.node.is_leader
+                              and old.node.current_term
+                              >= new.node.current_term
+                              and old.node.log.apply
+                              >= new.node.log.commit > old_commit)
+                if caught:
+                    break
+                time.sleep(0.01)
+            assert caught, "deposed leader never converged after heal"
+
+            # Post-heal service continues, exactly one leader.
+            assert _put(cl, b"post", b"2")
+            acked[b"post"] = b"2"
+            leaders = [d for d in c.live() if d.is_leader]
+            assert len(leaders) == 1, leaders
+
+            # NO ACKNOWLEDGED WRITE LOST — through the current leader...
+            for k, v in acked.items():
+                assert cl.read(encode_get(k)) == v, k
+        # ...and in every replica's applied state.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with leaders[0].lock:
+                target = leaders[0].node.log.commit
+            if all(d.node.log.apply >= target for d in c.live()):
+                break
+            time.sleep(0.01)
+        for d in c.live():
+            for k, v in acked.items():
+                assert d.node.sm.query(encode_get(k)) == v, (d.idx, k)
+
+
+@pytest.mark.mesh
+def test_mesh_descriptor_drop_degrades_then_reforms(tmp_path):
+    """The mesh descriptor channel rides the fault plane: dropping one
+    inbound descriptor NACKs the leader's feed (a follower that misses
+    one descriptor can never rejoin the dispatch sequence), the plane
+    degrades to TCP — and the reformer then rebuilds it under the next
+    epoch.  The deterministic, software-injected form of the member-
+    death degradation the mesh tests produce with SIGKILL."""
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import MESH_PROC_SPEC, ProcCluster
+
+    pc = ProcCluster(3, workdir=str(tmp_path / "c"), spec=MESH_PROC_SPEC,
+                     device_plane=True, db=False, fault_plane=True,
+                     fault_seed=FAULT_SEED)
+    pc.start(timeout=60.0)
+    try:
+        pc.wait_mesh_ready(timeout=120.0)
+        lead = pc.leader_idx(timeout=30.0)
+        follower = next(i for i in range(3) if i != lead)
+        with ApusClient(list(pc.spec.peers), timeout=15.0) as c:
+            # Commits must ride the device quorum before the fault.
+            deadline = time.monotonic() + 90.0
+            n = 0
+            from apus_tpu.models.kvs import encode_put
+            while time.monotonic() < deadline:
+                c.write(encode_put(b"m%d" % n, b"v%d" % n))
+                n += 1
+                st = pc.status(pc.leader_idx(timeout=5.0), timeout=1.0)
+                d = (st or {}).get("devplane") or {}
+                if d.get("commits", 0) > 0:
+                    break
+            else:
+                raise AssertionError("device plane never owned commit")
+            # Inject: every inbound mesh descriptor at the follower is
+            # dropped (NACKed) — the leader's next round kills its feed.
+            assert send_fault(pc.spec.peers[follower],
+                              {"cmd": "inbound_drop", "p": 1.0})
+            deadline = time.monotonic() + 60.0
+            degraded = False
+            while time.monotonic() < deadline and not degraded:
+                c.write(encode_put(b"d%d" % n, b"x"))
+                n += 1
+                try:
+                    st = pc.status(pc.leader_idx(timeout=5.0),
+                                   timeout=1.0)
+                except AssertionError:
+                    continue
+                d = (st or {}).get("devplane") or {}
+                degraded = bool(d.get("dead")) or \
+                    (d.get("epoch", 0) or 0) > 0
+            assert degraded, f"descriptor drop never degraded: {d}"
+            # Heal, then the reformer must bring device-owned commit
+            # back under a higher epoch.
+            assert send_fault(pc.spec.peers[follower],
+                              {"cmd": "heal"})
+            deadline = time.monotonic() + 180.0
+            owned = None
+            while time.monotonic() < deadline:
+                c.write(encode_put(b"r%d" % n, b"y"))
+                n += 1
+                try:
+                    st = pc.status(pc.leader_idx(timeout=5.0),
+                                   timeout=1.0)
+                except AssertionError:
+                    continue
+                owned = (st or {}).get("devplane") or {}
+                if owned.get("owns_commit") and not owned.get("dead") \
+                        and (owned.get("epoch") or 0) >= 1:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"plane never re-formed after heal: {owned}")
+    finally:
+        pc.stop()
+
+
+def test_partition_heal_over_the_wire_proc():
+    """Same scenario at the DEPLOYMENT altitude: real replica
+    processes, faults scripted over the wire (OP_FAULT) — the e2e
+    proof that the fault plane is reachable in live daemons, not just
+    in-process objects."""
+    import tempfile
+
+    from apus_tpu.models.kvs import encode_get
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    acked: dict[bytes, bytes] = {}
+    with tempfile.TemporaryDirectory(prefix="apus-fault-e2e") as td:
+        with ProcCluster(3, workdir=td, db=False, fault_plane=True,
+                         fault_seed=FAULT_SEED) as pc:
+            lead = pc.leader_idx(timeout=20.0)
+            with ApusClient(list(pc.spec.peers), timeout=10.0) as cl:
+                assert _put(cl, b"pre", b"1")
+                acked[b"pre"] = b"1"
+                assert isolate(list(pc.spec.peers), lead), \
+                    "fault scripting unreachable"
+                # New leader among the survivors; writes keep flowing.
+                deadline = time.monotonic() + 20.0
+                new = None
+                while time.monotonic() < deadline:
+                    for i in range(3):
+                        if i == lead:
+                            continue
+                        st = pc.status(i, timeout=0.3)
+                        if st and st.get("is_leader"):
+                            new = i
+                            break
+                    if new is not None:
+                        break
+                    time.sleep(0.05)
+                assert new is not None, "no new leader under partition"
+                for i in range(5):
+                    k, v = b"p%d" % i, b"v%d" % i
+                    if _put(cl, k, v):
+                        acked[k] = v
+                assert len(acked) > 1
+                # Heal everyone; deposed leader converges back in.
+                assert heal_all(list(pc.spec.peers))
+                pc.wait_converged(timeout=30.0)
+                for k, v in acked.items():
+                    assert cl.read(encode_get(k)) == v, k
+                # Fault counters prove the faults actually fired.
+                st = send_fault(pc.spec.peers[lead], {"cmd": "stats"})
+                assert st is not None and st["blocked"] > 0, st
