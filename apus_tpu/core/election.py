@@ -49,7 +49,7 @@ def log_up_to_date(cand_last_idx: int, cand_last_term: int,
 
 def should_grant(req: VoteRequest, own_sid: Sid,
                  own_last_idx: int, own_last_term: int,
-                 known_leader: bool) -> bool:
+                 known_leader: bool, lease_guard: bool = False) -> bool:
     """Whether a voter grants ``req``.
 
     - never vote backwards in term;
@@ -57,6 +57,13 @@ def should_grant(req: VoteRequest, own_sid: Sid,
       we adopted; a same-term request from a different candidate is refused);
     - ignore candidates while we believe a leader is alive
       (dare_server.c:1535 — mitigates disruptive servers);
+    - with ``lease_guard`` (leader read leases enabled, Raft §6.4):
+      refuse real votes at ANY term while the leader is alive — the
+      lease's safety rests on "no quorum can elect before every lease
+      quorum member has been silent for hb_timeout", which the
+      term-bounded refusal alone does not give (a candidate holding
+      stale pre-grants may request a higher-term vote the instant the
+      leader recovers);
     - candidate log must be up-to-date.
     """
     cand = req.sid
@@ -64,7 +71,7 @@ def should_grant(req: VoteRequest, own_sid: Sid,
         return False
     if cand.term == own_sid.term and (known_leader or cand.idx != own_sid.idx):
         return False
-    if known_leader and cand.term <= own_sid.term:
+    if known_leader and (lease_guard or cand.term <= own_sid.term):
         return False
     return log_up_to_date(req.last_idx, req.last_term,
                           own_last_idx, own_last_term)

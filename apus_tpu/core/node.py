@@ -88,6 +88,22 @@ class NodeConfig:
     # the sizing contract).  0 disables splitting (payloads ride whole,
     # device-ineligible when oversized).
     seg_chunk: int = 0
+    # Leader read lease (the Hermes-style local-read optimization):
+    # while the lease holds, linearizable reads are answered from the
+    # leader's applied state WITHOUT the per-read majority round
+    # (_verify_leadership).  Renewal: a heartbeat round whose writes a
+    # quorum acknowledged — with the ack's echoed SID proving the peer
+    # was still at our term — extends the lease to round-start +
+    # hb_timeout * (1 - lease_margin).  Safety (proven under the
+    # FaultPlane e2e): the peer server stamps _last_hb_seen at HB
+    # delivery, a lease_guard voter refuses real votes while within
+    # hb_timeout of a heartbeat, so any new leader's election happens
+    # >= round-start + hb_timeout — after every lease granted from that
+    # round expired.  lease_margin absorbs clock-RATE drift between the
+    # replicas' monotonic clocks over the (tiny) lease window plus
+    # scheduling skew.
+    read_lease: bool = True
+    lease_margin: float = 0.2
 
 
 @dataclasses.dataclass
@@ -247,6 +263,15 @@ class Node:
         self._await_contact = cfg.recovery_start
         self._contact_deadline: Optional[float] = None
         self._now = 0.0                     # last tick clock (sim-safe)
+        # Leader read lease (NodeConfig.read_lease): valid while
+        # _now < _lease_until.  Renewed by quorum-acked heartbeat
+        # rounds in _send_heartbeats; cleared on any role change.
+        self._lease_until = -1.0
+        # Monotone count of completed linearizable reads (lease or
+        # verified) — the daemon's wake predicate keys off it so a
+        # served read always wakes its waiting handler even when
+        # apply/role are otherwise unchanged that tick.
+        self.reads_done = 0
 
         # stats (observability, §5.5)
         self.stats = {"elections": 0, "commits": 0, "applied": 0,
@@ -326,8 +351,29 @@ class Node:
         self._reg_seq += 1
         rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
                          registered_at=self._reg_seq)
+        # Lease fast path: everything committed before registration is
+        # already applied AND the read lease holds — answer from local
+        # state NOW, no majority round, no tick wait.  _lease_valid
+        # compares against the LAST tick clock (<= real now), so a
+        # lease that looks valid here is valid at the real call time.
+        if self.log.apply >= wait_idx and self._lease_valid(self._now):
+            try:
+                rr.reply = self.sm.query(data)
+            except Exception:
+                rr.reply = None
+                rr.error = True
+            rr.done = True
+            self.reads_done += 1
+            self.stats["lease_reads"] = \
+                self.stats.get("lease_reads", 0) + 1
+            return rr
         self._pending_reads.append(rr)
         return rr
+
+    def _lease_valid(self, now: float) -> bool:
+        """Leader read lease currently held (see NodeConfig.read_lease)."""
+        return (self.cfg.read_lease and self.role == Role.LEADER
+                and now < self._lease_until)
 
     def handle_join(self, addr: str,
                     want_slot: Optional[int] = None) -> Optional[PendingJoin]:
@@ -617,6 +663,7 @@ class Node:
         self.external_commit = False       # host rules until a driver re-arms
         self.device_covered_from = None
         self._drain_wait = {}
+        self._lease_until = -1.0           # no lease carries across terms
         self._election_deadline = None
         self._next_hb_send = now           # heartbeat immediately
         self._next_idx = {}
@@ -673,6 +720,7 @@ class Node:
         self._known_leader = leader_sid.idx if leader_sid.leader else None
         self.external_commit = False       # host rules until a driver re-arms
         self.device_covered_from = None
+        self._lease_until = -1.0
         self._election_deadline = None
         self._last_hb_seen = now
         self.group_contact = True
@@ -748,7 +796,8 @@ class Node:
         last_idx, last_term = self.log.last_determinant()
         leader_alive = (self._known_leader is not None and
                         now - self._last_hb_seen < self._hb_timeout)
-        if not should_grant(best, my, last_idx, last_term, leader_alive):
+        if not should_grant(best, my, last_idx, last_term, leader_alive,
+                            lease_guard=self.cfg.read_lease):
             # A stale candidate: our term may still need to advance so it
             # can retry (higher term observed).
             if best.sid.term > my.term:
@@ -903,7 +952,12 @@ class Node:
 
     def _drain_pending(self, my: Sid) -> None:
         """tailq drain -> log append (get_tailq_message,
-        dare_ibv_ud.c:780-790)."""
+        dare_ibv_ud.c:780-790).  This is the group-commit admission
+        point: every op submitted since the last tick lands in the log
+        HERE, in one pass, so K concurrent writers share the same
+        replication windows (up to max_batch entries per log_write)
+        instead of paying K rounds."""
+        appended = 0
         for pr in self._pending:
             if pr.idx is not None:
                 continue
@@ -921,6 +975,15 @@ class Node:
                 continue
             pr.idx = self.log.append(my.term, req_id=pr.req_id,
                                      clt_id=pr.clt_id, data=pr.data)
+            appended += 1
+        if appended:
+            # Group-commit observability: one drain window per tick
+            # that admitted entries; entries/windows is the achieved
+            # coalescing factor.
+            self.stats["drain_windows"] = \
+                self.stats.get("drain_windows", 0) + 1
+            self.stats["drain_entries"] = \
+                self.stats.get("drain_entries", 0) + appended
         self._pending = [p for p in self._pending
                          if p.idx is None or p.idx >= self.log.commit]
 
@@ -1107,6 +1170,11 @@ class Node:
                 if batch:
                     self._next_idx[peer] = batch[-1].idx + 1
                     self.stats["entries_replicated"] += len(batch)
+                    # Per-peer replication windows (group-commit
+                    # invariant: K concurrent ops ship in
+                    # ceil(K/max_batch) windows per peer, not K).
+                    self.stats["repl_windows"] = \
+                        self.stats.get("repl_windows", 0) + 1
                 self._commit_sent[peer] = self.log.commit
                 self._fail_count[peer] = 0
                 if acked_end is not None and self.is_leader \
@@ -1232,17 +1300,50 @@ class Node:
         self._transit_pending = True
 
     def _send_heartbeats(self, my: Sid, now: float) -> None:
-        """rc_send_hb analog (dare_ibv_rc.c:868-926)."""
+        """rc_send_hb analog (dare_ibv_rc.c:868-926).  Doubles as the
+        read-lease renewal round (NodeConfig.read_lease): a quorum of
+        acknowledged HB writes — each ack's echoed SID proving the peer
+        was still at our term when it replied, and the peer server
+        having stamped its _last_hb_seen at delivery — extends the
+        lease to t0 + hb_timeout*(1 - lease_margin), anchored at the
+        round's START so the wire time is never credited."""
+        t0 = now
+        mask = 1 << self.idx
+        # Reply-time SID echoes recorded by the transport per peer
+        # ((sid_word, monotonic) — NetTransport.peer_sid_seen); absent
+        # on transports that don't echo (the deterministic sim), where
+        # multi-member leases simply never engage.
+        hints = getattr(self.t, "peer_sid_seen", None)
         for peer in self._replication_targets():
             if self.t.ctrl_write(peer, Region.HB, self.idx, my.word) \
                     != WriteResult.OK:
                 self._note_failure(peer, now)
-            else:
-                # A reachable peer is not failing: reset the counter so
-                # sporadic drops (async dial, transient congestion) far
-                # apart never accumulate to PERMANENT_FAILURE.
-                self._fail_count[peer] = 0
+                continue
+            # A reachable peer is not failing: reset the counter so
+            # sporadic drops (async dial, transient congestion) far
+            # apart never accumulate to PERMANENT_FAILURE.
+            self._fail_count[peer] = 0
+            if hints is not None:
+                seen = hints.get(peer)
+                if seen is not None and seen[1] >= t0 \
+                        and Sid.unpack(seen[0]).term <= my.term:
+                    mask |= 1 << peer
         self.stats["hb_sent"] += 1
+        if not self.cfg.read_lease or self.cid.state != CidState.STABLE:
+            return      # no lease across joint-consensus quorums
+        # The fan-out yields the node lock on the wire: renew only if
+        # still leading the SAME term (a lease for a term we no longer
+        # lead would outlive our authority).
+        cur = self.sid.sid
+        if not (self.role == Role.LEADER and cur.leader
+                and cur.term == my.term and cur.idx == self.idx):
+            return
+        if have_majority(mask, self.cid):
+            self._lease_until = max(
+                self._lease_until,
+                t0 + self.cfg.hb_timeout * (1.0 - self.cfg.lease_margin))
+            self.stats["lease_renewals"] = \
+                self.stats.get("lease_renewals", 0) + 1
 
     def _serve_reads(self, now: float) -> None:
         """Answer pending linearizable reads (ep_dp_reply_read_req
@@ -1253,9 +1354,30 @@ class Node:
             return
         if not any(self.log.apply >= r.wait_idx for r in self._pending_reads):
             return
+        if self._lease_valid(now):
+            # Lease path: the quorum-acked heartbeat round IS the
+            # leadership proof for every read registered before it —
+            # serve all ready reads from local state, no majority round.
+            for r in self._pending_reads:
+                if self.log.apply < r.wait_idx:
+                    continue
+                try:
+                    r.reply = self.sm.query(r.data)
+                except Exception:
+                    r.reply = None
+                    r.error = True
+                r.done = True
+                self.reads_done += 1
+                self.stats["lease_reads"] = \
+                    self.stats.get("lease_reads", 0) + 1
+            self._pending_reads = [r for r in self._pending_reads
+                                   if not r.done]
+            return
         newest = max(r.registered_at for r in self._pending_reads
                      if self.log.apply >= r.wait_idx)
         if self._leader_verified_seq < newest:
+            self.stats["readindex_verifies"] = \
+                self.stats.get("readindex_verifies", 0) + 1
             if not self._verify_leadership(now):
                 return
         # Re-derive the ready set AFTER verification: the transport
@@ -1271,6 +1393,7 @@ class Node:
                 r.reply = None
                 r.error = True
             r.done = True
+            self.reads_done += 1
         self._pending_reads = [r for r in self._pending_reads if not r.done]
 
     def _verify_leadership(self, now: float) -> bool:

@@ -530,6 +530,7 @@ def send_fault(addr: str, cmd: dict,
     try:
         with socket.create_connection((host, int(port)),
                                       timeout=timeout) as s:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             s.settimeout(timeout)
             s.sendall(wire.frame(
                 wire.u8(OP_FAULT) + wire.blob(json.dumps(cmd).encode())))

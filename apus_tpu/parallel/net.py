@@ -70,6 +70,13 @@ class PeerServer:
             self._sock.bind((host, port))
         self._sock.listen(64)
         self.addr = self._sock.getsockname()
+        #: Optional pipelined-burst handler, installed by the daemon:
+        #: called with a LIST of already-queued request frames, returns
+        #: the reply payloads (same order) or None to decline — the
+        #: frames then dispatch sequentially.  Lets K pipelined client
+        #: ops share one lock acquisition + one commit wait instead of
+        #: serializing: op i+1 is admitted before op i's commit.
+        self.batch_hook = None
         self._stop = threading.Event()
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
@@ -137,13 +144,50 @@ class PeerServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    #: Max frames drained per burst before replying (bounds the reply
+    #: latency of the first op in an endless inbound stream).
+    MAX_BURST = 256
+
     def _serve(self, conn: socket.socket) -> None:
+        stream = wire.FrameStream(conn)
         try:
             while not self._stop.is_set():
-                req = wire.read_frame(conn)
+                req = stream.next_frame()
                 if req is None or self._stop.is_set():
                     return
-                conn.sendall(wire.frame(self._dispatch(req)))
+                # Pipelined clients write many frames before reading
+                # replies: drain whatever is ALREADY queued (buffered
+                # by the stream's large recv, or a zero-wait poll — a
+                # lone request never stalls here) and hand the burst to
+                # the batch hook, so K ops pay one lock acquisition and
+                # one commit wait, with the replies leaving in one
+                # vectored flush.
+                batch = [req]
+                while len(batch) < self.MAX_BURST:
+                    more = stream.try_next()
+                    if more is None:
+                        break
+                    batch.append(more)
+                eof = stream.at_eof
+                if len(batch) == 1:
+                    conn.sendall(wire.frame(self._dispatch(req)))
+                else:
+                    replies = None
+                    hook = self.batch_hook
+                    if hook is not None:
+                        try:
+                            replies = hook(batch)
+                        except Exception:
+                            if self._logger is not None:
+                                self._logger.exception("batch hook failed")
+                            replies = None
+                    if replies is None:
+                        # Sequential fallback preserves request order —
+                        # the contract peer-transport exchanges rely on.
+                        replies = [self._dispatch(b) for b in batch]
+                    wire.send_frames(conn, replies)
+                if eof:
+                    return
         except (OSError, ConnectionError, ValueError):
             pass
         finally:
@@ -179,7 +223,23 @@ class PeerServer:
             slot = r.u8()
             value = wire.decode_value(r)
             res = onesided.apply_ctrl_write(node, region, slot, value)
-            return wire.u8(_ST_OF_RESULT[res])
+            # Read-lease support (live stack only — the sim path calls
+            # onesided directly and stays clock-pure).  (a) A valid
+            # leader heartbeat stamps _last_hb_seen at DELIVERY, under
+            # this lock: the no-vote-while-leader-alive promise then
+            # starts at delivery time, not at the next tick's region
+            # scan — the window the lease-safety proof needs closed.
+            # (b) The reply echoes our current SID: the writer counts
+            # this peer toward its lease quorum only when the echoed
+            # term proves we had not moved past its term at reply time.
+            if region is Region.HB and isinstance(value, int):
+                s = Sid.unpack(value)
+                if s.leader and s.idx == slot \
+                        and s.term >= node.current_term:
+                    node._last_hb_seen = max(node._last_hb_seen,
+                                             time.monotonic())
+                    node.group_contact = True
+            return wire.u8(_ST_OF_RESULT[res]) + wire.u64(node.sid.word)
         if op == wire.OP_CTRL_READ:
             region = wire.REGION_LIST[r.u8()]
             slot = r.u8()
@@ -267,6 +327,9 @@ class NetTransport(Transport):
         self.retries = retries
         self._retry_rng = random.Random(0x5EED ^ len(peers))
         self.stats = {"retries": 0, "retries_ok": 0}
+        #: peer -> (sid_word, monotonic arrival time) from ctrl-write
+        #: reply echoes (read-lease renewal evidence; see ctrl_write).
+        self.peer_sid_seen: dict[int, tuple[int, float]] = {}
         self._conns: dict[int, socket.socket] = {}
         self._down_until: dict[int, float] = {}
         self._peer_locks: dict[int, threading.Lock] = {}
@@ -517,6 +580,14 @@ class NetTransport(Transport):
         resp = self._roundtrip(target, payload)
         if resp is None:
             return WriteResult.DROPPED
+        if len(resp) >= 9:
+            # The reply echoes the target's current SID word: recorded
+            # per peer with its arrival time — the read-lease renewal
+            # proof (Node._send_heartbeats counts a peer toward the
+            # lease quorum only when the echo is from THIS round and
+            # its term has not moved past ours).
+            self.peer_sid_seen[target] = \
+                (wire.Reader(resp[1:9]).u64(), time.monotonic())
         return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
 
     def ctrl_read(self, target: int, region: Region, slot: int) -> Any:

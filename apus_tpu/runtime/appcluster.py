@@ -319,6 +319,20 @@ class RespClient(_CrlfClient):
         self.sock.sendall(b"".join(out))
         return self._reply()
 
+    def pipeline_cmds(self, cmds: list[tuple]) -> list:
+        """redis-benchmark -P analog: write every command in one
+        coalesced flush, then read all replies — through the
+        interposer this lands a burst of captured records at the
+        leader in one go, exercising the daemon's group-commit drain."""
+        out = []
+        for args in cmds:
+            out.append(b"*%d\r\n" % len(args))
+            for a in args:
+                b = a.encode() if isinstance(a, str) else a
+                out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(out))
+        return [self._reply() for _ in cmds]
+
     def _reply(self):
         line = self._line()
         t, rest = line[:1], line[1:]
@@ -395,6 +409,15 @@ class LineClient:
 
     def cmd(self, line: str) -> str:
         self.sock.sendall(line.encode() + b"\n")
+        return self._reply()
+
+    def pipeline_cmds(self, lines: list[str]) -> list[str]:
+        """Pipelined line-protocol burst: one coalesced write, then all
+        replies (see RespClient.pipeline_cmds)."""
+        self.sock.sendall(b"".join(ln.encode() + b"\n" for ln in lines))
+        return [self._reply() for _ in lines]
+
+    def _reply(self) -> str:
         while b"\n" not in self._buf:
             chunk = self.sock.recv(65536)
             if not chunk:

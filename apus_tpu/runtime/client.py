@@ -64,7 +64,7 @@ def make_client_ops(daemon) -> dict:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
-                daemon.commit_cond.wait(min(left, 0.05))
+                daemon.commit_cond.wait(min(left, 0.25))
 
     def clt_read(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
@@ -86,7 +86,7 @@ def make_client_ops(daemon) -> dict:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
-                daemon.commit_cond.wait(min(left, 0.05))
+                daemon.commit_cond.wait(min(left, 0.25))
 
     def status(r: wire.Reader) -> bytes:
         """Observability probe (ops tooling / process launchers): role,
@@ -123,6 +123,15 @@ def make_client_ops(daemon) -> dict:
                 # watches it) — absent for non-relay SMs.
                 "sm_records": getattr(n.sm, "record_count", None),
                 "sm_record_bytes": getattr(n.sm, "record_bytes", None),
+                # Throughput-path observability: lease-served vs
+                # read-index-verified reads, and group-commit coalescing
+                # (drain windows vs entries admitted through them).
+                "lease_reads": n.stats.get("lease_reads", 0),
+                "readindex_verifies": n.stats.get("readindex_verifies", 0),
+                "lease_renewals": n.stats.get("lease_renewals", 0),
+                "drain_windows": n.stats.get("drain_windows", 0),
+                "drain_entries": n.stats.get("drain_entries", 0),
+                "repl_windows": n.stats.get("repl_windows", 0),
             }
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
@@ -177,6 +186,78 @@ def make_client_ops(daemon) -> dict:
             OP_STATUS: status, OP_MAINT_READS: maint_reads}
 
 
+def make_client_batch_hook(daemon):
+    """Pipelined-burst handler for the daemon's PeerServer
+    (PeerServer.batch_hook): a burst of CLT_WRITE/CLT_READ frames is
+    admitted under ONE node-lock acquisition — group-commit admission:
+    op i+1 enters the log window before op i's commit, so K pipelined
+    ops share ~one replication round instead of paying K — and then
+    runs ONE commit wait for the whole window, replying in request
+    order.  Returns None (decline -> sequential dispatch) when the
+    burst contains any non-client op."""
+
+    def hook(frames: list[bytes]):
+        parsed = []
+        for f in frames:
+            r = wire.Reader(f)
+            op = r.u8()
+            if op not in (OP_CLT_WRITE, OP_CLT_READ):
+                return None
+            parsed.append((op, r.u64(), r.u64(), r.blob()))
+        with daemon.lock:
+            handles = [daemon.node.submit(req_id, clt_id, data)
+                       if op == OP_CLT_WRITE
+                       else daemon.node.read(req_id, clt_id, data)
+                       for op, req_id, clt_id, data in parsed]
+        replies: list = [None] * len(parsed)
+
+        def _resolve(i: int) -> bool:
+            """Reply for op i if it is decided (under the lock)."""
+            op, req_id, _clt, _d = parsed[i]
+            h = handles[i]
+            if h is None:
+                replies[i] = _not_leader(daemon, req_id)
+                return True
+            if op == OP_CLT_WRITE:
+                # Reply-sentinel gate, exactly as the single-op path:
+                # apply position alone can be satisfied by a DIFFERENT
+                # entry after truncation.
+                if h.reply is None:
+                    return False
+                replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                              + wire.blob(h.reply))
+                return True
+            if not h.done:
+                return False
+            if h.error:
+                replies[i] = wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+            else:
+                replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                              + wire.blob(h.reply or b""))
+            return True
+
+        deadline = time.monotonic() + daemon.client_op_timeout
+        with daemon.commit_cond:
+            while True:
+                unresolved = [i for i in range(len(parsed))
+                              if replies[i] is None and not _resolve(i)]
+                if not unresolved:
+                    return replies
+                if not daemon.node.is_leader:
+                    for i in unresolved:
+                        replies[i] = _not_leader(daemon, parsed[i][1])
+                    return replies
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    for i in unresolved:
+                        replies[i] = (wire.u8(ST_TIMEOUT)
+                                      + wire.u64(parsed[i][1]))
+                    return replies
+                daemon.commit_cond.wait(min(left, 0.25))
+
+    return hook
+
+
 def set_follower_reads(addr: str, allow: bool,
                        timeout: float = 2.0) -> bool:
     """Flip one daemon's stale-follower-reads maintenance gate (see
@@ -185,6 +266,7 @@ def set_follower_reads(addr: str, allow: bool,
     try:
         with socket.create_connection((host, int(port)),
                                       timeout=timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(timeout)
             conn.sendall(wire.frame(wire.u8(OP_MAINT_READS)
                                     + wire.u8(1 if allow else 0)))
@@ -202,6 +284,7 @@ def probe_status(addr: str, timeout: float = 0.5) -> Optional[dict]:
     try:
         with socket.create_connection((host, int(port)),
                                       timeout=timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(timeout)
             conn.sendall(wire.frame(wire.u8(OP_STATUS)))
             resp = wire.read_frame(conn)
@@ -293,6 +376,11 @@ class ApusClient:
         self._req_seq = 0
         self._leader: Optional[int] = None
         self._conns: dict[int, socket.socket] = {}
+        # One buffered frame stream per connection: ALL reads on a
+        # connection go through it (bytes it buffered are invisible to
+        # direct socket reads), and a pipelined burst's replies are
+        # ingested in ~one recv.
+        self._streams: dict[int, wire.FrameStream] = {}
         #: client-side fault observability (stale_replies = discarded
         #: duplicated/reordered reply frames)
         self.stats: dict[str, int] = {}
@@ -309,6 +397,7 @@ class ApusClient:
             except OSError:
                 pass
         self._conns.clear()
+        self._streams.clear()
 
     def __enter__(self) -> "ApusClient":
         return self
@@ -325,6 +414,124 @@ class ApusClient:
     def read(self, data: bytes) -> bytes:
         self._req_seq += 1
         return self._op(OP_CLT_READ, self._req_seq, data)
+
+    # -- pipelined ops ----------------------------------------------------
+
+    #: default in-flight window for pipeline() — matches the device
+    #: engine's 64-entry slot window, so one full client window can ride
+    #: one replicated commit round.
+    pipeline_window = 64
+
+    def pipeline(self, ops, window: Optional[int] = None) -> list[bytes]:
+        """Pipelined batch: write up to ``window`` framed requests ahead
+        of reading replies (one vectored flush per sub-window), pairing
+        replies by the echoed req_id — out-of-order and duplicated
+        frames are discarded/reordered exactly as the single-op path.
+        ``ops`` is a sequence of ``(op, data)`` with op in
+        {OP_CLT_WRITE, OP_CLT_READ}.  Returns the reply bodies in op
+        order.  Failover-safe: unresolved ops are resent to the next
+        target with the SAME req_ids, and the server-side dedup
+        (core.epdb) keeps retried writes exactly-once."""
+        window = window or self.pipeline_window
+        items = []
+        for op, data in ops:
+            self._req_seq += 1
+            items.append((op, self._req_seq, data))
+        results: dict[int, bytes] = {}
+        deadline = time.monotonic() + self.timeout
+        target = self._leader
+        pending = items
+        while pending:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{len(pending)} of {len(items)} pipelined ops not "
+                    f"served in {self.timeout}s")
+            if target is None:
+                target = self._probe_any(deadline)
+                if target is None:
+                    continue
+            outcome, hint = self._pipeline_attempt(
+                target, pending, results, deadline, window)
+            pending = [it for it in pending if it[1] not in results]
+            if outcome == "hint":
+                target = self._peer_index(hint) if hint \
+                    else self._next(target)
+                time.sleep(0.01)
+            elif outcome != "ok":
+                target = self._next(target)
+        return [results[req_id] for _op, req_id, _d in items]
+
+    def pipeline_writes(self, datas) -> list[bytes]:
+        return self.pipeline([(OP_CLT_WRITE, d) for d in datas])
+
+    def pipeline_reads(self, datas) -> list[bytes]:
+        return self.pipeline([(OP_CLT_READ, d) for d in datas])
+
+    def pipeline_puts(self, pairs) -> list[bytes]:
+        from apus_tpu.models.kvs import encode_put
+        return self.pipeline_writes(
+            [encode_put(k, v) for k, v in pairs])
+
+    def pipeline_gets(self, keys) -> list[bytes]:
+        from apus_tpu.models.kvs import encode_get
+        return self.pipeline_reads([encode_get(k) for k in keys])
+
+    def _pipeline_attempt(self, target: int, items: list, results: dict,
+                          deadline: float, window: int):
+        """One pipelined exchange against ``target``.  Returns
+        ("ok", None) when every item resolved, ("hint", addr_or_None)
+        on NOT_LEADER, ("rotate", None) on a peer-side commit timeout,
+        ("conn", None) on connection trouble — unresolved items stay
+        out of ``results`` and are retried by the caller."""
+        conn = self._connect(target, deadline)
+        if conn is None:
+            return "conn", None
+        queue = list(items)
+        inflight: dict[int, tuple] = {}
+        try:
+            while queue or inflight:
+                if queue and len(inflight) < window:
+                    burst = queue[:window - len(inflight)]
+                    del queue[:len(burst)]
+                    wire.send_frames(conn, [
+                        wire.u8(op) + wire.u64(rid)
+                        + wire.u64(self.clt_id) + wire.blob(data)
+                        for op, rid, data in burst])
+                    for it in burst:
+                        inflight[it[1]] = it
+                conn.settimeout(max(0.05, min(
+                    deadline - time.monotonic(), self.attempt_timeout)))
+                resp = self._streams[target].next_frame()
+                if resp is None:
+                    raise ConnectionError("peer closed")
+                if len(resp) < 9:
+                    raise ValueError("short reply frame")
+                rid = wire.Reader(resp[1:9]).u64()
+                if rid not in inflight:
+                    # Duplicated/reordered stale frame (or the tail of
+                    # an aborted earlier exchange on this connection).
+                    self.stats["stale_replies"] = \
+                        self.stats.get("stale_replies", 0) + 1
+                    continue
+                st = resp[0]
+                if st == wire.ST_OK:
+                    self._leader = target
+                    results[rid] = wire.Reader(resp[9:]).blob()
+                    del inflight[rid]
+                elif st == ST_NOT_LEADER:
+                    hint = wire.Reader(resp[9:]).blob().decode() \
+                        if len(resp) > 9 else ""
+                    return "hint", (hint or None)
+                elif st == ST_TIMEOUT:
+                    # The peer led but could not commit in its window:
+                    # rotate (same rationale as the single-op path).
+                    return "rotate", None
+                else:
+                    raise RuntimeError(f"server error (status {st})")
+            return "ok", None
+        except (OSError, ConnectionError, ValueError):
+            self._drop(target)
+            return "conn", None
 
     # -- kvs convenience (the DARE client's PUT/GET/RM, dare_kvs_sm.c) ----
 
@@ -414,6 +621,7 @@ class ApusClient:
                 timeout=max(0.05, min(1.0, deadline - time.monotonic())))
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns[target] = conn
+            self._streams[target] = wire.FrameStream(conn)
             return conn
         except OSError:
             return None
@@ -433,8 +641,9 @@ class ApusClient:
             conn.settimeout(max(0.05, min(deadline - time.monotonic(),
                                           self.attempt_timeout)))
             conn.sendall(wire.frame(payload))
+            stream = self._streams[target]
             while True:
-                resp = wire.read_frame(conn)
+                resp = stream.next_frame()
                 if resp is None:
                     raise ConnectionError("peer closed")
                 if len(resp) >= 9 and \
@@ -448,6 +657,7 @@ class ApusClient:
             return None
 
     def _drop(self, target: int) -> None:
+        self._streams.pop(target, None)
         conn = self._conns.pop(target, None)
         if conn is not None:
             try:

@@ -88,6 +88,7 @@ class ReplicaDaemon:
             max_batch=spec.max_batch, auto_remove=spec.auto_remove,
             fail_window=spec.fail_window, recovery_start=recovery_start,
             seed=seed,
+            read_lease=spec.read_lease, lease_margin=spec.lease_margin,
             # Segment oversized records so every entry stays device-
             # eligible (slot width minus wire-codec + envelope headroom;
             # DeviceCommitRunner.max_data_bytes is the contract).  With
@@ -114,6 +115,11 @@ class ReplicaDaemon:
                                  host=host, port=port, sock=listen_sock,
                                  extra_ops=self._extra_ops(),
                                  logger=self.logger)
+        # Pipelined client bursts: admit a whole burst of client ops
+        # under one lock acquisition + one commit wait (group-commit
+        # admission; see make_client_batch_hook).
+        from apus_tpu.runtime.client import make_client_batch_hook
+        self.server.batch_hook = make_client_batch_hook(self)
 
         # Committed-entry observers (proxy callback table analog):
         # each gets (LogEntry); registered by persistence/replay layers.
@@ -175,7 +181,13 @@ class ReplicaDaemon:
         self._last_role = None
         # Client-facing handlers wait on this instead of polling the
         # lock (K pollers at 0.2 ms would starve the tick thread).
+        # Wakes are WINDOW-GRANULAR: the tick thread notifies only when
+        # a waiter-visible event happened this tick (apply/commit
+        # advanced, role/term changed, a read was served) — not every
+        # tick, which at 0.5 ms cadence thrashed every parked handler
+        # thread 2000x/s for nothing.
         self.commit_cond = threading.Condition(self.lock)
+        self._wake_state = None
 
     # -- extra (two-sided) control ops ------------------------------------
 
@@ -295,7 +307,12 @@ class ReplicaDaemon:
                     self._log_role_changes()
                     for cb in self.on_tick:
                         cb()
-                    self.commit_cond.notify_all()
+                    n = self.node
+                    wake = (n.log.apply, n.log.commit, n.role,
+                            n.current_term, n.reads_done)
+                    if wake != self._wake_state:
+                        self._wake_state = wake
+                        self.commit_cond.notify_all()
             except Exception:
                 # A tick must never silently kill the replica (a dead
                 # tick thread with a live PeerServer is a zombie that
@@ -389,7 +406,11 @@ class ReplicaDaemon:
         """Block until the request is applied (the proxy release analog,
         proxy_update_state proxy.c:263-267).  Success is gated on the
         reply sentinel — commit/apply position alone can be satisfied by
-        a DIFFERENT entry after a truncation."""
+        a DIFFERENT entry after a truncation.  Wakes are event-driven
+        (the tick thread notifies per applied window / role change);
+        the residual wait cap is only a missed-wake backstop, not the
+        completion mechanism — the old fixed 0.05 s cap added up to
+        50 ms of tail latency per op even when commit was instant."""
         deadline = time.monotonic() + timeout
         with self.commit_cond:
             while True:
@@ -400,7 +421,7 @@ class ReplicaDaemon:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return False
-                self.commit_cond.wait(min(left, 0.05))
+                self.commit_cond.wait(min(left, 0.25))
 
 
 # -- CLI: one replica as a standalone OS process ---------------------------

@@ -329,6 +329,15 @@ class DeviceCommitRunner:
                                live=set(range(R)))
         devlog, acks, commit = self._step(devlog, bdata, bmeta, ctrl)
         self._jax.block_until_ready(self._pack_result(acks, commit))
+        # CHAINED second dispatch: feeding the device-resident outputs
+        # back re-specializes the program once (the jit cache keys on
+        # the operands' output shardings, which differ from
+        # make_device_log's fresh placement).  Without this the SECOND
+        # live round pays that compile mid-leadership — ~0.5 s on a
+        # loaded CPU host, which races the driver's stall watchdog and
+        # flips commit ownership to the host path for no real fault.
+        devlog, acks, commit = self._step(devlog, bdata, bmeta, ctrl)
+        self._jax.block_until_ready(self._pack_result(acks, commit))
         # Pipelined program too (compiled now, never mid-leadership),
         # reusing the step's returned devlog — a second make_device_log
         # would allocate+transfer another full shard set just to warm a
@@ -347,12 +356,39 @@ class DeviceCommitRunner:
         sdata, smeta = self._place_staged(
             np.zeros((self.PIPE_DEPTH, B, SB), np.uint8),
             np.zeros((self.PIPE_DEPTH, B, 4), np.int32), 0)
-        wctrl = self._make_ctrl(Cid.initial(min(R, 13)), 0, 1, 1,
-                                live=set(range(R)))
-        self._ctrl_cache = None          # warm ctrl is throwaway
-        devlog, commits, rounds_run, _ = self._window(
+        # Two dispatches, replaying commit_window's LIVE ctrl-cache
+        # sequence: the first runs with a fresh host-valued ctrl, then
+        # the donated output masks (ctrl2 — device-resident,
+        # differently-sharded arrays) are adopted into _ctrl_cache
+        # exactly as commit_window does, and the second dispatch runs
+        # with the cache-derived ctrl.  That second SIGNATURE is what
+        # every live window after the first uses — unwarmed, it cost a
+        # ~0.5 s recompile on the SECOND client op of each fresh
+        # leadership, tripping the stall watchdog into a host-path
+        # fallback with no real fault.
+        self._ctrl_cache = None
+        wcid = Cid.initial(min(R, 13))
+        wctrl = self._make_ctrl(wcid, 0, 1, 1, live=set(range(R)))
+        devlog, commits, rounds_run, wctrl2 = self._window(
             devlog, sdata, smeta, wctrl, self.PIPE_DEPTH, 1)
         self._jax.block_until_ready(self._pack_result(commits, rounds_run))
+        self._ctrl_cache = (self._ctrl_cache[0], wctrl2)
+        wctrl = self._make_ctrl(wcid, 0, 1, 1, live=set(range(R)))
+        devlog, commits, rounds_run, wctrl2 = self._window(
+            devlog, sdata, smeta, wctrl, self.PIPE_DEPTH, 1)
+        self._jax.block_until_ready(self._pack_result(commits, rounds_run))
+        # Adopt the latest donated masks (the previous generation was
+        # just consumed by donation — live commit_window re-adopts the
+        # same way after every dispatch).
+        self._ctrl_cache = (self._ctrl_cache[0], wctrl2)
+        # Single-round step with the cache-derived (device-resident)
+        # ctrl too: a live commit_round that follows any window round
+        # sees this signature via the shared _make_ctrl cache.
+        devlog, acks, commit = self._step(
+            devlog, bdata, bmeta,
+            self._make_ctrl(wcid, 0, 1, 1, live=set(range(R))))
+        self._jax.block_until_ready(self._pack_result(acks, commit))
+        self._ctrl_cache = None          # warm ctrl is throwaway
         # Reader paths too (follower drain batch + window gathers,
         # shard_end poll): their first use otherwise compiles
         # mid-drain, stalling a live follower for seconds.
@@ -1045,7 +1081,20 @@ class DevicePlaneDriver:
             return False
         # Micro-batching: take a partial unit only once arrivals pause
         # (one poll of delay), so bursts fill rounds instead of padding.
-        if end - self._dev_next < unit and end != self._last_end_seen:
+        # Queue-occupancy feed: ops admitted but NOT YET APPENDED
+        # (idx is None) will land in the log next tick (group-commit
+        # drain), so a partial window is also deferred while such ops
+        # are queued — the window depth the dispatch below picks then
+        # reflects the real backlog, not the slice of it that happened
+        # to be appended when we looked.  Strictly un-appended ops
+        # only: _pending also holds appended-but-uncommitted handles,
+        # and gating on those would deadlock (their commit needs this
+        # very dispatch).  Gated on log headroom too: a full ring must
+        # not wedge dispatch waiting for admissions that cannot land.
+        if end - self._dev_next < unit and (
+                end != self._last_end_seen
+                or (not node.log.near_full(3)
+                    and any(p.idx is None for p in node._pending))):
             self._last_end_seen = end
             return False
         self._last_end_seen = end

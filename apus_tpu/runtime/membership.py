@@ -136,6 +136,7 @@ def _roundtrip(addr: str, payload: bytes,
                 (host, int(port)),
                 timeout=max(0.05, min(2.0, deadline - time.monotonic()))) \
                 as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(max(0.05, deadline - time.monotonic()))
             conn.sendall(wire.frame(payload))
             return wire.read_frame(conn)

@@ -100,6 +100,15 @@ class ClusterSpec:
     fault_plane: bool = False
     fault_seed: int = 0
     fault_schedule: str = ""
+    # Leader read lease (core.node NodeConfig.read_lease): linearizable
+    # reads answered from the leader's local applied state while a
+    # quorum-acked heartbeat lease holds — no per-read majority round.
+    # Lease duration = hb_timeout * (1 - lease_margin), anchored at the
+    # heartbeat round's start; the margin absorbs monotonic clock-rate
+    # drift + scheduling skew across replicas.  Disable to force every
+    # read through the read-index verification path.
+    read_lease: bool = True
+    lease_margin: float = 0.2
     # Misdirection gate: False (default) = a non-leader's proxy REFUSES
     # client bytes to its raw app (the client reconnects and finds the
     # leader — structurally no unreplicated reads/writes; beyond the

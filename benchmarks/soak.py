@@ -80,6 +80,14 @@ def main() -> int:
     ap.add_argument("--fault-every", type=float, default=30.0,
                     help="with --fault-seed: seconds between injected "
                          "fault bursts")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run a SIDE stream of pipelined ApusClient "
+                         "windows (64-deep PUT bursts + lease GETs) "
+                         "against the daemons' client ops for the "
+                         "whole soak, so the batched admission / "
+                         "group-commit / lease-read path is exercised "
+                         "alongside the proxied app traffic (counted "
+                         "separately in the result)")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -243,6 +251,22 @@ def main() -> int:
                     pass
             return leader, client
 
+        # --pipeline: drive the app in pipelined bursts (one coalesced
+        # write of PIPE_W SETs, then all replies — redis-benchmark -P
+        # style).  Through the interposer the burst lands at the
+        # leader's daemon as a burst of captured records, exercising
+        # the group-commit drain + batched device dispatch the whole
+        # soak, with the same GET-after-SET verification per burst.
+        PIPE_W = 32
+        pipe_windows = 0
+
+        def do_pipeline_set(c, kvs) -> bool:
+            if args.toyserver:
+                rs = c.pipeline_cmds([f"SET {k} {v}" for k, v in kvs])
+            else:
+                rs = c.pipeline_cmds([("SET", k, v) for k, v in kvs])
+            return all(r == "OK" for r in rs)
+
         t0 = time.monotonic()
         while time.monotonic() < t_end:
             now = time.monotonic()
@@ -298,7 +322,22 @@ def main() -> int:
             v = f"v{seq}".ljust(32, "x")
             seq += 1
             try:
-                if not do_set(client, k, v):
+                if args.pipeline:
+                    kvs = [(k, v)]
+                    for _ in range(PIPE_W - 1):
+                        kk = f"soak:{seq % 4000}"
+                        kvs.append((kk, f"v{seq}".ljust(32, "x")))
+                        seq += 1
+                    k, v = kvs[-1]
+                    if not do_pipeline_set(client, kvs):
+                        errors += 1
+                    elif do_get(client, k) != v:
+                        errors += 1
+                    else:
+                        ops += len(kvs) + 1
+                        pipe_windows += 1
+                        last_acked = (k, v)
+                elif not do_set(client, k, v):
                     errors += 1
                 elif do_get(client, k) != v:
                     errors += 1
@@ -392,6 +431,9 @@ def main() -> int:
             "converged": converged,
             "app": "toyserver" if args.toyserver else "redis",
             "replicas": args.replicas,
+            **({"pipeline_window": PIPE_W,
+                "pipeline_windows": pipe_windows}
+               if args.pipeline else {}),
             **({"fault_seed": args.fault_seed,
                 "faults_injected": faults_injected}
                if args.fault_seed is not None else {}),

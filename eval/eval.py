@@ -79,6 +79,22 @@ def _run_tool(argv: list[str], timeout: float, env_extra=CPU_ENV):
     return _json_lines(proc.stdout)
 
 
+def _run_throughput(out) -> None:
+    """Pipelined replicated throughput (bench.py --throughput): 16
+    serial vs 16 pipelined clients on a live 3-replica LocalCluster —
+    raw loopback AND under an emulated client-link RTT — plus the
+    group-commit isolation (max_batch=1) and lease vs read-index GET
+    rows (ISSUE 3 headline)."""
+    print("bench.py --throughput: pipelined replicated throughput")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--throughput"],
+                         timeout=240):
+        _record(out, rec,
+                replicas=rec.get("detail", {}).get("replicas", 3),
+                bench="bench_throughput")
+
+
 def _run_single_window(out) -> None:
     """Single-window (un-amortized) latency: depth-1/depth-4 windows
     through the windowed commit engine, wall p50 + profiler-derived
@@ -99,6 +115,11 @@ def cmd_run(args) -> int:
         if getattr(args, "single_window_only", False):
             # Fast latency-path re-measure: skip the cluster suite.
             _run_single_window(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "throughput_only", False):
+            # Fast throughput-path re-measure: skip the cluster suite.
+            _run_throughput(out)
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -248,6 +269,10 @@ def cmd_run(args) -> int:
         # 3b. The un-amortized single-window counterpart (ISSUE 1
         # headline: wall p50 + device time for depth-1/depth-4).
         _run_single_window(out)
+
+        # 3c. Pipelined replicated throughput (ISSUE 3 headline:
+        # client pipelining + group-commit + read leases end to end).
+        _run_throughput(out)
     print(f"results appended to {RUNS}")
     return 0
 
@@ -361,6 +386,22 @@ def cmd_report(args) -> int:
             f"{_fmt(d4.get('device_time_per_dispatch_us'), 1)} us; "
             f"{last['detail'].get('speedup_vs_r05_single_dispatch')}x vs "
             f"the r05 single-dispatch wall")
+    tput = [r for r in runs if r.get("bench") == "bench_throughput"
+            and isinstance(r.get("value"), (int, float))]
+    if tput:
+        last = tput[-1]
+        d = last["detail"]
+        lines.append(
+            f"- pipelined replicated SET @ {last.get('replicas')} "
+            f"replicas ({d.get('clients')} clients, window "
+            f"{d.get('window')}): {_fmt(last['value'])} ops/sec raw "
+            f"loopback ({d.get('raw_loopback_speedup')}x vs serial); "
+            f"{d.get('pipelined_vs_serial')}x vs serial under "
+            f"{_fmt(d.get('emulated_link_rtt_ms'))} ms emulated client "
+            f"RTT; group-commit gain {d.get('group_commit_gain')}x "
+            f"(max_batch=1 control); lease GETs "
+            f"{_fmt(d.get('gets_lease_ops_per_sec'))} ops/sec vs "
+            f"read-index {_fmt(d.get('gets_readindex_ops_per_sec'))}")
     fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
           and isinstance(r.get("value"), (int, float))]
     ser = {}
@@ -500,6 +541,10 @@ def main() -> int:
                        help="run ONLY the single-window latency "
                             "microbench (fast latency-path re-measure; "
                             "skips the cluster suite)")
+        p.add_argument("--throughput-only", action="store_true",
+                       help="run ONLY the pipelined-throughput bench "
+                            "(bench.py --throughput; skips the cluster "
+                            "suite)")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",
