@@ -27,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from apus_tpu.core.cid import Cid, CidState
 from apus_tpu.core.election import (AdaptiveTimeout, VoteRequest,
@@ -96,8 +96,10 @@ class NodeConfig:
     # was still at our term — extends the lease to round-start +
     # hb_timeout * (1 - lease_margin).  Safety (proven under the
     # FaultPlane e2e): the peer server stamps _last_hb_seen at HB
-    # delivery, a lease_guard voter refuses real votes while within
-    # hb_timeout of a heartbeat, so any new leader's election happens
+    # delivery, and EVERY voter — lease_guard is unconditional, so a
+    # config-skewed voter cannot void the leader's lease — refuses
+    # real votes while within hb_timeout of a heartbeat, so any new
+    # leader's election happens
     # >= round-start + hb_timeout — after every lease granted from that
     # round expired.  lease_margin absorbs clock-RATE drift between the
     # replicas' monotonic clocks over the (tiny) lease window plus
@@ -263,8 +265,18 @@ class Node:
         self._await_contact = cfg.recovery_start
         self._contact_deadline: Optional[float] = None
         self._now = 0.0                     # last tick clock (sim-safe)
+        # Fresh clock for SAFETY-side time checks (lease validity).
+        # The tick-start stamp ``_now`` goes stale exactly when it
+        # matters: the heartbeat fan-out blocks on wire roundtrips with
+        # the node lock yielded — precisely while an isolated leader's
+        # ctrl writes time out — and a stale (smaller) clock makes
+        # ``now < _lease_until`` pass MORE easily, not less.  Live
+        # deployments install a real monotonic clock here
+        # (ReplicaDaemon sets time.monotonic); the deterministic sim
+        # leaves it None and the single-threaded tick clock is exact.
+        self.clock: Optional[Callable[[], float]] = None
         # Leader read lease (NodeConfig.read_lease): valid while
-        # _now < _lease_until.  Renewed by quorum-acked heartbeat
+        # fresh-now < _lease_until.  Renewed by quorum-acked heartbeat
         # rounds in _send_heartbeats; cleared on any role change.
         self._lease_until = -1.0
         # Monotone count of completed linearizable reads (lease or
@@ -333,13 +345,18 @@ class Node:
         self._inflight[key] = pr
         return pr
 
-    def read(self, req_id: int, clt_id: int,
-             data: bytes) -> Optional[PendingRead]:
+    def read(self, req_id: int, clt_id: int, data: bytes,
+             min_wait_idx: int = 0) -> Optional[PendingRead]:
         """Register a linearizable read (leader only): answered once
         every entry committed before registration is applied AND
         leadership has been re-verified against a majority
         (ud_clt_answer_read_request + wait_for_idx,
-        dare_ibv_ud.c:1424-1449, dare_ep_db.c:132-161)."""
+        dare_ibv_ud.c:1424-1449, dare_ep_db.c:132-161).
+
+        ``min_wait_idx`` raises the apply floor beyond the read-index
+        rule: the pipelined-burst hook passes the log index just past a
+        burst's earlier writes, giving reads program-order
+        (read-your-write) semantics WITHIN a burst."""
         if not self.is_leader:
             return None
         # Read-index rule: a fresh leader's commit may lag the cluster
@@ -347,16 +364,20 @@ class Node:
         # that entry so the read reflects every previously-committed
         # write (Raft §8 read-only optimization; the reference gets this
         # from poll_config_entries before answering, dare_server.c:1399).
-        wait_idx = max(self.log.commit, self._term_start_idx + 1)
+        wait_idx = max(self.log.commit, self._term_start_idx + 1,
+                       min_wait_idx)
         self._reg_seq += 1
         rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
                          registered_at=self._reg_seq)
         # Lease fast path: everything committed before registration is
         # already applied AND the read lease holds — answer from local
-        # state NOW, no majority round, no tick wait.  _lease_valid
-        # compares against the LAST tick clock (<= real now), so a
-        # lease that looks valid here is valid at the real call time.
-        if self.log.apply >= wait_idx and self._lease_valid(self._now):
+        # state NOW, no majority round, no tick wait.  Validity MUST be
+        # checked against a fresh clock (_fresh_now), never the
+        # tick-start stamp: a stale (smaller) clock would let an
+        # expired lease keep passing ``now < _lease_until`` — and the
+        # stamp freezes exactly when the leader is isolated and its
+        # tick stalls in heartbeat write timeouts.
+        if self.log.apply >= wait_idx and self._lease_valid(self._fresh_now()):
             try:
                 rr.reply = self.sm.query(data)
             except Exception:
@@ -374,6 +395,25 @@ class Node:
         """Leader read lease currently held (see NodeConfig.read_lease)."""
         return (self.cfg.read_lease and self.role == Role.LEADER
                 and now < self._lease_until)
+
+    def _fresh_now(self) -> float:
+        """Freshest available clock (see ``self.clock``): the daemon's
+        real monotonic clock when installed, else the last tick stamp
+        (deterministic sim, where the tick clock is exact)."""
+        return self._now if self.clock is None else self.clock()
+
+    def flush_pending(self) -> None:
+        """Admit queued client writes into the log NOW instead of at
+        the next tick's drain (leader only; no-op otherwise).  The
+        pipelined-burst hook calls this — under the daemon lock — so a
+        same-burst read's wait_idx can cover the indices of the writes
+        before it.  Identical to the tick-time drain and idempotent
+        per handle (drained handles keep their idx).  Declined while
+        the term-start blank is deferred (full-ring election corner):
+        the blank must stay the term's first entry, so those bursts
+        fall back to the tick-time drain."""
+        if self.is_leader and not self._term_blank_pending:
+            self._drain_pending(self.sid.sid)
 
     def handle_join(self, addr: str,
                     want_slot: Optional[int] = None) -> Optional[PendingJoin]:
@@ -796,8 +836,16 @@ class Node:
         last_idx, last_term = self.log.last_determinant()
         leader_alive = (self._known_leader is not None and
                         now - self._last_hb_seen < self._hb_timeout)
+        # lease_guard is UNCONDITIONAL, not cfg.read_lease: the guard
+        # protects the LEADER's lease, whose config this voter cannot
+        # see — keying it on our own flag meant one skewed voter
+        # (launched with read_lease=False) silently voided the cluster
+        # lease safety argument by granting higher-term votes while the
+        # leader's lease was live.  Liveness is unaffected: a dead
+        # leader stops being leader_alive after hb_timeout, and PreVote
+        # already refuses probes while the leader is alive.
         if not should_grant(best, my, last_idx, last_term, leader_alive,
-                            lease_guard=self.cfg.read_lease):
+                            lease_guard=True):
             # A stale candidate: our term may still need to advance so it
             # can retry (higher term observed).
             if best.sid.term > my.term:
@@ -1354,7 +1402,12 @@ class Node:
             return
         if not any(self.log.apply >= r.wait_idx for r in self._pending_reads):
             return
-        if self._lease_valid(now):
+        # Fresh clock, not the tick-start ``now``: the heartbeat
+        # fan-out earlier this tick blocks on wire roundtrips (lock
+        # yielded), so by the time reads are served the stamp can be
+        # arbitrarily stale — and stale-small is the UNSAFE direction
+        # for ``now < _lease_until``.
+        if self._lease_valid(self._fresh_now()):
             # Lease path: the quorum-acked heartbeat round IS the
             # leadership proof for every read registered before it —
             # serve all ready reads from local state, no majority round.

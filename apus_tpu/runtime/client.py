@@ -194,7 +194,16 @@ def make_client_batch_hook(daemon):
     ops share ~one replication round instead of paying K — and then
     runs ONE commit wait for the whole window, replying in request
     order.  Returns None (decline -> sequential dispatch) when the
-    burst contains any non-client op."""
+    burst contains any non-client op.
+
+    Program order WITHIN a burst (redis-pipeline read-your-write): a
+    read observes every write that precedes it in the same burst.  The
+    burst's writes are flushed into the log at admission
+    (Node.flush_pending) and each read registers with a wait_idx floor
+    just past its preceding writes' indices; a read whose preceding
+    write could not enter the log yet (transiently full ring) defers
+    registration to the wait loop, re-tried on each wake (the wake
+    tuple covers log.end, so the append itself wakes us)."""
 
     def hook(frames: list[bytes]):
         parsed = []
@@ -204,16 +213,44 @@ def make_client_batch_hook(daemon):
             if op not in (OP_CLT_WRITE, OP_CLT_READ):
                 return None
             parsed.append((op, r.u64(), r.u64(), r.blob()))
+        handles: list = [None] * len(parsed)
+        registered = [False] * len(parsed)
+
+        def _register_read(i: int) -> None:
+            """Register read i once every preceding write of the burst
+            holds a log index (caller holds the node lock).  Usually
+            immediate; deferred only while the ring is full."""
+            floor = 0
+            for j in range(i):
+                h = handles[j]
+                if parsed[j][0] != OP_CLT_WRITE or h is None:
+                    continue        # reads don't gate; None -> not-leader
+                if h.idx is None:
+                    return          # not in the log yet: retry on wake
+                floor = max(floor, h.idx + 1)
+            op, req_id, clt_id, data = parsed[i]
+            handles[i] = daemon.node.read(req_id, clt_id, data,
+                                          min_wait_idx=floor)
+            registered[i] = True
+
         with daemon.lock:
-            handles = [daemon.node.submit(req_id, clt_id, data)
-                       if op == OP_CLT_WRITE
-                       else daemon.node.read(req_id, clt_id, data)
-                       for op, req_id, clt_id, data in parsed]
+            for i, (op, req_id, clt_id, data) in enumerate(parsed):
+                if op == OP_CLT_WRITE:
+                    handles[i] = daemon.node.submit(req_id, clt_id, data)
+                    registered[i] = True
+            daemon.node.flush_pending()
+            for i, (op, *_rest) in enumerate(parsed):
+                if op == OP_CLT_READ:
+                    _register_read(i)
         replies: list = [None] * len(parsed)
 
         def _resolve(i: int) -> bool:
             """Reply for op i if it is decided (under the lock)."""
             op, req_id, _clt, _d = parsed[i]
+            if not registered[i]:
+                _register_read(i)
+                if not registered[i]:
+                    return False
             h = handles[i]
             if h is None:
                 replies[i] = _not_leader(daemon, req_id)
@@ -429,9 +466,14 @@ class ApusClient:
         frames are discarded/reordered exactly as the single-op path.
         ``ops`` is a sequence of ``(op, data)`` with op in
         {OP_CLT_WRITE, OP_CLT_READ}.  Returns the reply bodies in op
-        order.  Failover-safe: unresolved ops are resent to the next
-        target with the SAME req_ids, and the server-side dedup
-        (core.epdb) keeps retried writes exactly-once."""
+        order, with redis-pipeline program-order semantics: a read
+        observes every write earlier in the same pipeline call (the
+        server floors each read's wait index past the burst's earlier
+        writes; it may additionally observe later writes that applied
+        in the same commit window).  Failover-safe: unresolved ops are
+        resent to the next target with the SAME req_ids, and the
+        server-side dedup (core.epdb) keeps retried writes
+        exactly-once."""
         window = window or self.pipeline_window
         items = []
         for op, data in ops:

@@ -100,6 +100,11 @@ class ReplicaDaemon:
                           - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
+        # Lease-validity checks must see REAL time, not the tick-start
+        # stamp: an isolated leader's tick stalls in heartbeat write
+        # timeouts with the lock yielded, freezing the stamp exactly
+        # while client handler threads keep consulting the lease.
+        self.node.clock = time.monotonic
         # Live deployments stream snapshots off-tick (a multi-second
         # chunked push inline would pause this replica's heartbeats);
         # the deterministic sim keeps the inline path.
@@ -308,8 +313,18 @@ class ReplicaDaemon:
                     for cb in self.on_tick:
                         cb()
                     n = self.node
-                    wake = (n.log.apply, n.log.commit, n.role,
-                            n.current_term, n.reads_done)
+                    # Waiter-predicate contract: every commit_cond
+                    # waiter's wake condition must be a function of
+                    # this tuple — reply/done/join sentinels are set
+                    # during apply (apply moves), served reads bump
+                    # reads_done, leadership loss moves role/term, and
+                    # log.end covers append-only progress (a pipelined
+                    # burst's deferred read registration waits on its
+                    # writes entering the log).  Deadline expiry needs
+                    # no notify: every waiter bounds its wait by the
+                    # time left to its own deadline.
+                    wake = (n.log.apply, n.log.commit, n.log.end,
+                            n.role, n.current_term, n.reads_done)
                     if wake != self._wake_state:
                         self._wake_state = wake
                         self.commit_cond.notify_all()
@@ -408,9 +423,12 @@ class ReplicaDaemon:
         reply sentinel — commit/apply position alone can be satisfied by
         a DIFFERENT entry after a truncation.  Wakes are event-driven
         (the tick thread notifies per applied window / role change);
-        the residual wait cap is only a missed-wake backstop, not the
-        completion mechanism — the old fixed 0.05 s cap added up to
-        50 ms of tail latency per op even when commit was instant."""
+        the residual 0.25 s wait cap is only a missed-wake backstop,
+        never on the latency path: completion events notify (see the
+        wake-tuple contract in _run), and deadline expiry is exact
+        because the final wait is bounded by ``left`` itself — the old
+        fixed 0.05 s cap, by contrast, was the completion mechanism
+        and added up to 50 ms of tail latency per op."""
         deadline = time.monotonic() + timeout
         with self.commit_cond:
             while True:

@@ -77,6 +77,27 @@ def test_pipeline_mixed_ops_interleaved():
             assert out[1::2] == [b"0"] * 50
 
 
+def test_pipeline_burst_read_your_write():
+    """Program order WITHIN a burst: a read pipelined directly after a
+    write to the SAME key (distinct key per pair, so later writes can't
+    mask a miss) returns the just-written value — the batch hook floors
+    each read's wait_idx past its preceding burst writes, so the lease
+    fast path can never answer from pre-write state."""
+    with LocalCluster(3, spec=ClusterSpec(**SPEC)) as c:
+        c.wait_for_leader()
+        time.sleep(0.1)         # lease granted: fast path is the one in play
+        with ApusClient(list(c.spec.peers), timeout=20.0) as cl:
+            ops = []
+            for i in range(50):
+                ops.append((OP_CLT_WRITE,
+                            encode_put(b"rw%03d" % i, b"rv%03d" % i)))
+                ops.append((OP_CLT_READ, encode_get(b"rw%03d" % i)))
+            out = cl.pipeline(ops)
+            assert out[0::2] == [b"OK"] * 50
+            assert out[1::2] == [b"rv%03d" % i for i in range(50)], \
+                "a burst read missed the write pipelined before it"
+
+
 @pytest.mark.faultplane
 def test_pipeline_exactly_once_under_dup_reorder_drop():
     """Pipelined client against a cluster whose replica transports run
@@ -363,6 +384,70 @@ def test_lease_read_safety_under_isolation():
             time.sleep(0.02)
         with old.lock:
             assert old.node.sm.query(encode_get(b"lease-k")) == b"v2"
+
+
+def test_lease_fast_path_checks_fresh_clock():
+    """The lease fast path must validate against REAL time, not the
+    (possibly stale) tick-start stamp: with a lease that looks live
+    relative to the last tick clock but has expired on the fresh clock,
+    read() must NOT serve locally — a stale-small clock is exactly the
+    isolated-leader failure mode (tick frozen in heartbeat timeouts
+    while handler threads keep consulting the lease)."""
+    from apus_tpu.parallel.sim import Cluster as SimCluster
+    from apus_tpu.models.kvs import KvsStateMachine
+
+    c = SimCluster(3, seed=13, sm_factory=KvsStateMachine)
+    leader = c.wait_for_leader()
+    c.submit(encode_put(b"fk", b"fv"))
+    c.run(0.3)
+    assert leader.is_leader and leader.log.apply >= leader.log.commit
+    # Lease "live" relative to the frozen tick stamp...
+    leader._lease_until = leader._now + 1.0
+    # ...but expired on the fresh clock the daemon would install.
+    leader.clock = lambda: leader._lease_until + 0.5
+    rr = leader.read(10**6, 424242, encode_get(b"fk"))
+    assert rr is not None and not rr.done, \
+        "expired lease served a local read off the stale tick clock"
+    # Fresh clock within the lease: local serve, no majority round.
+    leader.clock = lambda: leader._lease_until - 0.5
+    rr2 = leader.read(10**6 + 1, 424242, encode_get(b"fk"))
+    assert rr2 is not None and rr2.done and rr2.reply == b"fv"
+
+
+def test_vote_guard_unconditional_under_config_skew():
+    """The lease safety argument rests on VOTERS refusing real votes
+    while their leader is alive — and the leader's read_lease config is
+    invisible to them, so the refusal must not key on the voter's own
+    flag: a voter launched with read_lease=False still refuses a
+    higher-term vote within hb_timeout of a heartbeat."""
+    from apus_tpu.core.election import VoteRequest
+    from apus_tpu.core.sid import Sid
+    from apus_tpu.parallel.sim import Cluster as SimCluster
+    from apus_tpu.parallel.transport import Region
+
+    c = SimCluster(3, seed=17)
+    leader = c.wait_for_leader()
+    c.run(0.1)                    # heartbeats flowing: leader is alive
+    follower = next(n for n in c.nodes if not n.is_leader)
+    follower.cfg.read_lease = False          # skewed launch config
+    cand = next(n.idx for n in c.nodes
+                if n.idx not in (leader.idx, follower.idx))
+    li, lt = follower.log.last_determinant()
+    req = VoteRequest(Sid(follower.current_term + 3, False, cand).word,
+                      last_idx=li + 100, last_term=lt + 100,
+                      cid_epoch=follower.cid.epoch)
+    follower.regions.ctrl[Region.VOTE_REQ][cand] = req
+    before = follower.stats["votes_granted"]
+    c.step()
+    assert follower.stats["votes_granted"] == before, \
+        "skewed voter granted a higher-term vote while its leader " \
+        "was alive — the lease guard must be unconditional"
+    # No vote materialized: the follower never adopted the candidate's
+    # SID (a follower's sid.idx records whom it adopted) and never
+    # wrote a VOTE_ACK into the candidate's region.
+    sid = follower.sid.sid
+    assert not (sid.term == req.sid.term and sid.idx == cand)
+    assert c.nodes[cand].regions.ctrl[Region.VOTE_ACK][follower.idx] is None
 
 
 def test_pipeline_throughput_beats_serial_smoke():
