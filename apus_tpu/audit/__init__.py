@@ -1,0 +1,17 @@
+"""Consistency audit plane: history capture + linearizability checking.
+
+The reference argues its read/lease safety; this package PROVES ours on
+live histories.  ``history`` records every client op's invoke/response
+wall-clock interval (serial and pipelined paths alike) into a
+lock-cheap ring with JSONL export; ``linear`` checks the captured
+history for linearizability against the KVS model — per-key partitioned
+(P-compositionality) Wing&Gong search with memoized state hashing,
+ambiguous (maybe-applied) ops handled Porcupine-style.  The chaos
+campaigns (``benchmarks/fuzz.py --check-linear``, ``benchmarks/soak.py
+--audit``) run the checker over histories captured under crash +
+network + disk-fault schedules, turning "no stale reads" from an
+argument into a checked property.
+"""
+
+from apus_tpu.audit.history import HistoryRecorder  # noqa: F401
+from apus_tpu.audit.linear import AuditResult, check_history  # noqa: F401

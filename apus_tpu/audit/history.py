@@ -1,0 +1,164 @@
+"""History capture: per-op invoke/response intervals for the checker.
+
+``HistoryRecorder`` is the client-side tap: ``ApusClient`` (serial and
+``pipeline*`` paths) reports each op's invocation and completion, and
+the recorder keeps ``(clt_id, req_id, op, key, value, status, t0, t1)``
+in a bounded ring.  Design points that matter for soundness:
+
+- **One interval per op, across retries.**  A failover retry reuses the
+  same ``req_id`` and the server-side dedup (core.epdb) makes it
+  exactly-once, so the whole retry chain is ONE operation whose
+  interval spans first send to final reply — exactly what the recorder
+  captures by keying open ops on ``(clt_id, req_id)``.
+- **Timeouts are ambiguous (maybe-applied).**  An op that timed out may
+  have been applied (the ack was lost) or not, at any time after its
+  invocation — the checker treats its response time as +infinity and
+  its effect as optional.  Ops still open at export time (client died
+  mid-op) are exported the same way.
+- **Lock-cheap.**  One lock, tiny critical sections, a
+  ``deque(maxlen=capacity)`` ring for completed ops.  When the ring
+  overwrites (capacity exceeded) the history is no longer complete and
+  the checker's verdict is advisory — ``dropped`` counts this and the
+  campaigns size the ring so it never happens.
+
+Wall-clock note: intervals come from ONE process clock
+(``time.monotonic``), so every client thread feeding a recorder must
+run in the same process — true for all campaigns.  Widening an
+interval is sound (fewer real-time constraints); the recorder never
+narrows one.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Optional
+
+#: Client-op wire codes (runtime.client; duplicated to keep this module
+#: import-light — asserted equal in tests/test_audit.py).
+OP_CLT_WRITE = 16
+OP_CLT_READ = 17
+
+
+def decode_kvs(data: bytes) -> Optional[tuple[str, bytes, bytes]]:
+    """Decode a KVS wire command (models.kvs) into ``(op, key, value)``
+    with op in {"put", "get", "delete"}; None for non-KVS payloads."""
+    try:
+        tag = data[:1]
+        klen_s, rest = data[1:].split(b":", 1)
+        klen = int(klen_s)
+        key, payload = rest[:klen], rest[klen:]
+    except (ValueError, IndexError):
+        return None
+    if tag == b"P":
+        return "put", key, payload
+    if tag == b"G" and not payload:
+        return "get", key, b""
+    if tag == b"D" and not payload:
+        return "delete", key, b""
+    return None
+
+
+class HistoryRecorder:
+    """Bounded ring of completed client ops + open-op table."""
+
+    def __init__(self, capacity: int = 1 << 16, clock=time.monotonic):
+        self.capacity = capacity
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._done: collections.deque = collections.deque(maxlen=capacity)
+        self._open: dict[tuple[int, int], dict] = {}
+        #: completed events lost to ring overwrite (history incomplete)
+        self.dropped = 0
+
+    # -- client-facing capture --------------------------------------------
+
+    def invoke(self, clt_id: int, req_id: int, op_code: int,
+               data: bytes) -> None:
+        """Record the invocation of a raw client op (wire payload);
+        non-KVS payloads are kept as op="other" (not checkable, but
+        still exported so the history shows them)."""
+        kv = decode_kvs(data)
+        if kv is None:
+            op, key, value = "other", b"", b""
+        else:
+            op, key, value = kv
+        if op_code == OP_CLT_READ and op not in ("get", "other"):
+            op = "other"            # a write command sent as a read
+        self.invoke_kv(clt_id, req_id, op, key, value)
+
+    def invoke_kv(self, clt_id: int, req_id: int, op: str, key: bytes,
+                  value: bytes = b"") -> None:
+        """Direct capture for app-level harnesses (e.g. the soak's
+        SET/GET stream, which never speaks the KVS wire format)."""
+        ev = {"clt": clt_id, "req": req_id, "op": op,
+              "key": key, "value": value if op != "get" else None,
+              "status": "ambiguous", "t0": self.clock(), "t1": None}
+        with self._lock:
+            self._open[(clt_id, req_id)] = ev
+
+    def complete(self, clt_id: int, req_id: int, status: str,
+                 reply: Optional[bytes] = None) -> None:
+        """Close an open op.  ``status``: "ok" (reply is the observed
+        value for gets), "ambiguous" (timed out — maybe applied), or
+        "error" (server refused; maybe applied for writes)."""
+        t1 = self.clock()
+        with self._lock:
+            ev = self._open.pop((clt_id, req_id), None)
+            if ev is None:
+                return
+            ev["status"] = status
+            ev["t1"] = t1
+            if ev["op"] == "get" and status == "ok":
+                ev["value"] = reply if reply is not None else b""
+            if len(self._done) == self._done.maxlen:
+                self.dropped += 1
+            self._done.append(ev)
+
+    # -- export ------------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Snapshot: completed ops + still-open ops (as ambiguous,
+        t1=None -> +inf in the checker), in no particular order — the
+        checker sorts by t0."""
+        with self._lock:
+            return [dict(e) for e in self._done] + \
+                   [dict(e) for e in self._open.values()]
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write one JSON object per op.  Keys/values are latin-1
+        mapped (lossless byte<->codepoint) so arbitrary bytes survive
+        the JSON roundtrip."""
+        evs = self.events()
+        with open(path, "w") as f:
+            for e in evs:
+                f.write(json.dumps(encode_event(e)) + "\n")
+        return len(evs)
+
+    @staticmethod
+    def load_jsonl(path: str) -> list[dict]:
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(decode_event(json.loads(line)))
+        return out
+
+
+def encode_event(e: dict) -> dict:
+    out = dict(e)
+    out["key"] = e["key"].decode("latin-1")
+    out["value"] = None if e["value"] is None \
+        else e["value"].decode("latin-1")
+    return out
+
+
+def decode_event(e: dict) -> dict:
+    out = dict(e)
+    out["key"] = e["key"].encode("latin-1")
+    out["value"] = None if e.get("value") is None \
+        else e["value"].encode("latin-1")
+    return out

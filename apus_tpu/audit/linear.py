@@ -1,0 +1,300 @@
+"""Linearizability checker for captured KVS histories.
+
+Algorithm: Wing & Gong's linearization search with the two standard
+refinements Porcupine popularized —
+
+- **P-compositionality**: the KVS model is a product of independent
+  per-key registers, and a history is linearizable iff each per-key
+  sub-history is (Herlihy & Wing's locality theorem), so the search is
+  partitioned by key.  This turns one exponential search over N ops
+  into many small ones, and a violation names its key.
+- **Memoized state hashing**: a search node is (set of linearized ops,
+  register value); revisiting an equivalent node via a different
+  linearization order is pruned.  The done-set is a bitmask, so the
+  memo key is an (int, bytes) pair.
+
+Ambiguity (Knossos/Porcupine "info" ops): an op whose ack was lost —
+client timeout, crash mid-op, server error on a write — MAY have been
+applied at any time after its invocation, or never.  Its response time
+is +infinity (it real-time-precedes nothing) and linearizing it is
+optional: the search succeeds once every CERTAIN op is linearized.
+Ambiguous reads carry no information and are dropped.
+
+Lease-served reads need no special casing here: the capture layer
+records the client-observed interval, and a stale lease read (served
+after a newer write was acked elsewhere) shows up as a read whose
+observed value cannot be placed in any valid order — exactly the
+violation class PR 3's lease machinery must never produce.
+
+On violation the checker shrinks to a MINIMAL failing window (verified
+at every step: each candidate window is re-checked, so the reported
+window genuinely fails on its own).  Front-shrinking switches the
+initial register value to "unknown" (the first read pins it), so a
+window is never called a violation merely because its initial write
+was shrunk away.
+
+CLI: ``python -m apus_tpu.audit.linear history.jsonl`` re-checks an
+exported history (the repro workflow printed by the campaigns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+INF = float("inf")
+
+#: Sentinel for "initial register value unknown" (front-shrunk windows).
+_UNKNOWN = object()
+
+
+@dataclasses.dataclass
+class Violation:
+    key: bytes
+    #: minimal failing window (event dicts, sorted by t0) — verified
+    #: non-linearizable on its own
+    window: list
+    #: True when the window was checked under an unknown initial value
+    #: (front-shrunk); False when it starts at history start with the
+    #: fresh-store initial value
+    unknown_init: bool
+    t_lo: float
+    t_hi: float
+
+    def describe(self) -> str:
+        lines = [f"linearizability violation on key {self.key!r}: "
+                 f"{len(self.window)} ops in "
+                 f"[{self.t_lo:.6f}, {self.t_hi:.6f}]"
+                 + (" (any initial value)" if self.unknown_init else "")]
+        for e in self.window:
+            t1 = e.get("t1")
+            lines.append(
+                f"  clt={e['clt']} req={e['req']} {e['op']}"
+                f"({e['key']!r}"
+                + (f", {e['value']!r}" if e.get("value") is not None
+                   else "")
+                + f") status={e['status']} "
+                f"[{e['t0']:.6f}, {'inf' if t1 is None else f'{t1:.6f}'}]")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class AuditResult:
+    ok: bool
+    ops_checked: int
+    keys: int
+    violations: list
+    #: keys whose search exhausted the node budget (no verdict — not
+    #: counted as violations, but not proven clean either)
+    undecided: list
+    #: non-KVS ops skipped + ambiguous/error reads dropped
+    skipped: int
+
+    def describe(self) -> str:
+        if self.ok and not self.undecided:
+            return (f"linearizable: {self.ops_checked} ops over "
+                    f"{self.keys} keys, 0 violations")
+        parts = [v.describe() for v in self.violations]
+        if self.undecided:
+            parts.append(f"undecided keys (search budget): "
+                         f"{self.undecided!r}")
+        return "\n".join(parts) or "undecided"
+
+
+# -- per-key search ---------------------------------------------------------
+
+def _search(ops: list[tuple], init, max_nodes: int) -> str:
+    """One Wing&Gong search.  ``ops``: (is_write, value, t0, t1,
+    certain) sorted by t0; ``init``: initial register value (bytes) or
+    _UNKNOWN.  Returns "ok" | "fail" | "undecided".
+
+    The per-node frontier scan exploits the t0 sort: a pending op j
+    can only disqualify a LATER-invoked candidate i (t1_j < t0_i
+    needs t0_j <= t1_j < t0_i), so scanning pending ops in t0 order
+    with a running min of their response times finds exactly the
+    minimal ops, and the scan stops at the first op invoked after
+    that running min — per-node cost is the CONCURRENCY window (plus
+    still-pending ambiguous ops), not the history length.  ``lo``
+    (first not-yet-linearized index) rides in the node so the scan
+    skips the linearized prefix without walking the mask."""
+    n = len(ops)
+    if n == 0:
+        return "ok"
+    certain_mask = 0
+    for i, o in enumerate(ops):
+        if o[4]:
+            certain_mask |= 1 << i
+    if certain_mask == 0:
+        return "ok"
+    seen = {(0, init)}
+    stack = [(0, 0, init)]
+    nodes = 0
+    while stack:
+        mask, lo, state = stack.pop()
+        if mask & certain_mask == certain_mask:
+            return "ok"
+        nodes += 1
+        if nodes > max_nodes:
+            return "undecided"
+        while lo < n and (mask >> lo) & 1:
+            lo += 1
+        # Minimal pending ops (nothing pending really-precedes them).
+        cands = []
+        min_ret = INF
+        i = lo
+        while i < n:
+            if not (mask >> i) & 1:
+                o = ops[i]
+                if o[2] > min_ret:
+                    break               # sorted t0: no candidates beyond
+                cands.append(i)
+                if o[3] < min_ret:
+                    min_ret = o[3]
+            i += 1
+        # Push ambiguous candidates first, certain ones last (LIFO pops
+        # certain first): on a clean history the greedy certain-only
+        # chain reaches the goal without ever popping the maybe-applied
+        # branches, so ambiguity costs pushes, not exploration.
+        for i in sorted(cands, key=lambda j: (ops[j][4], -ops[j][2])):
+            is_write, value, _t0, _t1, _c = ops[i]
+            if is_write:
+                ns = value
+            else:
+                if state is _UNKNOWN:
+                    ns = value          # first read pins the register
+                elif state != value:
+                    continue            # read can't observe this state
+                else:
+                    ns = state
+            key = (mask | (1 << i), ns)
+            if key not in seen:
+                seen.add(key)
+                stack.append((mask | (1 << i), lo, ns))
+    return "fail"
+
+
+def _to_search_ops(events: list[dict]) -> list[tuple]:
+    """Event dicts -> search tuples, applying the ambiguity rules.
+    Returns a list SORTED by t0; drops information-free ops."""
+    out = []
+    for e in events:
+        op = e["op"]
+        status = e["status"]
+        t1 = e["t1"] if e.get("t1") is not None else INF
+        if op in ("put", "delete"):
+            # A delete is a write of the absent value; KVS reads of an
+            # absent key observe b"", so absent IS b"" in the model.
+            value = e["value"] if op == "put" else b""
+            certain = status == "ok"
+            out.append((True, value, e["t0"],
+                        t1 if certain else INF, certain))
+        elif op == "get":
+            if status != "ok":
+                continue                # no observation: no constraint
+            out.append((False, e["value"] if e["value"] is not None
+                        else b"", e["t0"], t1, True))
+    out.sort(key=lambda o: (o[2], o[3]))
+    return out
+
+
+def _shrink(events: list[dict], init: bytes,
+            max_nodes: int) -> tuple[list[dict], bool]:
+    """Minimal failing window for a key that failed the main check.
+    Every candidate is re-verified, so the returned window genuinely
+    fails standalone.  Returns (window_events, unknown_init)."""
+    evs = sorted(events, key=lambda e: e["t0"])
+
+    def fails(sub: list[dict], ini) -> bool:
+        return _search(_to_search_ops(sub), ini, max_nodes) == "fail"
+
+    # Shrink from the end, geometrically (histories can be thousands of
+    # ops; one-by-one would cost O(n) searches): halve the removal step
+    # whenever the smaller window stops failing.
+    step = max(1, len(evs) // 2)
+    while len(evs) > 1:
+        if len(evs) - step >= 1 and fails(evs[:-step], init):
+            evs = evs[:-step]
+        elif step > 1:
+            step //= 2
+        else:
+            break
+    # Shrink from the front the same way; any window not anchored at
+    # history start must hold under ANY initial value or it is an
+    # artifact of the dropped prefix.
+    unknown = False
+    step = max(1, len(evs) // 2)
+    while len(evs) > 1:
+        if len(evs) - step >= 1 and fails(evs[step:], _UNKNOWN):
+            evs = evs[step:]
+            unknown = True
+        elif step > 1:
+            step //= 2
+        else:
+            break
+    return evs, unknown
+
+
+# -- public API -------------------------------------------------------------
+
+def check_history(events: list[dict], initial: bytes = b"",
+                  max_nodes_per_key: int = 500_000) -> AuditResult:
+    """Check a captured history (HistoryRecorder.events() /
+    load_jsonl() shape) for linearizability against the per-key KVS
+    register model.  ``initial`` is the fresh-store register value
+    (b"" — a KVS get of a never-written key observes the empty
+    value)."""
+    by_key: dict[bytes, list[dict]] = {}
+    skipped = 0
+    checked = 0
+    for e in events:
+        if e["op"] not in ("put", "get", "delete"):
+            skipped += 1
+            continue
+        if e["op"] == "get" and e["status"] != "ok":
+            skipped += 1
+            continue
+        by_key.setdefault(e["key"], []).append(e)
+        checked += 1
+    violations: list[Violation] = []
+    undecided: list[bytes] = []
+    for key, evs in sorted(by_key.items()):
+        ops = _to_search_ops(evs)
+        verdict = _search(ops, initial, max_nodes_per_key)
+        if verdict == "undecided":
+            undecided.append(key)
+            continue
+        if verdict == "ok":
+            continue
+        window, unknown = _shrink(evs, initial, max_nodes_per_key)
+        window = sorted(window, key=lambda e: e["t0"])
+        t_hi = max((e["t1"] for e in window
+                    if e.get("t1") is not None), default=INF)
+        violations.append(Violation(
+            key=key, window=window, unknown_init=unknown,
+            t_lo=window[0]["t0"], t_hi=t_hi))
+    return AuditResult(ok=not violations, ops_checked=checked,
+                       keys=len(by_key), violations=violations,
+                       undecided=undecided, skipped=skipped)
+
+
+def check_jsonl(path: str, **kwargs) -> AuditResult:
+    from apus_tpu.audit.history import HistoryRecorder
+    return check_history(HistoryRecorder.load_jsonl(path), **kwargs)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.audit.linear",
+        description="Re-check an exported history (campaign repro).")
+    ap.add_argument("history", help="JSONL path (HistoryRecorder dump)")
+    ap.add_argument("--max-nodes", type=int, default=500_000)
+    args = ap.parse_args(argv)
+    res = check_jsonl(args.history, max_nodes_per_key=args.max_nodes)
+    print(res.describe())
+    return 0 if res.ok and not res.undecided else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -132,6 +132,15 @@ def make_client_ops(daemon) -> dict:
                 "drain_windows": n.stats.get("drain_windows", 0),
                 "drain_entries": n.stats.get("drain_entries", 0),
                 "repl_windows": n.stats.get("repl_windows", 0),
+                # Disk-fault containment observability: I/O errors on
+                # the persistence path and whether they disabled it
+                # (the replica keeps serving; see daemon._persist_fail).
+                "persist_errors": getattr(daemon, "persist_errors", 0),
+                "persist_disabled": getattr(daemon, "persist_disabled",
+                                            False),
+                "persist_syncs": (daemon.persistence.syncs
+                                  if getattr(daemon, "persistence", None)
+                                  is not None else None),
             }
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
@@ -395,8 +404,15 @@ class ApusClient:
     """
 
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
-                 timeout: float = 5.0, attempt_timeout: float = 2.0):
+                 timeout: float = 5.0, attempt_timeout: float = 2.0,
+                 history=None):
         self.peers = [self._parse(p) for p in peers]
+        #: Optional consistency-audit tap (apus_tpu.audit.history.
+        #: HistoryRecorder): every op — serial and pipelined — reports
+        #: its invoke/response interval and outcome.  Timeouts complete
+        #: as "ambiguous" (maybe-applied); a retry chain is ONE interval
+        #: because retries reuse the req_id (exactly-once via epdb).
+        self.history = history
         self.clt_id = clt_id if clt_id is not None else (
             (os.getpid() << 20) ^ threading.get_ident()
             ^ secrets.randbits(63)) & ((1 << 63) - 1)
@@ -479,28 +495,40 @@ class ApusClient:
         for op, data in ops:
             self._req_seq += 1
             items.append((op, self._req_seq, data))
+            if self.history is not None:
+                self.history.invoke(self.clt_id, self._req_seq, op, data)
         results: dict[int, bytes] = {}
         deadline = time.monotonic() + self.timeout
         target = self._leader
         pending = items
-        while pending:
-            if time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"{len(pending)} of {len(items)} pipelined ops not "
-                    f"served in {self.timeout}s")
-            if target is None:
-                target = self._probe_any(deadline)
+        try:
+            while pending:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} of {len(items)} pipelined ops "
+                        f"not served in {self.timeout}s")
                 if target is None:
-                    continue
-            outcome, hint = self._pipeline_attempt(
-                target, pending, results, deadline, window)
-            pending = [it for it in pending if it[1] not in results]
-            if outcome == "hint":
-                target = self._peer_index(hint) if hint \
-                    else self._next(target)
-                time.sleep(0.01)
-            elif outcome != "ok":
-                target = self._next(target)
+                    target = self._probe_any(deadline)
+                    if target is None:
+                        continue
+                outcome, hint = self._pipeline_attempt(
+                    target, pending, results, deadline, window)
+                pending = [it for it in pending if it[1] not in results]
+                if outcome == "hint":
+                    target = self._peer_index(hint) if hint \
+                        else self._next(target)
+                    time.sleep(0.01)
+                elif outcome != "ok":
+                    target = self._next(target)
+        except BaseException:
+            # Unresolved ops are ambiguous: a retry MAY already have
+            # landed (the reply was simply never read).
+            if self.history is not None:
+                for _op, rid, _d in items:
+                    if rid not in results:
+                        self.history.complete(self.clt_id, rid,
+                                              "ambiguous")
+            raise
         return [results[req_id] for _op, req_id, _d in items]
 
     def pipeline_writes(self, datas) -> list[bytes]:
@@ -560,6 +588,9 @@ class ApusClient:
                     self._leader = target
                     results[rid] = wire.Reader(resp[9:]).blob()
                     del inflight[rid]
+                    if self.history is not None:
+                        self.history.complete(self.clt_id, rid, "ok",
+                                              results[rid])
                 elif st == ST_NOT_LEADER:
                     hint = wire.Reader(resp[9:]).blob().decode() \
                         if len(resp) > 9 else ""
@@ -592,6 +623,24 @@ class ApusClient:
     # -- internals --------------------------------------------------------
 
     def _op(self, op: int, req_id: int, data: bytes) -> bytes:
+        """One client op with audit capture: the whole retry chain is
+        one recorded interval; timeouts are ambiguous (maybe-applied),
+        server errors are ambiguous-for-writes."""
+        if self.history is None:
+            return self._op_raw(op, req_id, data)
+        self.history.invoke(self.clt_id, req_id, op, data)
+        try:
+            reply = self._op_raw(op, req_id, data)
+        except TimeoutError:
+            self.history.complete(self.clt_id, req_id, "ambiguous")
+            raise
+        except RuntimeError:
+            self.history.complete(self.clt_id, req_id, "error")
+            raise
+        self.history.complete(self.clt_id, req_id, "ok", reply)
+        return reply
+
+    def _op_raw(self, op: int, req_id: int, data: bytes) -> bytes:
         payload = (wire.u8(op) + wire.u64(req_id) + wire.u64(self.clt_id)
                    + wire.blob(data))
         deadline = time.monotonic() + self.timeout

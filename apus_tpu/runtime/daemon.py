@@ -145,15 +145,24 @@ class ReplicaDaemon:
         # re-persisted (the reference replays its BDB dump the same way,
         # proxy.c:306-339).
         self.persistence = None
+        #: disk-fault observability (OP_STATUS): I/O errors seen on the
+        #: persistence path, and whether they disabled it for the
+        #: session (the replica keeps serving; acked-write durability
+        #: is replication's job — see Persistence docstring)
+        self.persist_errors = 0
+        self.persist_disabled = False
         if db_dir is not None:
             from apus_tpu.runtime.persist import (Persistence,
                                                   daemon_store_path)
-            self.persistence = Persistence(daemon_store_path(db_dir, idx))
+            self.persistence = Persistence(
+                daemon_store_path(db_dir, idx),
+                sync_policy=getattr(spec, "sync_policy", "batch"),
+                logger=self.logger)
             if self.persistence.store.count:
                 self.persistence.replay_into(self.node.sm, self.node.epdb,
                                              node=self.node)
-            self.on_commit.append(self.persistence.on_commit)
-            self.on_snapshot.append(self.persistence.on_snapshot)
+            self.on_commit.append(self._persist_commit)
+            self.on_snapshot.append(self._persist_snapshot)
 
         # Device plane (runtime.device_plane): the jitted commit step as
         # the primary replication/quorum engine, host TCP as control
@@ -336,6 +345,55 @@ class ReplicaDaemon:
                 self.logger.exception("tick failed")
             time.sleep(self._tick_interval)
 
+    # -- persistence wrappers (disk-fault containment) ---------------------
+    #
+    # Every durable-store touch runs on the tick thread (via
+    # _drain_upcalls) — an unhandled ENOSPC/EIO there either killed the
+    # snapshot record forever (the upcall list was already drained) or
+    # log-spammed every tick.  Policy: FIRST I/O error disables
+    # persistence for the session, loudly, and the replica keeps
+    # serving — acked-write durability is replication's job; the local
+    # store only narrows full-cluster-power-loss exposure (DESIGN.md
+    # "durability & recovery semantics").  Disabling (rather than
+    # limping on) also keeps the on-disk store a valid PREFIX of the
+    # applied log: skipping one failed record and appending later ones
+    # would corrupt the restart replay.
+
+    def _persist_fail(self, stage: str, exc: OSError) -> None:
+        self.persist_errors += 1
+        if self.persist_disabled:
+            return
+        self.persist_disabled = True
+        self.logger.error(
+            "PERSISTENCE DISABLED for this session: %s failed (%s); "
+            "continuing to serve — durability of acked writes remains "
+            "replication; restart recovery will replay the store's "
+            "valid prefix + catch up from peers", stage, exc)
+
+    def _persist_commit(self, e: LogEntry) -> None:
+        if self.persist_disabled:
+            return
+        try:
+            self.persistence.on_commit(e)
+        except OSError as exc:
+            self._persist_fail("entry append", exc)
+
+    def _persist_snapshot(self, snap, ep_dump) -> None:
+        if self.persist_disabled:
+            return
+        try:
+            self.persistence.on_snapshot(snap, ep_dump)
+        except OSError as exc:
+            self._persist_fail("snapshot record", exc)
+
+    def _persist_flush(self) -> None:
+        if self.persist_disabled:
+            return
+        try:
+            self.persistence.flush_window()
+        except OSError as exc:
+            self._persist_fail("fsync", exc)
+
     def _drain_upcalls(self) -> None:
         if self.node.snapshot_upcalls:
             snaps, self.node.snapshot_upcalls = \
@@ -355,13 +413,17 @@ class ReplicaDaemon:
             cfgs, self.node.config_upcalls = self.node.config_upcalls, []
             for e in cfgs:
                 self._handle_config_entry(e)
-        if not self.node.committed_upcalls:
-            return
-        entries, self.node.committed_upcalls = \
-            self.node.committed_upcalls, []
-        for e in entries:
-            for cb in self.on_commit:
-                cb(e)
+        if self.node.committed_upcalls:
+            entries, self.node.committed_upcalls = \
+                self.node.committed_upcalls, []
+            for e in entries:
+                for cb in self.on_commit:
+                    cb(e)
+        if self.persistence is not None:
+            # Batch sync policy: ONE fdatasync per drain window,
+            # amortized over every record this tick appended (entries
+            # and snapshot records alike); no-op when nothing appended.
+            self._persist_flush()
 
     def _handle_config_entry(self, e: LogEntry) -> None:
         """Applied CONFIG entry: learn new peers (the poll_config_entries

@@ -39,13 +39,77 @@ SNAPFILE_MAGIC = b"APF1"   # an installed snapshot whose dump lives in a
 
 class Persistence:
     """Attach to a ReplicaDaemon: persists applied CSM entries and
-    installed snapshots."""
+    installed snapshots.
 
-    def __init__(self, path: str, prefer_native: bool = True):
+    ``sync_policy`` controls when appended records are fsynced:
+
+    - ``"none"``: never (OS writeback only).
+    - ``"batch"`` (default): the daemon calls :meth:`flush_window` once
+      per group-commit drain window — one ``fdatasync`` amortized over
+      every entry the window applied, not one per entry.
+    - ``"always"``: fsync after every appended record.
+
+    Durability model (see DESIGN.md "durability & recovery semantics"):
+    an ACKED write's durability comes from REPLICATION — it lives on a
+    quorum before the client sees OK — so fsync only narrows the
+    full-cluster-power-loss window; it is not on the ack path under
+    any policy.
+    """
+
+    def __init__(self, path: str, prefer_native: bool = True,
+                 sync_policy: str = "batch", logger=None):
+        if sync_policy not in ("none", "batch", "always"):
+            raise ValueError(f"bad sync_policy {sync_policy!r}")
         self.store = open_store(path, prefer_native=prefer_native)
+        self.sync_policy = sync_policy
+        self.logger = logger
+        self._dirty = False
+        #: fsync count (observability; the batch-policy test asserts
+        #: syncs << appends under a pipelined burst)
+        self.syncs = 0
 
     def on_commit(self, e: LogEntry) -> None:
         self.store.append(RECORD_MAGIC + wire.encode_entry(e))
+        self._note_appended()
+
+    def _note_appended(self) -> None:
+        if self.sync_policy == "always":
+            self._sync()
+        elif self.sync_policy == "batch":
+            self._dirty = True
+
+    def _sync(self) -> None:
+        self.store.sync()
+        self.syncs += 1
+        self._dirty = False
+
+    def flush_window(self) -> None:
+        """One sync per drain window (daemon tick, after the committed
+        upcalls drained) — no-op unless the batch policy has unsynced
+        appends."""
+        if self.sync_policy == "batch" and self._dirty:
+            self._sync()
+
+    def quarantine(self) -> str:
+        """Move the store file aside (``*.corrupt``) and reopen empty —
+        the undecodable-record / failed-replay policy (mirrors
+        PyRecordStore's corrupt-header handling).  Returns the
+        quarantine path."""
+        from apus_tpu.utils.store import quarantine_path
+        path = self.store.path
+        try:
+            self.store.close()
+        except OSError:
+            pass
+        dst = quarantine_path(path)
+        os.replace(path, dst)
+        if self.logger is not None:
+            self.logger.error(
+                "durable store %s quarantined to %s; starting empty "
+                "(this replica rejoins via catch-up)", path, dst)
+        self.store = open_store(path)
+        self._dirty = False
+        return dst
 
     #: copy-chunk size for sidecar creation (one chunk resident, ever)
     _SNAP_IO_CHUNK = 1 << 20
@@ -71,6 +135,7 @@ class Persistence:
                                          snap.last_term)
                 + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
                 + wire.blob(snap.seg))
+            self._note_appended()
             return
         # Sidecar names are STORE-scoped (several daemons share a
         # db_dir in the local process deployment — proc.py passes one
@@ -102,6 +167,7 @@ class Persistence:
                                          snap.last_term, snap.data_len)
             + wire.blob(name.encode()) + wire.encode_ep_dump(ep_dump)
             + wire.blob(snap.seg))
+        self._note_appended()
         # GC superseded sidecars OF THIS STORE ONLY: replay only ever
         # consults the LAST snapshot record (see replay_into), so
         # earlier dumps are dead weight — without this, every streamed
@@ -122,7 +188,16 @@ class Persistence:
         next log index to fetch from peers (apply floor).  With
         ``node``, a replayed snapshot's partial-chunk-group buffer is
         restored into the node's reassembler (catch-up may deliver
-        finals whose early chunks predate the snapshot)."""
+        finals whose early chunks predate the snapshot).
+
+        An UNDECODABLE record (unknown magic / truncated payload —
+        corruption the CRC frame did not catch, or a store written by
+        an incompatible build) quarantines the whole store and replays
+        NOTHING: raising here crash-looped the daemon forever (every
+        restart re-read the same bytes), and decoding garbage is
+        worse.  The replica starts empty and rejoins via snapshot
+        catch-up.  Decoding is validated in a PRE-PASS so the SM and
+        endpoint DB are never left holding half a replay."""
         recs = self.store.records()
         # A snapshot record is the FULL state at its point, so replay
         # starts at the LAST one (cheap magic scan): everything before
@@ -134,30 +209,54 @@ class Persistence:
         for i, rec in enumerate(recs):
             if rec[:4] in (SNAP_MAGIC, SNAPFILE_MAGIC):
                 start = i
+        try:
+            decoded = [decode_record(rec) for rec in recs[start:]]
+        except (ValueError, struct.error, IndexError) as e:
+            if self.logger is not None:
+                self.logger.error("undecodable store record: %s", e)
+            self.quarantine()
+            return 1
         nxt = 1
-        for rec in recs[start:]:
-            kind, payload = decode_record(rec)
-            if kind == "entry":
-                reply = sm.apply(payload.idx, payload.data)
-                epdb.note_applied(payload.clt_id, payload.req_id,
-                                  payload.idx, reply)
-                nxt = payload.idx + 1
-            else:
-                snap, ep_dump = payload
-                if kind == "snapfile":
-                    sidecar = os.path.join(
-                        os.path.dirname(self.store.path) or ".",
-                        snap.data_path)
-                    # Never adopt: the sidecar must survive for the
-                    # NEXT restart too (the SM copies chunk-wise).
-                    sm.apply_snapshot_file(snap, sidecar, adopt=False)
+        try:
+            for kind, payload in decoded:
+                if kind == "entry":
+                    reply = sm.apply(payload.idx, payload.data)
+                    epdb.note_applied(payload.clt_id, payload.req_id,
+                                      payload.idx, reply)
+                    nxt = payload.idx + 1
                 else:
-                    sm.apply_snapshot(snap)
-                epdb.load(ep_dump)
-                if node is not None:
-                    from apus_tpu.core.segment import Reassembler
-                    node._seg = Reassembler.load(snap.seg)
-                nxt = snap.last_idx + 1
+                    snap, ep_dump = payload
+                    if kind == "snapfile":
+                        sidecar = os.path.join(
+                            os.path.dirname(self.store.path) or ".",
+                            snap.data_path)
+                        # Never adopt: the sidecar must survive for the
+                        # NEXT restart too (the SM copies chunk-wise).
+                        sm.apply_snapshot_file(snap, sidecar, adopt=False)
+                    else:
+                        sm.apply_snapshot(snap)
+                    epdb.load(ep_dump)
+                    if node is not None:
+                        from apus_tpu.core.segment import Reassembler
+                        node._seg = Reassembler.load(snap.seg)
+                    nxt = snap.last_idx + 1
+        except OSError as e:
+            # A snapfile record whose sidecar is missing/short (deleted
+            # by hand, ENOSPC'd copy): same policy — quarantine, reset
+            # what the partial apply primed, start empty.
+            if self.logger is not None:
+                self.logger.error("store replay failed mid-apply: %s", e)
+            self.quarantine()
+            # Replay starts at the last snapshot record, so the only
+            # state a mid-apply failure can leave behind is that
+            # snapshot's partial prime — reset it (epdb is only loaded
+            # after a successful apply, so it is still clean).
+            try:
+                from apus_tpu.models.sm import Snapshot as _Snap
+                sm.apply_snapshot(_Snap(0, 0, b""))
+            except Exception:               # noqa: BLE001
+                pass
+            return 1
         return nxt
 
     def close(self) -> None:

@@ -80,8 +80,14 @@ class ProcCluster:
                  mesh_depth: int = 4,
                  follower_reads: Optional[bool] = None,
                  fault_plane: bool = False,
-                 fault_seed: int = 0):
+                 fault_seed: int = 0,
+                 extra_env: Optional[dict] = None):
         self.n = n
+        #: per-replica extra environment for spawn/restart (slot ->
+        #: {var: value}); chaos campaigns schedule disk faults by
+        #: setting APUS_DISKFAULT_* here before a (re)start and
+        #: clearing it afterwards (utils.store.FaultStore knobs).
+        self.extra_env: dict[int, dict] = dict(extra_env or {})
         self.workdir = workdir or tempfile.mkdtemp(prefix="apus-proc-")
         os.makedirs(self.workdir, exist_ok=True)
         base = dataclasses.replace(spec or PROC_SPEC)
@@ -208,6 +214,8 @@ class ProcCluster:
             self._logs[i] = open(
                 os.path.join(self.workdir, f"proc{tag}.out"), "ab")
         env = _repo_env()
+        env.update({k: str(v)
+                    for k, v in self.extra_env.get(i, {}).items()})
         # Orphan watchdog: if THIS harness process dies without stop()
         # (timeout-killed by a parent), the daemon self-exits when its
         # parent is no longer this pid (daemon.py main loop) — the pid
@@ -361,6 +369,13 @@ class ProcCluster:
         return slot
 
     # -- queries ----------------------------------------------------------
+
+    def store_path(self, idx: int) -> str:
+        """Replica ``idx``'s durable store file (db=True clusters) —
+        chaos campaigns corrupt it by surgery while the process is
+        killed, then exercise the restart recovery branches."""
+        from apus_tpu.runtime.persist import daemon_store_path
+        return daemon_store_path(os.path.join(self.workdir, "db"), idx)
 
     def status(self, idx: int, timeout: float = 0.5) -> Optional[dict]:
         return probe_status(self.spec.peers[idx], timeout=timeout)

@@ -90,6 +90,12 @@ class ClusterSpec:
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False
+    # fsync policy of the durable record store (runtime.persist):
+    # "none" = OS writeback only; "batch" = one fdatasync per
+    # group-commit drain window (daemon tick); "always" = per record.
+    # Acked-write durability is via REPLICATION under every policy —
+    # fsync only narrows full-cluster-power-loss exposure.
+    sync_policy: str = "batch"
     # Live-stack fault plane (apus_tpu.parallel.faults): wrap every
     # daemon's transport with seeded, schedule-driven fault injection
     # (drop/delay/duplicate/reorder, asymmetric partitions, throttles,

@@ -10,12 +10,16 @@ native implementation in tests).
 from __future__ import annotations
 
 import ctypes
+import errno
+import logging
 import os
 import struct
 import subprocess
 import threading
 import zlib
 from typing import Optional
+
+_log = logging.getLogger("apus.store")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -143,6 +147,9 @@ class PyRecordStore:
         self.path = path
         self.count = 0
         self.payload_bytes = 0
+        #: quarantine destination when the header was corrupt (None =
+        #: clean open); the daemon surfaces this loudly
+        self.quarantined: Optional[str] = None
         self._offsets: list[tuple[int, int]] = []   # (offset, len)
         create = not os.path.exists(path) or os.path.getsize(path) == 0
         self._f = open(path, "r+b" if not create else "w+b")
@@ -153,13 +160,36 @@ class PyRecordStore:
         else:
             self._scan()
 
+    def _quarantine(self) -> None:
+        """Corrupt 8-byte header: the file is unreadable as a store.
+        Raising here crash-looped the daemon forever on restart (every
+        re-exec re-hit the same bytes); instead QUARANTINE — rename the
+        file aside, log loudly, start empty.  The replica then rejoins
+        via normal catch-up (entry re-replication or a leader snapshot
+        push), during which the store is rebuilt as a valid prefix."""
+        self._f.close()
+        dst = quarantine_path(self.path)
+        os.replace(self.path, dst)
+        self.quarantined = dst
+        _log.error("store %s has a corrupt header; quarantined to %s "
+                   "and starting empty (replica rejoins via catch-up)",
+                   self.path, dst)
+        self._f = open(self.path, "w+b")
+        self._f.write(self._MAGIC)
+        self._f.flush()
+        self._size = len(self._MAGIC)
+        self._offsets = []
+        self.count = 0
+        self.payload_bytes = 0
+
     def _scan(self) -> None:
         f = self._f
         f.seek(0, os.SEEK_END)
         total = f.tell()
         f.seek(0)
         if f.read(8) != self._MAGIC:
-            raise OSError(f"bad store header in {self.path}")
+            self._quarantine()
+            return
         off = 8
         while off + 8 <= total:
             f.seek(off)
@@ -234,6 +264,128 @@ class PyRecordStore:
         self.close()
 
 
+def quarantine_path(path: str) -> str:
+    """First free ``<path>.corrupt[.N]`` name (quarantined stores are
+    kept for post-mortem, never reused)."""
+    dst = path + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.corrupt.{n}"
+    return dst
+
+
+class FaultStore:
+    """Deterministic disk-fault injection around a record store.
+
+    The live restart path (``Persistence``/``replay_into``/daemon
+    restart) had zero fault coverage — torn tails, latent CRC
+    corruption, fsync EIO and disk-full were all untested on the real
+    recovery code.  This wrapper schedules each fault class at an
+    APPEND/SYNC ORDINAL (1-based, deterministic — campaigns derive the
+    ordinals from their seed):
+
+    - ``torn_at=N``: after append N succeeds in memory, the record's
+      tail is TRUNCATED on disk (a crash mid-write: the page cache
+      made it to the platter only partially).  The daemon keeps
+      running none the wiser; the next open truncates back to record
+      N-1 and the replica re-fetches via catch-up.
+    - ``crc_at=N``: one payload byte of record N is flipped on disk
+      (latent media corruption).  Recovery treats it exactly like a
+      torn tail: scan stops there, later records are dropped.
+    - ``fsync_eio_at=N``: the Nth and every later ``sync()`` raises
+      EIO (dying disk).  The daemon's persistence wrapper must disable
+      persistence and keep serving.
+    - ``enospc_at=N``: the Nth and every later ``append()`` raises
+      ENOSPC (disk full) BEFORE touching the file.
+
+    Configured directly in tests, or per-daemon-process via
+    ``APUS_DISKFAULT_TORN/CRC/FSYNC_EIO/ENOSPC`` env vars (applied by
+    ``open_store``; ProcCluster passes per-replica env).
+    """
+
+    def __init__(self, inner, torn_at: int = 0, crc_at: int = 0,
+                 fsync_eio_at: int = 0, enospc_at: int = 0):
+        self._inner = inner
+        self.torn_at = torn_at
+        self.crc_at = crc_at
+        self.fsync_eio_at = fsync_eio_at
+        self.enospc_at = enospc_at
+        self._syncs = 0
+
+    def append(self, data: bytes) -> int:
+        if self.enospc_at and self._inner.count + 1 >= self.enospc_at:
+            raise OSError(errno.ENOSPC,
+                          "No space left on device (injected)")
+        n = self._inner.append(data)
+        if self.torn_at and n == self.torn_at:
+            self._corrupt(data, torn=True)
+        elif self.crc_at and n == self.crc_at:
+            self._corrupt(data, torn=False)
+        return n
+
+    def _corrupt(self, data: bytes, torn: bool) -> None:
+        """Damage the just-appended record ON DISK ONLY — the running
+        store's in-memory view stays valid, so later appends continue
+        past the damage (scan stops at the first bad record, exactly
+        the mid-file-corruption recovery branch)."""
+        try:
+            self._inner.sync()          # ensure the bytes are visible
+        except OSError:
+            pass
+        rec_len = 8 + len(data)
+        with open(self._inner.path, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            end = f.tell()
+            if torn:
+                # Cut into the payload (or the header for empty
+                # records): a partial write at crash.
+                cut = max(1, len(data) // 2 + 1) if data else 5
+                f.truncate(end - min(cut, rec_len - 1))
+            else:
+                off = end - 1 - len(data) // 2 if data else end - 5
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+        _log.warning("FaultStore: injected %s at record %d of %s",
+                     "torn tail" if torn else "CRC flip",
+                     self._inner.count, self._inner.path)
+
+    def sync(self) -> None:
+        self._syncs += 1
+        if self.fsync_eio_at and self._syncs >= self.fsync_eio_at:
+            raise OSError(errno.EIO, "fsync failed (injected)")
+        self._inner.sync()
+
+    def __getattr__(self, name: str):
+        # count/payload_bytes/path/dump/load_dump/records/close ...
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def diskfaults_from_env(env: Optional[dict] = None) -> Optional[dict]:
+    """Collect APUS_DISKFAULT_* knobs; None when unset/zero."""
+    e = os.environ if env is None else env
+    cfg = {}
+    for var, key in [("APUS_DISKFAULT_TORN", "torn_at"),
+                     ("APUS_DISKFAULT_CRC", "crc_at"),
+                     ("APUS_DISKFAULT_FSYNC_EIO", "fsync_eio_at"),
+                     ("APUS_DISKFAULT_ENOSPC", "enospc_at")]:
+        try:
+            v = int(e.get(var, "") or 0)
+        except ValueError:
+            v = 0
+        if v > 0:
+            cfg[key] = v
+    return cfg or None
+
+
 def parse_dump(blob: bytes) -> list[bytes]:
     """Decode the dump format: u64 count | (u32 len | data)*."""
     (count,) = struct.unpack_from("<Q", blob, 0)
@@ -248,10 +400,22 @@ def parse_dump(blob: bytes) -> list[bytes]:
 
 
 def open_store(path: str, prefer_native: bool = True):
-    """Open the durable store, preferring the native implementation."""
+    """Open the durable store, preferring the native implementation.
+    A corrupt header makes the native open fail (store.cpp returns
+    NULL), so the Python fallback — whose ``_scan`` quarantines — is
+    also the corrupt-header recovery path for native-preferring
+    daemons: either way the open SUCCEEDS with an empty store instead
+    of crash-looping the daemon.  APUS_DISKFAULT_* env knobs wrap the
+    result in a :class:`FaultStore` (chaos campaigns only)."""
+    store = None
     if prefer_native:
         try:
-            return NativeRecordStore(path)
+            store = NativeRecordStore(path)
         except (RuntimeError, OSError):
             pass
-    return PyRecordStore(path)
+    if store is None:
+        store = PyRecordStore(path)
+    cfg = diskfaults_from_env()
+    if cfg:
+        store = FaultStore(store, **cfg)
+    return store

@@ -306,6 +306,204 @@ def run_proc_schedule(fault_seed: int,
     return "ok"
 
 
+def _disk_surgery(path: str, kind: str, rng: random.Random) -> bool:
+    """Corrupt a KILLED replica's durable store in place — the restart
+    then runs the matching recovery branch (torn-tail truncation, CRC
+    scan stop, header quarantine)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    with open(path, "r+b") as f:
+        if kind == "torn" and size > 16:
+            f.truncate(size - rng.randint(1, min(12, size - 9)))
+        elif kind == "crc" and size > 24:
+            off = rng.randrange(12, size - 4)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+        elif kind == "header":
+            f.write(b"NOTASTOR")
+        else:
+            return False
+    return True
+
+
+def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
+    """One CONSISTENCY-AUDIT chaos trial on the deployment shape: a
+    3-replica ProcCluster with the live fault plane, concurrent client
+    workers (serial AND pipelined paths) recording every op's
+    invoke/response interval, and a seeded nemesis that composes
+
+      - network fault bursts (drop/delay scripted over the wire),
+      - a bidirectional leader partition + heal,
+      - leader SIGKILL mid-group-commit + restart,
+      - disk faults on the restart path (torn tail / CRC flip / corrupt
+        header by surgery while killed; ENOSPC / fsync-EIO injected
+        live into the restarted daemon via APUS_DISKFAULT_*).
+
+    After heal + convergence a final read round (one linearizable read
+    per key) is appended to the history, so the linearizability check
+    that follows ALSO proves no acked write was lost.  Any violation
+    dumps the history JSONL next to the CWD and raises; the caller
+    prints the one-command seeded repro."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.models.kvs import encode_get, encode_put
+    from apus_tpu.parallel.faults import heal_all, isolate, send_fault
+    from apus_tpu.runtime.client import (OP_CLT_READ, OP_CLT_WRITE,
+                                         ApusClient)
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    import dataclasses as _dc
+
+    def _dbg(msg: str) -> None:
+        if os.environ.get("APUS_AUDIT_DEBUG"):
+            print(f"[audit {fault_seed}] {msg}", file=sys.stderr,
+                  flush=True)
+
+    rng = random.Random(fault_seed)
+    # Fixed membership: eviction/rejoin semantics are the simulator
+    # campaign's subject; here a killed member must stay a member so
+    # its restart exercises store recovery, not the join protocol.
+    spec = _dc.replace(PROC_SPEC, auto_remove=False)
+    keys = [b"ak%d" % i for i in range(rng.randint(4, 7))]
+    recorder = HistoryRecorder(capacity=1 << 18)
+    stop = threading.Event()
+    n_workers = 3
+
+    def worker(wid: int, peers: list) -> None:
+        wrng = random.Random((fault_seed << 4) ^ wid)
+        n = 0
+        with ApusClient(peers, timeout=6.0, attempt_timeout=1.0,
+                        history=recorder) as c:
+            while not stop.is_set():
+                try:
+                    roll = wrng.random()
+                    if roll < 0.45:
+                        n += 1
+                        c.put(wrng.choice(keys), b"w%d.%d" % (wid, n))
+                    elif roll < 0.8:
+                        c.get(wrng.choice(keys))
+                    else:
+                        ops = []
+                        for _ in range(wrng.randint(4, 12)):
+                            if wrng.random() < 0.5:
+                                n += 1
+                                ops.append((OP_CLT_WRITE, encode_put(
+                                    wrng.choice(keys),
+                                    b"w%d.%d" % (wid, n))))
+                            else:
+                                ops.append((OP_CLT_READ, encode_get(
+                                    wrng.choice(keys))))
+                        c.pipeline(ops)
+                except (TimeoutError, RuntimeError, OSError,
+                        ConnectionError):
+                    _time.sleep(0.05)   # recorded as ambiguous; go on
+
+    with tempfile.TemporaryDirectory(prefix="apus-audit") as td:
+        with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
+                         fault_seed=fault_seed) as pc:
+            peers = list(pc.spec.peers)
+            _dbg("cluster up")
+            threads = [threading.Thread(target=worker, args=(w, peers),
+                                        daemon=True)
+                       for w in range(n_workers)]
+            for t in threads:
+                t.start()
+            _time.sleep(0.5)            # let traffic establish
+
+            def kill_restart(victim: int) -> None:
+                pc.kill(victim)
+                disk = rng.choice(["torn", "crc", "header", "enospc",
+                                   "fsync_eio", "none"])
+                if disk in ("torn", "crc", "header"):
+                    _disk_surgery(pc.store_path(victim), disk, rng)
+                elif disk == "enospc":
+                    pc.extra_env[victim] = {
+                        "APUS_DISKFAULT_ENOSPC": str(rng.randint(5, 40))}
+                elif disk == "fsync_eio":
+                    pc.extra_env[victim] = {
+                        "APUS_DISKFAULT_FSYNC_EIO":
+                            str(rng.randint(1, 10))}
+                _time.sleep(rng.uniform(0.1, 0.6))
+                pc.restart(victim)
+                pc.extra_env.pop(victim, None)
+
+            # Phase 1: network fault burst on a random member.
+            victim = rng.randrange(3)
+            send_fault(peers[victim], rng.choice([
+                {"cmd": "drop", "peer": "*",
+                 "p": round(rng.uniform(0.05, 0.25), 3)},
+                {"cmd": "delay", "lo": 0.0,
+                 "hi": round(rng.uniform(0.002, 0.015), 4)}]))
+            _time.sleep(rng.uniform(1.0, 2.0))
+            send_fault(peers[victim], {"cmd": "heal"})
+            _dbg("phase1 net burst done")
+
+            # Phase 2: leader SIGKILL mid-group-commit, restart with a
+            # seeded disk fault on the recovery path.
+            kill_restart(pc.leader_idx(timeout=15.0))
+            _dbg("phase2 leader kill/restart done")
+            _time.sleep(rng.uniform(1.0, 2.0))
+
+            # Phase 3 (seeded pick): bidirectional leader partition +
+            # heal, or a follower kill/restart with its own disk fault.
+            if rng.random() < 0.5:
+                lead = pc.leader_idx(timeout=15.0)
+                isolate(peers, lead)
+                _time.sleep(rng.uniform(0.8, 1.6))
+                heal_all(peers)
+            else:
+                lead = pc.leader_idx(timeout=15.0)
+                kill_restart(rng.choice([i for i in range(3)
+                                         if i != lead]))
+            _time.sleep(rng.uniform(1.0, 2.0))
+
+            # Heal everything, run a last clean-traffic window, stop.
+            _dbg("phase3 done")
+            heal_all(peers)
+            for i in range(3):
+                if pc.procs[i] is None:
+                    pc.restart(i)
+            _time.sleep(1.0 + minutes * 60.0)
+            stop.set()
+            _dbg("stopping workers")
+            for t in threads:
+                t.join(timeout=15.0)
+            _dbg("workers joined")
+            pc.wait_converged(timeout=45.0)
+            _dbg("converged")
+            # Final read round: with these in the history, a lost acked
+            # write is a linearizability violation too.
+            with ApusClient(peers, timeout=10.0,
+                            history=recorder) as c:
+                for k in keys:
+                    c.get(k)
+    _dbg(f"checking {len(recorder.events())} events")
+    res = check_history(recorder.events())
+    _dbg("check done")
+    stats = {"ops_checked": res.ops_checked, "keys": res.keys,
+             "ambiguous": sum(1 for e in recorder.events()
+                              if e["status"] != "ok"),
+             "recorded": len(recorder.events())}
+    if recorder.dropped:
+        raise AssertionError(
+            f"history ring overflowed ({recorder.dropped} dropped); "
+            f"verdict would be unsound")
+    if not res.ok or res.undecided:
+        dump = os.path.abspath(f"audit-fail-{fault_seed}.jsonl")
+        recorder.dump_jsonl(dump)
+        raise AssertionError(
+            f"LINEARIZABILITY VIOLATION (history: {dump})\n"
+            + res.describe())
+    return stats
+
+
 def _devplane_trial_subprocess(fault_seed: int,
                                timeout_s: float = 900.0) -> str:
     """Run one device-plane schedule in a CHILD process.  Each trial
@@ -358,6 +556,15 @@ def main() -> int:
                          "process-per-replica deployment shape at the "
                          "production envelope (kills, restarts, "
                          "durable-store recovery)")
+    ap.add_argument("--check-linear", action="store_true",
+                    help="consistency-audit chaos trials: concurrent "
+                         "recorded clients (serial + pipelined) on a "
+                         "live ProcCluster under seeded network faults "
+                         "+ leader SIGKILL/restart + disk faults, then "
+                         "a per-key Wing&Gong linearizability check "
+                         "over the captured history (apus_tpu.audit); "
+                         "any violation dumps the history JSONL and "
+                         "prints the seeded one-command repro")
     args = ap.parse_args()
     if args.one_devplane_trial is not None:
         verdict = run_devplane_schedule(args.one_devplane_trial, True)
@@ -365,16 +572,26 @@ def main() -> int:
         return 0
     mode_flags = (["--proc"] if args.proc else []) \
         + (["--device-plane"] if args.device_plane else []) \
-        + (["--auto-remove"] if args.auto_remove else [])
+        + (["--auto-remove"] if args.auto_remove else []) \
+        + (["--check-linear"] if args.check_linear else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
         seeds = [args.seed_base + t for t in range(args.trials)]
     ok = stalls = 0
     failures = []
+    audit = {"ops_checked": 0, "keys": 0, "ambiguous": 0,
+             "recorded": 0, "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
-            if args.proc:
+            if args.check_linear:
+                st = run_audit_schedule(fault_seed)
+                for k in ("ops_checked", "keys", "ambiguous",
+                          "recorded"):
+                    audit[k] += st[k]
+                audit["seeds"].append(fault_seed)
+                r = "ok"
+            elif args.proc:
                 r = run_proc_schedule(fault_seed,
                                       device_plane=args.device_plane)
             elif args.device_plane:
@@ -400,7 +617,8 @@ def main() -> int:
     eligible = len(seeds) - stalls
     pct = 100.0 if eligible <= 0 else round(100.0 * ok / eligible, 1)
     print(json.dumps({
-        "metric": ("proc_devplane_fuzz_clean_pct"
+        "metric": ("linear_audit_clean_pct" if args.check_linear
+                   else "proc_devplane_fuzz_clean_pct"
                    if args.proc and args.device_plane
                    else "devplane_fuzz_clean_pct" if args.device_plane
                    else "proc_fuzz_clean_pct" if args.proc
@@ -413,7 +631,13 @@ def main() -> int:
                    "seed_base": args.seed_base,
                    "fault_seed": args.fault_seed,
                    "device_plane": args.device_plane,
-                   "proc": args.proc},
+                   "proc": args.proc,
+                   # Audit campaign evidence (banked via eval.py): how
+                   # much history the checker proved linearizable, and
+                   # under which seeds.  violations is structurally 0
+                   # on a clean run — a violation is a trial FAILURE.
+                   **({"audit": {**audit, "violations": len(failures)}}
+                      if args.check_linear else {})},
     }))
     return 1 if failures else 0
 

@@ -88,6 +88,14 @@ def main() -> int:
                          "group-commit / lease-read path is exercised "
                          "alongside the proxied app traffic (counted "
                          "separately in the result)")
+    ap.add_argument("--audit", action="store_true",
+                    help="record every SET/GET of the soak stream as a "
+                         "timed history (apus_tpu.audit.HistoryRecorder"
+                         ") and run the per-key linearizability check "
+                         "over it at the end — failovers and fault "
+                         "bursts included; a violation fails the soak "
+                         "and dumps the history JSONL for "
+                         "`python -m apus_tpu.audit.linear <dump>`")
     args = ap.parse_args()
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
@@ -121,6 +129,24 @@ def main() -> int:
     ops_at_check = 0
     last_acked: tuple[str, str] | None = None     # (key, expected value)
     acked_at_check: tuple[str, str] | None = None
+
+    # --audit: the soak's own SET/GET stream, recorded as a timed
+    # history and linearizability-checked at the end.  App-LEVEL
+    # capture (invoke_kv), because the proxied app speaks its own
+    # protocol, not the KVS wire format.  The stream is single-
+    # threaded, but failovers/fault bursts interleave with it — a
+    # stale read served across a leadership move IS caught.
+    audit_rec = None
+    audit_req = [0]
+    if args.audit:
+        from apus_tpu.audit import HistoryRecorder
+        audit_rec = HistoryRecorder(capacity=1 << 18)
+
+    def _ainvoke(op: str, key: str, value: str = "") -> int:
+        audit_req[0] += 1
+        audit_rec.invoke_kv(1, audit_req[0], op, key.encode(),
+                            value.encode())
+        return audit_req[0]
 
     mesh_spec = None
     if args.mesh:
@@ -321,6 +347,7 @@ def main() -> int:
             k = f"soak:{seq % 4000}"
             v = f"v{seq}".ljust(32, "x")
             seq += 1
+            arids: list[int] = []
             try:
                 if args.pipeline:
                     kvs = [(k, v)]
@@ -329,22 +356,54 @@ def main() -> int:
                         kvs.append((kk, f"v{seq}".ljust(32, "x")))
                         seq += 1
                     k, v = kvs[-1]
-                    if not do_pipeline_set(client, kvs):
+                    if audit_rec is not None:
+                        arids = [_ainvoke("put", kk, vv)
+                                 for kk, vv in kvs]
+                    set_ok = do_pipeline_set(client, kvs)
+                    for rid in arids:
+                        audit_rec.complete(1, rid,
+                                           "ok" if set_ok else "error")
+                    arids = []
+                    if audit_rec is not None:
+                        arids = [_ainvoke("get", k)]
+                    got = do_get(client, k)
+                    if arids:
+                        audit_rec.complete(1, arids.pop(), "ok",
+                                           (got or "").encode())
+                    if not set_ok:
                         errors += 1
-                    elif do_get(client, k) != v:
+                    elif got != v:
                         errors += 1
                     else:
                         ops += len(kvs) + 1
                         pipe_windows += 1
                         last_acked = (k, v)
-                elif not do_set(client, k, v):
-                    errors += 1
-                elif do_get(client, k) != v:
-                    errors += 1
                 else:
-                    ops += 2
-                    last_acked = (k, v)
+                    if audit_rec is not None:
+                        arids = [_ainvoke("put", k, v)]
+                    set_ok = do_set(client, k, v)
+                    if arids:
+                        audit_rec.complete(1, arids.pop(),
+                                           "ok" if set_ok else "error")
+                    if not set_ok:
+                        errors += 1
+                    else:
+                        if audit_rec is not None:
+                            arids = [_ainvoke("get", k)]
+                        got = do_get(client, k)
+                        if arids:
+                            audit_rec.complete(1, arids.pop(), "ok",
+                                               (got or "").encode())
+                        if got != v:
+                            errors += 1
+                        else:
+                            ops += 2
+                            last_acked = (k, v)
             except (OSError, ConnectionError, RuntimeError):
+                # In-flight recorded ops are ambiguous (maybe applied).
+                if audit_rec is not None:
+                    for rid in arids:
+                        audit_rec.complete(1, rid, "ambiguous")
                 # Reconnect (leadership may have moved under us).
                 reconnects += 1
                 try:
@@ -417,6 +476,27 @@ def main() -> int:
                 time.sleep(0.5)
             converged = converged and ok
 
+    # Linearizability verdict over the recorded soak stream (the
+    # maintenance-gate convergence reads above are deliberately NOT in
+    # the history — they are allowed to be stale).
+    audit_detail = None
+    audit_ok = True
+    if audit_rec is not None:
+        from apus_tpu.audit import check_history
+        res = check_history(audit_rec.events())
+        audit_ok = res.ok and not res.undecided \
+            and audit_rec.dropped == 0
+        audit_detail = {"ops_checked": res.ops_checked,
+                        "keys": res.keys,
+                        "violations": len(res.violations),
+                        "undecided": len(res.undecided),
+                        "ring_dropped": audit_rec.dropped}
+        if not audit_ok:
+            dump = os.path.abspath("soak-audit-fail.jsonl")
+            audit_rec.dump_jsonl(dump)
+            audit_detail["dump"] = dump
+            print(res.describe(), file=sys.stderr)
+
     print(json.dumps({
         "metric": "soak_sustained_ops_per_sec",
         "value": round(ops / max(wall, 1e-9), 1),
@@ -437,6 +517,8 @@ def main() -> int:
             **({"fault_seed": args.fault_seed,
                 "faults_injected": faults_injected}
                if args.fault_seed is not None else {}),
+            **({"audit": audit_detail}
+               if audit_detail is not None else {}),
             **({"mesh": {
                 "device_commits": mesh_commits,
                 "degraded": mesh_dead,
@@ -451,14 +533,15 @@ def main() -> int:
             }} if args.mesh else {}),
         },
     }))
-    ok = converged and not errors
+    ok = converged and not errors and audit_ok
     if not ok and args.fault_seed is not None:
         print(f"SOAK FAIL (FAULT_SEED={args.fault_seed})\n"
               f"  repro: python benchmarks/soak.py --minutes "
               f"{args.minutes} --failover-every {args.failover_every} "
               f"--fault-seed {args.fault_seed}"
               + (" --mesh" if args.mesh else "")
-              + (" --toyserver" if args.toyserver else ""),
+              + (" --toyserver" if args.toyserver else "")
+              + (" --audit" if args.audit else ""),
               file=sys.stderr)
     return 0 if ok else 1
 

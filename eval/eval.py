@@ -108,6 +108,20 @@ def _run_single_window(out) -> None:
         _record(out, rec, replicas=5, bench="bench_single_window")
 
 
+def _run_audit(out, trials: int = 5) -> None:
+    """Consistency-audit chaos campaign (fuzz.py --check-linear):
+    seeded trials combining network faults + leader SIGKILL/restart +
+    disk faults on a live ProcCluster, with a per-key linearizability
+    check over the recorded client history after heal.  Banks ops
+    checked / violations / seeds as one record."""
+    print(f"fuzz.py --check-linear: consistency audit ({trials} trials)")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "benchmarks", "fuzz.py"),
+                          "--check-linear", "--trials", str(trials)],
+                         timeout=300 * trials):
+        _record(out, rec, replicas=3, bench="audit_campaign")
+
+
 def cmd_run(args) -> int:
     os.makedirs(RESULTS, exist_ok=True)
     replica_counts = [int(x) for x in args.replicas.split(",")]
@@ -115,6 +129,11 @@ def cmd_run(args) -> int:
         if getattr(args, "single_window_only", False):
             # Fast latency-path re-measure: skip the cluster suite.
             _run_single_window(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "audit_only", False):
+            # Fast consistency re-audit: skip the cluster suite.
+            _run_audit(out, trials=getattr(args, "audit_trials", 5))
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "throughput_only", False):
@@ -273,6 +292,10 @@ def cmd_run(args) -> int:
         # 3c. Pipelined replicated throughput (ISSUE 3 headline:
         # client pipelining + group-commit + read leases end to end).
         _run_throughput(out)
+
+        # 4. Consistency audit campaign (ISSUE 4: linearizability of
+        # live histories under crash + network + disk-fault chaos).
+        _run_audit(out, trials=getattr(args, "audit_trials", 5))
     print(f"results appended to {RUNS}")
     return 0
 
@@ -402,6 +425,19 @@ def cmd_report(args) -> int:
             f"(max_batch=1 control); lease GETs "
             f"{_fmt(d.get('gets_lease_ops_per_sec'))} ops/sec vs "
             f"read-index {_fmt(d.get('gets_readindex_ops_per_sec'))}")
+    aud = [r for r in runs if r.get("metric") == "linear_audit_clean_pct"
+           and isinstance(r.get("value"), (int, float))]
+    if aud:
+        last = aud[-1]
+        a = last.get("detail", {}).get("audit", {})
+        lines.append(
+            f"- consistency audit (chaos: network faults + leader "
+            f"SIGKILL/restart + disk faults): "
+            f"{last.get('detail', {}).get('trials')} seeded trials, "
+            f"{_fmt(a.get('ops_checked'))} client ops "
+            f"linearizability-checked over {a.get('keys')} keys, "
+            f"violations={a.get('violations', '?')}; "
+            f"seeds {a.get('seeds')}")
     fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
           and isinstance(r.get("value"), (int, float))]
     ser = {}
@@ -545,6 +581,12 @@ def main() -> int:
                        help="run ONLY the pipelined-throughput bench "
                             "(bench.py --throughput; skips the cluster "
                             "suite)")
+        p.add_argument("--audit-only", action="store_true",
+                       help="run ONLY the consistency-audit chaos "
+                            "campaign (fuzz.py --check-linear; skips "
+                            "the cluster suite)")
+        p.add_argument("--audit-trials", type=int, default=5,
+                       help="seeded audit-campaign trials per run")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",

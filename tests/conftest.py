@@ -37,3 +37,8 @@ def pytest_configure(config):
         "faultplane: live-stack fault-injection suite "
         "(apus_tpu.parallel.faults) — deterministic faults on the real "
         "transport; selectable with -m faultplane")
+    config.addinivalue_line(
+        "markers",
+        "audit: consistency-audit suite (apus_tpu.audit) — history "
+        "capture + linearizability checking, incl. live-cluster "
+        "accept/reject validation; selectable with -m audit")

@@ -82,6 +82,16 @@ def test_proc_devplane_fuzz_slice():
                                   device_plane=True) == "ok"
 
 
+def test_audit_fuzz_slice():
+    """One consistency-audit chaos trial (concurrent recorded clients,
+    network fault burst, leader SIGKILL + restart with a seeded disk
+    fault on the recovery path, linearizability check over the whole
+    captured history): zero violations, real checked volume."""
+    fuzz = _fuzz()
+    stats = fuzz.run_audit_schedule(36_000)
+    assert stats["ops_checked"] > 100, stats
+
+
 def test_soak_slice():
     """A 45-second endurance slice of the soak (real redis under
     sustained replicated traffic at the production misdirection

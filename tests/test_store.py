@@ -95,6 +95,107 @@ def test_cross_implementation_interop(tmp_path):
         assert s.records() == RECORDS + [b"from-native"]
 
 
+def test_cross_impl_torn_tail_equivalence(tmp_path):
+    """Torn-tail PROPERTY test across implementations: identical
+    records through NativeRecordStore and PyRecordStore yield
+    byte-identical files; after bitwise-identical corruption (torn
+    truncations at every boundary class + CRC flips at seeded offsets)
+    BOTH implementations must recover the SAME record prefix, and
+    appending after recovery must leave the files byte-identical
+    again.  store.cpp previously had no torn-tail test at all."""
+    import random
+    if not native_available():
+        pytest.fail("native store must build in this image")
+
+    rng = random.Random(0xD15C)
+    recs = [rng.randbytes(rng.choice([0, 1, 7, 64, 500]))
+            for _ in range(12)]
+    base_n, base_p = str(tmp_path / "n.db"), str(tmp_path / "p.db")
+    with NativeRecordStore(base_n) as sn, PyRecordStore(base_p) as sp:
+        for r in recs:
+            sn.append(r)
+            sp.append(r)
+    with open(base_n, "rb") as f:
+        blob_n = f.read()
+    with open(base_p, "rb") as f:
+        blob_p = f.read()
+    assert blob_n == blob_p, "implementations diverge on clean append"
+
+    size = len(blob_n)
+    # Corruption set: tears into the last header, mid-payload, one
+    # byte, deep multi-record tears; CRC flips at seeded offsets.
+    cases = [("torn", size - 1), ("torn", size - 5),
+             ("torn", size - 12), ("torn", size - 200),
+             ("torn", size // 2), ("torn", 9)]
+    cases += [("flip", rng.randrange(8, size)) for _ in range(8)]
+
+    for ci, (kind, off) in enumerate(cases):
+        blob = bytearray(blob_n)
+        if kind == "torn":
+            blob = blob[:off]
+        else:
+            blob[off] ^= 0xFF
+        recovered = {}
+        appended = {}
+        for impl, cls in (("native", NativeRecordStore),
+                          ("python", PyRecordStore)):
+            p = str(tmp_path / f"case{ci}.{impl}.db")
+            with open(p, "wb") as f:
+                f.write(blob)
+            with cls(p) as s:
+                recovered[impl] = s.records()
+                s.append(b"after-recovery")
+            with open(p, "rb") as f:
+                appended[impl] = f.read()
+        assert recovered["native"] == recovered["python"], \
+            (ci, kind, off)
+        # Both recover a strict PREFIX of the written records.
+        got = recovered["native"]
+        assert got == recs[:len(got)], (ci, kind, off)
+        assert appended["native"] == appended["python"], (ci, kind, off)
+
+
+def test_faultstore_injection_parity(tmp_path):
+    """FaultStore's torn/CRC injection produces the same recovered
+    prefix whichever implementation sits underneath (campaigns must
+    not depend on which store the daemon happened to open)."""
+    if not native_available():
+        pytest.fail("native store must build in this image")
+    from apus_tpu.utils.store import FaultStore
+
+    out = {}
+    for impl, cls in (("native", NativeRecordStore),
+                      ("python", PyRecordStore)):
+        p = str(tmp_path / f"f.{impl}.db")
+        with FaultStore(cls(p), torn_at=3, crc_at=5) as s:
+            for r in RECORDS:
+                s.append(r)
+            assert s.count == len(RECORDS)   # live view stays whole
+        with cls(p) as s:
+            out[impl] = s.records()
+    assert out["native"] == out["python"]
+    # Scan stops at the FIRST damaged record (the torn one).
+    assert out["native"] == RECORDS[:2]
+
+
+def test_open_store_quarantines_corrupt_header(tmp_path):
+    """open_store with a corrupt header: the native open refuses, the
+    Python fallback quarantines — either way the daemon gets a WORKING
+    empty store, never a crash-loop."""
+    from apus_tpu.utils.store import open_store
+    p = str(tmp_path / "q.db")
+    with PyRecordStore(p) as s:
+        s.append(b"data")
+    with open(p, "r+b") as f:
+        f.write(b"NOTASTOR")
+    with open_store(p, prefer_native=True) as s:
+        assert s.count == 0
+        s.append(b"fresh")
+    assert os.path.exists(p + ".corrupt")
+    with PyRecordStore(p) as s:
+        assert s.records() == [b"fresh"]
+
+
 def test_daemon_persistence(tmp_path):
     from apus_tpu.core.epdb import EndpointDB
     from apus_tpu.models.kvs import KvsStateMachine
