@@ -106,6 +106,20 @@ class Cid:
                                    state=CidState.STABLE,
                                    size=self.new_size, new_size=0)
 
+    def abort_extend(self) -> "Cid":
+        """EXTENDED -> STABLE at the OLD size, dropping every new slot
+        (epoch bump).  The clean-abort arm of the resize ladder: a
+        joiner that dies before catching up would otherwise pin the
+        configuration in EXTENDED forever (TRANSIT waits for its acks,
+        auto-removal refuses non-STABLE configs).  Safe under the
+        EXTENDED agreement rule — new slots never voted, so reverting
+        to the old member set changes no quorum anybody counted."""
+        if self.state != CidState.EXTENDED:
+            raise ValueError("abort_extend requires EXTENDED")
+        return dataclasses.replace(
+            self, epoch=self.epoch + 1, state=CidState.STABLE,
+            new_size=0, bitmask=self.bitmask & ((1 << self.size) - 1))
+
     @staticmethod
     def initial(size: int) -> "Cid":
         return Cid(epoch=0, state=CidState.STABLE, size=size,

@@ -119,6 +119,11 @@ class PendingJoin:
     slot: int
     entry_idx: Optional[int] = None
     done: bool = False
+    #: The CONFIG entry applied but the slot is NOT in the applied
+    #: configuration (a resize abort raced the join): the handler must
+    #: answer "retry", never "admitted" — a joiner told "admitted at
+    #: slot s" after the abort would boot straight into exclusion.
+    refused: bool = False
 
 
 @dataclasses.dataclass
@@ -201,6 +206,32 @@ class Node:
         # In-flight join requests by joiner address (ep_db join dedup
         # analog, dare_ep_db.h:20-31 / handle_server_join_request).
         self._pending_joins: dict[str, PendingJoin] = {}
+        # Why the last handle_join returned None while we WERE leader —
+        # the membership service reads it (under the same lock) to
+        # answer a typed refusal instead of a misleading NOT_LEADER
+        # that sends the joiner hint-chasing a leader it already found.
+        self.last_join_refusal: Optional[str] = None
+        # In-flight operator-initiated removals (OP_LEAVE) by slot,
+        # resolved when their CONFIG entry applies.
+        self._pending_leaves: dict[int, PendingJoin] = {}
+        # Graceful-leave drain: set by the runtime once OUR removal is
+        # committed cluster-wide — this replica stops voting/acking and
+        # never campaigns again (the runtime exits it cleanly).
+        self.draining = False
+        # Incarnation fencing (removed-member hygiene): ``incarnation``
+        # is the epoch of the CONFIG that admitted THIS tenancy of our
+        # slot (0 for initial members; joiners adopt the admission
+        # cid's epoch), sent with every outbound ctrl write on the live
+        # wire.  ``fence_epochs[slot]`` is the epoch of the latest
+        # applied CONFIG that REMOVED that slot; the peer server drops
+        # inbound ctrl writes whose incarnation is below it — so a
+        # stale ex-member's REP_ACK/vote can never be credited to the
+        # slot's next occupant (nor count while the slot is empty).
+        # Deterministic replicated state: derived from applied CONFIG
+        # entries, carried by snapshots (Snapshot.fence) for installers
+        # that skip the entries.
+        self.incarnation = 0
+        self.fence_epochs: dict[int, int] = {}
         # Applied member addresses (from join CONFIG payloads): lets a
         # retried join whose reply was lost be answered idempotently
         # instead of admitting the same address into a second slot.
@@ -223,8 +254,19 @@ class Node:
         # sim keeps the inline path (deterministic, no threads).
         self.async_snap_push = False
         self._snap_pushing: set[int] = set()
-        #: peer -> (term_at_start, result, pushed_last_idx)
+        #: peer -> (term_at_start, result, pushed_last_idx, push_gen)
         self._snap_push_done: dict[int, tuple] = {}
+        # Wedge watchdog for background pushes: a stream to a peer that
+        # died mid-transfer normally errors out within a few bounded
+        # chunk roundtrips, but the push SLOT must never be held
+        # hostage by a pathological stall — while a peer is in
+        # _snap_pushing the tick thread skips it entirely, so a wedged
+        # thread would silently stop replication to that slot's next
+        # incarnation forever.  After SNAP_PUSH_STALL_S the slot is
+        # abandoned: the generation bumps (the late completion is
+        # ignored) and normal adjustment resumes.
+        self._snap_push_started: dict[int, float] = {}
+        self._snap_push_gen: dict[int, int] = {}
         # Determinant of the last applied entry — the snapshot anchor
         # (snapshot_t.last_entry analog, dare_log.h:107-112); survives
         # pruning, unlike log.get(apply-1).
@@ -261,6 +303,9 @@ class Node:
         # (exactly as the reference's recovery reads the same memory
         # its RDMA writes landed in, rc_recover_log dare_ibv_rc.c:726).
         self.pre_election_hook = None
+        # EXTENDED-resize stall watchdog: (new-slot ack snapshot, since)
+        # — drives the clean abort in _maybe_advance_resize.
+        self._resize_stall: Optional[tuple] = None
         # Contact gate for recovery starts (see NodeConfig.recovery_start).
         self._await_contact = cfg.recovery_start
         self._contact_deadline: Optional[float] = None
@@ -433,6 +478,7 @@ class Node:
         dare_ibv_ud.c:1070-1087.)"""
         if not self.is_leader:
             return None
+        self.last_join_refusal = None
         pj = self._pending_joins.get(addr)
         if pj is not None:                   # retransmitted join: dedup
             return pj
@@ -449,15 +495,24 @@ class Node:
         # hasn't updated self.cid yet and is just as conflicting.
         if any(e.type == EntryType.CONFIG
                for e in self.log.entries(self.log.apply)):
+            self.last_join_refusal = "config_in_flight"
             return None
         if want_slot is not None:
-            if not (0 <= want_slot < self.cid.size) \
-                    or self.cid.contains(want_slot):
-                return None              # occupied/invalid: refuse
+            if not (0 <= want_slot < self.cid.size):
+                self.last_join_refusal = "slot_out_of_range"
+                return None
+            if self.cid.contains(want_slot):
+                # The slot a recovered server wants back is BOUND to a
+                # different live address: its identity was reassigned —
+                # rejoin at that slot is permanently refused (the
+                # typed "removed, rejoin refused" answer).
+                self.last_join_refusal = "slot_bound"
+                return None
             slot = want_slot
             new_cid = dataclasses.replace(
                 self.cid.with_server(slot), epoch=self.cid.epoch + 1)
             if self.log.near_full(1):
+                self.last_join_refusal = "log_full"
                 return None
             pj = PendingJoin(addr=addr, slot=slot)
             pj.entry_idx = self.log.append(
@@ -470,13 +525,16 @@ class Node:
             new_cid = dataclasses.replace(
                 self.cid.with_server(slot), epoch=self.cid.epoch + 1)
         elif self.cid.state != CidState.STABLE:
+            self.last_join_refusal = "mid_resize"
             return None                      # one resize at a time
         elif self.cid.size >= MAX_SERVER_COUNT:
+            self.last_join_refusal = "capacity"
             return None                      # at protocol capacity
         else:
             slot = self.cid.size
             new_cid = self.cid.extend(self.cid.size + 1).with_server(slot)
         if self.log.near_full(1):
+            self.last_join_refusal = "log_full"
             return None     # reserve the last slot for the HEAD entry
         pj = PendingJoin(addr=addr, slot=slot)
         pj.entry_idx = self.log.append(
@@ -484,6 +542,57 @@ class Node:
             data=f"{slot} {addr}".encode())
         self._pending_joins[addr] = pj
         return pj
+
+    #: handle_join/handle_leave refusal reasons the caller may retry
+    #: after backing off (the condition is transient); everything else
+    #: is permanent for the current configuration.
+    TRANSIENT_REFUSALS = ("config_in_flight", "mid_resize", "log_full")
+
+    def handle_leave(self, slot: int):
+        """Operator-initiated graceful removal (OP_LEAVE): append the
+        CONFIG entry removing ``slot`` — the drained replica stops
+        voting/serving once the removal is committed and exits clean,
+        vs. auto-removal's failure-detector-only path.  Returns a
+        handle resolved when the entry applies, a refusal-reason string
+        (see TRANSIENT_REFUSALS for which are retryable), or None when
+        not leader.  Removing the leader itself is allowed: the entry
+        is replicated to a quorum before it applies, and the leader
+        steps down at the apply (standard C_new-excludes-leader
+        handling).  Same guards as auto-removal: STABLE configurations
+        only, never below the quorum floor of the unchanged ``size``
+        denominator."""
+        if not self.is_leader:
+            return None
+        existing = self._pending_leaves.get(slot)
+        if existing is not None:             # retransmitted: dedup
+            return existing
+        if not self.cid.contains(slot):
+            return PendingJoin(addr="", slot=slot, done=True)  # already out
+        if self.cid.state != CidState.STABLE:
+            return "mid_resize"
+        if any(e.type == EntryType.CONFIG
+               for e in self.log.entries(self.log.apply)):
+            return "config_in_flight"
+        if len(self.cid.members()) - 1 < quorum_size(self.cid.size):
+            return "quorum_floor"
+        if self.log.near_full(1):
+            return "log_full"
+        pl = PendingJoin(addr="", slot=slot)
+        # The "leave" marker makes the removal's REASON replicated
+        # state: the drained replica (whichever member it is) learns
+        # from applying this entry that its removal was intentional —
+        # so it drains and exits instead of re-joining like an evicted
+        # member would.  Unparseable as a join payload by construction
+        # (join payloads are "<slot> <addr>").
+        pl.entry_idx = self.log.append(
+            self.sid.sid.term, type=EntryType.CONFIG,
+            cid=dataclasses.replace(self.cid.without_server(slot),
+                                    epoch=self.cid.epoch + 1),
+            data=b"leave %d" % slot)
+        self._pending_leaves[slot] = pl
+        self.stats["graceful_leaves"] = \
+            self.stats.get("graceful_leaves", 0) + 1
+        return pl
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
 
@@ -510,10 +619,44 @@ class Node:
         # prefix): an installer can then complete a group whose early
         # chunks lie below the snapshot cut — no mid-group gating, no
         # stranded seg_incomplete finals (core.segment.Reassembler).
-        snap = dataclasses.replace(snap, seg=self._seg.dump())
+        snap = dataclasses.replace(snap, seg=self._seg.dump(),
+                                   fence=self._fence_blob())
         self._snap_cache = (snap, self.epdb.dump(), self.cid,
                             dict(self._member_addrs))
         return self._snap_cache
+
+    def _fence_blob(self) -> bytes:
+        """Removed-slot fence table at the current apply point, in the
+        Snapshot.fence wire form (JSON; empty when no slot was ever
+        removed — the overwhelmingly common case costs zero bytes)."""
+        if not self.fence_epochs:
+            return b""
+        import json as _json
+        return _json.dumps({str(k): v for k, v
+                            in self.fence_epochs.items()}).encode()
+
+    def adopt_fence(self, fence: bytes) -> None:
+        """Merge a snapshot's fence table (monotone max per slot)."""
+        if not fence:
+            return
+        import json as _json
+        try:
+            table = _json.loads(fence.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        for k, v in table.items():
+            try:
+                slot, epoch = int(k), int(v)
+            except (TypeError, ValueError):
+                continue
+            if epoch > self.fence_epochs.get(slot, 0):
+                self.fence_epochs[slot] = epoch
+
+    #: Backstop for a background snapshot push whose thread never
+    #: completes (every chunk roundtrip is wire-timeout-bounded, so
+    #: this should never fire — but a held push slot silently stops
+    #: ALL replication to that peer, so a wedge must be bounded).
+    SNAP_PUSH_STALL_S = 60.0
 
     #: Stream (chunked) snapshot pushes instead of one-blob pushes when
     #: the SM's on-disk dump exceeds this.  The one-blob path holds the
@@ -543,7 +686,8 @@ class Node:
         if total is None or total < self.SNAP_STREAM_THRESHOLD:
             return None
         last_idx, last_term = self._applied_det
-        meta = Snapshot(last_idx, last_term, b"", seg=self._seg.dump())
+        meta = Snapshot(last_idx, last_term, b"", seg=self._seg.dump(),
+                        fence=self._fence_blob())
         gen = getattr(self.sm, "dump_generation", 0)
         self._snap_stream_cache = (meta, self.epdb.dump(), self.cid,
                                    dict(self._member_addrs), total, gen)
@@ -599,8 +743,15 @@ class Node:
         self._applied_det = (snap.last_idx, snap.last_term)
         self._snap_cache = None
         self._snap_stream_cache = None
+        self.adopt_fence(snap.fence)
         if cid is not None and cid.epoch >= self.cid.epoch:
             self.cid = cid
+            if cid.contains(self.idx):
+                # Adopting a configuration that includes us attests our
+                # tenancy at least to its epoch (safe to inflate: any
+                # config >= a removal epoch that still contains us
+                # means we were legitimately re-admitted).
+                self.incarnation = max(self.incarnation, cid.epoch)
             for addr, slot in (member_addrs or {}).items():
                 if not cid.contains(slot):
                     continue
@@ -714,7 +865,9 @@ class Node:
         self._fail_last = {}
         self._pending_head = None
         self._pending_joins.clear()
+        self._pending_leaves.clear()
         self._transit_pending = False
+        self._resize_stall = None
         self.regions.grant_log_access(self.idx, my.term)
         # A fresh leader may not know its own tail if it recovered; our
         # absolute-index log always does.  Append a blank entry so commit
@@ -768,6 +921,7 @@ class Node:
         self._inflight.clear()
         self._pending_reads.clear()    # clients retry against the new leader
         self._pending_joins.clear()    # joiners retry against the new leader
+        self._pending_leaves.clear()   # operators retry against the new leader
         self._leader_verified_seq = -1
 
     # ------------------------------------------------------------------
@@ -777,6 +931,13 @@ class Node:
     def _poll_vote_requests(self, now: float) -> None:
         """poll_vote_requests analog (dare_server.c:1526-1743)."""
         slots = self.regions.ctrl[Region.VOTE_REQ]
+        if self.draining:
+            # Graceful leave, removal committed: grant nothing — a
+            # drained replica's vote must never count toward any
+            # election (it is leaving the voter set).
+            for i in range(len(slots)):
+                slots[i] = None
+            return
         # Non-members cannot campaign: an evicted/stale server's vote
         # requests must not even bump our term, or it can depose live
         # leaders forever (the disruptive-server problem; the reference
@@ -907,6 +1068,8 @@ class Node:
     def _follower_tick(self, now: float) -> None:
         """hb_receive_cb + replication-ack + apply reporting
         (dare_server.c:822-922, persist_new_entries :1792-1810)."""
+        if self.draining:
+            return      # drained: no acks, no campaigns, no reports
         self._scan_heartbeats(now)
         if now - self._last_hb_seen > self._hb_timeout:
             if self._await_contact:
@@ -973,6 +1136,15 @@ class Node:
 
     def _leader_tick(self, now: float) -> None:
         my = self.sid.sid
+        if not self.cid.contains(self.idx):
+            # Our own committed removal applied (graceful leave of the
+            # leader, or an operator removal): C_new excludes us, so we
+            # replicated it to a quorum of C_new before apply — step
+            # down now instead of zombie-serving a group that will
+            # elect without us (the classic leader-removal rule; the
+            # reference's DIE_AF_COMMIT, dare_server.c:1870-1874).
+            self.become_follower(Sid(my.term, False, self.idx), now)
+            return
         if self._term_blank_pending:
             self._append_term_start(my)
         # Step down if a higher term appeared (hb_send_cb step-down check,
@@ -989,7 +1161,7 @@ class Node:
         self._drain_pending(my)
         self._replicate(my, now)
         self._advance_commit(my)
-        self._maybe_advance_resize(my)
+        self._maybe_advance_resize(my, now)
         if now >= self._next_hb_send:
             self._send_heartbeats(my, now)
             self._next_hb_send = now + self.cfg.hb_period
@@ -1057,14 +1229,31 @@ class Node:
             # guarantees any completion is fully recorded — popping
             # first could miss both and launch a duplicate full push.
             if peer in self._snap_pushing:
-                continue
+                started = self._snap_push_started.get(peer, now)
+                if now - started <= self.SNAP_PUSH_STALL_S:
+                    continue
+                # Wedged push (the stream normally errors out within a
+                # few bounded chunk roundtrips when the receiver dies —
+                # this is the backstop): abandon the slot so the next
+                # incarnation of the peer is served, bump the push
+                # generation so the late completion is ignored, and
+                # re-adjust from scratch.
+                self._snap_push_gen[peer] = \
+                    self._snap_push_gen.get(peer, 0) + 1
+                self._snap_pushing.discard(peer)
+                self._snap_push_started.pop(peer, None)
+                self._adjusted[peer] = False
+                self.stats["snap_push_abandoned"] = \
+                    self.stats.get("snap_push_abandoned", 0) + 1
             # Consume a background snapshot-push completion: once the
             # peer installed, its acks fast-forward next_idx past our
             # head and the push branch below never runs again for it —
             # the completion (stats + cursor/failure bookkeeping) must
-            # not strand.  Stale-term completions are dropped.
+            # not strand.  Stale-term and abandoned-generation
+            # completions are dropped.
             done = self._snap_push_done.pop(peer, None)
-            if done is not None and done[0] == my.term:
+            if done is not None and done[0] == my.term \
+                    and done[3] == self._snap_push_gen.get(peer, 0):
                 self._finish_snap_push(peer, done[1], done[2], now,
                                        streamed=True)
             ack = self.regions.ctrl[Region.REP_ACK][peer]
@@ -1153,6 +1342,8 @@ class Node:
                         dupper = getattr(self.sm, "dup_dump_fd", None)
                         dup_fd = dupper() if dupper is not None else None
                         self._snap_pushing.add(peer)
+                        self._snap_push_started[peer] = now
+                        push_gen = self._snap_push_gen.get(peer, 0)
                         import os as _os
                         import threading as _threading
 
@@ -1168,7 +1359,7 @@ class Node:
                                   ep_dump=ep_dump, snap_cid=snap_cid,
                                   members=members, total=total,
                                   read_chunk=_read_pinned,
-                                  dup_fd=dup_fd):
+                                  dup_fd=dup_fd, push_gen=push_gen):
                             try:
                                 r = self.t.snap_push_stream(
                                     peer, my, meta, ep_dump, snap_cid,
@@ -1182,8 +1373,14 @@ class Node:
                                     except OSError:
                                         pass
                             self._snap_push_done[peer] = \
-                                (my.term, r, meta.last_idx)
-                            self._snap_pushing.discard(peer)
+                                (my.term, r, meta.last_idx, push_gen)
+                            # Free the slot only if OUR push still owns
+                            # it — after a stall abandonment the slot
+                            # may belong to a successor push.
+                            if self._snap_push_gen.get(peer,
+                                                       0) == push_gen:
+                                self._snap_pushing.discard(peer)
+                                self._snap_push_started.pop(peer, None)
 
                         _threading.Thread(
                             target=_push, daemon=True,
@@ -1320,12 +1517,33 @@ class Node:
                         self.stats["commits"] += 1
                 break
 
-    def _maybe_advance_resize(self, my: Sid) -> None:
+    #: How long an EXTENDED resize tolerates a new slot with zero ack
+    #: progress AND failure-detector evidence of death before the
+    #: resize is ABORTED back to STABLE (see _maybe_advance_resize).
+    #: A multiple of the eviction delay so a merely-slow joiner
+    #: (snapshot install, cold boot) is never aborted.
+    def _resize_abort_after(self) -> float:
+        return max(2.0 * PERMANENT_FAILURE * self.cfg.fail_window,
+                   20 * self._hb_timeout)
+
+    def _maybe_advance_resize(self, my: Sid, now: float) -> None:
         """EXTENDED -> TRANSIT once every new slot has caught up
         (the reference moves to TRANSIT when the joiner's recovery
         completes; cf. dare_ibv_ud.c:1024-1037).  TRANSIT -> STABLE then
-        happens on TRANSIT's apply (_apply_config)."""
+        happens on TRANSIT's apply (_apply_config).
+
+        ABORT arm: a joiner that dies before catching up would pin the
+        configuration in EXTENDED forever — TRANSIT waits on its acks
+        and auto-removal refuses non-STABLE configs — wedging all
+        future membership changes (the cluster still commits under the
+        old majority, but can never resize or evict again).  When a
+        new slot shows failure-detector evidence of death
+        (PERMANENT_FAILURE strikes) and no ack progress for
+        _resize_abort_after, the resize is cleanly aborted: one CONFIG
+        entry back to STABLE at the old size (Cid.abort_extend), and
+        the joiner — if it ever returns — re-runs the join protocol."""
         if self.cid.state != CidState.EXTENDED or self._transit_pending:
+            self._resize_stall = None
             return
         # Another CONFIG in flight (e.g. an auto-removal built from the
         # same cid): appending TRANSIT now would apply after it at the
@@ -1337,10 +1555,28 @@ class Node:
         new_members = [m for m in self.cid.members() if m >= self.cid.size]
         if not new_members:
             return
+        ready = True
         for m in new_members:
             a = acks[m]
             if a is None or a < self.log.commit:
-                return
+                ready = False
+        if not ready:
+            snap = tuple(acks[m] for m in new_members)
+            prev = self._resize_stall
+            if prev is None or prev[0] != snap:
+                self._resize_stall = (snap, now)
+            elif now - prev[1] > self._resize_abort_after() and any(
+                    self._fail_count.get(m, 0) >= PERMANENT_FAILURE
+                    and m not in self._snap_pushing
+                    and not self.t.peer_failure_was_timeout(m)
+                    for m in new_members) and not self.log.near_full(1):
+                self.log.append(my.term, type=EntryType.CONFIG,
+                                cid=self.cid.abort_extend())
+                self._resize_stall = None
+                self.stats["resize_aborts"] = \
+                    self.stats.get("resize_aborts", 0) + 1
+            return
+        self._resize_stall = None
         if self.log.near_full(1):
             return          # reserve the last slot for the HEAD entry
         self.log.append(my.term, type=EntryType.CONFIG,
@@ -1362,9 +1598,16 @@ class Node:
         # on transports that don't echo (the deterministic sim), where
         # multi-member leases simply never engage.
         hints = getattr(self.t, "peer_sid_seen", None)
+        fenced = 0
         for peer in self._replication_targets():
-            if self.t.ctrl_write(peer, Region.HB, self.idx, my.word) \
-                    != WriteResult.OK:
+            res = self.t.ctrl_write(peer, Region.HB, self.idx, my.word)
+            if res == WriteResult.FENCED:
+                # The peer's fence table says our slot's incarnation
+                # was removed (incarnation fencing): affirmative
+                # removal evidence, counted below.
+                fenced += 1
+                continue
+            if res != WriteResult.OK:
                 self._note_failure(peer, now)
                 continue
             # A reachable peer is not failing: reset the counter so
@@ -1377,6 +1620,19 @@ class Node:
                         and Sid.unpack(seen[0]).term <= my.term:
                     mask |= 1 << peer
         self.stats["hb_sent"] += 1
+        if fenced >= quorum_size(self.cid.size):
+            # A quorum of peers affirms our slot was removed at an
+            # epoch past our incarnation — we are a zombie ex-leader
+            # that never applied its own removal (partitioned through
+            # it).  Step down; the runtime's exclusion watchdog owns
+            # re-admission.  Without this, such a leader idles forever
+            # (nobody heartbeats a non-member, so its hb-age never
+            # grows and the watchdog never fires) while client
+            # requests burn timeouts against it.
+            self.stats["fenced_stepdowns"] = \
+                self.stats.get("fenced_stepdowns", 0) + 1
+            self.become_follower(Sid(my.term, False, self.idx), now)
+            return
         if not self.cfg.read_lease or self.cid.state != CidState.STABLE:
             return      # no lease across joint-consensus quorums
         # The fan-out yields the node lock on the wire: renew only if
@@ -1483,9 +1739,10 @@ class Node:
     def _note_failure(self, peer: int, now: float) -> None:
         """check_failure_count analog (dare_server.c:1189-1227): after
         PERMANENT_FAILURE failures — counted at most once per fail_window —
-        the leader removes the peer via a CONFIG entry."""
-        if not self.cfg.auto_remove:
-            return
+        the leader removes the peer via a CONFIG entry.  The COUNTING
+        always runs (the resize-abort watchdog consumes the counter
+        even with auto_remove off); only the removal itself is gated
+        on cfg.auto_remove."""
         if not self.t.peer_established(peer):
             # Never reached at its current address: a cold-starting or
             # still-joining member, not a failed one.  The reference can
@@ -1512,6 +1769,8 @@ class Node:
         self._fail_last[peer] = now
         n = self._fail_count.get(peer, 0) + 1
         self._fail_count[peer] = n
+        if not self.cfg.auto_remove:
+            return
         if n >= PERMANENT_FAILURE and self.cid.contains(peer):
             # Reference guards (check_failure_count): removal only from
             # a STABLE configuration (dare_server.c:1202), and never so
@@ -1540,6 +1799,8 @@ class Node:
                     cid=dataclasses.replace(
                         self.cid.without_server(peer),
                         epoch=self.cid.epoch + 1))
+                self.stats["auto_removes"] = \
+                    self.stats.get("auto_removes", 0) + 1
 
     def _maybe_prune(self, my: Sid) -> None:
         """log_pruning analog (dare_server.c:1996-2067).  P1: only applied
@@ -1691,6 +1952,27 @@ class Node:
                 self._commit_sent.pop(m, None)
                 self.regions.ctrl[Region.REP_ACK][m] = None
                 self.regions.ctrl[Region.APPLY_IDX][m] = None
+        # Removed slots: record the removal epoch as the slot's fence —
+        # the peer server then drops inbound ctrl writes (REP_ACK,
+        # votes, heartbeats) from any incarnation admitted before it,
+        # so a stale ex-occupant can never be credited to the slot's
+        # next tenant nor count while the slot is empty.  Also clear
+        # the region slots NOW: a phantom REP_ACK/APPLY_IDX left from
+        # the old occupant must not survive into an empty slot (it
+        # doesn't count toward quorum while non-member, but a pruning
+        # floor read or a stale-looking ack at readmission would see
+        # it).
+        for m in self.cid.members():
+            if not new_cid.contains(m):
+                if new_cid.epoch > self.fence_epochs.get(m, 0):
+                    self.fence_epochs[m] = new_cid.epoch
+                self.regions.ctrl[Region.REP_ACK][m] = None
+                self.regions.ctrl[Region.APPLY_IDX][m] = None
+        if new_cid.contains(self.idx):
+            # A configuration that includes us attests our tenancy to
+            # its epoch (monotone; see install_snapshot for why
+            # inflating past the admission epoch is safe).
+            self.incarnation = max(self.incarnation, new_cid.epoch)
         self.cid = new_cid
         # Learn the joiner's address (idempotent-join dedup).  A reused
         # slot evicts the previous occupant's address claim, and slots
@@ -1714,11 +1996,23 @@ class Node:
         # Runtime notification (peer-table update on join, role of the
         # CFG_REPLY + poll_config_entries pair, dare_server.c:2133-2187).
         self.config_upcalls.append(e)
-        # Resolve join handles waiting on this entry.
+        # Resolve join handles waiting on this entry.  "Applied" is not
+        # "admitted": a resize ABORT that raced the join also satisfies
+        # entry_idx <= e.idx — the joiner's slot is then absent from
+        # the applied configuration and the handle resolves REFUSED
+        # (the joiner backs off and retries) instead of done.
         for addr, pj in list(self._pending_joins.items()):
             if pj.entry_idx is not None and pj.entry_idx <= e.idx:
-                pj.done = True
+                if new_cid.contains(pj.slot):
+                    pj.done = True
+                else:
+                    pj.refused = True
                 del self._pending_joins[addr]
+        # Resolve graceful-leave handles (OP_LEAVE waits on these).
+        for slot, pl in list(self._pending_leaves.items()):
+            if pl.entry_idx is not None and pl.entry_idx <= e.idx:
+                pl.done = True
+                del self._pending_leaves[slot]
         if self.is_leader:
             # Drive the joint-consensus ladder forward.
             if new_cid.state == CidState.EXTENDED:

@@ -20,8 +20,15 @@ class Snapshot:
     last_term: int
     data: bytes
     seg: bytes = b""
+    #: Removed-slot fence table at the snapshot point (JSON
+    #: ``{slot: last-removal-epoch}``; core.node incarnation fencing).
+    #: Derived from the CONFIG entries inside the covered prefix — the
+    #: installer never applies those, so the fence must travel with the
+    #: snapshot or a freshly-primed member would accept ctrl writes
+    #: from a stale ex-occupant of a removed-then-reused slot.
+    fence: bytes = b""
     #: LOCAL-ONLY fields for file-backed installs (never wire-encoded —
-    #: wire.encode_value serializes the four fields above only).  A
+    #: wire.encode_value serializes the five fields above only).  A
     #: streamed install sets ``data_path``/``data_len``/``data_gen`` so
     #: downstream consumers (persistence) can stream the immutable
     #: [0, data_len) prefix of that file instead of a blob that was

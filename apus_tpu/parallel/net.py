@@ -222,6 +222,19 @@ class PeerServer:
             region = wire.REGION_LIST[r.u8()]
             slot = r.u8()
             value = wire.decode_value(r)
+            # Incarnation fencing (core.node fence_epochs): the trailing
+            # u32 is the writer's incarnation — the epoch of the CONFIG
+            # that admitted its tenancy of ``slot``.  A write below the
+            # slot's recorded removal epoch comes from a STALE
+            # EX-OCCUPANT (removed, possibly replaced): dropped before
+            # it can be credited as the current occupant's REP_ACK /
+            # vote / heartbeat.  Absent on old frames (fence passes).
+            winc = r.u32() if r.remaining >= 4 else None
+            if winc is not None \
+                    and winc < node.fence_epochs.get(slot, 0):
+                node.stats["fenced_ctrl_writes"] = \
+                    node.stats.get("fenced_ctrl_writes", 0) + 1
+                return wire.u8(wire.ST_FENCED) + wire.u64(node.sid.word)
             res = onesided.apply_ctrl_write(node, region, slot, value)
             # Read-lease support (live stack only — the sim path calls
             # onesided directly and stays clock-pure).  (a) A valid
@@ -327,6 +340,13 @@ class NetTransport(Transport):
         self.retries = retries
         self._retry_rng = random.Random(0x5EED ^ len(peers))
         self.stats = {"retries": 0, "retries_ok": 0}
+        #: Our node's current incarnation (the epoch of the CONFIG that
+        #: admitted this tenancy of our slot), stamped onto every
+        #: outbound ctrl write for the receiver's removed-slot fence.
+        #: The daemon installs a live read (lambda over node state);
+        #: None sends 0 — raw-transport tests and fixed-membership
+        #: clusters are unaffected (fence tables stay empty).
+        self.incarnation_of: Optional[Callable[[], int]] = None
         #: peer -> (sid_word, monotonic arrival time) from ctrl-write
         #: reply echoes (read-lease renewal evidence; see ctrl_write).
         self.peer_sid_seen: dict[int, tuple[int, float]] = {}
@@ -574,9 +594,11 @@ class NetTransport(Transport):
 
     def ctrl_write(self, target: int, region: Region, slot: int,
                    value: Any) -> WriteResult:
+        inc = self.incarnation_of() if self.incarnation_of is not None \
+            else 0
         payload = (wire.u8(wire.OP_CTRL_WRITE)
                    + wire.u8(wire.REGION_INDEX[region]) + wire.u8(slot)
-                   + wire.encode_value(value))
+                   + wire.encode_value(value) + wire.u32(inc))
         resp = self._roundtrip(target, payload)
         if resp is None:
             return WriteResult.DROPPED

@@ -215,7 +215,8 @@ def encode_value(v: Any) -> bytes:
         return u8(VAR_BYTES) + blob(v)
     if isinstance(v, Snapshot):
         return (u8(VAR_SNAPSHOT) + _SNAP_FIXED.pack(
-            v.last_idx, v.last_term, len(v.data)) + v.data + blob(v.seg))
+            v.last_idx, v.last_term, len(v.data)) + v.data + blob(v.seg)
+            + blob(v.fence))
     raise TypeError(f"unencodable ctrl value {type(v)}")
 
 
@@ -234,7 +235,11 @@ def decode_value(r: Reader) -> Any:
     if tag == VAR_SNAPSHOT:
         li, lt, n = _SNAP_FIXED.unpack(r.take(_SNAP_FIXED.size))
         data = r.take(n)
-        return Snapshot(li, lt, data, seg=r.blob())
+        seg = r.blob()
+        # Fence blob appended by newer senders; absent frames decode
+        # with an empty fence (pre-fence stores / peers).
+        fence = r.blob() if r.remaining else b""
+        return Snapshot(li, lt, data, seg=seg, fence=fence)
     raise ValueError(f"bad variant tag {tag}")
 
 

@@ -93,8 +93,13 @@ def make_client_ops(daemon) -> dict:
         term, log offsets — the information run.sh greps out of server
         logs ("[T%d] LEADER" banners, run.sh:46-68), as a queryable op."""
         import json
+
+        from apus_tpu.core.cid import CidState
+        from apus_tpu.core.types import EntryType
         with daemon.lock:
             n = daemon.node
+            config_in_flight = any(e.type == EntryType.CONFIG
+                                   for e in n.log.entries(n.log.apply))
             st = {
                 "idx": daemon.idx,
                 "role": n.role.name,
@@ -115,10 +120,36 @@ def make_client_ops(daemon) -> dict:
                 "commit": n.log.commit,
                 "apply": n.log.apply,
                 "end": n.log.end,
+                "log_head": n.log.head,
                 "epoch": n.cid.epoch,
                 "group_size": n.cid.size,
                 "members": [i for i in range(n.cid.extended_group_size)
                             if n.cid.contains(i)],
+                # Reconfiguration observability: the churn nemesis,
+                # operators, and tests assert convergence on these
+                # fields instead of log-scraping — the full cid (state
+                # + resize target + bitmask), whether ANY membership
+                # change is still in flight (a non-STABLE cid OR an
+                # unapplied CONFIG entry), snapshot pushes in
+                # progress, this replica's incarnation, and the
+                # graceful-leave drain state.
+                "cid_state": n.cid.state.name,
+                "cid_new_size": n.cid.new_size,
+                "cid_bitmask": n.cid.bitmask,
+                "config_in_flight": config_in_flight,
+                "mid_resize": (n.cid.state != CidState.STABLE
+                               or config_in_flight),
+                "snap_pushing": sorted(n._snap_pushing),
+                "snapshots_pushed": n.stats.get("snapshots_pushed", 0),
+                "snapshots_installed": n.stats.get(
+                    "snapshots_installed", 0),
+                "incarnation": n.incarnation,
+                "draining": getattr(daemon, "draining", False),
+                "auto_removes": n.stats.get("auto_removes", 0),
+                "graceful_leaves": n.stats.get("graceful_leaves", 0),
+                "resize_aborts": n.stats.get("resize_aborts", 0),
+                "fenced_ctrl_writes": n.stats.get("fenced_ctrl_writes",
+                                                  0),
                 # Relay-SM record dump size (leak/ops gauge; the soak
                 # watches it) — absent for non-relay SMs.
                 "sm_records": getattr(n.sm, "record_count", None),

@@ -209,6 +209,27 @@ class LocalCluster:
         d.start()
         return d
 
+    def graceful_leave(self, idx: int, timeout: float = 15.0) -> None:
+        """Operator-initiated graceful removal (OP_LEAVE) at the
+        thread-cluster altitude: the leader commits the removal, the
+        drained daemon flips to draining (stops voting/acking), and
+        the harness — playing the CLI run loop's role — stops it."""
+        from apus_tpu.runtime.membership import request_leave
+        peers = [p for i, p in enumerate(self.spec.peers)
+                 if p and i != idx and i < len(self.daemons)
+                 and self.daemons[i] is not None]
+        request_leave(peers, idx, timeout=timeout,
+                      victim_addr=self.spec.peers[idx])
+        d = self.daemons[idx]
+        if d is not None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline and not d.draining:
+                time.sleep(0.01)
+            assert d.draining, \
+                f"replica {idx} never drained after its leave committed"
+            d.stop()
+            self.daemons[idx] = None
+
     def wait_caught_up(self, idx: int, timeout: float = 15.0) -> None:
         """Block until replica ``idx`` has applied everything committed
         cluster-wide at call time."""

@@ -72,9 +72,10 @@ class ReplicaDaemon:
         # Dial backoff scaled to the timing envelope: at the production
         # envelope (hb=1 ms) a 0.5 s backoff would leave a transiently
         # unreachable peer unreplicated for hundreds of heartbeats.
-        self.transport = NetTransport(
+        net = NetTransport(
             peers, yield_lock=self.lock,
             backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)))
+        self.transport = net
         # Live-stack fault plane (parallel.faults): only wraps when the
         # spec or APUS_FAULT_* env enables it — a production daemon's
         # transport is untouched.
@@ -100,6 +101,17 @@ class ReplicaDaemon:
                           - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
+        # Incarnation fencing: a joiner's tenancy starts at the epoch
+        # of the CONFIG that admitted it (the cid the join reply
+        # carried); static members start at 0.  The transport stamps
+        # the live value onto every outbound ctrl write.
+        if cid is not None:
+            self.node.incarnation = cid.epoch
+        net.incarnation_of = lambda: self.node.incarnation
+        # Graceful-leave drain (OP_LEAVE): set once OUR removal is
+        # committed — watchdogs stop re-joining, the node stops
+        # voting/acking, and the CLI run loop exits clean.
+        self.draining = False
         # Lease-validity checks must see REAL time, not the tick-start
         # stamp: an isolated leader's tick stalls in heartbeat write
         # timeouts with the lock yielded, freezing the stamp exactly
@@ -261,6 +273,21 @@ class ReplicaDaemon:
         from apus_tpu.parallel.onesided import _snap_session_drop
         _snap_session_drop(self.node)
 
+    def begin_drain(self, why: str) -> None:
+        """Graceful leave: our removal is COMMITTED cluster-wide
+        (either we applied the replicated ``leave <slot>`` marker, or
+        the operator's mode-1 notify confirmed it).  From here on this
+        replica never votes, never acks, never re-joins; the CLI run
+        loop exits 0 and in-process harnesses stop the daemon.
+        Idempotent."""
+        with self.lock:
+            if self.draining:
+                return
+            self.draining = True
+            self.node.draining = True
+        self.logger.info("graceful leave: draining (%s); this replica "
+                         "stops voting/serving and will exit clean", why)
+
     def _exclusion_watchdog(self) -> None:
         """Self-rejoin after eviction, for EVERY deployment shape.
 
@@ -289,6 +316,10 @@ class ReplicaDaemon:
             # hb_age < 0 covers the future-stamped cold-start grace.
             if is_leader or hb_age < silence or now - last_try < 2.0:
                 continue
+            if self.draining:
+                # Graceful leave: exclusion is INTENTIONAL — never
+                # rejoin (the whole point of OP_LEAVE vs auto-remove).
+                continue
             last_try = now
             if not _excluded_by_live_leader(self, self.spec):
                 continue
@@ -300,15 +331,25 @@ class ReplicaDaemon:
                 "removed from the group (a live leader excludes slot "
                 "%d); re-joining in place at %s", self.idx, my_addr)
             try:
-                slot, _cid, _peers = request_join(
+                slot, cid, _peers = request_join(
                     [p for i, p in enumerate(self.spec.peers)
-                     if p and i != self.idx], my_addr, timeout=5.0)
+                     if p and i != self.idx], my_addr, timeout=5.0,
+                    want_slot=self.idx)
                 if slot != self.idx:
                     self.logger.error(
                         "rejoin assigned slot %d != ours (%d); leaving "
                         "re-admission to the operator", slot, self.idx)
                     return
-                self.logger.info("re-admitted at slot %d", slot)
+                with self.lock:
+                    # Fresh tenancy: adopt the admission epoch NOW so
+                    # our ctrl writes clear the peers' removed-slot
+                    # fence immediately (applying our own re-add entry
+                    # during catch-up would bump it too, but our acks
+                    # would be fenced until then).
+                    self.node.incarnation = max(self.node.incarnation,
+                                                cid.epoch)
+                self.logger.info("re-admitted at slot %d (incarnation "
+                                 "%d)", slot, cid.epoch)
             except Exception as e:               # noqa: BLE001
                 self.logger.warning("rejoin attempt failed: %s", e)
 
@@ -430,6 +471,19 @@ class ReplicaDaemon:
         follower side, dare_server.c:2133-2187).  Join entries carry
         ``"<slot> <addr>"`` in data."""
         if e.data:
+            if e.data.startswith(b"leave "):
+                # Graceful-leave marker (Node.handle_leave): the
+                # removal reason is replicated, so the drained member
+                # — whichever replica it is — learns its removal was
+                # intentional the moment it applies the entry.
+                try:
+                    left = int(e.data.split(b" ", 1)[1])
+                except ValueError:
+                    self.logger.warning("bad LEAVE payload %r", e.data)
+                    return
+                if left == self.idx:
+                    self.begin_drain("applied own leave entry")
+                return
             try:
                 slot_s, addr = e.data.decode().split(" ", 1)
                 slot = int(slot_s)
@@ -745,6 +799,14 @@ def main(argv: Optional[list] = None) -> int:
         except ValueError:
             harness_pid = 0
         while not stop_evt.is_set():
+            if daemon.draining:
+                # Graceful leave (OP_LEAVE): our removal is committed
+                # cluster-wide.  Give in-flight handler replies a
+                # beat, then exit CLEAN (rc 0) — the "drained replica
+                # exits clean" contract, vs. eviction's rejoin loop.
+                stop_evt.wait(0.5)
+                daemon.logger.info("drained (graceful leave); exiting")
+                return 0
             if harness_pid > 0 and os.getppid() != harness_pid:
                 daemon.logger.error(
                     "harness (pid %d) gone; exiting "
@@ -779,7 +841,8 @@ def main(argv: Optional[list] = None) -> int:
                            and now - start_t > 0.5)
             stalled = (not progress[2] and now - progress_t > reexec_after
                        and hb_age > reexec_after)
-            if (stalled or silent_boot) and now - last_probe > 0.5:
+            if (stalled or silent_boot) and now - last_probe > 0.5 \
+                    and not daemon.draining:
                 last_probe = now
                 if _excluded_by_live_leader(daemon, spec):
                     daemon.logger.error(

@@ -1,4 +1,5 @@
-"""Membership service: join protocol over the DCN control plane.
+"""Membership service: join + graceful-leave protocol over the DCN
+control plane.
 
 The reference's join path rides UD multicast: a joiner mcasts JOIN,
 the leader assigns a slot or up-sizes the configuration and appends a
@@ -13,11 +14,29 @@ replies with the assigned slot, the new Cid, and the full peer list.
 Log/state catch-up needs no separate handshake: the leader's replication
 path adjusts the joiner from scratch and pushes a snapshot if the
 joiner is behind the pruned head (Node._replicate).
+
+Refusals are TYPED (the reference's CFG_REPLY carries only success):
+``ST_RETRY`` means the condition is transient (a resize already in
+flight, the log ring momentarily full) — the joiner backs off with
+jitter and retries inside its deadline; ``ST_REFUSED`` is permanent for
+the current configuration (the wanted slot is bound to a different
+address — the "removed, rejoin refused" answer — or the group is at
+protocol capacity) and surfaces as :class:`JoinRefusedError` instead of
+an indistinguishable timeout.
+
+Graceful leave (OP_LEAVE) is the operator-initiated counterpart of the
+failure detector's auto-removal: the leader commits the removal CONFIG
+entry (payload ``leave <slot>`` — the reason is replicated, so the
+drained member recognizes an intentional removal when it applies the
+entry), the drained replica stops voting/acking and exits clean, and
+its next incarnation re-enters through the join protocol with a fresh
+incarnation (snapshot catch-up included).
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
 import time
 from typing import Optional
@@ -27,21 +46,61 @@ from apus_tpu.parallel import wire
 from apus_tpu.runtime.client import ST_NOT_LEADER, ST_TIMEOUT, _not_leader
 
 OP_JOIN = wire.OP_JOIN
+#: operator-initiated graceful removal (see module docstring):
+#: payload u8 slot [+ u8 mode]; mode 0 = commit the removal at the
+#: leader (NOT_LEADER redirect otherwise), mode 1 = drain notification
+#: delivered to the (ex-)member itself after the removal committed.
+OP_LEAVE = 21
+
+#: Typed membership statuses (beyond the client plane's OK/NOT_LEADER/
+#: TIMEOUT): transient refusal — back off and retry — vs. permanent
+#: refusal for the current configuration (reason blob follows).
+ST_RETRY = 6
+ST_REFUSED = 7
+
+
+class JoinRefusedError(RuntimeError):
+    """The leader answered the join with a PERMANENT typed refusal
+    (e.g. "slot_bound": the wanted slot is owned by a different live
+    address — a removed server whose identity was reassigned must not
+    rejoin as that slot)."""
+
+
+class LeaveRefusedError(RuntimeError):
+    """The leader answered OP_LEAVE with a permanent typed refusal
+    (e.g. "quorum_floor": removing the member would leave fewer
+    members than the unchanged size-denominator quorum — a config that
+    could never commit or elect again)."""
 
 
 def make_membership_ops(daemon) -> dict:
-    """Extra PeerServer op: JOIN (runs on a per-connection thread)."""
+    """Extra PeerServer ops: JOIN + LEAVE (run on per-connection
+    threads)."""
 
     def join(r: wire.Reader) -> bytes:
         addr = r.blob().decode()
         want_slot = r.u8() if r.remaining else None
         with daemon.lock:
             pj = daemon.node.handle_join(addr, want_slot=want_slot)
+            reason = daemon.node.last_join_refusal
         if pj is None:
-            return _not_leader(daemon)
+            if reason is None:
+                return _not_leader(daemon)
+            # We ARE the leader but refused: answer typed, never
+            # NOT_LEADER — a hint-chase for a leader the joiner
+            # already found stalls it for its whole deadline.
+            transient = reason in daemon.node.TRANSIENT_REFUSALS
+            return (wire.u8(ST_RETRY if transient else ST_REFUSED)
+                    + wire.blob(reason.encode()))
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
+                if pj.refused:
+                    # The join's CONFIG entry applied, but the slot is
+                    # not in the applied configuration (a resize abort
+                    # raced it): transient — retry from scratch.
+                    return (wire.u8(ST_RETRY)
+                            + wire.blob(b"resize_aborted"))
                 if pj.done:
                     daemon.logger.info("JOIN %s -> slot %d (%r)", addr,
                                        pj.slot, daemon.node.cid)
@@ -66,16 +125,58 @@ def make_membership_ops(daemon) -> dict:
                     return wire.u8(ST_TIMEOUT)
                 daemon.commit_cond.wait(min(left, 0.05))
 
-    return {OP_JOIN: join}
+    def leave(r: wire.Reader) -> bytes:
+        slot = r.u8()
+        mode = r.u8() if r.remaining else 0
+        if mode == 1:
+            # Drain notification: the removal of OUR slot has been
+            # committed cluster-wide (the sender saw the leader's OK).
+            # Covers the race where the removal committed without this
+            # replica ever receiving the CONFIG entry (commit needs
+            # only a quorum); usually the replicated "leave" marker
+            # got here first and this is an idempotent no-op.
+            if slot != daemon.idx:
+                return wire.u8(ST_REFUSED) + wire.blob(b"not_my_slot")
+            daemon.begin_drain("operator notify")
+            return wire.u8(wire.ST_OK)
+        with daemon.lock:
+            pl = daemon.node.handle_leave(slot)
+        if pl is None:
+            return _not_leader(daemon)
+        if isinstance(pl, str):
+            transient = pl in daemon.node.TRANSIENT_REFUSALS
+            return (wire.u8(ST_RETRY if transient else ST_REFUSED)
+                    + wire.blob(pl.encode()))
+        deadline = time.monotonic() + daemon.client_op_timeout
+        with daemon.commit_cond:
+            while True:
+                if pl.done:
+                    daemon.logger.info("LEAVE slot %d committed (%r)",
+                                       slot, daemon.node.cid)
+                    return wire.u8(wire.ST_OK) + wire.u8(slot)
+                if not daemon.node.is_leader:
+                    return _not_leader(daemon)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return wire.u8(ST_TIMEOUT)
+                daemon.commit_cond.wait(min(left, 0.05))
+
+    return {OP_JOIN: join, OP_LEAVE: leave}
 
 
 def request_join(peers: list[str], my_addr: str,
                  timeout: float = 15.0,
                  want_slot: Optional[int] = None) -> tuple[int, Cid, list[str]]:
     """Joiner side: find the leader and request admission.  Returns
-    (slot, cid, full peer list).  Retries across redirects/elections.
-    ``want_slot`` requests slot affinity (recovered-server rejoin): the
-    leader admits at that exact slot or refuses.
+    (slot, cid, full peer list).  Retries across redirects/elections
+    with jittered exponential backoff under a TOTAL deadline — a
+    partitioned or flapping seed peer can no longer stall the joiner
+    beyond ``timeout``.  ``want_slot`` requests slot affinity
+    (recovered-server rejoin): the leader admits at that exact slot or
+    answers a typed refusal — permanent refusals ("removed, rejoin
+    refused": the slot is bound to another address) raise
+    :class:`JoinRefusedError` immediately instead of burning the
+    deadline.
 
     ``peers`` may be a SINGLE seed address (discovery bootstrap, the
     mcast-JOIN analog, dare_ibv_ud.c:952-1068): a non-leader seed
@@ -98,13 +199,15 @@ def request_join_spec(peers: list[str], my_addr: str,
         payload += wire.u8(want_slot)
     deadline = time.monotonic() + timeout
     candidates = list(peers)
+    rng = random.Random()
+    backoff = _Backoff(rng)
     i = 0
     while time.monotonic() < deadline:
         target = candidates[i % len(candidates)]
         i += 1
         resp = _roundtrip(target, payload, deadline)
         if resp is None:
-            time.sleep(0.05)
+            backoff.sleep(deadline)
             continue
         st = resp[0]
         if st == wire.ST_OK:
@@ -122,10 +225,107 @@ def request_join_spec(peers: list[str], my_addr: str,
                 candidates.append(hint)
             if hint:
                 i = candidates.index(hint)
+                backoff.reset()          # fresh lead: don't punish it
             time.sleep(0.01)
             continue
-        time.sleep(0.05)      # ST_TIMEOUT / transient: retry
+        if st == ST_REFUSED:
+            reason = _reason(resp)
+            raise JoinRefusedError(
+                f"join of {my_addr} refused by the leader: {reason} "
+                f"(want_slot={want_slot})")
+        # ST_RETRY (typed transient refusal) / ST_TIMEOUT / transient:
+        # jittered exponential backoff inside the deadline.
+        backoff.sleep(deadline)
     raise TimeoutError(f"join of {my_addr} not admitted in {timeout}s")
+
+
+def request_leave(peers: list[str], slot: int,
+                  timeout: float = 15.0,
+                  victim_addr: Optional[str] = None) -> bool:
+    """Operator side of the graceful leave: find the leader, have it
+    commit the removal of ``slot``, then best-effort notify the
+    drained replica (mode-1 OP_LEAVE) so it exits clean even if the
+    removal committed without reaching it.  Returns True once the
+    removal is committed.  Raises :class:`LeaveRefusedError` on a
+    permanent typed refusal and TimeoutError past the deadline."""
+    payload = wire.u8(OP_LEAVE) + wire.u8(slot)
+    deadline = time.monotonic() + timeout
+    candidates = [p for p in peers if p]
+    if victim_addr is None and slot < len(peers):
+        victim_addr = peers[slot]
+    rng = random.Random()
+    backoff = _Backoff(rng)
+    i = 0
+    while time.monotonic() < deadline:
+        target = candidates[i % len(candidates)]
+        i += 1
+        resp = _roundtrip(target, payload, deadline)
+        if resp is None:
+            backoff.sleep(deadline)
+            continue
+        st = resp[0]
+        if st == wire.ST_OK:
+            if victim_addr:
+                _notify_drained(victim_addr, slot)
+            return True
+        if st == ST_NOT_LEADER:
+            hint = wire.Reader(resp[1:]).blob().decode() \
+                if len(resp) > 1 else ""
+            if hint and hint not in candidates:
+                candidates.append(hint)
+            if hint:
+                i = candidates.index(hint)
+                backoff.reset()
+            time.sleep(0.01)
+            continue
+        if st == ST_REFUSED:
+            raise LeaveRefusedError(
+                f"leave of slot {slot} refused: {_reason(resp)}")
+        backoff.sleep(deadline)
+    raise TimeoutError(f"leave of slot {slot} not committed in {timeout}s")
+
+
+def _notify_drained(victim_addr: str, slot: int,
+                    timeout: float = 2.0) -> bool:
+    """Mode-1 OP_LEAVE to the drained replica itself (best effort: the
+    replicated "leave" marker usually got there first; a dead victim
+    simply misses a redundant notification)."""
+    try:
+        resp = _roundtrip(victim_addr,
+                          wire.u8(OP_LEAVE) + wire.u8(slot) + wire.u8(1),
+                          time.monotonic() + timeout)
+    except Exception:               # noqa: BLE001
+        return False
+    return bool(resp) and resp[0] == wire.ST_OK
+
+
+class _Backoff:
+    """Jittered exponential backoff capped per attempt AND by the
+    caller's absolute deadline (the join/leave retry discipline)."""
+
+    BASE = 0.05
+    CAP = 1.0
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self.cur = self.BASE
+
+    def reset(self) -> None:
+        self.cur = self.BASE
+
+    def sleep(self, deadline: float) -> None:
+        d = min(self.cur * self.rng.uniform(0.5, 1.5),
+                max(0.0, deadline - time.monotonic()))
+        if d > 0:
+            time.sleep(d)
+        self.cur = min(self.cur * 2.0, self.CAP)
+
+
+def _reason(resp: bytes) -> str:
+    try:
+        return wire.Reader(resp[1:]).blob().decode() or "unspecified"
+    except (ValueError, UnicodeDecodeError):
+        return "unspecified"
 
 
 def _roundtrip(addr: str, payload: bytes,

@@ -134,7 +134,7 @@ class Persistence:
                 SNAP_MAGIC + struct.pack("<QQ", snap.last_idx,
                                          snap.last_term)
                 + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
-                + wire.blob(snap.seg))
+                + wire.blob(snap.seg) + wire.blob(snap.fence))
             self._note_appended()
             return
         # Sidecar names are STORE-scoped (several daemons share a
@@ -166,7 +166,7 @@ class Persistence:
             SNAPFILE_MAGIC + struct.pack("<QQQ", snap.last_idx,
                                          snap.last_term, snap.data_len)
             + wire.blob(name.encode()) + wire.encode_ep_dump(ep_dump)
-            + wire.blob(snap.seg))
+            + wire.blob(snap.seg) + wire.blob(snap.fence))
         self._note_appended()
         # GC superseded sidecars OF THIS STORE ONLY: replay only ever
         # consults the LAST snapshot record (see replay_into), so
@@ -275,7 +275,9 @@ def decode_record(rec: bytes):
         data = r.blob()
         ep_dump = wire.decode_ep_dump(r)
         seg = r.blob() if r.remaining else b""
-        return "snapshot", (Snapshot(last_idx, last_term, data, seg=seg),
+        fence = r.blob() if r.remaining else b""
+        return "snapshot", (Snapshot(last_idx, last_term, data, seg=seg,
+                                     fence=fence),
                             ep_dump)
     if magic == SNAPFILE_MAGIC:
         last_idx, last_term, data_len = struct.unpack_from("<QQQ", rec, 4)
@@ -283,7 +285,9 @@ def decode_record(rec: bytes):
         name = r.blob().decode()
         ep_dump = wire.decode_ep_dump(r)
         seg = r.blob() if r.remaining else b""
+        fence = r.blob() if r.remaining else b""
         return "snapfile", (Snapshot(last_idx, last_term, b"", seg=seg,
+                                     fence=fence,
                                      data_path=name, data_len=data_len),
                             ep_dump)
     raise ValueError(

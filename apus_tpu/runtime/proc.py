@@ -366,7 +366,48 @@ class ProcCluster:
             # proc bookkeeping aligned with slots.
             self.procs[slot], self.procs[i] = self.procs[i], None
             self.app_ports[slot] = self.app_ports[i]
+        # Trim the trailing placeholder a slot-reusing join leaves
+        # behind — a permanent None tail would make every "all slots
+        # live" gate (failover/churn pacing) false forever.  Closing
+        # the parent's log handle is safe: the child owns its own fd.
+        while self.procs and self.procs[-1] is None \
+                and len(self.procs) > len(self.spec.peers):
+            self.procs.pop()
+            self.app_ports.pop()
+            f = self._logs.pop()
+            if f is not None:
+                f.close()
         return slot
+
+    def graceful_leave(self, idx: int, timeout: float = 30.0) -> None:
+        """Operator-initiated graceful removal of replica ``idx``
+        (OP_LEAVE, runtime.membership.request_leave): the leader
+        commits the removal CONFIG entry, the drained daemon stops
+        voting/serving and EXITS CLEAN — rc 0 is asserted here, the
+        contract that separates a drain from a crash.  The freed slot
+        is re-admittable via add_replica (next incarnation, snapshot
+        catch-up)."""
+        from apus_tpu.runtime.membership import request_leave
+        peers = [p for i, p in enumerate(self.spec.peers)
+                 if p and i != idx and i < len(self.procs)
+                 and self.procs[i] is not None]
+        request_leave(peers, idx, timeout=timeout,
+                      victim_addr=self.spec.peers[idx])
+        p = self.procs[idx]
+        if p is not None:
+            try:
+                rc = p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    f"drained replica {idx} did not exit within "
+                    f"{timeout}s (see {self.workdir}/proc{idx}.out)")
+            assert rc == 0, \
+                f"drained replica {idx} exited rc={rc} (clean exit is 0)"
+            self.procs[idx] = None
+            try:
+                os.unlink(self._ready_path(idx))
+            except OSError:
+                pass
 
     # -- queries ----------------------------------------------------------
 
@@ -430,6 +471,42 @@ class ProcCluster:
                 return
             time.sleep(0.05)
         raise AssertionError(f"replicas did not converge: {sts}")
+
+    def wait_config_converged(self, timeout: float = 30.0) -> dict:
+        """Block until every LIVE replica reports the SAME STABLE
+        configuration with no membership change in flight (cid epoch /
+        state / bitmask equal across members, mid_resize false, no
+        snapshot push outstanding) — the single-agreed-config
+        convergence criterion of the churn nemesis, asserted through
+        the OP_STATUS reconfiguration fields instead of log-scraping.
+        Returns the agreed view."""
+        deadline = time.monotonic() + timeout
+        last: list = []
+        while time.monotonic() < deadline:
+            want = [i for i in range(len(self.procs))
+                    if self.procs[i] is not None]
+            sts = [self.status(i) for i in want]
+            last = [(s or {}).get("epoch") for s in sts]
+            if want and all(s is not None for s in sts):
+                views = {(s.get("epoch"), s.get("cid_state"),
+                          s.get("cid_bitmask"), s.get("group_size"))
+                         for s in sts}
+                live_mask = sum(1 << i for i in want)
+                if len(views) == 1:
+                    epoch, state, mask, size = next(iter(views))
+                    if (state == "STABLE" and mask is not None
+                            and not any(s.get("mid_resize")
+                                        for s in sts)
+                            and not any(s.get("snap_pushing")
+                                        for s in sts)
+                            and mask == live_mask):
+                        return {"epoch": epoch, "cid_state": state,
+                                "cid_bitmask": mask,
+                                "group_size": size}
+            time.sleep(0.05)
+        raise AssertionError(
+            f"configurations did not converge within {timeout}s: "
+            f"epochs={last}")
 
     def wait_mesh_ready(self, timeout: float = 120.0,
                         tolerate_dead: bool = False) -> list:

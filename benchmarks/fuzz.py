@@ -504,6 +504,237 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
     return stats
 
 
+def run_churn_schedule(fault_seed: int, check_linear: bool = True,
+                       minutes: float = 0.0) -> dict:
+    """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
+    3-replica fault-plane ProcCluster with auto-removal ON, concurrent
+    recorded clients (serial + pipelined), and a seeded nemesis that
+    composes churn with faults:
+
+      - network fault burst (drop/delay scripted over the wire),
+      - JOIN under load: a new process runs the join protocol while
+        traffic flows (upsize 3 -> 4 through the EXTENDED -> TRANSIT
+        -> STABLE ladder) — usually with the LEADER SIGKILLed while
+        the resize is in flight (the successor must finish or cleanly
+        abort the in-flight CONFIG; the joiner's bounded-backoff retry
+        path is exercised when the admission reply dies with the old
+        leader),
+      - AUTO-REMOVE: the killed member is evicted by the failure
+        detector, then restarted — its next incarnation re-enters
+        through the join protocol (slot affinity + incarnation bump),
+      - GRACEFUL LEAVE: a live follower is drained via OP_LEAVE (its
+        process must EXIT CLEAN, and its endpoint must go dark — no
+        zombie ex-member serving), then a fresh process re-joins into
+        the freed slot.
+
+    Convergence is asserted through the OP_STATUS reconfiguration
+    fields (single agreed STABLE config across every live replica, no
+    CONFIG in flight, no snapshot push outstanding, membership ==
+    live set).  With ``check_linear`` the surviving client history —
+    plus a final read round, so a lost acked write across any
+    remove-then-rejoin is a violation too — must check linearizable
+    across all traversed config epochs."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.models.kvs import encode_get, encode_put
+    from apus_tpu.parallel.faults import heal_all, send_fault
+    from apus_tpu.runtime.client import (OP_CLT_READ, OP_CLT_WRITE,
+                                         ApusClient, probe_status)
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    import dataclasses as _dc
+
+    def _dbg(msg: str) -> None:
+        if os.environ.get("APUS_AUDIT_DEBUG"):
+            print(f"[churn {fault_seed}] {msg}", file=sys.stderr,
+                  flush=True)
+
+    rng = random.Random(fault_seed ^ 0xC0C0)
+    spec = _dc.replace(PROC_SPEC)          # auto_remove stays ON
+    keys = [b"ck%d" % i for i in range(rng.randint(4, 7))]
+    recorder = HistoryRecorder(capacity=1 << 18) if check_linear else None
+    stop = threading.Event()
+    churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
+             "leader_kills": 0}
+
+    def worker(wid: int, peers: list) -> None:
+        wrng = random.Random((fault_seed << 4) ^ wid)
+        n = 0
+        with ApusClient(peers, timeout=6.0, attempt_timeout=1.0,
+                        history=recorder) as c:
+            while not stop.is_set():
+                try:
+                    roll = wrng.random()
+                    if roll < 0.45:
+                        n += 1
+                        c.put(wrng.choice(keys), b"c%d.%d" % (wid, n))
+                    elif roll < 0.8:
+                        c.get(wrng.choice(keys))
+                    else:
+                        ops = []
+                        for _ in range(wrng.randint(4, 12)):
+                            if wrng.random() < 0.5:
+                                n += 1
+                                ops.append((OP_CLT_WRITE, encode_put(
+                                    wrng.choice(keys),
+                                    b"c%d.%d" % (wid, n))))
+                            else:
+                                ops.append((OP_CLT_READ, encode_get(
+                                    wrng.choice(keys))))
+                        c.pipeline(ops)
+                except (TimeoutError, RuntimeError, OSError,
+                        ConnectionError):
+                    _time.sleep(0.05)   # recorded as ambiguous; go on
+
+    def wait_evicted(pc, victim: int, timeout: float = 30.0) -> None:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            try:
+                st = pc.status(pc.leader_idx(timeout=10.0), timeout=1.0)
+            except AssertionError:
+                st = None
+            if st is not None and victim not in st.get("members",
+                                                       [victim]):
+                return
+            _time.sleep(0.05)
+        raise AssertionError(f"member {victim} never evicted")
+
+    def wait_member(pc, slot: int, timeout: float = 60.0) -> None:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            try:
+                st = pc.status(pc.leader_idx(timeout=10.0), timeout=1.0)
+            except AssertionError:
+                st = None
+            if st is not None and slot in st.get("members", []):
+                return
+            _time.sleep(0.1)
+        raise AssertionError(f"slot {slot} never re-admitted")
+
+    with tempfile.TemporaryDirectory(prefix="apus-churn") as td:
+        with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
+                         fault_seed=fault_seed) as pc:
+            peers = list(pc.spec.peers)
+            _dbg("cluster up")
+            threads = [threading.Thread(target=worker, args=(w, peers),
+                                        daemon=True)
+                       for w in range(3)]
+            for t in threads:
+                t.start()
+            _time.sleep(0.5)
+
+            # Phase 1: low-grade network fault burst on a random member
+            # — stays armed through the first churn so the join ladder
+            # runs UNDER network faults, healed before convergence.
+            fvictim = rng.randrange(3)
+            send_fault(peers[fvictim], rng.choice([
+                {"cmd": "drop", "peer": "*",
+                 "p": round(rng.uniform(0.03, 0.15), 3)},
+                {"cmd": "delay", "lo": 0.0,
+                 "hi": round(rng.uniform(0.001, 0.008), 4)}]))
+            _dbg("phase1 net fault armed")
+
+            # Phase 2: JOIN under load, usually with the leader killed
+            # while the resize ladder is in flight.
+            killed: list[int] = []
+            if rng.random() < 0.7:
+                delay = rng.uniform(0.0, 0.15)
+
+                def kill_leader_soon() -> None:
+                    _time.sleep(delay)
+                    try:
+                        v = pc.leader_idx(timeout=5.0)
+                        pc.kill(v)
+                        killed.append(v)
+                    except AssertionError:
+                        pass
+
+                kt = threading.Thread(target=kill_leader_soon,
+                                      daemon=True)
+                kt.start()
+            else:
+                kt = None
+            slot = pc.add_replica(timeout=90.0)
+            churn["joins"] += 1
+            if kt is not None:
+                kt.join(timeout=10.0)
+            _dbg(f"phase2 joined slot {slot}; leader killed: {killed}")
+
+            # Phase 3: AUTO-REMOVE + rejoin.  The leader kill above (or
+            # an explicit follower SIGKILL) is evicted by the failure
+            # detector; its restart re-enters through the join protocol
+            # at its own slot (next incarnation).
+            if killed:
+                churn["leader_kills"] += 1
+                victim = killed[0]
+            else:
+                lead = pc.leader_idx(timeout=15.0)
+                victim = rng.choice([i for i in range(3) if i != lead])
+                pc.kill(victim)
+            wait_evicted(pc, victim)
+            churn["auto_removes"] += 1
+            send_fault(peers[fvictim], {"cmd": "heal"})
+            pc.restart(victim)
+            wait_member(pc, victim)
+            _dbg(f"phase3 evicted+rejoined {victim}")
+
+            # Phase 4: GRACEFUL LEAVE of a live follower + zombie probe
+            # + re-admission of a fresh process into the freed slot.
+            lead = pc.leader_idx(timeout=15.0)
+            lvictim = rng.choice(
+                [i for i in range(len(pc.procs))
+                 if pc.procs[i] is not None and i != lead])
+            pc.graceful_leave(lvictim, timeout=45.0)
+            churn["graceful_leaves"] += 1
+            assert probe_status(peers[lvictim] if lvictim < len(peers)
+                                else pc.spec.peers[lvictim],
+                                timeout=0.5) is None, \
+                f"drained ex-member {lvictim} still serving (zombie)"
+            slot2 = pc.add_replica(timeout=90.0)
+            churn["joins"] += 1
+            assert slot2 == lvictim, (slot2, lvictim)
+            _dbg(f"phase4 graceful leave+rejoin {lvictim}")
+
+            # Heal everything, stop traffic, converge: one agreed
+            # STABLE config across every live replica, all caught up.
+            heal_all([p for p in pc.spec.peers if p])
+            _time.sleep(1.0 + minutes * 60.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=20.0)
+            _dbg("workers joined")
+            pc.wait_converged(timeout=60.0)
+            view = pc.wait_config_converged(timeout=60.0)
+            _dbg(f"converged: {view}")
+            ops_checked = 0
+            if recorder is not None:
+                with ApusClient(list(pc.spec.peers), timeout=10.0,
+                                history=recorder) as c:
+                    for k in keys:
+                        c.get(k)
+    stats = {"configs_traversed": view["epoch"], **churn}
+    if recorder is not None:
+        res = check_history(recorder.events())
+        ops_checked = res.ops_checked
+        if recorder.dropped:
+            raise AssertionError(
+                f"history ring overflowed ({recorder.dropped} dropped); "
+                f"verdict would be unsound")
+        if not res.ok or res.undecided:
+            dump = os.path.abspath(f"churn-fail-{fault_seed}.jsonl")
+            recorder.dump_jsonl(dump)
+            raise AssertionError(
+                f"LINEARIZABILITY VIOLATION under churn "
+                f"(history: {dump})\n" + res.describe())
+        stats["ops_checked"] = ops_checked
+        stats["keys"] = res.keys
+        stats["recorded"] = len(recorder.events())
+    return stats
+
+
 def _devplane_trial_subprocess(fault_seed: int,
                                timeout_s: float = 900.0) -> str:
     """Run one device-plane schedule in a CHILD process.  Each trial
@@ -556,6 +787,17 @@ def main() -> int:
                          "process-per-replica deployment shape at the "
                          "production envelope (kills, restarts, "
                          "durable-store recovery)")
+    ap.add_argument("--churn", action="store_true",
+                    help="membership-churn chaos trials on a live "
+                         "fault-plane ProcCluster: joins (leader "
+                         "usually SIGKILLed mid-resize), failure-"
+                         "detector evictions + rejoin, graceful "
+                         "leaves (OP_LEAVE, clean exit asserted), "
+                         "convergence to ONE agreed STABLE config via "
+                         "the OP_STATUS reconfiguration fields; "
+                         "composes with --check-linear (recorded "
+                         "clients + per-key linearizability check "
+                         "across config epochs)")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -573,6 +815,7 @@ def main() -> int:
     mode_flags = (["--proc"] if args.proc else []) \
         + (["--device-plane"] if args.device_plane else []) \
         + (["--auto-remove"] if args.auto_remove else []) \
+        + (["--churn"] if args.churn else []) \
         + (["--check-linear"] if args.check_linear else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
@@ -582,9 +825,21 @@ def main() -> int:
     failures = []
     audit = {"ops_checked": 0, "keys": 0, "ambiguous": 0,
              "recorded": 0, "seeds": []}
+    churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
+             "leader_kills": 0, "configs_traversed": 0,
+             "ops_checked": 0, "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
-            if args.check_linear:
+            if args.churn:
+                st = run_churn_schedule(fault_seed,
+                                        check_linear=args.check_linear)
+                for k in ("joins", "auto_removes", "graceful_leaves",
+                          "leader_kills", "configs_traversed",
+                          "ops_checked"):
+                    churn[k] += st.get(k, 0)
+                churn["seeds"].append(fault_seed)
+                r = "ok"
+            elif args.check_linear:
                 st = run_audit_schedule(fault_seed)
                 for k in ("ops_checked", "keys", "ambiguous",
                           "recorded"):
@@ -617,7 +872,9 @@ def main() -> int:
     eligible = len(seeds) - stalls
     pct = 100.0 if eligible <= 0 else round(100.0 * ok / eligible, 1)
     print(json.dumps({
-        "metric": ("linear_audit_clean_pct" if args.check_linear
+        "metric": (("churn_linear_clean_pct" if args.check_linear
+                    else "churn_clean_pct") if args.churn
+                   else "linear_audit_clean_pct" if args.check_linear
                    else "proc_devplane_fuzz_clean_pct"
                    if args.proc and args.device_plane
                    else "devplane_fuzz_clean_pct" if args.device_plane
@@ -637,7 +894,17 @@ def main() -> int:
                    # under which seeds.  violations is structurally 0
                    # on a clean run — a violation is a trial FAILURE.
                    **({"audit": {**audit, "violations": len(failures)}}
-                      if args.check_linear else {})},
+                      if args.check_linear and not args.churn else {}),
+                   # Churn campaign evidence: joins / evictions /
+                   # graceful leaves / leader-kills-mid-resize per
+                   # campaign, config epochs traversed, ops checked
+                   # linearizable.  violations and wedges (failed
+                   # convergence) are both trial FAILURES, so they are
+                   # structurally 0 on a clean run.
+                   **({"churn": {**churn,
+                                 "violations": len(failures),
+                                 "wedges": len(failures)}}
+                      if args.churn else {})},
     }))
     return 1 if failures else 0
 

@@ -80,6 +80,19 @@ def main() -> int:
     ap.add_argument("--fault-every", type=float, default=30.0,
                     help="with --fault-seed: seconds between injected "
                          "fault bursts")
+    ap.add_argument("--churn", action="store_true",
+                    help="membership churn during the soak: every "
+                         "--churn-every seconds, alternate a GRACEFUL "
+                         "LEAVE (OP_LEAVE: drain a live follower, "
+                         "assert its clean exit) and a failure-"
+                         "detector EVICTION (SIGKILL a follower, wait "
+                         "for its removal), each followed by a fresh "
+                         "join into the freed slot — replicas rotate "
+                         "in and out under sustained load (not "
+                         "composable with --mesh, whose campaigns pin "
+                         "membership)")
+    ap.add_argument("--churn-every", type=float, default=45.0,
+                    help="with --churn: seconds between churn events")
     ap.add_argument("--pipeline", action="store_true",
                     help="run a SIDE stream of pipelined ApusClient "
                          "windows (64-deep PUT bursts + lease GETs) "
@@ -148,6 +161,12 @@ def main() -> int:
                             value.encode())
         return audit_req[0]
 
+    if args.churn and args.mesh:
+        print("--churn is not composable with --mesh (mesh campaigns "
+              "pin membership; eviction semantics are the churn "
+              "nemesis' subject)", file=sys.stderr)
+        return 2
+
     mesh_spec = None
     if args.mesh:
         import dataclasses as _dc
@@ -176,6 +195,17 @@ def main() -> int:
         base = mesh_spec if mesh_spec is not None else PROC_SPEC
         mesh_spec = _dc.replace(base, fault_plane=True,
                                 fault_seed=args.fault_seed)
+    # --churn: rotate replicas in and out under load.  Alternates a
+    # graceful leave (OP_LEAVE drain, clean exit asserted by
+    # ProcCluster.graceful_leave) with a failure-detector eviction
+    # (SIGKILL + wait for removal), each followed by a fresh join into
+    # the freed slot.  Seeded by --fault-seed when given.
+    churn_rng = _random.Random((args.fault_seed or 0) ^ 0xC4)
+    next_churn = (time.monotonic() + args.churn_every
+                  if args.churn else float("inf"))
+    churn_phase = 0
+    churn_leaves = churn_evictions = churn_rejoins = churn_errors = 0
+
     mesh_commits = 0            # high-water device-owned commit count
     mesh_dead = False
     mesh_degraded_at_write = None
@@ -319,6 +349,51 @@ def main() -> int:
                 else:
                     fault_victim = None
                 next_fault = now + args.fault_every
+            if now >= next_churn:
+                # Churn event — only from full strength (every slot
+                # live), so quorum is never double-jeopardized.
+                if all(p is not None for p in pc.procs):
+                    try:
+                        try:
+                            client.close()
+                        except Exception:        # noqa: BLE001
+                            pass
+                        lead = pc.leader_idx(timeout=5.0)
+                        cv = churn_rng.choice(
+                            [i for i in range(args.replicas)
+                             if i != lead])
+                        if churn_phase % 2 == 0:
+                            pc.graceful_leave(cv, timeout=45.0)
+                            churn_leaves += 1
+                        else:
+                            pc.kill(cv)
+                            edl = time.monotonic() + 30.0
+                            while time.monotonic() < edl:
+                                st = pc.status(
+                                    pc.leader_idx(timeout=10.0),
+                                    timeout=1.0)
+                                if st and cv not in st.get(
+                                        "members", [cv]):
+                                    break
+                                time.sleep(0.05)
+                            else:
+                                raise AssertionError(
+                                    f"eviction of {cv} timed out")
+                            churn_evictions += 1
+                        slot = pc.add_replica(timeout=60.0)
+                        assert slot == cv, (slot, cv)
+                        churn_rejoins += 1
+                        churn_phase += 1
+                    except Exception as e:       # noqa: BLE001
+                        churn_errors += 1
+                        print(f"churn event failed: {e!r}",
+                              file=sys.stderr)
+                    try:
+                        leader = _find_leader_slot(pc)
+                        client = mk(pc.app_addr(leader))
+                    except Exception:            # noqa: BLE001
+                        pass
+                next_churn = now + args.churn_every
             if now >= next_failover:
                 # Keep quorum: only kill when every replica is up.
                 if all(p is not None for p in pc.procs):
@@ -514,6 +589,12 @@ def main() -> int:
             **({"pipeline_window": PIPE_W,
                 "pipeline_windows": pipe_windows}
                if args.pipeline else {}),
+            **({"churn": {
+                "graceful_leaves": churn_leaves,
+                "evictions": churn_evictions,
+                "rejoins": churn_rejoins,
+                "churn_errors": churn_errors,
+            }} if args.churn else {}),
             **({"fault_seed": args.fault_seed,
                 "faults_injected": faults_injected}
                if args.fault_seed is not None else {}),
@@ -533,7 +614,8 @@ def main() -> int:
             }} if args.mesh else {}),
         },
     }))
-    ok = converged and not errors and audit_ok
+    ok = (converged and not errors and audit_ok
+          and (not args.churn or churn_errors == 0))
     if not ok and args.fault_seed is not None:
         print(f"SOAK FAIL (FAULT_SEED={args.fault_seed})\n"
               f"  repro: python benchmarks/soak.py --minutes "
@@ -541,7 +623,9 @@ def main() -> int:
               f"--fault-seed {args.fault_seed}"
               + (" --mesh" if args.mesh else "")
               + (" --toyserver" if args.toyserver else "")
-              + (" --audit" if args.audit else ""),
+              + (" --audit" if args.audit else "")
+              + (f" --churn --churn-every {args.churn_every}"
+                 if args.churn else ""),
               file=sys.stderr)
     return 0 if ok else 1
 

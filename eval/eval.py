@@ -122,6 +122,24 @@ def _run_audit(out, trials: int = 5) -> None:
         _record(out, rec, replicas=3, bench="audit_campaign")
 
 
+def _run_churn(out, trials: int = 5) -> None:
+    """Membership-churn chaos campaign (fuzz.py --churn
+    --check-linear): seeded trials composing joins (leader usually
+    SIGKILLed mid-resize), failure-detector evictions + rejoin, and
+    graceful leaves (OP_LEAVE) with network faults on a live
+    ProcCluster, every trial's recorded history checked linearizable
+    across the traversed config epochs.  Banks trials / configs
+    traversed / ops checked / violations / wedges as one record."""
+    print(f"fuzz.py --churn --check-linear: membership churn "
+          f"({trials} trials)")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "benchmarks", "fuzz.py"),
+                          "--churn", "--check-linear",
+                          "--trials", str(trials)],
+                         timeout=300 * trials):
+        _record(out, rec, replicas=3, bench="churn_campaign")
+
+
 def cmd_run(args) -> int:
     os.makedirs(RESULTS, exist_ok=True)
     replica_counts = [int(x) for x in args.replicas.split(",")]
@@ -134,6 +152,11 @@ def cmd_run(args) -> int:
         if getattr(args, "audit_only", False):
             # Fast consistency re-audit: skip the cluster suite.
             _run_audit(out, trials=getattr(args, "audit_trials", 5))
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "churn_only", False):
+            # Fast churn re-campaign: skip the cluster suite.
+            _run_churn(out, trials=getattr(args, "churn_trials", 5))
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "throughput_only", False):
@@ -296,6 +319,10 @@ def cmd_run(args) -> int:
         # 4. Consistency audit campaign (ISSUE 4: linearizability of
         # live histories under crash + network + disk-fault chaos).
         _run_audit(out, trials=getattr(args, "audit_trials", 5))
+
+        # 5. Membership-churn campaign (ISSUE 5: joins, evictions,
+        # graceful leaves under faults, audited for linearizability).
+        _run_churn(out, trials=getattr(args, "churn_trials", 5))
     print(f"results appended to {RUNS}")
     return 0
 
@@ -438,6 +465,37 @@ def cmd_report(args) -> int:
             f"linearizability-checked over {a.get('keys')} keys, "
             f"violations={a.get('violations', '?')}; "
             f"seeds {a.get('seeds')}")
+    chn = [r for r in runs
+           if r.get("metric") in ("churn_linear_clean_pct",
+                                  "churn_clean_pct")
+           and isinstance(r.get("value"), (int, float))]
+    if chn:
+        last = chn[-1]
+        c = last.get("detail", {}).get("churn", {})
+        lines.append(
+            f"- membership churn (joins + evictions + graceful leaves "
+            f"under network faults, leader kills mid-resize): "
+            f"{last.get('detail', {}).get('trials')} seeded trials, "
+            f"{c.get('joins')} joins / {c.get('auto_removes')} "
+            f"auto-removes / {c.get('graceful_leaves')} graceful "
+            f"leaves / {c.get('leader_kills')} leader kills, "
+            f"{c.get('configs_traversed')} config epochs traversed, "
+            f"{_fmt(c.get('ops_checked'))} ops "
+            f"linearizability-checked; violations="
+            f"{c.get('violations', '?')}, wedges={c.get('wedges', '?')}"
+            f"; seeds {c.get('seeds')}")
+    glv = [r for r in runs if r.get("metric") == "proc_graceful_leave_time"
+           and isinstance(r.get("value"), (int, float))]
+    if glv:
+        last = glv[-1]
+        d = last["detail"]
+        lines.append(
+            f"- graceful leave (OP_LEAVE drain under client load, "
+            f"production envelope): drain {_fmt(last['value'])} ms, "
+            f"rejoin admitted {_fmt(d.get('rejoin_admitted_ms'))} ms, "
+            f"config converged {_fmt(d.get('config_converged_ms'))} ms, "
+            f"client errors during drain "
+            f"{d.get('client_errors_during_drain')}")
     fo = [r for r in runs if r.get("metric", "").endswith("failover_time")
           and isinstance(r.get("value"), (int, float))]
     ser = {}
@@ -587,6 +645,12 @@ def main() -> int:
                             "the cluster suite)")
         p.add_argument("--audit-trials", type=int, default=5,
                        help="seeded audit-campaign trials per run")
+        p.add_argument("--churn-only", action="store_true",
+                       help="run ONLY the membership-churn chaos "
+                            "campaign (fuzz.py --churn --check-linear; "
+                            "skips the cluster suite)")
+        p.add_argument("--churn-trials", type=int, default=5,
+                       help="seeded churn-campaign trials per run")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",

@@ -42,3 +42,8 @@ def pytest_configure(config):
         "audit: consistency-audit suite (apus_tpu.audit) — history "
         "capture + linearizability checking, incl. live-cluster "
         "accept/reject validation; selectable with -m audit")
+    config.addinivalue_line(
+        "markers",
+        "churn: membership-churn suite — joins/leaves/evictions under "
+        "faults (graceful leave, resize abort, incarnation fencing, "
+        "churn nemesis slice); selectable with -m churn")

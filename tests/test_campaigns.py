@@ -92,6 +92,24 @@ def test_audit_fuzz_slice():
     assert stats["ops_checked"] > 100, stats
 
 
+@pytest.mark.churn
+def test_churn_fuzz_slice():
+    """One membership-churn chaos trial (join under load with a
+    leader-kill-mid-resize arm, failure-detector eviction + rejoin,
+    graceful leave with clean-exit assertion, network faults, recorded
+    clients, linearizability check across config epochs): every churn
+    class must have fired and the history must check clean.  Failures
+    print the `--churn --check-linear --fault-seed N` repro via the
+    campaign CLI."""
+    fuzz = _fuzz()
+    stats = fuzz.run_churn_schedule(37_000, check_linear=True)
+    assert stats["joins"] >= 2, stats
+    assert stats["auto_removes"] >= 1, stats
+    assert stats["graceful_leaves"] >= 1, stats
+    assert stats["ops_checked"] > 100, stats
+    assert stats["configs_traversed"] >= 5, stats
+
+
 def test_soak_slice():
     """A 45-second endurance slice of the soak (real redis under
     sustained replicated traffic at the production misdirection

@@ -442,3 +442,454 @@ def test_seed_bootstrap_join(tmp_path):
             except (OSError, ProcessLookupError):
                 proc.kill()
             proc.wait(timeout=5)
+
+
+# -- reconfiguration churn: graceful leave, abort, fencing (ISSUE 5) ------
+
+import threading
+
+import dataclasses as _dc
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.quorum import quorum_size
+
+
+@pytest.mark.churn
+def test_graceful_leave_e2e_under_load(tmp_path):
+    """OP_LEAVE drains a live follower UNDER CLIENT LOAD: the leader
+    commits the removal, the drained daemon process exits CLEAN (rc 0,
+    asserted by ProcCluster.graceful_leave), its endpoint goes dark
+    (no zombie serving), client-visible errors stay zero, and the
+    ex-member's NEXT incarnation re-joins the freed slot with a fresh
+    incarnation and catches up via snapshot push."""
+    import os as _os
+
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with ProcCluster(3, workdir=str(tmp_path / "c")) as pc:
+        with ApusClient(list(pc.spec.peers)) as c:
+            # Enough history + prune ticks that the freed slot's next
+            # incarnation lands behind the pruned head (-> snapshot).
+            for i in range(60):
+                assert c.put(b"pre:%d" % i, b"v%d" % i) == b"OK"
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                st = pc.status(pc.leader_idx(timeout=10.0))
+                if st and st.get("log_head", 0) > 2:
+                    break
+                assert c.put(b"fill:%d" % int(time.monotonic() * 1e6),
+                             b"v") == b"OK"
+                time.sleep(0.1)
+            else:
+                raise AssertionError("leader never pruned")
+        lead = pc.leader_idx()
+        victim = next(i for i in range(3) if i != lead)
+        errors: list = []
+        stop = threading.Event()
+
+        def writer() -> None:
+            i = 0
+            with ApusClient(list(pc.spec.peers), timeout=5.0) as wc:
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        if wc.put(b"load:%d" % i, b"v") != b"OK":
+                            errors.append(f"bad reply {i}")
+                    except Exception as e:       # noqa: BLE001
+                        errors.append(repr(e))
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            pc.graceful_leave(victim, timeout=30.0)
+            st = pc.status(pc.leader_idx(timeout=10.0))
+            assert victim not in st["members"], st
+            assert st["graceful_leaves"] >= 1, st
+            # Endpoint dark: the drained process exited, nothing serves.
+            assert probe_status(pc.spec.peers[victim],
+                                timeout=0.5) is None
+            # Fresh incarnation: wipe the old store so the rejoin must
+            # catch up from the LEADER's state, not its own disk.
+            try:
+                _os.unlink(pc.store_path(victim))
+            except OSError:
+                pass
+            slot = pc.add_replica(timeout=60.0)
+            assert slot == victim, (slot, victim)
+            pc.wait_config_converged(timeout=45.0)
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        assert not errors, f"client-visible errors during drain: " \
+                           f"{errors[:5]}"
+        jst = pc.status(victim)
+        assert jst["incarnation"] > 0, jst
+        # Snapshot catch-up: the joiner was behind the pruned head.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            jst = pc.status(victim)
+            if jst and jst.get("snapshots_installed", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert jst.get("snapshots_installed", 0) >= 1, jst
+
+
+@pytest.mark.churn
+def test_leave_refusals_typed():
+    """handle_leave answers typed refusals: quorum-floor removals are
+    permanently refused (a config below quorum_size(size) could never
+    commit again), and a second removal while one is mid-flight is a
+    transient config_in_flight."""
+    from apus_tpu.parallel.sim import Cluster
+
+    c = Cluster(3, seed=5, sm_factory=KvsStateMachine,
+                auto_remove=False)
+    leader = c.wait_for_leader()
+    others = [i for i in range(3) if i != leader.idx]
+    pl = leader.handle_leave(others[0])
+    assert not isinstance(pl, str) and pl is not None
+    # Mid-flight: the first removal's CONFIG entry is not applied yet.
+    assert leader.handle_leave(others[1]) == "config_in_flight"
+    c.run(1.0)
+    assert pl.done
+    assert not leader.cid.contains(others[0])
+    # 2 members of a size-3 config: one more removal would drop below
+    # quorum_size(3) == 2 — permanently refused.
+    assert leader.handle_leave(others[1]) == "quorum_floor"
+    # Idempotent: leaving a non-member answers done immediately.
+    again = leader.handle_leave(others[0])
+    assert again is not None and not isinstance(again, str) \
+        and again.done
+    # The removal epoch fences the ex-member's slot.
+    assert leader.fence_epochs.get(others[0], 0) > 0
+
+
+@pytest.mark.churn
+def test_leader_self_leave_steps_down():
+    """OP_LEAVE of the LEADER itself: the removal commits (replicated
+    to a quorum of C_new before apply), the handle resolves, and the
+    ex-leader steps down instead of zombie-serving; the remaining
+    members elect and keep committing."""
+    from apus_tpu.core.types import Role
+    from apus_tpu.parallel.sim import Cluster
+
+    c = Cluster(3, seed=7, sm_factory=KvsStateMachine,
+                auto_remove=False)
+    leader = c.wait_for_leader()
+    pl = leader.handle_leave(leader.idx)
+    assert pl is not None and not isinstance(pl, str)
+    c.run(2.0)
+    assert pl.done
+    assert leader.role != Role.LEADER
+    assert not leader.cid.contains(leader.idx)
+    # The remaining pair elects and commits.
+    new_leader = c.wait_for_leader()
+    assert new_leader.idx != leader.idx
+    c.submit(encode_put(b"after", b"selfleave"))
+    assert new_leader.sm.store[b"after"] == b"selfleave"
+
+
+@pytest.mark.churn
+def test_resize_abort_node_level():
+    """Deterministic pin of the EXTENDED-abort arm: a new slot with
+    failure-detector death evidence and zero ack progress for the
+    stall window triggers ONE abort CONFIG back to STABLE at the old
+    size.  (The join handle resolves 'admitted' at the EXTENDED
+    apply — that is the admission reply — and the aborted joiner's
+    next attempt re-runs the join protocol.)"""
+    from apus_tpu.core.node import Node, NodeConfig
+    from apus_tpu.models.kvs import KvsStateMachine as _KVS
+    from apus_tpu.parallel.transport import (Region, Transport,
+                                             WriteResult)
+
+    class DeadJoinerTransport(Transport):
+        """Peers 1-2 reachable (their acks are scripted straight into
+        the regions); slot 3 reachable-then-dead."""
+
+        def ctrl_write(self, target, region, slot, value):
+            return (WriteResult.OK if target in (1, 2)
+                    else WriteResult.DROPPED)
+
+        def log_write(self, target, writer_sid, entries, commit):
+            return ((WriteResult.OK, None) if target in (1, 2)
+                    else (WriteResult.DROPPED, None))
+
+        def log_read_state(self, target):
+            return None
+
+        def peer_established(self, target):
+            return True
+
+        def peer_failure_was_timeout(self, target):
+            return False
+
+    n = Node(NodeConfig(idx=0, fail_window=0.05, adaptive_timeout=False),
+             Cid.initial(3), _KVS(), DeadJoinerTransport())
+    n.become_leader(0.0)
+    pj = n.handle_join("10.0.0.9:1")
+    assert pj is not None and pj.slot == 3
+    now = 0.0
+    deadline = 30.0
+    while now < deadline:
+        now += 0.01
+        # Live followers 1-2 ack everything; the joiner never does.
+        n.regions.ctrl[Region.REP_ACK][1] = n.log.end
+        n.regions.ctrl[Region.REP_ACK][2] = n.log.end
+        n.regions.ctrl[Region.APPLY_IDX][1] = n.log.apply
+        n.regions.ctrl[Region.APPLY_IDX][2] = n.log.apply
+        n.tick(now)
+        if n.cid.state == CidState.STABLE and not n.cid.contains(3) \
+                and n.stats.get("resize_aborts", 0):
+            break
+    assert n.stats.get("resize_aborts", 0) == 1, n.stats
+    assert n.cid.state == CidState.STABLE and n.cid.size == 3
+    assert not n.cid.contains(3)
+    # The abort's removal epoch fences the dead joiner's incarnation.
+    assert n.fence_epochs.get(3, 0) > 0
+    # Membership machinery is usable again.
+    assert n.handle_join("10.0.0.10:1") is not None
+
+
+@pytest.mark.churn
+def test_resize_unwedges_after_joiner_death_live():
+    """Live-stack counterpart (outcome-agnostic): a joiner that dies
+    right after admission must leave membership USABLE — the ladder
+    either finishes (then the dead slot is auto-removed) or cleanly
+    aborts; either way every live replica converges to a STABLE
+    config without slot 3, and a fresh joiner is admitted."""
+    import socket as _socket
+
+    spec = _dc.replace(SPEC, fail_window=0.1, auto_remove=True)
+    with LocalCluster(3, spec=spec) as c:
+        for i in range(5):
+            c.submit(encode_put(b"ra%d" % i, b"v"))
+        leader = c.wait_for_leader()
+        # A listener that accepts but never answers: the leader's dial
+        # succeeds (peer "established"), then dies when we close it —
+        # connection errors (not busy-timeouts) feed the counter.
+        lsock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        addr = "%s:%d" % lsock.getsockname()
+        with leader.lock:
+            pj = leader.node.handle_join(addr)
+            assert pj is not None and pj.slot == 3
+        # Let the EXTENDED entry commit + the leader dial the "joiner".
+        _wait(lambda: leader.node.cid.state == CidState.EXTENDED,
+              msg="EXTENDED applied")
+        time.sleep(0.5)
+        lsock.close()            # the joiner "dies"
+
+        def aborted():
+            # Leadership may move while the dead joiner's timeouts
+            # stall ticks — the ABORT may land at a successor.
+            for dd in c.live():
+                with dd.lock:
+                    if not (dd.node.cid.state == CidState.STABLE
+                            and not dd.node.cid.contains(3)):
+                        return False
+            return True
+        _wait(aborted, timeout=40,
+              msg="membership unwedged (STABLE without the dead slot)")
+        # Membership is usable again: a live joiner is admitted.
+        d = c.add_replica()
+        assert d.idx == 3
+        c.wait_caught_up(d.idx)
+
+
+@pytest.mark.churn
+def test_join_want_slot_bound_is_typed_refusal():
+    """A recovered server whose slot was reassigned to a DIFFERENT
+    address gets the typed permanent refusal (JoinRefusedError:
+    slot_bound) instead of hint-chasing into a timeout."""
+    from apus_tpu.runtime.membership import (JoinRefusedError,
+                                             request_join)
+
+    with LocalCluster(3, spec=SPEC) as c:
+        c.submit(encode_put(b"a", b"1"))
+        # Slot 0 is bound to a live member's address; a stranger
+        # demanding it must be refused permanently and quickly.
+        t0 = time.monotonic()
+        with pytest.raises(JoinRefusedError):
+            request_join([p for p in c.spec.peers if p],
+                         "127.0.0.1:1", timeout=10.0, want_slot=0)
+        assert time.monotonic() - t0 < 8.0, \
+            "permanent refusal burned the whole deadline"
+
+
+@pytest.mark.churn
+def test_incarnation_fencing_blocks_stale_ctrl_writes():
+    """After a slot is removed, ctrl writes carrying a pre-removal
+    incarnation are FENCED at the peer server — a stale ex-member's
+    REP_ACK/vote can never be credited to the slot (or its next
+    occupant).  The next incarnation (admission epoch > removal epoch)
+    passes."""
+    from apus_tpu.parallel.net import NetTransport
+    from apus_tpu.parallel.transport import Region, WriteResult
+
+    with LocalCluster(3, spec=SPEC) as c:
+        c.submit(encode_put(b"a", b"1"))
+        leader = c.wait_for_leader()
+        victim = next(i for i in range(3) if i != leader.idx)
+        c.graceful_leave(victim)
+        with leader.lock:
+            fence = leader.node.fence_epochs.get(victim, 0)
+            assert fence > 0
+        host, port = c.spec.peers[leader.idx].rsplit(":", 1)
+        t = NetTransport({leader.idx: (host, int(port))})
+        try:
+            # Stale incarnation (0 < fence): fenced, region untouched.
+            # (First calls may be DROPPED while the async dial runs.)
+            t.incarnation_of = lambda: 0
+            deadline = time.monotonic() + 5.0
+            while True:
+                res = t.ctrl_write(leader.idx, Region.REP_ACK,
+                                   victim, 999)
+                if res != WriteResult.DROPPED \
+                        or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.05)
+            assert res == WriteResult.FENCED, res
+            with leader.lock:
+                assert leader.node.regions.ctrl[Region.REP_ACK][victim] \
+                    != 999
+                assert leader.node.stats.get("fenced_ctrl_writes",
+                                             0) >= 1
+            # Next incarnation (>= fence epoch): accepted.
+            t.incarnation_of = lambda: fence + 1
+            res = t.ctrl_write(leader.idx, Region.REP_ACK, victim, 7)
+            assert res == WriteResult.OK, res
+        finally:
+            t.close()
+
+
+@pytest.mark.churn
+def test_fenced_quorum_steps_leader_down():
+    """A zombie ex-leader (partitioned through its own removal) whose
+    heartbeats come back FENCED from a quorum steps down instead of
+    serving timeouts forever (nobody heartbeats a non-member, so the
+    silence watchdog alone never fires for a 'leader')."""
+    from apus_tpu.core.node import Node, NodeConfig
+    from apus_tpu.core.types import Role
+    from apus_tpu.models.kvs import KvsStateMachine as _KVS
+    from apus_tpu.parallel.transport import (Region, Transport,
+                                             WriteResult)
+
+    class FencingTransport(Transport):
+        def ctrl_write(self, target, region, slot, value):
+            return WriteResult.FENCED
+
+        def peer_established(self, target):
+            return True
+
+        def peer_failure_was_timeout(self, target):
+            return False
+
+    n = Node(NodeConfig(idx=0), Cid.initial(3), _KVS(),
+             FencingTransport())
+    n.become_leader(0.0)
+    assert n.is_leader
+    # Drive one heartbeat round: every HB reply is FENCED.
+    n._send_heartbeats(n.sid.sid, 1.0)
+    assert n.role != Role.LEADER
+    assert n.stats.get("fenced_stepdowns", 0) == 1
+
+
+@pytest.mark.churn
+def test_snapshot_carries_fence_table():
+    """The removed-slot fence table travels with snapshots: an
+    installer that never applies the removal CONFIG entries still
+    learns which slots were removed at which epoch."""
+    from apus_tpu.core.node import Node, NodeConfig
+    from apus_tpu.models.kvs import KvsStateMachine as _KVS
+    from apus_tpu.parallel.sim import SimTransport
+    from apus_tpu.parallel import wire as _wire
+
+    t = SimTransport()
+    a = Node(NodeConfig(idx=0), Cid.initial(3), _KVS(), t)
+    b = Node(NodeConfig(idx=1), Cid.initial(3), _KVS(), t)
+    t.attach([a, b])
+    a.fence_epochs = {2: 4, 1: 7}
+    a._applied_det = (5, 1)      # a non-trivial snapshot point
+    snap, ep, cid, members = a.make_snapshot()
+    # Wire roundtrip preserves the fence blob.
+    rt = _wire.decode_value(_wire.Reader(_wire.encode_value(snap)))
+    assert rt.fence == snap.fence and snap.fence
+    assert b.install_snapshot(snap, ep, cid, members)
+    assert b.fence_epochs == {2: 4, 1: 7}
+
+
+@pytest.mark.churn
+def test_joiner_killed_mid_snapshot_push(tmp_path, monkeypatch):
+    """Joiner SIGKILL mid-snapshot-stream (the reconfiguration bug
+    nest): the leader must free the push slot — a held slot silently
+    stops ALL replication to that peer forever — keep committing to
+    the rest, and serve the joiner's NEXT incarnation, which catches
+    up via a fresh push."""
+    from apus_tpu.core.node import Node
+    from apus_tpu.parallel.net import NetTransport
+    from apus_tpu.runtime.bridge import RelayStateMachine
+
+    monkeypatch.setattr(Node, "SNAP_STREAM_THRESHOLD", 64 << 10)
+    # Small chunks + a per-op throttle on the leader's outbound ops to
+    # the joiner slot: the stream crawls, giving the kill a wide
+    # mid-transfer window.
+    monkeypatch.setattr(NetTransport, "SNAP_CHUNK_BYTES", 8 << 10)
+    made = [0]
+
+    def sm_factory():
+        made[0] += 1
+        return RelayStateMachine(
+            spill_path=str(tmp_path / f"dump{made[0]}.bin"))
+
+    spec = _dc.replace(SPEC, fault_plane=True, auto_remove=False)
+    with LocalCluster(3, spec=spec, sm_factory=sm_factory) as c:
+        payload = b"R" * 2048
+        for i in range(120):                # ~250 KB of dump
+            c.submit(b"rec-%03d-" % i + payload)
+
+        def pruned():
+            leader = c.leader()
+            if leader is None:
+                return False
+            with leader.lock:
+                return leader.node.log.head > 10
+        _wait(pruned, msg="leader log pruned")
+
+        # Throttle EVERY member's outbound ops to the joiner slot
+        # (leadership may move): whoever pushes, the stream crawls.
+        for dd in c.live():
+            dd.transport.set_throttle(3, 0.05)
+        d = c.add_replica()
+
+        def pushing():
+            for dd in c.live():
+                if dd.idx == 3:
+                    continue
+                with dd.lock:
+                    if dd.node._snap_pushing:
+                        return True
+            return False
+        _wait(pushing, timeout=30, msg="stream push in flight")
+        c.kill(d.idx)                       # joiner dies mid-transfer
+
+        def freed():
+            for dd in c.live():
+                with dd.lock:
+                    if dd.node._snap_pushing:
+                        return False
+            return True
+        _wait(freed, timeout=30, msg="push slot freed after death")
+        leader = c.wait_for_leader()
+        # The group kept serving through the whole episode.
+        c.submit(b"after-kill-" + payload)
+        # Next incarnation at the same slot: admitted and primed.
+        for dd in c.live():
+            dd.transport.heal()
+        d2 = c.restart(3)
+        c.wait_caught_up(3, timeout=60.0)
+        with d2.lock:
+            assert d2.node.stats.get("snapshots_installed", 0) >= 1
+        _wait(freed, timeout=20, msg="no push slot left held")
+        c.check_logs_consistent()
