@@ -176,6 +176,7 @@ class Node:
         self._next_apply_report = 0.0
 
         # leader state
+        self._peer_applied: dict[int, tuple] = {} # last applied det read
         self._next_idx: dict[int, int] = {}       # per-follower next entry
         self._commit_sent: dict[int, int] = {}    # lazy remote-commit writes
         self._adjusted: dict[int, bool] = {}      # log adjustment done?
@@ -253,6 +254,12 @@ class Node:
         # both thread-safe); the tick loop consumes completions.  The
         # sim keeps the inline path (deterministic, no threads).
         self.async_snap_push = False
+        # Spool dir for INBOUND snapshot streams (resumable partial
+        # assembly; see onesided._snap_spool_path).  The daemon points
+        # it at its durable-store dir so a partial transfer survives a
+        # receiver restart; None = tempfile (in-process clusters:
+        # resumable only within this process).
+        self.snap_spool_dir: Optional[str] = None
         self._snap_pushing: set[int] = set()
         #: peer -> (term_at_start, result, pushed_last_idx, push_gen)
         self._snap_push_done: dict[int, tuple] = {}
@@ -667,37 +674,89 @@ class Node:
 
     def make_snapshot_stream_meta(self):
         """Streaming counterpart of make_snapshot: everything EXCEPT the
-        data blob — (meta_snap, ep_dump, cid, members, total) — for SMs
-        exposing an on-disk dump (snapshot_stream_size /
-        read_snapshot_chunk).  Returns None when the SM can't stream or
-        the dump is below SNAP_STREAM_THRESHOLD.  Captured atomically
-        under the caller's lock: the dump file is append-only and
-        appends happen under the same lock, so the [0, total) prefix is
-        exactly the state at (last_idx, last_term) and stays immutable
-        while chunks are read.  Cached like _snap_cache (tiny: no
-        blob)."""
-        size_of = getattr(self.sm, "snapshot_stream_size", None)
-        if size_of is None:
-            return None
+        data blob — (meta_snap, ep_dump, cid, members, total, gen,
+        blob) — for SMs exposing an on-disk dump (snapshot_stream_size
+        / read_snapshot_chunk), where ``blob`` is None (chunks pread
+        the dump).  SMs WITHOUT a dump file (KVS) still get the
+        chunked resumable stream above the threshold: ``blob`` is then
+        the cached immutable snapshot bytes and chunks slice it (the
+        generation fence is unnecessary — bytes never mutate).
+        Returns None when the state is below SNAP_STREAM_THRESHOLD
+        (one-blob push is fine there).  Captured atomically under the
+        caller's lock: the dump file is append-only and appends happen
+        under the same lock, so the [0, total) prefix is exactly the
+        state at (last_idx, last_term) and stays immutable while
+        chunks are read.  Cached like _snap_cache."""
         if self._snap_stream_cache is not None and \
                 self._snap_stream_cache[0].last_idx + 1 >= self.log.head:
             return self._snap_stream_cache
-        total = size_of()
-        if total is None or total < self.SNAP_STREAM_THRESHOLD:
+        size_of = getattr(self.sm, "snapshot_stream_size", None)
+        total = size_of() if size_of is not None else None
+        if total is not None:
+            if total < self.SNAP_STREAM_THRESHOLD:
+                return None
+            last_idx, last_term = self._applied_det
+            meta = Snapshot(last_idx, last_term, b"",
+                            seg=self._seg.dump(),
+                            fence=self._fence_blob())
+            gen = getattr(self.sm, "dump_generation", 0)
+            self._snap_stream_cache = (meta, self.epdb.dump(), self.cid,
+                                       dict(self._member_addrs), total,
+                                       gen, None)
+            return self._snap_stream_cache
+        # Blob fallback (no dump file): reuse the one-blob snapshot
+        # cache; the blob is immutable bytes, so off-tick chunk reads
+        # need no generation fencing or fd pinning.
+        snap, ep_dump, cid, members = self.make_snapshot()
+        if len(snap.data) < self.SNAP_STREAM_THRESHOLD:
+            return None
+        meta = dataclasses.replace(snap, data=b"")
+        self._snap_stream_cache = (meta, ep_dump, cid, dict(members),
+                                   len(snap.data), 0, snap.data)
+        return self._snap_stream_cache
+
+    #: Inline delta pushes are capped here; a delta that would exceed
+    #: it falls back to the full chunked stream (which is resumable and
+    #: runs off-tick) — an unbounded delta blob would stall the tick
+    #: thread exactly like the whole-blob push the stream replaced.
+    DELTA_MAX_BYTES = 4 << 20
+
+    def make_snapshot_delta(self, base_idx: int, base_term: int):
+        """Delta-snapshot production: everything a rejoiner whose
+        applied determinant is (base_idx, base_term) needs — the SM's
+        state delta past that point plus the usual snapshot freight
+        (epdb dump, seg buffer, fence table, config).  None when the
+        SM can't serve the base (below its delta floor / no delta
+        support), when our own log still holds a CONFLICTING entry at
+        base_idx, or when the delta exceeds DELTA_MAX_BYTES — callers
+        fall back to the full push.  Returns (snap, ep_dump, cid,
+        member_addrs, (base_idx, base_term))."""
+        if base_idx <= 0:
             return None
         last_idx, last_term = self._applied_det
-        meta = Snapshot(last_idx, last_term, b"", seg=self._seg.dump(),
+        if last_idx <= base_idx:
+            return None                  # nothing past the base
+        if self.log.head <= base_idx < self.log.end:
+            e = self.log.get(base_idx)
+            if e is not None and e.term != base_term:
+                return None              # divergent base: full push
+        delta_fn = getattr(self.sm, "delta_since", None)
+        if delta_fn is None:
+            return None
+        data = delta_fn(base_idx)
+        if data is None or len(data) > self.DELTA_MAX_BYTES:
+            return None
+        snap = Snapshot(last_idx, last_term, data, seg=self._seg.dump(),
                         fence=self._fence_blob())
-        gen = getattr(self.sm, "dump_generation", 0)
-        self._snap_stream_cache = (meta, self.epdb.dump(), self.cid,
-                                   dict(self._member_addrs), total, gen)
-        return self._snap_stream_cache
+        return (snap, self.epdb.dump(), self.cid,
+                dict(self._member_addrs), (base_idx, base_term))
 
     def install_snapshot(self, snap: Snapshot, ep_dump: list,
                          cid: Optional[Cid] = None,
                          member_addrs: Optional[dict] = None,
                          data_path: Optional[str] = None,
-                         adopt: bool = False) -> bool:
+                         adopt: bool = False,
+                         delta_base: Optional[tuple] = None) -> bool:
         """Install a snapshot pushed by the leader (rc_recover_sm analog,
         dare_ibv_rc.c:603-689): replaces SM + dedup state, re-bases the
         log just past the snapshot, and adopts the snapshot-point
@@ -714,7 +773,30 @@ class Node:
         generation)."""
         if snap.last_idx < self.log.commit:
             return False                     # we already have more
-        if data_path is not None:
+        if delta_base is not None:
+            # DELTA install: snap.data is the state delta past
+            # (base_idx, base_term).  Exact iff our applied
+            # determinant still equals the base the sender read —
+            # committed prefixes at equal determinants are identical,
+            # so merge-on-match reconstructs the full state.  Any
+            # mismatch (we applied more meanwhile, or were reset)
+            # refuses; the sender falls back to a full image.
+            if self._applied_det != tuple(delta_base):
+                self.stats["delta_refused"] = \
+                    self.stats.get("delta_refused", 0) + 1
+                return False
+            apply_delta = getattr(self.sm, "apply_snapshot_delta", None)
+            if apply_delta is None:
+                return False
+            try:
+                apply_delta(snap)
+            except NotImplementedError:
+                return False
+            snap = dataclasses.replace(snap,
+                                       delta_base=tuple(delta_base))
+            self.stats["delta_installs"] = \
+                self.stats.get("delta_installs", 0) + 1
+        elif data_path is not None:
             import os as _os
             stable = self.sm.apply_snapshot_file(snap, data_path,
                                                  adopt=adopt)
@@ -805,7 +887,7 @@ class Node:
         if self._prevote_deadline is None or now >= self._prevote_deadline:
             self.regions.ctrl[Region.PREVOTE_ACK] = \
                 [None] * MAX_SERVER_COUNT
-            last_idx, last_term = self.log.last_determinant()
+            last_idx, last_term = self._last_det()
             req = VoteRequest(Sid(target, False, self.idx).word,
                               last_idx, last_term, self.cid.epoch,
                               prevote=True)
@@ -815,6 +897,21 @@ class Node:
             self._prevote_deadline = now + random_election_timeout(
                 self.rng, self.cfg.elect_low, self.cfg.elect_high)
             self.stats["prevotes"] = self.stats.get("prevotes", 0) + 1
+
+    def _last_det(self) -> tuple:
+        """Last-entry determinant for election up-to-dateness.  An
+        EMPTY log whose base is the apply point (snapshot install, or
+        restart replay re-basing) answers with the APPLIED determinant
+        instead of a term-0 placeholder — a replica that holds the
+        full committed state must not look maximally stale to voters
+        (liveness after whole-group restart from stores)."""
+        e = self.log.last_entry()
+        if e is not None:
+            return e.determinant()
+        li, lt = self._applied_det
+        if li == self.log.end - 1:
+            return (li, lt)
+        return (self.log.end - 1, 0)
 
     def start_election(self, now: float) -> None:
         """start_election analog (dare_server.c:1264-1322)."""
@@ -837,7 +934,7 @@ class Node:
         self.regions.grant_log_access(None, new.term)
         self.regions.ctrl[Region.VOTE_ACK] = [None] * len(self.regions.ctrl[Region.VOTE_ACK])
         self._replicate_vote(new)
-        last_idx, last_term = self.log.last_determinant()
+        last_idx, last_term = self._last_det()
         req = VoteRequest(new.word, last_idx, last_term, self.cid.epoch)
         for peer in self.cid.members():
             if peer != self.idx:
@@ -958,7 +1055,7 @@ class Node:
         reqs = [r for r in reqs if not r.prevote]
         if prevotes:
             my = self.sid.sid
-            last_idx, last_term = self.log.last_determinant()
+            last_idx, last_term = self._last_det()
             alive = (self.role == Role.LEADER
                      or (self._known_leader is not None
                          and now - self._last_hb_seen < self._hb_timeout))
@@ -994,7 +1091,7 @@ class Node:
             self.role = Role.FOLLOWER
             self._known_leader = None
             self._election_deadline = None
-        last_idx, last_term = self.log.last_determinant()
+        last_idx, last_term = self._last_det()
         leader_alive = (self._known_leader is not None and
                         now - self._last_hb_seen < self._hb_timeout)
         # lease_guard is UNCONDITIONAL, not cfg.read_lease: the guard
@@ -1273,6 +1370,11 @@ class Node:
                 if state is None:
                     self._note_failure(peer, now)
                     continue
+                # Remember the peer's applied determinant: the base a
+                # delta snapshot can build on (the rejoiner "presents
+                # its last applied (epoch, index)" via LogState).
+                self._peer_applied[peer] = (state.applied_idx,
+                                            state.applied_term)
                 div = self.log.find_divergence(state.nc_determinants,
                                                state.commit)
                 if div < state.end:
@@ -1299,22 +1401,70 @@ class Node:
                 # Peer is behind our pruned head: push a snapshot
                 # (leader-driven form of rc_recover_sm, the reference's
                 # joiner instead RDMA-reads it, dare_ibv_rc.c:603-689),
-                # then resume log replication just past it.  Large
-                # on-disk dumps stream in chunks (the pusher holds one
-                # chunk, not the whole history); small/in-memory dumps
-                # take the one-blob push.
+                # then resume log replication just past it.
+                #
+                # DELTA FIRST: a rejoiner that presented a usable
+                # applied determinant (durable-store replay primes it)
+                # receives only the state delta past that point when
+                # the SM's tracked history (its compaction floor)
+                # permits — O(recent churn) instead of O(state).  Any
+                # refusal (determinant moved, base below floor,
+                # oversized delta) falls through to the full push in
+                # this same pass.
+                # Fresh determinant read: the adjustment-time capture
+                # can predate the peer's whole lagging episode (a
+                # still-"adjusted" peer reaches here via the stale
+                # next_idx alone), and a stale base would silently
+                # forfeit the delta path.  One cheap roundtrip before
+                # a potentially O(state) push.
+                det = self._peer_applied.get(peer)
+                st_now = self.t.log_read_state(peer)
+                if st_now is not None:
+                    det = (st_now.applied_idx, st_now.applied_term)
+                    self._peer_applied[peer] = det
+                if det is not None and det[0] > 0:
+                    d = self.make_snapshot_delta(det[0], det[1])
+                    if d is not None:
+                        dsnap, dep, dcid, dmembers, base = d
+                        res = self.t.snap_push(peer, my, dsnap, dep,
+                                               dcid, dmembers,
+                                               delta_base=base)
+                        if res == WriteResult.OK:
+                            self.stats["delta_snapshots"] = \
+                                self.stats.get("delta_snapshots", 0) + 1
+                            self._finish_snap_push(peer, res,
+                                                   dsnap.last_idx, now)
+                            continue
+                        if res == WriteResult.FENCED:
+                            self._adjusted[peer] = False
+                            continue
+                        if res == WriteResult.DROPPED:
+                            self._note_failure(peer, now)
+                            continue
+                        # REFUSED: base no longer matches — the next
+                        # adjustment refreshes the determinant; ship
+                        # the full image below meanwhile.
+                        self._peer_applied.pop(peer, None)
+                # Large dumps stream in CRC'd resumable chunks (the
+                # pusher holds one chunk, not the whole history);
+                # small/in-memory dumps take the one-blob push.
                 stream = (self.make_snapshot_stream_meta()
                           if hasattr(self.t, "snap_push_stream") else None)
                 if stream is not None:
-                    meta, ep_dump, snap_cid, members, total, gen = stream
+                    meta, ep_dump, snap_cid, members, total, gen, blob \
+                        = stream
 
-                    def read_chunk(off, n, _gen=gen):
+                    def read_chunk(off, n, _gen=gen, _blob=blob):
                         # Frozen-prefix fence: the dump is append-only
                         # UNLESS apply_snapshot replaced it (we were
                         # deposed and re-primed mid-stream) — then the
                         # prefix no longer matches the captured meta
                         # and the stream must abort, not ship bytes of
-                        # someone else's history.
+                        # someone else's history.  A captured BLOB
+                        # (dump-less SMs) is immutable bytes: no fence
+                        # needed.
+                        if _blob is not None:
+                            return _blob[off:off + n]
                         if getattr(self.sm, "dump_generation", 0) != _gen:
                             return b""
                         return self.sm.read_snapshot_chunk(off, n)
@@ -1336,18 +1486,39 @@ class Node:
                         # so the pinned fd serves the immutable
                         # captured prefix forever; the generation check
                         # remains only as an early-abort optimization.
-                        if getattr(self.sm, "dump_generation", 0) != gen:
+                        if blob is None and \
+                                getattr(self.sm, "dump_generation",
+                                        0) != gen:
                             self._snap_stream_cache = None
                             continue       # stale meta: retry next pass
-                        dupper = getattr(self.sm, "dup_dump_fd", None)
-                        dup_fd = dupper() if dupper is not None else None
+                        dup_fd = None
+                        pinned = None
+                        if blob is None:
+                            dupper = getattr(self.sm, "dup_dump_fd",
+                                             None)
+                            if dupper is not None:
+                                dup_fd = dupper()
+                            else:
+                                # Ropes (dump-less SMs): pin the frozen
+                                # capture — immune to rebuilds, like
+                                # the dup'd fd pins the old inode.
+                                pinner = getattr(self.sm,
+                                                 "pin_dump_reader",
+                                                 None)
+                                if pinner is not None:
+                                    pinned = pinner()
                         self._snap_pushing.add(peer)
                         self._snap_push_started[peer] = now
                         push_gen = self._snap_push_gen.get(peer, 0)
                         import os as _os
                         import threading as _threading
 
-                        def _read_pinned(off, n, _gen=gen, _fd=dup_fd):
+                        def _read_pinned(off, n, _gen=gen, _fd=dup_fd,
+                                         _blob=blob, _pin=pinned):
+                            if _blob is not None:
+                                return _blob[off:off + n]  # immutable
+                            if _pin is not None:
+                                return _pin(off, n)        # frozen rope
                             if getattr(self.sm, "dump_generation",
                                        0) != _gen:
                                 return b""        # early abort
@@ -1372,15 +1543,9 @@ class Node:
                                         _os.close(dup_fd)
                                     except OSError:
                                         pass
-                            self._snap_push_done[peer] = \
-                                (my.term, r, meta.last_idx, push_gen)
-                            # Free the slot only if OUR push still owns
-                            # it — after a stall abandonment the slot
-                            # may belong to a successor push.
-                            if self._snap_push_gen.get(peer,
-                                                       0) == push_gen:
-                                self._snap_pushing.discard(peer)
-                                self._snap_push_started.pop(peer, None)
+                            self._record_push_done(
+                                peer, my.term, r, meta.last_idx,
+                                push_gen)
 
                         _threading.Thread(
                             target=_push, daemon=True,
@@ -1447,6 +1612,29 @@ class Node:
                 self._adjusted[peer] = False   # lost access: re-adjust later
             else:
                 self._note_failure(peer, now)
+
+    def _record_push_done(self, peer: int, term: int, res,
+                          pushed_last_idx: int, push_gen: int) -> None:
+        """Background push thread -> tick thread handoff.  Drops by
+        GENERATION before touching ANY per-peer push state: after a
+        stall abandonment a SUCCESSOR push may own the slot, and a
+        late completion from a dead generation overwriting
+        ``_snap_push_done`` would discard the successor's pending
+        completion (stranding its cursor/stats bookkeeping) — the PR 5
+        backstop edge.  Runs WITHOUT the node lock, so generations
+        being monotone is the belt against the check-then-write race:
+        a NEWER pending completion is never clobbered."""
+        if self._snap_push_gen.get(peer, 0) != push_gen:
+            self.stats["snap_push_stale_done"] = \
+                self.stats.get("snap_push_stale_done", 0) + 1
+            return
+        prev = self._snap_push_done.get(peer)
+        if prev is not None and prev[3] > push_gen:
+            return
+        self._snap_push_done[peer] = \
+            (term, res, pushed_last_idx, push_gen)
+        self._snap_pushing.discard(peer)
+        self._snap_push_started.pop(peer, None)
 
     def _finish_snap_push(self, peer: int, res: "WriteResult",
                           pushed_last_idx: int, now: float,

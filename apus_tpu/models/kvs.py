@@ -31,6 +31,28 @@ def encode_delete(key: bytes) -> bytes:
 class KvsStateMachine(StateMachine):
     def __init__(self) -> None:
         self.store: dict[bytes, bytes] = {}
+        # Delta-snapshot bookkeeping (models.sm delta contract): the
+        # log index that last MODIFIED each key (puts and deletes), so
+        # ``delta_since(base)`` can ship only the keys touched after a
+        # rejoiner's applied determinant.  ``delta_floor`` is the
+        # earliest base the history covers: 0 from a fresh boot (we
+        # saw every apply), bumped to the snapshot point on a full
+        # install (per-key history before it is unknown).
+        self._mod_idx: dict[bytes, int] = {}
+        self._del_idx: dict[bytes, int] = {}
+        self.delta_floor = 0
+        # Streamable snapshot "rope" (snapshot_stream_size /
+        # read_snapshot_chunk): the snapshot image as a FROZEN list of
+        # byte frames REFERENCING the live value objects — capture is
+        # O(#keys) with zero value copies, so a 100 MB snapshot never
+        # materializes (the b"".join under the node lock stalled
+        # heartbeats for hundreds of ms at that scale and deposed the
+        # leader on every capture).  ``dump_generation`` bumps on every
+        # rebuild; ``pin_dump_reader`` hands out a reader over the
+        # frozen rope for off-tick streams and compaction.
+        self._mutations = 0
+        self.dump_generation = 0
+        self._rope = None          # (frames, starts, total, mutations)
 
     def apply(self, idx: int, cmd: bytes) -> bytes | None:
         op = cmd[:1]
@@ -39,13 +61,145 @@ class KvsStateMachine(StateMachine):
         key, payload = rest[:klen], rest[klen:]
         if op == b"P":
             self.store[key] = payload
+            self._mutations += 1
+            if idx:
+                self._mod_idx[key] = idx
+                self._del_idx.pop(key, None)
             return b"OK"
         if op == b"G":
             return self.store.get(key, b"")
         if op == b"D":
             self.store.pop(key, None)
+            self._mutations += 1
+            if idx:
+                self._mod_idx.pop(key, None)
+                self._del_idx[key] = idx
             return b"OK"
         raise ValueError(f"bad kvs op {op!r}")
+
+    # -- streamable snapshot rope (zero-copy capture) ----------------------
+
+    def _build_rope(self) -> tuple:
+        """Byte-identical to ``create_snapshot().data`` as a frame
+        list: per item ``<klen>:<key><vlen>:<value>`` with the VALUE
+        frames aliasing the live (immutable) bytes objects.  Frozen
+        once built — later mutations replace the rope, never edit it."""
+        frames: list[bytes] = []
+        starts: list[int] = []
+        total = 0
+        for k, v in sorted(self.store.items()):
+            for f in (b"%d:%s%d:" % (len(k), k, len(v)), v):
+                frames.append(f)
+                starts.append(total)
+                total += len(f)
+        return frames, starts, total, self._mutations
+
+    def _fresh_rope(self) -> tuple:
+        if self._rope is None or self._rope[3] != self._mutations:
+            self._rope = self._build_rope()
+            self.dump_generation += 1
+        return self._rope
+
+    def snapshot_stream_size(self) -> int:
+        """Chunked-stream capture hook (see core.node
+        make_snapshot_stream_meta): the image size at the current
+        apply point.  Called under the node lock, like every apply —
+        the rope the size refers to is frozen at this moment."""
+        return self._fresh_rope()[2]
+
+    @staticmethod
+    def _rope_read(rope: tuple, off: int, n: int) -> bytes:
+        import bisect
+        frames, starts, total, _ = rope
+        if off >= total:
+            return b""
+        n = min(n, total - off)
+        i = bisect.bisect_right(starts, off) - 1
+        out = []
+        got = 0
+        while got < n and i < len(frames):
+            f = frames[i]
+            lo = off + got - starts[i]
+            take = f[lo:lo + (n - got)]
+            out.append(take)
+            got += len(take)
+            i += 1
+        return b"".join(out)
+
+    def read_snapshot_chunk(self, off: int, n: int) -> bytes:
+        # Serve the EXISTING rope, never rebuild here: a rebuild would
+        # bump the generation AFTER the caller's fence check passed and
+        # hand it bytes of a different capture (torn stream).  The
+        # generation fence upstream aborts streams whose rope was
+        # replaced by a later capture.
+        rope = self._rope if self._rope is not None \
+            else self._fresh_rope()
+        return self._rope_read(rope, off, n)
+
+    def pin_dump_reader(self):
+        """Reader over the CURRENT frozen rope, immune to later
+        rebuilds — the off-tick stream/compaction pin (the fd-dup
+        analog of dump-file SMs).  Pins the EXISTING rope (no rebuild:
+        the caller just generation-checked it against its capture —
+        rebuilding here would pin a newer image than the captured
+        metadata)."""
+        rope = self._rope if self._rope is not None \
+            else self._fresh_rope()
+        return lambda off, n: self._rope_read(rope, off, n)
+
+    # -- delta snapshots (models.sm contract) ------------------------------
+
+    def delta_since(self, base_idx: int) -> bytes | None:
+        """Keys modified after ``base_idx``, as ``u8 kind | key blob
+        [| value blob]`` records (kind P=put, D=delete), or None when
+        the base predates our tracked history."""
+        import struct
+        if base_idx < self.delta_floor:
+            return None
+        out = []
+        for k, i in self._mod_idx.items():
+            if i > base_idx:
+                v = self.store[k]
+                out.append(b"P" + struct.pack("<I", len(k)) + k
+                           + struct.pack("<I", len(v)) + v)
+        for k, i in self._del_idx.items():
+            if i > base_idx:
+                out.append(b"D" + struct.pack("<I", len(k)) + k)
+        return b"".join(out)
+
+    def apply_snapshot_delta(self, snap: Snapshot) -> None:
+        """Merge a delta produced by ``delta_since`` into the live
+        store (the receiver half; base-determinant equality is checked
+        by the caller, Node.install_snapshot)."""
+        import struct
+        self._mutations += 1
+        buf = snap.data
+        off = 0
+        while off < len(buf):
+            kind = buf[off:off + 1]
+            off += 1
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            k = buf[off:off + klen]
+            off += klen
+            if kind == b"P":
+                (vlen,) = struct.unpack_from("<I", buf, off)
+                off += 4
+                self.store[k] = buf[off:off + vlen]
+                off += vlen
+                self._mod_idx[k] = snap.last_idx
+                self._del_idx.pop(k, None)
+            elif kind == b"D":
+                self.store.pop(k, None)
+                self._mod_idx.pop(k, None)
+                self._del_idx[k] = snap.last_idx
+            else:
+                raise ValueError(f"bad delta record kind {kind!r}")
+        # Stamping merged keys at snap.last_idx is conservative-exact:
+        # their true modification indices lie in (base, last_idx], so
+        # any later delta_since(b >= delta_floor) still includes every
+        # key modified after b (at worst a few extra).  The floor is
+        # unchanged — history below it was already unknown.
 
     def query(self, cmd: bytes) -> bytes | None:
         """GET without logging (linearizable-read path).  GET is
@@ -61,10 +215,27 @@ class KvsStateMachine(StateMachine):
 
     def apply_snapshot(self, snap: Snapshot) -> None:
         self.store = {}
+        # Full replace: per-key modification history before the
+        # snapshot point is unknown — deltas can only build on bases at
+        # or past it.  The rope is stale too.
+        self._mod_idx = {}
+        self._del_idx = {}
+        self.delta_floor = snap.last_idx
+        self._mutations += 1
+        # Index-based parse, O(total): the old split-and-reslice loop
+        # copied the remaining buffer per item — O(items x size), which
+        # at a 100 MB image turned the receiver's install into minutes
+        # of memcpy under its lock (peers then evicted it as dead).
         buf = snap.data
-        while buf:
-            klen_s, buf = buf.split(b":", 1)
-            k, buf = buf[:int(klen_s)], buf[int(klen_s):]
-            vlen_s, buf = buf.split(b":", 1)
-            v, buf = buf[:int(vlen_s)], buf[int(vlen_s):]
+        off = 0
+        end = len(buf)
+        while off < end:
+            j = buf.index(b":", off)
+            klen = int(buf[off:j])
+            k = buf[j + 1:j + 1 + klen]
+            off = j + 1 + klen
+            j = buf.index(b":", off)
+            vlen = int(buf[off:j])
+            v = buf[j + 1:j + 1 + vlen]
+            off = j + 1 + vlen
             self.store[k] = v

@@ -38,6 +38,11 @@ class Snapshot:
     data_path: str | None = None
     data_len: int = 0
     data_gen: int = 0
+    #: LOCAL-ONLY delta marker: when set, ``data`` is a state DELTA on
+    #: top of this (idx, term) applied determinant, not a full image —
+    #: persistence must record it as a delta record (replayed via
+    #: ``apply_snapshot_delta``), never as a full snapshot record.
+    delta_base: "tuple[int, int] | None" = None
 
 
 class StateMachine:
@@ -57,6 +62,32 @@ class StateMachine:
 
     def apply_snapshot(self, snap: Snapshot) -> None:
         raise NotImplementedError
+
+    # -- delta snapshots (large-state recovery plane) ---------------------
+    #
+    # A rejoining member that presents its last applied (idx, term)
+    # can be primed with only the STATE DELTA past that point instead
+    # of the full image, when the SM's tracked history permits.
+    # Contract: ``delta_since(base_idx)`` returns an opaque delta blob
+    # covering (base_idx, current apply point], or None when base_idx
+    # predates ``delta_floor`` (history not tracked that far back —
+    # the caller falls back to a full push).
+    # ``apply_snapshot_delta(snap)`` merges such a blob into live
+    # state; the base-determinant equality check is the CALLER's job
+    # (Node.install_snapshot) — two committed prefixes at the same
+    # determinant are identical, so merge-on-match is exact.
+
+    #: Earliest base index ``delta_since`` can serve (the compaction
+    #: floor of the SM's tracked modification history).
+    delta_floor: int = 0
+
+    def delta_since(self, base_idx: int) -> bytes | None:
+        """Default: no delta support — always a full push."""
+        return None
+
+    def apply_snapshot_delta(self, snap: Snapshot) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no delta-install path")
 
     def apply_snapshot_file(self, snap: Snapshot, path: str,
                             adopt: bool = False) -> str | None:

@@ -383,11 +383,13 @@ class FaultPlane(Transport):
         return self.inner.log_bulk_read(target, start, stop)
 
     def snap_push(self, target: int, writer_sid, snap, ep_dump,
-                  cid=None, member_addrs=None) -> WriteResult:
+                  cid=None, member_addrs=None,
+                  delta_base=None) -> WriteResult:
         if not self._pre(target):
             return WriteResult.DROPPED
         return self.inner.snap_push(target, writer_sid, snap, ep_dump,
-                                    cid, member_addrs)
+                                    cid, member_addrs,
+                                    delta_base=delta_base)
 
     def snap_push_stream(self, target: int, *args, **kwargs):
         if not self._pre(target):

@@ -284,9 +284,16 @@ class PeerServer:
             ep_dump = wire.decode_ep_dump(r)
             cid = wire.decode_cid(r)
             members = wire.decode_members(r)
+            # Optional trailing delta header (wire.SNAPF_DELTA): the
+            # blob is a state DELTA on top of the receiver's applied
+            # determinant, not a full image.  Absent on old frames.
+            delta_base = None
+            if r.remaining >= 17 and r.u8() & wire.SNAPF_DELTA:
+                delta_base = (r.u64(), r.u64())
             res = onesided.apply_snap_push(
                 node, writer, snap, ep_dump,
-                cid if cid.size > 0 else None, members)
+                cid if cid.size > 0 else None, members,
+                delta_base=delta_base)
             return wire.u8(_ST_OF_RESULT[res])
         if op == wire.OP_SNAP_BEGIN:
             writer = Sid.unpack(r.u64())
@@ -295,16 +302,23 @@ class PeerServer:
             ep_dump = wire.decode_ep_dump(r)
             cid = wire.decode_cid(r)
             members = wire.decode_members(r)
-            res = onesided.apply_snap_begin(
+            res, resume = onesided.apply_snap_begin(
                 node, writer, total, meta, ep_dump,
                 cid if cid.size > 0 else None, members)
-            return wire.u8(_ST_OF_RESULT[res])
+            # Reply carries the RESUME OFFSET: the sender starts its
+            # chunk loop there instead of at byte zero (the whole
+            # point of the resumable stream).
+            return wire.u8(_ST_OF_RESULT[res]) + wire.u64(resume)
         if op == wire.OP_SNAP_CHUNK:
             writer = Sid.unpack(r.u64())
             off = r.u64()
             data = r.blob()
-            res = onesided.apply_snap_chunk(node, writer, off, data)
-            return wire.u8(_ST_OF_RESULT[res])
+            # Optional trailing CRC32 of the chunk (torn/flipped wire
+            # or disk bytes surface here, not at install).
+            crc = r.u32() if r.remaining >= 4 else None
+            res, acked = onesided.apply_snap_chunk(node, writer, off,
+                                                   data, crc=crc)
+            return wire.u8(_ST_OF_RESULT[res]) + wire.u64(acked)
         if op == wire.OP_SNAP_END:
             writer = Sid.unpack(r.u64())
             res = onesided.apply_snap_end(node, writer)
@@ -662,12 +676,19 @@ class NetTransport(Transport):
         return wire.decode_entries(wire.Reader(resp[1:]))
 
     def snap_push(self, target: int, writer_sid: Sid, snap,
-                  ep_dump: list, cid=None, member_addrs=None) -> WriteResult:
+                  ep_dump: list, cid=None, member_addrs=None,
+                  delta_base=None) -> WriteResult:
         payload = (wire.u8(wire.OP_SNAP_PUSH) + wire.u64(writer_sid.word)
                    + wire.encode_value(snap) + wire.encode_ep_dump(ep_dump)
                    + wire.encode_cid(cid if cid is not None
                                      else Cid.initial(0))
                    + wire.encode_members(member_addrs or {}))
+        if delta_base is not None:
+            # Delta snapshot (see wire.SNAPF_DELTA): snap.data is the
+            # state delta past the receiver's applied determinant.
+            payload += (wire.u8(wire.SNAPF_DELTA)
+                        + wire.u64(delta_base[0])
+                        + wire.u64(delta_base[1]))
         # Snapshots get a 2 s floor on top of _roundtrip's generic
         # payload scaling: the receiver persists the whole state before
         # replying, which costs more than the transfer alone.
@@ -684,13 +705,21 @@ class NetTransport(Transport):
     def snap_push_stream(self, target: int, writer_sid: Sid, meta_snap,
                          ep_dump: list, cid, member_addrs, total: int,
                          read_chunk) -> WriteResult:
-        """Chunked form of snap_push for large dumps: BEGIN (metadata)
-        -> N x CHUNK (read_chunk(off, n) supplies bytes, typically a
-        pread of the SM's on-disk record dump) -> END (installs with
-        snap_push's exact fence/staleness semantics).  The pusher never
-        holds more than one chunk in RAM — the whole-blob snap_push
-        materializes O(history) on the leader, whose GC pauses then
-        wobble elections at deep history."""
+        """Chunked RESUMABLE form of snap_push for large dumps: BEGIN
+        (metadata) -> N x CHUNK (read_chunk(off, n) supplies bytes,
+        typically a pread of the SM's on-disk record dump) -> END
+        (installs with snap_push's exact fence/staleness semantics).
+        The pusher never holds more than one chunk in RAM — the
+        whole-blob snap_push materializes O(history) on the leader,
+        whose GC pauses then wobble elections at deep history.
+
+        Resume: BEGIN's reply carries the receiver's verified progress
+        for this stream identity — after a sender restart, receiver
+        restart, or transient partition the chunk loop STARTS THERE
+        instead of at byte zero (stats: snap_resumes, resumed_bytes).
+        Each chunk ships with its CRC32 and the reply acks the
+        receiver's durable progress (stats: snap_chunks_sent/acked)."""
+        import zlib
         payload = (wire.u8(wire.OP_SNAP_BEGIN) + wire.u64(writer_sid.word)
                    + wire.u64(total) + wire.encode_value(meta_snap)
                    + wire.encode_ep_dump(ep_dump)
@@ -704,7 +733,16 @@ class NetTransport(Transport):
         res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
         if res != WriteResult.OK:
             return res
-        off = 0
+        rr = wire.Reader(resp[1:])
+        off = rr.u64() if rr.remaining >= 8 else 0
+        if off:
+            if off > total:              # corrupt reply: start over
+                off = 0
+            else:
+                self.stats["snap_resumes"] = \
+                    self.stats.get("snap_resumes", 0) + 1
+                self.stats["snap_resumed_bytes"] = \
+                    self.stats.get("snap_resumed_bytes", 0) + off
         while off < total:
             n = min(self.SNAP_CHUNK_BYTES, total - off)
             data = read_chunk(off, n)
@@ -712,14 +750,23 @@ class NetTransport(Transport):
                 return WriteResult.DROPPED
             payload = (wire.u8(wire.OP_SNAP_CHUNK)
                        + wire.u64(writer_sid.word) + wire.u64(off)
-                       + wire.blob(data))
+                       + wire.blob(data)
+                       + wire.u32(zlib.crc32(data) & 0xFFFFFFFF))
+            self.stats["snap_chunks_sent"] = \
+                self.stats.get("snap_chunks_sent", 0) + 1
             resp = self._roundtrip(target, payload)
             if resp is None:
                 return WriteResult.DROPPED
             res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
             if res != WriteResult.OK:
                 return res
-            off += n
+            self.stats["snap_chunks_acked"] = \
+                self.stats.get("snap_chunks_acked", 0) + 1
+            rr = wire.Reader(resp[1:])
+            acked = rr.u64() if rr.remaining >= 8 else off + n
+            # The receiver acks its durable progress: normally off+n;
+            # a duplicate-span retry acks FORWARD past our cursor.
+            off = acked if off < acked <= total else off + n
         # END: the receiver reads, installs, and persists the whole
         # assembled state before replying — allow well beyond the
         # normal cap (heartbeats pause for the duration on the pusher's

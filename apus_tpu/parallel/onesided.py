@@ -58,8 +58,10 @@ def apply_log_write(node: Node, writer_sid: Sid, entries: list[LogEntry],
 
 def apply_log_read_state(node: Node) -> LogState:
     log = node.log
+    ai, at = node._applied_det
     return LogState(commit=log.commit, end=log.end,
-                    nc_determinants=log.nc_determinants())
+                    nc_determinants=log.nc_determinants(),
+                    applied_idx=ai, applied_term=at)
 
 
 def apply_log_set_end(node: Node, writer_sid: Sid,
@@ -81,84 +83,291 @@ def apply_log_bulk_read(node: Node, start: int,
 
 def apply_snap_push(node: Node, writer_sid: Sid, snap: Any,
                     ep_dump: list, cid: Any = None,
-                    member_addrs: dict | None = None) -> WriteResult:
+                    member_addrs: dict | None = None,
+                    delta_base: "tuple[int, int] | None" = None
+                    ) -> WriteResult:
     """Install a leader-pushed snapshot.  Fence-checked exactly like log
     writes (it rewrites the log base); staleness is rejected inside
-    install_snapshot."""
+    install_snapshot.  ``delta_base`` marks snap.data as a state DELTA
+    on top of the receiver's applied determinant — refused (sender
+    falls back to a full image) unless the determinant still matches
+    exactly."""
     if not node.regions.log_write_allowed(writer_sid):
         return WriteResult.FENCED
-    if not node.install_snapshot(snap, ep_dump, cid, member_addrs):
-        # Stale snapshot (target's commit is already past it): surface
-        # the refusal so the pusher re-reads our real state instead of
-        # assuming we now sit at snap.last_idx.
+    if not node.install_snapshot(snap, ep_dump, cid, member_addrs,
+                                 delta_base=delta_base):
+        # Stale snapshot (target's commit is already past it) or a
+        # delta whose base no longer matches: surface the refusal so
+        # the pusher re-reads our real state / falls back to a full
+        # image instead of assuming we now sit at snap.last_idx.
         return WriteResult.REFUSED
     return WriteResult.OK
 
 
-# -- chunked snapshot stream (OP_SNAP_BEGIN/CHUNK/END) --------------------
-# One in-flight assembly per node; a new BEGIN replaces a stale session
-# (the pusher serializes its own stream, and a leadership change mid-
-# stream surfaces as FENCED on the next chunk/end).  The blob assembles
-# into a temp file so the receiver too holds at most one chunk in RAM
-# until install time.
+# -- chunked RESUMABLE snapshot stream (OP_SNAP_BEGIN/CHUNK/END) ----------
+# One in-flight assembly per node.  The partial blob assembles into a
+# DETERMINISTICALLY-NAMED file in the spool dir plus a checkpoint
+# sidecar (JSON: stream identity + cumulative CRC32 at every received
+# chunk boundary), so the receiver holds at most one chunk in RAM until
+# install time AND a stream interrupted by sender restart, receiver
+# restart, or a transient partition RESUMES from the last acked chunk:
+# a new BEGIN with the same identity (sender slot, last_idx, last_term,
+# total) verifies the partial file against its checkpoints, truncates
+# to the longest clean prefix, and answers the resume offset — never a
+# restart from byte zero.  A torn or bit-flipped partial file
+# quarantines (fresh start, counted) instead of wedging or installing
+# garbage.  Identity safety: our SM dumps are deterministic functions
+# of the applied prefix and the captured [0, total) prefix of a given
+# sender is immutable (append-only dump / immutable blob), so equal
+# identity => byte-identical stream; per-chunk CRCs guard the wire.
 
-def _snap_session_drop(node: Node) -> None:
+def _snap_spool_path(node: Node) -> "tuple[str | None, str | None]":
+    """(part_path, meta_path) in the spool dir, or (None, None) when no
+    spool dir exists (in-memory/in-process clusters: the session is
+    then resumable only within this process' lifetime, via tempfile).
+    Preference: the SM's own dump directory (adoption is then a
+    same-filesystem rename), else the runtime-provided spool
+    (``node.snap_spool_dir`` — the daemon points it at its db dir)."""
+    import os
+    spool_dir = None
+    spool = getattr(node.sm, "snapshot_spool_dir", None)
+    if spool is not None:
+        spool_dir = spool()
+    if spool_dir is None:
+        spool_dir = getattr(node, "snap_spool_dir", None)
+    if spool_dir is None:
+        return None, None
+    base = os.path.join(spool_dir, f"apus-snap-in-{node.idx}.part")
+    return base, base + ".meta"
+
+
+def _snap_session_close(node: Node) -> None:
+    """Close the in-memory session but KEEP the partial file + meta on
+    disk — the resume anchor for the next BEGIN."""
     sess = getattr(node, "_snap_stream_in", None)
     if sess is not None:
         try:
             sess["f"].close()
         except OSError:
             pass
+    node._snap_stream_in = None
+
+
+def _snap_session_drop(node: Node) -> None:
+    """Discard the session AND its on-disk partial (fresh start:
+    foreign identity, corruption quarantine, or successful install)."""
+    import os
+    sess = getattr(node, "_snap_stream_in", None)
+    paths = []
+    if sess is not None:
         try:
-            import os
-            os.unlink(sess["path"])
+            sess["f"].close()
+        except OSError:
+            pass
+        paths = [sess["path"], sess.get("meta_path")]
+    else:
+        part, meta = _snap_spool_path(node)
+        paths = [part, meta]
+    for p in paths:
+        if not p:
+            continue
+        try:
+            os.unlink(p)
         except OSError:
             pass
     node._snap_stream_in = None
 
 
+def _snap_meta_write(sess: dict) -> None:
+    """Checkpoint the session's progress next to the partial file
+    (atomic replace): identity + cumulative CRC at each chunk boundary.
+    Best-effort — a lost checkpoint only shrinks the resumable prefix."""
+    import json
+    import os
+    mp = sess.get("meta_path")
+    if not mp:
+        return
+    tmp = mp + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"ident": sess["ident"], "got": sess["got"],
+                       "crcs": sess["crcs"]}, f)
+        os.replace(tmp, mp)
+    except OSError:
+        pass
+
+
+def _snap_resume_offset(node: Node, part: str, meta_path: str,
+                        ident: list) -> int:
+    """Longest clean resumable prefix of an on-disk partial: identity
+    must match, and the file's cumulative CRC32 must agree with the
+    recorded checkpoint at each boundary (computed in one streaming
+    pass).  A torn tail resumes from the last intact boundary; a
+    bit-flip inside the prefix fails every later checkpoint and
+    quarantines down to the last boundary BEFORE the damage (possibly
+    0 — a full re-fetch, never an install of damaged bytes)."""
+    import json
+    import os
+    import zlib
+    try:
+        with open(meta_path) as f:
+            rec = json.load(f)
+        if rec.get("ident") != ident:
+            return 0
+        crcs = [(int(o), int(c)) for o, c in rec.get("crcs", [])]
+    except (OSError, ValueError, TypeError):
+        return 0
+    if not crcs:
+        return 0
+    try:
+        size = os.path.getsize(part)
+    except OSError:
+        return 0
+    good = 0
+    crc = 0
+    pos = 0
+    try:
+        with open(part, "rb") as f:
+            for off, want in crcs:
+                if off > size:
+                    break
+                while pos < off:
+                    chunk = f.read(min(1 << 20, off - pos))
+                    if not chunk:
+                        return good
+                    crc = zlib.crc32(chunk, crc)
+                    pos += len(chunk)
+                if (crc & 0xFFFFFFFF) != (want & 0xFFFFFFFF):
+                    break
+                good = off
+    except OSError:
+        return 0
+    return good
+
+
 def apply_snap_begin(node: Node, writer_sid: Sid, total: int,
                      meta_snap: Any, ep_dump: list, cid: Any,
-                     member_addrs: dict | None) -> WriteResult:
-    """Open an assembly session.  Same fence gate as SNAP_PUSH — a
-    deposed leader cannot even begin a stream."""
+                     member_addrs: dict | None
+                     ) -> "tuple[WriteResult, int]":
+    """Open (or RESUME) an assembly session; returns (result,
+    resume_offset) — the sender starts its chunk loop at the offset.
+    Same fence gate as SNAP_PUSH — a deposed leader cannot even begin a
+    stream."""
+    import os
     import tempfile
 
     if not node.regions.log_write_allowed(writer_sid):
-        return WriteResult.FENCED
-    _snap_session_drop(node)
-    # Assemble NEXT TO the SM's own dump when it has one: adoption is
-    # then a same-filesystem rename (os.replace raises EXDEV across
-    # filesystems — the default TMPDIR is commonly tmpfs while the
-    # spill lives on disk, and assembling a multi-GB dump on tmpfs
-    # would also re-consume the RAM the streaming avoids).
-    spool_dir = None
-    spool = getattr(node.sm, "snapshot_spool_dir", None)
-    if spool is not None:
-        spool_dir = spool()
-    f = tempfile.NamedTemporaryFile(prefix="apus-snap-in-", delete=False,
-                                    dir=spool_dir)
+        return WriteResult.FENCED, 0
+    ident = [writer_sid.idx, meta_snap.last_idx, meta_snap.last_term,
+             total]
+    sess = getattr(node, "_snap_stream_in", None)
+    part, meta_path = _snap_spool_path(node)
+    resume = 0
+    if sess is not None and sess["ident"] == ident:
+        # Same stream re-opened (sender-side retry after a transient
+        # failure): keep the bytes, hand back the progress.  The
+        # session's own paths win — they may be a tempfile when no
+        # spool dir exists.
+        resume = sess["got"]
+        part, meta_path = sess["path"], sess.get("meta_path")
+        _snap_session_close(node)
+    elif part is not None and os.path.exists(part) \
+            and os.path.exists(meta_path):
+        # Receiver restarted (or session closed) mid-stream: the
+        # partial file survived in the spool dir — verify and resume.
+        _snap_session_close(node)
+        resume = _snap_resume_offset(node, part, meta_path, ident)
+        if resume == 0:
+            # Foreign identity or damaged prefix: quarantine (count
+            # the damage case loudly) and start over.
+            try:
+                with open(meta_path) as f:
+                    import json as _json
+                    stale = _json.load(f).get("ident")
+            except (OSError, ValueError):
+                stale = None
+            if stale == ident:
+                node.stats["snap_chunk_quarantines"] = \
+                    node.stats.get("snap_chunk_quarantines", 0) + 1
+            _snap_session_drop(node)
+    else:
+        _snap_session_drop(node)
+
+    crcs: list = []
+    if resume:
+        import zlib
+        node.stats["snap_stream_resumes"] = \
+            node.stats.get("snap_stream_resumes", 0) + 1
+        with open(part, "r+b") as tf:
+            tf.truncate(resume)
+        f = open(part, "r+b")
+        f.seek(resume)
+        # Rebuild the cumulative-CRC chain root so later checkpoints
+        # extend the verified prefix.
+        crc = 0
+        with open(part, "rb") as rf:
+            left = resume
+            while left:
+                chunk = rf.read(min(1 << 20, left))
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+                left -= len(chunk)
+        crcs = [(resume, crc & 0xFFFFFFFF)]
+    elif part is not None:
+        f = open(part, "w+b")
+    else:
+        # No spool dir: assemble next to nothing — tempfile (resumable
+        # only while this process lives, via the in-memory session).
+        f = tempfile.NamedTemporaryFile(prefix="apus-snap-in-",
+                                        delete=False)
+        part, meta_path = f.name, None
     node._snap_stream_in = {
-        "sid": writer_sid.word, "total": total, "got": 0,
-        "meta": meta_snap, "ep_dump": ep_dump, "cid": cid,
-        "members": member_addrs, "f": f, "path": f.name,
+        "sid": writer_sid.word, "ident": ident, "total": total,
+        "got": resume, "meta": meta_snap, "ep_dump": ep_dump,
+        "cid": cid, "members": member_addrs, "f": f, "path": part,
+        "meta_path": meta_path, "crcs": crcs,
     }
-    return WriteResult.OK
+    _snap_meta_write(node._snap_stream_in)
+    return WriteResult.OK, resume
 
 
 def apply_snap_chunk(node: Node, writer_sid: Sid, off: int,
-                     data: bytes) -> WriteResult:
-    if not node.regions.log_write_allowed(writer_sid):
-        _snap_session_drop(node)
-        return WriteResult.FENCED
+                     data: bytes, crc: "int | None" = None
+                     ) -> "tuple[WriteResult, int]":
+    """Append one chunk; returns (result, acked_offset).  A duplicate
+    of an already-received span (sender retry after a lost reply) acks
+    forward instead of failing; a CRC mismatch quarantines the partial
+    and refuses (the sender's next BEGIN re-fetches from byte zero —
+    never wedges, never installs flipped bits)."""
     sess = getattr(node, "_snap_stream_in", None)
-    if sess is None or sess["sid"] != writer_sid.word \
-            or off != sess["got"] or off + len(data) > sess["total"]:
-        _snap_session_drop(node)
-        return WriteResult.REFUSED          # no/foreign/torn session
+    if not node.regions.log_write_allowed(writer_sid):
+        _snap_session_close(node)
+        return WriteResult.FENCED, 0
+    if sess is None or sess["sid"] != writer_sid.word:
+        return WriteResult.REFUSED, 0       # no/foreign session
+    if crc is not None:
+        import zlib
+        if (zlib.crc32(data) & 0xFFFFFFFF) != (crc & 0xFFFFFFFF):
+            node.stats["snap_chunk_quarantines"] = \
+                node.stats.get("snap_chunk_quarantines", 0) + 1
+            _snap_session_drop(node)
+            return WriteResult.REFUSED, 0   # damaged on the wire
+    if off + len(data) <= sess["got"]:
+        return WriteResult.OK, sess["got"]  # duplicate: ack forward
+    if off != sess["got"] or off + len(data) > sess["total"]:
+        # Out-of-order / overlong: close (keep bytes for resume).
+        _snap_session_close(node)
+        return WriteResult.REFUSED, 0
+    import zlib
     sess["f"].write(data)
+    sess["f"].flush()
     sess["got"] += len(data)
-    return WriteResult.OK
+    prev = sess["crcs"][-1][1] if sess["crcs"] else 0
+    sess["crcs"].append((sess["got"],
+                         zlib.crc32(data, prev) & 0xFFFFFFFF))
+    _snap_meta_write(sess)
+    return WriteResult.OK, sess["got"]
 
 
 def apply_snap_end(node: Node, writer_sid: Sid) -> WriteResult:
@@ -168,20 +377,28 @@ def apply_snap_end(node: Node, writer_sid: Sid) -> WriteResult:
     more than one chunk resident — completing what the pusher-side
     streaming started.  The reference installs from its disk-backed
     BDB dump the same way (proxy.c:306-339)."""
+    import os
     sess = getattr(node, "_snap_stream_in", None)
     if sess is None or sess["sid"] != writer_sid.word \
             or sess["got"] != sess["total"]:
-        _snap_session_drop(node)
+        _snap_session_close(node)
         return WriteResult.REFUSED
     if not node.regions.log_write_allowed(writer_sid):
-        _snap_session_drop(node)
+        _snap_session_close(node)
         return WriteResult.FENCED
     sess["f"].flush()
     sess["f"].close()
     ok = node.install_snapshot(sess["meta"], sess["ep_dump"],
                                sess["cid"], sess["members"],
                                data_path=sess["path"], adopt=True)
-    # _snap_session_drop's unlink is a no-op if the SM adopted (renamed)
-    # the file, and the needed cleanup otherwise.
+    # The checkpoint sidecar is dead either way; _snap_session_drop's
+    # unlink of the part file is a no-op if the SM adopted (renamed)
+    # it, and the needed cleanup otherwise.
+    mp = sess.get("meta_path")
+    if mp:
+        try:
+            os.unlink(mp)
+        except OSError:
+            pass
     _snap_session_drop(node)
     return WriteResult.OK if ok else WriteResult.REFUSED

@@ -117,11 +117,13 @@ class SimTransport(Transport):
         return onesided.apply_log_bulk_read(self.nodes[target], start, stop)
 
     def snap_push(self, target: int, writer_sid: Sid, snap,
-                  ep_dump: list, cid=None, member_addrs=None) -> WriteResult:
+                  ep_dump: list, cid=None, member_addrs=None,
+                  delta_base=None) -> WriteResult:
         if not self._reachable(target):
             return WriteResult.DROPPED
         return onesided.apply_snap_push(self.nodes[target], writer_sid,
-                                        snap, ep_dump, cid, member_addrs)
+                                        snap, ep_dump, cid, member_addrs,
+                                        delta_base=delta_base)
 
 
 class Cluster:

@@ -100,11 +100,19 @@ class WriteResult(enum.Enum):
 class LogState:
     """Snapshot of a remote log's offsets + NC determinants, as read by
     the leader during adjustment (LR_GET_WRITE/NCE steps,
-    dare_ibv_rc.c:1292-1451)."""
+    dare_ibv_rc.c:1292-1451).
+
+    ``applied_idx``/``applied_term`` carry the target's last APPLIED
+    determinant — the base a delta snapshot can build on (the rejoining
+    member "presents its last applied (epoch, index)"; the leader ships
+    only the state delta past it when its compaction floor permits).
+    (0, 0) from pre-delta peers: delta-ineligible, full push."""
 
     commit: int
     end: int
     nc_determinants: list[tuple[int, int]]
+    applied_idx: int = 0
+    applied_term: int = 0
 
 
 class Transport:
@@ -174,11 +182,16 @@ class Transport:
 
     def snap_push(self, target: int, writer_sid: Sid, snap: Any,
                   ep_dump: list, cid: Any = None,
-                  member_addrs: Optional[dict] = None) -> WriteResult:
+                  member_addrs: Optional[dict] = None,
+                  delta_base: Optional[tuple] = None) -> WriteResult:
         """Install a snapshot on a lagging/joining peer (leader-driven
         form of the reference's snapshot recovery, rc_recover_sm
         dare_ibv_rc.c:603-689).  Fence-checked like log writes.
         ``cid``/``member_addrs`` carry the snapshot-point configuration
         (CONFIG entries inside the covered prefix are never applied by
-        the installer)."""
+        the installer).  ``delta_base=(idx, term)`` marks snap.data as
+        a state DELTA on top of the receiver's applied determinant —
+        the receiver refuses (REFUSED) unless its determinant still
+        matches exactly, and the sender then falls back to a full
+        image."""
         raise NotImplementedError

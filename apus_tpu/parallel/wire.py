@@ -52,6 +52,12 @@ OP_SNAP_BEGIN = 10
 OP_SNAP_CHUNK = 11
 OP_SNAP_END = 12
 
+#: SNAP_PUSH trailing-flags bit: the payload is a DELTA on top of the
+#: receiver's applied determinant (u64 base_idx + u64 base_term follow
+#: the flag byte); the receiver refuses unless its applied determinant
+#: matches exactly — the sender then falls back to a full image.
+SNAPF_DELTA = 1
+
 # -- response status ------------------------------------------------------
 ST_OK = 0
 ST_DROPPED = 1
@@ -289,6 +295,10 @@ def encode_log_state(s: LogState) -> bytes:
     for idx, term in s.nc_determinants:
         out.append(u64(idx))
         out.append(u64(term))
+    # Applied determinant (delta-snapshot base; see transport.LogState).
+    # Trailing so pre-delta readers simply stop before it.
+    out.append(u64(s.applied_idx))
+    out.append(u64(s.applied_term))
     return b"".join(out)
 
 
@@ -296,7 +306,11 @@ def decode_log_state(r: Reader) -> LogState:
     commit, end = r.u64(), r.u64()
     n = struct.unpack("<H", r.take(2))[0]
     nc = [(r.u64(), r.u64()) for _ in range(n)]
-    return LogState(commit=commit, end=end, nc_determinants=nc)
+    # Absent on frames from pre-delta peers: (0, 0) = delta-ineligible.
+    applied_idx = r.u64() if r.remaining >= 16 else 0
+    applied_term = r.u64() if r.remaining >= 8 else 0
+    return LogState(commit=commit, end=end, nc_determinants=nc,
+                    applied_idx=applied_idx, applied_term=applied_term)
 
 
 # -- framing --------------------------------------------------------------

@@ -104,6 +104,17 @@ class RelayStateMachine(StateMachine):
         #: deposed leader's own dump gets rewritten by the new
         #: leader's snapshot push mid-stream.
         self.dump_generation = 0
+        # Delta-snapshot bookkeeping: the relay dump is an append-only
+        # deterministic function of the applied prefix, so the delta
+        # past a rejoiner's applied determinant is simply the DUMP
+        # SUFFIX appended after it.  ``_idx_offsets`` maps applied log
+        # index -> dump byte offset BEFORE that record (bounded ring;
+        # the oldest retained index is the delta floor).  A full
+        # install anchors the floor at the snapshot point.
+        self._idx_offsets: collections.deque = \
+            collections.deque(maxlen=self.DELTA_TRACK_CAP)
+        self.delta_floor = 0
+        self._delta_anchor: tuple[int, int] = (0, 0)  # (idx, offset)
         if spill_path:
             os.makedirs(os.path.dirname(spill_path) or ".",
                         exist_ok=True)
@@ -113,7 +124,21 @@ class RelayStateMachine(StateMachine):
         else:
             self._f = None
 
+    #: applied-index watermarks retained for delta production (one
+    #: tuple per applied record; beyond the cap the delta floor rises
+    #: — older bases fall back to a full push).  Sized to the same
+    #: order as the store's compaction retention (a few MB of RAM).
+    DELTA_TRACK_CAP = 1 << 16
+
     def apply(self, idx: int, cmd: bytes) -> bytes:
+        if idx:
+            before = (self._f.tell() if self._f is not None
+                      else self.record_bytes + 4 * self.record_count)
+            self._idx_offsets.append((idx, before))
+            if len(self._idx_offsets) == self._idx_offsets.maxlen:
+                # Ring full: the floor is now the oldest retained base.
+                self.delta_floor = max(self.delta_floor,
+                                       self._idx_offsets[0][0])
         if self._f is not None:
             self._f.write(struct.pack("<I", len(cmd)) + cmd)
         else:
@@ -157,6 +182,73 @@ class RelayStateMachine(StateMachine):
         assert self._f is not None
         return os.dup(self._f.fileno())
 
+    # -- delta snapshots (models.sm contract) ------------------------------
+
+    def _dump_size(self) -> int:
+        if self._f is None:
+            return self.record_bytes + 4 * self.record_count
+        self._f.flush()
+        return os.fstat(self._f.fileno()).st_size
+
+    def delta_since(self, base_idx: int) -> bytes | None:
+        """The dump SUFFIX appended after applied index ``base_idx`` —
+        the relay dump is append-only and deterministic in the applied
+        prefix, so this IS the state delta a rejoiner at that
+        determinant needs.  None when the base predates the tracked
+        watermark window (full push instead)."""
+        if base_idx < self.delta_floor:
+            return None
+        size = self._dump_size()
+        off = size
+        for idx, before in self._idx_offsets:
+            if idx > base_idx:
+                off = before
+                break
+        if off >= size:
+            return b""
+        if self._f is not None:
+            return os.pread(self._f.fileno(), size - off, off)
+        # In-memory mode: walk records backward until the suffix
+        # reaches ``off`` (frames are 4-byte-length-prefixed).
+        acc = 0
+        take = []
+        for rec in reversed(self.records):
+            if size - acc <= off:
+                break
+            take.append(rec)
+            acc += 4 + len(rec)
+        return b"".join(struct.pack("<I", len(r)) + r
+                        for r in reversed(take))
+
+    def apply_snapshot_delta(self, snap: Snapshot) -> None:
+        """Merge a ``delta_since`` blob: APPEND the record frames to
+        the dump (no replace — the append-only invariant and any
+        pinned reader fds stay intact; dump_generation unchanged) and
+        advance the gauges.  Per-record indices inside the delta span
+        are unknown, so delta tracking re-anchors at the snapshot
+        point."""
+        added = 0
+        off = 0
+        buf = snap.data
+        recs = []
+        while off < len(buf):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            recs.append(buf[off:off + n])
+            off += n
+            added += 1
+        if self._f is not None:
+            self._f.seek(0, os.SEEK_END)
+            self._f.write(buf)
+            self._f.flush()
+        else:
+            self.records.extend(recs)
+        self.record_count += added
+        self.record_bytes += sum(len(r) for r in recs)
+        self._idx_offsets.clear()
+        self.delta_floor = snap.last_idx
+        self._delta_anchor = (snap.last_idx, self._dump_size())
+
     def iter_records(self) -> list[bytes]:
         """The full record dump, mode-independent — what the Bridge's
         snapshot prime, dirty-app reprime, and deep-NACK fallback
@@ -192,6 +284,11 @@ class RelayStateMachine(StateMachine):
         self.record_count = 0
         self.record_bytes = 0
         self.dump_generation += 1
+        # Full replace: per-record history before the snapshot point
+        # is unknown — deltas re-anchor there.
+        self._idx_offsets.clear()
+        self.delta_floor = snap.last_idx
+        self._delta_anchor = (snap.last_idx, 0)
         if self._f is not None:
             # Replace, NEVER truncate in place: a background snapshot
             # stream may hold a dup'd fd of the old dump (dup_dump_fd)
@@ -240,6 +337,9 @@ class RelayStateMachine(StateMachine):
         self.record_count = 0
         self.record_bytes = 0
         self.dump_generation += 1
+        self._idx_offsets.clear()
+        self.delta_floor = snap.last_idx
+        self._delta_anchor = (snap.last_idx, 0)
         spill = self._f.name
         self._f.close()
         if adopt:

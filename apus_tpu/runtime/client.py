@@ -98,6 +98,10 @@ def make_client_ops(daemon) -> dict:
         from apus_tpu.core.types import EntryType
         with daemon.lock:
             n = daemon.node
+            # Sender-side snapshot-stream counters live on the REAL
+            # transport (the fault plane proxies everything else).
+            _t = daemon.transport
+            _tstats = getattr(getattr(_t, "inner", _t), "stats", {})
             config_in_flight = any(e.type == EntryType.CONFIG
                                    for e in n.log.entries(n.log.apply))
             st = {
@@ -143,6 +147,42 @@ def make_client_ops(daemon) -> dict:
                 "snapshots_pushed": n.stats.get("snapshots_pushed", 0),
                 "snapshots_installed": n.stats.get(
                     "snapshots_installed", 0),
+                # Snapshot-transfer view (large-state recovery plane):
+                # chunk progress + resume counters from the SENDER
+                # transport, receiver-side stream resumes/quarantines,
+                # delta-snapshot traffic both ways, per-peer push
+                # generations, and the store's compaction floor — so
+                # the churn nemesis and wait helpers assert RESUME
+                # (never restart-from-zero) behavior over the wire
+                # instead of log-scraping.
+                "snap_chunks_sent": _tstats.get("snap_chunks_sent", 0),
+                "snap_chunks_acked": _tstats.get("snap_chunks_acked",
+                                                 0),
+                "snap_resumes": _tstats.get("snap_resumes", 0),
+                "snap_resumed_bytes": _tstats.get("snap_resumed_bytes",
+                                                  0),
+                "snap_stream_resumes_rx": n.stats.get(
+                    "snap_stream_resumes", 0),
+                "snap_chunk_quarantines": n.stats.get(
+                    "snap_chunk_quarantines", 0),
+                "snap_push_abandoned": n.stats.get(
+                    "snap_push_abandoned", 0),
+                "snap_generation": dict(n._snap_push_gen),
+                "delta_snapshots": n.stats.get("delta_snapshots", 0),
+                "delta_installs": n.stats.get("delta_installs", 0),
+                "delta_refused": n.stats.get("delta_refused", 0),
+                "compaction_floor": (
+                    daemon.persistence.compaction_floor
+                    if getattr(daemon, "persistence", None) is not None
+                    else 0),
+                "compactions": (
+                    daemon.persistence.compactions
+                    if getattr(daemon, "persistence", None) is not None
+                    else 0),
+                "store_records_since_base": (
+                    daemon.persistence.entries_since_base
+                    if getattr(daemon, "persistence", None) is not None
+                    else None),
                 "incarnation": n.incarnation,
                 "draining": getattr(daemon, "draining", False),
                 "auto_removes": n.stats.get("auto_removes", 0),

@@ -166,6 +166,10 @@ class ReplicaDaemon:
         if db_dir is not None:
             from apus_tpu.runtime.persist import (Persistence,
                                                   daemon_store_path)
+            # Inbound snapshot streams assemble (and survive restarts)
+            # next to the durable store: a transfer interrupted by OUR
+            # crash resumes from the last acked chunk after restart.
+            self.node.snap_spool_dir = db_dir
             self.persistence = Persistence(
                 daemon_store_path(db_dir, idx),
                 sync_policy=getattr(spec, "sync_policy", "batch"),
@@ -204,6 +208,7 @@ class ReplicaDaemon:
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
         self._excl_thread: Optional[threading.Thread] = None
+        self._compact_thread: Optional[threading.Thread] = None
         self._last_role = None
         # Client-facing handlers wait on this instead of polling the
         # lock (K pollers at 0.2 ms would starve the tick thread).
@@ -243,6 +248,13 @@ class ReplicaDaemon:
                              name=f"apus-excl-{self.idx}", daemon=True)
         w.start()
         self._excl_thread = w
+        if self.persistence is not None \
+                and getattr(self.spec, "compact_retain", 0) > 0:
+            cw = threading.Thread(target=self._compaction_watchdog,
+                                  name=f"apus-compact-{self.idx}",
+                                  daemon=True)
+            cw.start()
+            self._compact_thread = cw
         if self.device_driver is not None:
             self.device_driver.start()
         # Arm any loaded fault schedule now that the daemon serves —
@@ -265,13 +277,18 @@ class ReplicaDaemon:
         if hasattr(self.transport, "stop"):
             self.transport.stop()       # fault-plane schedule thread
         self.transport.close()
+        if self._compact_thread is not None:
+            self._compact_thread.join(timeout=2.0)
         if self.persistence is not None:
             self.persistence.close()
-        # Drop any half-assembled inbound snapshot stream (fd + temp
-        # file) — an abandoned session would otherwise outlive us on
-        # disk.
-        from apus_tpu.parallel.onesided import _snap_session_drop
-        _snap_session_drop(self.node)
+        # Close (do NOT delete) any half-assembled inbound snapshot
+        # stream: the partial file + checkpoint sidecar in the spool
+        # dir are the RESUME anchor — our next incarnation hands the
+        # sender its verified progress instead of re-fetching from
+        # byte zero.  (Spool-less nodes leave only a tempfile behind,
+        # reaped with the tempdir.)
+        from apus_tpu.parallel.onesided import _snap_session_close
+        _snap_session_close(self.node)
 
     def begin_drain(self, why: str) -> None:
         """Graceful leave: our removal is COMMITTED cluster-wide
@@ -352,6 +369,40 @@ class ReplicaDaemon:
                                  "%d)", slot, cid.epoch)
             except Exception as e:               # noqa: BLE001
                 self.logger.warning("rejoin attempt failed: %s", e)
+
+    def _compaction_watchdog(self) -> None:
+        """Bounded restart replay: once the durable store accumulates
+        more than ``spec.compact_retain`` records past its last base
+        image, fold the applied prefix into a fresh base (Persistence
+        compaction — see persist.py's phase walkthrough).  The capture
+        and the final swap take the node lock briefly; the O(state)
+        I/O runs here, off the tick thread, while appends queue."""
+        period = max(0.5, getattr(self.spec, "compact_check_period",
+                                  5.0))
+        retain = getattr(self.spec, "compact_retain", 0)
+        while not self._stop.is_set():
+            self._stop.wait(period)
+            if self._stop.is_set() or self.persist_disabled:
+                return
+            p = self.persistence
+            if p is None or p.entries_since_base <= retain:
+                continue
+            cap = None
+            try:
+                with self.lock:
+                    cap = p.begin_compact(self.node)
+                if cap is None:
+                    continue
+                p.prepare_compact(cap)
+                with self.lock:
+                    p.finish_compact(cap)
+            except OSError as exc:
+                # A failed compaction leaves the OLD store authoritative
+                # (abort drains the queued appends back into it) — log
+                # and retry later; never disable persistence for it.
+                self.logger.warning("store compaction failed: %s", exc)
+                with self.lock:
+                    p.abort_compact(cap)
 
     def _run(self) -> None:
         while not self._stop.is_set():

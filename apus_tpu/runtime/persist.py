@@ -35,6 +35,11 @@ SNAPFILE_MAGIC = b"APF1"   # an installed snapshot whose dump lives in a
                            # SIDECAR file next to the store (streamed
                            # installs never materialize the blob, so the
                            # store record carries a filename, not data)
+DELTA_MAGIC = b"APD1"      # an installed DELTA snapshot: state delta on
+                           # top of the preceding record's applied
+                           # determinant (replayed via
+                           # sm.apply_snapshot_delta, never as a full
+                           # image)
 
 
 class Persistence:
@@ -61,18 +66,44 @@ class Persistence:
         if sync_policy not in ("none", "batch", "always"):
             raise ValueError(f"bad sync_policy {sync_policy!r}")
         self.store = open_store(path, prefer_native=prefer_native)
+        self.prefer_native = prefer_native
         self.sync_policy = sync_policy
         self.logger = logger
         self._dirty = False
         #: fsync count (observability; the batch-policy test asserts
         #: syncs << appends under a pipelined burst)
         self.syncs = 0
+        # -- compaction state (see compact()) -----------------------------
+        #: records a restart replay must walk (everything after the
+        #: last FULL snapshot record) — the compaction trigger gauge.
+        self.entries_since_base = 0
+        #: applied index of the last base image folded into the store
+        #: (local compaction or installed snapshot); 0 = raw history.
+        self.compaction_floor = 0
+        #: count of local base-image folds performed this session
+        self.compactions = 0
+        # While a compaction is in flight the live store file is
+        # FROZEN: appends queue here (the tick thread never blocks on
+        # the rewrite) and drain into the new file at the swap.
+        self._compacting = False
+        self._cq: list[bytes] = []
+        self._compact_abort = False
+
+    def _append(self, rec: bytes) -> None:
+        if self._compacting:
+            self._cq.append(rec)
+            return
+        self.store.append(rec)
 
     def on_commit(self, e: LogEntry) -> None:
-        self.store.append(RECORD_MAGIC + wire.encode_entry(e))
+        self._append(RECORD_MAGIC + wire.encode_entry(e))
+        self.entries_since_base += 1
         self._note_appended()
 
     def _note_appended(self) -> None:
+        if self._compacting:
+            self._dirty = True      # queued; synced after the swap
+            return
         if self.sync_policy == "always":
             self._sync()
         elif self.sync_policy == "batch":
@@ -86,7 +117,9 @@ class Persistence:
     def flush_window(self) -> None:
         """One sync per drain window (daemon tick, after the committed
         upcalls drained) — no-op unless the batch policy has unsynced
-        appends."""
+        appends (or while a compaction holds the file frozen)."""
+        if self._compacting:
+            return
         if self.sync_policy == "batch" and self._dirty:
             self._sync()
 
@@ -128,13 +161,35 @@ class Persistence:
         is valid while the SM's dump generation matches snap.data_gen
         (the install captured it); the upcall drain already discards
         stale captures (daemon._drain_upcalls order guarantees a
-        superseding install's record follows)."""
+        superseding install's record follows).
+
+        DELTA installs (snap.delta_base) append a DELTA record — the
+        blob is a state delta on the preceding record's applied
+        determinant, replayed in order via sm.apply_snapshot_delta —
+        never a full snapshot record (that would silently truncate the
+        replayed state to the delta)."""
+        if snap.delta_base is not None:
+            self._append(
+                DELTA_MAGIC + struct.pack(
+                    "<QQQQ", snap.last_idx, snap.last_term,
+                    snap.delta_base[0], snap.delta_base[1])
+                + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
+                + wire.blob(snap.seg) + wire.blob(snap.fence))
+            self.entries_since_base += 1
+            self._note_appended()
+            return
+        # A FULL install supersedes any in-flight local compaction —
+        # abort it (the installed snapshot is the fresher base).
+        if self._compacting:
+            self._compact_abort = True
         if snap.data_path is None:
-            self.store.append(
+            self._append(
                 SNAP_MAGIC + struct.pack("<QQ", snap.last_idx,
                                          snap.last_term)
                 + wire.blob(snap.data) + wire.encode_ep_dump(ep_dump)
                 + wire.blob(snap.seg) + wire.blob(snap.fence))
+            self.entries_since_base = 0
+            self.compaction_floor = snap.last_idx
             self._note_appended()
             return
         # Sidecar names are STORE-scoped (several daemons share a
@@ -146,27 +201,20 @@ class Persistence:
         name = f"{prefix}{snap.last_idx}.{snap.data_gen}.bin"
         side_dir = os.path.dirname(self.store.path) or "."
         sidecar = os.path.join(side_dir, name)
-        tmp = sidecar + ".tmp"
-        # Kernel-side copy (sendfile/copy_file_range via shutil) — this
-        # runs on the daemon's tick thread, so it must be as fast as
-        # the disk allows; the truncate pins the captured immutable
-        # prefix (appends may have grown the live dump since install).
-        import shutil
-        shutil.copyfile(snap.data_path, tmp)
-        if os.path.getsize(tmp) < snap.data_len:
-            raise OSError(
-                f"snapshot dump {snap.data_path} shorter than captured "
-                f"length {snap.data_len}")
-        with open(tmp, "r+b") as f:
-            f.truncate(snap.data_len)
-        os.replace(tmp, sidecar)
+        crc = _copy_sidecar(snap.data_path, sidecar, snap.data_len)
         # Record AFTER the sidecar is durable-named: a crash in between
         # leaves an orphan sidecar (harmless), never a dangling record.
-        self.store.append(
+        # The trailing CRC32 lets replay verify the BASE IMAGE before
+        # applying it — a torn or bit-flipped sidecar quarantines and
+        # re-fetches instead of priming the SM with damaged state.
+        self._append(
             SNAPFILE_MAGIC + struct.pack("<QQQ", snap.last_idx,
                                          snap.last_term, snap.data_len)
             + wire.blob(name.encode()) + wire.encode_ep_dump(ep_dump)
-            + wire.blob(snap.seg) + wire.blob(snap.fence))
+            + wire.blob(snap.seg) + wire.blob(snap.fence)
+            + wire.u32(crc))
+        self.entries_since_base = 0
+        self.compaction_floor = snap.last_idx
         self._note_appended()
         # GC superseded sidecars OF THIS STORE ONLY: replay only ever
         # consults the LAST snapshot record (see replay_into), so
@@ -180,6 +228,188 @@ class Persistence:
                 except OSError:
                     pass
 
+    # -- compaction (base image + retained tail) --------------------------
+    #
+    # A store that only ever appends replays its WHOLE lifetime at
+    # restart.  Compaction folds the applied prefix into a base image
+    # (a snapshot record — sidecar-backed for dump-exposing SMs, inline
+    # blob otherwise) and rewrites the file as [base] + retained tail,
+    # so restart replay is bounded by the RETENTION WINDOW
+    # (ClusterSpec.compact_retain entries), not history length.  The
+    # daemon runs the three phases from a watchdog thread:
+    #
+    #   begin_compact(node)   [under the node lock]  capture the base
+    #       (meta + pinned dump fd, or the cached blob) and freeze the
+    #       live file — subsequent appends queue in RAM;
+    #   prepare_compact(cap)  [no lock]  sidecar copy + new tmp store
+    #       [base record + frozen tail] — all O(state) I/O happens
+    #       here, off the tick thread;
+    #   finish_compact(cap)   [under the node lock]  drain the queued
+    #       appends into the tmp store, fsync, atomically swap files,
+    #       reopen.  O(queue), bounded by the compaction's duration.
+    #
+    # Crash safety: the swap is a single os.replace; a crash before it
+    # leaves the old file intact (plus a harmless orphan tmp/sidecar),
+    # a crash after it finds a complete compacted store.  A FULL
+    # snapshot install racing the compaction aborts it (the install is
+    # the fresher base).
+
+    def begin_compact(self, node) -> "dict | None":
+        """Capture the base image under the caller-held node lock."""
+        if self._compacting:
+            return None
+        sm = node.sm
+        cap: dict = {"tail_from": self.store.count,
+                     "ep_dump": node.epdb.dump()}
+        last_idx, last_term = node._applied_det
+        if last_idx <= 0:
+            return None
+        cap["meta"] = Snapshot(last_idx, last_term, b"",
+                               seg=node._seg.dump(),
+                               fence=node._fence_blob())
+        size_of = getattr(sm, "snapshot_stream_size", None)
+        total = size_of() if size_of is not None else None
+        if total is not None:
+            # Pin the captured image for the off-lock copy: a dup'd fd
+            # (dump-file SMs — installs replace the inode, the fd keeps
+            # the old bytes) or a frozen-rope reader (dump-less SMs).
+            dupper = getattr(sm, "dup_dump_fd", None)
+            pinner = getattr(sm, "pin_dump_reader", None)
+            if dupper is not None:
+                cap["dump_fd"] = dupper()
+                fd = cap["dump_fd"]
+                cap["read"] = (lambda off, n, _fd=fd:
+                               os.pread(_fd, n, off))
+            elif pinner is not None:
+                cap["read"] = pinner()
+            else:
+                return None
+            cap["total"] = total
+            cap["data_gen"] = getattr(sm, "dump_generation", 0)
+        else:
+            snap = sm.create_snapshot(last_idx, last_term)
+            cap["blob"] = snap.data
+        self._compacting = True
+        self._compact_abort = False
+        self._cq = []
+        return cap
+
+    def prepare_compact(self, cap: dict) -> None:
+        """Heavy I/O phase, no lock held: the live store file is frozen
+        (appends queue) so single-threaded reads of it are safe."""
+        import zlib
+        meta = cap["meta"]
+        tmp_path = self.store.path + ".compact"
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        from apus_tpu.utils.store import PyRecordStore
+        tmp = PyRecordStore(tmp_path)
+        if "read" in cap:
+            prefix = os.path.basename(self.store.path) + ".snap."
+            name = f"{prefix}{meta.last_idx}.c{cap['data_gen']}.bin"
+            side_dir = os.path.dirname(self.store.path) or "."
+            sidecar = os.path.join(side_dir, name)
+            stmp = sidecar + ".tmp"
+            crc = 0
+            written = 0
+            with open(stmp, "wb") as dst:
+                while written < cap["total"]:
+                    chunk = cap["read"](written,
+                                        min(self._SNAP_IO_CHUNK,
+                                            cap["total"] - written))
+                    if not chunk:
+                        raise OSError(
+                            f"dump shrank during compaction capture "
+                            f"({written} < {cap['total']})")
+                    dst.write(chunk)
+                    crc = zlib.crc32(chunk, crc)
+                    written += len(chunk)
+                dst.flush()
+                os.fsync(dst.fileno())
+            os.replace(stmp, sidecar)
+            cap["sidecar"] = sidecar
+            tmp.append(
+                SNAPFILE_MAGIC + struct.pack(
+                    "<QQQ", meta.last_idx, meta.last_term, cap["total"])
+                + wire.blob(name.encode())
+                + wire.encode_ep_dump(cap["ep_dump"])
+                + wire.blob(meta.seg) + wire.blob(meta.fence)
+                + wire.u32(crc & 0xFFFFFFFF))
+        else:
+            tmp.append(
+                SNAP_MAGIC + struct.pack("<QQ", meta.last_idx,
+                                         meta.last_term)
+                + wire.blob(cap["blob"])
+                + wire.encode_ep_dump(cap["ep_dump"])
+                + wire.blob(meta.seg) + wire.blob(meta.fence))
+        # Retained tail: every record appended after the capture point
+        # (applied strictly above the base image's determinant).
+        for rec in self.store.records()[cap["tail_from"]:]:
+            tmp.append(rec)
+        tmp.sync()
+        tmp.close()
+        cap["tmp_path"] = tmp_path
+
+    def finish_compact(self, cap: dict) -> bool:
+        """Swap phase, under the caller-held node lock.  Returns True
+        when the compacted store took effect."""
+        try:
+            if self._compact_abort or "tmp_path" not in cap:
+                return False
+            from apus_tpu.utils.store import PyRecordStore
+            tmp = PyRecordStore(cap["tmp_path"])
+            tail = len(self._cq)
+            for rec in self._cq:
+                tmp.append(rec)
+            tmp.sync()
+            tmp.close()
+            self.store.close()
+            os.replace(cap["tmp_path"], self.store.path)
+            self.store = open_store(self.store.path,
+                                    prefer_native=self.prefer_native)
+            self._cq = []
+            self._compacting = False
+            self.entries_since_base = tail
+            self.compaction_floor = cap["meta"].last_idx
+            self.compactions += 1
+            if self.logger is not None:
+                self.logger.info(
+                    "store compacted: base image @ idx %d, %d retained "
+                    "tail records (%d queued during the fold)",
+                    cap["meta"].last_idx, self.store.count - 1, tail)
+            return True
+        finally:
+            self.abort_compact(cap)
+
+    def abort_compact(self, cap: "dict | None") -> None:
+        """Idempotent cleanup: drain any queued appends back into the
+        live store, close pinned fds, remove temp files.  Called on
+        the failure/abort paths AND as finish_compact's finally (a
+        no-op after a successful swap)."""
+        if cap is not None:
+            fd = cap.pop("dump_fd", None)
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        if not self._compacting:
+            return
+        self._compacting = False
+        q, self._cq = self._cq, []
+        for rec in q:
+            try:
+                self.store.append(rec)
+            except OSError:
+                break
+        if cap is not None and "tmp_path" in cap:
+            try:
+                os.unlink(cap["tmp_path"])
+            except OSError:
+                pass
+
     # -- recovery ---------------------------------------------------------
 
     def replay_into(self, sm: StateMachine, epdb: EndpointDB,
@@ -188,7 +418,13 @@ class Persistence:
         next log index to fetch from peers (apply floor).  With
         ``node``, a replayed snapshot's partial-chunk-group buffer is
         restored into the node's reassembler (catch-up may deliver
-        finals whose early chunks predate the snapshot).
+        finals whose early chunks predate the snapshot), AND the
+        node's log/applied determinant are RE-BASED at the replay
+        point — the replica then RESUMES replication from there
+        (presenting its applied determinant, the delta-snapshot base)
+        instead of re-fetching its whole history.  (The replayed store
+        holds only apply-time — hence committed — records, so the
+        re-base is exactly install_snapshot's.)
 
         An UNDECODABLE record (unknown magic / truncated payload —
         corruption the CRC frame did not catch, or a store written by
@@ -197,14 +433,18 @@ class Persistence:
         restart re-read the same bytes), and decoding garbage is
         worse.  The replica starts empty and rejoins via snapshot
         catch-up.  Decoding is validated in a PRE-PASS so the SM and
-        endpoint DB are never left holding half a replay."""
+        endpoint DB are never left holding half a replay.  A base
+        image (snapfile sidecar) that is missing, short, or fails its
+        recorded CRC takes the same quarantine path."""
         recs = self.store.records()
-        # A snapshot record is the FULL state at its point, so replay
-        # starts at the LAST one (cheap magic scan): everything before
-        # it — entries and earlier snapshots alike — is superseded.
-        # This also makes the sidecar GC in on_snapshot sound (earlier
-        # snapfile records' sidecars are never consulted) and keeps
-        # deep-history restarts O(tail), not O(lifetime).
+        # A FULL snapshot record is the whole state at its point, so
+        # replay starts at the LAST one (cheap magic scan): everything
+        # before it — entries, deltas, and earlier snapshots alike —
+        # is superseded.  This also makes the sidecar GC in
+        # on_snapshot sound (earlier snapfile records' sidecars are
+        # never consulted) and keeps deep-history restarts O(retained
+        # tail), not O(lifetime).  DELTA records never restart the
+        # scan — they build on the state before them.
         start = 0
         for i, rec in enumerate(recs):
             if rec[:4] in (SNAP_MAGIC, SNAPFILE_MAGIC):
@@ -217,6 +457,7 @@ class Persistence:
             self.quarantine()
             return 1
         nxt = 1
+        last_det = (0, 0)
         try:
             for kind, payload in decoded:
                 if kind == "entry":
@@ -224,26 +465,44 @@ class Persistence:
                     epdb.note_applied(payload.clt_id, payload.req_id,
                                       payload.idx, reply)
                     nxt = payload.idx + 1
-                else:
+                    last_det = (payload.idx, payload.term)
+                elif kind == "delta":
                     snap, ep_dump = payload
+                    sm.apply_snapshot_delta(snap)
+                    epdb.load(ep_dump)
+                    if node is not None:
+                        from apus_tpu.core.segment import Reassembler
+                        node._seg = Reassembler.load(snap.seg)
+                    nxt = snap.last_idx + 1
+                    last_det = (snap.last_idx, snap.last_term)
+                else:
                     if kind == "snapfile":
+                        snap, ep_dump, crc = payload
                         sidecar = os.path.join(
                             os.path.dirname(self.store.path) or ".",
                             snap.data_path)
+                        _verify_sidecar(sidecar, snap.data_len, crc)
                         # Never adopt: the sidecar must survive for the
                         # NEXT restart too (the SM copies chunk-wise).
-                        sm.apply_snapshot_file(snap, sidecar, adopt=False)
+                        sm.apply_snapshot_file(snap, sidecar,
+                                               adopt=False)
                     else:
+                        snap, ep_dump = payload
                         sm.apply_snapshot(snap)
                     epdb.load(ep_dump)
                     if node is not None:
                         from apus_tpu.core.segment import Reassembler
                         node._seg = Reassembler.load(snap.seg)
                     nxt = snap.last_idx + 1
+                    last_det = (snap.last_idx, snap.last_term)
+                    self.compaction_floor = snap.last_idx
+                if kind != "entry" and node is not None and snap.fence:
+                    node.adopt_fence(snap.fence)
         except OSError as e:
-            # A snapfile record whose sidecar is missing/short (deleted
-            # by hand, ENOSPC'd copy): same policy — quarantine, reset
-            # what the partial apply primed, start empty.
+            # A snapfile record whose sidecar is missing/short/damaged
+            # (deleted by hand, ENOSPC'd copy, bit rot): same policy —
+            # quarantine, reset what the partial apply primed, start
+            # empty.
             if self.logger is not None:
                 self.logger.error("store replay failed mid-apply: %s", e)
             self.quarantine()
@@ -257,10 +516,71 @@ class Persistence:
             except Exception:               # noqa: BLE001
                 pass
             return 1
+        # Replay-cost gauge: records a future restart must walk again.
+        self.entries_since_base = len(decoded) - (
+            1 if decoded and decoded[0][0] in ("snapshot", "snapfile")
+            else 0)
+        if node is not None and last_det[0] > 0:
+            # RE-BASE: the log starts just past the replayed state and
+            # the applied determinant presents it to the leader — the
+            # foundation of bounded catch-up (tail re-replication or a
+            # delta snapshot, never the full history again).
+            node.log.reset(last_det[0] + 1)
+            node._applied_det = last_det
         return nxt
 
     def close(self) -> None:
         self.store.close()
+
+
+def _copy_sidecar(src: str, dst: str, length: int) -> int:
+    """Chunked copy of the immutable [0, length) prefix of ``src`` into
+    ``dst`` (tmp + atomic replace), returning its CRC32 — one chunk
+    resident, ever.  Runs on the daemon's tick thread, so it must be
+    as fast as the disk allows; the length pin freezes the captured
+    prefix (appends may have grown the live dump since install)."""
+    import zlib
+    tmp = dst + ".tmp"
+    crc = 0
+    written = 0
+    with open(src, "rb") as s, open(tmp, "wb") as d:
+        while written < length:
+            chunk = s.read(min(1 << 20, length - written))
+            if not chunk:
+                raise OSError(
+                    f"snapshot dump {src} shorter than captured "
+                    f"length {length}")
+            d.write(chunk)
+            crc = zlib.crc32(chunk, crc)
+            written += len(chunk)
+    os.replace(tmp, dst)
+    return crc & 0xFFFFFFFF
+
+
+def _verify_sidecar(path: str, length: int, crc: "int | None") -> None:
+    """Raise OSError unless the base image at ``path`` is whole: at
+    least ``length`` bytes and (when the record carries a CRC) its
+    [0, length) prefix checksums clean.  The torn/bit-flipped base
+    image then takes the quarantine-and-refetch path instead of
+    priming the SM with damaged state."""
+    import zlib
+    if os.path.getsize(path) < length:
+        raise OSError(f"base image {path} shorter than recorded "
+                      f"length {length}")
+    if crc is None:
+        return
+    got = 0
+    left = length
+    with open(path, "rb") as f:
+        while left:
+            chunk = f.read(min(1 << 20, left))
+            if not chunk:
+                raise OSError(f"base image {path} truncated mid-read")
+            got = zlib.crc32(chunk, got)
+            left -= len(chunk)
+    if (got & 0xFFFFFFFF) != (crc & 0xFFFFFFFF):
+        raise OSError(f"base image {path} fails its recorded CRC "
+                      f"(disk corruption)")
 
 
 def decode_record(rec: bytes):
@@ -286,14 +606,28 @@ def decode_record(rec: bytes):
         ep_dump = wire.decode_ep_dump(r)
         seg = r.blob() if r.remaining else b""
         fence = r.blob() if r.remaining else b""
+        # Trailing base-image CRC32 (absent on pre-CRC records).
+        crc = r.u32() if r.remaining >= 4 else None
         return "snapfile", (Snapshot(last_idx, last_term, b"", seg=seg,
                                      fence=fence,
                                      data_path=name, data_len=data_len),
-                            ep_dump)
+                            ep_dump, crc)
+    if magic == DELTA_MAGIC:
+        last_idx, last_term, base_idx, base_term = \
+            struct.unpack_from("<QQQQ", rec, 4)
+        r = wire.Reader(rec[36:])
+        data = r.blob()
+        ep_dump = wire.decode_ep_dump(r)
+        seg = r.blob() if r.remaining else b""
+        fence = r.blob() if r.remaining else b""
+        return "delta", (Snapshot(last_idx, last_term, data, seg=seg,
+                                  fence=fence,
+                                  delta_base=(base_idx, base_term)),
+                         ep_dump)
     raise ValueError(
         f"unsupported store record format {magic!r} (expected "
-        f"{RECORD_MAGIC!r}, {SNAP_MAGIC!r} or {SNAPFILE_MAGIC!r}); "
-        f"refusing to decode")
+        f"{RECORD_MAGIC!r}, {SNAP_MAGIC!r}, {SNAPFILE_MAGIC!r} or "
+        f"{DELTA_MAGIC!r}); refusing to decode")
 
 
 def daemon_store_path(db_dir: str, idx: int) -> str:

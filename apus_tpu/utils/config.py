@@ -90,6 +90,16 @@ class ClusterSpec:
     # durability
     db_path: str = "apus_records.db"
     req_log: bool = False
+    # Compacting store (runtime.persist compaction): once more than
+    # compact_retain records accumulate past the store's last base
+    # image, the daemon folds the applied prefix into a fresh base
+    # (snapshot record + retained tail), so restart replay — and the
+    # delta-snapshot window — is bounded by the RETENTION WINDOW, not
+    # history length.  0 disables (append-only store, unbounded
+    # replay).  The watchdog polls the gauge every
+    # compact_check_period seconds.
+    compact_retain: int = 20000
+    compact_check_period: float = 5.0
     # fsync policy of the durable record store (runtime.persist):
     # "none" = OS writeback only; "batch" = one fdatasync per
     # group-commit drain window (daemon tick); "always" = per record.

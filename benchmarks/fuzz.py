@@ -505,7 +505,8 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
 
 
 def run_churn_schedule(fault_seed: int, check_linear: bool = True,
-                       minutes: float = 0.0) -> dict:
+                       minutes: float = 0.0,
+                       state_size: int = 0) -> dict:
     """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
     3-replica fault-plane ProcCluster with auto-removal ON, concurrent
     recorded clients (serial + pipelined), and a seeded nemesis that
@@ -533,7 +534,21 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
     live set).  With ``check_linear`` the surviving client history —
     plus a final read round, so a lost acked write across any
     remove-then-rejoin is a violation too — must check linearizable
-    across all traversed config epochs."""
+    across all traversed config epochs.
+
+    ``state_size`` > 0 runs the LARGE-STATE variant (the recovery
+    plane's fault surface): the keyspace is pre-populated to roughly
+    that many bytes (32 KB values), so every catch-up in the trial
+    moves real state through the chunked resumable snapshot stream —
+    and a mid-stream nemesis watches OP_STATUS for an in-flight push
+    and (seeded) SIGKILLs the RECEIVER (the joiner, re-admitted
+    afterwards — its partial spool file survives in the shared db
+    dir) or lets the leader-kill arm take the SENDER.  The trial then
+    asserts the transfer COMPLETED and membership never wedged, and
+    reports the snap_resumes / chunk counters it observed (resume vs
+    restart evidence banked per trial; the stream identity legally
+    rotates when the snapshot point advances under load, so a hard
+    resume assertion lives in the paused-load ladder + e2e tests)."""
     import tempfile
     import threading
     import time as _time
@@ -558,7 +573,9 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
     recorder = HistoryRecorder(capacity=1 << 18) if check_linear else None
     stop = threading.Event()
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
-             "leader_kills": 0}
+             "leader_kills": 0, "receiver_kills": 0, "snap_resumes": 0,
+             "snap_chunks_acked": 0, "delta_snapshots": 0,
+             "chunkfile_faults": 0}
 
     def worker(wid: int, peers: list) -> None:
         wrng = random.Random((fault_seed << 4) ^ wid)
@@ -619,12 +636,34 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                          fault_seed=fault_seed) as pc:
             peers = list(pc.spec.peers)
             _dbg("cluster up")
+            if state_size > 0:
+                # Pre-populate ~state_size bytes of KVS state (32 KB
+                # values, pipelined) so every later catch-up ships a
+                # real multi-chunk snapshot stream.
+                val = bytes(32768)
+                nkeys = max(1, state_size // len(val))
+                with ApusClient(peers, timeout=60.0) as c:
+                    for lo in range(0, nkeys, 16):
+                        c.pipeline_puts(
+                            [(b"bulk%06d" % i, val)
+                             for i in range(lo, min(lo + 16, nkeys))])
+                _dbg(f"pre-populated {nkeys} x {len(val)} B")
             threads = [threading.Thread(target=worker, args=(w, peers),
                                         daemon=True)
                        for w in range(3)]
             for t in threads:
                 t.start()
             _time.sleep(0.5)
+
+            def snap_stat_sum(field: str) -> int:
+                tot = 0
+                for i in range(len(pc.procs)):
+                    if pc.procs[i] is None:
+                        continue
+                    st = pc.status(i, timeout=0.5)
+                    if st:
+                        tot += st.get(field, 0) or 0
+                return tot
 
             # Phase 1: low-grade network fault burst on a random member
             # — stays armed through the first churn so the join ladder
@@ -638,9 +677,15 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             _dbg("phase1 net fault armed")
 
             # Phase 2: JOIN under load, usually with the leader killed
-            # while the resize ladder is in flight.
+            # while the resize ladder is in flight.  Large-state
+            # trials pick a MID-STREAM victim instead: the SENDER
+            # (leader-kill arm below) or the RECEIVER (killed once the
+            # leader reports the push in flight, then re-admitted).
+            mid_kill = rng.choice(["receiver", "sender", "none"]) \
+                if state_size > 0 else None
             killed: list[int] = []
-            if rng.random() < 0.7:
+            if (mid_kill == "sender"
+                    or (mid_kill is None and rng.random() < 0.7)):
                 delay = rng.uniform(0.0, 0.15)
 
                 def kill_leader_soon() -> None:
@@ -657,11 +702,60 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                 kt.start()
             else:
                 kt = None
-            slot = pc.add_replica(timeout=90.0)
+            slot = pc.add_replica(timeout=120.0)
             churn["joins"] += 1
             if kt is not None:
                 kt.join(timeout=10.0)
             _dbg(f"phase2 joined slot {slot}; leader killed: {killed}")
+            if mid_kill == "receiver":
+                # Kill the RECEIVER mid-stream: wait for the leader to
+                # report the push to the joiner in flight, SIGKILL the
+                # joiner's process group, let the failure detector
+                # reclaim the slot (PR 5 abort/evict machinery), then
+                # re-admit a fresh incarnation — which shares the db
+                # dir, so its partial spool file lets the re-push
+                # RESUME when the snapshot point held still.  The hard
+                # invariants here: the transfer eventually COMPLETES
+                # and membership never wedges.
+                deadline = _time.monotonic() + 30.0
+                seen_push = False
+                while _time.monotonic() < deadline:
+                    try:
+                        lead = pc.leader_idx(timeout=5.0)
+                    except AssertionError:
+                        continue
+                    st = pc.status(lead, timeout=0.5) or {}
+                    if slot in (st.get("snap_pushing") or []):
+                        seen_push = True
+                        break
+                    if slot in st.get("members", []) \
+                            and not st.get("mid_resize"):
+                        break            # catch-up already done
+                    _time.sleep(0.02)
+                if seen_push and slot < len(pc.procs) \
+                        and pc.procs[slot] is not None:
+                    pc.kill(slot)
+                    churn["receiver_kills"] += 1
+                    _dbg(f"killed receiver {slot} mid-stream")
+                    # Seeded disk fault on the PARTIAL CHUNK FILE while
+                    # the receiver is down: the resumed BEGIN must
+                    # verify its checkpoints, quarantine the damage,
+                    # and re-fetch — never wedge, never install
+                    # flipped bits.
+                    part = os.path.join(td, "db",
+                                        f"apus-snap-in-{slot}.part")
+                    disk = rng.choice(["torn", "crc", "none"])
+                    if disk != "none" and os.path.exists(part):
+                        _disk_surgery(part, disk, rng)
+                        churn["chunkfile_faults"] = \
+                            churn.get("chunkfile_faults", 0) + 1
+                        _dbg(f"chunk-file {disk} fault injected")
+                    wait_evicted(pc, slot, timeout=60.0)
+                    churn["auto_removes"] += 1
+                    slot2 = pc.add_replica(timeout=120.0)
+                    churn["joins"] += 1
+                    wait_member(pc, slot2, timeout=90.0)
+                    _dbg(f"receiver re-admitted at {slot2}")
 
             # Phase 3: AUTO-REMOVE + rejoin.  The leader kill above (or
             # an explicit follower SIGKILL) is evicted by the failure
@@ -709,6 +803,14 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             pc.wait_converged(timeout=60.0)
             view = pc.wait_config_converged(timeout=60.0)
             _dbg(f"converged: {view}")
+            # Snapshot-transfer evidence over the wire (resume vs
+            # restart-from-zero), summed across live replicas.
+            churn["snap_resumes"] = (
+                snap_stat_sum("snap_resumes")
+                + snap_stat_sum("snap_stream_resumes_rx"))
+            churn["snap_chunks_acked"] = \
+                snap_stat_sum("snap_chunks_acked")
+            churn["delta_snapshots"] = snap_stat_sum("delta_snapshots")
             ops_checked = 0
             if recorder is not None:
                 with ApusClient(list(pc.spec.peers), timeout=10.0,
@@ -798,6 +900,16 @@ def main() -> int:
                          "composes with --check-linear (recorded "
                          "clients + per-key linearizability check "
                          "across config epochs)")
+    ap.add_argument("--state-size", type=int, default=0,
+                    help="with --churn: pre-populate roughly this many "
+                         "BYTES of KVS state (32 KB values) so every "
+                         "catch-up ships a real multi-chunk snapshot "
+                         "stream, and arm the mid-stream nemesis "
+                         "(SIGKILL the sender or receiver while the "
+                         "push is in flight; the transfer must "
+                         "complete — resumed when the snapshot point "
+                         "held still — and membership must never "
+                         "wedge).  Suggested: 10000000 (10 MB)")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -816,7 +928,9 @@ def main() -> int:
         + (["--device-plane"] if args.device_plane else []) \
         + (["--auto-remove"] if args.auto_remove else []) \
         + (["--churn"] if args.churn else []) \
-        + (["--check-linear"] if args.check_linear else [])
+        + (["--check-linear"] if args.check_linear else []) \
+        + (["--state-size", str(args.state_size)]
+           if args.state_size else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
@@ -827,15 +941,20 @@ def main() -> int:
              "recorded": 0, "seeds": []}
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
              "leader_kills": 0, "configs_traversed": 0,
-             "ops_checked": 0, "seeds": []}
+             "ops_checked": 0, "receiver_kills": 0, "snap_resumes": 0,
+             "snap_chunks_acked": 0, "delta_snapshots": 0,
+             "chunkfile_faults": 0, "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
             if args.churn:
                 st = run_churn_schedule(fault_seed,
-                                        check_linear=args.check_linear)
+                                        check_linear=args.check_linear,
+                                        state_size=args.state_size)
                 for k in ("joins", "auto_removes", "graceful_leaves",
                           "leader_kills", "configs_traversed",
-                          "ops_checked"):
+                          "ops_checked", "receiver_kills",
+                          "snap_resumes", "snap_chunks_acked",
+                          "delta_snapshots", "chunkfile_faults"):
                     churn[k] += st.get(k, 0)
                 churn["seeds"].append(fault_seed)
                 r = "ok"
@@ -902,6 +1021,7 @@ def main() -> int:
                    # convergence) are both trial FAILURES, so they are
                    # structurally 0 on a clean run.
                    **({"churn": {**churn,
+                                 "state_size": args.state_size,
                                  "violations": len(failures),
                                  "wedges": len(failures)}}
                       if args.churn else {})},

@@ -341,6 +341,236 @@ def proc_graceful_leave(n: int, writes: int) -> dict:
         }
 
 
+# -- rejoin-under-load ladder (large-state recovery plane) -----------------
+
+def _snap_sum(pc, field: str) -> int:
+    tot = 0
+    for i in range(len(pc.procs)):
+        if pc.procs[i] is None:
+            continue
+        st = pc.status(i, timeout=0.5)
+        if st:
+            tot += st.get(field, 0) or 0
+    return tot
+
+
+def _wait_member_caught_up(pc, slot: int, timeout: float) -> float:
+    """Seconds until ``slot`` is a member whose apply has reached the
+    leader's commit (the rejoin-complete criterion)."""
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            lead = pc.leader_idx(timeout=5.0)
+        except AssertionError:
+            continue
+        lst = pc.status(lead, timeout=1.0)
+        vst = pc.status(slot, timeout=1.0)
+        if lst and vst and slot in lst.get("members", []) \
+                and vst.get("apply", 0) >= lst.get("commit", 1) > 1 \
+                and not lst.get("mid_resize"):
+            return time.perf_counter() - t0
+        time.sleep(0.05)
+    raise AssertionError(
+        f"slot {slot} not caught up within {timeout}s")
+
+
+def rejoin_ladder(state_mbs, kill_mid_stream: bool = True) -> list:
+    """Rejoin-under-load ladder: at each state size, measure (a) the
+    FULL-PUSH rejoin (fresh joiner, wiped store — the whole image
+    rides the chunked resumable stream) and (b) the DELTA rejoin (a
+    restarted member replays its durable store, presents its applied
+    determinant, and receives only the key-delta since it), under a
+    light concurrent writer.  The recovery-plane claim is the SHAPE:
+    delta rejoin stays flat-ish while full push grows with state.
+
+    With ``kill_mid_stream`` the top rung additionally SIGKILLs the
+    receiver while the full push is in flight (writer paused, so the
+    snapshot identity holds still), re-admits it, and asserts the
+    transfer RESUMED from the last acked chunk (snap_resumes over
+    OP_STATUS) instead of restarting from byte zero."""
+    import shutil
+    import threading
+
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    val = bytes(32768)
+    results = []
+    for mi, mb in enumerate(state_mbs):
+        nkeys = max(1, (mb << 20) // len(val))
+        top = mi == len(state_mbs) - 1
+        with ProcCluster(3) as pc:
+            peers = list(pc.spec.peers)
+            with ApusClient(peers, timeout=120.0) as c:
+                for lo in range(0, nkeys, 16):
+                    c.pipeline_puts(
+                        [(b"bulk%06d" % i, val)
+                         for i in range(lo, min(lo + 16, nkeys))])
+            print(f"[ladder {mb} MB] populated {nkeys} keys",
+                  file=sys.stderr)
+
+            # Light concurrent writer ("under load"), pausable for the
+            # mid-stream-kill resume check.
+            stop = threading.Event()
+            pause = threading.Event()
+            wrote = [0]
+
+            def writer() -> None:
+                with ApusClient(peers, timeout=10.0) as wc:
+                    i = 0
+                    while not stop.is_set():
+                        if pause.is_set():
+                            time.sleep(0.05)
+                            continue
+                        i += 1
+                        try:
+                            wc.put(b"load%d" % i, b"v" * 64)
+                            wrote[0] += 1
+                        except Exception:      # noqa: BLE001
+                            time.sleep(0.1)
+                        time.sleep(0.02)
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+
+            # -- DELTA rejoin: kill a follower, let it be evicted so
+            # pruning passes its position, write a small delta, then
+            # restart it — store replay + delta snapshot catch-up.
+            lead = pc.leader_idx()
+            dvictim = next(i for i in range(3) if i != lead)
+            vst = pc.status(dvictim, timeout=1.0) or {}
+            v_apply = vst.get("apply", 0)
+            pc.kill(dvictim)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                st = pc.status(pc.leader_idx(timeout=10.0), timeout=1.0)
+                if st and dvictim not in st.get("members", [dvictim]):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("delta victim never evicted")
+            with ApusClient([p for i, p in enumerate(peers)
+                             if i != dvictim], timeout=60.0) as c:
+                c.pipeline_puts([(b"delta%04d" % i, val)
+                                 for i in range(32)])
+            # Pruning must pass the victim's old apply point or the
+            # leader serves a plain log tail (no snapshot at all).
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                st = pc.status(pc.leader_idx(timeout=10.0), timeout=1.0)
+                if st and st.get("log_head", 0) > v_apply:
+                    break
+                time.sleep(0.1)
+            deltas0 = _snap_sum(pc, "delta_snapshots")
+            t0 = time.perf_counter()
+            pc.restart(dvictim)
+            _wait_member_caught_up(pc, dvictim, 180.0)
+            t_delta = time.perf_counter() - t0
+            deltas = _snap_sum(pc, "delta_snapshots") - deltas0
+            print(f"[ladder {mb} MB] delta rejoin {t_delta * 1e3:.0f} "
+                  f"ms (delta_snapshots +{deltas})", file=sys.stderr)
+
+            # -- FULL-PUSH rejoin: graceful-leave a follower, wipe its
+            # durable state, re-admit a fresh process — the entire
+            # image rides the chunked stream.
+            lead = pc.leader_idx()
+            fvictim = next(i for i in range(3)
+                           if i != lead and i != dvictim)
+            pc.graceful_leave(fvictim, timeout=60.0)
+            db_dir = os.path.dirname(pc.store_path(fvictim))
+            for name in os.listdir(db_dir):
+                if name.startswith(
+                        os.path.basename(pc.store_path(fvictim))) \
+                        or name == f"apus-snap-in-{fvictim}.part" \
+                        or name == f"apus-snap-in-{fvictim}.part.meta":
+                    try:
+                        os.unlink(os.path.join(db_dir, name))
+                    except OSError:
+                        pass
+            t0 = time.perf_counter()
+            slot = pc.add_replica(timeout=180.0)
+            _wait_member_caught_up(pc, slot, 300.0)
+            t_full = time.perf_counter() - t0
+            chunks = _snap_sum(pc, "snap_chunks_acked")
+            print(f"[ladder {mb} MB] full-push rejoin "
+                  f"{t_full * 1e3:.0f} ms (chunks acked {chunks})",
+                  file=sys.stderr)
+
+            # -- mid-stream receiver kill: the full push must RESUME
+            # (not restart) after the receiver dies and returns.
+            resumed = None
+            if kill_mid_stream and top:
+                pause.set()          # freeze writes: identity stable
+                time.sleep(0.3)
+                lead = pc.leader_idx()
+                kvictim = next(i for i in range(3)
+                               if i != lead)
+                pc.graceful_leave(kvictim, timeout=60.0)
+                db_dir = os.path.dirname(pc.store_path(kvictim))
+                for name in os.listdir(db_dir):
+                    if name.startswith(os.path.basename(
+                            pc.store_path(kvictim))):
+                        try:
+                            os.unlink(os.path.join(db_dir, name))
+                        except OSError:
+                            pass
+                resumes0 = _snap_sum(pc, "snap_resumes") \
+                    + _snap_sum(pc, "snap_stream_resumes_rx")
+                slot2 = pc.add_replica(timeout=180.0)
+                # Kill the receiver once the push is in flight.
+                deadline = time.monotonic() + 60.0
+                seen = False
+                while time.monotonic() < deadline:
+                    st = pc.status(pc.leader_idx(timeout=10.0),
+                                   timeout=0.3)
+                    if st and slot2 in (st.get("snap_pushing") or []) \
+                            and st.get("snap_chunks_sent", 0) > 0:
+                        seen = True
+                        break
+                    time.sleep(0.01)
+                assert seen, "push to the fresh joiner never observed"
+                pc.kill(slot2)
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    st = pc.status(pc.leader_idx(timeout=10.0),
+                                   timeout=1.0)
+                    if st and slot2 not in st.get("members", [slot2]):
+                        break
+                    time.sleep(0.05)
+                slot3 = pc.add_replica(timeout=180.0)
+                _wait_member_caught_up(pc, slot3, 300.0)
+                resumed = (_snap_sum(pc, "snap_resumes")
+                           + _snap_sum(pc, "snap_stream_resumes_rx")
+                           - resumes0)
+                assert resumed >= 1, \
+                    "mid-stream receiver kill: transfer restarted " \
+                    "from byte zero (no resume observed)"
+                print(f"[ladder {mb} MB] mid-stream kill: resumed "
+                      f"({resumed} resume events)", file=sys.stderr)
+                pause.clear()
+
+            stop.set()
+            wt.join(timeout=5.0)
+            results.append({
+                "metric": "rejoin_ladder",
+                "value": round(t_full * 1e3, 1), "unit": "ms",
+                "detail": {
+                    "state_mb": mb,
+                    "full_push_ms": round(t_full * 1e3, 1),
+                    "delta_ms": round(t_delta * 1e3, 1),
+                    "delta_vs_full": round(t_delta / max(t_full, 1e-9),
+                                           3),
+                    "delta_snapshots": deltas,
+                    "chunks_acked": chunks,
+                    "mid_stream_kill_resumes": resumed,
+                    "writer_ops_during": wrote[0],
+                    "envelope": "production hb=1ms elect=10-30ms",
+                },
+            })
+    return results
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=3)
@@ -351,6 +581,23 @@ def main() -> int:
     ap.add_argument("--series", type=int, default=0,
                     help="with --proc: run N kill/restart trials on one "
                          "cluster boot and report p50/p95/p99")
+    ap.add_argument("--ladder", action="store_true",
+                    help="rejoin-under-load ladder (large-state "
+                         "recovery plane): at each --state-mb rung, "
+                         "time the FULL-PUSH rejoin (fresh joiner, "
+                         "chunked resumable stream) vs the DELTA "
+                         "rejoin (restarted member: store replay + "
+                         "key-delta since its applied determinant) "
+                         "under a light writer, and at the top rung "
+                         "SIGKILL the receiver mid-stream and assert "
+                         "the transfer RESUMES from the last acked "
+                         "chunk (snap_resumes over OP_STATUS)")
+    ap.add_argument("--state-mb", default="10,100",
+                    help="with --ladder: comma list of state sizes in "
+                         "MB (default 10,100)")
+    ap.add_argument("--no-midstream-kill", action="store_true",
+                    help="with --ladder: skip the mid-stream receiver "
+                         "kill resume check")
     ap.add_argument("--reconf", action="store_true",
                     help="with --proc: run the reconfiguration "
                          "scenarios (Upsize: grow a FULL group's size "
@@ -360,6 +607,20 @@ def main() -> int:
                          "admission/catch-up rows "
                          "(reconf_bench.sh:147-180)")
     args = ap.parse_args()
+
+    if args.ladder:
+        sizes = [int(x) for x in args.state_mb.split(",") if x]
+        results = rejoin_ladder(
+            sizes, kill_mid_stream=not args.no_midstream_kill)
+        print(f"{'state':<10}{'full push':>12}{'delta':>12}"
+              f"{'delta/full':>12}")
+        for r in results:
+            d = r["detail"]
+            print(f"{d['state_mb']:>6} MB {d['full_push_ms']:>10.0f} ms"
+                  f" {d['delta_ms']:>9.0f} ms {d['delta_vs_full']:>11}")
+        for r in results:
+            print(json.dumps(r))
+        return 0
 
     if args.proc and args.reconf:
         n = max(args.replicas, 3)

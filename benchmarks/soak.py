@@ -93,6 +93,16 @@ def main() -> int:
                          "membership)")
     ap.add_argument("--churn-every", type=float, default=45.0,
                     help="with --churn: seconds between churn events")
+    ap.add_argument("--state-size", type=int, default=0,
+                    help="pre-populate roughly this many BYTES of "
+                         "replicated state through the daemons' client "
+                         "plane (32 KB values, pipelined ApusClient "
+                         "puts) before traffic starts, so every churn "
+                         "rotation's catch-up moves real state through "
+                         "the chunked resumable snapshot stream; the "
+                         "end-of-run summary reports the snapshot-"
+                         "transfer counters (chunks sent/acked, "
+                         "resumes, delta snapshots, compaction floor)")
     ap.add_argument("--pipeline", action="store_true",
                     help="run a SIDE stream of pipelined ApusClient "
                          "windows (64-deep PUT bursts + lease GETs) "
@@ -227,6 +237,20 @@ def main() -> int:
                      spec=mesh_spec, device_plane=args.mesh,
                      tick_interval=args.tick_interval) as pc:
         leader = pc.leader_idx()
+        if args.state_size > 0:
+            # Pre-populate replicated state via the daemons' client
+            # plane (the relay SM appends every record to its dump, so
+            # this grows the snapshot the next catch-up must ship).
+            from apus_tpu.runtime.client import ApusClient
+            val = bytes(32768)
+            nkeys = max(1, args.state_size // len(val))
+            with ApusClient(list(pc.spec.peers), timeout=120.0) as sc:
+                for lo in range(0, nkeys, 16):
+                    sc.pipeline_puts(
+                        [(b"bulk%06d" % i, val)
+                         for i in range(lo, min(lo + 16, nkeys))])
+            print(f"pre-populated ~{nkeys * len(val)} bytes of state",
+                  file=sys.stderr)
         client = mk(pc.app_addr(leader))
 
         def mesh_check():
@@ -550,6 +574,23 @@ def main() -> int:
                     break
                 time.sleep(0.5)
             converged = converged and ok
+        # Snapshot-transfer counters (large-state recovery plane):
+        # summed over live replicas, plus per-replica compaction
+        # floors — the end-of-run evidence that churn catch-up rode
+        # the chunked/delta machinery (and resumed, never restarted).
+        snap_summary = {k: 0 for k in (
+            "snap_chunks_sent", "snap_chunks_acked", "snap_resumes",
+            "snap_stream_resumes_rx", "snap_chunk_quarantines",
+            "delta_snapshots", "delta_installs",
+            "snapshots_pushed", "snapshots_installed")}
+        compaction_floors: dict[int, int] = {}
+        for i in range(len(pc.procs)):
+            if pc.procs[i] is None:
+                continue
+            st = pc.status(i, timeout=1.0) or {}
+            for f in snap_summary:
+                snap_summary[f] += st.get(f, 0) or 0
+            compaction_floors[i] = st.get("compaction_floor", 0)
 
     # Linearizability verdict over the recorded soak stream (the
     # maintenance-gate convergence reads above are deliberately NOT in
@@ -598,6 +639,10 @@ def main() -> int:
             **({"fault_seed": args.fault_seed,
                 "faults_injected": faults_injected}
                if args.fault_seed is not None else {}),
+            "snapshot_transfers": {**snap_summary,
+                                   "compaction_floors":
+                                       compaction_floors,
+                                   "state_size": args.state_size},
             **({"audit": audit_detail}
                if audit_detail is not None else {}),
             **({"mesh": {
@@ -625,7 +670,9 @@ def main() -> int:
               + (" --toyserver" if args.toyserver else "")
               + (" --audit" if args.audit else "")
               + (f" --churn --churn-every {args.churn_every}"
-                 if args.churn else ""),
+                 if args.churn else "")
+              + (f" --state-size {args.state_size}"
+                 if args.state_size else ""),
               file=sys.stderr)
     return 0 if ok else 1
 

@@ -122,7 +122,7 @@ def _run_audit(out, trials: int = 5) -> None:
         _record(out, rec, replicas=3, bench="audit_campaign")
 
 
-def _run_churn(out, trials: int = 5) -> None:
+def _run_churn(out, trials: int = 5, state_size: int = 0) -> None:
     """Membership-churn chaos campaign (fuzz.py --churn
     --check-linear): seeded trials composing joins (leader usually
     SIGKILLed mid-resize), failure-detector evictions + rejoin, and
@@ -131,13 +131,33 @@ def _run_churn(out, trials: int = 5) -> None:
     across the traversed config epochs.  Banks trials / configs
     traversed / ops checked / violations / wedges as one record."""
     print(f"fuzz.py --churn --check-linear: membership churn "
-          f"({trials} trials)")
+          f"({trials} trials"
+          + (f", state {state_size} B" if state_size else "") + ")")
+    argv = [sys.executable,
+            os.path.join(REPO, "benchmarks", "fuzz.py"),
+            "--churn", "--check-linear", "--trials", str(trials)]
+    if state_size:
+        # Large-state variant: every catch-up ships a real multi-chunk
+        # stream and the mid-stream nemesis arms (ISSUE 6).
+        argv += ["--state-size", str(state_size)]
+    for rec in _run_tool(argv, timeout=600 * trials):
+        _record(out, rec, replicas=3,
+                bench="churn_largestate_campaign" if state_size
+                else "churn_campaign")
+
+
+def _run_ladder(out, state_mb: str = "10,100") -> None:
+    """Rejoin-under-load ladder (large-state recovery plane): full-push
+    vs delta rejoin time at each state size, with the top rung's
+    mid-stream receiver-kill resume assertion
+    (reconf_bench.py --ladder)."""
+    print(f"reconf_bench --ladder: rejoin ladder @ {state_mb} MB")
     for rec in _run_tool([sys.executable,
-                          os.path.join(REPO, "benchmarks", "fuzz.py"),
-                          "--churn", "--check-linear",
-                          "--trials", str(trials)],
-                         timeout=300 * trials):
-        _record(out, rec, replicas=3, bench="churn_campaign")
+                          os.path.join(REPO, "benchmarks",
+                                       "reconf_bench.py"),
+                          "--ladder", "--state-mb", state_mb],
+                         timeout=2400):
+        _record(out, rec, replicas=3, bench="rejoin_ladder")
 
 
 def cmd_run(args) -> int:
@@ -156,12 +176,19 @@ def cmd_run(args) -> int:
             return 0
         if getattr(args, "churn_only", False):
             # Fast churn re-campaign: skip the cluster suite.
-            _run_churn(out, trials=getattr(args, "churn_trials", 5))
+            _run_churn(out, trials=getattr(args, "churn_trials", 5),
+                       state_size=getattr(args, "churn_state_size", 0))
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "throughput_only", False):
             # Fast throughput-path re-measure: skip the cluster suite.
             _run_throughput(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "ladder_only", False):
+            # Large-state rejoin ladder only: skip the cluster suite.
+            _run_ladder(out, state_mb=getattr(args, "ladder_mb",
+                                              "10,100"))
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -323,6 +350,11 @@ def cmd_run(args) -> int:
         # 5. Membership-churn campaign (ISSUE 5: joins, evictions,
         # graceful leaves under faults, audited for linearizability).
         _run_churn(out, trials=getattr(args, "churn_trials", 5))
+
+        # 6. Large-state rejoin ladder (ISSUE 6: chunked resumable
+        # catch-up + delta snapshots — full-push vs delta rejoin time,
+        # mid-stream receiver-kill resume asserted at the top rung).
+        _run_ladder(out, state_mb=getattr(args, "ladder_mb", "10,100"))
     print(f"results appended to {RUNS}")
     return 0
 
@@ -483,7 +515,34 @@ def cmd_report(args) -> int:
             f"{_fmt(c.get('ops_checked'))} ops "
             f"linearizability-checked; violations="
             f"{c.get('violations', '?')}, wedges={c.get('wedges', '?')}"
-            f"; seeds {c.get('seeds')}")
+            + (f"; state {_fmt(c.get('state_size'))} B/trial, "
+               f"{c.get('receiver_kills')} receiver kills mid-stream, "
+               f"{c.get('chunkfile_faults', 0)} chunk-file faults, "
+               f"{c.get('snap_resumes')} stream resumes, "
+               f"{c.get('delta_snapshots')} delta snapshots"
+               if c.get("state_size") else "")
+            + f"; seeds {c.get('seeds')}")
+    lad = [r for r in runs if r.get("metric") == "rejoin_ladder"
+           and isinstance(r.get("value"), (int, float))]
+    if lad:
+        # Latest record per rung (state size).
+        rungs: dict = {}
+        for r in lad:
+            rungs[r["detail"].get("state_mb")] = r
+        for mb, r in sorted(rungs.items()):
+            d = r["detail"]
+            lines.append(
+                f"- rejoin ladder @ {mb} MB state: full push "
+                f"{_fmt(d.get('full_push_ms'))} ms vs delta "
+                f"{_fmt(d.get('delta_ms'))} ms "
+                f"(delta/full {d.get('delta_vs_full')}); "
+                f"{_fmt(d.get('chunks_acked'))} chunks acked, "
+                f"{d.get('delta_snapshots')} delta snapshot(s)"
+                + (f", mid-stream kill resumed "
+                   f"({d.get('mid_stream_kill_resumes')} resume "
+                   f"events)"
+                   if d.get("mid_stream_kill_resumes") is not None
+                   else ""))
     glv = [r for r in runs if r.get("metric") == "proc_graceful_leave_time"
            and isinstance(r.get("value"), (int, float))]
     if glv:
@@ -651,6 +710,16 @@ def main() -> int:
                             "skips the cluster suite)")
         p.add_argument("--churn-trials", type=int, default=5,
                        help="seeded churn-campaign trials per run")
+        p.add_argument("--churn-state-size", type=int, default=0,
+                       help="with --churn-only: pre-populate this many "
+                            "BYTES of state per trial and arm the "
+                            "mid-stream nemesis (fuzz --state-size)")
+        p.add_argument("--ladder-only", action="store_true",
+                       help="run ONLY the large-state rejoin ladder "
+                            "(reconf_bench.py --ladder; skips the "
+                            "cluster suite)")
+        p.add_argument("--ladder-mb", default="10,100",
+                       help="rejoin-ladder state sizes, MB comma list")
     p_rep = sub.add_parser("report", help="aggregate results")
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Tier-1 gate wrapper: the EXACT ROADMAP tier-1 command, plus a
+# 1-trial large-state churn smoke (chunked resumable catch-up + delta
+# snapshots under membership churn, linearizability-checked).
+#
+# Usage: scripts/tier1.sh [--no-smoke]
+#
+# The pytest stanza below must stay byte-comparable with ROADMAP.md's
+# "Tier-1 verify" line — it IS the gate the driver runs; this wrapper
+# only adds the recovery-plane smoke on top.
+
+set -u
+cd "$(dirname "$0")/.."
+
+smoke=1
+if [ "${1:-}" = "--no-smoke" ]; then
+    smoke=0
+fi
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then
+    echo "tier-1 FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+if [ "$smoke" -eq 1 ]; then
+    echo "== large-state churn smoke (1 trial, 2 MB state) =="
+    env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \
+        --churn --check-linear --state-size 2000000 --trials 1 \
+        --seed-base 9400
+    src=$?
+    if [ "$src" -ne 0 ]; then
+        echo "large-state churn smoke FAILED (rc=$src)" >&2
+        exit "$src"
+    fi
+fi
+echo "tier1.sh: all green"

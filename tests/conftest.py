@@ -47,3 +47,13 @@ def pytest_configure(config):
         "churn: membership-churn suite — joins/leaves/evictions under "
         "faults (graceful leave, resize abort, incarnation fencing, "
         "churn nemesis slice); selectable with -m churn")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (`-m 'not slow'`); "
+        "minutes-long ladders and campaigns")
+    config.addinivalue_line(
+        "markers",
+        "largestate: large-state recovery-plane suite — chunked "
+        "resumable catch-up, delta snapshots, compacting store; the "
+        "slow ladder e2e carries slow too (out of tier-1); "
+        "selectable with -m largestate")

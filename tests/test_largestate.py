@@ -1,0 +1,626 @@
+"""Large-state recovery plane: chunked resumable catch-up, delta
+snapshots, and the compacting store (ISSUE 6).
+
+Unit + node-level coverage (fast, tier-1):
+  - KVS/Relay delta production & merge (delta_since / apply_snapshot_
+    delta) reconstruct the full state exactly, floors respected;
+  - delta snapshot end-to-end through the sim transport (leader ships
+    only the delta past a lagging member's applied determinant), with
+    the base-mismatch refusal falling back to the full push;
+  - resumable inbound stream: session re-open resumes at the verified
+    offset; receiver "restart" (session closed, spool file on disk)
+    resumes; a torn partial resumes from the last intact checkpoint;
+    a bit-flipped partial (and a wire-CRC mismatch) quarantines and
+    re-fetches from byte zero — never installs damaged bytes;
+  - the stall-backstop regression: a late push completion from a DEAD
+    generation never touches per-peer push state (PR 5 edge);
+  - compaction/replay property: (base image + retained tail) replays
+    to a byte-identical SM and epdb versus full-history replay, for
+    both the native and Python store impls, blob and sidecar bases;
+  - a damaged sidecar base image quarantines the store at replay
+    instead of priming the SM with corrupt state;
+  - restart replay RE-BASES the node's log/applied determinant at the
+    replay point (the bounded-catch-up foundation).
+
+The slower ladder-shaped e2e lives behind the ``largestate`` marker
+(out of tier-1 via ``slow``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+
+import pytest
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.epdb import EndpointDB
+from apus_tpu.core.log import LogEntry
+from apus_tpu.core.node import Node, NodeConfig
+from apus_tpu.core.segment import Reassembler
+from apus_tpu.models.kvs import (KvsStateMachine, encode_delete,
+                                 encode_put)
+from apus_tpu.models.sm import Snapshot
+from apus_tpu.parallel import onesided
+from apus_tpu.parallel.sim import Cluster, SimTransport
+from apus_tpu.parallel.transport import WriteResult
+from apus_tpu.runtime.persist import Persistence, decode_record
+from apus_tpu.utils.store import PyRecordStore
+
+
+# -- delta production & merge ----------------------------------------------
+
+def _kvs_apply(sm: KvsStateMachine, idx: int, cmd: bytes) -> int:
+    sm.apply(idx, cmd)
+    return idx + 1
+
+
+def test_kvs_delta_roundtrip():
+    """A delta past the base determinant, merged into a copy at that
+    base, reconstructs the full state — puts, overwrites, and deletes
+    included."""
+    a, b = KvsStateMachine(), KvsStateMachine()
+    idx = 1
+    for i in range(20):
+        cmd = encode_put(b"k%d" % i, b"v%d" % i)
+        a.apply(idx, cmd)
+        b.apply(idx, cmd)
+        idx += 1
+    base = idx - 1
+    # Diverge a past the base: new keys, overwrites, deletes.
+    a.apply(idx, encode_put(b"new", b"x")); idx += 1
+    a.apply(idx, encode_put(b"k3", b"overwritten")); idx += 1
+    a.apply(idx, encode_delete(b"k7")); idx += 1
+    delta = a.delta_since(base)
+    assert delta is not None and len(delta) > 0
+    # Keys untouched since the base are NOT in the delta.
+    assert b"k1" not in delta
+    b.apply_snapshot_delta(Snapshot(idx - 1, 1, delta))
+    assert b.store == a.store
+
+
+def test_kvs_delta_floor_after_full_install():
+    sm = KvsStateMachine()
+    sm.apply(5, encode_put(b"a", b"1"))
+    full = sm.create_snapshot(10, 1)
+    fresh = KvsStateMachine()
+    fresh.apply_snapshot(full)
+    assert fresh.delta_floor == 10
+    assert fresh.delta_since(3) is None      # below the floor
+    fresh.apply(11, encode_put(b"b", b"2"))
+    d = fresh.delta_since(10)
+    assert d is not None and b"b" in d and b"a" not in d
+
+
+@pytest.mark.parametrize("spill", [False, True])
+def test_relay_delta_is_dump_suffix(tmp_path, spill):
+    from apus_tpu.runtime.bridge import RelayStateMachine
+
+    def mk(tag):
+        return RelayStateMachine(
+            spill_path=str(tmp_path / f"{tag}.bin") if spill else None)
+
+    a, b = mk("a"), mk("b")
+    for i in range(1, 11):
+        rec = b"rec-%02d" % i
+        a.apply(i, rec)
+        b.apply(i, rec)
+    for i in range(11, 16):
+        a.apply(i, b"tail-%02d" % i)
+    delta = a.delta_since(10)
+    assert delta is not None
+    b.apply_snapshot_delta(Snapshot(15, 1, delta))
+    assert b.iter_records() == a.iter_records()
+    assert b.record_count == a.record_count
+    assert b.record_bytes == a.record_bytes
+    # And the merged copy can serve deltas from the new anchor on.
+    b.apply(16, b"post")
+    d2 = b.delta_since(15)
+    assert d2 is not None and b"post" in d2
+    # Bases inside the (unknown-index) merged span are refused.
+    assert b.delta_since(12) is None
+
+
+# -- delta snapshots end-to-end (sim transport) ----------------------------
+
+def _lagging_follower_setup(seed=31):
+    """3-node sim cluster: partition a follower away, commit more
+    state, prune the leader's log past its position — healing then
+    demands a snapshot-shaped catch-up from a member that PRESENTS a
+    real applied determinant (the restart-replay shape: the sim's
+    recover() models a stateless restart, but the durable-store replay
+    path re-bases exactly like a partitioned survivor looks)."""
+    c = Cluster(3, seed=seed, sm_factory=KvsStateMachine,
+                auto_remove=False)
+    leader = c.wait_for_leader()
+    for i in range(8):
+        c.submit(encode_put(b"pre%d" % i, b"v%d" % i))
+    c.run(0.3)
+    victim = next(n for n in c.nodes if n is not leader)
+    others = {n.idx for n in c.nodes if n is not victim}
+    c.transport.partition({victim.idx}, others)
+    for i in range(12):
+        c.submit(encode_put(b"post%d" % i, b"w%d" % i))
+    c.run(0.3)
+    # Manual prune (P2/P3 are leader-policy, not safety): drop the
+    # applied prefix so the victim is behind the head.
+    leader = c.wait_for_leader()
+    leader.log.advance_head(leader.log.apply)
+    assert leader.log.head > victim.log.commit
+    assert victim._applied_det[0] > 0
+    return c, leader, victim
+
+
+def test_delta_snapshot_serves_lagging_member():
+    c, leader, victim = _lagging_follower_setup()
+    c.transport.heal()
+    assert c.run_until(
+        lambda: victim.sm.store.get(b"post11") == b"w11", timeout=20)
+    assert leader.stats.get("delta_snapshots", 0) >= 1, leader.stats
+    assert victim.stats.get("delta_installs", 0) >= 1, victim.stats
+    # Full catch-up: stores converge.
+    assert c.run_until(
+        lambda: victim.sm.store == leader.sm.store, timeout=20)
+
+
+def test_delta_base_mismatch_refused_at_install():
+    """The receiver's exactness gate: a delta whose base no longer
+    matches its applied determinant (it moved between the sender's
+    read and the install) is REFUSED, and the state is untouched."""
+    c, leader, victim = _lagging_follower_setup(seed=77)
+    base = victim._applied_det
+    d = leader.make_snapshot_delta(base[0], base[1])
+    assert d is not None
+    snap, ep, dcid, members, db = d
+    before = dict(victim.sm.store)
+    res = onesided.apply_snap_push(victim, leader.sid.sid, snap, ep,
+                                   dcid, members,
+                                   delta_base=(db[0], db[1] + 1))
+    assert res == WriteResult.REFUSED
+    assert victim.sm.store == before
+    assert victim.stats.get("delta_refused", 0) == 1
+    # The exact base installs fine.
+    res = onesided.apply_snap_push(victim, leader.sid.sid, snap, ep,
+                                   dcid, members, delta_base=db)
+    assert res == WriteResult.OK
+    assert victim.stats.get("delta_installs", 0) == 1
+    assert victim.sm.store.get(b"post11") == b"w11"
+    # And a full catch-up converges after heal regardless.
+    c.transport.heal()
+    assert c.run_until(
+        lambda: victim.sm.store == leader.sm.store, timeout=20)
+
+
+def test_delta_production_refused_on_divergent_base():
+    """The sender's own guard: a base whose term CONFLICTS with the
+    leader's log entry at that index never yields a delta (full push
+    instead) — two histories that disagree at the base cannot merge."""
+    c = Cluster(3, seed=9, sm_factory=KvsStateMachine)
+    leader = c.wait_for_leader()
+    for i in range(6):
+        c.submit(encode_put(b"k%d" % i, b"v"))
+    c.run(0.3)
+    base_idx = leader.log.apply - 2
+    e = leader.log.get(base_idx)
+    assert e is not None
+    assert leader.make_snapshot_delta(base_idx, e.term + 5) is None
+    # The matching term produces one (when anything follows the base).
+    assert leader.make_snapshot_delta(base_idx, e.term) is not None
+
+
+# -- stall-backstop generation regression ----------------------------------
+
+def test_record_push_done_drops_dead_generation():
+    """A late completion from an ABANDONED push generation must not
+    touch per-peer push state — and never clobber a successor's
+    pending completion (the PR 5 stall-backstop edge)."""
+    t = SimTransport()
+    n = Node(NodeConfig(idx=0), Cid.initial(3), KvsStateMachine(), t)
+    peer = 2
+    # The stall backstop abandoned gen 0 and a successor (gen 1) owns
+    # the slot.
+    n._snap_push_gen[peer] = 1
+    n._snap_pushing.add(peer)
+    n._snap_push_started[peer] = 123.0
+    n._record_push_done(peer, 5, WriteResult.OK, 40, push_gen=0)
+    assert peer not in n._snap_push_done          # dropped, not recorded
+    assert peer in n._snap_pushing                # slot still owned
+    assert n._snap_push_started.get(peer) == 123.0
+    assert n.stats.get("snap_push_stale_done") == 1
+    # The successor's completion lands normally...
+    n._record_push_done(peer, 6, WriteResult.OK, 80, push_gen=1)
+    assert n._snap_push_done[peer] == (6, WriteResult.OK, 80, 1)
+    assert peer not in n._snap_pushing
+    # ...and a straggler from the dead generation cannot clobber it
+    # even if it races past the generation check (monotone-gen belt).
+    n._snap_push_gen[peer] = 0            # simulate the racy interleave
+    n._record_push_done(peer, 5, WriteResult.DROPPED, 40, push_gen=0)
+    assert n._snap_push_done[peer] == (6, WriteResult.OK, 80, 1)
+
+
+# -- resumable inbound stream ----------------------------------------------
+
+def _stream_fixture(tmp_path, seed=5):
+    """Elected sim cluster + a follower wired for inbound streams: the
+    leader's fence already grants it log access, and the spool dir is
+    on disk (the receiver-restart resume anchor)."""
+    c = Cluster(3, seed=seed, sm_factory=KvsStateMachine)
+    leader = c.wait_for_leader()
+    c.submit(encode_put(b"w", b"1"))
+    c.run(0.2)
+    follower = next(n for n in c.nodes if n is not leader)
+    follower.snap_spool_dir = str(tmp_path)
+    # Payload: a real KVS snapshot image, chunked by hand.
+    src = KvsStateMachine()
+    for i in range(64):
+        src.apply(i + 1, encode_put(b"big%02d" % i, bytes(997)))
+    snap = src.create_snapshot(80, leader.current_term)
+    meta = dataclasses.replace(snap, data=b"")
+    return c, leader, follower, src, snap, meta
+
+
+CHUNK = 4096
+
+
+def _send_chunks(follower, writer, data, lo, hi):
+    for off in range(lo, hi, CHUNK):
+        blk = data[off:off + CHUNK]
+        res, acked = onesided.apply_snap_chunk(
+            follower, writer, off, blk,
+            crc=zlib.crc32(blk) & 0xFFFFFFFF)
+        assert res == WriteResult.OK
+        assert acked == off + len(blk)
+
+
+def test_stream_resume_after_interruption(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert (res, resume) == (WriteResult.OK, 0)
+    cut = (total // 2 // CHUNK) * CHUNK
+    _send_chunks(follower, writer, snap.data, 0, cut)
+    # Interruption: sender-side failure → stream call ends; the next
+    # BEGIN (same identity) must hand back the verified progress.
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert res == WriteResult.OK
+    assert resume == cut, "resume must start at the last acked chunk"
+    assert follower.stats.get("snap_stream_resumes") == 1
+    _send_chunks(follower, writer, snap.data, resume, total)
+    assert onesided.apply_snap_end(follower, writer) == WriteResult.OK
+    assert follower.sm.store == src.store
+
+
+def test_stream_resume_survives_receiver_restart(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    onesided.apply_snap_begin(follower, writer, total, meta, [], None,
+                              None)
+    cut = 3 * CHUNK
+    _send_chunks(follower, writer, snap.data, 0, cut)
+    # "Restart": the in-memory session dies with the process; the part
+    # file + checkpoint meta in the spool dir survive.
+    onesided._snap_session_close(follower)
+    assert follower._snap_stream_in is None
+    part = os.path.join(str(tmp_path),
+                        f"apus-snap-in-{follower.idx}.part")
+    assert os.path.exists(part) and os.path.exists(part + ".meta")
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert res == WriteResult.OK and resume == cut
+    _send_chunks(follower, writer, snap.data, resume, total)
+    assert onesided.apply_snap_end(follower, writer) == WriteResult.OK
+    assert follower.sm.store == src.store
+    # Install consumed the spool files.
+    assert not os.path.exists(part)
+    assert not os.path.exists(part + ".meta")
+
+
+def test_stream_torn_partial_resumes_at_checkpoint(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    onesided.apply_snap_begin(follower, writer, total, meta, [], None,
+                              None)
+    _send_chunks(follower, writer, snap.data, 0, 4 * CHUNK)
+    onesided._snap_session_close(follower)
+    part = os.path.join(str(tmp_path),
+                        f"apus-snap-in-{follower.idx}.part")
+    # Torn tail: the last chunk half-written at crash.
+    with open(part, "r+b") as f:
+        f.truncate(3 * CHUNK + CHUNK // 2)
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert res == WriteResult.OK
+    assert resume == 3 * CHUNK, "torn tail resumes at last checkpoint"
+    _send_chunks(follower, writer, snap.data, resume, total)
+    assert onesided.apply_snap_end(follower, writer) == WriteResult.OK
+    assert follower.sm.store == src.store
+
+
+def test_stream_flipped_partial_quarantines(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    onesided.apply_snap_begin(follower, writer, total, meta, [], None,
+                              None)
+    _send_chunks(follower, writer, snap.data, 0, 4 * CHUNK)
+    onesided._snap_session_close(follower)
+    part = os.path.join(str(tmp_path),
+                        f"apus-snap-in-{follower.idx}.part")
+    with open(part, "r+b") as f:       # bit rot inside the FIRST chunk
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert res == WriteResult.OK
+    assert resume == 0, "damaged prefix must re-fetch from byte zero"
+    assert follower.stats.get("snap_chunk_quarantines", 0) >= 1
+    _send_chunks(follower, writer, snap.data, 0, total)
+    assert onesided.apply_snap_end(follower, writer) == WriteResult.OK
+    assert follower.sm.store == src.store
+
+
+def test_stream_wire_crc_mismatch_refused(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    onesided.apply_snap_begin(follower, writer, total, meta, [], None,
+                              None)
+    blk = snap.data[:CHUNK]
+    res, _ = onesided.apply_snap_chunk(
+        follower, writer, 0, blk,
+        crc=(zlib.crc32(blk) ^ 1) & 0xFFFFFFFF)
+    assert res == WriteResult.REFUSED
+    assert follower.stats.get("snap_chunk_quarantines", 0) >= 1
+    # Fresh BEGIN starts clean and the transfer still completes.
+    res, resume = onesided.apply_snap_begin(
+        follower, writer, total, meta, [], None, None)
+    assert res == WriteResult.OK and resume == 0
+    _send_chunks(follower, writer, snap.data, 0, total)
+    assert onesided.apply_snap_end(follower, writer) == WriteResult.OK
+
+
+def test_stream_duplicate_chunk_acks_forward(tmp_path):
+    c, leader, follower, src, snap, meta = _stream_fixture(tmp_path)
+    writer = leader.sid.sid
+    total = len(snap.data)
+    onesided.apply_snap_begin(follower, writer, total, meta, [], None,
+                              None)
+    blk = snap.data[:CHUNK]
+    crc = zlib.crc32(blk) & 0xFFFFFFFF
+    onesided.apply_snap_chunk(follower, writer, 0, blk, crc=crc)
+    # Sender retry after a lost reply: the duplicate span acks FORWARD
+    # instead of tearing the session.
+    res, acked = onesided.apply_snap_chunk(follower, writer, 0, blk,
+                                           crc=crc)
+    assert res == WriteResult.OK and acked == CHUNK
+
+
+# -- compaction / replay property ------------------------------------------
+
+def _entry(idx: int, cmd: bytes, term: int = 1, clt: int = 9,
+           rid: int = 0) -> LogEntry:
+    return LogEntry(idx=idx, term=term, req_id=rid or idx, clt_id=clt,
+                    data=cmd)
+
+
+class _NodeStub:
+    """The capture surface begin_compact needs, without a transport."""
+
+    def __init__(self, sm, epdb, det):
+        self.sm = sm
+        self.epdb = epdb
+        self._applied_det = det
+        self._seg = Reassembler()
+
+    def _fence_blob(self) -> bytes:
+        return json.dumps({"1": 7}).encode()
+
+    def adopt_fence(self, fence: bytes) -> None:
+        self.fence = fence
+
+
+@pytest.mark.parametrize("prefer_native", [False, True])
+def test_compaction_replay_property_kvs(tmp_path, prefer_native):
+    """(base image + retained tail) replays to a byte-identical SM and
+    epdb versus full-history replay — blob base (KVS), both store
+    impls."""
+    from tests.test_store import native_available
+    if prefer_native and not native_available():
+        pytest.fail("native store must build in this image")
+    pa = Persistence(str(tmp_path / "a.db"),
+                     prefer_native=prefer_native)
+    pb = Persistence(str(tmp_path / "b.db"),
+                     prefer_native=prefer_native)
+    sm_live, ep_live = KvsStateMachine(), EndpointDB()
+    cmds = [encode_put(b"k%d" % (i % 7), b"v%d" % i) for i in range(30)]
+    cmds += [encode_delete(b"k3")]
+    idx = 1
+    entries = [ _entry(i + 1, c) for i, c in enumerate(cmds) ]
+    split = 18
+    for e in entries[:split]:
+        reply = sm_live.apply(e.idx, e.data)
+        ep_live.note_applied(e.clt_id, e.req_id, e.idx, reply)
+        pa.on_commit(e)
+        pb.on_commit(e)
+    # Fold A: base image at the split point + (empty) retained tail.
+    stub = _NodeStub(sm_live, ep_live, (entries[split - 1].idx, 1))
+    cap = pa.begin_compact(stub)
+    assert cap is not None
+    pa.prepare_compact(cap)
+    assert pa.finish_compact(cap)
+    assert pa.compaction_floor == entries[split - 1].idx
+    for e in entries[split:]:
+        reply = sm_live.apply(e.idx, e.data)
+        ep_live.note_applied(e.clt_id, e.req_id, e.idx, reply)
+        pa.on_commit(e)
+        pb.on_commit(e)
+    pa.store.sync(); pb.store.sync()
+    assert pa.store.count < pb.store.count      # prefix folded away
+    pa.close(); pb.close()
+    outs = []
+    for path in ("a.db", "b.db"):
+        p = Persistence(str(tmp_path / path),
+                        prefer_native=prefer_native)
+        sm, ep = KvsStateMachine(), EndpointDB()
+        nxt = p.replay_into(sm, ep)
+        outs.append((nxt, sm.store, ep.dump()))
+        p.close()
+    assert outs[0] == outs[1]                    # identical replay
+    assert outs[0][1] == sm_live.store           # and == live state
+    assert outs[0][2] == ep_live.dump()
+
+
+def test_compaction_replay_property_relay_sidecar(tmp_path):
+    """Sidecar base (dump-exposing relay SM): the fold copies the dump
+    into a CRC'd sidecar; replay reconstructs the identical record
+    stream — and a corrupted sidecar QUARANTINES at replay instead of
+    priming damaged state."""
+    from apus_tpu.runtime.bridge import RelayStateMachine
+    sm_live = RelayStateMachine(spill_path=str(tmp_path / "spill.bin"))
+    ep_live = EndpointDB()
+    pa = Persistence(str(tmp_path / "a.db"), prefer_native=False)
+    entries = [_entry(i + 1, b"record-%03d-" % (i + 1) + bytes(64))
+               for i in range(25)]
+    for e in entries[:15]:
+        sm_live.apply(e.idx, e.data)
+        ep_live.note_applied(e.clt_id, e.req_id, e.idx, b"OK")
+        pa.on_commit(e)
+    stub = _NodeStub(sm_live, ep_live, (15, 1))
+    cap = pa.begin_compact(stub)
+    assert cap is not None and "dump_fd" in cap
+    pa.prepare_compact(cap)
+    assert pa.finish_compact(cap)
+    sidecar = cap["sidecar"]
+    assert os.path.exists(sidecar)
+    for e in entries[15:]:
+        sm_live.apply(e.idx, e.data)
+        ep_live.note_applied(e.clt_id, e.req_id, e.idx, b"OK")
+        pa.on_commit(e)
+    pa.store.sync()
+    pa.close()
+    # Clean replay reconstructs the full record stream.
+    p = Persistence(str(tmp_path / "a.db"), prefer_native=False)
+    sm2 = RelayStateMachine(spill_path=str(tmp_path / "spill2.bin"))
+    ep2 = EndpointDB()
+    nxt = p.replay_into(sm2, ep2)
+    assert nxt == 26
+    assert sm2.iter_records() == sm_live.iter_records()
+    assert ep2.dump() == ep_live.dump()
+    assert p.compaction_floor == 15
+    assert p.entries_since_base == 10
+    p.close()
+    # Bit-flip the base image: replay must QUARANTINE, not wedge or
+    # decode garbage.
+    with open(sidecar, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    p = Persistence(str(tmp_path / "a.db"), prefer_native=False)
+    sm3 = RelayStateMachine(spill_path=str(tmp_path / "spill3.bin"))
+    nxt = p.replay_into(sm3, EndpointDB())
+    assert nxt == 1                              # started empty
+    assert sm3.record_count == 0
+    assert os.path.exists(str(tmp_path / "a.db") + ".corrupt")
+    p.close()
+
+
+def test_compaction_queues_appends_during_fold(tmp_path):
+    """Appends landing between begin and finish ride the queue and
+    come out AFTER the base, in order."""
+    pa = Persistence(str(tmp_path / "q.db"), prefer_native=False)
+    sm, ep = KvsStateMachine(), EndpointDB()
+    for i in range(1, 6):
+        e = _entry(i, encode_put(b"k%d" % i, b"v"))
+        sm.apply(e.idx, e.data)
+        pa.on_commit(e)
+    cap = pa.begin_compact(_NodeStub(sm, ep, (5, 1)))
+    # Mid-fold append: must queue (file frozen), then drain.
+    mid = _entry(6, encode_put(b"mid", b"m"))
+    sm.apply(mid.idx, mid.data)
+    pa.on_commit(mid)
+    assert pa.store.count != 7        # not in the file yet
+    pa.prepare_compact(cap)
+    assert pa.finish_compact(cap)
+    kinds = [decode_record(r)[0] for r in pa.store.records()]
+    assert kinds[0] in ("snapshot", "snapfile")
+    assert kinds[1:] == ["entry"]
+    sm2 = KvsStateMachine()
+    pa.replay_into(sm2, EndpointDB())
+    assert sm2.store == sm.store
+    pa.close()
+
+
+def test_delta_record_replays_in_order(tmp_path):
+    """A DELTA install persists as a delta record and replays via
+    apply_snapshot_delta — state after replay equals the live state."""
+    pa = Persistence(str(tmp_path / "d.db"), prefer_native=False)
+    sm = KvsStateMachine()
+    for i in range(1, 6):
+        e = _entry(i, encode_put(b"k%d" % i, b"v%d" % i))
+        sm.apply(e.idx, e.data)
+        pa.on_commit(e)
+    donor = KvsStateMachine()
+    for i in range(1, 6):
+        donor.apply(i, encode_put(b"k%d" % i, b"v%d" % i))
+    for i in range(6, 9):
+        donor.apply(i, encode_put(b"d%d" % i, b"x"))
+    delta = donor.delta_since(5)
+    dsnap = Snapshot(8, 1, delta, delta_base=(5, 1))
+    sm.apply_snapshot_delta(dsnap)
+    pa.on_snapshot(dsnap, [])
+    pa.close()
+    p = Persistence(str(tmp_path / "d.db"), prefer_native=False)
+    sm2 = KvsStateMachine()
+    nxt = p.replay_into(sm2, EndpointDB())
+    assert nxt == 9
+    assert sm2.store == sm.store == donor.store
+    p.close()
+
+
+def test_replay_rebases_node_log(tmp_path):
+    """Restart replay re-bases the node's log + applied determinant at
+    the replay point, and elections speak with the applied term (the
+    bounded-catch-up foundation)."""
+    pa = Persistence(str(tmp_path / "r.db"), prefer_native=False)
+    for i in range(1, 8):
+        pa.on_commit(_entry(i, encode_put(b"k%d" % i, b"v"), term=3))
+    pa.close()
+    t = SimTransport()
+    n = Node(NodeConfig(idx=0), Cid.initial(3), KvsStateMachine(), t)
+    p = Persistence(str(tmp_path / "r.db"), prefer_native=False)
+    nxt = p.replay_into(n.sm, n.epdb, node=n)
+    assert nxt == 8
+    assert n._applied_det == (7, 3)
+    assert n.log.end == n.log.commit == n.log.apply == n.log.head == 8
+    assert n._last_det() == (7, 3)
+    p.close()
+
+
+# -- ladder-shaped e2e (slow; out of tier-1) -------------------------------
+
+@pytest.mark.largestate
+@pytest.mark.slow
+def test_rejoin_ladder_smoke():
+    """One 6 MB rung of the rejoin ladder (above the 4 MB stream
+    threshold, so the full push rides the chunked stream), mid-stream
+    receiver kill included: the push completes with a RESUME after the
+    receiver dies mid-stream, and the delta rejoin ships a delta
+    snapshot."""
+    import benchmarks.reconf_bench as rb
+
+    results = rb.rejoin_ladder([6], kill_mid_stream=True)
+    assert len(results) == 1
+    d = results[0]["detail"]
+    assert d["delta_snapshots"] >= 1
+    assert d["chunks_acked"] >= 1
+    assert d["mid_stream_kill_resumes"] >= 1
