@@ -41,6 +41,7 @@ from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, MAX_SERVER_COUNT,
                                  PERMANENT_FAILURE, EntryType, Role)
 from apus_tpu.core import segment
 from apus_tpu.models.sm import Snapshot, StateMachine
+from apus_tpu.obs.metrics import MetricsRegistry
 from apus_tpu.parallel.transport import (Region, Regions, Transport,
                                          WriteResult)
 
@@ -337,9 +338,19 @@ class Node:
         # apply/role are otherwise unchanged that tick.
         self.reads_done = 0
 
-        # stats (observability, §5.5)
-        self.stats = {"elections": 0, "commits": 0, "applied": 0,
-                      "votes_granted": 0, "hb_sent": 0, "entries_replicated": 0}
+        # stats (observability, §5.5): a dict-compatible view over a
+        # metrics registry (apus_tpu.obs.metrics) — private by default;
+        # the daemon swaps in its shared ObsHub registry via attach_obs
+        # so every counter is scrapeable through OP_METRICS.  The view
+        # keeps every legacy ``stats[...]`` consumer working.
+        self.obs = None
+        self.stats = MetricsRegistry().view("node")
+        for k in ("elections", "commits", "applied", "votes_granted",
+                  "hb_sent", "entries_replicated"):
+            self.stats.setdefault(k, 0)
+        # Lease flight-recorder edge tracking (grant/lapse transitions
+        # only — per-renewal notes would flood the ring at HB rate).
+        self._lease_noted = False
 
     # ------------------------------------------------------------------
     # public api
@@ -357,6 +368,33 @@ class Node:
     @property
     def leader_hint(self) -> Optional[int]:
         return self._known_leader
+
+    # -- observability hooks (apus_tpu.obs) ---------------------------
+
+    def bump(self, name: str, n: int = 1) -> None:
+        """Increment a node_* counter (the one-call spelling the
+        metrics drift lint tracks; see scripts/check_metrics.py)."""
+        self.stats.bump(name, n)
+
+    def attach_obs(self, hub) -> None:
+        """Adopt a shared ObsHub: the stats view rebinds onto the hub's
+        registry (carrying any pre-attach counts), and span/flight
+        recording engages.  Called once by the daemon, before ticking;
+        sim nodes never call it and stay clock-pure."""
+        old = self.stats
+        self.obs = hub
+        self.stats = hub.registry.view("node")
+        for k, v in old.items():
+            if v:
+                self.stats[k] = v
+
+    def _note(self, category: str, msg: str = "", **fields) -> None:
+        """Flight-recorder note (no-op without a hub)."""
+        if self.obs is not None:
+            self.obs.flight.note(category, msg, **fields)
+
+    def _spans(self):
+        return self.obs.spans if self.obs is not None else None
 
     def submit(self, req_id: int, clt_id: int, data: bytes) -> Optional[PendingRequest]:
         """Enqueue a client request (leader only).  Returns a handle whose
@@ -383,7 +421,7 @@ class Node:
             parts = segment.split(data, self.cfg.seg_chunk,
                                   clt_id, req_id)
             pr.chunks, pr.data = parts[:-1], parts[-1]
-            self.stats["seg_split"] = self.stats.get("seg_split", 0) + 1
+            self.bump("seg_split")
         else:
             # Magic-prefix escape runs UNCONDITIONALLY (even with
             # splitting disabled): the apply path treats any MAGIC-
@@ -437,8 +475,7 @@ class Node:
                 rr.error = True
             rr.done = True
             self.reads_done += 1
-            self.stats["lease_reads"] = \
-                self.stats.get("lease_reads", 0) + 1
+            self.bump("lease_reads")
             return rr
         self._pending_reads.append(rr)
         return rr
@@ -597,8 +634,7 @@ class Node:
                                     epoch=self.cid.epoch + 1),
             data=b"leave %d" % slot)
         self._pending_leaves[slot] = pl
-        self.stats["graceful_leaves"] = \
-            self.stats.get("graceful_leaves", 0) + 1
+        self.bump("graceful_leaves")
         return pl
 
     # -- snapshots (SM recovery, §3.4) ---------------------------------
@@ -782,8 +818,7 @@ class Node:
             # mismatch (we applied more meanwhile, or were reset)
             # refuses; the sender falls back to a full image.
             if self._applied_det != tuple(delta_base):
-                self.stats["delta_refused"] = \
-                    self.stats.get("delta_refused", 0) + 1
+                self.bump("delta_refused")
                 return False
             apply_delta = getattr(self.sm, "apply_snapshot_delta", None)
             if apply_delta is None:
@@ -794,8 +829,7 @@ class Node:
                 return False
             snap = dataclasses.replace(snap,
                                        delta_base=tuple(delta_base))
-            self.stats["delta_installs"] = \
-                self.stats.get("delta_installs", 0) + 1
+            self.bump("delta_installs")
         elif data_path is not None:
             import os as _os
             stable = self.sm.apply_snapshot_file(snap, data_path,
@@ -813,8 +847,7 @@ class Node:
                     snap, data=b"", data_path=stable,
                     data_len=_os.path.getsize(stable),
                     data_gen=getattr(self.sm, "dump_generation", 0))
-            self.stats["snapshots_file_installed"] = \
-                self.stats.get("snapshots_file_installed", 0) + 1
+            self.bump("snapshots_file_installed")
         else:
             self.sm.apply_snapshot(snap)
         self.epdb.load(ep_dump)
@@ -843,8 +876,7 @@ class Node:
                     type=EntryType.CONFIG, cid=cid,
                     data=f"{slot} {addr}".encode()))
         self.snapshot_upcalls.append((snap, ep_dump))
-        self.stats["snapshots_installed"] = \
-            self.stats.get("snapshots_installed", 0) + 1
+        self.bump("snapshots_installed")
         return True
 
     def tick(self, now: float) -> None:
@@ -896,7 +928,7 @@ class Node:
                     self.t.ctrl_write(peer, Region.VOTE_REQ, self.idx, req)
             self._prevote_deadline = now + random_election_timeout(
                 self.rng, self.cfg.elect_low, self.cfg.elect_high)
-            self.stats["prevotes"] = self.stats.get("prevotes", 0) + 1
+            self.bump("prevotes")
 
     def _last_det(self) -> tuple:
         """Last-entry determinant for election up-to-dateness.  An
@@ -928,7 +960,8 @@ class Node:
         self.sid.update(new.word)
         self.role = Role.CANDIDATE
         self._known_leader = None
-        self.stats["elections"] += 1
+        self.bump("elections")
+        self._note("election", term=new.term)
         # Fence: revoke everyone's access to our log during the vote
         # (dare_server.c:1290), then vote for ourselves durably.
         self.regions.grant_log_access(None, new.term)
@@ -952,6 +985,7 @@ class Node:
         self.device_covered_from = None
         self._drain_wait = {}
         self._lease_until = -1.0           # no lease carries across terms
+        self._lease_noted = False
         self._election_deadline = None
         self._next_hb_send = now           # heartbeat immediately
         self._next_idx = {}
@@ -1011,6 +1045,7 @@ class Node:
         self.external_commit = False       # host rules until a driver re-arms
         self.device_covered_from = None
         self._lease_until = -1.0
+        self._lease_noted = False
         self._election_deadline = None
         self._last_hb_seen = now
         self.group_contact = True
@@ -1116,7 +1151,7 @@ class Node:
         self._known_leader = None
         self._last_hb_seen = now          # give the candidate time to win
         self.group_contact = True
-        self.stats["votes_granted"] += 1
+        self.bump("votes_granted")
         # Durable vote: replicate to a majority (rc_replicate_vote,
         # dare_ibv_rc.c:1049-1109).
         self._replicate_vote(Sid(cand.term, False, cand.idx))
@@ -1293,14 +1328,19 @@ class Node:
             pr.idx = self.log.append(my.term, req_id=pr.req_id,
                                      clt_id=pr.clt_id, data=pr.data)
             appended += 1
+            # Stage span: the sampled op now holds a log index (the
+            # group-commit admission hop).  Unsampled ops pay one
+            # attribute test + one masked compare.
+            if self.obs is not None \
+                    and self.obs.spans.sampled(pr.req_id):
+                self.obs.spans.stamp(pr.clt_id, pr.req_id, "append",
+                                     idx=pr.idx, term=my.term)
         if appended:
             # Group-commit observability: one drain window per tick
             # that admitted entries; entries/windows is the achieved
             # coalescing factor.
-            self.stats["drain_windows"] = \
-                self.stats.get("drain_windows", 0) + 1
-            self.stats["drain_entries"] = \
-                self.stats.get("drain_entries", 0) + appended
+            self.bump("drain_windows")
+            self.bump("drain_entries", appended)
         self._pending = [p for p in self._pending
                          if p.idx is None or p.idx >= self.log.commit]
 
@@ -1340,8 +1380,8 @@ class Node:
                 self._snap_pushing.discard(peer)
                 self._snap_push_started.pop(peer, None)
                 self._adjusted[peer] = False
-                self.stats["snap_push_abandoned"] = \
-                    self.stats.get("snap_push_abandoned", 0) + 1
+                self.bump("snap_push_abandoned")
+                self._note("watchdog", "snap_push_abandoned", peer=peer)
             # Consume a background snapshot-push completion: once the
             # peer installed, its acks fast-forward next_idx past our
             # head and the push branch below never runs again for it —
@@ -1430,8 +1470,7 @@ class Node:
                                                dcid, dmembers,
                                                delta_base=base)
                         if res == WriteResult.OK:
-                            self.stats["delta_snapshots"] = \
-                                self.stats.get("delta_snapshots", 0) + 1
+                            self.bump("delta_snapshots")
                             self._finish_snap_push(peer, res,
                                                    dsnap.last_idx, now)
                             continue
@@ -1574,17 +1613,22 @@ class Node:
                 batch = list(self.log.entries(nxt, nxt + self.cfg.max_batch))
             if not batch and self._commit_sent.get(peer, 0) >= self.log.commit:
                 continue   # nothing new and remote commit is current
+            if batch and self.obs is not None:
+                # Stage span: replication fan-out shipping these
+                # indices (first peer wins; later peers are no-ops).
+                self.obs.spans.stamp_range("repl", batch[0].idx,
+                                           batch[-1].idx + 1,
+                                           term=my.term)
             res, acked_end = self.t.log_write(peer, my, batch,
                                               self.log.commit)
             if res == WriteResult.OK:
                 if batch:
                     self._next_idx[peer] = batch[-1].idx + 1
-                    self.stats["entries_replicated"] += len(batch)
+                    self.bump("entries_replicated", len(batch))
                     # Per-peer replication windows (group-commit
                     # invariant: K concurrent ops ship in
                     # ceil(K/max_batch) windows per peer, not K).
-                    self.stats["repl_windows"] = \
-                        self.stats.get("repl_windows", 0) + 1
+                    self.bump("repl_windows")
                 self._commit_sent[peer] = self.log.commit
                 self._fail_count[peer] = 0
                 if acked_end is not None and self.is_leader \
@@ -1625,8 +1669,7 @@ class Node:
         being monotone is the belt against the check-then-write race:
         a NEWER pending completion is never clobbered."""
         if self._snap_push_gen.get(peer, 0) != push_gen:
-            self.stats["snap_push_stale_done"] = \
-                self.stats.get("snap_push_stale_done", 0) + 1
+            self.bump("snap_push_stale_done")
             return
         prev = self._snap_push_done.get(peer)
         if prev is not None and prev[3] > push_gen:
@@ -1642,13 +1685,13 @@ class Node:
         """Common completion bookkeeping for snapshot pushes, inline or
         background (the async thread only records its result; all state
         mutation happens here, on the tick thread, under the lock)."""
+        self._note("snap_push", str(res), peer=peer,
+                   last_idx=pushed_last_idx, streamed=streamed)
         if res == WriteResult.OK:
             if streamed:
-                self.stats["snapshots_streamed"] = \
-                    self.stats.get("snapshots_streamed", 0) + 1
+                self.bump("snapshots_streamed")
             self._next_idx[peer] = pushed_last_idx + 1
-            self.stats["snapshots_pushed"] = \
-                self.stats.get("snapshots_pushed", 0) + 1
+            self.bump("snapshots_pushed")
         elif res in (WriteResult.FENCED, WriteResult.REFUSED):
             # REFUSED: the peer's commit is already past the snapshot
             # (our view of it was stale) — re-read its real log state
@@ -1701,8 +1744,13 @@ class Node:
                 # (the blank entry from become_leader guarantees progress).
                 last = self.log.get(c - 1)
                 if last is not None and last.term == my.term:
+                    before = self.log.commit
                     if self.log.advance_commit(c) == c:
-                        self.stats["commits"] += 1
+                        self.bump("commits")
+                        if self.obs is not None:
+                            # Stage span: quorum acked these indices.
+                            self.obs.spans.stamp_range(
+                                "quorum", before, c, term=my.term)
                 break
 
     #: How long an EXTENDED resize tolerates a new slot with zero ack
@@ -1761,8 +1809,9 @@ class Node:
                 self.log.append(my.term, type=EntryType.CONFIG,
                                 cid=self.cid.abort_extend())
                 self._resize_stall = None
-                self.stats["resize_aborts"] = \
-                    self.stats.get("resize_aborts", 0) + 1
+                self.bump("resize_aborts")
+                self._note("config", "resize_abort",
+                           epoch=self.cid.epoch)
             return
         self._resize_stall = None
         if self.log.near_full(1):
@@ -1807,7 +1856,7 @@ class Node:
                 if seen is not None and seen[1] >= t0 \
                         and Sid.unpack(seen[0]).term <= my.term:
                     mask |= 1 << peer
-        self.stats["hb_sent"] += 1
+        self.bump("hb_sent")
         if fenced >= quorum_size(self.cid.size):
             # A quorum of peers affirms our slot was removed at an
             # epoch past our incarnation — we are a zombie ex-leader
@@ -1817,8 +1866,7 @@ class Node:
             # (nobody heartbeats a non-member, so its hb-age never
             # grows and the watchdog never fires) while client
             # requests burn timeouts against it.
-            self.stats["fenced_stepdowns"] = \
-                self.stats.get("fenced_stepdowns", 0) + 1
+            self.bump("fenced_stepdowns")
             self.become_follower(Sid(my.term, False, self.idx), now)
             return
         if not self.cfg.read_lease or self.cid.state != CidState.STABLE:
@@ -1834,8 +1882,12 @@ class Node:
             self._lease_until = max(
                 self._lease_until,
                 t0 + self.cfg.hb_timeout * (1.0 - self.cfg.lease_margin))
-            self.stats["lease_renewals"] = \
-                self.stats.get("lease_renewals", 0) + 1
+            self.bump("lease_renewals")
+            if not self._lease_noted:
+                # Grant edge only (per-renewal notes would flood the
+                # flight ring at heartbeat rate).
+                self._lease_noted = True
+                self._note("lease", "grant", term=my.term)
 
     def _serve_reads(self, now: float) -> None:
         """Answer pending linearizable reads (ep_dp_reply_read_req
@@ -1865,16 +1917,19 @@ class Node:
                     r.error = True
                 r.done = True
                 self.reads_done += 1
-                self.stats["lease_reads"] = \
-                    self.stats.get("lease_reads", 0) + 1
+                self.bump("lease_reads")
             self._pending_reads = [r for r in self._pending_reads
                                    if not r.done]
             return
+        if self._lease_noted:
+            # A read is paying the majority round though a lease was
+            # previously held: the lease lapsed (black-box edge).
+            self._lease_noted = False
+            self._note("lease", "lapse", term=self.current_term)
         newest = max(r.registered_at for r in self._pending_reads
                      if self.log.apply >= r.wait_idx)
         if self._leader_verified_seq < newest:
-            self.stats["readindex_verifies"] = \
-                self.stats.get("readindex_verifies", 0) + 1
+            self.bump("readindex_verifies")
             if not self._verify_leadership(now):
                 return
         # Re-derive the ready set AFTER verification: the transport
@@ -1987,8 +2042,9 @@ class Node:
                     cid=dataclasses.replace(
                         self.cid.without_server(peer),
                         epoch=self.cid.epoch + 1))
-                self.stats["auto_removes"] = \
-                    self.stats.get("auto_removes", 0) + 1
+                self.bump("auto_removes")
+                self._note("config", "auto_remove", peer=peer,
+                           epoch=self.cid.epoch + 1)
 
     def _maybe_prune(self, my: Sid) -> None:
         """log_pruning analog (dare_server.c:1996-2067).  P1: only applied
@@ -2033,8 +2089,7 @@ class Node:
         if self.log.is_full and self.log.apply > self.log.head:
             self.log.advance_head(self.log.apply)
             self._pending_head = None
-            self.stats["emergency_prunes"] = \
-                self.stats.get("emergency_prunes", 0) + 1
+            self.bump("emergency_prunes")
 
     def _apply_committed(self, now: float) -> None:
         """apply_committed_entries analog (dare_server.c:1815-1974)."""
@@ -2066,7 +2121,7 @@ class Node:
                             # final chunk with the reassembled record.
                             self._applied_det = e.determinant()
                             self.log.advance_apply(e.idx + 1)
-                            self.stats["applied"] += 1
+                            self.bump("applied")
                             continue
                         if full is None:
                             # The group was evicted under the orphan
@@ -2075,8 +2130,7 @@ class Node:
                             # answers this final identically (empty
                             # reply).  Loud: >4096 concurrent partial
                             # groups means something is very wrong.
-                            self.stats["seg_incomplete"] = \
-                                self.stats.get("seg_incomplete", 0) + 1
+                            self.bump("seg_incomplete")
                             data = None
                         else:
                             data = full
@@ -2093,6 +2147,14 @@ class Node:
                     self.committed_upcalls.append(
                         e if data is e.data
                         else dataclasses.replace(e, data=data))
+                if self.obs is not None and e.req_id > 0 \
+                        and self.obs.spans.sampled(e.req_id):
+                    # Stage span: applied on THIS replica (leader opens
+                    # the op; followers ring-only, keyed (req, term,
+                    # idx) for the cross-replica stitch).
+                    self.obs.spans.stamp(e.clt_id, e.req_id, "apply",
+                                         idx=e.idx, term=e.term,
+                                         open_new=False)
                 pr = self._inflight.pop((e.clt_id, e.req_id), None)
                 if pr is not None:
                     # Sentinel contract: reply stays None until THIS
@@ -2112,7 +2174,7 @@ class Node:
                 continue
             self._applied_det = e.determinant()
             self.log.advance_apply(e.idx + 1)
-            self.stats["applied"] += 1
+            self.bump("applied")
         if self.log.is_full:
             # Followers never run _maybe_prune; a ring filled by
             # replicated writes/drains frees its applied prefix here.
@@ -2161,6 +2223,9 @@ class Node:
             # its epoch (monotone; see install_snapshot for why
             # inflating past the admission epoch is safe).
             self.incarnation = max(self.incarnation, new_cid.epoch)
+        self._note("config", epoch=new_cid.epoch,
+                   state=new_cid.state.name, size=new_cid.size,
+                   bitmask=new_cid.bitmask, idx=e.idx, term=e.term)
         self.cid = new_cid
         # Learn the joiner's address (idempotent-join dedup).  A reused
         # slot evicts the previous occupant's address claim, and slots

@@ -1,0 +1,107 @@
+"""Unified observability plane: metrics registry, per-op stage spans,
+and the black-box flight recorder, bundled per process as an ObsHub.
+
+One hub per replica daemon (and optionally per client):
+
+- ``hub.registry`` — the MetricsRegistry every legacy stats dict now
+  rides (namespaced views: node_*, net_*, fault_*, srv_*), plus the
+  span-stage histograms; exposed over the wire via OP_METRICS and
+  scraped by ``python -m apus_tpu.obs.scrape``.
+- ``hub.spans`` — SpanRecorder: per-op stage stamps for req_id-sampled
+  ops (default 1/64; APUS_OBS_SAMPLE overrides the period).
+- ``hub.flight`` — FlightRecorder: the always-on bounded ring of
+  state-transition events, dumped via OP_OBS_DUMP and automatically by
+  fuzz/soak on failure; rendered by ``python -m apus_tpu.obs.timeline``.
+
+``APUS_OBS=0`` disables the whole plane (make_hub returns None and the
+daemon falls back to private per-component registries, keeping the
+legacy stats surface alive with zero span/flight overhead).
+
+Deterministic-simulator nodes never get a hub: the sim stays clock-pure.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from apus_tpu.obs import catalog
+from apus_tpu.obs.flight import FlightRecorder
+from apus_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                  MetricsRegistry, StatsView, bump,
+                                  render_prometheus)
+from apus_tpu.obs.spans import (STAGE_DURATIONS, STAGE_ORDER,
+                                SpanRecorder)
+
+__all__ = ["ObsHub", "make_hub", "MetricsRegistry", "StatsView",
+           "SpanRecorder", "FlightRecorder", "Counter", "Gauge",
+           "Histogram", "bump", "render_prometheus", "STAGE_ORDER",
+           "STAGE_DURATIONS", "DEFAULT_SAMPLE_PERIOD"]
+
+DEFAULT_SAMPLE_PERIOD = 64
+
+
+class ObsHub:
+    """One process/replica's observability state."""
+
+    def __init__(self, ident: str = "",
+                 sample_period: Optional[int] = None,
+                 span_capacity: int = 8192,
+                 flight_capacity: int = 2048):
+        if sample_period is None:
+            try:
+                sample_period = int(os.environ.get(
+                    "APUS_OBS_SAMPLE", DEFAULT_SAMPLE_PERIOD))
+            except ValueError:
+                sample_period = DEFAULT_SAMPLE_PERIOD
+        self.ident = ident
+        self.registry = MetricsRegistry()
+        # Pre-register the full catalog: a scrape sees every metric
+        # from the first reply (zeros included), and the drift lint's
+        # "cataloged => reachable via OP_METRICS" contract holds by
+        # construction.
+        for name in catalog.COUNTERS:
+            self.registry.counter(name)
+        for name in catalog.GAUGES:
+            self.registry.gauge(name)
+        for name in catalog.HISTOGRAMS:
+            self.registry.histogram(name)
+        self.spans = SpanRecorder(self.registry,
+                                  sample_period=sample_period,
+                                  capacity=span_capacity)
+        self.flight = FlightRecorder(flight_capacity)
+
+    def view(self, namespace: str) -> StatsView:
+        return self.registry.view(namespace)
+
+    def dump(self) -> dict:
+        """JSON-able full dump: metrics snapshot + flight + span rings,
+        with a wall/mono anchor so cross-process timelines align on
+        wall time (per-event stamps are monotonic µs, which are only
+        comparable within one process)."""
+        return {
+            "ident": self.ident,
+            "pid": os.getpid(),
+            "anchor": {"wall_us": time.time_ns() // 1000,
+                       "mono_us": time.monotonic_ns() // 1000},
+            "sample_period": self.spans.sample_period,
+            "metrics": self.registry.snapshot(),
+            "flight": self.flight.events(),
+            "flight_dropped": self.flight.dropped,
+            "spans": self.spans.events(),
+            "spans_dropped": self.spans.dropped,
+        }
+
+
+def obs_enabled(env: Optional[dict] = None) -> bool:
+    e = os.environ if env is None else env
+    return e.get("APUS_OBS", "1").lower() not in ("0", "false", "off")
+
+
+def make_hub(ident: str = "", **kwargs) -> Optional[ObsHub]:
+    """The daemon's single construction point: a hub, or None when the
+    plane is disabled via APUS_OBS=0."""
+    if not obs_enabled():
+        return None
+    return ObsHub(ident, **kwargs)

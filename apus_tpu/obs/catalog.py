@@ -1,0 +1,103 @@
+"""The metrics catalog: every registry metric the runtime may emit.
+
+This is the drift gate's source of truth (scripts/check_metrics.py):
+
+- every counter bumped in source (the ``.bump("name")`` spelling) must
+  be cataloged here under its namespace,
+- every cataloged name must be documented in DESIGN.md's
+  "Observability plane" section (as a backticked literal),
+- every cataloged name is pre-registered by ObsHub, so it is reachable
+  through OP_METRICS from the first scrape (zeros included) — the
+  roundtrip test asserts that.
+
+Names are the FULL registry names (``<namespace>_<metric>``).
+"""
+
+from __future__ import annotations
+
+COUNTERS: dict[str, str] = {
+    # -- node_*: protocol core (core/node.py, parallel/onesided.py,
+    #    runtime/bridge.py, runtime/device_plane.py) -------------------
+    "node_elections": "elections started by this replica",
+    "node_prevotes": "prevote rounds opened",
+    "node_votes_granted": "real votes granted to candidates",
+    "node_commits": "commit-index advances observed as leader",
+    "node_applied": "entries applied to the state machine",
+    "node_hb_sent": "leader heartbeat rounds fanned out",
+    "node_entries_replicated": "entries shipped in replication writes",
+    "node_repl_windows": "replication fan-out windows shipped",
+    "node_drain_windows": "group-commit drain windows formed",
+    "node_drain_entries": "client entries admitted through drain windows",
+    "node_seg_split": "oversized commands split into segment chunks",
+    "node_seg_incomplete": "applies deferred on an incomplete segment",
+    "node_lease_reads": "linearizable reads served from the leader lease",
+    "node_lease_renewals": "leader lease renewals (quorum-acked HB rounds)",
+    "node_readindex_verifies": "reads that paid the read-index majority round",
+    "node_graceful_leaves": "OP_LEAVE removals committed",
+    "node_auto_removes": "failure-detector evictions committed",
+    "node_resize_aborts": "EXTENDED-resize aborts (joiner died mid-catch-up)",
+    "node_emergency_prunes": "emergency log prunes under ring pressure",
+    "node_fenced_stepdowns": "leaderships dropped on a fenced HB quorum",
+    "node_fenced_ctrl_writes": "stale-incarnation ctrl writes dropped",
+    "node_snapshots_pushed": "whole-blob snapshot pushes completed",
+    "node_snapshots_streamed": "chunked snapshot streams completed",
+    "node_snapshots_installed": "snapshots installed on this replica",
+    "node_snapshots_file_installed": "file-adopted (streamed) installs",
+    "node_snap_push_abandoned": "wedged push threads abandoned by the watchdog",
+    "node_snap_push_stale_done": "stale push completions dropped by generation",
+    "node_snap_chunk_quarantines": "damaged partial chunk files quarantined",
+    "node_snap_stream_resumes": "inbound snapshot streams resumed mid-file",
+    "node_delta_snapshots": "delta snapshots served to lagging peers",
+    "node_delta_installs": "delta snapshots installed",
+    "node_delta_refused": "delta installs refused on a base mismatch",
+    "node_devplane_commits": "commit advances adopted from the device quorum",
+    "node_nack_ranges_dropped": "proxy NACK ranges dropped by the bridge",
+    "node_proxy_spin_timeouts": "proxy spin-wait timeouts observed",
+    "node_replay_reprimes": "bridge replay re-primes after reconnect",
+    # -- net_*: initiator transport (parallel/net.py) ------------------
+    "net_retries": "in-op connection-fault retries attempted",
+    "net_retries_ok": "in-op retries that succeeded",
+    "net_snap_chunks_sent": "snapshot chunks sent",
+    "net_snap_chunks_acked": "snapshot chunks acked durable",
+    "net_snap_resumes": "outbound snapshot streams resumed past byte 0",
+    "net_snap_resumed_bytes": "bytes skipped by stream resumes",
+    # -- fault_*: injected-fault plane (parallel/faults.py) ------------
+    "fault_drops": "ops dropped by the fault plane",
+    "fault_delays": "ops delayed by the fault plane",
+    "fault_dups": "ops duplicated by the fault plane",
+    "fault_reorders": "ops held for reordering",
+    "fault_blocked": "ops refused by partitions/crash state",
+    "fault_throttles": "ops stalled by a slow-peer throttle",
+    "fault_inbound_drops": "inbound handler messages dropped",
+    "fault_inbound_delays": "inbound handler messages delayed",
+    # -- srv_*: passive peer server (parallel/net.py PeerServer) -------
+    "srv_ingest_batches": "multi-frame bursts drained off one connection",
+    "srv_ingest_frames": "frames ingested through burst drains",
+    "srv_ingest_solo": "single-frame (non-burst) requests served",
+}
+
+GAUGES: dict[str, str] = {
+    # Mirrored from daemon/persistence state at OP_METRICS scrape time.
+    "daemon_persist_errors": "I/O errors seen on the persistence path",
+    "daemon_persist_disabled": "1 when persistence is disabled for the session",
+    "daemon_persist_syncs": "fdatasync calls issued by the batch policy",
+    "daemon_compactions": "store compactions completed",
+    "daemon_compaction_floor": "first log index covered by the base image",
+    "daemon_store_records_since_base": "records appended past the base image",
+}
+
+HISTOGRAMS: dict[str, str] = {
+    "stage_lock_wait_us": "ingest -> node lock acquired",
+    "stage_dedup_admit_us": "lock -> submit returned (dedup + enqueue)",
+    "stage_append_us": "admit -> entry holds a log index",
+    "stage_repl_fanout_us": "append -> first replication write shipped",
+    "stage_quorum_ack_us": "repl -> commit advanced past the index",
+    "stage_apply_us": "quorum -> entry applied to the SM",
+    "stage_fsync_us": "apply -> drain-window fdatasync covered it",
+    "stage_reply_flush_us": "fsync/apply -> reply bytes built",
+    "stage_wire_out_us": "reply -> client parsed the reply frame",
+    "op_server_us": "server end-to-end: ingest -> reply (telescoped stages)",
+    "op_client_us": "client end-to-end: send -> reply parsed",
+}
+
+CATALOG: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

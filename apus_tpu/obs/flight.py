@@ -1,0 +1,75 @@
+"""Black-box flight recorder: a bounded ring of state-transition events.
+
+When a fuzz campaign shrinks a linearizability violation to a minimal
+failing window, the question that remains is "what was the CLUSTER
+doing in the 200 ms before it" — which role flips, CONFIG applies,
+lease lapses, snapshot streams, fault injections, and watchdog firings
+surrounded the bad read.  Those events are rare (Hz, not kHz), so an
+always-on ring is effectively free; like an aircraft recorder it keeps
+only the last N events and is read out on demand (OP_OBS_DUMP) or
+automatically when a harness fails.
+
+Each event: (monotonic µs, category, fields).  Wall-clock alignment
+across processes rides the ObsHub dump anchor, not per-event stamps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class FlightRecorder:
+    """Bounded event ring; `note()` is safe from any thread."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = max(16, int(capacity))
+        self._ring: list = [None] * self.capacity
+        self._seq = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def note(self, category: str, msg: str = "", **fields) -> None:
+        t = time.monotonic_ns() // 1000
+        ev = (t, category, msg, fields or None)
+        with self._lock:
+            if self._seq >= self.capacity:
+                self.dropped += 1
+            self._ring[self._seq % self.capacity] = ev
+            self._seq += 1
+
+    def events(self) -> list[dict]:
+        """Chronological snapshot (oldest retained first)."""
+        with self._lock:
+            n = min(self._seq, self.capacity)
+            start = self._seq - n
+            evs = [self._ring[(start + i) % self.capacity]
+                   for i in range(n)]
+            dropped = self.dropped
+        out = []
+        for ev in evs:
+            if ev is None:
+                continue
+            t, cat, msg, fields = ev
+            d = {"t_us": t, "cat": cat}
+            if msg:
+                d["msg"] = msg
+            if fields:
+                d.update(fields)
+            out.append(d)
+        if dropped and out:
+            out[0] = dict(out[0], wrapped=dropped)
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self.capacity)
+
+
+def note(flight: Optional[FlightRecorder], category: str,
+         msg: str = "", **fields) -> None:
+    """None-tolerant helper for call sites that may run without a
+    recorder (sim nodes, raw transports)."""
+    if flight is not None:
+        flight.note(category, msg, **fields)

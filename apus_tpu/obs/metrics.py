@@ -1,0 +1,302 @@
+"""Lock-cheap metrics registry: counters, gauges, log2 histograms.
+
+The reference prints ad-hoc counters when servers exit; our runtime had
+grown the same shape — a dozen scattered ``stats`` dicts (Node,
+NetTransport, FaultPlane, PeerServer, device runners) each with its own
+``d[k] = d.get(k, 0) + 1`` plumbing, readable only through OP_STATUS
+fields added one by one.  This module is the single namespace those
+dicts collapse into:
+
+- ``Counter`` / ``Gauge`` — one mutable slot each, bumped with plain
+  int/float ops.  No lock on the increment path: CPython's GIL makes a
+  single ``+=`` effectively atomic for our purposes, and a metrics race
+  that loses one increment under free-threading is an accepted error
+  bar (the hot path must never serialize on observability).
+- ``Histogram`` — FIXED log2 buckets (64 slots, value -> bucket by bit
+  length), preallocated at registration: observing a sample is two int
+  ops and two list updates, no per-sample allocation — the property
+  DXRAM found non-negotiable for always-on instrumentation of a µs
+  data plane (PAPERS.md).
+- ``MetricsRegistry`` — name -> metric, namespaced ``<ns>_<name>``.
+  Structure changes (first registration of a name) take a small lock;
+  reads/bumps never do.  ``snapshot()``/``render_prometheus()`` feed
+  the OP_METRICS wire op and the scrape CLI.
+- ``StatsView`` — a dict-compatible view over one namespace, so the
+  legacy ``node.stats["commits"] += 1`` call sites migrate onto the
+  registry without rewriting every consumer: reads of unregistered
+  names return 0 (counters are born at zero), writes register.
+
+Sim nodes keep plain dicts (no registry, no clock calls): determinism
+of the virtual-time simulator is untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+#: number of log2 buckets: covers [0, 2^62) µs — wider than any op.
+HIST_BUCKETS = 64
+
+
+class Counter:
+    """Monotone (by convention) integer counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins numeric gauge (floats allowed)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bucket log2 histogram.
+
+    Bucket b holds samples with ``int(x).bit_length() == b``, i.e.
+    bucket 0 is exactly 0, bucket b >= 1 covers [2^(b-1), 2^b).  The
+    bucket of a sample is one ``bit_length()`` call — no search, no
+    float math, no allocation.  Percentiles interpolate inside the
+    selected bucket (geometric midpoint), which is exact to within the
+    2x bucket width — the right fidelity for "where did the time go"
+    breakdowns, at hot-path cost."""
+
+    __slots__ = ("name", "counts", "count", "sum")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counts = [0] * HIST_BUCKETS
+        self.count = 0
+        self.sum = 0
+
+    @staticmethod
+    def bucket_of(x) -> int:
+        xi = int(x)
+        if xi <= 0:
+            return 0
+        b = xi.bit_length()
+        return b if b < HIST_BUCKETS else HIST_BUCKETS - 1
+
+    @staticmethod
+    def bucket_hi(b: int) -> int:
+        """Exclusive upper bound of bucket ``b`` (its ``le`` edge)."""
+        return 1 if b == 0 else 1 << b
+
+    def observe(self, x) -> None:
+        self.counts[self.bucket_of(x)] += 1
+        self.count += 1
+        self.sum += int(x)
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 1])."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, int(q * self.count + 0.5))
+        seen = 0
+        for b, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                if b == 0:
+                    return 0.0
+                lo = 1 << (b - 1)
+                # Geometric midpoint of [2^(b-1), 2^b).
+                return lo * 1.5
+        return float(self.bucket_hi(HIST_BUCKETS - 1))
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Namespaced metric store: ``<ns>_<name>`` -> metric object."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get_or_create(self, full: str, cls):
+        m = self._metrics.get(full)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(full)
+                if m is None:
+                    m = cls(full)
+                    self._metrics[full] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {full!r} is {type(m).__name__}, "
+                            f"wanted {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram)
+
+    def view(self, namespace: str) -> "StatsView":
+        return StatsView(self, namespace)
+
+    def names(self) -> list[str]:
+        return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        """JSON-able {name: {type, ...}} of every registered metric —
+        the OP_METRICS payload."""
+        out = {}
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                out[name] = {"type": "counter", "value": m.value}
+            elif isinstance(m, Gauge):
+                out[name] = {"type": "gauge", "value": m.value}
+            else:
+                h: Histogram = m          # type: ignore[assignment]
+                nz = {str(b): c for b, c in enumerate(h.counts) if c}
+                out[name] = {"type": "histogram", "count": h.count,
+                             "sum": h.sum, "buckets": nz,
+                             "p50": round(h.percentile(0.50), 1),
+                             "p99": round(h.percentile(0.99), 1)}
+        return out
+
+    def render_prometheus(self, prefix: str = "apus",
+                          labels: Optional[dict] = None) -> str:
+        return render_prometheus(self.snapshot(), prefix=prefix,
+                                 labels=labels)
+
+
+def render_prometheus(snapshot: dict, prefix: str = "apus",
+                      labels: Optional[dict] = None) -> str:
+    """Prometheus text exposition of a registry ``snapshot()`` (shared
+    by the in-process registry and the scrape CLI, which only holds
+    the JSON that crossed the wire).  Histograms emit cumulative
+    ``_bucket{le=...}`` series on the log2 edges."""
+    lab = ""
+    if labels:
+        lab = "{" + ",".join(f'{k}="{v}"'
+                             for k, v in sorted(labels.items())) + "}"
+
+    def bucket_lab(le) -> str:
+        return (lab[:-1] + f',le="{le}"}}') if lab else f'{{le="{le}"}}'
+
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        rec = snapshot[name]
+        full = f"{prefix}_{name}"
+        kind = rec.get("type", "counter")
+        if kind in ("counter", "gauge"):
+            lines += [f"# TYPE {full} {kind}",
+                      f"{full}{lab} {rec.get('value', 0)}"]
+            continue
+        lines.append(f"# TYPE {full} histogram")
+        cum = 0
+        buckets = rec.get("buckets", {})
+        for b in sorted(buckets, key=int):
+            c = buckets[b]
+            if not c:
+                continue
+            cum += c
+            lines.append(f"{full}_bucket{bucket_lab(Histogram.bucket_hi(int(b)))}"
+                         f" {cum}")
+        lines.append(f"{full}_bucket{bucket_lab('+Inf')} "
+                     f"{rec.get('count', 0)}")
+        lines += [f"{full}_sum{lab} {rec.get('sum', 0)}",
+                  f"{full}_count{lab} {rec.get('count', 0)}"]
+    return "\n".join(lines) + "\n"
+
+
+class StatsView:
+    """Dict-compatible view over one registry namespace.
+
+    Backwards compatibility with the legacy ad-hoc stats dicts:
+    ``view[k]`` and ``view.get(k)`` read 0 for names never bumped
+    (counters are born at zero), ``view[k] = v`` registers-and-sets,
+    ``bump(k)`` is the one-call increment that replaces the
+    ``d[k] = d.get(k, 0) + 1`` plumbing.  Iteration and membership
+    reflect only names actually registered in this namespace."""
+
+    __slots__ = ("_reg", "_ns", "_prefix")
+
+    def __init__(self, registry: MetricsRegistry, namespace: str):
+        self._reg = registry
+        self._ns = namespace
+        self._prefix = namespace + "_" if namespace else ""
+
+    @property
+    def namespace(self) -> str:
+        return self._ns
+
+    def bump(self, name: str, n: int = 1) -> int:
+        c = self._reg.counter(self._prefix + name)
+        c.value += n
+        return c.value
+
+    def __getitem__(self, name: str):
+        m = self._reg._metrics.get(self._prefix + name)
+        return 0 if m is None else m.value
+
+    def get(self, name: str, default=0):
+        m = self._reg._metrics.get(self._prefix + name)
+        return default if m is None else m.value
+
+    def __setitem__(self, name: str, value) -> None:
+        self._reg.counter(self._prefix + name).value = int(value)
+
+    def setdefault(self, name: str, default=0):
+        full = self._prefix + name
+        m = self._reg._metrics.get(full)
+        if m is None:
+            self._reg.counter(full).value = int(default)
+            return default
+        return m.value
+
+    def __contains__(self, name: str) -> bool:
+        return (self._prefix + name) in self._reg._metrics
+
+    def _names(self) -> list[str]:
+        p = self._prefix
+        return [n[len(p):] for n in self._reg.names() if n.startswith(p)]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._names())
+
+    def __len__(self) -> int:
+        return len(self._names())
+
+    def keys(self):
+        return self._names()
+
+    def items(self):
+        return [(n, self[n]) for n in self._names()]
+
+    def __repr__(self) -> str:
+        return f"StatsView({self._ns!r}, {dict(self.items())!r})"
+
+
+def bump(stats, name: str, n: int = 1) -> None:
+    """Increment ``name`` on either a StatsView or a plain dict — the
+    shared helper for code paths (onesided, node) that run both under
+    the registry-backed daemon and the dict-backed sim."""
+    b = getattr(stats, "bump", None)
+    if b is not None:
+        b(name, n)
+    else:
+        stats[name] = stats.get(name, 0) + n

@@ -1,0 +1,64 @@
+"""Scrape CLI: OP_METRICS from live replicas, as Prometheus text or JSON.
+
+    python -m apus_tpu.obs.scrape HOST:PORT[,HOST:PORT...] [--json]
+
+Each replica's registry snapshot renders with ``replica`` and ``addr``
+labels, so one invocation against the whole peer table emits a single
+Prometheus exposition covering the cluster (or one JSON object keyed
+by address with ``--json``).  Exit status 0 when at least one replica
+answered, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from apus_tpu.obs.metrics import render_prometheus
+from apus_tpu.obs.service import fetch_metrics
+
+
+def scrape(addrs: list[str], timeout: float = 2.0) -> dict:
+    """addr -> OP_METRICS payload (only replicas that answered)."""
+    out = {}
+    for addr in addrs:
+        rec = fetch_metrics(addr, timeout=timeout)
+        if rec is not None:
+            out[addr] = rec
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.obs.scrape",
+        description="Scrape OP_METRICS from live apus replicas.")
+    ap.add_argument("addrs", nargs="+",
+                    help="replica control endpoints (host:port); "
+                         "comma-separated lists are flattened")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object keyed by address "
+                         "instead of Prometheus text")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    addrs = [a for chunk in args.addrs for a in chunk.split(",") if a]
+    got = scrape(addrs, timeout=args.timeout)
+    if args.json:
+        print(json.dumps(got, indent=2, sort_keys=True))
+    else:
+        for addr, rec in got.items():
+            sys.stdout.write(render_prometheus(
+                rec.get("metrics", {}),
+                labels={"replica": rec.get("replica", ""),
+                        "addr": addr}))
+    if not got:
+        print("no replica answered OP_METRICS "
+              f"({', '.join(addrs)})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Wire surface of the observability plane: OP_METRICS + OP_OBS_DUMP.
+
+Both are extra PeerServer ops on the replica's existing control port —
+the same transport OP_STATUS rides — so scraping a production cluster
+needs no new listener.  OP_METRICS answers the registry snapshot (with
+daemon/persistence gauges refreshed at scrape time); OP_OBS_DUMP
+answers the full hub dump (metrics + flight ring + span ring + the
+wall/mono anchor the timeline renderer aligns on).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional
+
+from apus_tpu.parallel import wire
+
+OP_METRICS = 22
+OP_OBS_DUMP = 23
+
+
+def _refresh_daemon_gauges(daemon) -> None:
+    """Mirror daemon/persistence scalars into the registry as gauges —
+    the scattered OP_STATUS-only stats absorbed behind one namespace."""
+    hub = daemon.obs
+    if hub is None:
+        return
+    g = hub.registry.gauge
+    g("daemon_persist_errors").set(getattr(daemon, "persist_errors", 0))
+    g("daemon_persist_disabled").set(
+        1 if getattr(daemon, "persist_disabled", False) else 0)
+    p = getattr(daemon, "persistence", None)
+    g("daemon_persist_syncs").set(getattr(p, "syncs", 0) if p else 0)
+    g("daemon_compactions").set(getattr(p, "compactions", 0) if p else 0)
+    g("daemon_compaction_floor").set(
+        getattr(p, "compaction_floor", 0) if p else 0)
+    g("daemon_store_records_since_base").set(
+        getattr(p, "entries_since_base", 0) if p else 0)
+
+
+def make_obs_ops(daemon) -> dict:
+    """Extra PeerServer ops for a ReplicaDaemon with an ObsHub."""
+
+    def metrics_op(r: wire.Reader) -> bytes:
+        hub = daemon.obs
+        if hub is None:
+            return wire.u8(wire.ST_ERROR)
+        with daemon.lock:
+            _refresh_daemon_gauges(daemon)
+            payload = {"replica": daemon.idx,
+                       "role": daemon.node.role.name,
+                       "term": daemon.node.current_term,
+                       "metrics": hub.registry.snapshot()}
+        return wire.u8(wire.ST_OK) + wire.blob(
+            json.dumps(payload).encode())
+
+    def dump_op(r: wire.Reader) -> bytes:
+        hub = daemon.obs
+        if hub is None:
+            return wire.u8(wire.ST_ERROR)
+        _refresh_daemon_gauges(daemon)
+        d = hub.dump()
+        d["replica"] = daemon.idx
+        with daemon.lock:
+            d["role"] = daemon.node.role.name
+            d["term"] = daemon.node.current_term
+        return wire.u8(wire.ST_OK) + wire.blob(json.dumps(d).encode())
+
+    return {OP_METRICS: metrics_op, OP_OBS_DUMP: dump_op}
+
+
+def _one_shot(addr: str, op: int, timeout: float) -> Optional[dict]:
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(timeout)
+            conn.sendall(wire.frame(wire.u8(op)))
+            resp = wire.read_frame(conn)
+    except (OSError, ConnectionError, ValueError):
+        return None
+    if not resp or resp[0] != wire.ST_OK:
+        return None
+    try:
+        return json.loads(wire.Reader(resp[1:]).blob().decode())
+    except (ValueError, KeyError):
+        return None
+
+
+def fetch_metrics(addr: str, timeout: float = 2.0) -> Optional[dict]:
+    """One OP_METRICS scrape: {"replica", "role", "term", "metrics"}
+    or None when unreachable / obs disabled."""
+    return _one_shot(addr, OP_METRICS, timeout)
+
+
+def fetch_obs_dump(addr: str, timeout: float = 5.0) -> Optional[dict]:
+    """One OP_OBS_DUMP fetch: the full hub dump, or None."""
+    return _one_shot(addr, OP_OBS_DUMP, timeout)
+
+
+def collect_cluster_dumps(peers: list[str],
+                          timeout: float = 5.0) -> list[dict]:
+    """Best-effort OP_OBS_DUMP across a peer table — the harnesses'
+    failure-dump primitive (unreachable replicas are skipped; whatever
+    answered is still a usable timeline)."""
+    out = []
+    for addr in peers:
+        if not addr:
+            continue
+        d = fetch_obs_dump(addr, timeout=timeout)
+        if d is not None:
+            out.append(d)
+    return out

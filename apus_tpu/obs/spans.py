@@ -1,0 +1,251 @@
+"""Per-op stage spans: where a client op's time went, hop by hop.
+
+BENCH_r07's uncomfortable finding — the pipelined path is CPU-bound in
+Python framing/dedup/locks, not roundtrip-bound — was reached by
+process-of-elimination benchmarking.  This module makes that question
+answerable directly: a SAMPLED client op (by req_id, default 1 in 64,
+so every replica and the client pick the same ops with no propagated
+flag) is timestamped at each hop of the replication path, the stamps
+are kept in a bounded per-process ring, and at reply time the leader
+folds the stage-to-stage durations into the metrics registry's log2
+histograms — per-stage p50/p99 with no per-sample allocation.
+
+Stage taxonomy (write path; the canonical order is STAGE_ORDER):
+
+    client_send   client: request framed and handed to the socket
+    ingest        server: burst read off the wire (FrameStream drain)
+    lock          server: daemon node lock acquired for admission
+    admit         leader: submit() returned (dedup + enqueue done)
+    append        leader: entry holds a log index (group-commit drain)
+    repl          leader: first replication fan-out shipping the index
+    quorum        leader: commit advanced past the index (quorum ack)
+    apply         every replica: the entry applied to the SM
+    fsync         leader: the drain window's batch fdatasync covered it
+    reply         leader: reply bytes built for the flush
+    client_reply  client: reply frame parsed
+    follower_append  follower: one-sided log write landed the index
+    dev_dispatch / dev_ready  device plane: window dispatched/resolved
+                     (idx-range ring events, not per-op stamps)
+
+Stage durations are named for the later stamp of each adjacent pair
+(STAGE_DURATIONS); their per-op sum telescopes to reply - ingest,
+which is also observed as ``op_server_us`` — so summed stage p50s
+land within a few percent of the end-to-end p50 by construction.
+
+Timestamps are monotonic µs (comparable within a process; the ObsHub
+dump carries a wall/mono anchor so cross-process timelines align on
+wall time).  All mutation takes a small internal lock — acceptable
+because only sampled ops (1/64) ever reach it; the UNSAMPLED fast path
+is a single ``req_id & mask`` test.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from apus_tpu.obs.metrics import MetricsRegistry
+
+STAGE_ORDER = ("client_send", "ingest", "lock", "admit", "append",
+               "repl", "quorum", "apply", "fsync", "reply",
+               "client_reply")
+
+#: duration name of each adjacent (earlier-stage -> later-stage) pair,
+#: keyed by the LATER stage; observed into ``stage_<name>_us``.
+STAGE_DURATIONS = {
+    "lock": "lock_wait",
+    "admit": "dedup_admit",
+    "append": "append",
+    "repl": "repl_fanout",
+    "quorum": "quorum_ack",
+    "apply": "apply",
+    "fsync": "fsync",
+    "reply": "reply_flush",
+    "client_reply": "wire_out",
+}
+
+_ORDER_IDX = {s: i for i, s in enumerate(STAGE_ORDER)}
+
+
+def now_us() -> int:
+    return time.monotonic_ns() // 1000
+
+
+class SpanRecorder:
+    """Sampled per-op stage stamps + bounded event ring.
+
+    ``sample_period`` must be a power of two (rounded up otherwise);
+    an op is sampled iff ``req_id & (period - 1) == 0``.  Client
+    req_ids are per-client monotone from 1, so period 64 samples every
+    64th op of every client — and every process (client, leader,
+    followers) independently selects the SAME ops."""
+
+    OPEN_CAP = 1024
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 sample_period: int = 64, capacity: int = 8192):
+        self._reg = registry
+        period = max(1, int(sample_period))
+        if period & (period - 1):
+            period = 1 << period.bit_length()
+        self.sample_period = period
+        self._mask = period - 1
+        self.capacity = max(16, int(capacity))
+        self._lock = threading.Lock()
+        self._ring: list = [None] * self.capacity
+        self._seq = 0
+        self.dropped = 0
+        # (clt_id, req_id) -> {"stamps": {stage: t_us}, "idx", "term"}
+        self._open: dict[tuple, dict] = {}
+
+    # -- the hot-path gate -------------------------------------------------
+
+    def sampled(self, req_id: int) -> bool:
+        return (req_id & self._mask) == 0
+
+    @staticmethod
+    def now() -> int:
+        return now_us()
+
+    # -- stamping ----------------------------------------------------------
+
+    def _push(self, ev: tuple) -> None:
+        # Caller holds self._lock.
+        if self._seq >= self.capacity:
+            self.dropped += 1
+        self._ring[self._seq % self.capacity] = ev
+        self._seq += 1
+
+    def stamp(self, clt_id: int, req_id: int, stage: str,
+              t: Optional[int] = None, idx: Optional[int] = None,
+              term: Optional[int] = None, open_new: bool = True) -> None:
+        """Record one stage stamp for a sampled op.  ``open_new=False``
+        (follower-side stages) rings the event without tracking the op
+        in the open table — followers never see the reply, so their
+        opens would leak."""
+        if t is None:
+            t = now_us()
+        key = (clt_id, req_id)
+        with self._lock:
+            self._push((t, clt_id, req_id, stage, idx, term, None))
+            o = self._open.get(key)
+            if o is None:
+                if not open_new:
+                    return
+                if len(self._open) >= self.OPEN_CAP:
+                    # Evict the oldest abandoned op (lost leadership,
+                    # dead client): bounded memory beats completeness.
+                    self._open.pop(next(iter(self._open)))
+                o = self._open[key] = {"stamps": {}, "idx": idx,
+                                       "term": term}
+            o["stamps"].setdefault(stage, t)
+            if idx is not None:
+                o["idx"] = idx
+            if term is not None:
+                o["term"] = term
+
+    def stamp_range(self, stage: str, lo: int, hi: int,
+                    t: Optional[int] = None,
+                    term: Optional[int] = None) -> None:
+        """Stamp ``stage`` on every OPEN op whose log index falls in
+        [lo, hi) and lacks it — window-granular events (replication
+        fan-out, quorum ack) attributed to the sampled ops they
+        carried.  O(open) = O(sampled in flight), a handful."""
+        if lo >= hi:
+            return
+        if t is None:
+            t = now_us()
+        with self._lock:
+            for (clt_id, req_id), o in self._open.items():
+                oidx = o.get("idx")
+                if oidx is None or not (lo <= oidx < hi) \
+                        or stage in o["stamps"]:
+                    continue
+                o["stamps"][stage] = t
+                self._push((t, clt_id, req_id, stage, oidx,
+                            term if term is not None else o.get("term"),
+                            None))
+
+    def stamp_have(self, stage: str, require: str,
+                   t: Optional[int] = None) -> None:
+        """Stamp ``stage`` on every open op that already carries stamp
+        ``require`` but not ``stage`` (e.g. fsync covers everything
+        applied this drain window)."""
+        if t is None:
+            t = now_us()
+        with self._lock:
+            for (clt_id, req_id), o in self._open.items():
+                st = o["stamps"]
+                if require in st and stage not in st:
+                    st[stage] = t
+                    self._push((t, clt_id, req_id, stage, o.get("idx"),
+                                o.get("term"), None))
+
+    def window_event(self, stage: str, lo: int, hi: int,
+                     t: Optional[int] = None) -> None:
+        """Ring-only idx-range event (device dispatch/ready): no open
+        table, stitched into timelines by index overlap."""
+        if t is None:
+            t = now_us()
+        with self._lock:
+            self._push((t, 0, 0, stage, lo, None, hi))
+
+    # -- completion --------------------------------------------------------
+
+    def finish(self, clt_id: int, req_id: int) -> Optional[dict]:
+        """Close a sampled op: fold its stage-to-stage durations into
+        the registry histograms (``stage_<name>_us``) plus the
+        telescoped server end-to-end (``op_server_us``).  Returns the
+        stamps dict (tests/bench stitching) or None if unknown."""
+        with self._lock:
+            o = self._open.pop((clt_id, req_id), None)
+        if o is None:
+            return None
+        if self._reg is not None:
+            stamps = o["stamps"]
+            present = sorted((s for s in stamps if s in _ORDER_IDX),
+                             key=_ORDER_IDX.__getitem__)
+            for a, b in zip(present, present[1:]):
+                name = STAGE_DURATIONS.get(b)
+                if name is None:
+                    continue
+                self._reg.histogram(f"stage_{name}_us").observe(
+                    max(0, stamps[b] - stamps[a]))
+            if "ingest" in stamps and "reply" in stamps:
+                self._reg.histogram("op_server_us").observe(
+                    max(0, stamps["reply"] - stamps["ingest"]))
+            if "client_send" in stamps and "client_reply" in stamps:
+                self._reg.histogram("op_client_us").observe(
+                    max(0, stamps["client_reply"]
+                        - stamps["client_send"]))
+        return o
+
+    # -- export ------------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Chronological snapshot of the ring as JSON-able dicts."""
+        with self._lock:
+            n = min(self._seq, self.capacity)
+            start = self._seq - n
+            evs = [self._ring[(start + i) % self.capacity]
+                   for i in range(n)]
+        out = []
+        for ev in evs:
+            if ev is None:
+                continue
+            t, clt_id, req_id, stage, idx, term, hi = ev
+            d = {"t_us": t, "clt": clt_id, "req": req_id,
+                 "stage": stage}
+            if idx is not None:
+                d["idx"] = idx
+            if term is not None:
+                d["term"] = term
+            if hi is not None:
+                d["hi"] = hi
+            out.append(d)
+        return out
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)

@@ -1,0 +1,191 @@
+"""Cross-replica timeline: merge OP_OBS_DUMP rings and render them.
+
+    python -m apus_tpu.obs.timeline DUMP.json [DUMP2.json ...]
+    python -m apus_tpu.obs.timeline --addrs host:p0,host:p1 [-o DIR]
+
+Every per-replica dump carries monotonic-µs event stamps plus one
+wall/mono anchor; merging converts each event to wall time
+(ev_mono + (anchor_wall - anchor_mono)), so rings from different
+processes interleave correctly to within NTP-class skew — on one host
+(the harnesses' shape) they are microsecond-comparable.
+
+Two event kinds interleave:
+
+- flight events (role/term changes, CONFIG applies, lease grant/lapse,
+  snapshot stream begin/resume/end, fault injections, watchdog fires),
+- span stamps (sampled per-op stage hops), additionally STITCHED into
+  per-op groups keyed by (clt_id, req_id) and labeled with the op's
+  (term, idx) once known — the cross-replica trace of one client op.
+
+This module is also the harnesses' failure-dump library:
+``write_dump(dir, dumps)`` persists the raw dumps + rendered timeline
+(fuzz/soak call it when a violation or wedge ships a repro).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _wall(ev_t_us: int, anchor: dict) -> int:
+    return ev_t_us + (anchor.get("wall_us", 0)
+                      - anchor.get("mono_us", 0))
+
+
+def merge_dumps(dumps: list[dict]) -> list[dict]:
+    """Flatten per-replica dumps into one wall-clock-sorted event list.
+    Each event gains ``wall_us``, ``src`` (replica ident) and ``kind``
+    ("flight" | "span")."""
+    merged = []
+    for d in dumps:
+        anchor = d.get("anchor", {})
+        src = d.get("ident") or f"r{d.get('replica', '?')}"
+        for ev in d.get("flight", []):
+            e = dict(ev)
+            e["wall_us"] = _wall(ev.get("t_us", 0), anchor)
+            e["src"] = src
+            e["kind"] = "flight"
+            merged.append(e)
+        for ev in d.get("spans", []):
+            e = dict(ev)
+            e["wall_us"] = _wall(ev.get("t_us", 0), anchor)
+            e["src"] = src
+            e["kind"] = "span"
+            merged.append(e)
+    merged.sort(key=lambda e: e["wall_us"])
+    return merged
+
+
+def stitch_ops(merged: list[dict]) -> dict:
+    """Group span stamps by (clt_id, req_id) across every source —
+    the cross-replica trace of one sampled client op.  Returns
+    {(clt, req): {"term", "idx", "stamps": [event...]}} with stamps in
+    wall order."""
+    ops: dict = {}
+    for ev in merged:
+        if ev.get("kind") != "span" or not ev.get("req"):
+            continue
+        key = (ev.get("clt", 0), ev["req"])
+        o = ops.setdefault(key, {"term": None, "idx": None,
+                                 "stamps": []})
+        o["stamps"].append(ev)
+        if ev.get("idx") is not None:
+            o["idx"] = ev["idx"]
+        if ev.get("term") is not None:
+            o["term"] = ev["term"]
+    return ops
+
+
+def render(merged: list[dict], last_s: Optional[float] = None,
+           max_events: int = 2000) -> str:
+    """Human-readable timeline, relative to the last event ("-12.345ms"
+    = that long before the end — the shape of a black-box readout)."""
+    if not merged:
+        return "(no events)\n"
+    if last_s is not None:
+        cutoff = merged[-1]["wall_us"] - int(last_s * 1e6)
+        merged = [e for e in merged if e["wall_us"] >= cutoff]
+    if len(merged) > max_events:
+        merged = merged[-max_events:]
+    end = merged[-1]["wall_us"]
+    lines = []
+    for ev in merged:
+        dt_ms = (ev["wall_us"] - end) / 1000.0
+        src = ev.get("src", "?")
+        if ev.get("kind") == "span":
+            extra = " ".join(
+                f"{k}={ev[k]}" for k in ("req", "idx", "term", "hi")
+                if ev.get(k) is not None)
+            lines.append(f"[{dt_ms:>10.3f}ms] {src:<6} span   "
+                         f"{ev.get('stage', '?'):<16} {extra}")
+        else:
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(ev.items())
+                if k not in ("t_us", "wall_us", "src", "kind", "cat",
+                             "msg"))
+            msg = ev.get("msg", "")
+            lines.append(f"[{dt_ms:>10.3f}ms] {src:<6} flight "
+                         f"{ev.get('cat', '?'):<16} {msg} {extra}"
+                         .rstrip())
+    ops = stitch_ops(merged)
+    if ops:
+        lines.append("")
+        lines.append(f"-- {len(ops)} sampled op(s) stitched "
+                     f"(clt/req -> term,idx: stage@src...) --")
+        for (clt, req), o in sorted(ops.items(),
+                                    key=lambda kv: kv[1]["stamps"][0]
+                                    ["wall_us"]):
+            hops = " -> ".join(
+                f"{s.get('stage')}@{s.get('src')}"
+                for s in o["stamps"])
+            lines.append(f"  req={req} clt={clt & 0xFFFF:04x} "
+                         f"term={o['term']} idx={o['idx']}: {hops}")
+    return "\n".join(lines) + "\n"
+
+
+def write_dump(out_dir: str, dumps: list[dict],
+               tag: str = "obs") -> str:
+    """Persist raw dumps + rendered timeline; returns the timeline
+    path.  The harnesses' failure-dump entry point."""
+    os.makedirs(out_dir, exist_ok=True)
+    raw = os.path.join(out_dir, f"{tag}-dumps.json")
+    with open(raw, "w") as f:
+        json.dump({"dumps": dumps}, f)
+    txt = os.path.join(out_dir, f"{tag}-timeline.txt")
+    with open(txt, "w") as f:
+        f.write(render(merge_dumps(dumps)))
+    return txt
+
+
+def load_dumps(path: str) -> list[dict]:
+    """Load one dump file: a bare per-replica dump, a list of them, or
+    the ``write_dump`` envelope."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "dumps" in data:
+        return list(data["dumps"])
+    if isinstance(data, list):
+        return data
+    return [data]
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.obs.timeline",
+        description="Merge + render cross-replica observability dumps.")
+    ap.add_argument("files", nargs="*",
+                    help="dump JSON files (from OP_OBS_DUMP fetches or "
+                         "a harness failure dump)")
+    ap.add_argument("--addrs", default="",
+                    help="fetch live dumps from these replica "
+                         "endpoints (comma-separated host:port)")
+    ap.add_argument("--last", type=float, default=None,
+                    help="render only the last N seconds")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also persist raw dumps + timeline into this "
+                         "directory")
+    args = ap.parse_args(argv)
+
+    dumps: list[dict] = []
+    for path in args.files:
+        dumps.extend(load_dumps(path))
+    if args.addrs:
+        from apus_tpu.obs.service import collect_cluster_dumps
+        dumps.extend(collect_cluster_dumps(
+            [a for a in args.addrs.split(",") if a]))
+    if not dumps:
+        print("no dumps (give files and/or --addrs)", file=sys.stderr)
+        return 1
+    if args.out:
+        path = write_dump(args.out, dumps)
+        print(f"wrote {path}", file=sys.stderr)
+    sys.stdout.write(render(merge_dumps(dumps), last_s=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

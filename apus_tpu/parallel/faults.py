@@ -119,7 +119,8 @@ class FaultPlane(Transport):
     #: releases it far sooner).
     REORDER_HOLD_S = 0.05
 
-    def __init__(self, inner: Transport, seed: int = 0, logger=None):
+    def __init__(self, inner: Transport, seed: int = 0, logger=None,
+                 stats=None):
         self.inner = inner
         self.seed = seed
         self.logger = logger
@@ -129,10 +130,20 @@ class FaultPlane(Transport):
         self._crashed = False
         self.crash_hooks: list[Callable[[], None]] = []
         self.restart_hooks: list[Callable[[], None]] = []
-        #: injected-fault counters (observability + test assertions)
-        self.stats = {"drops": 0, "delays": 0, "dups": 0, "reorders": 0,
-                      "blocked": 0, "throttles": 0, "inbound_drops": 0,
-                      "inbound_delays": 0}
+        #: injected-fault counters (observability + test assertions):
+        #: fault_* registry namespace (shared ObsHub view when the
+        #: daemon passes one), dict-compatible with the legacy surface.
+        if stats is None:
+            from apus_tpu.obs.metrics import MetricsRegistry
+            stats = MetricsRegistry().view("fault")
+        self.stats = stats
+        for k in ("drops", "delays", "dups", "reorders", "blocked",
+                  "throttles", "inbound_drops", "inbound_delays"):
+            self.stats.setdefault(k, 0)
+        #: black-box hook (ObsHub flight recorder, daemon-installed):
+        #: scripted fault commands land in the ring so a failure dump
+        #: shows what was injected around the violation.
+        self.flight = None
         # reorder holds: peer -> Event released by the next op
         self._holds: dict[int, threading.Event] = {}
         self._schedule: list[dict] = []
@@ -279,11 +290,11 @@ class FaultPlane(Transport):
         dropped (blocked / crashed / drop draw)."""
         with self._lock:
             if self._crashed:
-                self.stats["blocked"] += 1
+                self.stats.bump("blocked")
                 return False
             st = self._state(target)
             if st.blocked:
-                self.stats["blocked"] += 1
+                self.stats.bump("blocked")
                 return False
             throttle = st.throttle
             delay = (self.rng.uniform(st.delay_lo, st.delay_hi)
@@ -295,15 +306,15 @@ class FaultPlane(Transport):
             release = self._holds.pop(target, None)
             if reorder:
                 hold = self._holds[target] = threading.Event()
-                self.stats["reorders"] += 1
+                self.stats.bump("reorders")
         # Sleeps OUTSIDE the lock (concurrent peers must not serialize).
         if release is not None:
             release.set()               # we are the "next op": release
         if throttle > 0:
-            self.stats["throttles"] += 1
+            self.stats.bump("throttles")
             self._sleep_yielding(throttle)
         if delay > 0:
-            self.stats["delays"] += 1
+            self.stats.bump("delays")
             self._sleep_yielding(delay)
         if hold is not None:
             # Park until the NEXT op to this peer passes _pre (which
@@ -324,7 +335,7 @@ class FaultPlane(Transport):
                 if self._holds.get(target) is hold:
                     del self._holds[target]
         if dropped:
-            self.stats["drops"] += 1
+            self.stats.bump("drops")
             return False
         return True
 
@@ -332,7 +343,7 @@ class FaultPlane(Transport):
         with self._lock:
             st = self._state(target)
             if st.dup > 0 and self.rng.random() < st.dup:
-                self.stats["dups"] += 1
+                self.stats.bump("dups")
                 return True
         return False
 
@@ -429,10 +440,10 @@ class FaultPlane(Transport):
                 delay = (self.rng.uniform(st.delay_lo, st.delay_hi)
                          if st is not None and st.delay_hi > 0 else 0.0)
             if delay > 0:
-                self.stats["inbound_delays"] += 1
+                self.stats.bump("inbound_delays")
                 time.sleep(delay)
             if drop:
-                self.stats["inbound_drops"] += 1
+                self.stats.bump("inbound_drops")
                 if self.logger is not None:
                     self.logger.warning("faultplane: dropping inbound "
                                         "%s message", tag)
@@ -464,6 +475,9 @@ def apply_command(plane: FaultPlane, cmd: dict) -> dict:
     """Apply one scripting command (shared by wire op + schedules).
     Returns a result dict (counters for ``stats``)."""
     c = cmd.get("cmd")
+    if plane.flight is not None and c != "stats":
+        plane.flight.note("fault", c, **{k: v for k, v in cmd.items()
+                                         if k != "cmd"})
     if c == "drop":
         plane.set_drop(cmd.get("peer", "*"), cmd["p"])
     elif c == "delay":
@@ -623,11 +637,18 @@ def config_from_env(env: Optional[dict] = None) -> Optional[dict]:
     return cfg
 
 
-def build_plane(inner: Transport, cfg: dict, logger=None) -> FaultPlane:
+def build_plane(inner: Transport, cfg: dict, logger=None,
+                obs=None) -> FaultPlane:
     """Construct + configure a FaultPlane from a config dict (the
     ``config_from_env`` / ClusterSpec shape).  The schedule is loaded
-    but NOT armed — the daemon arms it once it serves."""
-    plane = FaultPlane(inner, seed=int(cfg.get("seed", 0)), logger=logger)
+    but NOT armed — the daemon arms it once it serves.  ``obs`` (an
+    ObsHub) routes the injected-fault counters into the shared
+    registry and scripted commands into the flight recorder."""
+    plane = FaultPlane(inner, seed=int(cfg.get("seed", 0)), logger=logger,
+                       stats=obs.view("fault") if obs is not None
+                       else None)
+    if obs is not None:
+        plane.flight = obs.flight
     for peer, p in cfg.get("drop", []):
         plane.set_drop(peer, p)
     for peer, lo, hi in cfg.get("delay", []):
@@ -646,7 +667,7 @@ def build_plane(inner: Transport, cfg: dict, logger=None) -> FaultPlane:
 
 
 def maybe_wrap(inner: Transport, spec=None, logger=None,
-               env: Optional[dict] = None) -> Transport:
+               env: Optional[dict] = None, obs=None) -> Transport:
     """The daemon's single integration point: wrap ``inner`` when the
     fault plane is enabled by spec (``fault_plane=True``) or any
     APUS_FAULT_* env var; otherwise return ``inner`` untouched (zero
@@ -666,4 +687,4 @@ def maybe_wrap(inner: Transport, spec=None, logger=None,
                     cfg["schedule"] = json.load(f)
             else:
                 cfg["schedule"] = json.loads(sched)
-    return build_plane(inner, cfg, logger=logger)
+    return build_plane(inner, cfg, logger=logger, obs=obs)

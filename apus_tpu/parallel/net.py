@@ -37,6 +37,7 @@ from apus_tpu.core.cid import Cid
 from apus_tpu.core.log import LogEntry
 from apus_tpu.core.node import Node
 from apus_tpu.core.sid import Sid
+from apus_tpu.obs.metrics import MetricsRegistry
 from apus_tpu.parallel import onesided, wire
 from apus_tpu.parallel.transport import (LogState, Region, Transport,
                                          WriteResult)
@@ -54,10 +55,17 @@ class PeerServer:
     def __init__(self, node_ref: Callable[[], Node], lock: threading.RLock,
                  host: str = "127.0.0.1", port: int = 0,
                  sock: Optional[socket.socket] = None,
-                 extra_ops: Optional[dict] = None, logger=None):
+                 extra_ops: Optional[dict] = None, logger=None,
+                 stats=None):
         self._node_ref = node_ref
         self._lock = lock
         self._logger = logger
+        #: ingest observability (srv_* namespace when the daemon passes
+        #: its ObsHub view): how many frames arrive per burst drain —
+        #: the direct evidence that pipelined clients coalesce on the
+        #: wire (the de-flaked throughput smoke asserts on it).
+        self.stats = stats if stats is not None \
+            else MetricsRegistry().view("srv")
         # extra_ops: op byte -> handler(body_reader) -> response payload
         # (used by the runtime for JOIN / snapshot-fetch, which are
         # two-sided control messages, not one-sided region ops).
@@ -170,8 +178,11 @@ class PeerServer:
                     batch.append(more)
                 eof = stream.at_eof
                 if len(batch) == 1:
+                    self.stats.bump("ingest_solo")
                     conn.sendall(wire.frame(self._dispatch(req)))
                 else:
+                    self.stats.bump("ingest_batches")
+                    self.stats.bump("ingest_frames", len(batch))
                     replies = None
                     hook = self.batch_hook
                     if hook is not None:
@@ -232,8 +243,7 @@ class PeerServer:
             winc = r.u32() if r.remaining >= 4 else None
             if winc is not None \
                     and winc < node.fence_epochs.get(slot, 0):
-                node.stats["fenced_ctrl_writes"] = \
-                    node.stats.get("fenced_ctrl_writes", 0) + 1
+                node.bump("fenced_ctrl_writes")
                 return wire.u8(wire.ST_FENCED) + wire.u64(node.sid.word)
             res = onesided.apply_ctrl_write(node, region, slot, value)
             # Read-lease support (live stack only — the sim path calls
@@ -332,7 +342,7 @@ class NetTransport(Transport):
     def __init__(self, peers: dict[int, tuple[str, int]],
                  timeout: float = 0.2, backoff: float = 0.5,
                  yield_lock: Optional[threading.RLock] = None,
-                 retries: int = 1):
+                 retries: int = 1, stats=None):
         self.peers = dict(peers)
         self.timeout = timeout
         self.backoff = backoff
@@ -353,7 +363,13 @@ class NetTransport(Transport):
         #: safe.
         self.retries = retries
         self._retry_rng = random.Random(0x5EED ^ len(peers))
-        self.stats = {"retries": 0, "retries_ok": 0}
+        # net_* registry namespace (shared ObsHub view when the daemon
+        # passes one; private registry otherwise) — dict-compatible
+        # with the legacy ``stats`` surface.
+        self.stats = stats if stats is not None \
+            else MetricsRegistry().view("net")
+        self.stats.setdefault("retries", 0)
+        self.stats.setdefault("retries_ok", 0)
         #: Our node's current incarnation (the epoch of the CONFIG that
         #: admitted this tenancy of our slot), stamped onto every
         #: outbound ctrl write for the receiver's removed-slot fence.
@@ -553,7 +569,7 @@ class NetTransport(Transport):
                             raise ConnectionError("peer closed")
                         self._timeout_hint.pop(target, None)
                         if attempt > 0:
-                            self.stats["retries_ok"] += 1
+                            self.stats.bump("retries_ok")
                         return resp
                     except TimeoutError:
                         # Timeout on an ESTABLISHED connection: the
@@ -584,7 +600,7 @@ class NetTransport(Transport):
                             # bounded (a fraction of one dial backoff),
                             # and safe because one-sided ops are
                             # idempotent (module docstring).
-                            self.stats["retries"] += 1
+                            self.stats.bump("retries")
                             time.sleep(
                                 self._retry_rng.uniform(0.25, 0.75)
                                 * min(self.backoff, 0.05))
@@ -739,10 +755,8 @@ class NetTransport(Transport):
             if off > total:              # corrupt reply: start over
                 off = 0
             else:
-                self.stats["snap_resumes"] = \
-                    self.stats.get("snap_resumes", 0) + 1
-                self.stats["snap_resumed_bytes"] = \
-                    self.stats.get("snap_resumed_bytes", 0) + off
+                self.stats.bump("snap_resumes")
+                self.stats.bump("snap_resumed_bytes", off)
         while off < total:
             n = min(self.SNAP_CHUNK_BYTES, total - off)
             data = read_chunk(off, n)
@@ -752,16 +766,14 @@ class NetTransport(Transport):
                        + wire.u64(writer_sid.word) + wire.u64(off)
                        + wire.blob(data)
                        + wire.u32(zlib.crc32(data) & 0xFFFFFFFF))
-            self.stats["snap_chunks_sent"] = \
-                self.stats.get("snap_chunks_sent", 0) + 1
+            self.stats.bump("snap_chunks_sent")
             resp = self._roundtrip(target, payload)
             if resp is None:
                 return WriteResult.DROPPED
             res = _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
             if res != WriteResult.OK:
                 return res
-            self.stats["snap_chunks_acked"] = \
-                self.stats.get("snap_chunks_acked", 0) + 1
+            self.stats.bump("snap_chunks_acked")
             rr = wire.Reader(resp[1:])
             acked = rr.u64() if rr.remaining >= 8 else off + n
             # The receiver acks its durable progress: normally off+n;

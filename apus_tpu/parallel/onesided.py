@@ -52,6 +52,14 @@ def apply_log_write(node: Node, writer_sid: Sid, entries: list[LogEntry],
         if node.log.is_full:
             break
         node.log.write(dataclasses.replace(e))
+        # Stage span (cross-replica stitch): the sampled entry landed
+        # in THIS follower's log (ring-only — followers never see the
+        # reply, so no open-table entry).
+        if node.obs is not None and e.req_id > 0 \
+                and node.obs.spans.sampled(e.req_id):
+            node.obs.spans.stamp(e.clt_id, e.req_id, "follower_append",
+                                 idx=e.idx, term=e.term,
+                                 open_new=False)
     node.log.advance_commit(min(commit, node.log.end))
     return WriteResult.OK
 
@@ -287,17 +295,17 @@ def apply_snap_begin(node: Node, writer_sid: Sid, total: int,
             except (OSError, ValueError):
                 stale = None
             if stale == ident:
-                node.stats["snap_chunk_quarantines"] = \
-                    node.stats.get("snap_chunk_quarantines", 0) + 1
+                node.bump("snap_chunk_quarantines")
             _snap_session_drop(node)
     else:
         _snap_session_drop(node)
 
+    node._note("snap_stream", "begin", sender=writer_sid.idx,
+               total=total, resume=resume)
     crcs: list = []
     if resume:
         import zlib
-        node.stats["snap_stream_resumes"] = \
-            node.stats.get("snap_stream_resumes", 0) + 1
+        node.bump("snap_stream_resumes")
         with open(part, "r+b") as tf:
             tf.truncate(resume)
         f = open(part, "r+b")
@@ -349,8 +357,8 @@ def apply_snap_chunk(node: Node, writer_sid: Sid, off: int,
     if crc is not None:
         import zlib
         if (zlib.crc32(data) & 0xFFFFFFFF) != (crc & 0xFFFFFFFF):
-            node.stats["snap_chunk_quarantines"] = \
-                node.stats.get("snap_chunk_quarantines", 0) + 1
+            node.bump("snap_chunk_quarantines")
+            node._note("snap_stream", "chunk_quarantine", off=off)
             _snap_session_drop(node)
             return WriteResult.REFUSED, 0   # damaged on the wire
     if off + len(data) <= sess["got"]:
@@ -391,6 +399,8 @@ def apply_snap_end(node: Node, writer_sid: Sid) -> WriteResult:
     ok = node.install_snapshot(sess["meta"], sess["ep_dump"],
                                sess["cid"], sess["members"],
                                data_path=sess["path"], adopt=True)
+    node._note("snap_stream", "end", sender=writer_sid.idx,
+               installed=bool(ok), total=sess["total"])
     # The checkpoint sidecar is dead either way; _snap_session_drop's
     # unlink of the part file is a no-op if the SM adopted (renamed)
     # it, and the needed cleanup otherwise.

@@ -1001,8 +1001,7 @@ class Bridge:
         snapshot prime uses (_on_snapshot)."""
         with self.daemon.lock:
             records = self._sm_records()
-            self.daemon.node.stats["replay_reprimes"] = \
-                self.daemon.node.stats.get("replay_reprimes", 0) + 1
+            self.daemon.node.bump("replay_reprimes")
         out: list[tuple[int, int, bytes]] = []
         for rec in records:
             try:

@@ -46,8 +46,17 @@ def make_client_ops(daemon) -> dict:
     def clt_write(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
         data = r.blob()
+        obs = daemon.obs
+        sp = obs.spans if obs is not None else None
+        traced = sp is not None and sp.sampled(req_id)
+        if traced:
+            sp.stamp(clt_id, req_id, "ingest")
         with daemon.lock:
+            if traced:
+                sp.stamp(clt_id, req_id, "lock")
             pr = daemon.node.submit(req_id, clt_id, data)
+            if traced:
+                sp.stamp(clt_id, req_id, "admit")
         if pr is None:
             return _not_leader(daemon, req_id)
         deadline = time.monotonic() + daemon.client_op_timeout
@@ -57,6 +66,9 @@ def make_client_ops(daemon) -> dict:
                 # entry applied) — apply position alone can be satisfied
                 # by a different entry after truncation.
                 if pr.reply is not None:
+                    if traced:
+                        sp.stamp(clt_id, req_id, "reply", idx=pr.idx)
+                        sp.finish(clt_id, req_id)
                     return (wire.u8(wire.ST_OK) + wire.u64(req_id)
                             + wire.blob(pr.reply))
                 if not daemon.node.is_leader:
@@ -203,6 +215,18 @@ def make_client_ops(daemon) -> dict:
                 "drain_windows": n.stats.get("drain_windows", 0),
                 "drain_entries": n.stats.get("drain_entries", 0),
                 "repl_windows": n.stats.get("repl_windows", 0),
+                # Wire-ingest coalescing (PeerServer burst drains):
+                # frames/batch is the direct proof pipelined clients
+                # coalesce on the wire — the de-flaked throughput
+                # smoke asserts on these instead of wall clock.
+                "ingest_batches": daemon.server.stats.get(
+                    "ingest_batches", 0),
+                "ingest_frames": daemon.server.stats.get(
+                    "ingest_frames", 0),
+                "ingest_solo": daemon.server.stats.get("ingest_solo",
+                                                       0),
+                # Observability plane: OP_METRICS/OP_OBS_DUMP served?
+                "obs": daemon.obs is not None,
                 # Disk-fault containment observability: I/O errors on
                 # the persistence path and whether they disabled it
                 # (the replica keeps serving; see daemon._persist_fail).
@@ -295,6 +319,18 @@ def make_client_batch_hook(daemon):
             parsed.append((op, r.u64(), r.u64(), r.blob()))
         handles: list = [None] * len(parsed)
         registered = [False] * len(parsed)
+        # Per-op stage spans (write ops, req_id-sampled): the whole
+        # burst shares one ingest/lock stamp time — stamps here are
+        # batch-granular by design (that IS the group-commit shape).
+        obs = daemon.obs
+        sp = obs.spans if obs is not None else None
+        traced: list[int] = []
+        if sp is not None:
+            t_ingest = sp.now()
+            for i, (op, rid, cid_, _d) in enumerate(parsed):
+                if op == OP_CLT_WRITE and sp.sampled(rid):
+                    sp.stamp(cid_, rid, "ingest", t=t_ingest)
+                    traced.append(i)
 
         def _register_read(i: int) -> None:
             """Register read i once every preceding write of the burst
@@ -314,10 +350,20 @@ def make_client_batch_hook(daemon):
             registered[i] = True
 
         with daemon.lock:
+            if traced:
+                t_lock = sp.now()
+                for i in traced:
+                    sp.stamp(parsed[i][2], parsed[i][1], "lock",
+                             t=t_lock)
             for i, (op, req_id, clt_id, data) in enumerate(parsed):
                 if op == OP_CLT_WRITE:
                     handles[i] = daemon.node.submit(req_id, clt_id, data)
                     registered[i] = True
+            if traced:
+                t_admit = sp.now()
+                for i in traced:
+                    sp.stamp(parsed[i][2], parsed[i][1], "admit",
+                             t=t_admit)
             daemon.node.flush_pending()
             for i, (op, *_rest) in enumerate(parsed):
                 if op == OP_CLT_READ:
@@ -343,6 +389,11 @@ def make_client_batch_hook(daemon):
                     return False
                 replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
                               + wire.blob(h.reply))
+                if sp is not None and sp.sampled(req_id):
+                    # Reply built: close the span (folds the stage
+                    # durations into the registry histograms).
+                    sp.stamp(_clt, req_id, "reply", idx=h.idx)
+                    sp.finish(_clt, req_id)
                 return True
             if not h.done:
                 return False
@@ -476,8 +527,13 @@ class ApusClient:
 
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
                  timeout: float = 5.0, attempt_timeout: float = 2.0,
-                 history=None):
+                 history=None, tracer=None):
         self.peers = [self._parse(p) for p in peers]
+        #: Optional client-side span recorder (apus_tpu.obs.spans.
+        #: SpanRecorder): sampled ops get client_send/client_reply
+        #: stamps, stitched against the replicas' rings by (clt_id,
+        #: req_id) — bench.py --breakdown wires one in.
+        self.tracer = tracer
         #: Optional consistency-audit tap (apus_tpu.audit.history.
         #: HistoryRecorder): every op — serial and pipelined — reports
         #: its invoke/response interval and outcome.  Timeouts complete
@@ -568,6 +624,10 @@ class ApusClient:
             items.append((op, self._req_seq, data))
             if self.history is not None:
                 self.history.invoke(self.clt_id, self._req_seq, op, data)
+            if self.tracer is not None \
+                    and self.tracer.sampled(self._req_seq):
+                self.tracer.stamp(self.clt_id, self._req_seq,
+                                  "client_send")
         results: dict[int, bytes] = {}
         deadline = time.monotonic() + self.timeout
         target = self._leader
@@ -662,6 +722,11 @@ class ApusClient:
                     if self.history is not None:
                         self.history.complete(self.clt_id, rid, "ok",
                                               results[rid])
+                    if self.tracer is not None \
+                            and self.tracer.sampled(rid):
+                        self.tracer.stamp(self.clt_id, rid,
+                                          "client_reply")
+                        self.tracer.finish(self.clt_id, rid)
                 elif st == ST_NOT_LEADER:
                     hint = wire.Reader(resp[9:]).blob().decode() \
                         if len(resp) > 9 else ""
@@ -697,6 +762,19 @@ class ApusClient:
         """One client op with audit capture: the whole retry chain is
         one recorded interval; timeouts are ambiguous (maybe-applied),
         server errors are ambiguous-for-writes."""
+        if self.tracer is not None and self.tracer.sampled(req_id):
+            self.tracer.stamp(self.clt_id, req_id, "client_send")
+            try:
+                reply = self._op_history(op, req_id, data)
+            except BaseException:
+                self.tracer.finish(self.clt_id, req_id)
+                raise
+            self.tracer.stamp(self.clt_id, req_id, "client_reply")
+            self.tracer.finish(self.clt_id, req_id)
+            return reply
+        return self._op_history(op, req_id, data)
+
+    def _op_history(self, op: int, req_id: int, data: bytes) -> bytes:
         if self.history is None:
             return self._op_raw(op, req_id, data)
         self.history.invoke(self.clt_id, req_id, op, data)

@@ -68,20 +68,29 @@ class ReplicaDaemon:
         self.logger = make_logger(f"apus.srv{idx}", log_file)
         self._tick_interval = tick_interval
 
+        # Observability plane (apus_tpu.obs): one hub per replica —
+        # shared metrics registry (all the stats views below), sampled
+        # per-op stage spans, and the black-box flight recorder.
+        # APUS_OBS=0 disables it; components then fall back to private
+        # registries (the legacy stats surface stays alive).
+        from apus_tpu.obs import make_hub
+        self.obs = make_hub(ident=f"r{idx}")
+
         peers = {i: _parse_peer(a) for i, a in enumerate(spec.peers)}
         # Dial backoff scaled to the timing envelope: at the production
         # envelope (hb=1 ms) a 0.5 s backoff would leave a transiently
         # unreachable peer unreplicated for hundreds of heartbeats.
         net = NetTransport(
             peers, yield_lock=self.lock,
-            backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)))
+            backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)),
+            stats=self.obs.view("net") if self.obs is not None else None)
         self.transport = net
         # Live-stack fault plane (parallel.faults): only wraps when the
         # spec or APUS_FAULT_* env enables it — a production daemon's
         # transport is untouched.
         from apus_tpu.parallel.faults import maybe_wrap
         self.transport = maybe_wrap(self.transport, spec=spec,
-                                    logger=self.logger)
+                                    logger=self.logger, obs=self.obs)
         cfg = NodeConfig(
             idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
@@ -101,6 +110,10 @@ class ReplicaDaemon:
                           - 128))
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
+        if self.obs is not None:
+            # node_* counters land in the shared registry; span stamps
+            # and flight notes engage (sim nodes never attach).
+            self.node.attach_obs(self.obs)
         # Incarnation fencing: a joiner's tenancy starts at the epoch
         # of the CONFIG that admitted it (the cid the join reply
         # carried); static members start at 0.  The transport stamps
@@ -131,7 +144,9 @@ class ReplicaDaemon:
         self.server = PeerServer(lambda: self.node, self.lock,
                                  host=host, port=port, sock=listen_sock,
                                  extra_ops=self._extra_ops(),
-                                 logger=self.logger)
+                                 logger=self.logger,
+                                 stats=self.obs.view("srv")
+                                 if self.obs is not None else None)
         # Pipelined client bursts: admit a whole burst of client ops
         # under one lock acquisition + one commit wait (group-commit
         # admission; see make_client_batch_hook).
@@ -230,6 +245,10 @@ class ReplicaDaemon:
         from apus_tpu.runtime.client import make_client_ops
         from apus_tpu.runtime.membership import make_membership_ops
         ops = {**make_client_ops(self), **make_membership_ops(self)}
+        if self.obs is not None:
+            # OP_METRICS scrape + OP_OBS_DUMP flight/span readout.
+            from apus_tpu.obs.service import make_obs_ops
+            ops.update(make_obs_ops(self))
         if isinstance(self.transport, FaultPlane):
             # Remote fault scripting: tests compose cluster-wide
             # partitions by scripting each member's plane over the wire.
@@ -347,6 +366,9 @@ class ReplicaDaemon:
             self.logger.error(
                 "removed from the group (a live leader excludes slot "
                 "%d); re-joining in place at %s", self.idx, my_addr)
+            if self.obs is not None:
+                self.obs.flight.note("watchdog", "exclusion_rejoin",
+                                     slot=self.idx)
             try:
                 slot, cid, _peers = request_join(
                     [p for i, p in enumerate(self.spec.peers)
@@ -396,6 +418,10 @@ class ReplicaDaemon:
                 p.prepare_compact(cap)
                 with self.lock:
                     p.finish_compact(cap)
+                if self.obs is not None:
+                    self.obs.flight.note(
+                        "watchdog", "compaction",
+                        floor=p.compaction_floor)
             except OSError as exc:
                 # A failed compaction leaves the OLD store authoritative
                 # (abort drains the queued appends back into it) — log
@@ -456,6 +482,9 @@ class ReplicaDaemon:
         if self.persist_disabled:
             return
         self.persist_disabled = True
+        if self.obs is not None:
+            self.obs.flight.note("persist", "disabled", stage=stage,
+                                 error=repr(exc))
         self.logger.error(
             "PERSISTENCE DISABLED for this session: %s failed (%s); "
             "continuing to serve — durability of acked writes remains "
@@ -511,11 +540,19 @@ class ReplicaDaemon:
             for e in entries:
                 for cb in self.on_commit:
                     cb(e)
+            applied_this_tick = True
+        else:
+            applied_this_tick = False
         if self.persistence is not None:
             # Batch sync policy: ONE fdatasync per drain window,
             # amortized over every record this tick appended (entries
             # and snapshot records alike); no-op when nothing appended.
             self._persist_flush()
+            if applied_this_tick and self.obs is not None \
+                    and not self.persist_disabled:
+                # Stage span: the drain window's batch fdatasync now
+                # covers every sampled op applied this tick.
+                self.obs.spans.stamp_have("fsync", require="apply")
 
     def _handle_config_entry(self, e: LogEntry) -> None:
         """Applied CONFIG entry: learn new peers (the poll_config_entries
@@ -556,6 +593,12 @@ class ReplicaDaemon:
         role = (self.node.role, self.node.current_term)
         if role != self._last_role:
             self._last_role = role
+            if self.obs is not None:
+                # Black box: role/term transitions, edge-triggered.
+                self.obs.flight.note(
+                    "role", self.node.role.name,
+                    term=self.node.current_term,
+                    commit=self.node.log.commit)
             # Leader banner greppable by ops tooling, matching the
             # "[T<term>] LEADER" lines run.sh greps (run.sh:46-68).
             if self.node.is_leader:

@@ -923,6 +923,8 @@ class DevicePlaneDriver:
             node.external_commit = False
             self._cooldown_until = time.monotonic() + window
             self.stats["fallbacks"] += 1
+            node._note("watchdog", "devplane_stall_fallback",
+                       window_s=round(window, 3))
             self.logger.warning("device plane stalled; host commit path "
                                 "re-enabled")
 
@@ -1200,6 +1202,13 @@ class DevicePlaneDriver:
         live = live_now
 
         # -- device dispatch outside the daemon lock --
+        obs = getattr(self.daemon, "obs", None)
+        if obs is not None:
+            # Device-plane span: window [end0, end0+K*B) handed to the
+            # jitted engine (idx-range ring event; dev_ready pairs it
+            # at commit adoption).
+            obs.spans.window_event("dev_dispatch", end0,
+                                   end0 + span_rounds * B)
         handle = None
         win = None
         self.daemon.lock.release()
@@ -1297,9 +1306,15 @@ class DevicePlaneDriver:
             after = node.log.advance_commit(min(dev_commit, node.log.end))
             if after > before:
                 self._last_commit_advance = time.monotonic()
-                node.stats["commits"] += 1
-                node.stats["devplane_commits"] = \
-                    node.stats.get("devplane_commits", 0) + 1
+                obs = getattr(self.daemon, "obs", None)
+                if obs is not None:
+                    # Device quorum advanced commit: pair of the
+                    # dev_dispatch event, plus the per-op quorum stage
+                    # for sampled ops in the window.
+                    obs.spans.window_event("dev_ready", before, after)
+                    obs.spans.stamp_range("quorum", before, after)
+                node.bump("commits")
+                node.bump("devplane_commits")
                 self.daemon.commit_cond.notify_all()
 
     def _reset_for_leadership(self, node, term: int) -> bool:

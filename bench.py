@@ -844,6 +844,173 @@ def _bench_throughput() -> None:
     print(json.dumps(result), flush=True)
 
 
+def _bench_breakdown() -> None:
+    """--breakdown mode: per-stage latency decomposition of the
+    pipelined PUT path (the paper's per-stage evaluation axis, and the
+    baseline the native-hot-path PR must beat stage by stage).
+
+    Drives P pipelined clients against a live LocalCluster with the
+    observability plane sampling aggressively (APUS_OBS_SAMPLE=16),
+    then reads the answer two ways:
+
+    - STITCHED (exact): the daemons' span rings + the clients' tracers
+      live in this process, so every sampled op's stamps stitch into
+      exact per-stage durations — the banked per-stage p50/p99 table,
+      with wire_in/wire_out (client <-> server hops) included.
+    - SCRAPED (wire path): OP_METRICS histograms from the leader — the
+      log2-bucket per-stage p50s a production scrape would see,
+      reported alongside for cross-validation.
+
+    Stage durations telescope (their per-op sum == server e2e), so the
+    acceptance check "sum of stage p50s within 20% of end-to-end p50"
+    is reported as ``stage_sum_vs_e2e``.  Env knobs: APUS_BRK_CLIENTS
+    (4), APUS_BRK_SECONDS (3.0), APUS_BRK_REPLICAS (3)."""
+    import statistics
+    import threading
+
+    from apus_tpu.obs.service import fetch_metrics
+    from apus_tpu.obs.spans import STAGE_DURATIONS, SpanRecorder
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    P = int(os.environ.get("APUS_BRK_CLIENTS", "4"))
+    seconds = float(os.environ.get("APUS_BRK_SECONDS", "3.0"))
+    R = int(os.environ.get("APUS_BRK_REPLICAS", "3"))
+    os.environ.setdefault("APUS_OBS_SAMPLE", "16")
+    sample = int(os.environ["APUS_OBS_SAMPLE"])
+
+    tracers = [SpanRecorder(sample_period=sample, capacity=16384)
+               for _ in range(P)]
+    with LocalCluster(R) as c:
+        leader = c.wait_for_leader(30.0)
+        peers = list(c.spec.peers)
+        stop_at = time.monotonic() + seconds
+        done = [0] * P
+
+        def worker(w: int):
+            with ApusClient(peers, timeout=30.0,
+                            tracer=tracers[w]) as cl:
+                i = 0
+                while time.monotonic() < stop_at:
+                    cl.pipeline_puts(
+                        [(b"b%d-%d-%d" % (w, i, j), b"v" * 64)
+                         for j in range(64)])
+                    done[w] += 64
+                    i += 1
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(P)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+
+        # -- stitch: in-process rings, exact monotonic stamps ----------
+        ops: dict[tuple, dict] = {}
+        sources = [d.obs.spans.events() for d in c.daemons
+                   if d is not None and d.obs is not None]
+        sources += [tr.events() for tr in tracers]
+        for evs in sources:
+            for ev in evs:
+                if not ev.get("req"):
+                    continue
+                key = (ev.get("clt", 0), ev["req"])
+                ops.setdefault(key, {})[ev["stage"]] = \
+                    min(ops.get(key, {}).get(ev["stage"], 1 << 62),
+                        ev["t_us"])
+        scraped = fetch_metrics(peers[leader.idx], timeout=5.0) or {}
+
+    order = ["client_send", "ingest", "lock", "admit", "append",
+             "repl", "quorum", "apply", "fsync", "reply",
+             "client_reply"]
+    names = {"ingest": "wire_in", **STAGE_DURATIONS}
+    durs: dict[str, list] = {}
+    e2e_server, e2e_client = [], []
+    server_stages = [s for s in order
+                     if s not in ("client_send", "client_reply",
+                                  "ingest")]
+    for stamps in ops.values():
+        present = [s for s in order if s in stamps]
+        # Only fully-telescoped chains keep the sum == e2e identity
+        # (ring wrap can drop an op's early stamps): client bracket +
+        # server bracket required.
+        if not all(s in stamps for s in ("client_send", "ingest",
+                                         "reply", "client_reply")):
+            continue
+        for a, b in zip(present, present[1:]):
+            durs.setdefault(names.get(b, b), []).append(
+                max(0, stamps[b] - stamps[a]))
+        e2e_server.append(stamps["reply"] - stamps["ingest"])
+        e2e_client.append(stamps["client_reply"]
+                          - stamps["client_send"])
+
+    def pcts(vals):
+        if not vals:
+            return None
+        vs = sorted(vals)
+        return {"p50": round(statistics.median(vs), 1),
+                "p99": round(vs[min(len(vs) - 1,
+                                    int(0.99 * len(vs)))], 1),
+                "n": len(vs)}
+
+    stages = {name: pcts(v) for name, v in durs.items() if v}
+    srv_stage_names = [names[s] for s in server_stages
+                       if names.get(s, s) in stages]
+    # The acceptance chain: EVERY named stage of the full client-to-
+    # client telescope (wire_in + server stages + wire_out); their
+    # per-op durations sum exactly to the client e2e, so the p50 sum
+    # tracks the e2e p50.
+    chain_names = [names.get(s, s) for s in order[1:]]
+    chain_names = [n for n in chain_names if n in stages]
+    stage_p50_sum = sum(stages[n]["p50"] for n in chain_names)
+    srv_p50_sum = sum(stages[n]["p50"] for n in srv_stage_names)
+    e2e = pcts(e2e_client) or {"p50": 0.0}
+    e2e_srv = pcts(e2e_server) or {"p50": 0.0}
+    ratio = stage_p50_sum / e2e["p50"] if e2e["p50"] else 0.0
+
+    met = scraped.get("metrics", {})
+    scraped_stages = {
+        k: {"p50": v.get("p50"), "p99": v.get("p99"),
+            "n": v.get("count")}
+        for k, v in met.items()
+        if v.get("type") == "histogram" and v.get("count")}
+
+    result = {
+        "metric": "pipelined_put_stage_breakdown",
+        "value": e2e["p50"],
+        "unit": "us (client e2e p50)",
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "mode": "breakdown",
+            "replicas": R, "clients": P, "window": 64,
+            "sample_period": sample,
+            "ops_per_sec": round(sum(done) / elapsed, 1),
+            "sampled_ops_stitched": len(e2e_client),
+            "stages_us": stages,
+            "named_stages": chain_names,
+            "named_server_stages": srv_stage_names,
+            "stage_p50_sum_us": round(stage_p50_sum, 1),
+            "server_stage_p50_sum_us": round(srv_p50_sum, 1),
+            "e2e_client_us": e2e,
+            "e2e_server_us": e2e_srv,
+            "stage_sum_vs_e2e": round(ratio, 3),
+            "server_stage_sum_vs_server_e2e": round(
+                srv_p50_sum / e2e_srv["p50"], 3)
+            if e2e_srv["p50"] else 0.0,
+            "scraped_histograms_us": scraped_stages,
+            "note": ("stages_us are exact stitched durations from the "
+                     "in-process span rings (client+daemons share a "
+                     "monotonic clock); scraped_histograms_us are the "
+                     "log2-bucket OP_METRICS view of the same run. "
+                     "Stage durations telescope, so stage_sum_vs_e2e "
+                     "~ 1.0 by construction."),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
 def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
     """Run the measurement in a watched subprocess; return the parsed
     JSON result or None on failure/timeout (stderr passes through)."""
@@ -941,6 +1108,20 @@ def _tpu_probe(timeout_s: float) -> bool:
 
 
 def main() -> None:
+    if "--breakdown" in sys.argv[1:]:
+        # Per-stage latency decomposition (host path, no JAX).
+        try:
+            _bench_breakdown()
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "metric": "pipelined_put_stage_breakdown",
+                "value": None, "unit": "us (server e2e p50)",
+                "vs_baseline": 0.0,
+                "detail": {"mode": "breakdown", "error": repr(e)},
+            }), flush=True)
+        return
     if "--throughput" in sys.argv[1:]:
         # Host-path replicated throughput: runs inline (no JAX, no
         # TPU probe/watchdog scaffolding — live sockets on this host).

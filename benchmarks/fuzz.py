@@ -306,6 +306,69 @@ def run_proc_schedule(fault_seed: int,
     return "ok"
 
 
+def _collect_obs(pc) -> list:
+    """Best-effort OP_OBS_DUMP sweep across a live ProcCluster — the
+    flight/span rings of every reachable replica, fetched BEFORE
+    teardown so a post-mortem check can still ship the cluster's last
+    seconds with the repro."""
+    try:
+        from apus_tpu.obs.service import collect_cluster_dumps
+        return collect_cluster_dumps(
+            [p for p in pc.spec.peers if p], timeout=2.0)
+    except Exception:                                 # noqa: BLE001
+        return []
+
+
+def _obs_fail_dump(dumps: list, dump_obs: "str | None",
+                   tag: str) -> "str | None":
+    """Persist collected obs dumps + the merged cross-replica timeline
+    (apus_tpu.obs.timeline) under ``dump_obs`` (or ./obs-fail-<tag>);
+    returns the timeline path, or None when nothing was collected."""
+    if not dumps:
+        return None
+    from apus_tpu.obs import timeline
+    out_dir = os.path.abspath(dump_obs or f"obs-fail-{tag}")
+    try:
+        return timeline.write_dump(out_dir, dumps, tag=tag)
+    except OSError:
+        return None
+
+
+def _obs_event_count(dumps: list) -> int:
+    return sum(len(d.get("flight", [])) + len(d.get("spans", []))
+               for d in dumps)
+
+
+class _ObsGuard:
+    """Rides the cluster's ``with`` statement (listed AFTER the
+    ProcCluster, so it exits FIRST, while the daemons still serve):
+    always sweeps the replicas' flight/span rings into ``sink``, and on
+    an in-flight exception — a wedge, a failed convergence — writes the
+    merged cross-replica timeline immediately, since the post-mortem
+    code that handles clean-exit violations will never run."""
+
+    def __init__(self, pc_ref, sink: list, dump_obs, tag: str):
+        self.pc_ref = pc_ref
+        self.sink = sink
+        self.dump_obs = dump_obs
+        self.tag = tag
+
+    def __enter__(self) -> "_ObsGuard":
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        try:
+            self.sink.extend(_collect_obs(self.pc_ref()))
+        except Exception:                             # noqa: BLE001
+            pass
+        if et is not None:
+            tl = _obs_fail_dump(self.sink, self.dump_obs, self.tag)
+            if tl:
+                print(f"[obs] cross-replica timeline dumped: {tl}",
+                      file=sys.stderr)
+        return False
+
+
 def _disk_surgery(path: str, kind: str, rng: random.Random) -> bool:
     """Corrupt a KILLED replica's durable store in place — the restart
     then runs the matching recovery branch (torn-tail truncation, CRC
@@ -330,7 +393,8 @@ def _disk_surgery(path: str, kind: str, rng: random.Random) -> bool:
     return True
 
 
-def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
+def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
+                       dump_obs: "str | None" = None) -> dict:
     """One CONSISTENCY-AUDIT chaos trial on the deployment shape: a
     3-replica ProcCluster with the live fault plane, concurrent client
     workers (serial AND pipelined paths) recording every op's
@@ -405,9 +469,12 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
                         ConnectionError):
                     _time.sleep(0.05)   # recorded as ambiguous; go on
 
+    obs_dumps: list = []
     with tempfile.TemporaryDirectory(prefix="apus-audit") as td:
         with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
-                         fault_seed=fault_seed) as pc:
+                         fault_seed=fault_seed) as pc, \
+                _ObsGuard(lambda: pc, obs_dumps, dump_obs,
+                          f"audit-{fault_seed}"):
             peers = list(pc.spec.peers)
             _dbg("cluster up")
             threads = [threading.Thread(target=worker, args=(w, peers),
@@ -490,7 +557,8 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
     stats = {"ops_checked": res.ops_checked, "keys": res.keys,
              "ambiguous": sum(1 for e in recorder.events()
                               if e["status"] != "ok"),
-             "recorded": len(recorder.events())}
+             "recorded": len(recorder.events()),
+             "obs_events": _obs_event_count(obs_dumps)}
     if recorder.dropped:
         raise AssertionError(
             f"history ring overflowed ({recorder.dropped} dropped); "
@@ -498,15 +566,20 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0) -> dict:
     if not res.ok or res.undecided:
         dump = os.path.abspath(f"audit-fail-{fault_seed}.jsonl")
         recorder.dump_jsonl(dump)
+        # The black-box readout travels WITH the repro: every replica's
+        # last-N-seconds flight/span rings, merged into one timeline.
+        tl = _obs_fail_dump(obs_dumps, dump_obs,
+                            f"audit-{fault_seed}")
         raise AssertionError(
-            f"LINEARIZABILITY VIOLATION (history: {dump})\n"
-            + res.describe())
+            f"LINEARIZABILITY VIOLATION (history: {dump}; "
+            f"obs timeline: {tl})\n" + res.describe())
     return stats
 
 
 def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                        minutes: float = 0.0,
-                       state_size: int = 0) -> dict:
+                       state_size: int = 0,
+                       dump_obs: "str | None" = None) -> dict:
     """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
     3-replica fault-plane ProcCluster with auto-removal ON, concurrent
     recorded clients (serial + pipelined), and a seeded nemesis that
@@ -631,9 +704,12 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             _time.sleep(0.1)
         raise AssertionError(f"slot {slot} never re-admitted")
 
+    obs_dumps: list = []
     with tempfile.TemporaryDirectory(prefix="apus-churn") as td:
         with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
-                         fault_seed=fault_seed) as pc:
+                         fault_seed=fault_seed) as pc, \
+                _ObsGuard(lambda: pc, obs_dumps, dump_obs,
+                          f"churn-{fault_seed}"):
             peers = list(pc.spec.peers)
             _dbg("cluster up")
             if state_size > 0:
@@ -817,7 +893,8 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                                 history=recorder) as c:
                     for k in keys:
                         c.get(k)
-    stats = {"configs_traversed": view["epoch"], **churn}
+    stats = {"configs_traversed": view["epoch"], **churn,
+             "obs_events": _obs_event_count(obs_dumps)}
     if recorder is not None:
         res = check_history(recorder.events())
         ops_checked = res.ops_checked
@@ -828,9 +905,12 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
         if not res.ok or res.undecided:
             dump = os.path.abspath(f"churn-fail-{fault_seed}.jsonl")
             recorder.dump_jsonl(dump)
+            tl = _obs_fail_dump(obs_dumps, dump_obs,
+                                f"churn-{fault_seed}")
             raise AssertionError(
                 f"LINEARIZABILITY VIOLATION under churn "
-                f"(history: {dump})\n" + res.describe())
+                f"(history: {dump}; obs timeline: {tl})\n"
+                + res.describe())
         stats["ops_checked"] = ops_checked
         stats["keys"] = res.keys
         stats["recorded"] = len(recorder.events())
@@ -910,6 +990,15 @@ def main() -> int:
                          "complete — resumed when the snapshot point "
                          "held still — and membership must never "
                          "wedge).  Suggested: 10000000 (10 MB)")
+    ap.add_argument("--dump-obs", default=None, metavar="DIR",
+                    help="with --check-linear/--churn: directory for "
+                         "the failure-triggered observability dump — "
+                         "every replica's flight/span rings fetched "
+                         "over OP_OBS_DUMP before teardown, merged "
+                         "into one cross-replica timeline by "
+                         "apus_tpu.obs.timeline (default: "
+                         "./obs-fail-<mode>-<seed>).  Violations AND "
+                         "wedges dump; repro lines carry the flag")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -938,31 +1027,34 @@ def main() -> int:
     ok = stalls = 0
     failures = []
     audit = {"ops_checked": 0, "keys": 0, "ambiguous": 0,
-             "recorded": 0, "seeds": []}
+             "recorded": 0, "obs_events": 0, "seeds": []}
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
              "leader_kills": 0, "configs_traversed": 0,
              "ops_checked": 0, "receiver_kills": 0, "snap_resumes": 0,
              "snap_chunks_acked": 0, "delta_snapshots": 0,
-             "chunkfile_faults": 0, "seeds": []}
+             "chunkfile_faults": 0, "obs_events": 0, "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
             if args.churn:
                 st = run_churn_schedule(fault_seed,
                                         check_linear=args.check_linear,
-                                        state_size=args.state_size)
+                                        state_size=args.state_size,
+                                        dump_obs=args.dump_obs)
                 for k in ("joins", "auto_removes", "graceful_leaves",
                           "leader_kills", "configs_traversed",
                           "ops_checked", "receiver_kills",
                           "snap_resumes", "snap_chunks_acked",
-                          "delta_snapshots", "chunkfile_faults"):
+                          "delta_snapshots", "chunkfile_faults",
+                          "obs_events"):
                     churn[k] += st.get(k, 0)
                 churn["seeds"].append(fault_seed)
                 r = "ok"
             elif args.check_linear:
-                st = run_audit_schedule(fault_seed)
+                st = run_audit_schedule(fault_seed,
+                                        dump_obs=args.dump_obs)
                 for k in ("ops_checked", "keys", "ambiguous",
-                          "recorded"):
-                    audit[k] += st[k]
+                          "recorded", "obs_events"):
+                    audit[k] += st.get(k, 0)
                 audit["seeds"].append(fault_seed)
                 r = "ok"
             elif args.proc:
@@ -979,10 +1071,17 @@ def main() -> int:
         except Exception as e:                   # noqa: BLE001
             failures.append({"trial": trial, "fault_seed": fault_seed,
                              "error": repr(e)[:200]})
+            # Live-cluster modes replay with the obs dump armed, so the
+            # repro ships the cross-replica timeline too.
+            obs_flag = ""
+            if args.churn or args.check_linear:
+                mode = "churn" if args.churn else "audit"
+                obs_flag = (f" --dump-obs "
+                            f"{args.dump_obs or f'obs-fail-{mode}-{fault_seed}'}")
             print(f"trial {trial}: FAIL (FAULT_SEED={fault_seed}) {e!r}\n"
                   f"  repro: python benchmarks/fuzz.py "
                   f"--fault-seed {fault_seed} "
-                  + " ".join(mode_flags), file=sys.stderr)
+                  + " ".join(mode_flags) + obs_flag, file=sys.stderr)
     # Percentage (new metric NAME so historical count-valued records
     # never average into the same row), over the trials that could
     # have been clean: expected stalls (quorum-floor schedules under

@@ -37,6 +37,40 @@ def _rss_kb(pid: int) -> int:
     return 0
 
 
+#: leader counters surfaced in the periodic [obs] delta line
+_OBS_DELTA_KEYS = ("node_commits", "node_applied",
+                   "node_drain_windows", "node_drain_entries",
+                   "node_repl_windows", "node_lease_reads",
+                   "node_readindex_verifies", "node_elections",
+                   "node_snapshots_pushed", "srv_ingest_frames",
+                   "net_retries", "fault_drops")
+
+
+def _print_obs_delta(pc, last: dict) -> None:
+    """One compact metrics-delta line from the leader's OP_METRICS
+    scrape (counter increments since the previous line; leader moves
+    reset the baseline — per-daemon counters are not comparable across
+    replicas)."""
+    try:
+        lead = pc.leader_idx(timeout=2.0)
+    except AssertionError:
+        return
+    from apus_tpu.obs.service import fetch_metrics
+    rec = fetch_metrics(pc.spec.peers[lead], timeout=1.0)
+    if rec is None:
+        return
+    met = rec.get("metrics", {})
+    cur = {k: met.get(k, {}).get("value", 0) for k in _OBS_DELTA_KEYS}
+    if last.get("lead") == lead and "vals" in last:
+        deltas = [(k, cur[k] - last["vals"][k]) for k in _OBS_DELTA_KEYS]
+        line = " ".join(f"{k.split('_', 1)[1]}+{v}"
+                        for k, v in deltas if v > 0)
+        print(f"[obs r{lead}] {line or 'idle'}", file=sys.stderr,
+              flush=True)
+    last["lead"] = lead
+    last["vals"] = cur
+
+
 def _find_leader_slot(pc) -> int:
     """Leader slot via the framework's hint-following find_leader (the
     FindLeader-as-API path a real client uses), not the harness's
@@ -111,6 +145,10 @@ def main() -> int:
                          "group-commit / lease-read path is exercised "
                          "alongside the proxied app traffic (counted "
                          "separately in the result)")
+    ap.add_argument("--obs-every", type=float, default=30.0,
+                    help="print a [obs] metrics-delta line (leader "
+                         "OP_METRICS counter increments) every N "
+                         "seconds; 0 disables")
     ap.add_argument("--audit", action="store_true",
                     help="record every SET/GET of the soak stream as a "
                          "timed history (apus_tpu.audit.HistoryRecorder"
@@ -348,8 +386,14 @@ def main() -> int:
             return all(r == "OK" for r in rs)
 
         t0 = time.monotonic()
+        next_obs = (time.monotonic() + args.obs_every
+                    if args.obs_every > 0 else float("inf"))
+        obs_last: dict = {}
         while time.monotonic() < t_end:
             now = time.monotonic()
+            if now >= next_obs:
+                _print_obs_delta(pc, obs_last)
+                next_obs = now + args.obs_every
             if fault_heal_at is not None and now >= fault_heal_at:
                 from apus_tpu.parallel.faults import send_fault
                 send_fault(pc.spec.peers[fault_victim], {"cmd": "heal"})
@@ -591,6 +635,15 @@ def main() -> int:
             for f in snap_summary:
                 snap_summary[f] += st.get(f, 0) or 0
             compaction_floors[i] = st.get("compaction_floor", 0)
+        # Black-box sweep before teardown: an audit failure below
+        # ships every replica's flight/span rings with the verdict.
+        obs_dumps: list = []
+        try:
+            from apus_tpu.obs.service import collect_cluster_dumps
+            obs_dumps = collect_cluster_dumps(
+                [p for p in pc.spec.peers if p], timeout=2.0)
+        except Exception:                        # noqa: BLE001
+            pass
 
     # Linearizability verdict over the recorded soak stream (the
     # maintenance-gate convergence reads above are deliberately NOT in
@@ -611,6 +664,14 @@ def main() -> int:
             dump = os.path.abspath("soak-audit-fail.jsonl")
             audit_rec.dump_jsonl(dump)
             audit_detail["dump"] = dump
+            if obs_dumps:
+                from apus_tpu.obs import timeline
+                tl = timeline.write_dump(
+                    os.path.abspath("soak-obs-fail"), obs_dumps,
+                    tag="soak")
+                audit_detail["obs_timeline"] = tl
+                print(f"[obs] cross-replica timeline dumped: {tl}",
+                      file=sys.stderr)
             print(res.describe(), file=sys.stderr)
 
     print(json.dumps({

@@ -146,6 +146,21 @@ def _run_churn(out, trials: int = 5, state_size: int = 0) -> None:
                 else "churn_campaign")
 
 
+def _run_breakdown(out) -> None:
+    """Per-stage latency decomposition of the pipelined PUT path
+    (bench.py --breakdown): exact stitched stage p50/p99 from the span
+    rings + the OP_METRICS histogram view, banked as the baseline the
+    native-hot-path PR must beat stage by stage."""
+    print("bench.py --breakdown: pipelined PUT stage decomposition")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--breakdown"],
+                         timeout=240):
+        _record(out, rec,
+                replicas=rec.get("detail", {}).get("replicas", 3),
+                bench="bench_breakdown")
+
+
 def _run_ladder(out, state_mb: str = "10,100") -> None:
     """Rejoin-under-load ladder (large-state recovery plane): full-push
     vs delta rejoin time at each state size, with the top rung's
@@ -167,6 +182,11 @@ def cmd_run(args) -> int:
         if getattr(args, "single_window_only", False):
             # Fast latency-path re-measure: skip the cluster suite.
             _run_single_window(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "breakdown_only", False):
+            # Fast stage-decomposition re-measure: skip the suite.
+            _run_breakdown(out)
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "audit_only", False):
@@ -522,6 +542,22 @@ def cmd_report(args) -> int:
                f"{c.get('delta_snapshots')} delta snapshots"
                if c.get("state_size") else "")
             + f"; seeds {c.get('seeds')}")
+    brk = [r for r in runs
+           if r.get("metric") == "pipelined_put_stage_breakdown"
+           and isinstance(r.get("value"), (int, float))]
+    if brk:
+        last = brk[-1]
+        d = last.get("detail", {})
+        st = d.get("stages_us", {})
+        tops = sorted(((v["p50"], k) for k, v in st.items() if v),
+                      reverse=True)[:3]
+        lines.append(
+            f"- pipelined PUT stage breakdown (span plane, "
+            f"{d.get('sampled_ops_stitched')} sampled ops): client e2e "
+            f"p50 {_fmt(last['value'])} µs across "
+            f"{len(d.get('named_stages', []))} named stages (p50 sum / "
+            f"e2e = {d.get('stage_sum_vs_e2e')}); heaviest: "
+            + ", ".join(f"{k} {_fmt(v)} µs" for v, k in tops))
     lad = [r for r in runs if r.get("metric") == "rejoin_ladder"
            and isinstance(r.get("value"), (int, float))]
     if lad:
@@ -698,6 +734,10 @@ def main() -> int:
                        help="run ONLY the pipelined-throughput bench "
                             "(bench.py --throughput; skips the cluster "
                             "suite)")
+        p.add_argument("--breakdown-only", action="store_true",
+                       help="run ONLY the per-stage latency "
+                            "decomposition (bench.py --breakdown) and "
+                            "bank its record")
         p.add_argument("--audit-only", action="store_true",
                        help="run ONLY the consistency-audit chaos "
                             "campaign (fuzz.py --check-linear; skips "

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Metrics-consistency lint (tier-1 gate, ISSUE 7).
+
+Contract it enforces, against drift:
+
+1. every counter bumped in source — the ``.bump("name")`` spelling is
+   THE registry-counter spelling (Node.bump -> node_*, transport
+   ``stats.bump`` -> its view's namespace) — must be cataloged in
+   apus_tpu/obs/catalog.py under its namespace;
+2. every cataloged metric must be documented in DESIGN.md's
+   "Observability plane" section (as a backticked literal);
+3. reachability via OP_METRICS is enforced by construction (ObsHub
+   pre-registers the whole catalog) and pinned by
+   tests/test_obs.py::test_op_metrics_scrape_roundtrip.
+
+Out of scope by design: plain-dict runner stats (DeviceCommitRunner /
+MeshCommitRunner / client-side ``stale_replies``) — those are
+OP_STATUS-only internals, not registry metrics; migrating one means
+switching it to the ``.bump`` spelling, which this lint then tracks.
+
+Exit 0 clean; exit 1 with the drift list otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apus_tpu.obs import catalog  # noqa: E402
+
+#: file (relative) -> namespace its ``.bump("...")`` counters land in
+NAMESPACE_OF = {
+    "apus_tpu/core/node.py": "node",
+    "apus_tpu/parallel/onesided.py": "node",
+    "apus_tpu/runtime/bridge.py": "node",
+    "apus_tpu/runtime/device_plane.py": "node",
+    "apus_tpu/runtime/mesh_plane.py": "node",
+    "apus_tpu/parallel/net.py": None,     # mixed: resolved per call
+    "apus_tpu/parallel/faults.py": "fault",
+    "apus_tpu/runtime/client.py": "srv",
+    "apus_tpu/runtime/daemon.py": "node",
+}
+
+_BUMP = re.compile(r'\.bump\(\s*"([a-z0-9_]+)"')
+_RECV = re.compile(r'([\w.]+)\.bump\(\s*"([a-z0-9_]+)"')
+
+
+def _net_namespace(owner: str) -> str:
+    # net.py hosts NetTransport (self.stats -> net_*), PeerServer
+    # (self.stats -> srv_*), and node.bump call sites (node_*).
+    if owner.startswith("node"):
+        return "node"
+    return None  # resolved by class scan below
+
+
+def collect_bumps() -> list[tuple[str, str, str]]:
+    """[(file, namespace, counter_name)] for every .bump() literal."""
+    out = []
+    for rel, ns in NAMESPACE_OF.items():
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            continue
+        src = open(path).read()
+        if rel == "apus_tpu/parallel/net.py":
+            # Class-scoped resolution: NetTransport -> net,
+            # PeerServer -> srv, node.bump -> node.
+            cls_spans = []
+            for m in re.finditer(r"^class (\w+)", src, re.M):
+                cls_spans.append((m.start(), m.group(1)))
+            cls_spans.append((len(src), ""))
+
+            def cls_at(pos: int) -> str:
+                cur = ""
+                for start, name in cls_spans:
+                    if pos < start:
+                        return cur
+                    cur = name
+                return cur
+
+            for m in _RECV.finditer(src):
+                owner, name = m.group(1), m.group(2)
+                if owner.startswith("node"):
+                    ns_here = "node"
+                elif cls_at(m.start()) == "PeerServer":
+                    ns_here = "srv"
+                else:
+                    ns_here = "net"
+                out.append((rel, ns_here, name))
+            continue
+        for m in _RECV.finditer(src):
+            out.append((rel, ns, m.group(2)))
+    return out
+
+
+def main() -> int:
+    errors: list[str] = []
+
+    bumps = collect_bumps()
+    if not bumps:
+        errors.append("no .bump() call sites found — the lint's source "
+                      "scan is broken")
+    for rel, ns, name in bumps:
+        full = f"{ns}_{name}"
+        if full not in catalog.COUNTERS:
+            errors.append(
+                f"{rel}: counter {full!r} is bumped but not cataloged "
+                f"in apus_tpu/obs/catalog.py (add it there AND to "
+                f"DESIGN.md's Observability plane table)")
+
+    design = open(os.path.join(REPO, "DESIGN.md")).read()
+    documented = set(re.findall(r"`([a-z0-9_]+)`", design))
+    for full in sorted(catalog.CATALOG):
+        if full not in documented:
+            errors.append(
+                f"catalog metric {full!r} is not documented in "
+                f"DESIGN.md (backticked literal required)")
+
+    if errors:
+        print(f"check_metrics: {len(errors)} drift error(s)",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({len(bumps)} bump sites, "
+          f"{len(catalog.CATALOG)} cataloged metrics, all documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,9 @@ if [ "${1:-}" = "--no-smoke" ]; then
     smoke=0
 fi
 
+echo "== metrics-consistency lint =="
+python scripts/check_metrics.py || exit $?
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -32,6 +35,14 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 if [ "$smoke" -eq 1 ]; then
+    echo "== observability-plane smoke (-m obs slice) =="
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q \
+        -m obs -p no:cacheprovider
+    orc=$?
+    if [ "$orc" -ne 0 ]; then
+        echo "obs smoke FAILED (rc=$orc)" >&2
+        exit "$orc"
+    fi
     echo "== large-state churn smoke (1 trial, 2 MB state) =="
     env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \
         --churn --check-linear --state-size 2000000 --trials 1 \

@@ -53,6 +53,11 @@ def pytest_configure(config):
         "minutes-long ladders and campaigns")
     config.addinivalue_line(
         "markers",
+        "obs: observability-plane suite (apus_tpu.obs) — metrics "
+        "registry, per-op stage spans, flight recorder, OP_METRICS "
+        "scrape, cross-replica timeline; selectable with -m obs")
+    config.addinivalue_line(
+        "markers",
         "largestate: large-state recovery-plane suite — chunked "
         "resumable catch-up, delta snapshots, compacting store; the "
         "slow ladder e2e carries slow too (out of tier-1); "

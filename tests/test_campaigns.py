@@ -90,6 +90,10 @@ def test_audit_fuzz_slice():
     fuzz = _fuzz()
     stats = fuzz.run_audit_schedule(36_000)
     assert stats["ops_checked"] > 100, stats
+    # Black-box plane rode along: the pre-teardown OP_OBS_DUMP sweep
+    # (the same one a violation ships with its repro) captured a
+    # non-empty cross-replica timeline.
+    assert stats["obs_events"] > 0, stats
 
 
 @pytest.mark.churn
@@ -108,6 +112,7 @@ def test_churn_fuzz_slice():
     assert stats["graceful_leaves"] >= 1, stats
     assert stats["ops_checked"] > 100, stats
     assert stats["configs_traversed"] >= 5, stats
+    assert stats["obs_events"] > 0, stats     # failure-dump sweep live
 
 
 def test_soak_slice():

@@ -451,13 +451,34 @@ def test_vote_guard_unconditional_under_config_skew():
 
 
 def test_pipeline_throughput_beats_serial_smoke():
-    """Small-scale sanity of the headline claim: 4 pipelined clients
-    push clearly more acked writes/sec than 4 serial clients on the
-    same cluster (the full 16-client >=5x figure is bench.py
-    --throughput's job; this guards the mechanism)."""
+    """Small-scale sanity of the headline claim, DE-FLAKED (ISSUE 7):
+    the old raw wall-clock ratio (pipelined > 2x serial ops) failed
+    ~50% of full runs on this 1-core box — both shapes are CPU-bound
+    there, so scheduler noise decided the verdict.  The MECHANISM is
+    what this test guards, and the obs counters now expose it
+    directly: a pipelined burst must form group-commit drain windows
+    that admit many entries each (vs ~single-entry windows for serial
+    writers), and must ingest many frames per server recv drain.  The
+    wall-clock ratio is kept as a non-fatal report line for eyeballs
+    (bench.py --throughput owns the real >=5x figure under an
+    emulated RTT)."""
     with LocalCluster(3, spec=ClusterSpec(**SPEC)) as c:
         c.wait_for_leader()
         peers = list(c.spec.peers)
+
+        def counters() -> dict:
+            # Sum across daemons: drain counters only move on the
+            # leader — whoever that is if leadership migrates mid-run.
+            tot = {k: 0 for k in ("drain_windows", "drain_entries",
+                                  "ingest_batches", "ingest_frames")}
+            for d in c.daemons:
+                if d is None:
+                    continue
+                for k in ("drain_windows", "drain_entries"):
+                    tot[k] += d.node.stats.get(k, 0)
+                for k in ("ingest_batches", "ingest_frames"):
+                    tot[k] += d.server.stats.get(k, 0)
+            return tot
 
         def run(pipelined: bool, seconds: float = 1.2) -> int:
             done = [0] * 4
@@ -485,6 +506,30 @@ def test_pipeline_throughput_beats_serial_smoke():
                 t.join()
             return sum(done)
 
+        c0 = counters()
         serial = run(False)
+        c1 = counters()
         piped = run(True)
-        assert piped > 2 * serial, (piped, serial)
+        c2 = counters()
+        s = {k: c1[k] - c0[k] for k in c0}
+        p = {k: c2[k] - c1[k] for k in c0}
+
+        # Group-commit formed real windows: the pipelined phase's
+        # entries-per-drain-window must show genuine coalescing, and
+        # clearly more of it than the serial phase's incidental
+        # cross-connection batching.
+        assert p["drain_windows"] > 0 and p["drain_entries"] > 0, p
+        p_epw = p["drain_entries"] / p["drain_windows"]
+        s_epw = s["drain_entries"] / max(1, s["drain_windows"])
+        assert p_epw >= 4.0, (s, p)
+        assert p_epw >= 2.0 * s_epw, (s, p)
+        # Wire-ingest coalescing: bursts arrive many frames per recv
+        # drain (serial connections read ~one frame at a time).
+        assert p["ingest_batches"] > 0, p
+        assert p["ingest_frames"] / p["ingest_batches"] >= 4.0, (s, p)
+        # Wall clock stays a REPORT, not a gate (the 1-core flake).
+        print(f"pipeline smoke: serial={serial} piped={piped} "
+              f"(ratio {piped / max(1, serial):.2f}), "
+              f"entries/window serial={s_epw:.1f} piped={p_epw:.1f}, "
+              f"frames/ingest-batch="
+              f"{p['ingest_frames'] / p['ingest_batches']:.1f}")
