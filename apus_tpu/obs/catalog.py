@@ -51,6 +51,7 @@ COUNTERS: dict[str, str] = {
     "node_delta_installs": "delta snapshots installed",
     "node_delta_refused": "delta installs refused on a base mismatch",
     "node_devplane_commits": "commit advances adopted from the device quorum",
+    "node_devplane_own_flips": "device-plane commit ownership flips (own/release)",
     "node_nack_ranges_dropped": "proxy NACK ranges dropped by the bridge",
     "node_proxy_spin_timeouts": "proxy spin-wait timeouts observed",
     "node_replay_reprimes": "bridge replay re-primes after reconnect",
@@ -74,6 +75,17 @@ COUNTERS: dict[str, str] = {
     "srv_ingest_batches": "multi-frame bursts drained off one connection",
     "srv_ingest_frames": "frames ingested through burst drains",
     "srv_ingest_solo": "single-frame (non-burst) requests served",
+    # -- dev_*: device-plane engine (runtime/device_plane.py runner;
+    #    process-wide registry merged into every replica's scrape) ----
+    "dev_rounds": "device commit rounds executed",
+    "dev_resets": "device-log resets (fresh leaderships)",
+    "dev_quorum_fail_rounds": "rounds whose device quorum vote failed",
+    "dev_entries_devplane": "entries carried by device commit rounds",
+    "dev_pipelined_dispatches": "multi-round windows dispatched (async/deep)",
+    "dev_window_dispatches": "single-window engine dispatches",
+    "dev_deep_dispatches": "deep-rung (>= DEEP_DEPTH) window dispatches",
+    "dev_early_exits": "windowed dispatches cut short by device-side early exit",
+    "dev_recompiles": "post-warmup XLA recompiles on live executables",
 }
 
 GAUGES: dict[str, str] = {
@@ -84,6 +96,17 @@ GAUGES: dict[str, str] = {
     "daemon_compactions": "store compactions completed",
     "daemon_compaction_floor": "first log index covered by the base image",
     "daemon_store_records_since_base": "records appended past the base image",
+    # Device-plane gauges: dev_* mirrors runner scalars, devd_* mirrors
+    # the per-daemon driver's stats dict at OP_METRICS scrape time.
+    "dev_max_dispatch_ms": "slowest blocked device-result wait observed (ms)",
+    "devd_rounds": "device rounds this daemon's driver dispatched",
+    "devd_drained": "device rows drained into the host log (follower path)",
+    "devd_holes": "device-ineligible spans handed to the host path",
+    "devd_fallbacks": "commit ownership handed back to the host path",
+    "devd_quorum_gated": "dispatches skipped: live mask below quorum",
+    "devd_qfail_timeouts": "quorum-fail streak timeouts (dispatch paused)",
+    "devd_async_windows": "deep windows enqueued without blocking",
+    "devd_partial_deferrals": "partial windows deferred for queued admissions",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -98,6 +121,30 @@ HISTOGRAMS: dict[str, str] = {
     "stage_wire_out_us": "reply -> client parsed the reply frame",
     "op_server_us": "server end-to-end: ingest -> reply (telescoped stages)",
     "op_client_us": "client end-to-end: send -> reply parsed",
+    # Device-plane dispatch/occupancy distributions (runner registry).
+    "dev_dispatch_wait_us": "blocked device->host result wait per dispatch",
+    "dev_window_wall_us": "whole sync window dispatch wall (encode+stage+wait)",
+    "dev_window_depth": "requested rounds per window dispatch",
+    "dev_window_rounds_run": "rounds actually executed per resolved window",
+    "dev_staging_wait_us": "HostStagingRing acquire consumer-edge block",
 }
 
 CATALOG: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}
+
+#: Flight-recorder event categories — the black-box ring's taxonomy.
+#: scripts/check_metrics.py lints every ``_note("...")`` /
+#: ``flight.note("...")`` literal in the runtime against this table
+#: (and requires each category documented in DESIGN.md), so a new
+#: event class cannot ship undocumented.
+FLIGHT_CATEGORIES: dict[str, str] = {
+    "role": "role/term transitions (edge-triggered, daemon tick)",
+    "election": "elections opened by this replica",
+    "config": "CONFIG applies: joins, auto-removes, resize aborts, leaves",
+    "lease": "leader read-lease grant/lapse edges",
+    "snap_push": "snapshot push completions (per peer, with result)",
+    "snap_stream": "chunked snapshot stream begin/resume/quarantine/end",
+    "watchdog": "watchdog fires: snap-push abandon, devplane stall, rejoin",
+    "persist": "persistence disablement (first I/O error of the session)",
+    "fault": "scripted fault-plane commands landing on this replica",
+    "devplane": "device-plane ownership flips (cause-tagged) + recompiles",
+}

@@ -1,0 +1,226 @@
+"""Critical-path attribution: where does a client op's time go?
+
+    python -m apus_tpu.obs.critpath DUMP.json [DUMP2.json ...]
+    python -m apus_tpu.obs.critpath --addrs host:p0,host:p1 [--json]
+
+Folds stitched span dumps (OP_OBS_DUMP fetches, or a harness failure
+dump) into a per-op dominant-stage table: each sampled op's stage
+durations are computed from its cross-replica hop chain (device window
+events included), aggregated into per-stage p50/p99/mean, and every op
+is attributed to the stage that DOMINATED it.  The stages then roll up
+into buckets — host CPU (framing/dedup/locks), replication roundtrip,
+device dispatch, durability, apply — and the tool answers ROADMAP's
+standing question quantitatively: is the hot path Python-CPU-bound or
+roundtrip-bound?  (BENCH_r07 answered it by process-of-elimination
+benchmarking; this reads it off any live cluster or failure dump.)
+
+The per-op durations telescope (each is the gap to the previous
+present stamp in canonical order), so bucket shares sum to ~100% of
+the server end-to-end and the verdict is an identity, not a model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from apus_tpu.obs.timeline import load_dumps, merge_dumps, stitch_ops
+
+#: Canonical stamp order with the device window hops interleaved where
+#: they sit on the wall (dispatch after the fan-out, ready before the
+#: commit adoption).  Durations are named by the LATER stamp of each
+#: adjacent present pair.
+ORDER = ("client_send", "ingest", "lock", "admit", "append", "repl",
+         "dev_dispatch", "dev_ready", "quorum", "apply", "fsync",
+         "reply", "client_reply")
+
+DUR_NAMES = {
+    "ingest": "wire_in",
+    "lock": "lock_wait",
+    "admit": "dedup_admit",
+    "append": "append",
+    "repl": "repl_fanout",
+    "dev_dispatch": "dev_dispatch_wait",
+    "dev_ready": "dev_execute",
+    "quorum": "quorum_ack",
+    "apply": "apply",
+    "fsync": "fsync",
+    "reply": "reply_flush",
+    "client_reply": "wire_out",
+}
+
+#: Stage -> attribution bucket.  host_cpu is the Python data-plane
+#: work the native-hot-path ROADMAP item would absorb; replication +
+#: device are the roundtrip-shaped waits it would not.
+BUCKETS = {
+    "wire_in": "host_cpu",
+    "lock_wait": "host_cpu",
+    "dedup_admit": "host_cpu",
+    "append": "host_cpu",
+    "reply_flush": "host_cpu",
+    "repl_fanout": "replication",
+    "quorum_ack": "replication",
+    "dev_dispatch_wait": "device",
+    "dev_execute": "device",
+    "fsync": "durability",
+    "apply": "apply",
+    "wire_out": "client_wire",
+}
+
+#: Stages outside the server bracket (ingest..reply): excluded from
+#: dominance/verdict math, reported in the stage table only.
+_CLIENT_SIDE = ("wire_in", "wire_out")
+
+_ORDER_IDX = {s: i for i, s in enumerate(ORDER)}
+
+
+def op_durations(stamps: dict) -> dict:
+    """{duration_name: µs} for one op's {stage: t} stamp dict —
+    adjacent gaps over the present stages in canonical order."""
+    present = sorted((s for s in stamps if s in _ORDER_IDX),
+                     key=_ORDER_IDX.__getitem__)
+    out = {}
+    for a, b in zip(present, present[1:]):
+        name = DUR_NAMES.get(b)
+        if name is not None:
+            out[name] = max(0, stamps[b] - stamps[a])
+    return out
+
+
+def _pcts(vals: list) -> dict:
+    vs = sorted(vals)
+    n = len(vs)
+    return {"n": n,
+            "p50": round(vs[n // 2], 1),
+            "p99": round(vs[min(n - 1, int(0.99 * n))], 1),
+            "mean": round(sum(vs) / n, 1),
+            "total": round(sum(vs), 1)}
+
+
+def attribute(dumps: list[dict]) -> dict:
+    """The attribution report for a set of per-replica dumps:
+
+    - ``stages``: per-duration n/p50/p99/mean/total (µs),
+    - ``dominant``: how many ops each SERVER stage dominated,
+    - ``buckets``: share of total server time per bucket,
+    - ``verdict``: the one-line answer ("host-CPU-bound ...").
+    """
+    merged = merge_dumps(dumps)
+    ops = stitch_ops(merged)           # device windows attached
+    stage_vals: dict[str, list] = {}
+    dominant: dict[str, int] = {}
+    n_ops = 0
+    for o in ops.values():
+        stamps: dict[str, int] = {}
+        for ev in o["stamps"]:
+            s = ev.get("stage")
+            if s in _ORDER_IDX and s not in stamps:
+                stamps[s] = ev.get("wall_us", ev.get("t_us", 0))
+        durs = op_durations(stamps)
+        if not durs:
+            continue
+        n_ops += 1
+        for name, v in durs.items():
+            stage_vals.setdefault(name, []).append(v)
+        server = {k: v for k, v in durs.items()
+                  if k not in _CLIENT_SIDE}
+        if server:
+            top = max(server, key=server.get)
+            dominant[top] = dominant.get(top, 0) + 1
+
+    stages = {name: _pcts(vals) for name, vals in stage_vals.items()}
+    bucket_tot: dict[str, float] = {}
+    for name, st in stages.items():
+        if name in _CLIENT_SIDE:
+            continue
+        b = BUCKETS.get(name, "other")
+        bucket_tot[b] = bucket_tot.get(b, 0.0) + st["total"]
+    total = sum(bucket_tot.values())
+    buckets = {b: {"total_us": round(t, 1),
+                   "share": round(t / total, 3) if total else 0.0}
+               for b, t in sorted(bucket_tot.items(),
+                                  key=lambda kv: -kv[1])}
+
+    verdict = "no sampled ops with stitched durations"
+    if total:
+        host = buckets.get("host_cpu", {}).get("share", 0.0)
+        rtt = (buckets.get("replication", {}).get("share", 0.0)
+               + buckets.get("device", {}).get("share", 0.0))
+        top_b = next(iter(buckets))
+        if host >= 0.5:
+            verdict = (f"host-CPU-bound: {host:.0%} of server time in "
+                       f"Python framing/dedup/locks "
+                       f"(roundtrip {rtt:.0%}) — the native-hot-path "
+                       f"item pays off")
+        elif rtt >= 0.5:
+            verdict = (f"roundtrip-bound: {rtt:.0%} of server time in "
+                       f"replication/device waits (host CPU "
+                       f"{host:.0%}) — batching/pipelining depth is "
+                       f"the lever")
+        else:
+            verdict = (f"mixed: dominant bucket {top_b} "
+                       f"({buckets[top_b]['share']:.0%}); host CPU "
+                       f"{host:.0%}, roundtrip {rtt:.0%}")
+    return {"ops": n_ops, "stages": stages, "dominant": dominant,
+            "buckets": buckets, "verdict": verdict}
+
+
+def render_table(rep: dict) -> str:
+    lines = [f"critical-path attribution over {rep['ops']} sampled "
+             f"op(s)", "",
+             f"{'stage':<18} {'n':>6} {'p50us':>9} {'p99us':>10} "
+             f"{'meanus':>9} {'dominates':>10}"]
+    order = [DUR_NAMES[s] for s in ORDER if s in DUR_NAMES]
+    for name in order:
+        st = rep["stages"].get(name)
+        if st is None:
+            continue
+        dom = rep["dominant"].get(name, 0)
+        lines.append(f"{name:<18} {st['n']:>6} {st['p50']:>9,.1f} "
+                     f"{st['p99']:>10,.1f} {st['mean']:>9,.1f} "
+                     f"{dom:>10}")
+    lines += ["", f"{'bucket':<14} {'share':>7} {'total_us':>12}"]
+    for b, rec in rep["buckets"].items():
+        lines.append(f"{b:<14} {rec['share']:>6.1%} "
+                     f"{rec['total_us']:>12,.1f}")
+    lines += ["", f"verdict: {rep['verdict']}"]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apus_tpu.obs.critpath",
+        description="Fold stitched span dumps into a per-op "
+                    "dominant-stage attribution table.")
+    ap.add_argument("files", nargs="*",
+                    help="dump JSON files (OP_OBS_DUMP fetches or a "
+                         "harness failure dump)")
+    ap.add_argument("--addrs", default="",
+                    help="fetch live dumps from these replica "
+                         "endpoints (comma-separated host:port)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    dumps: list[dict] = []
+    for path in args.files:
+        dumps.extend(load_dumps(path))
+    if args.addrs:
+        from apus_tpu.obs.service import collect_cluster_dumps
+        dumps.extend(collect_cluster_dumps(
+            [a for a in args.addrs.split(",") if a]))
+    if not dumps:
+        print("no dumps (give files and/or --addrs)", file=sys.stderr)
+        return 1
+    rep = attribute(dumps)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        sys.stdout.write(render_table(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

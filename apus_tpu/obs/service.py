@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import socket
+import time
 from typing import Optional
 
 from apus_tpu.parallel import wire
@@ -37,6 +38,82 @@ def _refresh_daemon_gauges(daemon) -> None:
         getattr(p, "compaction_floor", 0) if p else 0)
     g("daemon_store_records_since_base").set(
         getattr(p, "entries_since_base", 0) if p else 0)
+    # Device-plane driver stats (per-daemon dict) mirrored as devd_*
+    # gauges — the driver's half of the device telemetry; the runner's
+    # half (dev_*) is merged from its own registry by _merged_snapshot.
+    drv = getattr(daemon, "device_driver", None)
+    if drv is not None:
+        for k in ("rounds", "drained", "holes", "fallbacks",
+                  "quorum_gated", "qfail_timeouts", "async_windows",
+                  "partial_deferrals"):
+            g(f"devd_{k}").set(drv.stats.get(k, 0))
+
+
+def _merged_snapshot(daemon) -> dict:
+    """Registry snapshot with the device runner's process-wide
+    registry merged over it: in-process clusters share ONE runner, so
+    every replica's scrape reports the same (true) device-plane
+    numbers; the hub's pre-registered zeros keep the catalog reachable
+    when no device plane is attached."""
+    snap = daemon.obs.registry.snapshot()
+    drv = getattr(daemon, "device_driver", None)
+    runner = getattr(drv, "runner", None) if drv is not None else None
+    rmetrics = getattr(runner, "metrics", None)
+    if rmetrics is not None:
+        snap.update(rmetrics.snapshot())
+    return snap
+
+
+def _metric_value(metrics: dict, name: str, default=0):
+    rec = metrics.get(name)
+    if not isinstance(rec, dict):
+        return default
+    return rec.get("value", rec.get("count", default))
+
+
+def health_verdict(daemon, metrics: dict) -> dict:
+    """Derived per-replica health summary: the degradation signals that
+    otherwise hide in counter noise, folded into one scrapeable verdict
+    (fuzz/soak assert on it at teardown so silent degradation fails
+    loudly).  ``flags`` lists every degradation signal present;
+    ``verdict`` is "ok" iff none fired.  Flags can be LEGITIMATE under
+    injected faults (a chaos campaign expects fallbacks), so harnesses
+    assert on the subset their fault schedule cannot explain —
+    ``dev_recompiles`` is never explainable."""
+    flags = []
+    if _metric_value(metrics, "daemon_persist_disabled"):
+        flags.append("persist_disabled")
+    if _metric_value(metrics, "dev_recompiles"):
+        flags.append("dev_recompiles")
+    if _metric_value(metrics, "node_snap_push_abandoned"):
+        flags.append("snap_push_abandoned")
+    if _metric_value(metrics, "devd_qfail_timeouts"):
+        flags.append("devplane_qfail_timeout")
+    if _metric_value(metrics, "devd_fallbacks"):
+        flags.append("devplane_fallbacks")
+    if _metric_value(metrics, "node_delta_refused"):
+        flags.append("delta_refused")
+    if _metric_value(metrics, "node_snap_chunk_quarantines"):
+        flags.append("snap_chunk_quarantines")
+    uptime = time.monotonic() - getattr(daemon, "started_mono",
+                                        time.monotonic())
+    elections = _metric_value(metrics, "node_elections")
+    return {
+        "verdict": "ok" if not flags else "degraded",
+        "flags": flags,
+        "leader_flaps": elections,
+        "leader_flap_rate_per_min": round(
+            elections / (uptime / 60.0), 3) if uptime > 1.0 else 0.0,
+        "persist_errors": _metric_value(metrics,
+                                        "daemon_persist_errors"),
+        "quorum_fail_rounds": _metric_value(metrics,
+                                            "dev_quorum_fail_rounds"),
+        "quorum_fail_streaks": _metric_value(metrics,
+                                             "devd_qfail_timeouts"),
+        "snap_push_abandons": _metric_value(metrics,
+                                            "node_snap_push_abandoned"),
+        "recompiles": _metric_value(metrics, "dev_recompiles"),
+    }
 
 
 def make_obs_ops(daemon) -> dict:
@@ -48,10 +125,12 @@ def make_obs_ops(daemon) -> dict:
             return wire.u8(wire.ST_ERROR)
         with daemon.lock:
             _refresh_daemon_gauges(daemon)
+            metrics = _merged_snapshot(daemon)
             payload = {"replica": daemon.idx,
                        "role": daemon.node.role.name,
                        "term": daemon.node.current_term,
-                       "metrics": hub.registry.snapshot()}
+                       "metrics": metrics,
+                       "health": health_verdict(daemon, metrics)}
         return wire.u8(wire.ST_OK) + wire.blob(
             json.dumps(payload).encode())
 
@@ -62,6 +141,8 @@ def make_obs_ops(daemon) -> dict:
         _refresh_daemon_gauges(daemon)
         d = hub.dump()
         d["replica"] = daemon.idx
+        d["metrics"] = _merged_snapshot(daemon)
+        d["health"] = health_verdict(daemon, d["metrics"])
         with daemon.lock:
             d["role"] = daemon.node.role.name
             d["term"] = daemon.node.current_term

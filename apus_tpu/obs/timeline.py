@@ -54,17 +54,26 @@ def merge_dumps(dumps: list[dict]) -> list[dict]:
             e = dict(ev)
             e["wall_us"] = _wall(ev.get("t_us", 0), anchor)
             e["src"] = src
-            e["kind"] = "span"
+            # Device window events (dev_dispatch/dev_ready) are
+            # idx-RANGE events, not per-op stamps: they ride the span
+            # ring with req=0 and an exclusive upper index in "hi".
+            # Tag them "dev" so the renderer and the stitcher treat
+            # them as windows, interleaved with host spans.
+            e["kind"] = "dev" if ev.get("hi") is not None else "span"
             merged.append(e)
     merged.sort(key=lambda e: e["wall_us"])
     return merged
 
 
-def stitch_ops(merged: list[dict]) -> dict:
+def stitch_ops(merged: list[dict],
+               attach_device: bool = True) -> dict:
     """Group span stamps by (clt_id, req_id) across every source —
     the cross-replica trace of one sampled client op.  Returns
     {(clt, req): {"term", "idx", "stamps": [event...]}} with stamps in
-    wall order."""
+    wall order.  With ``attach_device`` (default), device window
+    events whose [idx, hi) range covers an op's log index are STITCHED
+    into that op's hop chain — the device dispatch/ready hops of the
+    window that carried the op, interleaved at their wall position."""
     ops: dict = {}
     for ev in merged:
         if ev.get("kind") != "span" or not ev.get("req"):
@@ -77,7 +86,35 @@ def stitch_ops(merged: list[dict]) -> dict:
             o["idx"] = ev["idx"]
         if ev.get("term") is not None:
             o["term"] = ev["term"]
+    if attach_device:
+        attach_device_windows(ops, merged)
     return ops
+
+
+def attach_device_windows(ops: dict, merged: list[dict]) -> None:
+    """Interleave device window events into the stitched per-op
+    chains: a dev event covers every op whose log index falls in
+    [ev["idx"], ev["hi"]).  First covering event per (op, stage) wins
+    (the dispatch that actually carried the index); stamps are
+    re-sorted so the chain stays in wall order."""
+    devs = [ev for ev in merged if ev.get("kind") == "dev"
+            and ev.get("idx") is not None]
+    if not devs:
+        return
+    for o in ops.values():
+        idx = o.get("idx")
+        if idx is None:
+            continue
+        seen = {s.get("stage") for s in o["stamps"]}
+        touched = False
+        for ev in devs:
+            if ev["idx"] <= idx < ev.get("hi", ev["idx"]) \
+                    and ev.get("stage") not in seen:
+                o["stamps"].append(ev)
+                seen.add(ev.get("stage"))
+                touched = True
+        if touched:
+            o["stamps"].sort(key=lambda e: e.get("wall_us", 0))
 
 
 def render(merged: list[dict], last_s: Optional[float] = None,
@@ -96,7 +133,12 @@ def render(merged: list[dict], last_s: Optional[float] = None,
     for ev in merged:
         dt_ms = (ev["wall_us"] - end) / 1000.0
         src = ev.get("src", "?")
-        if ev.get("kind") == "span":
+        if ev.get("kind") == "dev":
+            lines.append(
+                f"[{dt_ms:>10.3f}ms] {src:<6} dev    "
+                f"{ev.get('stage', '?'):<16} "
+                f"idx=[{ev.get('idx')},{ev.get('hi')})")
+        elif ev.get("kind") == "span":
             extra = " ".join(
                 f"{k}={ev[k]}" for k in ("req", "idx", "term", "hi")
                 if ev.get(k) is not None)

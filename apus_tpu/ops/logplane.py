@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -132,6 +133,12 @@ class HostStagingRing:
         self._lock = threading.Lock()
         self._pools: dict[int, list] = {}     # depth -> [_StageSlot]
         self._cursor: dict[int, int] = {}
+        #: optional obs Histogram observing the consumer-edge block of
+        #: every acquire, in µs (apus_tpu.obs.metrics.Histogram-shaped:
+        #: anything with .observe()).  The window-occupancy question
+        #: "is staging ever the wait?" becomes a scrapeable
+        #: distribution instead of a profiler session.
+        self.wait_hist = None
 
     class _StageSlot:
         __slots__ = ("data", "meta", "inflight")
@@ -159,7 +166,12 @@ class HostStagingRing:
             # Ready outputs of the staging transfer imply the host
             # buffer's bytes have been read; rewriting before that
             # would corrupt the in-flight window.
+            t0 = time.perf_counter() if self.wait_hist is not None \
+                else 0.0
             jax.block_until_ready(slot.inflight)
+            if self.wait_hist is not None:
+                self.wait_hist.observe(
+                    int((time.perf_counter() - t0) * 1e6))
             slot.inflight = None
         # memset, not realloc: encoders only write each entry's wire
         # bytes, so stale tail bytes from the last window must be

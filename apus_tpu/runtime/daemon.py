@@ -75,6 +75,9 @@ class ReplicaDaemon:
         # registries (the legacy stats surface stays alive).
         from apus_tpu.obs import make_hub
         self.obs = make_hub(ident=f"r{idx}")
+        #: uptime anchor for the scrape's derived health verdict
+        #: (leader flap RATE needs a denominator).
+        self.started_mono = time.monotonic()
 
         peers = {i: _parse_peer(a) for i, a in enumerate(spec.peers)}
         # Dial backoff scaled to the timing envelope: at the production

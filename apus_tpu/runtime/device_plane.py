@@ -79,6 +79,41 @@ from apus_tpu.core.types import EntryType
 from apus_tpu.parallel import wire
 from apus_tpu.parallel.transport import Region
 
+# -- process-wide XLA compile accounting (the recompile sentinel's
+#    signal source).  jax.monitoring fires one
+#    /jax/core/compile/backend_compile_duration event per REAL backend
+#    compile (cached dispatches fire nothing; the C++ fastpath cache
+#    can grow per call signature WITHOUT compiling, so jit cache sizes
+#    alone over-report).  Builders account their own compiles into
+#    _EXPECTED, so "unexpected compiles" — the PR 3 mid-leadership
+#    stall class — is (total - expected), stable across other runners
+#    building in the same process.
+_COMPILES = {"count": 0, "secs": 0.0}
+_EXPECTED = {"count": 0}
+_LISTENING = [False]
+
+
+def _ensure_compile_listener() -> None:
+    if _LISTENING[0]:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_event(name: str, secs: float, **_kw) -> None:
+            if name == "/jax/core/compile/backend_compile_duration":
+                _COMPILES["count"] += 1
+                _COMPILES["secs"] += secs
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _LISTENING[0] = True
+    except Exception:                                 # noqa: BLE001
+        pass          # sentinel degrades to "never fires", not a crash
+
+
+def unexpected_compiles() -> int:
+    """Backend compiles nobody's build/warmup accounted for."""
+    return _COMPILES["count"] - _EXPECTED["count"]
+
 
 class DeviceCommitRunner:
     """Process-wide device-plane engine: HBM log shards + jitted commit
@@ -124,8 +159,38 @@ class DeviceCommitRunner:
         self._leader: Optional[int] = None
         self._term = 0
         self._built = False
-        self.stats = {"rounds": 0, "resets": 0, "quorum_fail_rounds": 0,
-                      "entries_devplane": 0, "pipelined_dispatches": 0}
+        # Device-plane telemetry rides a registry of its own (the
+        # runner is process-wide, shared by every in-process daemon;
+        # OP_METRICS/OP_OBS_DUMP merge this snapshot into each
+        # replica's scrape) — the ad-hoc stats dict becomes the
+        # dict-compatible dev_* view over it, so every legacy
+        # ``runner.stats[...]`` consumer keeps working while the
+        # counters/gauges/histograms become scrapeable.
+        from apus_tpu.obs.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        self.stats = self.metrics.view("dev")
+        for k in ("rounds", "resets", "quorum_fail_rounds",
+                  "entries_devplane", "pipelined_dispatches",
+                  "window_dispatches", "deep_dispatches",
+                  "early_exits", "recompiles"):
+            self.stats.setdefault(k, 0)
+        #: slowest blocked device-result wait observed (the stall
+        #: watchdog scales to this) — a float gauge behind the same
+        #: "max_dispatch_ms" view key the dict exposed.
+        self._max_dispatch = self.metrics.gauge("dev_max_dispatch_ms")
+        self._dispatch_wait_hist = self.metrics.histogram(
+            "dev_dispatch_wait_us")
+        self._window_wall_hist = self.metrics.histogram(
+            "dev_window_wall_us")
+        self._window_depth_hist = self.metrics.histogram(
+            "dev_window_depth")
+        self._rounds_run_hist = self.metrics.histogram(
+            "dev_window_rounds_run")
+        #: post-warmup compile-cache baseline per live executable
+        #: (attribution hints) + the unexpected-compile watermark the
+        #: sentinel actually alarms on; armed at the end of _build.
+        self._exec_cache_sizes: Optional[dict] = None
+        self._compile_baseline = 0
         #: dispatch-depth histogram {window_rounds: dispatches} — the
         #: wrl_count_array analog (the reference histograms its commit
         #: loop's iteration counts, dare_ibv_rc.c:1868-1937); this shows
@@ -145,6 +210,10 @@ class DeviceCommitRunner:
     def _build_locked(self) -> None:
         if self._built:
             return
+        # Every compile this build+warmup performs is EXPECTED: the
+        # sentinel only alarms on compiles past this accounting.
+        _ensure_compile_listener()
+        _compiles_at_build_start = _COMPILES["count"]
         import jax
 
         from apus_tpu.ops.commit import build_commit_step
@@ -293,6 +362,12 @@ class DeviceCommitRunner:
         # transfer that read the buffer two windows ago).
         from apus_tpu.ops.logplane import HostStagingRing
         self._staging = HostStagingRing(B, SB)
+        # Occupancy telemetry: how long window encoding blocks on the
+        # consumer edge (the transfer that read this buffer pair two
+        # windows ago) — nonzero p99 here means staging, not the
+        # device, is the pipeline's wait.
+        self._staging.wait_hist = self.metrics.histogram(
+            "dev_staging_wait_us")
         #: Whether the driver keeps deep windows in flight
         #: (commit_rounds_async) rather than resolving each before
         #: staging the next.  With the in-place staging encoder the
@@ -308,6 +383,14 @@ class DeviceCommitRunner:
         self._ctrl_cache: Optional[tuple] = None
         self._jax = jax
         self._warmup()
+        # Recompile sentinel baseline: _warmup just exercised every
+        # live dispatch signature, so further backend compiles on this
+        # plane are a bug class (the PR 3 mid-leadership stall) —
+        # alarm, not archaeology.  Our own build's compiles go into
+        # the expected ledger first.
+        _EXPECTED["count"] += _COMPILES["count"] - _compiles_at_build_start
+        self._snapshot_exec_caches()
+        self._compile_baseline = unexpected_compiles()
         self._built = True
 
     def _warmup(self) -> None:
@@ -388,6 +471,21 @@ class DeviceCommitRunner:
             devlog, bdata, bmeta,
             self._make_ctrl(wcid, 0, 1, 1, live=set(range(R))))
         self._jax.block_until_ready(self._pack_result(acks, commit))
+        # Deep pipes with the cache-derived ctrl too (pipes never
+        # donate ctrl, so the cached masks survive): a live deep
+        # dispatch that follows ANY window dispatch derives its ctrl
+        # from the donated masks — unwarmed, the FIRST deep window of
+        # such a leadership paid a mid-leadership XLA recompile.
+        # Found by this PR's recompile sentinel on its first run; the
+        # exact sibling of the PR 3 second-window stall.
+        for depth, pipe in self._pipes.items():
+            pdata2, pmeta2 = self._place_staged(
+                np.zeros((depth, B, SB), np.uint8),
+                np.zeros((depth, B, 4), np.int32), 0)
+            devlog, commits, _ = pipe(
+                devlog, pdata2, pmeta2,
+                self._make_ctrl(wcid, 0, 1, 1, live=set(range(R))))
+            self._jax.block_until_ready(commits)
         self._ctrl_cache = None          # warm ctrl is throwaway
         # Reader paths too (follower drain batch + window gathers,
         # shard_end poll): their first use otherwise compiles
@@ -398,6 +496,69 @@ class DeviceCommitRunner:
                 np.zeros(n, np.int32)))
         self._jax.block_until_ready(self._offs_one(devlog.offs,
                                                    np.int32(0)))
+
+    # -- device-plane telemetry (recompile sentinel + dispatch timing) ----
+
+    def _executables(self) -> list:
+        """(name, jitted fn) for every live executable whose compile
+        cache the sentinel watches.  Anything without a ``_cache_size``
+        probe (plain-python fallbacks) is skipped."""
+        out = []
+        for attr in ("_step", "_window", "_gather", "_offs_one",
+                     "_pack_result", "_place_dev", "_place_staged_dev"):
+            fn = getattr(self, attr, None)
+            if fn is not None and hasattr(fn, "_cache_size"):
+                out.append((attr.lstrip("_"), fn))
+        for depth, pipe in getattr(self, "_pipes", {}).items():
+            if hasattr(pipe, "_cache_size"):
+                out.append((f"pipe{depth}", pipe))
+        return out
+
+    def _snapshot_exec_caches(self) -> None:
+        self._exec_cache_sizes = {name: fn._cache_size()
+                                  for name, fn in self._executables()}
+
+    def check_recompiles(self) -> list:
+        """Recompile sentinel.  The alarm signal is jax's own
+        backend-compile event stream: any compile past what builds/
+        warmups accounted for is a post-warmup XLA compile racing live
+        traffic — the PR 3 mid-leadership ~0.5 s stall class, which
+        tripped the stall watchdog and flipped commit ownership with
+        no real fault.  (The C++ fastpath jit caches can grow per call
+        signature WITHOUT compiling, so cache sizes alone over-report;
+        they are used only to ATTRIBUTE a detected compile to an
+        executable.)  Each detection is reported once (the watermark
+        advances) and counted in ``dev_recompiles``; the driver turns
+        every report into a flight-recorder event.  Returns
+        ``[(executable_name, old_cache, new_cache), ...]`` — name
+        "unknown" when no watched cache grew (the compile came from
+        outside the watched set)."""
+        if self._exec_cache_sizes is None:
+            return []
+        # Attribution sweep (always, so the hints stay current).
+        grown = []
+        for name, fn in self._executables():
+            cur = fn._cache_size()
+            old = self._exec_cache_sizes.get(name, 0)
+            if cur > old:
+                grown.append((name, old, cur))
+                self._exec_cache_sizes[name] = cur
+        unexpected = unexpected_compiles()
+        delta = unexpected - self._compile_baseline
+        if delta <= 0:
+            return []
+        self._compile_baseline = unexpected
+        self.stats.bump("recompiles", delta)
+        return grown if grown else [("unknown", 0, 0)]
+
+    def _observe_dispatch_wait(self, seconds: float) -> None:
+        """Fold one blocked device->host result wait into the
+        telemetry: the per-dispatch wait histogram (µs) plus the
+        max-wait gauge the stall watchdog scales to."""
+        ms = seconds * 1e3
+        if ms > self._max_dispatch.value:
+            self._max_dispatch.set(ms)
+        self._dispatch_wait_hist.observe(int(seconds * 1e6))
 
     #: bytes of wire-codec overhead per slot payload (encode_entry
     #: header + optional cid, upper bound).  The authoritative gate is
@@ -445,7 +606,7 @@ class DeviceCommitRunner:
                 term=term, sharding=self._sharding)
             self._next_end0 = first_idx
             self._leader, self._term = leader, term
-            self.stats["resets"] += 1
+            self.stats.bump("resets")
             if self.logger is not None:
                 self.logger.info(
                     "device plane reset: gen=%d leader=%d term=%d base=%d",
@@ -487,9 +648,11 @@ class DeviceCommitRunner:
                                                   pmeta, ctrl)
             self._devlog = new_devlog
             self._next_end0 = end0 + B
-            self.stats["rounds"] += 1
-            self.stats["entries_devplane"] += B
+            self.stats.bump("rounds")
+            self.stats.bump("entries_devplane", B)
             self.depth_histogram[1] = self.depth_histogram.get(1, 0) + 1
+            self._window_depth_hist.observe(1)
+        t0 = time.monotonic()
         if self._use_device_expand:
             # One blocked device->host transfer per round (two separate
             # readbacks pay two relay round trips on a tunneled chip).
@@ -502,8 +665,9 @@ class DeviceCommitRunner:
             # as _use_device_expand).
             acks_host = [int(a) for a in np.asarray(acks)]
             commit_host = int(np.asarray(commit))
+        self._observe_dispatch_wait(time.monotonic() - t0)
         if commit_host < end0 + B:
-            self.stats["quorum_fail_rounds"] += 1
+            self.stats.bump("quorum_fail_rounds")
         return acks_host, commit_host
 
     def _encode_batch(self, entries: list[LogEntry], end0: int,
@@ -559,6 +723,7 @@ class DeviceCommitRunner:
         B, W = self.batch, self.PIPE_DEPTH
         n = len(entries) // B
         assert 1 <= n <= W and len(entries) == n * B, (len(entries), n, B)
+        t_wall = time.monotonic()
         with self.lock:
             if gen != self.generation or self._devlog is None:
                 return None
@@ -586,24 +751,30 @@ class DeviceCommitRunner:
             # runner has a single dispatcher, so no window can slip in
             # between at the stale cursor).
             self._next_end0 = end0 + n * B
-            self.stats["window_dispatches"] = \
-                self.stats.get("window_dispatches", 0) + 1
+            self.stats.bump("window_dispatches")
             self.depth_histogram[n] = self.depth_histogram.get(n, 0) + 1
+            self._window_depth_hist.observe(n)
         t0 = time.monotonic()
         packed = np.asarray(self._pack_result(commits, rounds_run))
-        self.stats["max_dispatch_ms"] = max(
-            self.stats.get("max_dispatch_ms", 0.0),
-            (time.monotonic() - t0) * 1e3)
+        self._observe_dispatch_wait(time.monotonic() - t0)
         commits_host, rr = packed[:-1], int(packed[-1])
         commit_host = int(commits_host[max(rr - 1, 0)])
+        self._window_wall_hist.observe(
+            int((time.monotonic() - t_wall) * 1e6))
         with self.lock:
             if gen != self.generation:
                 return None
-            self.stats["rounds"] += rr
-            self.stats["entries_devplane"] += rr * B
-            self.stats["quorum_fail_rounds"] += int(sum(
-                int(commits_host[k]) < end0 + (k + 1) * B
-                for k in range(rr)))
+            self.stats.bump("rounds", rr)
+            self.stats.bump("entries_devplane", rr * B)
+            self._rounds_run_hist.observe(rr)
+            if rr < n:
+                # Requested depth vs early-exit round: the occupancy
+                # evidence that a quorum failure cut the window short.
+                self.stats.bump("early_exits")
+            qf = int(sum(int(commits_host[k]) < end0 + (k + 1) * B
+                         for k in range(rr)))
+            if qf:
+                self.stats.bump("quorum_fail_rounds", qf)
             if rr < n and self._next_end0 == end0 + n * B:
                 # Quorum failed at round rr-1: rounds rr..n-1 never
                 # executed anywhere — rewind the contiguity cursor to
@@ -670,13 +841,13 @@ class DeviceCommitRunner:
                     self._devlog, sdata, smeta, ctrl)
             self._devlog = new_devlog
             self._next_end0 = end0 + K * B
-            self.stats["rounds"] += K
-            self.stats["entries_devplane"] += K * B
-            self.stats["pipelined_dispatches"] += 1
+            self.stats.bump("rounds", K)
+            self.stats.bump("entries_devplane", K * B)
+            self.stats.bump("pipelined_dispatches")
             self.depth_histogram[K] = self.depth_histogram.get(K, 0) + 1
+            self._window_depth_hist.observe(K)
             if K >= self.DEEP_DEPTH:
-                self.stats["deep_dispatches"] = \
-                    self.stats.get("deep_dispatches", 0) + 1
+                self.stats.bump("deep_dispatches")
         return _WindowHandle(gen, end0, K, commits)
 
     def resolve_rounds(self, h: "_WindowHandle") -> Optional[int]:
@@ -687,18 +858,18 @@ class DeviceCommitRunner:
         the caller must no longer act on."""
         t0 = time.monotonic()
         commits_host = np.asarray(h.commits)        # device->host wait
-        self.stats["max_dispatch_ms"] = max(
-            self.stats.get("max_dispatch_ms", 0.0),
-            (time.monotonic() - t0) * 1e3)
+        self._observe_dispatch_wait(time.monotonic() - t0)
         B = self.batch
         with self.lock:
             if h.gen != self.generation:
                 return None
             # Per-round accounting (parity with the single-round path:
             # a dispatch where all K rounds miss quorum counts K, not 1).
-            self.stats["quorum_fail_rounds"] += int(sum(
-                int(commits_host[k]) < h.end0 + (k + 1) * B
-                for k in range(h.K)))
+            qf = int(sum(int(commits_host[k]) < h.end0 + (k + 1) * B
+                         for k in range(h.K)))
+            if qf:
+                self.stats.bump("quorum_fail_rounds", qf)
+            self._rounds_run_hist.observe(h.K)
         # Index by round count, not -1: the shallow windowed engine
         # returns a max_depth-padded commits vector.
         return int(commits_host[h.K - 1])
@@ -869,7 +1040,37 @@ class DevicePlaneDriver:
         self._qfail_pause_until = 0.0
         self._gate_since: Optional[float] = None
         self.stats = {"rounds": 0, "drained": 0, "holes": 0,
-                      "fallbacks": 0}
+                      "fallbacks": 0, "partial_deferrals": 0}
+
+    def _set_owned(self, node, owned: bool, cause: str) -> None:
+        """Flip device-plane commit ownership (under the daemon lock),
+        leaving a cause-tagged flight event + counter behind — every
+        ``owns_commit`` transition becomes attributable from a
+        black-box dump (stall watchdog vs quorum-fail streak vs
+        leadership warmup vs cursor catch-up), instead of a mystery
+        boolean observed after the fact."""
+        if bool(node.external_commit) == owned:
+            return
+        node.external_commit = owned
+        node.bump("devplane_own_flips")
+        node._note("devplane", "own" if owned else "release",
+                   cause=cause, commit=node.log.commit,
+                   dev_next=self._dev_next)
+
+    def _check_recompiles(self, node) -> None:
+        """Drain the runner's recompile sentinel into the flight
+        recorder (called under the daemon lock after dispatch
+        adoption; the sentinel itself is a handful of jit-cache size
+        probes)."""
+        check = getattr(self.runner, "check_recompiles", None)
+        if check is None:
+            return
+        for name, old, new in check():
+            node._note("devplane", "recompile", exe=name,
+                       cached_before=old, cached_after=new)
+            self.logger.warning(
+                "device plane: post-warmup XLA recompile on live "
+                "executable %r (jit cache %d -> %d)", name, old, new)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -893,7 +1094,7 @@ class DevicePlaneDriver:
             self._thread.join(timeout=2.0)
         with self.daemon.lock:
             node = self.daemon.node
-            node.external_commit = False
+            self._set_owned(node, False, "driver_stop")
             if node.pre_election_hook == self._drain_for_election:
                 node.pre_election_hook = None
             if self._tick_watchdog in self.daemon.on_tick:
@@ -920,7 +1121,7 @@ class DevicePlaneDriver:
             window = max(window, 2.5 * md_ms / 1e3)
         if node.log.end > node.log.commit and \
                 time.monotonic() - self._last_commit_advance > window:
-            node.external_commit = False
+            self._set_owned(node, False, "stall_watchdog")
             self._cooldown_until = time.monotonic() + window
             self.stats["fallbacks"] += 1
             node._note("watchdog", "devplane_stall_fallback",
@@ -943,7 +1144,7 @@ class DevicePlaneDriver:
 
     def _deactivate(self) -> None:
         with self.daemon.lock:
-            self.daemon.node.external_commit = False
+            self._set_owned(self.daemon.node, False, "driver_error")
             self.daemon.node.device_covered_from = None
         self._gen = None
         self._inflight.clear()
@@ -969,7 +1170,7 @@ class DevicePlaneDriver:
             if self._gen is not None:
                 self._gen = None
                 self._inflight.clear()
-                node.external_commit = False
+                self._set_owned(node, False, "role_change")
         return self._follower_step(node)
 
     # -- leader half ------------------------------------------------------
@@ -986,7 +1187,7 @@ class DevicePlaneDriver:
             if self._gen is not None:
                 self._gen = None
                 self._inflight.clear()
-                node.external_commit = False
+                self._set_owned(node, False, "coverage_lost")
                 node.device_covered_from = None
                 self.stats["fallbacks"] += 1
             return False
@@ -1023,7 +1224,7 @@ class DevicePlaneDriver:
         if not node.external_commit and node.log.commit >= self._dev_base \
                 and time.monotonic() >= self._cooldown_until \
                 and self._dev_next >= node.log.commit:
-            node.external_commit = True
+            self._set_owned(node, True, "cursor_catchup")
             # Future-stamp by one watchdog window: freshly-armed
             # ownership gets a doubled first stall check — the first
             # window after arming legitimately covers staging + the
@@ -1059,7 +1260,7 @@ class DevicePlaneDriver:
                 self._gate_since = now
             elif now - self._gate_since > window and \
                     node.external_commit:
-                node.external_commit = False
+                self._set_owned(node, False, "quorum_gate")
                 self._cooldown_until = now + window
                 self.stats["fallbacks"] += 1
                 self.logger.warning(
@@ -1097,6 +1298,11 @@ class DevicePlaneDriver:
                 end != self._last_end_seen
                 or (not node.log.near_full(3)
                     and any(p.idx is None for p in node._pending))):
+            # Window-occupancy feed: a partial window deferred while
+            # admitted-but-unappended ops queue (or arrivals are still
+            # landing) — counted so the occupancy question "how often
+            # did we wait to fill instead of padding?" is scrapeable.
+            self.stats["partial_deferrals"] += 1
             self._last_end_seen = end
             return False
         self._last_end_seen = end
@@ -1157,8 +1363,7 @@ class DevicePlaneDriver:
             # dispatch, so the host path owns this span; re-base past it
             # once the host quorum has committed it through.
             self.stats["holes"] += 1
-            if node.external_commit:
-                node.external_commit = False
+            self._set_owned(node, False, "oversize_hole")
             if node.log.commit >= self._dev_next + unit:
                 self._gen = None           # re-base next iteration
             return False
@@ -1171,8 +1376,7 @@ class DevicePlaneDriver:
                 # path; re-base the device plane past it once that
                 # happens.
                 self.stats["holes"] += 1
-                if node.external_commit:
-                    node.external_commit = False
+                self._set_owned(node, False, "oversize_hole")
                 if node.log.commit >= self._dev_next + B:
                     self._gen = None       # re-base next iteration
                 return False
@@ -1234,6 +1438,10 @@ class DevicePlaneDriver:
                                                live)
         finally:
             self.daemon.lock.acquire()
+        # Sentinel sweep right after the dispatch: a recompile that
+        # happened inside it is attributed to THIS window's flight
+        # events, not discovered by archaeology a campaign later.
+        self._check_recompiles(node)
 
         if res is None:                    # stale generation
             self._gen = None
@@ -1284,6 +1492,7 @@ class DevicePlaneDriver:
             dev_commit = self.runner.resolve_rounds(h)
         finally:
             self.daemon.lock.acquire()
+        self._check_recompiles(node)
         if self._inflight and self._inflight[0] is h:
             self._inflight.pop(0)
         if dev_commit is None:             # runner reset since enqueue
@@ -1348,7 +1557,8 @@ class DevicePlaneDriver:
         # below the device base; under load that may already be true by
         # the time the shards are rebuilt — take over immediately then,
         # or the racing host path keeps outrunning every fresh base.
-        node.external_commit = node.log.commit >= base
+        self._set_owned(node, node.log.commit >= base,
+                        "leadership_reset")
         node.device_covered_from = base
         if node.external_commit:
             self.logger.info("device plane owns commit from idx %d", base)
@@ -1388,7 +1598,7 @@ class DevicePlaneDriver:
             self._qfail_since = None
             self._qfail_pause_until = now + window
             if node.external_commit:
-                node.external_commit = False
+                self._set_owned(node, False, "quorum_fail_streak")
                 self.stats["fallbacks"] += 1
             self._cooldown_until = max(self._cooldown_until, now + window)
             self.stats["qfail_timeouts"] = \

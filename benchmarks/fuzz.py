@@ -339,6 +339,40 @@ def _obs_event_count(dumps: list) -> int:
                for d in dumps)
 
 
+#: health flags that no injected fault can explain (a chaos campaign
+#: EXPECTS fallbacks and flaps, but a post-warmup XLA recompile is a
+#: bug class regardless, and persistence may only disable when the
+#: trial armed a live disk fault).
+_HARD_HEALTH_FLAGS = ("dev_recompiles", "persist_disabled")
+
+
+def _assert_obs_health(dumps: list, allow: set, tag: str,
+                       dump_obs: "str | None") -> list:
+    """Teardown health gate over the pre-teardown obs sweep: every
+    replica's derived health verdict (OP_OBS_DUMP ``health`` field) is
+    inspected; hard flags the trial's fault schedule cannot explain
+    fail the trial LOUDLY (with the merged timeline shipped alongside,
+    like any other violation) — silent degradation is the failure mode
+    this plane exists to kill.  Returns the informational flag list
+    for the trial's stats."""
+    flagged, hard_bad = [], []
+    for d in dumps:
+        h = d.get("health") or {}
+        flags = list(h.get("flags", []))
+        if flags:
+            flagged.append(f"r{d.get('replica')}:{'+'.join(flags)}")
+        bad = [f for f in flags
+               if f in _HARD_HEALTH_FLAGS and f not in allow]
+        if bad:
+            hard_bad.append((d.get("replica"), bad))
+    if hard_bad:
+        tl = _obs_fail_dump(dumps, dump_obs, tag)
+        raise AssertionError(
+            f"DEVICE-HEALTH VERDICT FAILED ({tag}): {hard_bad} "
+            f"(obs timeline: {tl})")
+    return flagged
+
+
 class _ObsGuard:
     """Rides the cluster's ``with`` statement (listed AFTER the
     ProcCluster, so it exits FIRST, while the daemons still serve):
@@ -470,6 +504,7 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                     _time.sleep(0.05)   # recorded as ambiguous; go on
 
     obs_dumps: list = []
+    armed_persist_fault: list = []   # enospc/fsync_eio armed this trial
     with tempfile.TemporaryDirectory(prefix="apus-audit") as td:
         with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
                          fault_seed=fault_seed) as pc, \
@@ -491,9 +526,11 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                 if disk in ("torn", "crc", "header"):
                     _disk_surgery(pc.store_path(victim), disk, rng)
                 elif disk == "enospc":
+                    armed_persist_fault.append(disk)
                     pc.extra_env[victim] = {
                         "APUS_DISKFAULT_ENOSPC": str(rng.randint(5, 40))}
                 elif disk == "fsync_eio":
+                    armed_persist_fault.append(disk)
                     pc.extra_env[victim] = {
                         "APUS_DISKFAULT_FSYNC_EIO":
                             str(rng.randint(1, 10))}
@@ -573,6 +610,13 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
         raise AssertionError(
             f"LINEARIZABILITY VIOLATION (history: {dump}; "
             f"obs timeline: {tl})\n" + res.describe())
+    # Teardown health verdict: hard degradation flags the schedule
+    # cannot explain (recompiles always; persist_disabled unless this
+    # trial armed a live enospc/fsync-eio fault) fail the trial.
+    stats["health_flags"] = _assert_obs_health(
+        obs_dumps,
+        allow={"persist_disabled"} if armed_persist_fault else set(),
+        tag=f"audit-health-{fault_seed}", dump_obs=dump_obs)
     return stats
 
 
@@ -914,6 +958,11 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
         stats["ops_checked"] = ops_checked
         stats["keys"] = res.keys
         stats["recorded"] = len(recorder.events())
+    # Teardown health verdict (churn arms no live persistence fault,
+    # so both hard flags gate here).
+    stats["health_flags"] = _assert_obs_health(
+        obs_dumps, allow=set(),
+        tag=f"churn-health-{fault_seed}", dump_obs=dump_obs)
     return stats
 
 

@@ -645,6 +645,26 @@ def main() -> int:
         except Exception:                        # noqa: BLE001
             pass
 
+    # Teardown health verdict over the pre-teardown obs sweep: the
+    # soak injects no disk faults, so a persist_disabled — and a
+    # post-warmup device recompile under ANY schedule — is silent
+    # degradation and fails the run loudly.
+    health_flags: dict = {}
+    health_bad: list = []
+    for d in obs_dumps:
+        h = d.get("health") or {}
+        fl = list(h.get("flags", []))
+        if fl:
+            health_flags[d.get("replica")] = fl
+        bad = [f for f in fl
+               if f in ("dev_recompiles", "persist_disabled")]
+        if bad:
+            health_bad.append([d.get("replica"), bad])
+    if health_flags:
+        print(f"[obs] health flags at teardown: {health_flags}"
+              + (f" — HARD: {health_bad}" if health_bad else ""),
+              file=sys.stderr)
+
     # Linearizability verdict over the recorded soak stream (the
     # maintenance-gate convergence reads above are deliberately NOT in
     # the history — they are allowed to be stale).
@@ -704,6 +724,8 @@ def main() -> int:
                                    "compaction_floors":
                                        compaction_floors,
                                    "state_size": args.state_size},
+            "obs_health": {"flags": health_flags,
+                           "bad": health_bad},
             **({"audit": audit_detail}
                if audit_detail is not None else {}),
             **({"mesh": {
@@ -721,6 +743,7 @@ def main() -> int:
         },
     }))
     ok = (converged and not errors and audit_ok
+          and not health_bad
           and (not args.churn or churn_errors == 0))
     if not ok and args.fault_seed is not None:
         print(f"SOAK FAIL (FAULT_SEED={args.fault_seed})\n"

@@ -379,6 +379,172 @@ def cmd_run(args) -> int:
     return 0
 
 
+# -- perf-regression gate (eval.py compare) --------------------------------
+
+def _norm_records(path: str) -> list[dict]:
+    """Load one banked result set as a flat record list.  Accepts
+    runs.jsonl shape (one JSON record per line), a BENCH_rXX.json
+    envelope ({"parsed": record-or-list, ...}), a bare record, or a
+    JSON list of records."""
+    recs: list[dict] = []
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if path.endswith(".jsonl"):
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+            return recs
+        data = json.load(f) if head else []
+    if isinstance(data, list):
+        return [r for r in data if isinstance(r, dict)]
+    if isinstance(data, dict):
+        if "parsed" in data:
+            parsed = data["parsed"]
+            return [parsed] if isinstance(parsed, dict) else \
+                [r for r in parsed if isinstance(r, dict)]
+        if "metric" in data:
+            return [data]
+    return recs
+
+
+def _series_fields(rec: dict):
+    """(field, value, unit) comparison axes of one record: the
+    headline value plus every latency percentile the detail carries —
+    stage-breakdown p50s and single-window depth walls included, so a
+    per-STAGE regression trips the gate even when the headline moved
+    within threshold."""
+    if isinstance(rec.get("value"), (int, float)):
+        yield ("value", float(rec["value"]), rec.get("unit", ""))
+    det = rec.get("detail") or {}
+    for k in ("p50_us", "p95_us", "p99_us",
+              "p50_ms", "p95_ms", "p99_ms"):
+        if isinstance(det.get(k), (int, float)):
+            yield (k, float(det[k]), k.rsplit("_", 1)[-1])
+    for name, st in (det.get("stages_us") or {}).items():
+        if isinstance(st, dict) and isinstance(st.get("p50"),
+                                               (int, float)):
+            yield (f"stage_{name}_p50", float(st["p50"]), "us")
+    for depth, w in (det.get("windows") or {}).items():
+        if isinstance(w, dict) and isinstance(w.get("wall_p50_us"),
+                                              (int, float)):
+            yield (f"depth{depth}_wall_p50", float(w["wall_p50_us"]),
+                   "us")
+
+
+def _extract_series(recs: list[dict]) -> dict:
+    """{(metric, replicas, app, field): [values]} over a record set."""
+    out: dict = {}
+    for rec in recs:
+        metric = rec.get("metric")
+        if not metric:
+            continue
+        base = (metric, rec.get("replicas"), rec.get("app", ""))
+        for field, v, unit in _series_fields(rec):
+            out.setdefault(base + (field,), []).append((v, unit))
+    return out
+
+
+def _direction(metric: str, unit: str, field: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (skipped —
+    the gate never guesses on a metric it cannot orient)."""
+    if field != "value":
+        return -1                  # extracted fields are latencies
+    u = (unit or "").lower()
+    if "ops/" in u or "/sec" in u or u.endswith("/s"):
+        return +1
+    if metric.endswith("_throughput") or metric.endswith("_clean_pct") \
+            or u in ("%", "pct"):
+        return +1
+    if u.startswith("us") or u.startswith("ms") or u.startswith("s ") \
+            or u in ("s", "seconds"):
+        return -1
+    return 0
+
+
+def cmd_compare(args) -> int:
+    """Diff two banked result sets with per-metric noise-aware
+    thresholds; non-zero exit on any regression.  The allowed
+    degradation per axis is max(--threshold-pct, --noise-mult x the
+    baseline's coefficient of variation) — a metric that is noisy
+    ACROSS BANKED RUNS earns a proportionally wider band instead of
+    gating on its own jitter."""
+    base = _extract_series(_norm_records(args.baseline))
+    cand = _extract_series(_norm_records(args.candidate))
+    if not base:
+        print(f"compare: no records in baseline {args.baseline}",
+              file=sys.stderr)
+        return 2
+    if not cand:
+        print(f"compare: no records in candidate {args.candidate}",
+              file=sys.stderr)
+        return 2
+
+    rows, regressions, improved, compared = [], [], 0, 0
+    for key in sorted(set(base) & set(cand),
+                      key=lambda k: tuple(str(x) for x in k)):
+        metric, replicas, app, field = key
+        bvals = [v for v, _ in base[key]]
+        cvals = [v for v, _ in cand[key]]
+        unit = base[key][-1][1]
+        d = _direction(metric, unit, field)
+        if d == 0:
+            continue
+        b = statistics.fmean(bvals)
+        c = statistics.fmean(cvals)
+        if b <= 0:
+            continue
+        compared += 1
+        noise_cv = (statistics.pstdev(bvals) / b) \
+            if len(bvals) > 1 else 0.0
+        allowed = max(args.threshold_pct / 100.0,
+                      args.noise_mult * noise_cv)
+        delta = (c - b) / b
+        worse = delta if d < 0 else -delta
+        if worse > allowed:
+            verdict = "REGRESSED"
+            regressions.append(key)
+        elif worse < -allowed:
+            verdict = "improved"
+            improved += 1
+        else:
+            verdict = "ok"
+        rows.append((metric, replicas, app, field, b, c,
+                     delta * 100.0, allowed * 100.0, verdict))
+
+    missing = sorted(set(base) - set(cand))
+    width = max((len(f"{m} [{f}]") for m, _, _, f, *_ in rows),
+                default=20)
+    print(f"{'metric [axis]':<{width}}  {'repl':>4} {'baseline':>12} "
+          f"{'candidate':>12} {'delta%':>8} {'allow%':>7}  verdict")
+    for metric, replicas, app, field, b, c, dpct, apct, verdict \
+            in rows:
+        name = f"{metric} [{field}]"
+        print(f"{name:<{width}}  {replicas or '-':>4} {b:>12,.1f} "
+              f"{c:>12,.1f} {dpct:>+8.1f} {apct:>7.1f}  {verdict}"
+              + (f" ({app})" if app else ""))
+    if missing and args.strict_missing:
+        for key in missing:
+            print(f"MISSING in candidate: {key[0]} [{key[3]}]")
+    print(f"compare: {compared} axes compared, "
+          f"{len(regressions)} regressed, {improved} improved, "
+          f"{len(missing)} baseline-only"
+          + (" (strict)" if args.strict_missing else ""))
+    if regressions:
+        for metric, _r, _a, field in regressions:
+            print(f"  REGRESSION: {metric} [{field}]",
+                  file=sys.stderr)
+        return 1
+    if missing and args.strict_missing:
+        return 1
+    return 0
+
+
 # -- aggregation -----------------------------------------------------------
 
 def _load_runs() -> list[dict]:
@@ -557,7 +723,52 @@ def cmd_report(args) -> int:
             f"p50 {_fmt(last['value'])} µs across "
             f"{len(d.get('named_stages', []))} named stages (p50 sum / "
             f"e2e = {d.get('stage_sum_vs_e2e')}); heaviest: "
-            + ", ".join(f"{k} {_fmt(v)} µs" for v, k in tops))
+            + ", ".join(f"{k} {_fmt(v)} µs" for v, k in tops)
+            + (f"; device windows {d.get('device_windows_seen')}, "
+               f"recompile sentinel {d.get('dev_recompiles')}"
+               if d.get("device_plane") else ""))
+        # Critical-path attribution over the same banked stage table
+        # (the full per-op view is `python -m apus_tpu.obs.critpath`).
+        try:
+            from apus_tpu.obs.critpath import BUCKETS
+            shares: dict = {}
+            for name, sv in st.items():
+                b = BUCKETS.get(name)
+                if b and name not in ("wire_in", "wire_out") and sv:
+                    shares[b] = shares.get(b, 0.0) + (sv.get("p50")
+                                                      or 0.0)
+            tot = sum(shares.values())
+            if tot:
+                host = shares.get("host_cpu", 0.0) / tot
+                rtt = (shares.get("replication", 0.0)
+                       + shares.get("device", 0.0)) / tot
+                verdict = ("host-CPU-bound" if host >= 0.5 else
+                           "roundtrip-bound" if rtt >= 0.5 else
+                           "mixed")
+                parts = ", ".join(
+                    f"{b} {v / tot:.0%}"
+                    for b, v in sorted(shares.items(),
+                                       key=lambda kv: -kv[1]))
+                lines.append(
+                    f"- critical-path attribution (p50 shares of the "
+                    f"server chain): {parts} -> {verdict}")
+        except Exception:                         # noqa: BLE001
+            pass
+    pg_path = os.path.join(RESULTS, "perfgate_last.json")
+    if os.path.exists(pg_path):
+        try:
+            with open(pg_path) as f:
+                pg = json.load(f)
+            checks = ", ".join(
+                f"{name} {_fmt(rec.get('measured'))} vs budget "
+                f"{_fmt(rec.get('budget'))} {rec.get('unit', '')}"
+                f" [{'PASS' if rec.get('ok') else 'FAIL'}]"
+                for name, rec in sorted(pg.get("checks", {}).items()))
+            lines.append(
+                f"- perf gate (scripts/perfgate.sh, last run "
+                f"{'PASS' if pg.get('ok') else 'FAIL'}): {checks}")
+        except (OSError, ValueError):
+            pass
     lad = [r for r in runs if r.get("metric") == "rejoin_ladder"
            and isinstance(r.get("value"), (int, float))]
     if lad:
@@ -764,11 +975,30 @@ def main() -> int:
     for p in (p_rep, p_all):
         p.add_argument("--plot", action="store_true",
                        help="write PNG plots (needs matplotlib)")
+    p_cmp = sub.add_parser(
+        "compare",
+        help="perf-regression gate: diff two banked result sets "
+             "(runs.jsonl / BENCH_rXX.json / record lists) with "
+             "noise-aware thresholds; exit 1 on regression")
+    p_cmp.add_argument("baseline", help="baseline result file")
+    p_cmp.add_argument("candidate", help="candidate result file")
+    p_cmp.add_argument("--threshold-pct", type=float, default=20.0,
+                       help="relative degradation allowed per axis "
+                            "(default 20)")
+    p_cmp.add_argument("--noise-mult", type=float, default=3.0,
+                       help="widen the band to this many baseline "
+                            "coefficient-of-variations when the "
+                            "baseline has repeated runs (default 3)")
+    p_cmp.add_argument("--strict-missing", action="store_true",
+                       help="also fail when a baseline metric is "
+                            "absent from the candidate")
     args = ap.parse_args()
     if args.cmd == "run":
         return cmd_run(args)
     if args.cmd == "report":
         return cmd_report(args)
+    if args.cmd == "compare":
+        return cmd_compare(args)
     rc = cmd_run(args)
     return rc or cmd_report(args)
 

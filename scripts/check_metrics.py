@@ -11,12 +11,17 @@ Contract it enforces, against drift:
    "Observability plane" section (as a backticked literal);
 3. reachability via OP_METRICS is enforced by construction (ObsHub
    pre-registers the whole catalog) and pinned by
-   tests/test_obs.py::test_op_metrics_scrape_roundtrip.
+   tests/test_obs.py::test_op_metrics_scrape_roundtrip;
+4. every flight-recorder event CATEGORY noted in the runtime (the
+   ``_note("...")`` / ``flight.note("...")`` literal spellings) must
+   be cataloged in ``catalog.FLIGHT_CATEGORIES`` and documented in
+   DESIGN.md — a new black-box event class cannot ship unnamed.
 
-Out of scope by design: plain-dict runner stats (DeviceCommitRunner /
-MeshCommitRunner / client-side ``stale_replies``) — those are
-OP_STATUS-only internals, not registry metrics; migrating one means
-switching it to the ``.bump`` spelling, which this lint then tracks.
+DeviceCommitRunner's stats migrated to the registry (ISSUE 8): its
+``self.stats.bump`` sites resolve to the ``dev_*`` namespace, while
+``node.bump`` sites in the same file stay ``node_*``.  Still out of
+scope: MeshCommitRunner's plain dict and client-side
+``stale_replies`` (OP_STATUS-only internals).
 
 Exit 0 clean; exit 1 with the drift list otherwise.
 """
@@ -37,7 +42,9 @@ NAMESPACE_OF = {
     "apus_tpu/core/node.py": "node",
     "apus_tpu/parallel/onesided.py": "node",
     "apus_tpu/runtime/bridge.py": "node",
-    "apus_tpu/runtime/device_plane.py": "node",
+    # device_plane.py is mixed: node.bump -> node_*, the runner's
+    # self.stats.bump -> dev_* (resolved per call below).
+    "apus_tpu/runtime/device_plane.py": None,
     "apus_tpu/runtime/mesh_plane.py": "node",
     "apus_tpu/parallel/net.py": None,     # mixed: resolved per call
     "apus_tpu/parallel/faults.py": "fault",
@@ -91,8 +98,40 @@ def collect_bumps() -> list[tuple[str, str, str]]:
                     ns_here = "net"
                 out.append((rel, ns_here, name))
             continue
+        if rel == "apus_tpu/runtime/device_plane.py":
+            for m in _RECV.finditer(src):
+                owner = m.group(1)
+                ns_here = "node" if owner.startswith("node") else "dev"
+                out.append((rel, ns_here, m.group(2)))
+            continue
         for m in _RECV.finditer(src):
             out.append((rel, ns, m.group(2)))
+    return out
+
+
+#: files scanned for flight-recorder note literals (the runtime; tests
+#: and the obs plumbing itself excluded).
+_FLIGHT_SCAN_DIRS = ("apus_tpu",)
+_FLIGHT_SKIP = ("apus_tpu/obs/flight.py",)
+_NOTE = re.compile(r'(?:\b_note|flight\.note|\bnote)\(\s*(?:flight\s*,\s*)?"([a-z_]+)"')
+
+
+def collect_flight_categories() -> list[tuple[str, str]]:
+    """[(file, category)] for every flight-note literal in the
+    runtime."""
+    out = []
+    for d in _FLIGHT_SCAN_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(REPO, d)):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                rel = os.path.relpath(path, REPO)
+                if rel in _FLIGHT_SKIP:
+                    continue
+                src = open(path).read()
+                for m in _NOTE.finditer(src):
+                    out.append((rel, m.group(1)))
     return out
 
 
@@ -111,12 +150,26 @@ def main() -> int:
                 f"in apus_tpu/obs/catalog.py (add it there AND to "
                 f"DESIGN.md's Observability plane table)")
 
+    # Flight-recorder event categories: every noted literal cataloged.
+    flights = collect_flight_categories()
+    for rel, cat in flights:
+        if cat not in catalog.FLIGHT_CATEGORIES:
+            errors.append(
+                f"{rel}: flight event category {cat!r} is noted but "
+                f"not cataloged in catalog.FLIGHT_CATEGORIES (add it "
+                f"there AND to DESIGN.md)")
+
     design = open(os.path.join(REPO, "DESIGN.md")).read()
     documented = set(re.findall(r"`([a-z0-9_]+)`", design))
     for full in sorted(catalog.CATALOG):
         if full not in documented:
             errors.append(
                 f"catalog metric {full!r} is not documented in "
+                f"DESIGN.md (backticked literal required)")
+    for cat in sorted(catalog.FLIGHT_CATEGORIES):
+        if cat not in documented:
+            errors.append(
+                f"flight category {cat!r} is not documented in "
                 f"DESIGN.md (backticked literal required)")
 
     if errors:
@@ -126,7 +179,10 @@ def main() -> int:
             print(f"  - {e}", file=sys.stderr)
         return 1
     print(f"check_metrics: OK ({len(bumps)} bump sites, "
-          f"{len(catalog.CATALOG)} cataloged metrics, all documented)")
+          f"{len(catalog.CATALOG)} cataloged metrics, "
+          f"{len(flights)} flight-note sites over "
+          f"{len(catalog.FLIGHT_CATEGORIES)} categories, "
+          f"all documented)")
     return 0
 
 

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Continuous perf-regression gate (scripts/perfgate.sh drives this).
+
+Two budgets, chosen because they bracket the hot path from both ends
+and measure in seconds, not minutes, so the gate can ride tier-1:
+
+- ``depth1_window_wall_p50_us`` — one depth-1 window through the
+  windowed commit engine (compile excluded, small geometry so the
+  compile itself stays cheap).  This is the un-amortized device-plane
+  latency unit every live client op rides; the PR 1 headline at gate
+  scale.
+- ``unsampled_obs_check_ns`` — the per-op cost of the span plane's
+  UNSAMPLED fast path (the only obs code 63/64 of ops ever touch).
+  The obs plane's "always-on must be ~free" contract as a number.
+- ``hist_observe_ns`` — one log2-histogram observe (the per-sample
+  cost of every always-on distribution).
+
+Workflow:
+    python scripts/perfgate.py --rebase   # bank scripts/perfgate_baseline.json
+    python scripts/perfgate.py            # measure, gate, exit 1 on breach
+
+The baseline stores best-of-N medians plus a generous budget factor
+per check (1-core CI boxes jitter; the gate exists to catch 2x-class
+regressions — an accidental sync in the dispatch path, an obs fast
+path that grew an allocation — not 5% noise).  Every run writes
+``eval/results/perfgate_last.json`` for ``eval.py report``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE = os.path.join(REPO, "scripts", "perfgate_baseline.json")
+LAST = os.path.join(REPO, "eval", "results", "perfgate_last.json")
+
+#: budget factor per check: measured-at-bank-time * factor = budget.
+FACTORS = {
+    "depth1_window_wall_p50_us": 2.0,
+    "unsampled_obs_check_ns": 3.0,
+    "hist_observe_ns": 3.0,
+}
+UNITS = {
+    "depth1_window_wall_p50_us": "us",
+    "unsampled_obs_check_ns": "ns",
+    "hist_observe_ns": "ns",
+}
+
+
+def _measure_depth1_window(repeats: int = 3, iters: int = 40) -> float:
+    """Depth-1 window wall p50 through the windowed commit engine at a
+    gate-sized geometry (best-of-``repeats`` medians over ``iters``
+    dispatches each — best-of absorbs scheduler noise the way the
+    overhead guard does)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.ops.commit import (CommitControl,
+                                     build_windowed_commit_step)
+    from apus_tpu.ops.logplane import make_device_log
+    from apus_tpu.ops.mesh import (REPLICA_AXIS, replica_mesh,
+                                   replica_sharding)
+
+    R, S, SB, B, MD = 3, 512, 512, 32, 4
+    mesh = replica_mesh(R, devices=jax.devices()[:1])
+    sh = replica_sharding(mesh)
+    step = build_windowed_commit_step(mesh, R, S, SB, B, max_depth=MD)
+    devlog = make_device_log(R, S, SB, batch=B, leader=0, term=1,
+                             sharding=sh)
+    ctrl = CommitControl.from_cid(Cid.initial(R), R, 0, 1, 1)
+    ssh = NamedSharding(mesh, P(None, REPLICA_AXIS))
+    sdata = jax.device_put(np.zeros((MD, R, B, SB), np.uint8), ssh)
+    smeta = jax.device_put(np.zeros((MD, R, B, 4), np.int32), ssh)
+    end0 = 1
+    for _ in range(3):                 # compile + chained warm
+        devlog, commits, rounds_run, ctrl = step(devlog, sdata, smeta,
+                                                 ctrl, MD, 1)
+        end0 += MD * B
+    best = float("inf")
+    for _ in range(repeats):
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            devlog, commits, rounds_run, ctrl = step(
+                devlog, sdata, smeta, ctrl, 1, 1)
+            int(commits[0])            # the client-release readback
+            walls.append((time.perf_counter_ns() - t0) / 1e3)
+            end0 += B
+        best = min(best, statistics.median(walls))
+    return round(best, 2)
+
+
+def _measure_obs_fast_path(n: int = 300_000) -> tuple[float, float]:
+    """(unsampled check ns/op, histogram observe ns/sample), each the
+    best of 3 passes."""
+    from apus_tpu.obs.metrics import Histogram
+    from apus_tpu.obs.spans import SpanRecorder
+
+    sp = SpanRecorder(sample_period=64)
+    sampled = sp.sampled
+    best_chk = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for rid in range(1, n + 1):
+            if sampled(rid):
+                pass
+        best_chk = min(best_chk, (time.perf_counter() - t0) / n * 1e9)
+
+    h = Histogram("g")
+    observe = h.observe
+    best_obs = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for v in range(1, n + 1):
+            observe(v)
+        best_obs = min(best_obs, (time.perf_counter() - t0) / n * 1e9)
+    return round(best_chk, 1), round(best_obs, 1)
+
+
+def measure(fast: bool = False) -> dict:
+    chk, obs = _measure_obs_fast_path()
+    out = {"unsampled_obs_check_ns": chk, "hist_observe_ns": obs}
+    if not fast:
+        out["depth1_window_wall_p50_us"] = _measure_depth1_window()
+    return out
+
+
+def evaluate(baseline: dict, measured: dict) -> dict:
+    """Gate verdict: {"ok", "checks": {name: {measured, baseline,
+    budget, unit, ok}}} — pure so the test suite can drive it without
+    paying a compile."""
+    checks = {}
+    ok = True
+    budgets = baseline.get("budget", {})
+    banked = baseline.get("measured", {})
+    for name, m in measured.items():
+        budget = budgets.get(name)
+        if budget is None:
+            continue
+        passed = m <= budget
+        ok = ok and passed
+        checks[name] = {"measured": m, "baseline": banked.get(name),
+                        "budget": budget, "unit": UNITS.get(name, ""),
+                        "ok": passed}
+    return {"ok": ok, "checks": checks}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="scripts/perfgate.py")
+    ap.add_argument("--rebase", action="store_true",
+                    help="re-measure and bank the baseline + budgets")
+    ap.add_argument("--fast", action="store_true",
+                    help="obs fast-path checks only (no jax compile) "
+                         "— the tier-1 smoke shape")
+    args = ap.parse_args(argv)
+
+    measured = measure(fast=args.fast)
+    if args.rebase:
+        baseline = {
+            "banked_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "measured": measured,
+            "budget": {k: round(v * FACTORS[k], 1)
+                       for k, v in measured.items()},
+            "note": ("budget = measured * factor "
+                     f"({FACTORS}); generous on purpose — this gate "
+                     "catches 2x-class regressions on a noisy 1-core "
+                     "box, eval.py compare owns the fine-grained "
+                     "diffs"),
+        }
+        with open(BASELINE, "w") as f:
+            json.dump(baseline, f, indent=2)
+        print(f"perfgate: baseline banked to "
+              f"{os.path.relpath(BASELINE, REPO)}: {measured}")
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"perfgate: no baseline ({BASELINE}); run with --rebase "
+              f"first", file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        baseline = json.load(f)
+    verdict = evaluate(baseline, measured)
+    os.makedirs(os.path.dirname(LAST), exist_ok=True)
+    with open(LAST, "w") as f:
+        json.dump(verdict, f, indent=2)
+    for name, rec in sorted(verdict["checks"].items()):
+        print(f"perfgate: {name}: {rec['measured']} {rec['unit']} "
+              f"(baseline {rec['baseline']}, budget {rec['budget']}) "
+              f"{'PASS' if rec['ok'] else 'FAIL'}")
+    if not verdict["ok"]:
+        print("perfgate: FAIL — hot-path budget exceeded "
+              "(re-bank with --rebase ONLY if the regression is "
+              "understood and accepted)", file=sys.stderr)
+        return 1
+    print("perfgate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Continuous perf-regression gate: depth-1 window wall p50 + the
+# unsampled-obs-path budgets vs the banked baseline
+# (scripts/perfgate_baseline.json).  Exit non-zero on breach.
+#
+# Usage: scripts/perfgate.sh [--rebase] [--fast]
+#   --rebase  re-measure and bank the baseline + budgets
+#   --fast    obs fast-path checks only (no jax compile; the tier-1
+#             smoke shape)
+#
+# Every gate run also writes eval/results/perfgate_last.json, which
+# `python eval/eval.py report` surfaces as the perf-gate headline.
+set -u
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python scripts/perfgate.py "$@"

@@ -35,6 +35,13 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 if [ "$smoke" -eq 1 ]; then
+    echo "== perf-regression gate (scripts/perfgate.sh) =="
+    scripts/perfgate.sh
+    prc=$?
+    if [ "$prc" -ne 0 ]; then
+        echo "perfgate FAILED (rc=$prc)" >&2
+        exit "$prc"
+    fi
     echo "== observability-plane smoke (-m obs slice) =="
     env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q \
         -m obs -p no:cacheprovider

@@ -1,0 +1,434 @@
+"""Device-plane telemetry, critical-path attribution, and the
+perf-regression gate (ISSUE 8).
+
+Covers: the recompile sentinel (zero across fresh leaderships' live
+windows — the PR 3 warmup-fix pin — and firing on a planted cache
+bust), the runner's stats migration onto the metrics registry
+(dispatch/occupancy histograms, staging-wait, max-dispatch gauge),
+cause-tagged ownership-flip flight events, the scrape's derived health
+verdict, device-event interleaving in the stitched timeline, the
+critpath attribution table, `eval.py compare`'s regression gate, and
+the perfgate's pure verdict math.
+
+The runner-backed tests share ONE module-scoped DeviceCommitRunner
+(each build compiles the whole engine family); their order inside this
+file is load-bearing — clean-path assertions run before the planted
+cache bust dirties the sentinel.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import os
+import threading
+import time
+import types
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+B = 8
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+    return DeviceCommitRunner(n_replicas=3, n_slots=256,
+                              slot_bytes=256, batch=B)
+
+
+def _window(e0: int, n: int, term: int = 1):
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    return [LogEntry(idx=e0 + j, term=term, type=EntryType.CSM,
+                     req_id=j + 1, clt_id=1, data=b"d%d" % (e0 + j))
+            for j in range(n * B)]
+
+
+# -- recompile sentinel (the PR 3 warmup fix, pinned) ------------------------
+
+def test_recompile_sentinel_zero_across_fresh_leaderships(runner):
+    """The old flake, now a deterministic guard: a fresh leadership's
+    SECOND live window (and every other dispatch shape — single round,
+    shallow window, deep async — across TWO leaderships) must compile
+    NOTHING post-warmup.  The sentinel watches jax's backend-compile
+    event stream, so a mid-leadership XLA compile cannot hide behind
+    the stall watchdog's grace again."""
+    from apus_tpu.core.cid import Cid
+    cid = Cid.initial(3)
+    live = {0, 1, 2}
+    assert runner.check_recompiles() == []
+    gen = runner.reset(leader=0, term=1, first_idx=1)
+    e0 = 1
+    for _ in range(2):              # first leadership: two live windows
+        commit, rr = runner.commit_window(gen, e0, _window(e0, 2),
+                                          cid, live)
+        assert rr == 2 and commit == e0 + 2 * B
+        e0 += 2 * B
+        assert runner.check_recompiles() == []
+    gen = runner.reset(leader=1, term=2, first_idx=e0)
+    # Second leadership: window, single round, deep async, shallow
+    # async — every live dispatch signature.
+    commit, rr = runner.commit_window(gen, e0, _window(e0, 1, term=2),
+                                      cid, live)
+    assert rr == 1
+    e0 += B
+    acks, commit = runner.commit_round(gen, e0, _window(e0, 1, term=2),
+                                       cid, live)
+    assert commit == e0 + B
+    e0 += B
+    h = runner.commit_rounds_async(gen, e0,
+                                   _window(e0, runner.DEEP_DEPTH,
+                                           term=2), cid, live)
+    assert runner.resolve_rounds(h) == e0 + runner.DEEP_DEPTH * B
+    e0 += runner.DEEP_DEPTH * B
+    h = runner.commit_rounds_async(gen, e0, _window(e0, 2, term=2),
+                                   cid, live)
+    assert runner.resolve_rounds(h) == e0 + 2 * B
+    assert runner.check_recompiles() == []
+    assert runner.stats["recompiles"] == 0
+
+
+def test_runner_metrics_on_shared_registry(runner):
+    """Satellite: the ad-hoc stats dict now rides the registry —
+    dict-compat reads intact, dispatch/occupancy distributions and the
+    float max-dispatch gauge scrapeable."""
+    assert runner.stats["rounds"] > 0              # dict-compat read
+    assert runner.stats.get("entries_devplane") > 0
+    snap = runner.metrics.snapshot()
+    assert snap["dev_rounds"]["value"] == runner.stats["rounds"]
+    for name in ("dev_window_depth", "dev_window_rounds_run",
+                 "dev_dispatch_wait_us", "dev_window_wall_us",
+                 "dev_staging_wait_us"):
+        assert snap[name]["type"] == "histogram"
+        assert snap[name]["count"] >= 1, name
+    # max_dispatch_ms is a FLOAT gauge behind the legacy view key (the
+    # stall watchdog reads it through stats.get).
+    assert isinstance(runner.stats.get("max_dispatch_ms"), float)
+    assert snap["dev_max_dispatch_ms"]["type"] == "gauge"
+    # Requested depths landed in the occupancy histogram (depth 2 ->
+    # log2 bucket 2, depth 16 -> bucket 5).
+    assert snap["dev_window_depth"]["count"] >= 5
+
+
+def test_recompile_sentinel_fires_on_planted_cache_bust(runner):
+    """A novel shape through a live executable IS a post-warmup
+    compile: the sentinel must fire once, attribute it, count it, and
+    go quiet again.  (Runs LAST of the runner tests — it dirties the
+    sentinel on purpose.)"""
+    import numpy as np
+    grown = runner.check_recompiles()
+    assert grown == [], grown
+    runner._gather(runner._devlog.data, runner._devlog.meta,
+                   np.int32(0), np.zeros(3, np.int32))
+    grown = runner.check_recompiles()
+    assert grown and grown[0][0] == "gather", grown
+    assert runner.stats["recompiles"] >= 1
+    assert runner.check_recompiles() == []         # reported once
+
+
+def test_sentinel_unaffected_by_other_runner_builds(runner):
+    """A SECOND runner building in the same process accounts its own
+    compiles — the live runner's sentinel must not false-alarm (the
+    in-process cluster / test-suite shape)."""
+    from apus_tpu.runtime.device_plane import DeviceCommitRunner
+    before = runner.stats["recompiles"]
+    DeviceCommitRunner(n_replicas=3, n_slots=128, slot_bytes=128,
+                       batch=4)
+    assert runner.check_recompiles() == []
+    assert runner.stats["recompiles"] == before
+
+
+# -- ownership-flip flight events (cause-tagged) -----------------------------
+
+class _FakeLog:
+    commit = 5
+    end = 9
+
+    def __bool__(self):
+        return True
+
+
+class _FakeNode:
+    def __init__(self, hub):
+        self.external_commit = False
+        self.is_leader = True
+        self.obs = hub
+        self.stats = hub.registry.view("node")
+        self.log = _FakeLog()
+
+    def bump(self, name, n=1):
+        self.stats.bump(name, n)
+
+    def _note(self, category, msg="", **fields):
+        self.obs.flight.note(category, msg, **fields)
+
+
+def _fake_driver(runner):
+    from apus_tpu.obs import ObsHub
+    from apus_tpu.runtime.device_plane import DevicePlaneDriver
+    hub = ObsHub("rT")
+    daemon = types.SimpleNamespace(
+        lock=threading.RLock(), logger=logging.getLogger("t-devd"),
+        spec=types.SimpleNamespace(hb_timeout=0.03, hb_period=0.005),
+        obs=hub, idx=0, on_tick=[], _tick_interval=0.0005)
+    daemon.node = _FakeNode(hub)
+    return DevicePlaneDriver(daemon, runner), daemon.node, hub
+
+
+def test_ownership_flips_are_cause_tagged_flight_events(runner):
+    drv, node, hub = _fake_driver(runner)
+    drv._set_owned(node, True, "cursor_catchup")
+    drv._set_owned(node, True, "cursor_catchup")   # no-op, no dup
+    drv._set_owned(node, False, "quorum_fail_streak")
+    evs = [e for e in hub.flight.events() if e["cat"] == "devplane"]
+    assert [(e["msg"], e["cause"]) for e in evs] == \
+        [("own", "cursor_catchup"), ("release", "quorum_fail_streak")]
+    assert node.stats["devplane_own_flips"] == 2
+    assert node.external_commit is False
+
+
+def test_stall_watchdog_release_is_attributed(runner):
+    drv, node, hub = _fake_driver(runner)
+    node.external_commit = True
+    drv._last_commit_advance = time.monotonic() - 60.0
+    drv._tick_watchdog()
+    assert node.external_commit is False
+    evs = [e for e in hub.flight.events() if e["cat"] == "devplane"]
+    assert evs and evs[-1]["msg"] == "release" \
+        and evs[-1]["cause"] == "stall_watchdog"
+    assert any(e["cat"] == "watchdog"
+               and e.get("msg") == "devplane_stall_fallback"
+               for e in hub.flight.events())
+
+
+# -- health verdict in the scrape --------------------------------------------
+
+def test_health_verdict_in_scrape():
+    from apus_tpu.obs.service import fetch_metrics
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3) as c:
+        lead = c.wait_for_leader()
+        peers = list(c.spec.peers)
+        with ApusClient(peers) as cl:
+            for i in range(20):
+                assert cl.put(b"h%d" % i, b"v") == b"OK"
+        rec = fetch_metrics(peers[lead.idx])
+        h = rec["health"]
+        assert h["verdict"] == "ok" and h["flags"] == []
+        assert h["recompiles"] == 0
+        assert h["leader_flaps"] >= 1          # the election that won
+        # Forced degradation surfaces as a flag, not a buried counter.
+        c.daemons[lead.idx].persist_disabled = True
+        rec = fetch_metrics(peers[lead.idx])
+        assert rec["health"]["verdict"] == "degraded"
+        assert "persist_disabled" in rec["health"]["flags"]
+
+
+# -- timeline: device window events interleaved (satellite) ------------------
+
+def _synth_dump():
+    return {
+        "ident": "r0", "replica": 0,
+        "anchor": {"wall_us": 1_000_000, "mono_us": 0},
+        "flight": [{"t_us": 5, "cat": "role", "msg": "LEADER",
+                    "term": 1}],
+        "spans": [
+            {"t_us": 10, "clt": 1, "req": 64, "stage": "ingest"},
+            {"t_us": 20, "clt": 1, "req": 64, "stage": "lock"},
+            {"t_us": 25, "clt": 1, "req": 64, "stage": "admit",
+             "idx": 5, "term": 1},
+            {"t_us": 40, "clt": 1, "req": 64, "stage": "append",
+             "idx": 5},
+            {"t_us": 50, "clt": 1, "req": 64, "stage": "repl",
+             "idx": 5},
+            {"t_us": 55, "clt": 0, "req": 0, "stage": "dev_dispatch",
+             "idx": 1, "hi": 65},
+            {"t_us": 90, "clt": 0, "req": 0, "stage": "dev_ready",
+             "idx": 1, "hi": 65},
+            {"t_us": 95, "clt": 1, "req": 64, "stage": "quorum",
+             "idx": 5},
+            {"t_us": 100, "clt": 1, "req": 64, "stage": "apply",
+             "idx": 5},
+            {"t_us": 110, "clt": 1, "req": 64, "stage": "reply",
+             "idx": 5},
+        ],
+    }
+
+
+def test_timeline_interleaves_device_window_events():
+    from apus_tpu.obs.timeline import merge_dumps, render, stitch_ops
+
+    merged = merge_dumps([_synth_dump()])
+    kinds = {e.get("stage"): e["kind"] for e in merged
+             if e.get("kind") != "flight"}
+    assert kinds["dev_dispatch"] == "dev" and kinds["dev_ready"] == "dev"
+    assert kinds["ingest"] == "span"
+    # Stitched per-op chain carries the covering window's hops, in
+    # wall order between repl and quorum.
+    ops = stitch_ops(merged)
+    chain = [e["stage"] for e in ops[(1, 64)]["stamps"]]
+    assert chain.index("repl") < chain.index("dev_dispatch") \
+        < chain.index("dev_ready") < chain.index("quorum")
+    # An op OUTSIDE the window range gets nothing attached.
+    d2 = _synth_dump()
+    for ev in d2["spans"]:
+        if ev["req"]:
+            ev["req"] = 128
+            if ev.get("idx") is not None:
+                ev["idx"] = 200            # past hi=65
+    ops2 = stitch_ops(merge_dumps([d2]))
+    assert "dev_dispatch" not in [e["stage"]
+                                  for e in ops2[(1, 128)]["stamps"]]
+    # Rendered timeline shows the dev rows with their idx range.
+    text = render(merged)
+    assert "dev_dispatch" in text and "idx=[1,65)" in text
+
+
+# -- critpath attribution ----------------------------------------------------
+
+def test_critpath_attribution_table(tmp_path):
+    from apus_tpu.obs import critpath
+
+    rep = critpath.attribute([_synth_dump()])
+    assert rep["ops"] == 1
+    st = rep["stages"]
+    # Exact durations from the synthetic stamps.
+    assert st["lock_wait"]["p50"] == 10.0
+    assert st["dev_dispatch_wait"]["p50"] == 5.0   # repl 50 -> dispatch 55
+    assert st["dev_execute"]["p50"] == 35.0        # 55 -> 90
+    assert st["quorum_ack"]["p50"] == 5.0          # dev_ready 90 -> 95
+    # Dominance: dev_execute (35) dominates this op.
+    assert rep["dominant"] == {"dev_execute": 1}
+    assert rep["buckets"]["device"]["share"] > 0.3
+    assert "bound" in rep["verdict"] or "mixed" in rep["verdict"]
+    # CLI roundtrip over a dump file.
+    p = tmp_path / "d.json"
+    p.write_text(json.dumps(_synth_dump()))
+    assert critpath.main([str(p)]) == 0
+    assert critpath.main([str(p), "--json"]) == 0
+    table = critpath.render_table(rep)
+    assert "dev_execute" in table and "verdict:" in table
+
+
+# -- eval.py compare (perf-regression gate) ----------------------------------
+
+def _load_eval():
+    spec = importlib.util.spec_from_file_location(
+        "apus_eval_cmp", os.path.join(REPO, "eval", "eval.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cmp_args(base, cand, **kw):
+    import argparse
+    d = {"baseline": str(base), "candidate": str(cand),
+         "threshold_pct": 20.0, "noise_mult": 3.0,
+         "strict_missing": False}
+    d.update(kw)
+    return argparse.Namespace(**d)
+
+
+def test_eval_compare_gate(tmp_path):
+    ev = _load_eval()
+
+    def bank(path, value, stage_p50, tput):
+        recs = [
+            {"metric": "pipelined_put_stage_breakdown", "value": value,
+             "unit": "us (client e2e p50)", "replicas": 3,
+             "detail": {"stages_us": {"quorum_ack":
+                                      {"p50": stage_p50}}}},
+            {"metric": "x_throughput", "value": tput, "unit": "ops/s",
+             "replicas": 3, "detail": {}},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    base = tmp_path / "base.jsonl"
+    bank(base, 1000.0, 400.0, 8000.0)
+    same = tmp_path / "same.jsonl"
+    bank(same, 1000.0, 400.0, 8000.0)
+    # Identical runs pass.
+    assert ev.cmd_compare(_cmp_args(base, same)) == 0
+    # A planted >=20% latency regression (and a throughput DROP) exit
+    # non-zero; a per-stage regression trips even if headline is ok.
+    bad = tmp_path / "bad.jsonl"
+    bank(bad, 1250.0, 400.0, 8000.0)
+    assert ev.cmd_compare(_cmp_args(base, bad)) == 1
+    stage_bad = tmp_path / "stage_bad.jsonl"
+    bank(stage_bad, 1000.0, 650.0, 8000.0)
+    assert ev.cmd_compare(_cmp_args(base, stage_bad)) == 1
+    tput_bad = tmp_path / "tput_bad.jsonl"
+    bank(tput_bad, 1000.0, 400.0, 5000.0)
+    assert ev.cmd_compare(_cmp_args(base, tput_bad)) == 1
+    # Improvements and within-threshold drift pass.
+    good = tmp_path / "good.jsonl"
+    bank(good, 900.0, 360.0, 9000.0)
+    assert ev.cmd_compare(_cmp_args(base, good)) == 0
+    drift = tmp_path / "drift.jsonl"
+    bank(drift, 1100.0, 430.0, 7500.0)
+    assert ev.cmd_compare(_cmp_args(base, drift)) == 0
+    # Noise-aware: a metric noisy across banked baseline runs earns a
+    # wider band than the flat threshold.
+    noisy_base = tmp_path / "noisy.jsonl"
+    with open(noisy_base, "w") as f:
+        for v in (1000.0, 1600.0, 700.0):
+            f.write(json.dumps(
+                {"metric": "m", "value": v, "unit": "us",
+                 "replicas": 3, "detail": {}}) + "\n")
+    cand = tmp_path / "cand.jsonl"
+    with open(cand, "w") as f:
+        f.write(json.dumps(
+            {"metric": "m", "value": 1500.0, "unit": "us",
+             "replicas": 3, "detail": {}}) + "\n")
+    # +36% vs mean, but baseline cv ~0.33 -> allowed ~100%: passes.
+    assert ev.cmd_compare(_cmp_args(noisy_base, cand)) == 0
+    # strict-missing: baseline metric absent from candidate fails.
+    only_one = tmp_path / "one.jsonl"
+    with open(only_one, "w") as f:
+        f.write(json.dumps(
+            {"metric": "x_throughput", "value": 8000.0,
+             "unit": "ops/s", "replicas": 3, "detail": {}}) + "\n")
+    assert ev.cmd_compare(_cmp_args(base, only_one)) == 0
+    assert ev.cmd_compare(
+        _cmp_args(base, only_one, strict_missing=True)) == 1
+    # BENCH_rXX.json envelopes compare too (self vs self passes).
+    bench = os.path.join(REPO, "BENCH_r07.json")
+    assert ev.cmd_compare(_cmp_args(bench, bench)) == 0
+
+
+# -- perfgate verdict math ---------------------------------------------------
+
+def test_perfgate_evaluate_pure():
+    spec = importlib.util.spec_from_file_location(
+        "apus_perfgate", os.path.join(REPO, "scripts", "perfgate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    baseline = {"measured": {"depth1_window_wall_p50_us": 300.0,
+                             "unsampled_obs_check_ns": 100.0},
+                "budget": {"depth1_window_wall_p50_us": 600.0,
+                           "unsampled_obs_check_ns": 300.0}}
+    ok = pg.evaluate(baseline, {"depth1_window_wall_p50_us": 450.0,
+                                "unsampled_obs_check_ns": 120.0})
+    assert ok["ok"] and all(c["ok"] for c in ok["checks"].values())
+    bad = pg.evaluate(baseline, {"depth1_window_wall_p50_us": 900.0,
+                                 "unsampled_obs_check_ns": 120.0})
+    assert not bad["ok"]
+    assert not bad["checks"]["depth1_window_wall_p50_us"]["ok"]
+    assert bad["checks"]["unsampled_obs_check_ns"]["ok"]
+    # The banked baseline file is well-formed and budgeted.
+    with open(os.path.join(REPO, "scripts",
+                           "perfgate_baseline.json")) as f:
+        banked = json.load(f)
+    assert set(banked["budget"]) == set(banked["measured"])
+    for k, v in banked["budget"].items():
+        assert v > banked["measured"][k]

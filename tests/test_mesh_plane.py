@@ -311,12 +311,22 @@ def test_mesh_plane_survives_sustained_traffic(tmp_path):
             while time.monotonic() < t_end:
                 assert c.put(b"st-%d" % n, b"v%d" % n) == b"OK"
                 n += 1
-            lead = pc.leader_idx(timeout=10.0)
             for i in range(3):
                 d = _devplane(pc, i)
                 assert not d.get("dead"), \
                     f"plane died under sustained traffic on {i}: " \
                     f"{d.get('death_reason')}"
+            # owns_commit is a point SAMPLE: under 1-core suite load
+            # the stall watchdog can have just handed commit to the
+            # host path (a by-design, bounded fallback — cause-tagged
+            # in the flight ring since ISSUE 8).  The invariant this
+            # test owns is that the live plane RE-ARMS and keeps
+            # owning commit under continued traffic, not that no
+            # fallback ever sampled — so pump until it owns again.
+            _pump_until(
+                pc, lambda: _devplane(pc, pc.leader_idx(timeout=5.0))
+                .get("owns_commit", False), c, timeout=60.0, tag=b"so")
+            lead = pc.leader_idx(timeout=10.0)
             dl = _devplane(pc, lead)
             assert dl.get("owns_commit"), dl
             assert c.get(b"st-%d" % (n - 1)) == b"v%d" % (n - 1)
