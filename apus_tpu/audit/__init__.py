@@ -14,4 +14,5 @@ argument into a checked property.
 """
 
 from apus_tpu.audit.history import HistoryRecorder  # noqa: F401
-from apus_tpu.audit.linear import AuditResult, check_history  # noqa: F401
+from apus_tpu.audit.linear import (AuditResult, check_history,  # noqa: F401
+                                   resolve_undecided)

@@ -48,6 +48,13 @@ class PendingRead:
     done: bool = False
     error: bool = False           # query raised: answered as an error
     reply: Optional[bytes] = None
+    #: Follower-lease read (Node.follower_read): served from a
+    #: follower's local applied state while its commit-index-bounded
+    #: lease is live; ``refused`` resolves the handle when the lease
+    #: lapses/invalidates — the client handler answers NOT_LEADER with
+    #: a hint and the client falls back to the leader.
+    flr: bool = False
+    refused: bool = False
 
 
 class EndpointDB:

@@ -107,6 +107,48 @@ class NodeConfig:
     # scheduling skew.
     read_lease: bool = True
     lease_margin: float = 0.2
+    # Follower read leases (the read scale-out half of the Hermes
+    # design point: writes invalidate, reads are local EVERYWHERE).
+    # The leader grants a follower a commit-index-bounded read lease in
+    # reply to the follower's own request (OP_FLR_LEASE, piggybacking
+    # the quorum-acked heartbeat machinery):
+    #
+    # - ANCHORING (the delayed-grant trap): the follower's validity
+    #   window starts at its own REQUEST-SEND stamp, never at grant
+    #   delivery — the leader's conservative window (anchored at its
+    #   RECEIPT of the request, which real-time-follows the send) then
+    #   always outlives the follower's, regardless of wire delay.  A
+    #   delivery-anchored lease would let a delayed grant outlive every
+    #   guard (the same trap the leader lease avoids by anchoring at
+    #   round START).
+    # - DURATION: the remaining leader-lease window (_lease_until -
+    #   now), so every follower window nests inside the leader's own
+    #   lease — and the UNCONDITIONAL vote-refusal lease guard
+    #   (should_grant lease_guard) that proves no election completes
+    #   inside the leader's lease therefore proves it for every
+    #   outstanding follower lease too.  That nesting IS the "elections
+    #   cannot complete inside any follower lease window" extension.
+    # - INVALIDATION (writes): while a granted window is live on the
+    #   leader's clock, commit does not advance past an index the
+    #   grantee has not acked (_advance_commit blocker rule) — the
+    #   Hermes write-invalidation, expressed on the log.  A paused or
+    #   partitioned lease holder therefore stalls commit for at most
+    #   one lease duration, after which it is cut out.
+    # - SERVING (follower side): a local read is served only while the
+    #   fresh-clock lease is live, at the SAME term and config epoch it
+    #   was granted, the config is STABLE, and applied state covers
+    #   max(grant's commit floor, the follower's log end at read
+    #   registration) — the floor covers everything committed before
+    #   the grant, the log-end gate covers everything whose commit
+    #   required our ack during the window.
+    follower_read_leases: bool = True
+    #: Deliberately-broken lease for the planted-stale-read harness
+    #: (set from APUS_FLR_PLANT by the daemon; NEVER in production):
+    #: "expiry" skips the fresh-clock expiry check, "epoch" skips the
+    #: config-epoch fence — each makes the audit plane's checker the
+    #: only thing standing between the bug and a stale read, which is
+    #: exactly what the harness proves it catches.
+    flr_plant: str = ""
 
 
 @dataclasses.dataclass
@@ -324,9 +366,11 @@ class Node:
         # the node lock yielded — precisely while an isolated leader's
         # ctrl writes time out — and a stale (smaller) clock makes
         # ``now < _lease_until`` pass MORE easily, not less.  Live
-        # deployments install a real monotonic clock here
-        # (ReplicaDaemon sets time.monotonic); the deterministic sim
-        # leaves it None and the single-threaded tick clock is exact.
+        # deployments install the daemon's per-process clock here
+        # (ReplicaDaemon sets its SkewClock — real monotonic unless the
+        # adversarial-time nemesis skews it; utils/clock.py); the
+        # deterministic sim leaves it None and the single-threaded tick
+        # clock is exact.
         self.clock: Optional[Callable[[], float]] = None
         # Leader read lease (NodeConfig.read_lease): valid while
         # fresh-now < _lease_until.  Renewed by quorum-acked heartbeat
@@ -335,8 +379,48 @@ class Node:
         # Monotone count of completed linearizable reads (lease or
         # verified) — the daemon's wake predicate keys off it so a
         # served read always wakes its waiting handler even when
-        # apply/role are otherwise unchanged that tick.
+        # apply/role are otherwise unchanged that tick.  Follower-lease
+        # reads AND their refusals bump it too (both resolve a parked
+        # handler).
         self.reads_done = 0
+        # -- follower read leases (NodeConfig.follower_read_leases) ----
+        # Leader side: peer -> conservative expiry of the lease WE
+        # granted it, on OUR fresh clock (receipt-anchored + margin, so
+        # it real-time-outlives the grantee's own window under
+        # margin-bounded rate drift).  While live, _advance_commit
+        # requires the grantee's ack (write invalidation).  Pruned by
+        # time only — membership changes must keep blocking until
+        # expiry or a not-yet-aware removed holder could serve stale.
+        self._fgrants: dict[int, float] = {}
+        # peer -> fresh-clock stamp of the last commit advance its
+        # missing ack held back.  Liveness guard: a holder that blocks
+        # commit is refused RENEWAL until it catches up, so a peer
+        # whose inbound link died (asymmetric partition: our entries
+        # dropped, its requests arriving) stalls writes for at most ONE
+        # lease window instead of renewing itself into a permanent
+        # write outage.
+        self._flr_blocked_at: dict[int, float] = {}
+        # Follower side: the currently-held lease tuple.  All adopted
+        # atomically from one grant; validity is _flease_ok.
+        self._flease_until = -1.0
+        self._flease_term = -1
+        self._flease_epoch = -1
+        self._flease_floor = 0
+        self._flease_dur = 0.0
+        # Reads parked on the lease (serve once applied covers them).
+        self._flr_pending: list[PendingRead] = []
+        # Lease-keeping is LAZY: requested only while follower reads
+        # are actually flowing (hot window), so idle clusters and
+        # leader-only workloads pay nothing.
+        self._flr_hot_until = -1.0
+        self._flr_next_req = 0.0
+        self._flr_req_inflight = False
+        self._flr_noted = False       # flight-recorder grant/lapse edge
+        #: Wire hook installed by the runtime (runtime.flr): callable
+        #: (leader_idx) -> grant dict or None, one bounded roundtrip
+        #: with the node lock yielded on the wire.  None on the
+        #: deterministic sim — follower leases then never engage.
+        self.lease_requester = None
 
         # stats (observability, §5.5): a dict-compatible view over a
         # metrics registry (apus_tpu.obs.metrics) — private by default;
@@ -490,6 +574,307 @@ class Node:
         real monotonic clock when installed, else the last tick stamp
         (deterministic sim, where the tick clock is exact)."""
         return self._now if self.clock is None else self.clock()
+
+    # -- follower read leases (NodeConfig.follower_read_leases) --------
+
+    def _flr_enabled(self) -> bool:
+        return self.cfg.read_lease and self.cfg.follower_read_leases
+
+    def grant_follower_lease(self, peer: int,
+                             incarnation: int = 0) -> Optional[dict]:
+        """Leader side of OP_FLR_LEASE (called under the node lock by
+        the lease wire op): grant ``peer`` a commit-index-bounded read
+        lease nested inside our own leader lease, or refuse (None).
+
+        The returned ``dur`` is the REMAINING leader-lease window; the
+        requester anchors it at its own request-send stamp, so its
+        window ends before ours does in real time (send precedes our
+        receipt), and ours is already proven to end before any election
+        can complete (lease_guard quorum intersection).  Our
+        conservative tracking window starts at receipt and adds the
+        lease margin, covering the grantee's rate drift."""
+        if not (self.is_leader and self._flr_enabled()):
+            return None
+        if self.draining or self.external_commit:
+            # Device-owned commit bypasses the host ack rule the
+            # blocker invalidation hangs off — no grants while the
+            # device quorum owns commit (outstanding ones are capped
+            # via flr_commit_cap until they expire).
+            self.bump("flr_grant_refusals")
+            return None
+        if self.cid.state != CidState.STABLE \
+                or not self.cid.contains(peer) or peer == self.idx:
+            self.bump("flr_grant_refusals")
+            return None
+        if incarnation < self.fence_epochs.get(peer, 0):
+            # Stale ex-occupant of the slot: its reads must bounce to
+            # the leader like everything else it sends.
+            self.bump("flr_grant_refusals")
+            return None
+        fnow = self._fresh_now()
+        if not self._lease_valid(fnow):
+            self.bump("flr_grant_refusals")
+            return None
+        # Liveness guards: only a caught-up follower may hold a lease —
+        # a laggard holding one would stall commit (blocker rule) for
+        # the whole window while never serving a read — and a holder
+        # that RECENTLY blocked commit must fully catch up before it
+        # renews (see _flr_blocked_at: without this, an asymmetric
+        # partition that drops our entries but delivers its requests
+        # would let it renew itself into a permanent write stall).
+        ack = self.regions.ctrl[Region.REP_ACK][peer]
+        if ack is None or ack < self.log.commit:
+            self.bump("flr_grant_refusals")
+            return None
+        if ack < self.log.end and \
+                fnow - self._flr_blocked_at.get(peer, -1e9) \
+                < 2.0 * self.cfg.hb_timeout:
+            self.bump("flr_grant_refusals")
+            return None
+        dur = self._lease_until - fnow
+        if dur <= 0:
+            self.bump("flr_grant_refusals")
+            return None
+        until = fnow + dur * (1.0 + self.cfg.lease_margin)
+        had_live = self._fgrants.get(peer, -1.0) > fnow
+        if until > self._fgrants.get(peer, -1.0):
+            self._fgrants[peer] = until
+        self.bump("flr_grants")
+        if not had_live:
+            self._note("lease", "flr_grant", peer=peer,
+                       term=self.current_term, floor=self.log.commit)
+        return {"term": self.current_term, "epoch": self.cid.epoch,
+                "floor": self.log.commit, "dur": dur}
+
+    def _flr_live_blockers(self, fnow: float) -> list[int]:
+        """Peers whose granted lease window is still live on our clock:
+        commit must not advance past an index they have not acked.
+        Pruned by TIME only — a slot removed from the config keeps
+        blocking until its window expires (its ex-holder may not have
+        applied the removal yet and would serve reads missing anything
+        we committed without it)."""
+        if not self._fgrants:
+            return []
+        live = []
+        for p, until in list(self._fgrants.items()):
+            if until <= fnow:
+                del self._fgrants[p]
+            else:
+                live.append(p)
+        return live
+
+    def flr_commit_cap(self) -> Optional[int]:
+        """Max index commit may advance to under outstanding follower
+        leases (None = unconstrained).  Consulted by _advance_commit
+        AND by the device plane's commit adoption — grants are refused
+        while external_commit is on, but a grant issued just before the
+        flip must keep binding until it expires."""
+        blockers = self._flr_live_blockers(self._fresh_now())
+        if not blockers:
+            return None
+        acks = self.regions.ctrl[Region.REP_ACK]
+        return min((acks[p] if acks[p] is not None else 0)
+                   for p in blockers)
+
+    def _flease_ok(self, fnow: float) -> tuple[bool, str]:
+        """Is OUR follower lease currently serveable?  Returns
+        (ok, reason) with NO side effects (callers bump counters/notes
+        so OP_STATUS can probe this freely).  The planted-bug knobs
+        (NodeConfig.flr_plant) skip exactly one check each — the
+        stale-read harness relies on the audit plane catching what this
+        function would otherwise have stopped."""
+        plant = self.cfg.flr_plant
+        if not self._flr_enabled() or self.draining:
+            return False, "disabled"
+        if self.role != Role.FOLLOWER:
+            return False, "role"
+        if self._flease_term != self.current_term:
+            return False, "term"
+        if self.cid.state != CidState.STABLE:
+            return False, "config"
+        if self._flease_epoch != self.cid.epoch and plant != "epoch":
+            return False, "epoch"
+        if fnow >= self._flease_until and plant != "expiry":
+            if fnow - self._flease_until > self._flease_dur:
+                # Missed by more than a whole window: the process was
+                # paused or the clock jumped — the classic lease
+                # killer, surfaced distinctly.
+                return False, "pause_or_jump"
+            return False, "expired"
+        return True, "ok"
+
+    def follower_read(self, req_id: int, clt_id: int,
+                      data: bytes) -> Optional[PendingRead]:
+        """Register (and, on the warm path, immediately serve) a
+        linearizable read at a FOLLOWER under its read lease.  None
+        when follower reads cannot engage at all (not a follower,
+        disabled, no live wire) — the caller answers NOT_LEADER with a
+        hint.  A returned handle resolves either ``done`` (served from
+        local applied state) or ``refused`` (lease lapsed: the caller
+        answers NOT_LEADER and the client falls back to the leader).
+
+        Safety of the serve condition (see NodeConfig docstring): with
+        the lease live, every write acked to any client BEFORE this
+        read's invoke either committed before the governing grant
+        (idx <= floor) or required our log ack while the window was
+        live (idx < our log end at registration) — so waiting for
+        apply >= max(floor, end-at-registration) covers them all."""
+        if self.role != Role.FOLLOWER or self.draining:
+            return None
+        if not self._flr_enabled() or self.lease_requester is None:
+            return None
+        fnow = self._fresh_now()
+        self._flr_hot_until = fnow + 1.0
+        ok, _why = self._flease_ok(fnow)
+        if not ok:
+            # Cold lease: one inline request (lock yielded on the
+            # wire) before parking the read — a cold GET then costs
+            # one extra roundtrip instead of a leader bounce.
+            self._request_flease(fnow)
+            fnow = self._fresh_now()
+            ok, _why = self._flease_ok(fnow)
+        wait_idx = max(self.log.end, self._flease_floor)
+        rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
+                         registered_at=fnow, flr=True)
+        if ok and self.log.apply >= wait_idx:
+            try:
+                rr.reply = self.sm.query(data)
+            except Exception:
+                rr.reply = None
+                rr.error = True
+            rr.done = True
+            self.reads_done += 1
+            self.bump("flr_local_reads")
+            return rr
+        self._flr_pending.append(rr)
+        return rr
+
+    #: How long a parked follower read waits through an invalid lease
+    #: (renewal in flight) before being refused to the leader, in
+    #: heartbeat timeouts.
+    FLR_REFUSE_AFTER_HB = 2.0
+
+    def _serve_follower_reads(self, now: float) -> None:
+        """Resolve parked follower reads (follower tick): serve the
+        ones applied state covers while the lease is live; refuse the
+        ones a dead lease has stranded (the client retries at the
+        leader — the 'forward' path, expressed as a typed bounce)."""
+        if not self._flr_pending:
+            return
+        fnow = self._fresh_now()
+        ok, why = self._flease_ok(fnow)
+        if not ok and self._flr_noted:
+            self._flr_noted = False
+            self.bump("flr_lapses")
+            if why == "pause_or_jump":
+                self.bump("flr_pause_lapses")
+            elif why == "epoch":
+                # Config-epoch fence tripped: a membership change
+                # applied under the lease — reads bounce until a
+                # fresh-epoch grant arrives.
+                self.bump("flr_epoch_refusals")
+            self._note("lease", "flr_lapse", cause=why,
+                       term=self.current_term)
+        still: list[PendingRead] = []
+        for r in self._flr_pending:
+            if ok and self.log.apply >= max(r.wait_idx,
+                                            self._flease_floor):
+                try:
+                    r.reply = self.sm.query(r.data)
+                except Exception:
+                    r.reply = None
+                    r.error = True
+                r.done = True
+                self.reads_done += 1
+                self.bump("flr_local_reads")
+            elif not ok and fnow - r.registered_at \
+                    > self.FLR_REFUSE_AFTER_HB * self._hb_timeout:
+                r.refused = True
+                self.reads_done += 1
+                self.bump("flr_forwards")
+            else:
+                still.append(r)
+        self._flr_pending = still
+
+    def _flr_refuse_all(self, why: str) -> None:
+        """Refuse every parked follower read (role/term/leader loss)."""
+        for r in self._flr_pending:
+            r.refused = True
+            self.reads_done += 1
+            self.bump("flr_forwards")
+        self._flr_pending = []
+        if self._flr_noted:
+            self._flr_noted = False
+            self.bump("flr_lapses")
+            self._note("lease", "flr_lapse", cause=why,
+                       term=self.current_term)
+
+    def _maybe_request_flease(self, now: float) -> None:
+        """Keep the lease warm while follower reads are flowing
+        (follower tick): request a fresh grant once the held window
+        runs low.  Rate-limited to ~one request per heartbeat period."""
+        if self.lease_requester is None or not self._flr_enabled() \
+                or self.draining:
+            return
+        fnow = self._fresh_now()
+        if fnow >= self._flr_hot_until and not self._flr_pending:
+            return
+        if self._flease_until - fnow > 0.5 * self._hb_timeout \
+                and self._flease_ok(fnow)[0]:
+            return
+        if now < self._flr_next_req:
+            return
+        self._flr_next_req = now + max(self.cfg.hb_period, 0.001)
+        self._request_flease(fnow)
+
+    def _request_flease(self, t_req: float) -> None:
+        """One lease-request roundtrip to the known leader.  ``t_req``
+        MUST be our fresh-clock stamp from BEFORE the wire call — the
+        adopted window is anchored there (see NodeConfig: anchoring at
+        delivery would let a delayed grant outlive the guards).  The
+        transport yields the node lock on the wire; state is
+        re-validated after it returns."""
+        leader = self._known_leader
+        if leader is None or leader == self.idx \
+                or self._flr_req_inflight:
+            return
+        term0 = self.current_term
+        self._flr_req_inflight = True
+        try:
+            self.bump("flr_requests")
+            grant = self.lease_requester(leader)
+        finally:
+            self._flr_req_inflight = False
+        if not grant:
+            return
+        # Post-roundtrip validation: same term at both ends, grant from
+        # the leader we asked, window still worth adopting.
+        if self.role != Role.FOLLOWER or self.current_term != term0 \
+                or grant.get("term") != term0:
+            return
+        until = t_req + float(grant.get("dur", 0.0))
+        if until <= self._flease_until and \
+                grant.get("epoch") == self._flease_epoch:
+            return
+        self._flease_until = until
+        self._flease_term = int(grant["term"])
+        self._flease_epoch = int(grant["epoch"])
+        self._flease_floor = max(self._flease_floor,
+                                 int(grant["floor"]))
+        self._flease_dur = float(grant.get("dur", 0.0))
+        self.bump("flr_renewals")
+        if not self._flr_noted:
+            self._flr_noted = True
+            self._note("lease", "flr_held", term=self._flease_term,
+                       floor=self._flease_floor)
+
+    def _flease_reset(self) -> None:
+        """Drop our held lease + parked reads (role/term transitions)."""
+        self._flease_until = -1.0
+        self._flease_term = -1
+        self._flease_epoch = -1
+        self._flease_floor = 0
+        self._flr_refuse_all("role_change")
 
     def flush_pending(self) -> None:
         """Admit queued client writes into the log NOW instead of at
@@ -960,6 +1345,9 @@ class Node:
         self.sid.update(new.word)
         self.role = Role.CANDIDATE
         self._known_leader = None
+        # Candidates serve no follower reads: resolve parked ones so
+        # their handlers bounce to wherever leadership lands.
+        self._flease_reset()
         self.bump("elections")
         self._note("election", term=new.term)
         # Fence: revoke everyone's access to our log during the vote
@@ -986,6 +1374,14 @@ class Node:
         self._drain_wait = {}
         self._lease_until = -1.0           # no lease carries across terms
         self._lease_noted = False
+        # Follower-lease state dies with the role: grants we issued in
+        # an earlier leadership are safe to drop — the election that
+        # made us leader again completed after every outstanding window
+        # (lease_guard quorum intersection) — and any lease WE held as
+        # a follower is term-dead.
+        self._fgrants.clear()
+        self._flr_blocked_at.clear()
+        self._flease_reset()
         self._election_deadline = None
         self._next_hb_send = now           # heartbeat immediately
         self._next_idx = {}
@@ -1046,6 +1442,13 @@ class Node:
         self.device_covered_from = None
         self._lease_until = -1.0
         self._lease_noted = False
+        # A term/leader move invalidates our held follower lease (term
+        # check would refuse anyway); grants we issued while leading
+        # must KEEP blocking nothing — we no longer advance commit at
+        # all — so clearing them is safe.
+        self._fgrants.clear()
+        self._flr_blocked_at.clear()
+        self._flease_reset()
         self._election_deadline = None
         self._last_hb_seen = now
         self.group_contact = True
@@ -1152,12 +1555,23 @@ class Node:
         self._last_hb_seen = now          # give the candidate time to win
         self.group_contact = True
         self.bump("votes_granted")
+        # Fence our log for the candidate BEFORE the vote leaves this
+        # replica (restore_log_access grants the candidate's QP only,
+        # dare_ibv_rc.c:2195-2255 — the reference likewise revokes
+        # before votes).  ORDER IS SAFETY-CRITICAL: _replicate_vote
+        # blocks on the wire with the node lock YIELDED, and an
+        # un-fenced deposed leader could land a log write in that
+        # window — the up-to-dateness decision above would then be
+        # STALE, and its entry could COMMIT via our synchronous ack
+        # while our vote elects a leader that lacks it (a committed
+        # write the new leader then truncates).  Found live by the
+        # adversarial-time nemesis (seed 94500): a SIGSTOPped leader
+        # resumed into exactly this window and the linearizability
+        # checker caught the lost write as a stale read.
+        self.regions.grant_log_access(cand.idx, cand.term)
         # Durable vote: replicate to a majority (rc_replicate_vote,
         # dare_ibv_rc.c:1049-1109).
         self._replicate_vote(Sid(cand.term, False, cand.idx))
-        # Fence our log for the candidate (restore_log_access grants the
-        # candidate's QP only, dare_ibv_rc.c:2195-2255).
-        self.regions.grant_log_access(cand.idx, cand.term)
         # Ack: write our commit index into the candidate's vote_ack slot.
         self.t.ctrl_write(cand.idx, Region.VOTE_ACK, self.idx, self.log.commit)
 
@@ -1201,9 +1615,18 @@ class Node:
         """hb_receive_cb + replication-ack + apply reporting
         (dare_server.c:822-922, persist_new_entries :1792-1810)."""
         if self.draining:
-            return      # drained: no acks, no campaigns, no reports
+            # Drained: no acks, no campaigns, no reports — and any
+            # parked follower reads resolve as refusals (this replica
+            # is leaving; clients re-find the group).
+            self._flr_refuse_all("draining")
+            return
         self._scan_heartbeats(now)
+        self._serve_follower_reads(now)
         if now - self._last_hb_seen > self._hb_timeout:
+            # Leader contact lost: the lease is not renewable and a
+            # fresh election may be forming — bounce parked follower
+            # reads to the (next) leader rather than stranding them.
+            self._flr_refuse_all("no_leader")
             if self._await_contact:
                 # No campaigning before group contact; fall back to
                 # normal elections if nobody reaches us for a long time
@@ -1226,6 +1649,10 @@ class Node:
         if now >= self._next_apply_report and r == WriteResult.OK:
             self.t.ctrl_write(leader, Region.APPLY_IDX, self.idx, self.log.apply)
             self._next_apply_report = now + self.cfg.apply_report_period
+        # Keep the follower read lease warm while reads are flowing
+        # (after the REP_ACK write above, so the leader's caught-up
+        # check sees our freshest ack).
+        self._maybe_request_flease(now)
 
     def _scan_heartbeats(self, now: float) -> None:
         hb = self.regions.ctrl[Region.HB]
@@ -1650,6 +2077,12 @@ class Node:
                     # a joiner reusing the slot would inherit a phantom
                     # ack) or leadership may have moved.
                     self.regions.ctrl[Region.REP_ACK][peer] = acked_end
+                    # clock-exempt: region touch stamps feed the
+                    # device-plane liveness mask, which compares them
+                    # against ITS OWN time.monotonic() reads — both
+                    # sides must stay in the REAL clock domain, outside
+                    # the skewable lease/failure-detector seam
+                    # (scripts/check_clock.py).
                     self.regions.touch(Region.REP_ACK, peer,
                                        time.monotonic())
             elif res == WriteResult.FENCED:
@@ -1730,6 +2163,17 @@ class Node:
         if self.external_commit:
             return          # the device-plane quorum owns commit
         acks = self.regions.ctrl[Region.REP_ACK]
+        # Follower-lease write invalidation (Hermes on the log): while
+        # a granted read-lease window is live, commit must not advance
+        # past an index its holder has not acked — otherwise the holder
+        # could serve a local read missing a client-acked write.  A
+        # blocked candidate falls through to SMALLER candidates (the
+        # holder's own ack is in the candidate set), so commit still
+        # advances as far as every live lease holder has replicated;
+        # an unreachable holder stalls it for at most one lease window.
+        fnow = self._fresh_now() if self._fgrants else 0.0
+        blockers = (self._flr_live_blockers(fnow)
+                    if self._fgrants else [])
         candidates = sorted({a for a in acks if a is not None} | {self.log.end},
                             reverse=True)
         for c in candidates:
@@ -1740,6 +2184,15 @@ class Node:
                 if a is not None and a >= c:
                     mask |= 1 << peer
             if have_majority(mask, self.cid):
+                lagging = [p for p in blockers
+                           if acks[p] is None or acks[p] < c]
+                if lagging:
+                    self.bump("flr_commit_blocked")
+                    for p in lagging:
+                        # Renewal embargo until it catches up (grant
+                        # liveness guard).
+                        self._flr_blocked_at[p] = fnow
+                    continue    # try a smaller, holder-acked candidate
                 # Raft safety: only commit prefixes ending in our own term
                 # (the blank entry from become_leader guarantees progress).
                 last = self.log.get(c - 1)

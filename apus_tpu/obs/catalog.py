@@ -33,6 +33,17 @@ COUNTERS: dict[str, str] = {
     "node_lease_reads": "linearizable reads served from the leader lease",
     "node_lease_renewals": "leader lease renewals (quorum-acked HB rounds)",
     "node_readindex_verifies": "reads that paid the read-index majority round",
+    # Follower read leases (read scale-out; core/node.py flr_*).
+    "node_flr_grants": "follower read leases granted by this leader",
+    "node_flr_grant_refusals": "follower lease requests refused (typed guards)",
+    "node_flr_requests": "lease requests this follower sent to the leader",
+    "node_flr_renewals": "lease grants adopted by this follower",
+    "node_flr_local_reads": "linearizable reads served from a follower lease",
+    "node_flr_forwards": "follower reads bounced to the leader (lease dead)",
+    "node_flr_lapses": "follower lease lapse edges (any cause)",
+    "node_flr_pause_lapses": "lapses missed by a whole window (pause/clock jump)",
+    "node_flr_epoch_refusals": "lapses on the config-epoch fence (membership moved)",
+    "node_flr_commit_blocked": "commit advances held for a live lease holder's ack",
     "node_graceful_leaves": "OP_LEAVE removals committed",
     "node_auto_removes": "failure-detector evictions committed",
     "node_resize_aborts": "EXTENDED-resize aborts (joiner died mid-catch-up)",
@@ -71,6 +82,7 @@ COUNTERS: dict[str, str] = {
     "fault_throttles": "ops stalled by a slow-peer throttle",
     "fault_inbound_drops": "inbound handler messages dropped",
     "fault_inbound_delays": "inbound handler messages delayed",
+    "fault_clock_cmds": "adversarial-time commands applied (rate/jump/reset)",
     # -- srv_*: passive peer server (parallel/net.py PeerServer) -------
     "srv_ingest_batches": "multi-frame bursts drained off one connection",
     "srv_ingest_frames": "frames ingested through burst drains",

@@ -138,12 +138,20 @@ class FaultPlane(Transport):
             stats = MetricsRegistry().view("fault")
         self.stats = stats
         for k in ("drops", "delays", "dups", "reorders", "blocked",
-                  "throttles", "inbound_drops", "inbound_delays"):
+                  "throttles", "inbound_drops", "inbound_delays",
+                  "clock_cmds"):
             self.stats.setdefault(k, 0)
         #: black-box hook (ObsHub flight recorder, daemon-installed):
         #: scripted fault commands land in the ring so a failure dump
         #: shows what was injected around the violation.
         self.flight = None
+        #: Adversarial-time control (utils.clock.SkewClock), installed
+        #: by the daemon: the clock_rate / clock_jump / clock_reset
+        #: wire commands skew THIS replica's whole clock seam — lease
+        #: math, failure detector, tick stamps — like a machine whose
+        #: CLOCK_MONOTONIC drifts.  None on planes without a daemon
+        #: (raw-transport tests): clock commands then error loudly.
+        self.clock_ctl = None
         # reorder holds: peer -> Event released by the next op
         self._holds: dict[int, threading.Event] = {}
         self._schedule: list[dict] = []
@@ -503,6 +511,21 @@ def apply_command(plane: FaultPlane, cmd: dict) -> dict:
         plane.set_inbound_drop(cmd["p"])
     elif c == "inbound_delay":
         plane.set_inbound_delay(cmd["lo"], cmd.get("hi"))
+    elif c in ("clock_rate", "clock_jump", "clock_reset"):
+        # Adversarial time (the SkewClock seam): rate skew, step jumps,
+        # back to real rate.  Scriptable over the wire AND from seeded
+        # schedules, like every other fault.
+        ctl = getattr(plane, "clock_ctl", None)
+        if ctl is None:
+            raise ValueError("no clock control on this plane "
+                             "(daemon-installed SkewClock required)")
+        if c == "clock_rate":
+            ctl.set_rate(cmd["rate"])
+        elif c == "clock_jump":
+            ctl.jump(cmd["seconds"])
+        else:
+            ctl.reset()
+        plane.stats.bump("clock_cmds")
     elif c == "stats":
         pass                            # stats ride every reply
     else:

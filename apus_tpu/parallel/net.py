@@ -259,8 +259,14 @@ class PeerServer:
                 s = Sid.unpack(value)
                 if s.leader and s.idx == slot \
                         and s.term >= node.current_term:
+                    # Stamped from the NODE's clock seam (_fresh_now ->
+                    # the daemon's SkewClock): the no-vote-while-
+                    # leader-alive window is compared against tick
+                    # stamps from the same domain, and the adversarial-
+                    # time nemesis must skew both coherently
+                    # (scripts/check_clock.py pins this).
                     node._last_hb_seen = max(node._last_hb_seen,
-                                             time.monotonic())
+                                             node._fresh_now())
                     node.group_contact = True
             return wire.u8(_ST_OF_RESULT[res]) + wire.u64(node.sid.word)
         if op == wire.OP_CTRL_READ:
@@ -377,7 +383,14 @@ class NetTransport(Transport):
         #: None sends 0 — raw-transport tests and fixed-membership
         #: clusters are unaffected (fence tables stay empty).
         self.incarnation_of: Optional[Callable[[], int]] = None
-        #: peer -> (sid_word, monotonic arrival time) from ctrl-write
+        #: Clock for the reply-echo stamps below — the daemon installs
+        #: its per-replica SkewClock so the stamps share the heartbeat
+        #: round-start's clock domain (Node._send_heartbeats compares
+        #: ``seen[1] >= t0``; mixing domains there would corrupt the
+        #: lease-renewal proof exactly when the nemesis skews time).
+        #: Wire mechanics (timeouts, backoff) stay on real time.
+        self.clock: Callable[[], float] = time.monotonic
+        #: peer -> (sid_word, clock-domain arrival time) from ctrl-write
         #: reply echoes (read-lease renewal evidence; see ctrl_write).
         self.peer_sid_seen: dict[int, tuple[int, float]] = {}
         self._conns: dict[int, socket.socket] = {}
@@ -639,7 +652,7 @@ class NetTransport(Transport):
             # lease quorum only when the echo is from THIS round and
             # its term has not moved past ours).
             self.peer_sid_seen[target] = \
-                (wire.Reader(resp[1:9]).u64(), time.monotonic())
+                (wire.Reader(resp[1:9]).u64(), self.clock())
         return _RESULT_OF_ST.get(resp[0], WriteResult.DROPPED)
 
     def ctrl_read(self, target: int, region: Region, slot: int) -> Any:

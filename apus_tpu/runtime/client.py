@@ -38,6 +38,20 @@ ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
 
 
+def _svc_emulate(daemon, n_reads: int) -> None:
+    """Per-replica read service-capacity emulation (bench.py
+    --throughput follower-read rows): each served read holds this
+    daemon's service gate for APUS_READ_SVC_US microseconds, modeling a
+    replica that owns one core on boxes that don't have one per
+    process.  Runs OUTSIDE the node lock (the gate serializes read
+    service per replica, nothing else).  Off (zero overhead) unless the
+    bench armed it."""
+    svc = getattr(daemon, "read_svc", 0.0)
+    if svc and n_reads > 0:
+        with daemon._svc_gate:
+            time.sleep(svc * n_reads)
+
+
 def make_client_ops(daemon) -> dict:
     """Extra PeerServer ops for a ReplicaDaemon (runs on per-connection
     server threads; blocking a handler blocks only that client's
@@ -83,22 +97,33 @@ def make_client_ops(daemon) -> dict:
         data = r.blob()
         with daemon.lock:
             rr = daemon.node.read(req_id, clt_id, data)
+            if rr is None:
+                # Not the leader: try the follower-lease local-read
+                # path (core/node.py follower_read) before bouncing.
+                rr = daemon.node.follower_read(req_id, clt_id, data)
         if rr is None:
             return _not_leader(daemon, req_id)
+        follower = getattr(rr, "flr", False)
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
                 if rr.done:
                     if rr.error:
                         return wire.u8(wire.ST_ERROR) + wire.u64(req_id)
-                    return (wire.u8(wire.ST_OK) + wire.u64(req_id)
-                            + wire.blob(rr.reply or b""))
-                if not daemon.node.is_leader:
+                    break           # served; svc gate OUTSIDE the lock
+                if getattr(rr, "refused", False):
+                    # Lease lapsed/invalidated under the parked read:
+                    # typed bounce; the client retries at the leader.
+                    return _not_leader(daemon, req_id)
+                if not follower and not daemon.node.is_leader:
                     return _not_leader(daemon, req_id)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
                 daemon.commit_cond.wait(min(left, 0.25))
+        _svc_emulate(daemon, 1)
+        return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                + wire.blob(rr.reply or b""))
 
     def status(r: wire.Reader) -> bytes:
         """Observability probe (ops tooling / process launchers): role,
@@ -212,6 +237,29 @@ def make_client_ops(daemon) -> dict:
                 "lease_reads": n.stats.get("lease_reads", 0),
                 "readindex_verifies": n.stats.get("readindex_verifies", 0),
                 "lease_renewals": n.stats.get("lease_renewals", 0),
+                # Follower-read-lease observability (read scale-out):
+                # grants issued (leader) / local reads served and
+                # bounces (follower) / commit advances held back by a
+                # live holder's missing ack / pause- or jump-induced
+                # lapses, plus whether THIS replica currently holds a
+                # serveable lease and whether its clock is skewed by
+                # the adversarial-time nemesis.
+                "flr_grants": n.stats.get("flr_grants", 0),
+                "flr_grant_refusals": n.stats.get("flr_grant_refusals",
+                                                  0),
+                "flr_local_reads": n.stats.get("flr_local_reads", 0),
+                "flr_forwards": n.stats.get("flr_forwards", 0),
+                "flr_renewals": n.stats.get("flr_renewals", 0),
+                "flr_lapses": n.stats.get("flr_lapses", 0),
+                "flr_pause_lapses": n.stats.get("flr_pause_lapses", 0),
+                "flr_epoch_refusals": n.stats.get("flr_epoch_refusals",
+                                                  0),
+                "flr_commit_blocked": n.stats.get("flr_commit_blocked",
+                                                  0),
+                "flr_lease_live": bool(
+                    n._flease_ok(n._fresh_now())[0]),
+                "clock_skewed": bool(getattr(daemon.clock, "skewed",
+                                             False)),
                 "drain_windows": n.stats.get("drain_windows", 0),
                 "drain_entries": n.stats.get("drain_entries", 0),
                 "repl_windows": n.stats.get("repl_windows", 0),
@@ -347,6 +395,11 @@ def make_client_batch_hook(daemon):
             op, req_id, clt_id, data = parsed[i]
             handles[i] = daemon.node.read(req_id, clt_id, data,
                                           min_wait_idx=floor)
+            if handles[i] is None:
+                # Not the leader: the follower-lease local-read path
+                # (burst writes all bounce NOT_LEADER; floor is 0).
+                handles[i] = daemon.node.follower_read(req_id, clt_id,
+                                                       data)
             registered[i] = True
 
         with daemon.lock:
@@ -395,6 +448,10 @@ def make_client_batch_hook(daemon):
                     sp.stamp(_clt, req_id, "reply", idx=h.idx)
                     sp.finish(_clt, req_id)
                 return True
+            if getattr(h, "refused", False):
+                # Follower lease lapsed under the parked read.
+                replies[i] = _not_leader(daemon, req_id)
+                return True
             if not h.done:
                 return False
             if h.error:
@@ -404,24 +461,48 @@ def make_client_batch_hook(daemon):
                               + wire.blob(h.reply or b""))
             return True
 
+        def _finish():
+            # Service-capacity emulation covers every read the burst
+            # served locally (leader lease or follower lease alike);
+            # runs outside the lock, after the replies are built.
+            # Gated on the knob so unarmed runs pay nothing per burst.
+            if getattr(daemon, "read_svc", 0.0):
+                _svc_emulate(daemon, sum(
+                    1 for i, (op, *_r) in enumerate(parsed)
+                    if op == OP_CLT_READ and replies[i] is not None
+                    and replies[i][:1] == wire.u8(wire.ST_OK)))
+            return replies
+
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
                 unresolved = [i for i in range(len(parsed))
                               if replies[i] is None and not _resolve(i)]
                 if not unresolved:
-                    return replies
+                    break
                 if not daemon.node.is_leader:
+                    # Leader-path ops bounce; follower-lease reads keep
+                    # waiting (they resolve done/refused on the tick —
+                    # this daemon is structurally not the leader).
+                    waiting = []
                     for i in unresolved:
-                        replies[i] = _not_leader(daemon, parsed[i][1])
-                    return replies
+                        h = handles[i]
+                        if h is not None and getattr(h, "flr", False):
+                            waiting.append(i)
+                        else:
+                            replies[i] = _not_leader(daemon,
+                                                     parsed[i][1])
+                    if not waiting:
+                        break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     for i in unresolved:
-                        replies[i] = (wire.u8(ST_TIMEOUT)
-                                      + wire.u64(parsed[i][1]))
-                    return replies
+                        if replies[i] is None:
+                            replies[i] = (wire.u8(ST_TIMEOUT)
+                                          + wire.u64(parsed[i][1]))
+                    break
                 daemon.commit_cond.wait(min(left, 0.25))
+        return _finish()
 
     return hook
 
@@ -527,8 +608,21 @@ class ApusClient:
 
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
                  timeout: float = 5.0, attempt_timeout: float = 2.0,
-                 history=None, tracer=None):
+                 history=None, tracer=None,
+                 read_policy: str = "leader"):
         self.peers = [self._parse(p) for p in peers]
+        #: Read routing: "leader" (default — every op chases the
+        #: leader) or "spread" — GETs rotate across ALL replicas and
+        #: are served from follower read leases where live
+        #: (linearizable; core/node.py follower_read); a follower
+        #: whose lease cannot serve answers NOT_LEADER-with-hint and
+        #: the read falls back to the leader.  Writes always chase the
+        #: leader regardless.
+        self.read_policy = read_policy
+        # Desynchronized start: clients constructed together must not
+        # herd their spread reads onto the same replica each round.
+        self._read_rotor = (secrets.randbits(16) % len(self.peers)
+                            if self.peers else 0)
         #: Optional client-side span recorder (apus_tpu.obs.spans.
         #: SpanRecorder): sampled ops get client_send/client_reply
         #: stamps, stitched against the replicas' rings by (clt_id,
@@ -595,6 +689,14 @@ class ApusClient:
         self._req_seq += 1
         return self._op(OP_CLT_READ, self._req_seq, data)
 
+    def _spread_target(self) -> Optional[int]:
+        """Next read target under read_policy='spread' (round-robin
+        over the known peer table)."""
+        if self.read_policy != "spread" or not self.peers:
+            return None
+        self._read_rotor = (self._read_rotor + 1) % len(self.peers)
+        return self._read_rotor
+
     # -- pipelined ops ----------------------------------------------------
 
     #: default in-flight window for pipeline() — matches the device
@@ -630,7 +732,14 @@ class ApusClient:
                                   "client_send")
         results: dict[int, bytes] = {}
         deadline = time.monotonic() + self.timeout
-        target = self._leader
+        # Pure-read bursts under read_policy='spread' rotate across
+        # replicas (served from follower read leases); a NOT_LEADER
+        # bounce falls back to the hinted leader for the remainder.
+        spread = (self.read_policy == "spread"
+                  and all(op == OP_CLT_READ for op, _r, _d in items))
+        target = self._spread_target() if spread else self._leader
+        if target is None:
+            target = self._leader
         pending = items
         try:
             while pending:
@@ -643,14 +752,18 @@ class ApusClient:
                     if target is None:
                         continue
                 outcome, hint = self._pipeline_attempt(
-                    target, pending, results, deadline, window)
+                    target, pending, results, deadline, window,
+                    learn_leader=not spread)
                 pending = [it for it in pending if it[1] not in results]
                 if outcome == "hint":
                     target = self._peer_index(hint) if hint \
-                        else self._next(target)
+                        else (self._leader if spread
+                              and self._leader is not None
+                              else self._next(target))
                     time.sleep(0.01)
                 elif outcome != "ok":
-                    target = self._next(target)
+                    target = ((target + 1) % len(self.peers)
+                              if spread else self._next(target))
         except BaseException:
             # Unresolved ops are ambiguous: a retry MAY already have
             # landed (the reply was simply never read).
@@ -678,7 +791,8 @@ class ApusClient:
         return self.pipeline_reads([encode_get(k) for k in keys])
 
     def _pipeline_attempt(self, target: int, items: list, results: dict,
-                          deadline: float, window: int):
+                          deadline: float, window: int,
+                          learn_leader: bool = True):
         """One pipelined exchange against ``target``.  Returns
         ("ok", None) when every item resolved, ("hint", addr_or_None)
         on NOT_LEADER, ("rotate", None) on a peer-side commit timeout,
@@ -716,7 +830,8 @@ class ApusClient:
                     continue
                 st = resp[0]
                 if st == wire.ST_OK:
-                    self._leader = target
+                    if learn_leader:
+                        self._leader = target
                     results[rid] = wire.Reader(resp[9:]).blob()
                     del inflight[rid]
                     if self.history is not None:
@@ -793,7 +908,13 @@ class ApusClient:
         payload = (wire.u8(op) + wire.u64(req_id) + wire.u64(self.clt_id)
                    + wire.blob(data))
         deadline = time.monotonic() + self.timeout
-        target = self._leader
+        # Spread reads rotate across replicas (follower read leases);
+        # their failovers must not clobber the cached leader the write
+        # path relies on, so they rotate locally instead of _next().
+        spread = op == OP_CLT_READ and self.read_policy == "spread"
+        target = self._spread_target() if spread else self._leader
+        if target is None:
+            target = self._leader
         while time.monotonic() < deadline:
             if target is None:
                 target = self._probe_any(deadline)
@@ -801,20 +922,31 @@ class ApusClient:
                     continue
             resp = self._roundtrip(target, payload, deadline, req_id)
             if resp is None:
-                target = self._next(target)
+                target = ((target + 1) % len(self.peers) if spread
+                          else self._next(target))
                 continue
             st = resp[0]
             # Replies echo req_id after the status byte (reply pairing
             # under duplication/reordering; _roundtrip already matched
             # it) — the body starts at offset 9.
             if st == wire.ST_OK:
-                self._leader = target
+                if not spread:
+                    self._leader = target
                 return wire.Reader(resp[9:]).blob()
             if st == ST_NOT_LEADER:
                 hint = wire.Reader(resp[9:]).blob().decode() if \
                     len(resp) > 9 else ""
-                target = self._peer_index(hint) if hint \
-                    else self._next(target)
+                if spread:
+                    # Lease cold/lapsed at that follower: fall back to
+                    # the leader for THIS read, keep the rotor for the
+                    # next one.
+                    target = (self._peer_index(hint) if hint
+                              else self._leader
+                              if self._leader is not None
+                              else (target + 1) % len(self.peers))
+                else:
+                    target = self._peer_index(hint) if hint \
+                        else self._next(target)
                 time.sleep(0.01)
                 continue
             if st == ST_TIMEOUT:

@@ -23,6 +23,7 @@ submits take it for their short critical sections.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -79,6 +80,18 @@ class ReplicaDaemon:
         #: (leader flap RATE needs a denominator).
         self.started_mono = time.monotonic()
 
+        # THE per-replica clock seam (utils/clock.py): every lease /
+        # failure-detector time read in this daemon — tick stamps,
+        # fresh-clock lease checks, heartbeat-delivery stamps, reply-
+        # echo stamps — goes through this one callable, so the
+        # adversarial-time nemesis can skew the WHOLE replica's notion
+        # of time coherently (OP_FAULT clock_rate/clock_jump), exactly
+        # like a machine with a drifting CLOCK_MONOTONIC.  Client-
+        # facing deadlines and wire backoffs stay on real time (they
+        # are mechanics, not protocol safety).
+        from apus_tpu.utils.clock import SkewClock
+        self.clock = SkewClock()
+
         peers = {i: _parse_peer(a) for i, a in enumerate(spec.peers)}
         # Dial backoff scaled to the timing envelope: at the production
         # envelope (hb=1 ms) a 0.5 s backoff would leave a transiently
@@ -88,12 +101,20 @@ class ReplicaDaemon:
             backoff=min(0.5, max(0.02, 2.0 * spec.hb_timeout)),
             stats=self.obs.view("net") if self.obs is not None else None)
         self.transport = net
+        # Reply-echo stamps (lease renewal evidence) must share the
+        # node's clock domain — they are compared against heartbeat
+        # round-start stamps taken from the same seam.
+        net.clock = self.clock
         # Live-stack fault plane (parallel.faults): only wraps when the
         # spec or APUS_FAULT_* env enables it — a production daemon's
         # transport is untouched.
         from apus_tpu.parallel.faults import maybe_wrap
         self.transport = maybe_wrap(self.transport, spec=spec,
                                     logger=self.logger, obs=self.obs)
+        if self.transport is not net:
+            # Adversarial-time scripting rides the fault plane's wire
+            # op (OP_FAULT clock_rate / clock_jump / clock_reset).
+            self.transport.clock_ctl = self.clock
         cfg = NodeConfig(
             idx=idx, n_slots=spec.n_slots, hb_period=spec.hb_period,
             hb_timeout=spec.hb_timeout, elect_low=spec.elect_low,
@@ -102,6 +123,12 @@ class ReplicaDaemon:
             fail_window=spec.fail_window, recovery_start=recovery_start,
             seed=seed,
             read_lease=spec.read_lease, lease_margin=spec.lease_margin,
+            follower_read_leases=getattr(spec, "follower_read_leases",
+                                         True),
+            # Planted-stale-lease harness knob (tests only): makes one
+            # follower's lease deliberately wrong so the audit plane
+            # must catch the resulting stale read.
+            flr_plant=os.environ.get("APUS_FLR_PLANT", ""),
             # Segment oversized records so every entry stays device-
             # eligible (slot width minus wire-codec + envelope headroom;
             # DeviceCommitRunner.max_data_bytes is the contract).  With
@@ -128,18 +155,36 @@ class ReplicaDaemon:
         # committed — watchdogs stop re-joining, the node stops
         # voting/acking, and the CLI run loop exits clean.
         self.draining = False
-        # Lease-validity checks must see REAL time, not the tick-start
+        # Lease-validity checks must see FRESH time, not the tick-start
         # stamp: an isolated leader's tick stalls in heartbeat write
         # timeouts with the lock yielded, freezing the stamp exactly
-        # while client handler threads keep consulting the lease.
-        self.node.clock = time.monotonic
+        # while client handler threads keep consulting the lease.  The
+        # fresh clock is the daemon's SkewClock, so injected skew
+        # reaches the lease math through the same seam.
+        self.node.clock = self.clock
+        # Follower linearizable reads (runtime.flr): install the lease
+        # requester; Node gates everything on cfg.follower_read_leases.
+        from apus_tpu.runtime.flr import install_flr
+        install_flr(self)
+        # Per-replica read service-capacity emulation for the follower-
+        # read throughput bench on single-core boxes (bench.py
+        # --throughput): each served read holds this daemon's service
+        # gate for APUS_READ_SVC_US microseconds, emulating a replica
+        # that owns one core.  0 (default) = off, zero overhead.
+        try:
+            self.read_svc = float(os.environ.get("APUS_READ_SVC_US",
+                                                 "0") or 0) / 1e6
+        except ValueError:
+            self.read_svc = 0.0
+        self._svc_gate = threading.Lock()
         # Live deployments stream snapshots off-tick (a multi-second
         # chunked push inline would pause this replica's heartbeats);
         # the deterministic sim keeps the inline path.
         self.node.async_snap_push = True
         # Fresh-start grace: randomize the first election timeout so a
-        # cold cluster elects cleanly (dare_server.c:1237).
-        self.node._last_hb_seen = (time.monotonic()
+        # cold cluster elects cleanly (dare_server.c:1237).  Stamped
+        # from the daemon clock — _last_hb_seen lives in that domain.
+        self.node._last_hb_seen = (self.clock()
                                    + self.node.rng.random()
                                    * self.node.cfg.elect_high)
 
@@ -246,8 +291,10 @@ class ReplicaDaemon:
     def _extra_ops(self) -> dict:
         from apus_tpu.parallel.faults import FaultPlane, make_fault_ops
         from apus_tpu.runtime.client import make_client_ops
+        from apus_tpu.runtime.flr import make_flr_ops
         from apus_tpu.runtime.membership import make_membership_ops
-        ops = {**make_client_ops(self), **make_membership_ops(self)}
+        ops = {**make_client_ops(self), **make_membership_ops(self),
+               **make_flr_ops(self)}
         if self.obs is not None:
             # OP_METRICS scrape + OP_OBS_DUMP flight/span readout.
             from apus_tpu.obs.service import make_obs_ops
@@ -348,7 +395,9 @@ class ReplicaDaemon:
         last_try = 0.0
         while not self._stop.is_set():
             self._stop.wait(0.25)
-            now = time.monotonic()
+            # hb_age compares against _last_hb_seen, which lives in the
+            # daemon-clock domain (tick stamps + HB delivery stamps).
+            now = self.clock()
             with self.lock:
                 is_leader = self.node.is_leader
                 hb_age = now - self.node._last_hb_seen
@@ -437,7 +486,7 @@ class ReplicaDaemon:
         while not self._stop.is_set():
             try:
                 with self.lock:
-                    self.node.tick(time.monotonic())
+                    self.node.tick(self.clock())
                     self._drain_upcalls()
                     self._log_role_changes()
                     for cb in self.on_tick:
@@ -917,7 +966,8 @@ def main(argv: Optional[list] = None) -> int:
             with daemon.lock:
                 progress = (daemon.node.current_term, daemon.node.log.commit,
                             daemon.node.is_leader)
-                hb_age = now - daemon.node._last_hb_seen
+                # _last_hb_seen lives in the daemon-clock domain.
+                hb_age = daemon.clock() - daemon.node._last_hb_seen
             if progress != last_progress:
                 last_progress, progress_t = progress, now
             with daemon.lock:

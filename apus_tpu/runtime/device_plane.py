@@ -1509,7 +1509,16 @@ class DevicePlaneDriver:
 
     def _adopt_commit(self, node, dev_commit: int) -> None:
         """Advance host commit from a device quorum result (under the
-        daemon lock, leadership already re-validated)."""
+        daemon lock, leadership already re-validated).  Capped by any
+        live follower read lease's missing HOST ack (flr_commit_cap):
+        new grants are refused while the device plane owns commit, but
+        a grant issued just before the ownership flip keeps binding
+        until it expires — the device quorum attests SHARD placement,
+        not the holder's host log, and the holder serves reads from
+        its host-applied state."""
+        cap = node.flr_commit_cap()
+        if cap is not None:
+            dev_commit = min(dev_commit, cap)
         if node.log.commit >= self._dev_base and dev_commit > node.log.commit:
             before = node.log.commit
             after = node.log.advance_commit(min(dev_commit, node.log.end))

@@ -1,0 +1,67 @@
+"""Follower linearizable reads: the lease wire exchange (OP_FLR_LEASE).
+
+Protocol glue for NodeConfig.follower_read_leases (the lease state
+machine itself lives in core/node.py):
+
+- ``make_flr_ops`` registers the LEADER side on a daemon's PeerServer:
+  one two-sided control op answering a follower's lease request with a
+  grant (term, config epoch, commit floor, duration) or a typed
+  refusal.  Runs under the daemon lock; no wire ops inside.
+- ``install_flr`` installs the FOLLOWER side: a ``Node.lease_requester``
+  callable that performs one bounded request/response roundtrip through
+  the daemon's transport — which both yields the node lock on the wire
+  AND routes through the fault plane when one is armed, so lease
+  traffic is attackable (dropped, delayed, partitioned) like every
+  other control message.
+
+Anchoring contract (the part that keeps adversarial time out): the
+requester stamps its fresh clock BEFORE the roundtrip and anchors the
+granted duration there (Node._request_flease); the granter's
+conservative window is anchored at its receipt.  Send precedes receipt
+in real time, so the granter's tracking window always outlives the
+holder's belief, with rate drift absorbed by the lease margin.
+
+The deterministic simulator never installs a requester, so sim nodes
+stay wire-free and clock-pure.
+"""
+
+from __future__ import annotations
+
+from apus_tpu.parallel import wire
+
+#: PeerServer extra-op byte (after OP_OBS_DUMP=23).
+OP_FLR_LEASE = 24
+
+
+def make_flr_ops(daemon) -> dict:
+    """Leader-side lease grant op for a ReplicaDaemon's PeerServer."""
+
+    def flr_lease(r: wire.Reader) -> bytes:
+        peer = r.u8()
+        incarnation = r.u32() if r.remaining >= 4 else 0
+        with daemon.lock:
+            g = daemon.node.grant_follower_lease(
+                peer, incarnation=incarnation)
+        if g is None:
+            return wire.u8(wire.ST_REFUSED)
+        return (wire.u8(wire.ST_OK) + wire.u64(g["term"])
+                + wire.u64(g["epoch"]) + wire.u64(g["floor"])
+                + wire.u64(max(0, int(g["dur"] * 1e6))))
+
+    return {OP_FLR_LEASE: flr_lease}
+
+
+def install_flr(daemon) -> None:
+    """Install the follower-side lease requester on ``daemon.node``."""
+
+    def request(leader_idx: int):
+        payload = (wire.u8(OP_FLR_LEASE) + wire.u8(daemon.idx)
+                   + wire.u32(daemon.node.incarnation))
+        resp = daemon.transport.request(leader_idx, payload)
+        if not resp or resp[0] != wire.ST_OK or len(resp) < 33:
+            return None
+        rr = wire.Reader(resp[1:])
+        return {"term": rr.u64(), "epoch": rr.u64(),
+                "floor": rr.u64(), "dur": rr.u64() / 1e6}
+
+    daemon.node.lease_requester = request

@@ -128,6 +128,9 @@ class ProcCluster:
             _free_port() if app_argv is not None else None
             for _ in range(n)]
         self.procs: list[Optional[subprocess.Popen]] = [None] * n
+        #: replicas currently SIGSTOPped by the pause nemesis (resumed
+        #: before teardown so SIGTERM is deliverable).
+        self._paused: set[int] = set()
         self._logs: list = [None] * n
         self._coord: Optional[subprocess.Popen] = None
         self._coord_log = None
@@ -280,6 +283,8 @@ class ProcCluster:
         raise AssertionError(f"replica process {i} not ready in time")
 
     def stop(self) -> None:
+        for i in list(self._paused):
+            self.resume(i)          # SIGTERM pends on stopped processes
         for i, p in enumerate(self.procs):
             if p is not None and p.poll() is None:
                 try:
@@ -316,10 +321,40 @@ class ProcCluster:
 
     # -- fault injection --------------------------------------------------
 
+    def pause(self, idx: int) -> bool:
+        """SIGSTOP replica ``idx``'s whole process group — the GC-pause
+        /VM-freeze stand-in that historically kills lease systems: the
+        process stops dead mid-whatever (lease checks included) while
+        real time, its peers, and CLOCK_MONOTONIC keep running.  On
+        resume the replica must observe its leases expired and refuse
+        to serve — the adversarial-time nemesis pauses a lease-holding
+        follower past expiry, commits newer writes, resumes it, and
+        lets the audit plane judge what it serves."""
+        p = self.procs[idx]
+        if p is None or p.poll() is not None:
+            return False
+        try:
+            os.killpg(p.pid, signal.SIGSTOP)
+        except (OSError, ProcessLookupError):
+            return False
+        self._paused.add(idx)
+        return True
+
+    def resume(self, idx: int) -> None:
+        """SIGCONT a paused replica (see pause)."""
+        p = self.procs[idx]
+        if p is not None:
+            try:
+                os.killpg(p.pid, signal.SIGCONT)
+            except (OSError, ProcessLookupError):
+                pass
+        self._paused.discard(idx)
+
     def kill(self, idx: int) -> None:
         """Machine-crash a replica: SIGKILL its whole process group
         (daemon + app), no shutdown handshake (reconf_bench.sh:100-117)."""
         p = self.procs[idx]
+        self._paused.discard(idx)   # SIGKILL works on stopped processes
         if p is None:
             return
         try:

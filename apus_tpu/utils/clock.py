@@ -1,0 +1,93 @@
+"""Per-process adversarial-time clock (the ``Node.clock`` seam).
+
+Every lease/failure-detector comparison in a live daemon reads time
+through ONE per-daemon callable (ReplicaDaemon.clock): the tick stamp,
+the fresh-clock lease checks (``Node._fresh_now``), the peer server's
+heartbeat-delivery stamp, and the transport's reply-echo stamps all
+share it.  That single seam is what makes adversarial time INJECTABLE:
+the fault plane scripts rate skew and step jumps into this object
+(OP_FAULT ``clock_rate``/``clock_jump``/``clock_reset``) and the whole
+replica — but only that replica — experiences the skewed clock, exactly
+like a machine whose CLOCK_MONOTONIC drifts.
+
+Semantics:
+
+- ``set_rate(r)``: from now on the clock advances at ``r`` x real time
+  (re-anchored at the current value, so the switch is continuous).
+  ``r < 1`` is the classically dangerous direction for lease HOLDERS
+  (their ``now < lease_until`` keeps passing after real expiry);
+  ``r = 0`` freezes the clock outright.
+- ``jump(s)``: one-time step of ``s`` seconds.  Forward jumps make
+  leases expire EARLY (the safe direction).  Backward jumps cannot make
+  the returned value regress — the clock is clamped monotone, so a
+  negative jump behaves as a freeze until real time catches up (real
+  monotonic clocks never run backwards; a stuck clock is the realistic
+  rendering of "time went back").
+- ``reset()``: rate back to 1.0 (accumulated offset is kept — offsets
+  are indistinguishable from a different boot epoch and removing one
+  would need a backward step).
+
+SIGSTOP pauses need no support here: CLOCK_MONOTONIC keeps running
+while a process is stopped, so on SIGCONT the resumed replica's clock
+has already moved past its leases — which is precisely the property
+lease safety rests on, and what the pause nemesis attacks.
+
+Thread-safe; the fast path is one lock + a few floats.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class SkewClock:
+    """Monotone per-process clock with scriptable rate skew + jumps."""
+
+    def __init__(self, base: Callable[[], float] = time.monotonic):
+        self._base = base
+        self._lock = threading.Lock()
+        self._rate = 1.0
+        self._anchor_real = base()
+        self._anchor_val = self._anchor_real
+        self._last = self._anchor_val
+
+    def __call__(self) -> float:
+        with self._lock:
+            v = self._anchor_val \
+                + (self._base() - self._anchor_real) * self._rate
+            if v < self._last:
+                v = self._last          # monotone clamp (never regress)
+            self._last = v
+            return v
+
+    def set_rate(self, rate: float) -> None:
+        """Advance at ``rate`` x real time from the CURRENT value on
+        (continuous: the anchor moves to now, so no step happens)."""
+        with self._lock:
+            real = self._base()
+            self._anchor_val += (real - self._anchor_real) * self._rate
+            self._anchor_real = real
+            self._rate = max(0.0, float(rate))
+
+    def jump(self, seconds: float) -> None:
+        """One-time step.  Negative steps are absorbed by the monotone
+        clamp (the clock freezes until real time catches up)."""
+        with self._lock:
+            self._anchor_val += float(seconds)
+
+    def reset(self) -> None:
+        """Back to real rate (offset kept; see module docstring)."""
+        self.set_rate(1.0)
+
+    @property
+    def rate(self) -> float:
+        with self._lock:
+            return self._rate
+
+    @property
+    def skewed(self) -> bool:
+        with self._lock:
+            return self._rate != 1.0 \
+                or self._anchor_val != self._anchor_real

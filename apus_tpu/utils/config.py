@@ -125,6 +125,18 @@ class ClusterSpec:
     # read through the read-index verification path.
     read_lease: bool = True
     lease_margin: float = 0.2
+    # Follower read leases (core.node NodeConfig.follower_read_leases):
+    # LINEARIZABLE reads served from every replica's local applied
+    # state under commit-index-bounded leases the leader grants in
+    # reply to follower requests, nested inside its own leader lease —
+    # writes invalidate (commit waits for live lease holders' acks),
+    # so a stale local read is structurally impossible within the
+    # documented clock assumption (rate drift under lease_margin).
+    # Lease-keeping is lazy (requested only while follower-routed GETs
+    # are flowing), so leader-only workloads pay nothing.  Distinct
+    # from ``follower_reads`` below, which gates STALE app-level reads
+    # at the proxy.
+    follower_read_leases: bool = True
     # Misdirection gate: False (default) = a non-leader's proxy REFUSES
     # client bytes to its raw app (the client reconnects and finds the
     # leader — structurally no unreplicated reads/writes; beyond the

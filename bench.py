@@ -693,26 +693,45 @@ def _bench_throughput() -> None:
     base_spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
                             elect_low=0.050, elect_high=0.150)
 
+    def flr_sum(peers):
+        tot = 0
+        for p in peers:
+            st = probe_status(p, timeout=1.0) or {}
+            tot += st.get("flr_local_reads", 0) or 0
+        return tot
+
     def drive(cluster, pipelined: bool, reads: bool = False,
-              link_rtt: float = 0.0):
+              link_rtt: float = 0.0, read_policy: str = "leader"):
         """P worker threads for ``seconds``; returns (ops, elapsed,
         leader-counter deltas).  ``link_rtt`` adds one client-side
         sleep per wire roundtrip — serial pays it per OP, pipelined per
         WINDOW — emulating a remote client's link identically for both
-        shapes."""
+        shapes.  ``read_policy="spread"`` routes GETs across all
+        replicas (follower read leases)."""
         leader = cluster.wait_for_leader(30.0)
         peers = list(cluster.spec.peers)
-        with ApusClient(peers, timeout=20.0) as warm:
+        with ApusClient(peers, timeout=20.0,
+                        read_policy=read_policy) as warm:
             warm.put(b"warm", b"w")
             if reads:
                 warm.get(b"warm")
         st0 = probe_status(peers[leader.idx], timeout=2.0) or {}
+        flr0 = flr_sum(peers) if reads else 0
         done = [0] * P
         stop_at = time.monotonic() + seconds
         fails = [0] * P
 
         def worker(w: int):
-            with ApusClient(peers, timeout=30.0) as cl:
+            with ApusClient(peers, timeout=30.0,
+                            read_policy=read_policy) as cl:
+                if reads:
+                    # Pin the leader before timing: a fresh client's
+                    # first probe can land on a follower, and under
+                    # follower read leases that follower would SERVE
+                    # the "leader-only" baseline's reads — the pin
+                    # keeps the leader row leader-routed (spread reads
+                    # route by rotor regardless).
+                    cl.put(b"warm", b"w")
                 i = 0
                 while time.monotonic() < stop_at:
                     try:
@@ -751,13 +770,17 @@ def _bench_throughput() -> None:
                  for k in ("lease_reads", "readindex_verifies",
                            "drain_windows", "drain_entries",
                            "repl_windows")}
+        if reads:
+            delta["flr_local_reads"] = flr_sum(peers) - flr0
         return sum(done), elapsed, delta
 
     results: dict[str, dict] = {}
 
-    def run_variant(cluster, name, pipelined, reads=False, link_rtt=0.0):
+    def run_variant(cluster, name, pipelined, reads=False, link_rtt=0.0,
+                    read_policy="leader"):
         ops, elapsed, delta = drive(cluster, pipelined, reads=reads,
-                                    link_rtt=link_rtt)
+                                    link_rtt=link_rtt,
+                                    read_policy=read_policy)
         results[name] = {
             "ops_per_sec": round(ops / elapsed, 1),
             "ops": ops, "elapsed_s": round(elapsed, 3),
@@ -778,6 +801,35 @@ def _bench_throughput() -> None:
         g = run_variant(c, "gets_lease", pipelined=True, reads=True)
         _mark(f"    (lease_reads +{g['counters']['lease_reads']}, "
               f"verifies +{g['counters']['readindex_verifies']})")
+        gf = run_variant(c, "gets_follower_raw", pipelined=True,
+                         reads=True, read_policy="spread")
+        _mark(f"    (flr_local_reads "
+              f"+{gf['counters'].get('flr_local_reads', 0)})")
+
+    # FOLLOWER-READ SCALE ROW (the ROADMAP read scale-out target):
+    # leader-only vs spread GETs under a per-replica read
+    # service-capacity gate (APUS_READ_SVC_US) — on this one-core box
+    # every replica timeshares one core, so raw aggregate throughput
+    # cannot exceed ~1x no matter where reads are served; the gate
+    # emulates the multi-core deployment the architecture targets
+    # (each replica owning a core's worth of read service), identically
+    # for both rows, exactly like the emulated-RTT pair above emulates
+    # a remote link.  The raw (ungated) pair is reported alongside.
+    svc_ms = float(os.environ.get("APUS_TPUT_SVC_MS", "1.0"))
+    if svc_ms > 0:
+        os.environ["APUS_READ_SVC_US"] = str(int(svc_ms * 1000))
+        try:
+            with LocalCluster(R, spec=dataclasses.replace(
+                    base_spec)) as c:
+                run_variant(c, "gets_leader_svc", pipelined=True,
+                            reads=True)
+                gs = run_variant(c, "gets_follower_svc",
+                                 pipelined=True, reads=True,
+                                 read_policy="spread")
+                _mark(f"    (flr_local_reads "
+                      f"+{gs['counters'].get('flr_local_reads', 0)})")
+        finally:
+            os.environ.pop("APUS_READ_SVC_US", None)
 
     with LocalCluster(R, spec=dataclasses.replace(
             base_spec, max_batch=1)) as c:
@@ -828,6 +880,21 @@ def _bench_throughput() -> None:
             "lease_gain": round(
                 (ops("gets_lease") or 0.0)
                 / (ops("gets_readindex") or 1.0), 2),
+            # Follower-read scale-out (ROADMAP: 3-replica GETs >= 2.5x
+            # leader-only).  The _svc pair runs under the per-replica
+            # read service gate (emulated_read_svc_ms, identical for
+            # both rows — see note); the _raw follower row shows the
+            # ungated single-core reality alongside.
+            "gets_follower_raw_ops_per_sec": ops("gets_follower_raw"),
+            "gets_leader_svc_ops_per_sec": ops("gets_leader_svc"),
+            "gets_follower_svc_ops_per_sec": ops("gets_follower_svc"),
+            "emulated_read_svc_ms": svc_ms,
+            "follower_read_gain": round(
+                (ops("gets_follower_svc") or 0.0)
+                / (ops("gets_leader_svc") or 1.0), 2),
+            "follower_read_gain_raw": round(
+                (ops("gets_follower_raw") or 0.0)
+                / (ops("gets_lease") or 1.0), 2),
             "variants": results,
             # Every SET is one log entry here: entries/sec == ops/sec.
             "entries_per_sec": piped_raw,
@@ -838,7 +905,18 @@ def _bench_throughput() -> None:
                      "on this 1-core box raw-loopback serial is "
                      "CPU-bound, not roundtrip-bound, so the raw ratio "
                      "understates the pipelining win remote clients "
-                     "see."),
+                     "see.  gets_*_svc rows gate read service at "
+                     "emulated_read_svc_ms per read PER REPLICA "
+                     "(APUS_READ_SVC_US, identical gate both rows): "
+                     "all replicas timeshare this box's one core, so "
+                     "ungated aggregate read throughput is core-bound "
+                     "wherever reads are served — the gate emulates "
+                     "the multi-core deployment where each replica "
+                     "owns a core, which is the regime the follower-"
+                     "read architecture targets; follower_read_gain "
+                     "is the 3-replica-spread vs leader-only ratio "
+                     "under that gate, follower_read_gain_raw the "
+                     "ungated single-core one."),
         },
     }
     print(json.dumps(result), flush=True)

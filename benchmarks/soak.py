@@ -149,6 +149,24 @@ def main() -> int:
                     help="print a [obs] metrics-delta line (leader "
                          "OP_METRICS counter increments) every N "
                          "seconds; 0 disables")
+    ap.add_argument("--kv", action="store_true",
+                    help="bare DARE-mode soak: no app/interposer — the "
+                         "SET/GET stream runs through ApusClient "
+                         "against the daemons' KVS plane (the shape "
+                         "the fuzz campaigns drive), so daemon-plane "
+                         "linearizable reads are first-class; implied "
+                         "by --read-local (the bridged relay SM has "
+                         "no query path)")
+    ap.add_argument("--read-local", action="store_true",
+                    help="run a SIDE stream of follower-lease GETs "
+                         "(ApusClient read_policy='spread': reads "
+                         "rotate across ALL replicas and are served "
+                         "from their local applied state under "
+                         "commit-index-bounded leases) with occasional "
+                         "PUTs, for the whole soak; composes with "
+                         "--audit — the side stream records into the "
+                         "same history, so the final linearizability "
+                         "verdict covers every follower-served read")
     ap.add_argument("--audit", action="store_true",
                     help="record every SET/GET of the soak stream as a "
                          "timed history (apus_tpu.audit.HistoryRecorder"
@@ -162,7 +180,22 @@ def main() -> int:
     from apus_tpu.runtime.appcluster import RespClient, LineClient
     from apus_tpu.runtime.proc import ProcCluster
 
-    if args.toyserver:
+    if args.read_local:
+        args.kv = True          # follower reads need a queryable SM
+    if args.kv:
+        # Bare DARE mode: the soak stream is ApusClient over the
+        # daemons' peer ports (KVS SM); GET-after-SET rides the
+        # linearizable read path (leader lease, or a follower lease
+        # when the connection lands on a follower).
+        from apus_tpu.runtime.client import ApusClient
+        app_argv = None
+        mk = lambda addr: ApusClient(  # noqa: E731
+            ["%s:%d" % addr], timeout=15.0)
+        do_set = lambda c, k, v: (  # noqa: E731
+            c.put(k.encode(), v.encode()) == b"OK")
+        do_get = lambda c, k: (  # noqa: E731
+            lambda r: r.decode() if r else None)(c.get(k.encode()))
+    elif args.toyserver:
         app_argv = "toyserver"
         mk = lambda addr: LineClient(addr, timeout=15.0)  # noqa: E731
         do_set = lambda c, k, v: c.cmd(f"SET {k} {v}") == "OK"  # noqa: E731
@@ -275,6 +308,14 @@ def main() -> int:
                      spec=mesh_spec, device_plane=args.mesh,
                      tick_interval=args.tick_interval) as pc:
         leader = pc.leader_idx()
+
+        def conn_addr(i):
+            """Client endpoint of replica i: the app port (bridged
+            soak) or the daemon's peer port (--kv DARE mode)."""
+            if args.kv:
+                host, port = pc.spec.peers[i].rsplit(":", 1)
+                return (host, int(port))
+            return pc.app_addr(i)
         if args.state_size > 0:
             # Pre-populate replicated state via the daemons' client
             # plane (the relay SM appends every record to its dump, so
@@ -289,7 +330,44 @@ def main() -> int:
                          for i in range(lo, min(lo + 16, nkeys))])
             print(f"pre-populated ~{nkeys * len(val)} bytes of state",
                   file=sys.stderr)
-        client = mk(pc.app_addr(leader))
+        client = mk(conn_addr(leader))
+
+        # --read-local: follower-lease GET side stream (its reads ride
+        # the same recorder as the main stream when --audit is on, so
+        # the end-of-run linearizability verdict covers them).
+        import threading as _threading
+        rl_stop = _threading.Event()
+        rl_thread = None
+        rl_stats = {"reads": 0, "writes": 0, "errors": 0}
+        if args.read_local:
+            from apus_tpu.runtime.client import ApusClient
+
+            def _read_local_stream():
+                import random as _r
+                rng = _r.Random((args.fault_seed or 0) ^ 0x51EE)
+                keys = [b"rl%d" % i for i in range(8)]
+                n = 0
+                with ApusClient(list(pc.spec.peers), timeout=6.0,
+                                attempt_timeout=1.0,
+                                history=audit_rec,
+                                read_policy="spread") as c:
+                    while not rl_stop.is_set():
+                        try:
+                            if rng.random() < 0.15:
+                                n += 1
+                                c.put(rng.choice(keys), b"rv%d" % n)
+                                rl_stats["writes"] += 1
+                            else:
+                                c.get(rng.choice(keys))
+                                rl_stats["reads"] += 1
+                        except (TimeoutError, RuntimeError, OSError,
+                                ConnectionError):
+                            rl_stats["errors"] += 1
+                            time.sleep(0.1)
+
+            rl_thread = _threading.Thread(target=_read_local_stream,
+                                          daemon=True)
+            rl_thread.start()
 
         def mesh_check():
             """Track the mesh plane's device-owned commit high-water
@@ -379,6 +457,10 @@ def main() -> int:
         pipe_windows = 0
 
         def do_pipeline_set(c, kvs) -> bool:
+            if args.kv:
+                rs = c.pipeline_puts([(k.encode(), v.encode())
+                                      for k, v in kvs])
+                return all(r == b"OK" for r in rs)
             if args.toyserver:
                 rs = c.pipeline_cmds([f"SET {k} {v}" for k, v in kvs])
             else:
@@ -458,7 +540,7 @@ def main() -> int:
                               file=sys.stderr)
                     try:
                         leader = _find_leader_slot(pc)
-                        client = mk(pc.app_addr(leader))
+                        client = mk(conn_addr(leader))
                     except Exception:            # noqa: BLE001
                         pass
                 next_churn = now + args.churn_every
@@ -479,7 +561,7 @@ def main() -> int:
                                 if pc.procs[i] is None)
                     pc.restart(dead)
                     leader = _find_leader_slot(pc)
-                    client = mk(pc.app_addr(leader))
+                    client = mk(conn_addr(leader))
                 next_failover = now + args.failover_every
             # Bounded keyspace (4000 < toyserver's fixed 4096-slot
             # table, native/toyserver.c MAX_KEYS), seq-unique values:
@@ -561,7 +643,7 @@ def main() -> int:
                     # harmless — the misdirection gate refuses it and
                     # we land back here.
                     leader = _find_leader_slot(pc)
-                    client = mk(pc.app_addr(leader))
+                    client = mk(conn_addr(leader))
                 except Exception:        # noqa: BLE001
                     time.sleep(0.5)
             if seq % 200 == 0:
@@ -585,6 +667,9 @@ def main() -> int:
         mesh_interval_close()
         wall = time.monotonic() - t0
         client.close()
+        if rl_thread is not None:
+            rl_stop.set()
+            rl_thread.join(timeout=10.0)
         # Traffic ran with the misdirection gate at the PRODUCTION
         # posture (non-leaders REFUSE client bytes — misdirected can
         # only ever count leadership moves the gate itself already
@@ -608,7 +693,7 @@ def main() -> int:
             deadline = time.monotonic() + args.converge_timeout
             while True:
                 try:
-                    with mk(pc.app_addr(i)) as c:
+                    with mk(conn_addr(i)) as c:
                         if do_get(c, wk) == wv:
                             ok = True
                             break
@@ -628,12 +713,17 @@ def main() -> int:
             "delta_snapshots", "delta_installs",
             "snapshots_pushed", "snapshots_installed")}
         compaction_floors: dict[int, int] = {}
+        flr_summary = {k: 0 for k in (
+            "flr_grants", "flr_local_reads", "flr_forwards",
+            "flr_lapses", "flr_pause_lapses")}
         for i in range(len(pc.procs)):
             if pc.procs[i] is None:
                 continue
             st = pc.status(i, timeout=1.0) or {}
             for f in snap_summary:
                 snap_summary[f] += st.get(f, 0) or 0
+            for f in flr_summary:
+                flr_summary[f] += st.get(f, 0) or 0
             compaction_floors[i] = st.get("compaction_floor", 0)
         # Black-box sweep before teardown: an audit failure below
         # ships every replica's flight/span rings with the verdict.
@@ -671,10 +761,14 @@ def main() -> int:
     audit_detail = None
     audit_ok = True
     if audit_rec is not None:
-        from apus_tpu.audit import check_history
+        from apus_tpu.audit import check_history, resolve_undecided
         res = check_history(audit_rec.events())
-        audit_ok = res.ok and not res.undecided \
-            and audit_rec.dropped == 0
+        if res.undecided:
+            # Search-budget exhaustion is a missing verdict, not a
+            # violation: retry the undecided keys with a raised budget
+            # offline; only a REAL violation fails the soak.
+            res = resolve_undecided(audit_rec.events(), res)
+        audit_ok = res.ok and audit_rec.dropped == 0
         audit_detail = {"ops_checked": res.ops_checked,
                         "keys": res.keys,
                         "violations": len(res.violations),
@@ -706,7 +800,8 @@ def main() -> int:
             "failover_ms": [round(v, 1) for v in failover_ms],
             "peak_rss_kb": peak_rss,
             "converged": converged,
-            "app": "toyserver" if args.toyserver else "redis",
+            "app": ("kv" if args.kv else
+                    "toyserver" if args.toyserver else "redis"),
             "replicas": args.replicas,
             **({"pipeline_window": PIPE_W,
                 "pipeline_windows": pipe_windows}
@@ -724,6 +819,8 @@ def main() -> int:
                                    "compaction_floors":
                                        compaction_floors,
                                    "state_size": args.state_size},
+            **({"read_local": {**rl_stats, **flr_summary}}
+               if args.read_local else {}),
             "obs_health": {"flags": health_flags,
                            "bad": health_bad},
             **({"audit": audit_detail}
@@ -753,6 +850,7 @@ def main() -> int:
               + (" --mesh" if args.mesh else "")
               + (" --toyserver" if args.toyserver else "")
               + (" --audit" if args.audit else "")
+              + (" --read-local" if args.read_local else "")
               + (f" --churn --churn-every {args.churn_every}"
                  if args.churn else "")
               + (f" --state-size {args.state_size}"

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Clock-hygiene lint (tier-1 gate, ISSUE 9).
+
+PR 3's review found one stale-clock lease check by hand; this lint
+makes the whole clock-domain discipline mechanical.  The contract:
+
+1. ``apus_tpu/core/node.py`` never reads a raw wall/monotonic clock —
+   the protocol core gets time through ``tick(now)`` and the installed
+   ``self.clock`` seam (``_fresh_now``), so the adversarial-time
+   nemesis (utils/clock.SkewClock) skews EVERYTHING coherently.  A
+   deliberate real-clock read (device-plane liveness stamps, which are
+   compared against other real-clock reads) must carry a
+   ``clock-exempt`` marker in a comment on or just above the line.
+2. The known lease-critical stamp sites OUTSIDE the core stay on the
+   seam: the peer server's heartbeat-delivery stamp goes through
+   ``node._fresh_now()``, the transport's reply-echo stamps go through
+   its daemon-installed ``self.clock``, and the daemon ticks the node
+   from ``self.clock()`` (never ``time.monotonic()``), including the
+   cold-start heartbeat grace and the exclusion watchdog's hb-age.
+
+Exit 0 clean; exit 1 with the drift list otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RAW = re.compile(r"time\.(monotonic|time)\s*\(")
+_EXEMPT = "clock-exempt"
+
+
+def lint_node_py(errors: list[str]) -> None:
+    path = os.path.join(REPO, "apus_tpu/core/node.py")
+    lines = open(path).read().splitlines()
+    window: list[str] = []
+    for i, line in enumerate(lines, 1):
+        # A marker anywhere in the preceding comment block (up to 8
+        # lines) or on the line itself exempts the read.
+        window.append(line)
+        if len(window) > 8:
+            window.pop(0)
+        if _RAW.search(line) and not line.lstrip().startswith("#"):
+            if not any(_EXEMPT in w for w in window):
+                errors.append(
+                    f"apus_tpu/core/node.py:{i}: raw {_RAW.search(line).group(0)}) "
+                    f"in the protocol core — read time through tick(now) "
+                    f"or self.clock/_fresh_now (or mark a deliberate "
+                    f"real-clock read with a '{_EXEMPT}: <why>' comment)")
+
+
+#: (file, required substring, what it pins)
+_PINS = [
+    ("apus_tpu/parallel/net.py",
+     "node._fresh_now()",
+     "PeerServer heartbeat-delivery stamp must go through the node's "
+     "clock seam (lease no-vote window anchoring)"),
+    ("apus_tpu/parallel/net.py",
+     "self.clock())",
+     "NetTransport reply-echo stamps (peer_sid_seen) must use the "
+     "daemon-installed clock (lease renewal round comparison)"),
+    ("apus_tpu/runtime/daemon.py",
+     "self.node.tick(self.clock())",
+     "the daemon must tick the node from its SkewClock seam"),
+    ("apus_tpu/runtime/daemon.py",
+     "self.node.clock = self.clock",
+     "the daemon must install its SkewClock as the node's fresh clock"),
+    ("apus_tpu/runtime/daemon.py",
+     "self.node._last_hb_seen = (self.clock()",
+     "the cold-start heartbeat grace must be stamped from the daemon "
+     "clock (same domain as delivery stamps)"),
+]
+
+
+def lint_pins(errors: list[str]) -> None:
+    for rel, needle, why in _PINS:
+        src = open(os.path.join(REPO, rel)).read()
+        if needle not in src:
+            errors.append(f"{rel}: missing {needle!r} — {why}")
+
+
+def main() -> int:
+    errors: list[str] = []
+    lint_node_py(errors)
+    lint_pins(errors)
+    if errors:
+        print(f"check_clock: {len(errors)} clock-domain error(s)",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print("check_clock: OK (protocol core clock-pure; lease-critical "
+          "stamp sites pinned to the SkewClock seam)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

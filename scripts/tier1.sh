@@ -20,6 +20,9 @@ fi
 echo "== metrics-consistency lint =="
 python scripts/check_metrics.py || exit $?
 
+echo "== clock-hygiene lint (lease/failure-detector clock domains) =="
+python scripts/check_clock.py || exit $?
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -62,3 +62,9 @@ def pytest_configure(config):
         "resumable catch-up, delta snapshots, compacting store; the "
         "slow ladder e2e carries slow too (out of tier-1); "
         "selectable with -m largestate")
+    config.addinivalue_line(
+        "markers",
+        "flr: follower-read-lease suite — linearizable local reads at "
+        "followers, lease grant/invalidation rules, the adversarial-"
+        "time nemesis (pause/skew), and the planted-stale-lease "
+        "harness; selectable with -m flr")

