@@ -422,6 +422,21 @@ class Node:
         #: deterministic sim — follower leases then never engage.
         self.lease_requester = None
 
+        # -- multi-group (Multi-Raft) seams --------------------------------
+        # Consensus-group id of this node within its daemon (0 = the
+        # primary group; purely informational for logging/obs — the
+        # protocol itself is group-oblivious, the runtime demuxes).
+        self.gid = 0
+        # Coalesced-heartbeat sink, installed by the multi-group
+        # runtime (runtime/groupset.py): when set, _send_heartbeats
+        # REGISTERS this group's round with the daemon-level coalescer
+        # — one OP_HB_MULTI frame per peer then carries every group's
+        # (term, commit, lease) vector, and the coalescer calls back
+        # into hb_round_finish with the per-peer results.  None (the
+        # default, and always on single-group daemons and the sim)
+        # keeps the direct per-peer ctrl-write fan-out below.
+        self.hb_sink = None
+
         # stats (observability, §5.5): a dict-compatible view over a
         # metrics registry (apus_tpu.obs.metrics) — private by default;
         # the daemon swaps in its shared ObsHub registry via attach_obs
@@ -927,6 +942,31 @@ class Node:
             self.last_join_refusal = "config_in_flight"
             return None
         if want_slot is not None:
+            if want_slot == self.cid.size \
+                    and not self.cid.contains(want_slot):
+                # Slot affinity for a slot this group hasn't grown to
+                # yet: a multi-group joiner holds group 0's assignment
+                # and every other group must admit at the SAME slot —
+                # when that slot is exactly the next one, run the same
+                # STABLE -> EXTENDED upsize ladder the unsolicited
+                # join takes, pinned to it.
+                if self.cid.state != CidState.STABLE:
+                    self.last_join_refusal = "mid_resize"
+                    return None
+                if self.cid.size >= MAX_SERVER_COUNT:
+                    self.last_join_refusal = "capacity"
+                    return None
+                if self.log.near_full(1):
+                    self.last_join_refusal = "log_full"
+                    return None
+                new_cid = self.cid.extend(
+                    self.cid.size + 1).with_server(want_slot)
+                pj = PendingJoin(addr=addr, slot=want_slot)
+                pj.entry_idx = self.log.append(
+                    self.sid.sid.term, type=EntryType.CONFIG,
+                    cid=new_cid, data=f"{want_slot} {addr}".encode())
+                self._pending_joins[addr] = pj
+                return pj
             if not (0 <= want_slot < self.cid.size):
                 self.last_join_refusal = "slot_out_of_range"
                 return None
@@ -2281,35 +2321,65 @@ class Node:
         having stamped its _last_hb_seen at delivery — extends the
         lease to t0 + hb_timeout*(1 - lease_margin), anchored at the
         round's START so the wire time is never credited."""
+        if self.hb_sink is not None:
+            # Multi-group runtime: register with the daemon's HB
+            # coalescer; ONE OP_HB_MULTI frame per peer will carry
+            # every registered group, and hb_round_finish is called
+            # back per group with the per-peer results.
+            self.hb_sink(self, my, now)
+            return
         t0 = now
-        mask = 1 << self.idx
         # Reply-time SID echoes recorded by the transport per peer
         # ((sid_word, monotonic) — NetTransport.peer_sid_seen); absent
         # on transports that don't echo (the deterministic sim), where
         # multi-member leases simply never engage.
         hints = getattr(self.t, "peer_sid_seen", None)
-        fenced = 0
+        results: dict[int, tuple] = {}
         for peer in self._replication_targets():
             res = self.t.ctrl_write(peer, Region.HB, self.idx, my.word)
             if res == WriteResult.FENCED:
                 # The peer's fence table says our slot's incarnation
                 # was removed (incarnation fencing): affirmative
-                # removal evidence, counted below.
-                fenced += 1
+                # removal evidence, counted in hb_round_finish.
+                results[peer] = ("fenced", None)
                 continue
             if res != WriteResult.OK:
-                self._note_failure(peer, now)
+                results[peer] = ("fail", None)
+                continue
+            echo = None
+            if hints is not None:
+                seen = hints.get(peer)
+                if seen is not None and seen[1] >= t0:
+                    echo = seen[0]
+            results[peer] = ("ok", echo)
+        self.hb_round_finish(my, t0, results)
+
+    def hb_round_finish(self, my: Sid, t0: float,
+                        results: dict[int, tuple]) -> None:
+        """Account one heartbeat round — direct fan-out and coalesced
+        (OP_HB_MULTI) alike.  ``results[peer] = (status, echo_word)``
+        with status in {"ok", "fenced", "fail"}; ``echo_word`` is the
+        peer's reply-time SID from THIS round (None = no echo — the
+        peer never counts toward the lease quorum).  Runs under the
+        node lock; the wire work already happened (and yielded the
+        lock), so leadership is re-validated before the lease renews."""
+        mask = 1 << self.idx
+        fenced = 0
+        for peer, (status, echo) in results.items():
+            if status == "fenced":
+                fenced += 1
+                continue
+            if status != "ok":
+                self._note_failure(peer, t0)
                 continue
             # A reachable peer is not failing: reset the counter so
             # sporadic drops (async dial, transient congestion) far
             # apart never accumulate to PERMANENT_FAILURE.
             self._fail_count[peer] = 0
-            if hints is not None:
-                seen = hints.get(peer)
-                if seen is not None and seen[1] >= t0 \
-                        and Sid.unpack(seen[0]).term <= my.term:
-                    mask |= 1 << peer
+            if echo is not None and Sid.unpack(echo).term <= my.term:
+                mask |= 1 << peer
         self.bump("hb_sent")
+        now = t0
         if fenced >= quorum_size(self.cid.size):
             # A quorum of peers affirms our slot was removed at an
             # epoch past our incarnation — we are a zombie ex-leader

@@ -62,6 +62,8 @@ COUNTERS: dict[str, str] = {
     "node_delta_installs": "delta snapshots installed",
     "node_delta_refused": "delta installs refused on a base mismatch",
     "node_devplane_commits": "commit advances adopted from the device quorum",
+    # Multi-group sharded consensus (runtime/groupset.py).
+    "node_hb_coalesced_groups": "groups carried by coalesced OP_HB_MULTI flushes",
     "node_devplane_own_flips": "device-plane commit ownership flips (own/release)",
     "node_nack_ranges_dropped": "proxy NACK ranges dropped by the bridge",
     "node_proxy_spin_timeouts": "proxy spin-wait timeouts observed",
@@ -98,6 +100,8 @@ COUNTERS: dict[str, str] = {
     "dev_deep_dispatches": "deep-rung (>= DEEP_DEPTH) window dispatches",
     "dev_early_exits": "windowed dispatches cut short by device-side early exit",
     "dev_recompiles": "post-warmup XLA recompiles on live executables",
+    # Group-major dispatch (runtime/group_plane.py).
+    "dev_group_major_windows": "group-major device dispatches (many groups per window)",
 }
 
 GAUGES: dict[str, str] = {
@@ -119,6 +123,7 @@ GAUGES: dict[str, str] = {
     "devd_qfail_timeouts": "quorum-fail streak timeouts (dispatch paused)",
     "devd_async_windows": "deep windows enqueued without blocking",
     "devd_partial_deferrals": "partial windows deferred for queued admissions",
+    "devd_group_windows": "per-group windows carried by this daemon's group-major dispatches",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -139,6 +144,7 @@ HISTOGRAMS: dict[str, str] = {
     "dev_window_depth": "requested rounds per window dispatch",
     "dev_window_rounds_run": "rounds actually executed per resolved window",
     "dev_staging_wait_us": "HostStagingRing acquire consumer-edge block",
+    "dev_groups_per_dispatch": "consensus groups carried per group-major dispatch",
 }
 
 CATALOG: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

@@ -38,6 +38,12 @@ def _refresh_daemon_gauges(daemon) -> None:
         getattr(p, "compaction_floor", 0) if p else 0)
     g("daemon_store_records_since_base").set(
         getattr(p, "entries_since_base", 0) if p else 0)
+    # Multi-group dimension: per-group namespaced gauges
+    # (``nodeg<gid>_*`` — term/commit/apply/end/is_leader/epoch per
+    # consensus group), mirrored at scrape time like everything here.
+    gs = getattr(daemon, "groupset", None)
+    if gs is not None:
+        gs.scrape_gauges(hub.registry)
     # Device-plane driver stats (per-daemon dict) mirrored as devd_*
     # gauges — the driver's half of the device telemetry; the runner's
     # half (dev_*) is merged from its own registry by _merged_snapshot.
@@ -45,7 +51,7 @@ def _refresh_daemon_gauges(daemon) -> None:
     if drv is not None:
         for k in ("rounds", "drained", "holes", "fallbacks",
                   "quorum_gated", "qfail_timeouts", "async_windows",
-                  "partial_deferrals"):
+                  "partial_deferrals", "group_windows"):
             g(f"devd_{k}").set(drv.stats.get(k, 0))
 
 

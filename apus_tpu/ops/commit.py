@@ -722,6 +722,165 @@ def build_windowed_commit_step(mesh: Mesh, n_replicas: int, n_slots: int,
     return step
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GroupCommitControl:
+    """Per-group control vectors for ONE group-major dispatch
+    (Multi-Raft): element g of every field is group g's CommitControl
+    scalar, plus ``rounds[g]`` — how many of the window's staged rounds
+    that group actually runs this dispatch (its PER-GROUP EARLY-EXIT
+    mask: rounds beyond it write nothing and vote nothing for that
+    group, so groups with shallow backlogs ride the same dispatch as
+    deep ones without paying their rounds)."""
+
+    leader: jax.Array    # [G] i32
+    term: jax.Array      # [G] i32
+    end0: jax.Array      # [G] i32
+    rounds: jax.Array    # [G] i32  (0 = group inactive this dispatch)
+    mask_old: jax.Array  # [G, R] i32
+    mask_new: jax.Array  # [G, R] i32
+    q_old: jax.Array     # [G] i32
+    q_new: jax.Array     # [G] i32
+
+
+def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
+                            n_slots: int, slot_bytes: int, batch: int,
+                            max_depth: int):
+    """GROUP-MAJOR windowed commit: ONE XLA program replicates, fences,
+    votes, and advances commit for up to ``max_depth`` rounds of up to
+    ``n_groups`` consensus groups' windows — the dispatch-amortization
+    axis the Multi-Raft design adds on top of the round axis.  A
+    single-group deployment amortizes ROUNDS per dispatch (the windowed
+    engine above); this step amortizes GROUPS x rounds: one leader
+    broadcast pmax, one ack all_gather, and one vectorized dual-majority
+    vote cover every group per round, so device throughput scales with
+    group count instead of drowning in per-dispatch overhead.
+
+    Semantics per (group, round) are exactly ``_commit_body``'s,
+    vectorized over the leading group axis (each group has its OWN
+    leader, term, end0, membership masks, and quorum thresholds —
+    different groups may have different leaders on different shards of
+    the same dispatch).  ``ctrl.rounds[g]`` masks group g out of rounds
+    it did not stage (its early-exit mask): an inactive (group, round)
+    writes into scratch and reports commit 0.
+
+    Returns ``step(gdevlog, staged_data [MD,G,R,B,SB] u8, staged_meta
+    [MD,G,R,B,4] i32, ctrl: GroupCommitControl) -> (gdevlog',
+    commits [MD,G] i32)`` where ``commits[i, g]`` is group g's global
+    commit index after round i (0 for rounds past ``rounds[g]``).
+    The input devlog is donated (in-place HBM update)."""
+    _check_geometry(mesh, n_replicas, n_slots, batch)
+    G, MD, B, S = n_groups, max_depth, batch, n_slots
+
+    def pipe(log_data, log_meta, offs, fence, sdata, smeta, ctrl):
+        _g, K, rows, SB = log_data.shape
+        a = lax.axis_index(REPLICA_AXIS)
+        rid = a * K + jnp.arange(K, dtype=jnp.int32)        # [K]
+        is_leader = rid[None, :] == ctrl.leader[:, None]    # [G,K]
+        member_any = (ctrl.mask_old | ctrl.mask_new) == 1   # [G,R]
+
+        def one(carry, i):
+            log_data, log_meta, offs, fence, end0 = carry
+            bd = lax.dynamic_index_in_dim(sdata, i, axis=0,
+                                          keepdims=False)  # [G,K,B,SB]
+            bm = lax.dynamic_index_in_dim(smeta, i, axis=0,
+                                          keepdims=False)  # [G,K,B,4]
+            # (1) leader->all broadcast per group (non-leader rows are
+            # zero by the host staging contract, payloads unsigned):
+            # one max-reduce over the shard block + one pmax covers
+            # EVERY group.
+            bcast_d = lax.pmax(jnp.max(bd, axis=1), REPLICA_AXIS)
+            bcast_m = lax.pmax(jnp.max(bm, axis=1), REPLICA_AXIS)
+            # (2) fence + contiguity + per-group round mask.
+            active = i < ctrl.rounds                        # [G]
+            fence_ok = ((fence[:, :, FENCE_GRANTED]
+                         == ctrl.leader[:, None])
+                        & (ctrl.term[:, None]
+                           >= fence[:, :, FENCE_TERM])) | is_leader
+            own_end = offs[:, :, OFF_END]                   # [G,K]
+            do_write = (fence_ok & (own_end == end0[:, None])
+                        & active[:, None])                  # [G,K]
+            # (3) slot writes: one contiguous span per (group, row);
+            # rejected/inactive writes land in the scratch rows.
+            span = (end0 - 1) % S                           # [G]
+            start = jnp.where(do_write, span[:, None], S)   # [G,K]
+            j = jnp.arange(B, dtype=jnp.int32)
+            entry_idx = end0[:, None] + j[None, :]          # [G,B]
+            fresh_meta = jnp.stack([
+                entry_idx,
+                jnp.broadcast_to(ctrl.term[:, None], (G, B)),
+                bcast_m[:, :, 0], bcast_m[:, :, 1],
+                bcast_m[:, :, 2], bcast_m[:, :, 3],
+            ], axis=-1)                                     # [G,B,6]
+            zero = jnp.int32(0)
+            for g in range(G):
+                for k in range(K):
+                    log_data = lax.dynamic_update_slice(
+                        log_data, bcast_d[g][None, None],
+                        (jnp.int32(g), jnp.int32(k), start[g, k], zero))
+                    log_meta = lax.dynamic_update_slice(
+                        log_meta, fresh_meta[g][None, None],
+                        (jnp.int32(g), jnp.int32(k), start[g, k], zero))
+            # (4) acks + per-group (dual-)majority quorum — ONE gather,
+            # one vectorized vote for all groups.
+            new_end = jnp.where(do_write, end0[:, None] + B, own_end)
+            acks = lax.all_gather(new_end, REPLICA_AXIS)    # [axis,G,K]
+            acks = jnp.moveaxis(acks, 0, 1).reshape(G, -1)  # [G,R]
+            leader_ack = end0 + B                           # [G]
+            cand = jnp.minimum(acks, leader_ack[:, None])   # [G,R]
+            ge = acks[:, None, :] >= cand[:, :, None]       # [G,R,R]
+            n_old = jnp.sum(ge * ctrl.mask_old[:, None, :], axis=2)
+            n_new = jnp.sum(ge * ctrl.mask_new[:, None, :], axis=2)
+            ok = (n_old >= ctrl.q_old[:, None]) \
+                & ((ctrl.q_new[:, None] == 0)
+                   | (n_new >= ctrl.q_new[:, None]))
+            commit_g = jnp.max(
+                jnp.where(ok & member_any, cand, 0), axis=1)  # [G]
+            commit_g = jnp.where(active, commit_g, 0)
+            # (5) advance offsets (same accepted-only clamp discipline
+            # as _commit_body, per group).
+            own_commit = offs[:, :, OFF_COMMIT]
+            new_commit = jnp.where(
+                do_write,
+                jnp.maximum(own_commit,
+                            jnp.minimum(commit_g[:, None], new_end)),
+                own_commit)
+            offs = offs.at[:, :, OFF_END].set(new_end)
+            offs = offs.at[:, :, OFF_COMMIT].set(new_commit)
+            end0 = end0 + B * active.astype(jnp.int32)
+            return (log_data, log_meta, offs, fence, end0), commit_g
+
+        (log_data, log_meta, offs, fence, _end0), commits = lax.scan(
+            one, (log_data, log_meta, offs, fence, ctrl.end0),
+            jnp.arange(MD, dtype=jnp.int32))
+        return log_data, log_meta, offs, fence, commits
+
+    sharded = P(None, REPLICA_AXIS)
+    staged = P(None, None, REPLICA_AXIS)
+    repl = P()
+    ctrl_specs = GroupCommitControl(*([repl] * 8))
+    fn = shard_map(
+        pipe, mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded, staged, staged,
+                  ctrl_specs),
+        out_specs=(sharded, sharded, sharded, sharded, repl))
+
+    from apus_tpu.ops.logplane import GroupDeviceLog
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(gdevlog: GroupDeviceLog, staged_data, staged_meta,
+             ctrl: GroupCommitControl):
+        assert gdevlog.data.shape == (G, n_replicas, n_slots + batch,
+                                      slot_bytes), gdevlog.data.shape
+        assert staged_data.shape[0] == MD
+        d, m, o, f, commits = fn(gdevlog.data, gdevlog.meta,
+                                 gdevlog.offs, gdevlog.fence,
+                                 staged_data, staged_meta, ctrl)
+        return GroupDeviceLog(d, m, o, f), commits
+
+    return step
+
+
 def place_batch(mesh: Mesh, n_replicas: int, leader: int,
                 batch_data_host: np.ndarray, batch_meta_host: np.ndarray):
     """Expand a host batch [B,SB]/[B,4] into leader-row-only arrays

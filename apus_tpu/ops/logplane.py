@@ -71,6 +71,53 @@ class DeviceLog:
         return self.data.shape[2]
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GroupDeviceLog:
+    """Group-major device-log state (Multi-Raft): every field carries a
+    leading GROUP axis over the per-replica layout of DeviceLog, so ONE
+    dispatch can replicate/vote/commit windows for MANY consensus
+    groups — the group-major axis the multi-group throughput design
+    amortizes dispatch overhead over.  Sharded on the replica axis
+    (axis 1); the group axis is replicated layout, not a mesh axis."""
+
+    data: jax.Array    # [G, R, S+B, SB] uint8
+    meta: jax.Array    # [G, R, S+B, 6] int32
+    offs: jax.Array    # [G, R, 4]      int32
+    fence: jax.Array   # [G, R, 2]      int32
+
+    @property
+    def n_groups(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_replicas(self) -> int:
+        return self.data.shape[1]
+
+
+def make_group_device_log(n_groups: int, n_replicas: int,
+                          n_slots: int, slot_bytes: int,
+                          batch: int, sharding=None) -> GroupDeviceLog:
+    """Fresh group-major logs: every group empty at index 1 with a
+    closed fence (granted_to -1 at term 0 — no writer admitted until
+    that group's first leadership reset rewrites its fence row)."""
+    if n_slots % batch != 0:
+        raise ValueError(f"n_slots ({n_slots}) must be a multiple of "
+                         f"the batch size ({batch})")
+    kw = {} if sharding is None else {"device": sharding}
+    rows = n_slots + batch
+    data = jnp.zeros((n_groups, n_replicas, rows, slot_bytes),
+                     jnp.uint8, **kw)
+    meta = jnp.zeros((n_groups, n_replicas, rows, META_COLS),
+                     jnp.int32, **kw)
+    offs = jnp.ones((n_groups, n_replicas, 4), jnp.int32, **kw)
+    fence = jnp.tile(jnp.array([-1, 0], jnp.int32),
+                     (n_groups, n_replicas, 1))
+    if sharding is not None:
+        fence = jax.device_put(fence, sharding)
+    return GroupDeviceLog(data=data, meta=meta, offs=offs, fence=fence)
+
+
 def slot_of(idx, n_slots: int):
     """Device slot of 1-based absolute log index ``idx``."""
     return (idx - 1) % n_slots

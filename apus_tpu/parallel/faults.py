@@ -415,12 +415,13 @@ class FaultPlane(Transport):
             return WriteResult.DROPPED
         return self.inner.snap_push_stream(target, *args, **kwargs)
 
-    def request(self, target: int, payload: bytes) -> Optional[bytes]:
+    def request(self, target: int, payload: bytes,
+                **kw) -> Optional[bytes]:
         if not self._pre(target):
             return None
-        resp = self.inner.request(target, payload)
+        resp = self.inner.request(target, payload, **kw)
         if self._dup_draw(target):
-            self.inner.request(target, payload)
+            self.inner.request(target, payload, **kw)
         return resp
 
     # -- non-op delegation (set_peer, close, peers, stats, ...) -----------

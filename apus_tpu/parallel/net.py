@@ -78,6 +78,11 @@ class PeerServer:
             self._sock.bind((host, port))
         self._sock.listen(64)
         self.addr = self._sock.getsockname()
+        #: Multi-group demux (runtime/groupset.py): gid -> GroupPort
+        #: (``.node`` + ``.extra_ops``) or None for unknown gids.  Left
+        #: None on single-group daemons — OP_GROUP / OP_HB_MULTI frames
+        #: then answer ST_ERROR and nothing else changes.
+        self.group_ref = None
         #: Optional pipelined-burst handler, installed by the daemon:
         #: called with a LIST of already-queued request frames, returns
         #: the reply payloads (same order) or None to decline — the
@@ -213,6 +218,26 @@ class PeerServer:
         r = wire.Reader(req)
         op = r.u8()
         try:
+            if op == wire.OP_GROUP:
+                # Multi-group demux: ``u8 gid`` then the inner frame,
+                # dispatched against that group's node/handlers (one
+                # PeerServer ingest loop serves every group).
+                if self.group_ref is None:
+                    return wire.u8(wire.ST_ERROR)
+                gid = r.u8()
+                port = self.group_ref(gid)
+                if port is None:
+                    return wire.u8(wire.ST_ERROR)
+                op = r.u8()
+                if op in port.extra_ops:
+                    return port.extra_ops[op](r)
+                with self._lock:
+                    return self._apply(op, r, node=port.node)
+            if op == wire.OP_HB_MULTI:
+                if self.group_ref is None:
+                    return wire.u8(wire.ST_ERROR)
+                with self._lock:
+                    return self._hb_multi(r)
             if op in self._extra_ops:
                 return self._extra_ops[op](r)
             with self._lock:
@@ -227,8 +252,50 @@ class PeerServer:
                 traceback.print_exc()
             return wire.u8(wire.ST_ERROR)
 
-    def _apply(self, op: int, r: wire.Reader) -> bytes:
-        node = self._node_ref()
+    def _hb_multi(self, r: wire.Reader) -> bytes:
+        """Coalesced per-peer heartbeat (wire.OP_HB_MULTI): ONE frame
+        carries every group the sender leads.  Per item, semantics are
+        exactly the OP_CTRL_WRITE Region.HB path for that group's node
+        — incarnation fence, HB slot deposit, delivery-time
+        ``_last_hb_seen`` stamp.  The reply echoes each group's
+        CURRENT sid (lease-renewal evidence, per group).
+
+        The carried commit offset is OBSERVABILITY ONLY — it is never
+        adopted here.  Commit propagation stays on the per-group
+        log-write path, which only reaches ADJUSTED followers: a
+        follower holding a divergent unadjusted tail must never clamp
+        leader-commit against its own log end (advance_commit(min(
+        commit, end)) would mark stale entries committed — the classic
+        Raft last-NEW-entry rule).  The first multi-group churn
+        campaign (seed 26000) caught exactly that as a batch of stale
+        reads when an earlier revision adopted it."""
+        sender, items = wire.decode_hb_multi(r)
+        echoes = []
+        for gid, word, _commit, _lease_us, inc in items:
+            port = self.group_ref(gid)
+            if port is None:
+                echoes.append((wire.ST_ERROR, 0))
+                continue
+            node = port.node
+            if inc < node.fence_epochs.get(sender, 0):
+                node.bump("fenced_ctrl_writes")
+                echoes.append((wire.ST_FENCED, node.sid.word))
+                continue
+            onesided.apply_ctrl_write(node, Region.HB, sender, word)
+            s = Sid.unpack(word)
+            if s.leader and s.idx == sender \
+                    and s.term >= node.current_term:
+                # Delivery-time stamp, same clock seam as the
+                # OP_CTRL_WRITE HB path (lease-safety contract).
+                node._last_hb_seen = max(node._last_hb_seen,
+                                         node._fresh_now())
+                node.group_contact = True
+            echoes.append((wire.ST_OK, node.sid.word))
+        return wire.encode_hb_echoes(echoes)
+
+    def _apply(self, op: int, r: wire.Reader, node=None) -> bytes:
+        if node is None:
+            node = self._node_ref()
         if op == wire.OP_CTRL_WRITE:
             region = wire.REGION_LIST[r.u8()]
             slot = r.u8()
@@ -806,5 +873,80 @@ class NetTransport(Transport):
 
     # -- generic request (two-sided control messages: join, snapshots) ----
 
-    def request(self, target: int, payload: bytes) -> Optional[bytes]:
-        return self._roundtrip(target, payload)
+    def request(self, target: int, payload: bytes,
+                timeout: Optional[float] = None,
+                cap_s: float = 8.0) -> Optional[bytes]:
+        return self._roundtrip(target, payload, timeout=timeout,
+                               cap_s=cap_s)
+
+
+class GroupTransport(NetTransport):
+    """A per-group VIEW of a shared transport (Multi-Raft): every
+    outbound frame is wrapped ``OP_GROUP | gid`` and lands on the
+    receiver's same-gid node, while the sockets, dial/backoff state,
+    failure evidence, and (when armed) the fault plane are all the
+    SHARED inner transport's — one connection set serves every group.
+
+    Implementation: the op methods are inherited verbatim from
+    NetTransport (payload build + reply parse), but the single
+    ``_roundtrip`` choke point delegates to ``inner.request`` with the
+    group prefix — so when ``inner`` is a FaultPlane, group traffic is
+    attacked exactly like group-0 traffic.  Per-GROUP protocol state
+    (reply-echo sids for lease renewal, the group node's incarnation
+    stamp) lives here; everything connection-shaped delegates."""
+
+    def __init__(self, inner, gid: int):
+        # Deliberately NOT calling NetTransport.__init__: this view
+        # owns no sockets.  Only the attributes the inherited op
+        # methods read are bound here; connection state delegates.
+        self._inner = inner
+        self.gid = gid
+        self._prefix = wire.u8(wire.OP_GROUP) + wire.u8(gid)
+        self.peer_sid_seen = {}
+        self.incarnation_of = None
+        self.stats = getattr(inner, "stats",
+                             MetricsRegistry().view("net"))
+
+    # Shared-transport delegation.  ``clock``/``timeout``/``peers`` are
+    # read dynamically (the daemon installs its SkewClock on the RAW
+    # transport after construction; a copy here would miss it).  A
+    # FaultPlane inner forwards unknown attributes to the raw transport.
+    @property
+    def clock(self):
+        return self._inner.clock
+
+    @property
+    def timeout(self):
+        return self._inner.timeout
+
+    @property
+    def peers(self):
+        return self._inner.peers
+
+    def peer_established(self, target: int) -> bool:
+        return self._inner.peer_established(target)
+
+    def peer_failure_was_timeout(self, target: int) -> bool:
+        return self._inner.peer_failure_was_timeout(target)
+
+    def set_peer(self, idx: int, addr) -> None:
+        # The shared peer table is owned by the primary transport
+        # (group 0's config path updates it); per-group set_peer is a
+        # no-op so CONFIG applies in extra groups cannot double-reset
+        # the shared connection state.
+        pass
+
+    def close(self) -> None:
+        pass                      # the owner closes the shared transport
+
+    def _roundtrip(self, target: int, payload: bytes,
+                   timeout: Optional[float] = None,
+                   cap_s: float = 8.0) -> Optional[bytes]:
+        return self._inner.request(target, self._prefix + payload,
+                                   timeout=timeout, cap_s=cap_s)
+
+    def request(self, target: int, payload: bytes,
+                timeout: Optional[float] = None,
+                cap_s: float = 8.0) -> Optional[bytes]:
+        return self._inner.request(target, self._prefix + payload,
+                                   timeout=timeout, cap_s=cap_s)

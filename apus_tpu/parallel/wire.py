@@ -52,6 +52,61 @@ OP_SNAP_BEGIN = 10
 OP_SNAP_CHUNK = 11
 OP_SNAP_END = 12
 
+# -- multi-group (Multi-Raft) envelope ------------------------------------
+# OP_GROUP wraps any other op for a NON-ZERO consensus group sharing the
+# same daemon/socket set: ``u8 OP_GROUP | u8 gid | <inner frame>``.  The
+# receiver demuxes on gid to that group's node/handlers.  Group 0 (and
+# EVERYTHING when groups == 1) is never wrapped, so single-group wire
+# frames stay byte-identical to the pre-multi-group protocol.
+OP_GROUP = 25
+# OP_HB_MULTI: one coalesced heartbeat frame per peer carrying ALL
+# groups this daemon currently leads — the (term, commit, lease)
+# vector of the Multi-Raft design, replacing per-group HB ctrl writes:
+#   request: u8 op | u8 sender_slot | u8 n |
+#            n x (u8 gid | u64 sid_word | u64 commit | u32 lease_us
+#                 | u32 incarnation)
+#   reply:   u8 ST_OK | n x (u8 status | u64 echo_sid_word)
+# Per-item status is ST_OK / ST_FENCED (stale incarnation for that
+# group's fence table) / ST_ERROR (unknown gid); the echoed SID is the
+# receiver's CURRENT sid for that group — the per-group lease-renewal
+# evidence (same contract as the OP_CTRL_WRITE reply echo).
+OP_HB_MULTI = 26
+
+_HB_ITEM = struct.Struct("<BQQII")
+_HB_ECHO = struct.Struct("<BQ")
+
+
+def encode_hb_multi(sender: int, items: list) -> bytes:
+    """``items`` = [(gid, sid_word, commit, lease_us, incarnation)]."""
+    out = [bytes([OP_HB_MULTI, sender, len(items)])]
+    for gid, word, commit, lease_us, inc in items:
+        out.append(_HB_ITEM.pack(gid, word, commit, lease_us, inc))
+    return b"".join(out)
+
+
+def decode_hb_multi(r: "Reader") -> tuple[int, list]:
+    sender = r.u8()
+    n = r.u8()
+    items = [_HB_ITEM.unpack(r.take(_HB_ITEM.size)) for _ in range(n)]
+    return sender, items
+
+
+def encode_hb_echoes(echoes: list) -> bytes:
+    """``echoes`` = [(status, sid_word)] in request item order."""
+    return bytes([ST_OK]) + b"".join(_HB_ECHO.pack(s, w)
+                                     for s, w in echoes)
+
+
+def decode_hb_echoes(resp: bytes, n: int) -> Optional[list]:
+    """Parse a multi-HB reply into n (status, echo_word) pairs; None on
+    a malformed/short frame (treated as a wire drop by the sender)."""
+    if not resp or resp[0] != ST_OK \
+            or len(resp) < 1 + n * _HB_ECHO.size:
+        return None
+    return [_HB_ECHO.unpack_from(resp, 1 + i * _HB_ECHO.size)
+            for i in range(n)]
+
+
 #: SNAP_PUSH trailing-flags bit: the payload is a DELTA on top of the
 #: receiver's applied determinant (u64 base_idx + u64 base_term follow
 #: the flag byte); the receiver refuses unless its applied determinant

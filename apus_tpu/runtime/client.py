@@ -52,10 +52,28 @@ def _svc_emulate(daemon, n_reads: int) -> None:
             time.sleep(svc * n_reads)
 
 
-def make_client_ops(daemon) -> dict:
+def _wsvc_emulate(daemon, gid: int, n_writes: int) -> None:
+    """Per-GROUP write service-capacity emulation (bench.py --throughput
+    --groups): each admitted write holds its group's service gate for
+    APUS_WRITE_SVC_US microseconds at the leader, modeling a deployment
+    where every group's leader owns one core (the write-path sibling of
+    ``_svc_emulate``).  Gates are per gid, so different groups' service
+    runs in parallel — exactly the sharding the aggregate-throughput
+    claim is about.  Off (zero overhead) unless the bench armed it."""
+    svc = getattr(daemon, "write_svc", 0.0)
+    if svc and n_writes > 0:
+        gate = daemon._wsvc_gates.setdefault(gid, threading.Lock())
+        with gate:
+            time.sleep(svc * n_writes)
+
+
+def make_client_ops(daemon, node=None) -> dict:
     """Extra PeerServer ops for a ReplicaDaemon (runs on per-connection
     server threads; blocking a handler blocks only that client's
-    connection)."""
+    connection).  ``node`` binds the handlers to one consensus group's
+    node (multi-group daemons build one table per group, dispatched by
+    the OP_GROUP demux); None = the primary group."""
+    node = node if node is not None else daemon.node
 
     def clt_write(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
@@ -68,11 +86,11 @@ def make_client_ops(daemon) -> dict:
         with daemon.lock:
             if traced:
                 sp.stamp(clt_id, req_id, "lock")
-            pr = daemon.node.submit(req_id, clt_id, data)
+            pr = node.submit(req_id, clt_id, data)
             if traced:
                 sp.stamp(clt_id, req_id, "admit")
         if pr is None:
-            return _not_leader(daemon, req_id)
+            return _not_leader(daemon, req_id, node=node)
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
@@ -83,26 +101,28 @@ def make_client_ops(daemon) -> dict:
                     if traced:
                         sp.stamp(clt_id, req_id, "reply", idx=pr.idx)
                         sp.finish(clt_id, req_id)
-                    return (wire.u8(wire.ST_OK) + wire.u64(req_id)
-                            + wire.blob(pr.reply))
-                if not daemon.node.is_leader:
-                    return _not_leader(daemon, req_id)
+                    break
+                if not node.is_leader:
+                    return _not_leader(daemon, req_id, node=node)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
                 daemon.commit_cond.wait(min(left, 0.25))
+        _wsvc_emulate(daemon, node.gid, 1)
+        return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                + wire.blob(pr.reply))
 
     def clt_read(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
         data = r.blob()
         with daemon.lock:
-            rr = daemon.node.read(req_id, clt_id, data)
+            rr = node.read(req_id, clt_id, data)
             if rr is None:
                 # Not the leader: try the follower-lease local-read
                 # path (core/node.py follower_read) before bouncing.
-                rr = daemon.node.follower_read(req_id, clt_id, data)
+                rr = node.follower_read(req_id, clt_id, data)
         if rr is None:
-            return _not_leader(daemon, req_id)
+            return _not_leader(daemon, req_id, node=node)
         follower = getattr(rr, "flr", False)
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
@@ -114,9 +134,9 @@ def make_client_ops(daemon) -> dict:
                 if getattr(rr, "refused", False):
                     # Lease lapsed/invalidated under the parked read:
                     # typed bounce; the client retries at the leader.
-                    return _not_leader(daemon, req_id)
-                if not follower and not daemon.node.is_leader:
-                    return _not_leader(daemon, req_id)
+                    return _not_leader(daemon, req_id, node=node)
+                if not follower and not node.is_leader:
+                    return _not_leader(daemon, req_id, node=node)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
@@ -285,6 +305,13 @@ def make_client_ops(daemon) -> dict:
                                   if getattr(daemon, "persistence", None)
                                   is not None else None),
             }
+            # Multi-group (Multi-Raft) observability: per-group
+            # role/term/offsets/config so harnesses assert PER-GROUP
+            # convergence (different groups may have different
+            # leaders) over the wire instead of log-scraping.
+            st["n_groups"] = getattr(daemon, "n_groups", 1)
+            if getattr(daemon, "groupset", None) is not None:
+                st["groups"] = daemon.groupset.status_view()
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
             refusals = getattr(daemon, "misdirect_refusals", None)
@@ -358,13 +385,24 @@ def make_client_batch_hook(daemon):
     tuple covers log.end, so the append itself wakes us)."""
 
     def hook(frames: list[bytes]):
+        # Multi-group bursts: frames may arrive OP_GROUP-wrapped —
+        # each op carries its gid, admitted against ITS group's node.
+        # One lock acquisition and one commit-wait loop still cover
+        # the WHOLE burst, so the leader's group-commit drain
+        # amortizes across every group with queued ops.
         parsed = []
+        nodes = []
         for f in frames:
             r = wire.Reader(f)
             op = r.u8()
+            gid = 0
+            if op == wire.OP_GROUP:
+                gid = r.u8()
+                op = r.u8()
             if op not in (OP_CLT_WRITE, OP_CLT_READ):
                 return None
-            parsed.append((op, r.u64(), r.u64(), r.blob()))
+            parsed.append((op, r.u64(), r.u64(), r.blob(), gid))
+            nodes.append(daemon.group_node(gid))
         handles: list = [None] * len(parsed)
         registered = [False] * len(parsed)
         # Per-op stage spans (write ops, req_id-sampled): the whole
@@ -375,31 +413,38 @@ def make_client_batch_hook(daemon):
         traced: list[int] = []
         if sp is not None:
             t_ingest = sp.now()
-            for i, (op, rid, cid_, _d) in enumerate(parsed):
+            for i, (op, rid, cid_, _d, _g) in enumerate(parsed):
                 if op == OP_CLT_WRITE and sp.sampled(rid):
                     sp.stamp(cid_, rid, "ingest", t=t_ingest)
                     traced.append(i)
 
         def _register_read(i: int) -> None:
-            """Register read i once every preceding write of the burst
-            holds a log index (caller holds the node lock).  Usually
-            immediate; deferred only while the ring is full."""
+            """Register read i once every preceding SAME-GROUP write of
+            the burst holds a log index (caller holds the node lock).
+            Program order — and read-your-write — is a WITHIN-group
+            contract; cross-group ops interleave freely (each group is
+            an independent log).  Usually immediate; deferred only
+            while the ring is full."""
+            node = nodes[i]
+            if node is None:
+                registered[i] = True      # unknown gid: resolves ERROR
+                return
             floor = 0
             for j in range(i):
                 h = handles[j]
-                if parsed[j][0] != OP_CLT_WRITE or h is None:
+                if parsed[j][0] != OP_CLT_WRITE or h is None \
+                        or parsed[j][4] != parsed[i][4]:
                     continue        # reads don't gate; None -> not-leader
                 if h.idx is None:
                     return          # not in the log yet: retry on wake
                 floor = max(floor, h.idx + 1)
-            op, req_id, clt_id, data = parsed[i]
-            handles[i] = daemon.node.read(req_id, clt_id, data,
-                                          min_wait_idx=floor)
+            op, req_id, clt_id, data, _gid = parsed[i]
+            handles[i] = node.read(req_id, clt_id, data,
+                                   min_wait_idx=floor)
             if handles[i] is None:
                 # Not the leader: the follower-lease local-read path
                 # (burst writes all bounce NOT_LEADER; floor is 0).
-                handles[i] = daemon.node.follower_read(req_id, clt_id,
-                                                       data)
+                handles[i] = node.follower_read(req_id, clt_id, data)
             registered[i] = True
 
         with daemon.lock:
@@ -408,16 +453,22 @@ def make_client_batch_hook(daemon):
                 for i in traced:
                     sp.stamp(parsed[i][2], parsed[i][1], "lock",
                              t=t_lock)
-            for i, (op, req_id, clt_id, data) in enumerate(parsed):
-                if op == OP_CLT_WRITE:
-                    handles[i] = daemon.node.submit(req_id, clt_id, data)
+            flush_nodes = []
+            for i, (op, req_id, clt_id, data, _gid) in enumerate(parsed):
+                if op == OP_CLT_WRITE and nodes[i] is not None:
+                    handles[i] = nodes[i].submit(req_id, clt_id, data)
                     registered[i] = True
+                    if nodes[i] not in flush_nodes:
+                        flush_nodes.append(nodes[i])
+                elif op == OP_CLT_WRITE:
+                    registered[i] = True  # unknown gid: resolves ERROR
             if traced:
                 t_admit = sp.now()
                 for i in traced:
                     sp.stamp(parsed[i][2], parsed[i][1], "admit",
                              t=t_admit)
-            daemon.node.flush_pending()
+            for node in flush_nodes:
+                node.flush_pending()
             for i, (op, *_rest) in enumerate(parsed):
                 if op == OP_CLT_READ:
                     _register_read(i)
@@ -425,52 +476,79 @@ def make_client_batch_hook(daemon):
 
         def _resolve(i: int) -> bool:
             """Reply for op i if it is decided (under the lock)."""
-            op, req_id, _clt, _d = parsed[i]
+            op, req_id, _clt, _d, _gid = parsed[i]
+            node = nodes[i]
+            if node is None:
+                replies[i] = wire.u8(ST_ERROR) + wire.u64(req_id)
+                return True
             if not registered[i]:
+                if not node.is_leader:
+                    # Leadership moved before the read could register
+                    # (its gating write will bounce too).
+                    replies[i] = _not_leader(daemon, req_id, node=node)
+                    return True
                 _register_read(i)
                 if not registered[i]:
                     return False
             h = handles[i]
             if h is None:
-                replies[i] = _not_leader(daemon, req_id)
+                replies[i] = _not_leader(daemon, req_id, node=node)
                 return True
             if op == OP_CLT_WRITE:
                 # Reply-sentinel gate, exactly as the single-op path:
                 # apply position alone can be satisfied by a DIFFERENT
                 # entry after truncation.
-                if h.reply is None:
-                    return False
-                replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
-                              + wire.blob(h.reply))
-                if sp is not None and sp.sampled(req_id):
-                    # Reply built: close the span (folds the stage
-                    # durations into the registry histograms).
-                    sp.stamp(_clt, req_id, "reply", idx=h.idx)
-                    sp.finish(_clt, req_id)
-                return True
+                if h.reply is not None:
+                    replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                                  + wire.blob(h.reply))
+                    if sp is not None and sp.sampled(req_id):
+                        # Reply built: close the span (folds the stage
+                        # durations into the registry histograms).
+                        sp.stamp(_clt, req_id, "reply", idx=h.idx)
+                        sp.finish(_clt, req_id)
+                    return True
+                if not node.is_leader:
+                    replies[i] = _not_leader(daemon, req_id, node=node)
+                    return True
+                return False
             if getattr(h, "refused", False):
                 # Follower lease lapsed under the parked read.
-                replies[i] = _not_leader(daemon, req_id)
+                replies[i] = _not_leader(daemon, req_id, node=node)
                 return True
-            if not h.done:
-                return False
-            if h.error:
-                replies[i] = wire.u8(wire.ST_ERROR) + wire.u64(req_id)
-            else:
-                replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
-                              + wire.blob(h.reply or b""))
-            return True
+            if h.done:
+                if h.error:
+                    replies[i] = wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+                else:
+                    replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                                  + wire.blob(h.reply or b""))
+                return True
+            if not getattr(h, "flr", False) and not node.is_leader:
+                # Leader-path read stranded by a leadership move;
+                # follower-lease reads keep waiting (they resolve
+                # done/refused on the tick).
+                replies[i] = _not_leader(daemon, req_id, node=node)
+                return True
+            return False
 
         def _finish():
             # Service-capacity emulation covers every read the burst
-            # served locally (leader lease or follower lease alike);
-            # runs outside the lock, after the replies are built.
-            # Gated on the knob so unarmed runs pay nothing per burst.
+            # served locally (leader lease or follower lease alike)
+            # and — per group — every write it committed; runs outside
+            # the lock, after the replies are built.  Gated on the
+            # knobs so unarmed runs pay nothing per burst.
             if getattr(daemon, "read_svc", 0.0):
                 _svc_emulate(daemon, sum(
                     1 for i, (op, *_r) in enumerate(parsed)
                     if op == OP_CLT_READ and replies[i] is not None
                     and replies[i][:1] == wire.u8(wire.ST_OK)))
+            if getattr(daemon, "write_svc", 0.0):
+                per_gid: dict[int, int] = {}
+                for i, (op, _r, _c, _d, gid) in enumerate(parsed):
+                    if op == OP_CLT_WRITE and replies[i] is not None \
+                            and replies[i][:1] == wire.u8(wire.ST_OK):
+                        per_gid[gid] = per_gid.get(gid, 0) + 1
+                for gid, n in per_gid.items():
+                    _wsvc_emulate(daemon, gid, n)
             return replies
 
         deadline = time.monotonic() + daemon.client_op_timeout
@@ -480,20 +558,6 @@ def make_client_batch_hook(daemon):
                               if replies[i] is None and not _resolve(i)]
                 if not unresolved:
                     break
-                if not daemon.node.is_leader:
-                    # Leader-path ops bounce; follower-lease reads keep
-                    # waiting (they resolve done/refused on the tick —
-                    # this daemon is structurally not the leader).
-                    waiting = []
-                    for i in unresolved:
-                        h = handles[i]
-                        if h is not None and getattr(h, "flr", False):
-                            waiting.append(i)
-                        else:
-                            replies[i] = _not_leader(daemon,
-                                                     parsed[i][1])
-                    if not waiting:
-                        break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     for i in unresolved:
@@ -579,14 +643,18 @@ def find_leader(peers: list[str], timeout: float = 5.0,
     return None
 
 
-def _not_leader(daemon, req_id: Optional[int] = None) -> bytes:
+def _not_leader(daemon, req_id: Optional[int] = None,
+                node=None) -> bytes:
     """NOT_LEADER + the leader's address (not its index: the client's
     peer list may be partial or reordered, so an index is meaningless to
     it).  Empty hint = unknown.  Client ops (clt_write/clt_read) echo
     the request's ``req_id`` after the status byte — the client matches
     it to pair replies under transport-level duplication/reordering;
-    the JOIN op (no req_id) omits the echo."""
-    hint = daemon.leader_hint
+    the JOIN op (no req_id) omits the echo.  ``node`` selects the
+    consensus group whose leader is hinted (different groups may have
+    different leaders); None = the primary group."""
+    hint = (node.leader_hint if node is not None
+            else daemon.leader_hint)
     addr = b""
     if hint is not None and hint < len(daemon.spec.peers):
         addr = daemon.spec.peers[hint].encode()
@@ -609,8 +677,20 @@ class ApusClient:
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
                  timeout: float = 5.0, attempt_timeout: float = 2.0,
                  history=None, tracer=None,
-                 read_policy: str = "leader"):
+                 read_policy: str = "leader", groups: int = 1):
         self.peers = [self._parse(p) for p in peers]
+        #: Multi-group routing (Multi-Raft): KVS ops hash their key to
+        #: one of ``groups`` consensus groups (runtime/router.py) and
+        #: ride OP_GROUP-wrapped frames for gid > 0; pipelined bursts
+        #: split per group and run CONCURRENT per-group sub-pipelines
+        #: over per-(group, peer) connections, merged back in op order.
+        #: Per-group leader caches honor per-group NOT_LEADER hints —
+        #: different groups may have different leaders.  groups == 1
+        #: (default): the router is the identity, nothing is wrapped,
+        #: and every frame is byte-identical to the single-group
+        #: client.
+        self.groups = max(1, groups)
+        self._leaders: dict[int, Optional[int]] = {}
         #: Read routing: "leader" (default — every op chases the
         #: leader) or "spread" — GETs rotate across ALL replicas and
         #: are served from follower read leases where live
@@ -648,13 +728,16 @@ class ApusClient:
         #: dedup (epdb) makes it exactly-once wherever it lands.
         self.attempt_timeout = attempt_timeout
         self._req_seq = 0
-        self._leader: Optional[int] = None
-        self._conns: dict[int, socket.socket] = {}
+        # Connections/streams are keyed (gid, target): concurrent
+        # per-group sub-pipelines must never share a socket (frame
+        # interleaving would corrupt both).  Single-group clients only
+        # ever use gid 0 keys.
+        self._conns: dict[tuple, socket.socket] = {}
         # One buffered frame stream per connection: ALL reads on a
         # connection go through it (bytes it buffered are invisible to
         # direct socket reads), and a pipelined burst's replies are
         # ingested in ~one recv.
-        self._streams: dict[int, wire.FrameStream] = {}
+        self._streams: dict[tuple, wire.FrameStream] = {}
         #: client-side fault observability (stale_replies = discarded
         #: duplicated/reordered reply frames)
         self.stats: dict[str, int] = {}
@@ -663,6 +746,39 @@ class ApusClient:
     def _parse(addr: str) -> tuple[str, int]:
         host, port = addr.rsplit(":", 1)
         return host, int(port)
+
+    # -- multi-group plumbing ---------------------------------------------
+
+    @property
+    def _leader(self) -> Optional[int]:
+        """Group 0's cached leader (single-group compat alias)."""
+        return self._leaders.get(0)
+
+    @_leader.setter
+    def _leader(self, v: Optional[int]) -> None:
+        self._leaders[0] = v
+
+    def _gleader(self, gid: int) -> Optional[int]:
+        return self._leaders.get(gid)
+
+    def _set_gleader(self, gid: int, v: Optional[int]) -> None:
+        self._leaders[gid] = v
+
+    def group_of(self, key: bytes) -> int:
+        """Stable key -> group id (runtime/router.py); 0 when this
+        client is single-group."""
+        if self.groups <= 1:
+            return 0
+        from apus_tpu.runtime.router import group_of_key
+        return group_of_key(key, self.groups)
+
+    @staticmethod
+    def _wrap(gid: int, payload: bytes) -> bytes:
+        """OP_GROUP envelope for gid > 0; gid 0 frames stay bare
+        (byte-identical to the single-group protocol)."""
+        if gid == 0:
+            return payload
+        return wire.u8(wire.OP_GROUP) + wire.u8(gid) + payload
 
     def close(self) -> None:
         for c in self._conns.values():
@@ -709,21 +825,32 @@ class ApusClient:
         of reading replies (one vectored flush per sub-window), pairing
         replies by the echoed req_id — out-of-order and duplicated
         frames are discarded/reordered exactly as the single-op path.
-        ``ops`` is a sequence of ``(op, data)`` with op in
-        {OP_CLT_WRITE, OP_CLT_READ}.  Returns the reply bodies in op
-        order, with redis-pipeline program-order semantics: a read
-        observes every write earlier in the same pipeline call (the
-        server floors each read's wait index past the burst's earlier
-        writes; it may additionally observe later writes that applied
-        in the same commit window).  Failover-safe: unresolved ops are
-        resent to the next target with the SAME req_ids, and the
-        server-side dedup (core.epdb) keeps retried writes
-        exactly-once."""
+        ``ops`` is a sequence of ``(op, data)`` or ``(op, data, gid)``
+        with op in {OP_CLT_WRITE, OP_CLT_READ} (the 3-tuple form routes
+        to consensus group ``gid``; the KVS helpers below derive gid
+        from the key).  Returns the reply bodies in op order, with
+        redis-pipeline program-order semantics WITHIN a group: a read
+        observes every same-group write earlier in the same pipeline
+        call (the server floors each read's wait index past the burst's
+        earlier writes; it may additionally observe later writes that
+        applied in the same commit window).  Ops routed to different
+        groups interleave freely — each group is an independent log.
+        A multi-group burst splits per group and the sub-pipelines run
+        CONCURRENTLY (each on its own (group, peer) connections),
+        replies merged back in op order.  Failover-safe: unresolved
+        ops are resent to the next target with the SAME req_ids, and
+        the server-side per-group dedup (core.epdb) keeps retried
+        writes exactly-once."""
         window = window or self.pipeline_window
         items = []
-        for op, data in ops:
+        for entry in ops:
+            if len(entry) == 3:
+                op, data, gid = entry
+            else:
+                op, data = entry
+                gid = 0
             self._req_seq += 1
-            items.append((op, self._req_seq, data))
+            items.append((op, self._req_seq, data, gid))
             if self.history is not None:
                 self.history.invoke(self.clt_id, self._req_seq, op, data)
             if self.tracer is not None \
@@ -732,48 +859,82 @@ class ApusClient:
                                   "client_send")
         results: dict[int, bytes] = {}
         deadline = time.monotonic() + self.timeout
-        # Pure-read bursts under read_policy='spread' rotate across
-        # replicas (served from follower read leases); a NOT_LEADER
-        # bounce falls back to the hinted leader for the remainder.
-        spread = (self.read_policy == "spread"
-                  and all(op == OP_CLT_READ for op, _r, _d in items))
-        target = self._spread_target() if spread else self._leader
-        if target is None:
-            target = self._leader
-        pending = items
+        by_gid: dict[int, list] = {}
+        for it in items:
+            by_gid.setdefault(it[3], []).append(it)
         try:
-            while pending:
-                if time.monotonic() >= deadline:
-                    raise TimeoutError(
-                        f"{len(pending)} of {len(items)} pipelined ops "
-                        f"not served in {self.timeout}s")
-                if target is None:
-                    target = self._probe_any(deadline)
-                    if target is None:
-                        continue
-                outcome, hint = self._pipeline_attempt(
-                    target, pending, results, deadline, window,
-                    learn_leader=not spread)
-                pending = [it for it in pending if it[1] not in results]
-                if outcome == "hint":
-                    target = self._peer_index(hint) if hint \
-                        else (self._leader if spread
-                              and self._leader is not None
-                              else self._next(target))
-                    time.sleep(0.01)
-                elif outcome != "ok":
-                    target = ((target + 1) % len(self.peers)
-                              if spread else self._next(target))
+            if len(by_gid) == 1:
+                gid, sub = next(iter(by_gid.items()))
+                self._pipeline_group(gid, sub, results, deadline, window)
+            else:
+                # Concurrent per-group sub-pipelines: connections are
+                # keyed (gid, target), so threads never share a socket
+                # even when two groups' leaders are the same daemon.
+                errs: list[BaseException] = []
+
+                def run(gid, sub):
+                    try:
+                        self._pipeline_group(gid, sub, results,
+                                             deadline, window)
+                    except BaseException as e:   # noqa: BLE001
+                        errs.append(e)
+
+                threads = [threading.Thread(target=run, args=(g, s),
+                                            daemon=True)
+                           for g, s in by_gid.items()]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                if errs:
+                    raise errs[0]
         except BaseException:
             # Unresolved ops are ambiguous: a retry MAY already have
             # landed (the reply was simply never read).
             if self.history is not None:
-                for _op, rid, _d in items:
+                for _op, rid, _d, _g in items:
                     if rid not in results:
                         self.history.complete(self.clt_id, rid,
                                               "ambiguous")
             raise
-        return [results[req_id] for _op, req_id, _d in items]
+        return [results[req_id] for _op, req_id, _d, _g in items]
+
+    def _pipeline_group(self, gid: int, items: list,
+                        results: dict, deadline: float,
+                        window: int) -> None:
+        """Drive one group's sub-pipeline to completion (chasing that
+        GROUP's leader via its own NOT_LEADER hints)."""
+        # Pure-read bursts under read_policy='spread' rotate across
+        # replicas (served from follower read leases); a NOT_LEADER
+        # bounce falls back to the hinted leader for the remainder.
+        spread = (self.read_policy == "spread"
+                  and all(op == OP_CLT_READ for op, _r, _d, _g in items))
+        target = self._spread_target() if spread else self._gleader(gid)
+        if target is None:
+            target = self._gleader(gid)
+        pending = items
+        while pending:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{len(pending)} of {len(items)} pipelined ops "
+                    f"(group {gid}) not served in {self.timeout}s")
+            if target is None:
+                target = self._probe_any(deadline, gid)
+                if target is None:
+                    continue
+            outcome, hint = self._pipeline_attempt(
+                target, pending, results, deadline, window,
+                learn_leader=not spread, gid=gid)
+            pending = [it for it in pending if it[1] not in results]
+            if outcome == "hint":
+                target = self._peer_index(hint) if hint \
+                    else (self._gleader(gid) if spread
+                          and self._gleader(gid) is not None
+                          else self._next(target, gid))
+                time.sleep(0.01)
+            elif outcome != "ok":
+                target = ((target + 1) % len(self.peers)
+                          if spread else self._next(target, gid))
 
     def pipeline_writes(self, datas) -> list[bytes]:
         return self.pipeline([(OP_CLT_WRITE, d) for d in datas])
@@ -783,22 +944,25 @@ class ApusClient:
 
     def pipeline_puts(self, pairs) -> list[bytes]:
         from apus_tpu.models.kvs import encode_put
-        return self.pipeline_writes(
-            [encode_put(k, v) for k, v in pairs])
+        return self.pipeline(
+            [(OP_CLT_WRITE, encode_put(k, v), self.group_of(k))
+             for k, v in pairs])
 
     def pipeline_gets(self, keys) -> list[bytes]:
         from apus_tpu.models.kvs import encode_get
-        return self.pipeline_reads([encode_get(k) for k in keys])
+        return self.pipeline(
+            [(OP_CLT_READ, encode_get(k), self.group_of(k))
+             for k in keys])
 
     def _pipeline_attempt(self, target: int, items: list, results: dict,
                           deadline: float, window: int,
-                          learn_leader: bool = True):
+                          learn_leader: bool = True, gid: int = 0):
         """One pipelined exchange against ``target``.  Returns
         ("ok", None) when every item resolved, ("hint", addr_or_None)
         on NOT_LEADER, ("rotate", None) on a peer-side commit timeout,
         ("conn", None) on connection trouble — unresolved items stay
         out of ``results`` and are retried by the caller."""
-        conn = self._connect(target, deadline)
+        conn = self._connect(target, deadline, gid)
         if conn is None:
             return "conn", None
         queue = list(items)
@@ -809,14 +973,15 @@ class ApusClient:
                     burst = queue[:window - len(inflight)]
                     del queue[:len(burst)]
                     wire.send_frames(conn, [
-                        wire.u8(op) + wire.u64(rid)
-                        + wire.u64(self.clt_id) + wire.blob(data)
-                        for op, rid, data in burst])
+                        self._wrap(gid, wire.u8(op) + wire.u64(rid)
+                                   + wire.u64(self.clt_id)
+                                   + wire.blob(data))
+                        for op, rid, data, _g in burst])
                     for it in burst:
                         inflight[it[1]] = it
                 conn.settimeout(max(0.05, min(
                     deadline - time.monotonic(), self.attempt_timeout)))
-                resp = self._streams[target].next_frame()
+                resp = self._streams[(gid, target)].next_frame()
                 if resp is None:
                     raise ConnectionError("peer closed")
                 if len(resp) < 9:
@@ -831,7 +996,7 @@ class ApusClient:
                 st = resp[0]
                 if st == wire.ST_OK:
                     if learn_leader:
-                        self._leader = target
+                        self._set_gleader(gid, target)
                     results[rid] = wire.Reader(resp[9:]).blob()
                     del inflight[rid]
                     if self.history is not None:
@@ -854,47 +1019,55 @@ class ApusClient:
                     raise RuntimeError(f"server error (status {st})")
             return "ok", None
         except (OSError, ConnectionError, ValueError):
-            self._drop(target)
+            self._drop(target, gid)
             return "conn", None
 
     # -- kvs convenience (the DARE client's PUT/GET/RM, dare_kvs_sm.c) ----
 
     def put(self, key: bytes, value: bytes) -> bytes:
         from apus_tpu.models.kvs import encode_put
-        return self.write(encode_put(key, value))
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq,
+                        encode_put(key, value), gid=self.group_of(key))
 
     def get(self, key: bytes) -> bytes:
         from apus_tpu.models.kvs import encode_get
-        return self.read(encode_get(key))
+        self._req_seq += 1
+        return self._op(OP_CLT_READ, self._req_seq, encode_get(key),
+                        gid=self.group_of(key))
 
     def delete(self, key: bytes) -> bytes:
         from apus_tpu.models.kvs import encode_delete
-        return self.write(encode_delete(key))
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq,
+                        encode_delete(key), gid=self.group_of(key))
 
     # -- internals --------------------------------------------------------
 
-    def _op(self, op: int, req_id: int, data: bytes) -> bytes:
+    def _op(self, op: int, req_id: int, data: bytes,
+            gid: int = 0) -> bytes:
         """One client op with audit capture: the whole retry chain is
         one recorded interval; timeouts are ambiguous (maybe-applied),
         server errors are ambiguous-for-writes."""
         if self.tracer is not None and self.tracer.sampled(req_id):
             self.tracer.stamp(self.clt_id, req_id, "client_send")
             try:
-                reply = self._op_history(op, req_id, data)
+                reply = self._op_history(op, req_id, data, gid)
             except BaseException:
                 self.tracer.finish(self.clt_id, req_id)
                 raise
             self.tracer.stamp(self.clt_id, req_id, "client_reply")
             self.tracer.finish(self.clt_id, req_id)
             return reply
-        return self._op_history(op, req_id, data)
+        return self._op_history(op, req_id, data, gid)
 
-    def _op_history(self, op: int, req_id: int, data: bytes) -> bytes:
+    def _op_history(self, op: int, req_id: int, data: bytes,
+                    gid: int = 0) -> bytes:
         if self.history is None:
-            return self._op_raw(op, req_id, data)
+            return self._op_raw(op, req_id, data, gid)
         self.history.invoke(self.clt_id, req_id, op, data)
         try:
-            reply = self._op_raw(op, req_id, data)
+            reply = self._op_raw(op, req_id, data, gid)
         except TimeoutError:
             self.history.complete(self.clt_id, req_id, "ambiguous")
             raise
@@ -904,26 +1077,28 @@ class ApusClient:
         self.history.complete(self.clt_id, req_id, "ok", reply)
         return reply
 
-    def _op_raw(self, op: int, req_id: int, data: bytes) -> bytes:
-        payload = (wire.u8(op) + wire.u64(req_id) + wire.u64(self.clt_id)
-                   + wire.blob(data))
+    def _op_raw(self, op: int, req_id: int, data: bytes,
+                gid: int = 0) -> bytes:
+        payload = self._wrap(gid, wire.u8(op) + wire.u64(req_id)
+                             + wire.u64(self.clt_id) + wire.blob(data))
         deadline = time.monotonic() + self.timeout
         # Spread reads rotate across replicas (follower read leases);
         # their failovers must not clobber the cached leader the write
         # path relies on, so they rotate locally instead of _next().
         spread = op == OP_CLT_READ and self.read_policy == "spread"
-        target = self._spread_target() if spread else self._leader
+        target = self._spread_target() if spread else self._gleader(gid)
         if target is None:
-            target = self._leader
+            target = self._gleader(gid)
         while time.monotonic() < deadline:
             if target is None:
-                target = self._probe_any(deadline)
+                target = self._probe_any(deadline, gid)
                 if target is None:
                     continue
-            resp = self._roundtrip(target, payload, deadline, req_id)
+            resp = self._roundtrip(target, payload, deadline, req_id,
+                                   gid)
             if resp is None:
                 target = ((target + 1) % len(self.peers) if spread
-                          else self._next(target))
+                          else self._next(target, gid))
                 continue
             st = resp[0]
             # Replies echo req_id after the status byte (reply pairing
@@ -931,7 +1106,7 @@ class ApusClient:
             # it) — the body starts at offset 9.
             if st == wire.ST_OK:
                 if not spread:
-                    self._leader = target
+                    self._set_gleader(gid, target)
                 return wire.Reader(resp[9:]).blob()
             if st == ST_NOT_LEADER:
                 hint = wire.Reader(resp[9:]).blob().decode() if \
@@ -941,12 +1116,12 @@ class ApusClient:
                     # the leader for THIS read, keep the rotor for the
                     # next one.
                     target = (self._peer_index(hint) if hint
-                              else self._leader
-                              if self._leader is not None
+                              else self._gleader(gid)
+                              if self._gleader(gid) is not None
                               else (target + 1) % len(self.peers))
                 else:
                     target = self._peer_index(hint) if hint \
-                        else self._next(target)
+                        else self._next(target, gid)
                 time.sleep(0.01)
                 continue
             if st == ST_TIMEOUT:
@@ -955,7 +1130,7 @@ class ApusClient:
                 # the same stuck leader until our own deadline — the
                 # same req_id is exactly-once wherever it lands, and a
                 # healthy majority may be one hop away.
-                target = self._next(target)
+                target = self._next(target, gid)
                 continue
             raise RuntimeError(f"server error (status {st})")
         raise TimeoutError(f"request {req_id} not served in {self.timeout}s")
@@ -969,22 +1144,22 @@ class ApusClient:
         self.peers.append(pa)
         return len(self.peers) - 1
 
-    def _next(self, current: Optional[int]) -> int:
-        self._leader = None
+    def _next(self, current: Optional[int], gid: int = 0) -> int:
+        self._set_gleader(gid, None)
         if current is None:
             return 0
         return (current + 1) % len(self.peers)
 
-    def _probe_any(self, deadline: float) -> Optional[int]:
+    def _probe_any(self, deadline: float, gid: int = 0) -> Optional[int]:
         for i in range(len(self.peers)):
-            if self._connect(i, deadline) is not None:
+            if self._connect(i, deadline, gid) is not None:
                 return i
         time.sleep(0.05)
         return None
 
-    def _connect(self, target: int,
-                 deadline: float) -> Optional[socket.socket]:
-        conn = self._conns.get(target)
+    def _connect(self, target: int, deadline: float,
+                 gid: int = 0) -> Optional[socket.socket]:
+        conn = self._conns.get((gid, target))
         if conn is not None:
             return conn
         try:
@@ -992,28 +1167,28 @@ class ApusClient:
                 self.peers[target],
                 timeout=max(0.05, min(1.0, deadline - time.monotonic())))
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns[target] = conn
-            self._streams[target] = wire.FrameStream(conn)
+            self._conns[(gid, target)] = conn
+            self._streams[(gid, target)] = wire.FrameStream(conn)
             return conn
         except OSError:
             return None
 
     def _roundtrip(self, target: int, payload: bytes, deadline: float,
-                   req_id: int) -> Optional[bytes]:
+                   req_id: int, gid: int = 0) -> Optional[bytes]:
         """One request/response exchange, paired by the reply's echoed
         req_id: frames whose echo doesn't match are STALE — duplicated
         or reordered replies to an earlier request on this (reused)
         connection — and are discarded, not misread as this request's
         answer.  Pre-fix a duplicated reply desynchronized the
         connection's request/reply pairing for every later op."""
-        conn = self._connect(target, deadline)
+        conn = self._connect(target, deadline, gid)
         if conn is None:
             return None
         try:
             conn.settimeout(max(0.05, min(deadline - time.monotonic(),
                                           self.attempt_timeout)))
             conn.sendall(wire.frame(payload))
-            stream = self._streams[target]
+            stream = self._streams[(gid, target)]
             while True:
                 resp = stream.next_frame()
                 if resp is None:
@@ -1025,12 +1200,12 @@ class ApusClient:
                     continue
                 return resp
         except (OSError, ConnectionError, ValueError):
-            self._drop(target)
+            self._drop(target, gid)
             return None
 
-    def _drop(self, target: int) -> None:
-        self._streams.pop(target, None)
-        conn = self._conns.pop(target, None)
+    def _drop(self, target: int, gid: int = 0) -> None:
+        self._streams.pop((gid, target), None)
+        conn = self._conns.pop((gid, target), None)
         if conn is not None:
             try:
                 conn.close()

@@ -26,7 +26,8 @@ class LocalCluster:
                  sm_factory: Callable[[], StateMachine] = KvsStateMachine,
                  daemon_cls=ReplicaDaemon, seed: int = 0,
                  device_plane: bool = False, device_batch: int = 16,
-                 device_devices=None, **daemon_kwargs):
+                 device_devices=None, groups: int = 1,
+                 group_major: bool = False, **daemon_kwargs):
         self.n = n
         self.sm_factory = sm_factory
         self.daemon_cls = daemon_cls
@@ -38,13 +39,28 @@ class LocalCluster:
         base = spec or ClusterSpec(
             hb_period=0.005, hb_timeout=0.030,
             elect_low=0.050, elect_high=0.150)
-        self.spec = dataclasses.replace(base, group_size=n, peers=peers)
+        groups = max(groups, getattr(base, "groups", 1))
+        self.spec = dataclasses.replace(base, group_size=n, peers=peers,
+                                        groups=groups)
+        self.groups = groups
         # Shared device-plane engine (one mesh per process, like one TPU
         # pod slice per host); each daemon's driver binds its replica to
         # a shard.  Replication through the jitted commit step, host TCP
-        # as control plane + catch-up (runtime.device_plane).
+        # as control plane + catch-up (runtime.device_plane).  With
+        # groups > 1 the GROUP-MAJOR engine (runtime.group_plane) runs
+        # instead: many groups' windows per dispatch.
         self.device_runner = None
-        if device_plane:
+        if device_plane and (groups > 1 or group_major):
+            # group_major=True forces the group-major engine even at
+            # groups == 1 — the bench's apples-to-apples ladder floor.
+            from apus_tpu.runtime.group_plane import GroupDeviceRunner
+            self.device_runner = GroupDeviceRunner(
+                n_groups=groups, n_replicas=n,
+                slot_bytes=self.spec.slot_bytes, batch=device_batch,
+                devices=device_devices)
+            self.daemon_kwargs = dict(self.daemon_kwargs,
+                                      device_runner=self.device_runner)
+        elif device_plane:
             from apus_tpu.runtime.device_plane import DeviceCommitRunner
             self.device_runner = DeviceCommitRunner(
                 n_replicas=n, n_slots=self.spec.n_slots,
@@ -87,6 +103,40 @@ class LocalCluster:
         if not leaders:
             return None
         return max(leaders, key=lambda d: d.term)
+
+    def group_leader(self, gid: int) -> Optional[ReplicaDaemon]:
+        """The daemon currently leading consensus group ``gid`` (may
+        differ per group), or None."""
+        best = None
+        for d in self.live():
+            node = d.group_node(gid)
+            if node is not None and node.is_leader:
+                if best is None or node.current_term > \
+                        best.group_node(gid).current_term:
+                    best = d
+        return best
+
+    def wait_for_group_leaders(self, timeout: float = 20.0) -> dict:
+        """Block until EVERY group has exactly one live leader; returns
+        {gid: daemon}."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = {}
+            for gid in range(self.groups):
+                leaders = []
+                for d in self.live():
+                    node = d.group_node(gid)
+                    with d.lock:
+                        if node is not None and node.is_leader:
+                            leaders.append(d)
+                if len(leaders) == 1:
+                    out[gid] = leaders[0]
+            if len(out) == self.groups:
+                return out
+            time.sleep(0.005)
+        raise AssertionError(
+            f"not all {self.groups} groups elected a stable leader "
+            f"within {timeout}s")
 
     def wait_for_leader(self, timeout: float = 15.0) -> ReplicaDaemon:
         deadline = time.monotonic() + timeout
@@ -171,6 +221,20 @@ class LocalCluster:
             # join returned (parity with add_replica and the daemon
             # CLI's --join path) instead of a stale epoch-0 full set.
             kwargs["cid"] = rejoin_cid
+            if self.groups > 1:
+                # Re-admit into every extra group too (idempotent for
+                # groups that still list the slot); the per-group
+                # exclusion watchdog arm backstops any group whose
+                # leader is mid-election right now.
+                from apus_tpu.runtime.membership import \
+                    request_join_all_groups
+                try:
+                    kwargs["group_cids"] = request_join_all_groups(
+                        [p for i, p in enumerate(self.spec.peers)
+                         if p and i != idx], self.spec.peers[idx], idx,
+                        self.groups)
+                except Exception:            # noqa: BLE001
+                    pass                     # watchdog arm will retry
         d = self.daemon_cls(idx, self.spec, sm=self.sm_factory(),
                             recovery_start=True, seed=self.seed,
                             **kwargs)
@@ -199,14 +263,27 @@ class LocalCluster:
         while len(self.spec.peers) <= slot:
             self.spec.peers.append("")
         self.spec.peers[slot] = addr
+        join_kwargs = dict(self.daemon_kwargs)
+        if self.groups > 1:
+            from apus_tpu.runtime.membership import \
+                request_join_all_groups
+            join_kwargs["group_cids"] = request_join_all_groups(
+                [p for i, p in enumerate(self.spec.peers)
+                 if p and i != slot], addr, slot, self.groups,
+                timeout=timeout)
         d = self.daemon_cls(slot, self.spec, sm=self.sm_factory(), cid=cid,
                             listen_sock=sock, recovery_start=True,
-                            seed=self.seed, **self.daemon_kwargs)
+                            seed=self.seed, **join_kwargs)
         while len(self.daemons) <= slot:
             self.daemons.append(None)
         self.daemons[slot] = d
         self.n = max(self.n, slot + 1)
         d.start()
+        if self.groups > 1:
+            missing = sorted(set(range(1, self.groups))
+                             - set(join_kwargs.get("group_cids") or {}))
+            if missing:
+                d.retry_group_joins(addr, missing)
         return d
 
     def graceful_leave(self, idx: int, timeout: float = 15.0) -> None:
@@ -219,7 +296,8 @@ class LocalCluster:
                  if p and i != idx and i < len(self.daemons)
                  and self.daemons[i] is not None]
         request_leave(peers, idx, timeout=timeout,
-                      victim_addr=self.spec.peers[idx])
+                      victim_addr=self.spec.peers[idx],
+                      groups=self.groups)
         d = self.daemons[idx]
         if d is not None:
             deadline = time.monotonic() + timeout

@@ -62,7 +62,9 @@ class ReplicaDaemon:
                  db_dir: Optional[str] = None,
                  recovery_start: bool = False,
                  seed: int = 0,
-                 device_runner=None):
+                 device_runner=None,
+                 group_cids: Optional[dict] = None,
+                 group_sm_factory=None):
         self.idx = idx
         self.spec = spec
         self.lock = threading.RLock()
@@ -138,6 +140,9 @@ class ReplicaDaemon:
                                  spec.mesh_slot_bytes
                                  if spec.mesh_n > 0 else spec.slot_bytes)
                           - 128))
+        #: kept for the multi-group runtime: extra groups clone this
+        #: config with a per-gid rng phase (runtime/groupset.py).
+        self._node_cfg = cfg
         self.node = Node(cfg, cid or Cid.initial(spec.group_size),
                          sm or KvsStateMachine(), self.transport)
         if self.obs is not None:
@@ -195,6 +200,39 @@ class ReplicaDaemon:
                                  logger=self.logger,
                                  stats=self.obs.view("srv")
                                  if self.obs is not None else None)
+        # Multi-group sharded consensus (Multi-Raft; runtime/groupset):
+        # spec.groups independent consensus groups multiplexed over
+        # THIS daemon's sockets/transport/fault plane/clock.  Group 0
+        # is self.node (membership discovery, persistence, bridge);
+        # extra groups ride OP_GROUP-wrapped frames and the coalesced
+        # per-peer OP_HB_MULTI heartbeat.  groups == 1 (default):
+        # nothing is built, no hb_sink is installed, and every wire
+        # frame stays byte-identical to the single-group protocol.
+        self.n_groups = max(1, int(getattr(spec, "groups", 1) or 1))
+        self.groupset = None
+        if self.n_groups > 1:
+            from apus_tpu.runtime.groupset import GroupSet
+            gs_kwargs = {}
+            if group_sm_factory is not None:
+                gs_kwargs["sm_factory"] = group_sm_factory
+            self.groupset = GroupSet(self, self.n_groups,
+                                     cids=group_cids, **gs_kwargs)
+            self.server.group_ref = self.groupset.port
+
+        # Per-group write service-capacity emulation for the multi-
+        # group throughput bench (bench.py --throughput --groups):
+        # each admitted write holds ITS GROUP's service gate for
+        # APUS_WRITE_SVC_US microseconds at the leader, emulating a
+        # deployment where every group's leader owns a core — the
+        # exact sibling of APUS_READ_SVC_US above.  0 (default) = off,
+        # zero overhead.
+        try:
+            self.write_svc = float(os.environ.get("APUS_WRITE_SVC_US",
+                                                  "0") or 0) / 1e6
+        except ValueError:
+            self.write_svc = 0.0
+        self._wsvc_gates: dict[int, threading.Lock] = {}
+
         # Pipelined client bursts: admit a whole burst of client ops
         # under one lock acquisition + one commit wait (group-commit
         # admission; see make_client_batch_hook).
@@ -251,7 +289,14 @@ class ReplicaDaemon:
         # term checks and registers its descriptor op on the peer
         # server.
         self.device_driver = None
-        if device_runner is not None:
+        if device_runner is not None \
+                and getattr(device_runner, "group_major", False):
+            # Group-major engine (runtime.group_plane): one driver
+            # thread serves ALL of this daemon's consensus groups —
+            # many groups' windows per device dispatch.
+            from apus_tpu.runtime.group_plane import GroupPlaneDriver
+            self.device_driver = GroupPlaneDriver(self, device_runner)
+        elif device_runner is not None:
             from apus_tpu.runtime.device_plane import DevicePlaneDriver
             if hasattr(device_runner, "attach"):
                 device_runner.attach(self)
@@ -358,6 +403,9 @@ class ReplicaDaemon:
         # reaped with the tempdir.)
         from apus_tpu.parallel.onesided import _snap_session_close
         _snap_session_close(self.node)
+        if self.groupset is not None:
+            for gnode in self.groupset.nodes[1:]:
+                _snap_session_close(gnode)
 
     def begin_drain(self, why: str) -> None:
         """Graceful leave: our removal is COMMITTED cluster-wide
@@ -371,6 +419,8 @@ class ReplicaDaemon:
                 return
             self.draining = True
             self.node.draining = True
+            if self.groupset is not None:
+                self.groupset.begin_drain()
         self.logger.info("graceful leave: draining (%s); this replica "
                          "stops voting/serving and will exit clean", why)
 
@@ -443,6 +493,91 @@ class ReplicaDaemon:
                                  "%d)", slot, cid.epoch)
             except Exception as e:               # noqa: BLE001
                 self.logger.warning("rejoin attempt failed: %s", e)
+            # Multi-group: the eviction removed this slot from EVERY
+            # group whose failure detector saw the silence — rejoin
+            # the extra groups too (idempotent where still a member).
+            self._rejoin_extra_groups(my_addr)
+
+    def retry_group_joins(self, my_addr: str, gids) -> None:
+        """Finish deferred extra-group admissions in the background
+        (request_join_all_groups skips groups whose join timed out at
+        boot — a group mid-election/mid-resize under churn): keep
+        retrying each until admitted or permanently refused."""
+        from apus_tpu.runtime.membership import (JoinRefusedError,
+                                                 request_join_group)
+        gids = sorted(gids)
+        if not gids:
+            return
+
+        def run():
+            left = list(gids)
+            while left and not self._stop.is_set():
+                for gid in list(left):
+                    peers = [p for i, p in enumerate(self.spec.peers)
+                             if p and i != self.idx]
+                    try:
+                        cid = request_join_group(peers, my_addr, gid,
+                                                 self.idx, timeout=10.0)
+                    except JoinRefusedError as e:
+                        self.logger.error(
+                            "group %d join permanently refused: %s",
+                            gid, e)
+                        left.remove(gid)
+                        continue
+                    except Exception:        # noqa: BLE001
+                        continue             # retry next round
+                    gnode = self.group_node(gid)
+                    if gnode is not None:
+                        with self.lock:
+                            gnode.incarnation = max(gnode.incarnation,
+                                                    cid.epoch)
+                    self.logger.info(
+                        "group %d admitted at slot %d (deferred join, "
+                        "incarnation %d)", gid, self.idx, cid.epoch)
+                    left.remove(gid)
+                self._stop.wait(1.0)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"apus-gjoin-{self.idx}").start()
+
+    def _rejoin_extra_groups(self, my_addr: str) -> None:
+        """In-place rejoin of extra consensus groups whose live leader
+        excludes our slot (the per-group arm of the exclusion
+        watchdog).  Best effort per group; a group that still lists us
+        answers the join idempotently."""
+        if self.groupset is None:
+            return
+        from apus_tpu.runtime.client import probe_status
+        from apus_tpu.runtime.membership import request_join_group
+        peers = [p for i, p in enumerate(self.spec.peers)
+                 if p and i != self.idx]
+        for gid in range(1, self.n_groups):
+            gnode = self.groupset.node(gid)
+            if gnode is None:
+                continue
+            excluded = False
+            for addr in peers:
+                st = probe_status(addr, timeout=0.3)
+                gst = ((st or {}).get("groups") or {}).get(str(gid))
+                if (gst is not None and gst.get("is_leader")
+                        and gst.get("term", 0) >= gnode.current_term
+                        and self.idx not in gst.get("members", [])):
+                    excluded = True
+                    break
+            if not excluded:
+                continue
+            try:
+                cid = request_join_group(peers, my_addr, gid, self.idx,
+                                         timeout=5.0)
+                with self.lock:
+                    gnode.incarnation = max(gnode.incarnation,
+                                            cid.epoch)
+                self.logger.info("group %d re-admitted at slot %d "
+                                 "(incarnation %d)", gid, self.idx,
+                                 cid.epoch)
+            except Exception as e:               # noqa: BLE001
+                self.logger.warning("group %d rejoin failed: %s",
+                                    gid, e)
 
     def _compaction_watchdog(self) -> None:
         """Bounded restart replay: once the durable store accumulates
@@ -486,8 +621,16 @@ class ReplicaDaemon:
         while not self._stop.is_set():
             try:
                 with self.lock:
-                    self.node.tick(self.clock())
+                    now = self.clock()
+                    self.node.tick(now)
                     self._drain_upcalls()
+                    if self.groupset is not None:
+                        # Extra groups tick under the SAME lock hold,
+                        # then every group's registered heartbeat round
+                        # flushes as one coalesced OP_HB_MULTI frame
+                        # per peer (the lock is yielded on the wire).
+                        self.groupset.tick(now)
+                        self.groupset.flush_heartbeats()
                     self._log_role_changes()
                     for cb in self.on_tick:
                         cb()
@@ -501,9 +644,13 @@ class ReplicaDaemon:
                     # burst's deferred read registration waits on its
                     # writes entering the log).  Deadline expiry needs
                     # no notify: every waiter bounds its wait by the
-                    # time left to its own deadline.
+                    # time left to its own deadline.  Extra groups
+                    # contribute their own tuples (their waiters park
+                    # on the same condition).
                     wake = (n.log.apply, n.log.commit, n.log.end,
                             n.role, n.current_term, n.reads_done)
+                    if self.groupset is not None:
+                        wake = (wake, self.groupset.wake_state())
                     if wake != self._wake_state:
                         self._wake_state = wake
                         self.commit_cond.notify_all()
@@ -661,6 +808,15 @@ class ReplicaDaemon:
 
     # -- client-facing API ------------------------------------------------
 
+    def group_node(self, gid: int):
+        """The Node of consensus group ``gid`` (0 = the primary), or
+        None for unknown gids."""
+        if gid == 0:
+            return self.node
+        if self.groupset is None:
+            return None
+        return self.groupset.node(gid)
+
     @property
     def is_leader(self) -> bool:
         return self.node.is_leader
@@ -804,6 +960,8 @@ def main(argv: Optional[list] = None) -> int:
         return RelayStateMachine(spill_path=os.path.join(
             args.workdir, f"records{replica_idx}.bin"))
 
+    missing_groups: list = []
+    join_my_addr = None
     if args.join:
         import socket as _socket
 
@@ -833,6 +991,22 @@ def main(argv: Optional[list] = None) -> int:
         while len(spec.peers) <= slot:
             spec.peers.append("")
         spec.peers[slot] = my_addr
+        # Multi-group: a joiner is admitted into EVERY consensus group
+        # (slots agree across groups; each group's leader answers its
+        # own join).  The per-group cids seed the GroupSet's
+        # incarnations so extra-group ctrl writes clear the removed-
+        # slot fences immediately.
+        group_cids = None
+        missing_groups = []
+        if getattr(spec, "groups", 1) > 1:
+            from apus_tpu.runtime.membership import \
+                request_join_all_groups
+            group_cids = request_join_all_groups(
+                [p for i, p in enumerate(spec.peers)
+                 if p and i != slot], my_addr, slot, spec.groups)
+            missing_groups = sorted(set(range(1, spec.groups))
+                                    - set(group_cids))
+        join_my_addr = my_addr
         # Mesh-capable joiners carry a DETACHED runner: the leader's
         # reformer re-admits the slot into the device clique at the
         # next plane epoch (the RC re-handshake-on-rejoin analog).
@@ -843,7 +1017,8 @@ def main(argv: Optional[list] = None) -> int:
                                listen_sock=sock, recovery_start=True,
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir,
-                               device_runner=mesh_runner)
+                               device_runner=mesh_runner,
+                               group_cids=group_cids)
     else:
         # Multi-controller mesh plane (runtime.mesh_plane): static
         # members 0..mesh_n-1 each own one device of the global mesh.
@@ -881,6 +1056,10 @@ def main(argv: Optional[list] = None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
 
     daemon.start()
+    if missing_groups and join_my_addr:
+        # Extra groups whose admission timed out at boot (mid-election/
+        # mid-resize churn): finish them in the background.
+        daemon.retry_group_joins(join_my_addr, missing_groups)
     # Re-formation orchestrator (active only while this daemon leads):
     # rebuilds the device clique under the next plane epoch once
     # membership re-stabilizes after a death/rejoin.

@@ -33,14 +33,17 @@ from apus_tpu.parallel import wire
 OP_FLR_LEASE = 24
 
 
-def make_flr_ops(daemon) -> dict:
-    """Leader-side lease grant op for a ReplicaDaemon's PeerServer."""
+def make_flr_ops(daemon, node=None) -> dict:
+    """Leader-side lease grant op for a ReplicaDaemon's PeerServer.
+    ``node`` binds the grant to one consensus group's node (multi-group
+    daemons register one per group port); None = the primary group."""
+    node = node if node is not None else daemon.node
 
     def flr_lease(r: wire.Reader) -> bytes:
         peer = r.u8()
         incarnation = r.u32() if r.remaining >= 4 else 0
         with daemon.lock:
-            g = daemon.node.grant_follower_lease(
+            g = node.grant_follower_lease(
                 peer, incarnation=incarnation)
         if g is None:
             return wire.u8(wire.ST_REFUSED)

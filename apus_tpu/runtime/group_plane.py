@@ -1,0 +1,876 @@
+"""Group-major device plane: one dispatch commits MANY groups' windows.
+
+The Multi-Raft payoff on the device (ROADMAP "group-major device
+dispatch"): the single-group engine (runtime.device_plane) amortizes
+dispatch overhead over ROUNDS of one group; this plane adds the GROUP
+axis — a ``GroupDeviceRunner`` owns a group-major devlog
+(ops.logplane.GroupDeviceLog, [G, R, ...]) and the group-window step
+(ops.commit.build_group_window_step), so one XLA program carries up to
+``max_depth`` rounds of up to ``n_groups`` groups' pending windows:
+one leader-broadcast pmax, one ack all_gather, one vectorized
+dual-majority vote for every group, with per-group early-exit masks
+(``GroupCommitControl.rounds``) letting shallow-backlog groups ride a
+deep dispatch without paying its rounds.
+
+``GroupPlaneDriver`` is one thread per daemon serving ALL of its
+groups: each driver pass collects every led group's clean window under
+the daemon lock, dispatches them as ONE group-major window (the
+leader's group-commit drain amortizing one lock + one dispatch across
+every group with queued ops), and adopts each group's device commit
+under the same safety rules as the single-group driver:
+
+1. commit chaining — a group's device results are adopted only once
+   host commit covered the prefix below that group's device base;
+2. follower drain — device rows append only on top of a current-term
+   host tail (per group);
+3. live-mask honesty — the vote is masked to members whose host
+   control-plane writes were recently observed, denominators stay the
+   full configuration sizes;
+plus the stall watchdog / quorum-fail streak fallbacks, per group.
+
+Telemetry (the acceptance evidence that dispatches are group-major):
+``dev_group_major_windows`` counts dispatches, ``dev_groups_per_dispatch``
+histograms how many groups each carried, and the recompile sentinel
+rides the same process-wide compile ledger as the single-group runner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from apus_tpu.core.cid import CidState
+from apus_tpu.core.quorum import quorum_size
+from apus_tpu.core.types import EntryType
+from apus_tpu.parallel import wire
+from apus_tpu.parallel.transport import Region
+from apus_tpu.runtime.device_plane import (_COMPILES, _EXPECTED,
+                                           _ensure_compile_listener,
+                                           unexpected_compiles)
+
+
+class GroupDeviceRunner:
+    """Process-wide group-major engine, shared by every in-process
+    daemon (one devlog, per-group generations/fences)."""
+
+    #: marks this runner for the daemon's driver selection.
+    group_major = True
+
+    def __init__(self, n_groups: int, n_replicas: int,
+                 n_slots: int = 512, slot_bytes: int = 4096,
+                 batch: int = 16, max_depth: int = 4, devices=None,
+                 logger=None):
+        self.n_groups = n_groups
+        self.n_replicas = n_replicas
+        self.n_slots = n_slots
+        self.slot_bytes = slot_bytes
+        self.batch = batch
+        self.max_depth = max_depth
+        self._devices = devices
+        self.logger = logger
+        self.lock = threading.Lock()
+        #: per-GROUP generation tokens (a group's leadership reset must
+        #: not invalidate other groups' in-flight work).
+        self.generations = [0] * n_groups
+        self._leader = [None] * n_groups
+        self._term = [0] * n_groups
+        self._next_end0 = [None] * n_groups
+        from apus_tpu.obs.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        self.stats = self.metrics.view("dev")
+        for k in ("rounds", "resets", "quorum_fail_rounds",
+                  "entries_devplane", "group_major_windows",
+                  "recompiles"):
+            self.stats.setdefault(k, 0)
+        self._groups_per_dispatch = self.metrics.histogram(
+            "dev_groups_per_dispatch")
+        self._dispatch_wait_hist = self.metrics.histogram(
+            "dev_dispatch_wait_us")
+        self._max_dispatch = self.metrics.gauge("dev_max_dispatch_ms")
+        self._built = False
+        self._build()
+
+    # -- build + warmup ----------------------------------------------------
+
+    def _build(self) -> None:
+        if self._built:
+            return
+        _ensure_compile_listener()
+        compiles_at_start = _COMPILES["count"]
+        import jax
+        import jax.numpy as jnp
+        import functools
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apus_tpu.ops.commit import build_group_window_step
+        from apus_tpu.ops.logplane import (GroupDeviceLog,
+                                           make_group_device_log)
+        from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+
+        self._jax = jax
+        devices = self._devices
+        if devices is None:
+            devices = jax.devices()[:1]
+        self._mesh = replica_mesh(self.n_replicas, devices=devices)
+        self._sharding = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
+        self._staged_sharding = NamedSharding(
+            self._mesh, P(None, None, REPLICA_AXIS))
+        self._step = build_group_window_step(
+            self._mesh, self.n_groups, self.n_replicas, self.n_slots,
+            self.slot_bytes, self.batch, self.max_depth)
+        # Follower shard readers (one batch / one window of rows).
+        self._gather = jax.jit(lambda d, m, g, r, s: (d[g, r, s],
+                                                      m[g, r, s]))
+        self._offs_one = jax.jit(lambda o, g, r: o[g, r])
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def _reset(gl: GroupDeviceLog, g, leader, term, first_idx):
+            data = gl.data.at[g].set(0)
+            meta = gl.meta.at[g].set(0)
+            offs = gl.offs.at[g].set(first_idx)
+            fence = gl.fence.at[g].set(
+                jnp.stack([leader, term]).astype(jnp.int32))
+            return GroupDeviceLog(data, meta, offs, fence)
+
+        self._reset_fn = _reset
+        self._devlog = make_group_device_log(
+            self.n_groups, self.n_replicas, self.n_slots,
+            self.slot_bytes, self.batch, sharding=self._sharding)
+        self._warmup()
+        _EXPECTED["count"] += _COMPILES["count"] - compiles_at_start
+        self._compile_baseline = unexpected_compiles()
+        self._built = True
+
+    def _warmup(self) -> None:
+        """Compile every live dispatch signature up front — a compile
+        racing live traffic is the recompile-sentinel bug class.  Two
+        step dispatches (fresh placement, then the donated/device-
+        resident signature every later dispatch uses), a reset, and
+        both reader shapes."""
+        jax, np_ = self._jax, np
+        G, R, B, MD, SB = (self.n_groups, self.n_replicas, self.batch,
+                          self.max_depth, self.slot_bytes)
+        self._devlog = self._reset_fn(self._devlog, np_.int32(0),
+                                      np_.int32(0), np_.int32(1),
+                                      np_.int32(1))
+        sdata = jax.device_put(np_.zeros((MD, G, R, B, SB), np_.uint8),
+                               self._staged_sharding)
+        smeta = jax.device_put(np_.zeros((MD, G, R, B, 4), np_.int32),
+                               self._staged_sharding)
+        ctrl = self._make_ctrl(
+            [(g, 0, 1, 1, None, set(range(R)), 0) for g in range(G)])
+        self._devlog, commits = self._step(self._devlog, sdata, smeta,
+                                           ctrl)
+        jax.block_until_ready(commits)
+        sdata = jax.device_put(np_.zeros((MD, G, R, B, SB), np_.uint8),
+                               self._staged_sharding)
+        smeta = jax.device_put(np_.zeros((MD, G, R, B, 4), np_.int32),
+                               self._staged_sharding)
+        self._devlog, commits = self._step(self._devlog, sdata, smeta,
+                                           ctrl)
+        jax.block_until_ready(commits)
+        for n in (B, B * MD):
+            jax.block_until_ready(self._gather(
+                self._devlog.data, self._devlog.meta, np_.int32(0),
+                np_.int32(0), np_.zeros(n, np_.int32)))
+        jax.block_until_ready(self._offs_one(self._devlog.offs,
+                                             np_.int32(0),
+                                             np_.int32(0)))
+        # Warm state is throwaway: every group back to a closed fence.
+        for g in range(G):
+            self._devlog = self._reset_fn(self._devlog, np_.int32(g),
+                                          np_.int32(-1), np_.int32(0),
+                                          np_.int32(1))
+
+    def check_recompiles(self) -> list:
+        """Process-wide recompile sentinel (shared compile ledger with
+        the single-group runner): any backend compile past what builds
+        and warmups accounted for is a live-path recompile."""
+        unexpected = unexpected_compiles()
+        delta = unexpected - self._compile_baseline
+        if delta <= 0:
+            return []
+        self._compile_baseline = unexpected
+        self.stats.bump("recompiles", delta)
+        return [("group_step", 0, 0)]
+
+    # -- sizing contract ---------------------------------------------------
+
+    WIRE_OVERHEAD = 64
+
+    def max_data_bytes(self) -> int:
+        return self.slot_bytes - self.WIRE_OVERHEAD
+
+    def covers_replica(self, slot: int) -> bool:
+        return 0 <= slot < self.n_replicas
+
+    def quorum_coverable(self, cid) -> bool:
+        return cid.extended_group_size <= self.n_replicas
+
+    # -- per-group leadership reset ---------------------------------------
+
+    def reset_group(self, gid: int, leader: int, term: int,
+                    first_idx: int) -> Optional[int]:
+        """Fresh shard set for group ``gid``'s new leadership; other
+        groups' state is untouched.  Stale terms refused (None)."""
+        with self.lock:
+            if term < self._term[gid]:
+                return None
+            self.generations[gid] += 1
+            self._devlog = self._reset_fn(
+                self._devlog, np.int32(gid), np.int32(leader),
+                np.int32(term), np.int32(first_idx))
+            self._leader[gid], self._term[gid] = leader, term
+            self._next_end0[gid] = first_idx
+            self.stats.bump("resets")
+            if self.logger is not None:
+                self.logger.info(
+                    "group plane reset: g%d gen=%d leader=%d term=%d "
+                    "base=%d", gid, self.generations[gid], leader, term,
+                    first_idx)
+            return self.generations[gid]
+
+    # -- the group-major dispatch -----------------------------------------
+
+    def _encode_round(self, entries, end0: int, out_data, out_meta):
+        B, SB = self.batch, self.slot_bytes
+        flat = memoryview(out_data.reshape(-1))
+        for j, e in enumerate(entries):
+            assert e.idx == end0 + j, (e.idx, end0, j)
+            size = wire.entry_wire_size(e)
+            if size > SB:
+                raise ValueError(f"entry {e.idx} wire size {size} > "
+                                 f"slot {SB}; segment upstream")
+            wire.encode_entry_into(e, flat, j * SB)
+            out_meta[j] = (e.req_id & 0x7FFFFFFF, e.clt_id & 0x7FFFFFFF,
+                           int(e.type), size)
+
+    def _make_ctrl(self, items):
+        """GroupCommitControl from per-group work items:
+        ``items`` = [(gid, leader, term, end0, cid_or_None, live,
+        n_rounds)]; groups absent from ``items`` get rounds 0 (masked
+        out of every round)."""
+        import jax.numpy as jnp
+
+        from apus_tpu.ops.commit import GroupCommitControl
+        G, R = self.n_groups, self.n_replicas
+        leader = np.full(G, -2, np.int32)
+        term = np.zeros(G, np.int32)
+        end0 = np.ones(G, np.int32)
+        rounds = np.zeros(G, np.int32)
+        mask_old = np.zeros((G, R), np.int32)
+        mask_new = np.zeros((G, R), np.int32)
+        q_old = np.full(G, R + 1, np.int32)
+        q_new = np.zeros(G, np.int32)
+        for gid, ldr, trm, e0, cid, live, n in items:
+            leader[gid], term[gid], end0[gid] = ldr, trm, e0
+            rounds[gid] = n
+            if cid is None:
+                mask_old[gid] = [1 if i in live else 0 for i in range(R)]
+                q_old[gid] = quorum_size(R)
+                continue
+            mask_old[gid] = [
+                1 if (cid.contains(i) and i < cid.size and i in live)
+                else 0 for i in range(R)]
+            q_old[gid] = quorum_size(cid.size)
+            if cid.state == CidState.TRANSIT:
+                mask_new[gid] = [
+                    1 if (cid.contains(i) and i < cid.new_size
+                          and i in live) else 0 for i in range(R)]
+                q_new[gid] = quorum_size(cid.new_size)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)   # noqa: E731
+        return GroupCommitControl(i32(leader), i32(term), i32(end0),
+                                  i32(rounds), i32(mask_old),
+                                  i32(mask_new), i32(q_old), i32(q_new))
+
+    def commit_groups(self, work: list) -> Optional[dict]:
+        """ONE group-major dispatch.  ``work`` = [(gid, gen, end0,
+        entries, cid, live)] with ``len(entries) = n_g * batch``,
+        1 <= n_g <= max_depth, entries idx-contiguous from end0.
+        Returns {gid: device_commit} for the non-stale items (a gid
+        whose generation moved between collection and dispatch is
+        silently dropped), or None when nothing was dispatchable."""
+        B, MD, G, R, SB = (self.batch, self.max_depth, self.n_groups,
+                           self.n_replicas, self.slot_bytes)
+        with self.lock:
+            live_work = []
+            for gid, gen, end0, entries, cid, live in work:
+                if gen != self.generations[gid] \
+                        or end0 != self._next_end0[gid]:
+                    continue
+                live_work.append((gid, gen, end0, entries, cid, live))
+            if not live_work:
+                return None
+        # Host staging with the runner lock released (encode is the
+        # slow part); leader-row-only expansion host-side (CPU-backend
+        # deployment; mirrors place_batch's rationale).
+        sdata = np.zeros((MD, G, R, B, SB), np.uint8)
+        smeta = np.zeros((MD, G, R, B, 4), np.int32)
+        items = []
+        for gid, gen, end0, entries, cid, live in live_work:
+            n = len(entries) // B
+            assert 1 <= n <= MD and len(entries) == n * B, \
+                (gid, len(entries), n)
+            with self.lock:
+                ldr, trm = self._leader[gid], self._term[gid]
+            for k in range(n):
+                self._encode_round(entries[k * B:(k + 1) * B],
+                                   end0 + k * B,
+                                   sdata[k, gid, ldr],
+                                   smeta[k, gid, ldr])
+            items.append((gid, ldr, trm, end0, cid, live, n))
+        ctrl = self._make_ctrl(items)
+        jd = self._jax.device_put(sdata, self._staged_sharding)
+        jm = self._jax.device_put(smeta, self._staged_sharding)
+        with self.lock:
+            # Re-validate under the lock right before the (donating)
+            # step: a reset that raced the staging discards this work.
+            final = []
+            for (gid, gen, end0, _e, _c, _lv), it in zip(live_work,
+                                                         items):
+                if gen != self.generations[gid] \
+                        or end0 != self._next_end0[gid]:
+                    continue
+                final.append(it)
+            if not final:
+                return None
+            if len(final) != len(items):
+                # Somebody reset mid-staging: rebuild ctrl with the
+                # stale groups masked out (rounds 0 — they write into
+                # scratch and report 0).
+                ctrl = self._make_ctrl(final)
+            self._devlog, commits = self._step(self._devlog, jd, jm,
+                                               ctrl)
+            total_rounds = 0
+            for gid, _l, _t, end0, _c, _lv, n in final:
+                self._next_end0[gid] = end0 + n * B
+                total_rounds += n
+            self.stats.bump("rounds", total_rounds)
+            self.stats.bump("entries_devplane", total_rounds * B)
+            self.stats.bump("group_major_windows")
+            self._groups_per_dispatch.observe(len(final))
+            gen_snapshot = {it[0]: self.generations[it[0]]
+                            for it in final}
+        t0 = time.monotonic()
+        commits_host = np.asarray(commits)          # [MD, G]
+        wait = time.monotonic() - t0
+        self._dispatch_wait_hist.observe(int(wait * 1e6))
+        if wait * 1e3 > self._max_dispatch.value:
+            self._max_dispatch.set(wait * 1e3)
+        out = {}
+        with self.lock:
+            for gid, _l, _t, end0, _c, _lv, n in final:
+                if self.generations[gid] != gen_snapshot[gid]:
+                    continue                 # reset since dispatch
+                commit = int(commits_host[n - 1, gid])
+                qf = sum(int(commits_host[k, gid]) < end0 + (k + 1) * B
+                         for k in range(n))
+                if qf:
+                    self.stats.bump("quorum_fail_rounds", qf)
+                out[gid] = commit
+        return out
+
+    # -- follower shard readback ------------------------------------------
+
+    def shard_end(self, gid: int, replica: int,
+                  gen: int) -> Optional[int]:
+        from apus_tpu.ops.logplane import OFF_END
+        if not (0 <= replica < self.n_replicas):
+            return None
+        with self.lock:
+            if gen != self.generations[gid]:
+                return None
+            row = self._offs_one(self._devlog.offs, np.int32(gid),
+                                 np.int32(replica))
+        return int(np.asarray(row)[OFF_END])
+
+    def read_rows(self, gid: int, replica: int, gen: int, lo: int,
+                  hi: int, window: bool = False):
+        from apus_tpu.core.log import LogEntry  # noqa: F401 (decode)
+        from apus_tpu.ops.logplane import META_IDX, META_LEN, slot_of
+        if not (0 <= replica < self.n_replicas):
+            return None
+        cap = self.batch * (self.max_depth if window else 1)
+        hi = min(hi, lo + cap)
+        n = self.batch if hi - lo <= self.batch else cap
+        slots = slot_of(lo + np.arange(n, dtype=np.int64),
+                        self.n_slots).astype(np.int32)
+        with self.lock:
+            if gen != self.generations[gid]:
+                return None
+            if hi <= lo:
+                return []
+            data_rows, meta_rows = self._gather(
+                self._devlog.data, self._devlog.meta, np.int32(gid),
+                np.int32(replica), slots)
+        data = np.asarray(data_rows)
+        meta = np.asarray(meta_rows)
+        out = []
+        for j, idx in enumerate(range(lo, hi)):
+            if int(meta[j, META_IDX]) != idx:
+                break
+            blob = data[j, :int(meta[j, META_LEN])].tobytes()
+            try:
+                e = wire.decode_entry(wire.Reader(blob))
+            except Exception:
+                break
+            if e.idx != idx:
+                break
+            out.append(e)
+        return out
+
+
+class _GState:
+    """Per-group driver-side cursor state."""
+
+    __slots__ = ("gen", "base", "next", "last_adv", "qfail_since",
+                 "qfail_pause_until", "cooldown_until", "gate_since",
+                 "last_end_seen", "drain_idle_key")
+
+    def __init__(self):
+        self.gen = None
+        self.base = 0
+        self.next = 0
+        self.last_adv = 0.0
+        self.qfail_since = None
+        self.qfail_pause_until = 0.0
+        self.cooldown_until = 0.0
+        self.gate_since = None
+        self.last_end_seen = 0
+        self.drain_idle_key = None
+
+
+class GroupPlaneDriver:
+    """One thread per daemon driving ALL of its groups through the
+    shared group-major runner."""
+
+    def __init__(self, daemon, runner: GroupDeviceRunner):
+        self.daemon = daemon
+        self.runner = runner
+        self.logger = daemon.logger
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._g = {gid: _GState()
+                   for gid in range(runner.n_groups)}
+        self.stats = {"rounds": 0, "drained": 0, "holes": 0,
+                      "fallbacks": 0, "partial_deferrals": 0,
+                      "group_windows": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self.daemon.lock:
+            for gid in range(self.runner.n_groups):
+                node = self.daemon.group_node(gid)
+                if node is not None:
+                    node.pre_election_hook = \
+                        self._make_election_hook(gid)
+            self.daemon.on_tick.append(self._tick_watchdog)
+        t = threading.Thread(target=self._run,
+                             name=f"apus-groupplane-{self.daemon.idx}",
+                             daemon=True)
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self.daemon.lock:
+            for gid in range(self.runner.n_groups):
+                node = self.daemon.group_node(gid)
+                if node is not None:
+                    self._set_owned(node, False, "driver_stop")
+                    node.pre_election_hook = None
+            if self._tick_watchdog in self.daemon.on_tick:
+                self.daemon.on_tick.remove(self._tick_watchdog)
+
+    def _set_owned(self, node, owned: bool, cause: str) -> None:
+        if bool(node.external_commit) == owned:
+            return
+        node.external_commit = owned
+        node.bump("devplane_own_flips")
+        node._note("devplane", "own" if owned else "release",
+                   cause=cause, gid=node.gid, commit=node.log.commit)
+
+    def _tick_watchdog(self) -> None:
+        """Under the daemon lock, tick thread: per group, release
+        device commit ownership when it stalls (the driver thread may
+        itself be wedged in a dispatch)."""
+        window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+        md_ms = self.runner.stats.get("max_dispatch_ms")
+        if md_ms:
+            window = max(window, 2.5 * md_ms / 1e3)
+        now = time.monotonic()
+        for gid, st in self._g.items():
+            node = self.daemon.group_node(gid)
+            if node is None or not (node.is_leader
+                                    and node.external_commit):
+                continue
+            if node.log.end > node.log.commit \
+                    and now - st.last_adv > window:
+                self._set_owned(node, False, "stall_watchdog")
+                st.cooldown_until = now + window
+                self.stats["fallbacks"] += 1
+                node._note("watchdog", "devplane_stall_fallback",
+                           gid=gid, window_s=round(window, 3))
+
+    # -- main loop ---------------------------------------------------------
+
+    def _run(self) -> None:
+        poll = max(self.daemon._tick_interval, 0.0005)
+        while not self._stop.is_set():
+            try:
+                if not self._step_once():
+                    time.sleep(poll)
+            except Exception:
+                self.logger.exception("group-plane driver error")
+                with self.daemon.lock:
+                    for gid in self._g:
+                        node = self.daemon.group_node(gid)
+                        if node is not None:
+                            self._set_owned(node, False, "driver_error")
+                        self._g[gid].gen = None
+                time.sleep(10 * poll)
+
+    def _step_once(self) -> bool:
+        work = []
+        terms = {}
+        led = 0
+        with self.daemon.lock:
+            for gid, st in self._g.items():
+                node = self.daemon.group_node(gid)
+                if node is None:
+                    continue
+                if node.is_leader:
+                    if st.gen is not None:
+                        led += 1
+                    item = self._collect_leader(gid, st, node)
+                    if item is not None:
+                        work.append(item)
+                        terms[gid] = node.current_term
+                elif st.gen is not None:
+                    st.gen = None
+                    self._set_owned(node, False, "role_change")
+        if work and len(work) < led:
+            # Group-commit accumulation beat: this daemon leads MORE
+            # groups than have a window ready — one tick of patience
+            # often lets their queued admissions land, so the dispatch
+            # below carries them too (the group-major amortization this
+            # plane exists for) instead of paying one dispatch each.
+            time.sleep(2 * self.daemon._tick_interval)
+            with self.daemon.lock:
+                have = {w[0] for w in work}
+                for gid, st in self._g.items():
+                    if gid in have:
+                        continue
+                    node = self.daemon.group_node(gid)
+                    if node is not None and node.is_leader:
+                        item = self._collect_leader(gid, st, node)
+                        if item is not None:
+                            work.append(item)
+                            terms[gid] = node.current_term
+        did = False
+        if work:
+            did = self._dispatch(work, terms)
+        # Follower drains (outside the daemon lock for the gathers).
+        for gid in self._g:
+            if self._follower_drain(gid):
+                did = True
+        return did
+
+    def _live_members(self, node) -> set:
+        window = max(node._hb_timeout,
+                     4 * self.daemon.spec.hb_period, 0.25)
+        now = time.monotonic()
+        live = {node.idx}
+        touched = node.regions.touched
+        for m in node.cid.members():
+            if m == node.idx:
+                continue
+            t = touched.get((Region.REP_ACK, m))
+            if t is not None and now - t <= window:
+                live.add(m)
+        return live
+
+    def _live_covers_quorum(self, cid, live) -> bool:
+        old = sum(1 for m in live if cid.contains(m) and m < cid.size)
+        if old < quorum_size(cid.size):
+            return False
+        if cid.state == CidState.TRANSIT:
+            new = sum(1 for m in live
+                      if cid.contains(m) and m < cid.new_size)
+            if new < quorum_size(cid.new_size):
+                return False
+        return True
+
+    def _collect_leader(self, gid: int, st: _GState, node):
+        """Under the daemon lock: one group's dispatchable window (or
+        None).  Mirrors the single-group driver's gating, simplified to
+        the sync group-major dispatch shape."""
+        B, MD = self.runner.batch, self.runner.max_depth
+        term = node.current_term
+        if not self.runner.quorum_coverable(node.cid):
+            if st.gen is not None:
+                st.gen = None
+                self._set_owned(node, False, "coverage_lost")
+                node.device_covered_from = None
+                self.stats["fallbacks"] += 1
+            return None
+        if st.gen is None or self.runner._term[gid] != term \
+                or self.runner._leader[gid] != node.idx:
+            self._reset_group_leadership(gid, st, node, term)
+            return None
+        if st.next < node.log.head:
+            st.gen = None               # pruned past the cursor: re-base
+            return None
+        now = time.monotonic()
+        # Re-arm ownership once host commit covered the device base and
+        # the cursor caught up (same rules as the single-group driver).
+        if not node.external_commit and node.log.commit >= st.base \
+                and now >= st.cooldown_until \
+                and st.next >= node.log.commit:
+            self._set_owned(node, True, "cursor_catchup")
+            st.last_adv = now + max(4 * self.daemon.spec.hb_timeout, 0.5)
+        live = self._live_members(node)
+        if not self._live_covers_quorum(node.cid, live):
+            window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+            if st.gate_since is None:
+                st.gate_since = now
+            elif now - st.gate_since > window and node.external_commit:
+                self._set_owned(node, False, "quorum_gate")
+                st.cooldown_until = now + window
+                self.stats["fallbacks"] += 1
+            return None
+        st.gate_since = None
+        if now < st.qfail_pause_until:
+            return None
+        end = node.log.end
+        if end <= st.next:
+            return None
+        # Micro-batching: defer a partial batch while arrivals are
+        # still landing or admissions are queued (see the single-group
+        # driver's occupancy rationale); pad with NOOPs once they
+        # pause.
+        if end - st.next < B and (
+                end != st.last_end_seen
+                or (not node.log.near_full(3)
+                    and any(p.idx is None for p in node._pending))):
+            self.stats["partial_deferrals"] += 1
+            st.last_end_seen = end
+            return None
+        st.last_end_seen = end
+        if end - st.next < B:
+            while (node.log.end - st.next) % B != 0 \
+                    and not node.log.near_full(2):
+                node.log.append(term, type=EntryType.NOOP)
+            if (node.log.end - st.next) % B != 0:
+                return None
+            end = node.log.end
+        n = min((end - st.next) // B, MD)
+        span = list(node.log.entries(st.next, st.next + n * B))
+        while n > 0:
+            span_n = span[:n * B]
+            if len(span_n) == n * B and not any(
+                    wire.entry_wire_size(e) > self.runner.slot_bytes
+                    for e in span_n):
+                break
+            n -= 1
+        if n <= 0:
+            # Oversized entry leads the span: that window is the host
+            # path's; re-base past it once host commit covers it.
+            self.stats["holes"] += 1
+            self._set_owned(node, False, "oversize_hole")
+            if node.log.commit >= st.next + B:
+                st.gen = None
+            return None
+        return (gid, st.gen, st.next, span[:n * B], node.cid, live)
+
+    def _reset_group_leadership(self, gid: int, st: _GState, node,
+                                term: int) -> None:
+        B = self.runner.batch
+        while (node.log.end - 1) % B != 0 and not node.log.near_full(2):
+            node.log.append(term, type=EntryType.NOOP)
+        if (node.log.end - 1) % B != 0:
+            return
+        base = node.log.end
+        idx = node.idx
+        self.daemon.lock.release()
+        try:
+            gen = self.runner.reset_group(gid, idx, term, base)
+        finally:
+            self.daemon.lock.acquire()
+        if gen is None or self._stop.is_set() \
+                or not (node.is_leader and node.current_term == term):
+            return
+        st.gen = gen
+        st.base = base
+        st.next = base
+        st.last_end_seen = 0
+        st.last_adv = time.monotonic() + \
+            max(4 * self.daemon.spec.hb_timeout, 0.5)
+        self._set_owned(node, node.log.commit >= base,
+                        "leadership_reset")
+        node.device_covered_from = base
+
+    def _dispatch(self, work: list, terms: dict) -> bool:
+        """The group-major dispatch: runs OUTSIDE the daemon lock, then
+        adopts every group's device commit under it."""
+        res = self.runner.commit_groups(work)
+        self.stats["dispatches"] = self.stats.get("dispatches", 0) + 1
+        with self.daemon.lock:
+            self._check_recompiles()
+            for gid, gen, end0, entries, _cid, _live in work:
+                st = self._g[gid]
+                node = self.daemon.group_node(gid)
+                n = len(entries) // self.runner.batch
+                if res is None or gid not in res:
+                    st.gen = None       # stale: re-base next pass
+                    continue
+                st.next = end0 + n * self.runner.batch
+                self.stats["rounds"] += n
+                self.stats["group_windows"] += 1
+                if node is None or self._stop.is_set() \
+                        or not (node.is_leader
+                                and node.current_term == terms[gid]):
+                    st.gen = None
+                    continue
+                self._adopt_commit(gid, st, node, res[gid])
+                self._note_quorum(gid, st, node, res[gid] > end0)
+        return True
+
+    def _check_recompiles(self) -> None:
+        for name, old, new in self.runner.check_recompiles():
+            self.daemon.node._note("devplane", "recompile", exe=name,
+                                   cached_before=old, cached_after=new)
+            self.logger.warning(
+                "group plane: post-warmup XLA recompile (%r)", name)
+
+    def _adopt_commit(self, gid: int, st: _GState, node,
+                      dev_commit: int) -> None:
+        cap = node.flr_commit_cap()
+        if cap is not None:
+            dev_commit = min(dev_commit, cap)
+        if node.log.commit >= st.base and dev_commit > node.log.commit:
+            before = node.log.commit
+            after = node.log.advance_commit(min(dev_commit,
+                                                node.log.end))
+            if after > before:
+                st.last_adv = time.monotonic()
+                node.bump("commits")
+                node.bump("devplane_commits")
+                self.daemon.commit_cond.notify_all()
+
+    def _note_quorum(self, gid: int, st: _GState, node,
+                     advanced: bool) -> None:
+        if advanced:
+            st.qfail_since = None
+            return
+        now = time.monotonic()
+        if st.qfail_since is None:
+            st.qfail_since = now
+            return
+        window = max(4 * self.daemon.spec.hb_timeout, 0.5)
+        if now - st.qfail_since > window:
+            st.qfail_since = None
+            st.qfail_pause_until = now + window
+            if node.external_commit:
+                self._set_owned(node, False, "quorum_fail_streak")
+                self.stats["fallbacks"] += 1
+            st.cooldown_until = max(st.cooldown_until, now + window)
+            st.gen = None               # cursor diverged: re-base
+            self.stats["qfail_timeouts"] = \
+                self.stats.get("qfail_timeouts", 0) + 1
+
+    # -- follower drain + election reconciliation --------------------------
+
+    def _follower_drain(self, gid: int) -> bool:
+        node = self.daemon.group_node(gid)
+        st = self._g[gid]
+        if node is None \
+                or not self.runner.covers_replica(self.daemon.idx):
+            return False
+        gen = self.runner.generations[gid]
+        if gen == 0:
+            return False
+        key = (gen, self.runner.stats["rounds"])
+        if key == st.drain_idle_key:
+            return False
+        with self.daemon.lock:
+            if node.is_leader:
+                return False
+            term = node.current_term
+            end = node.log.end
+            prev = node.log.get(end - 1)
+            if prev is None or prev.term != term:
+                return False
+        shard_end = self.runner.shard_end(gid, self.daemon.idx, gen)
+        if shard_end is None or shard_end <= end:
+            st.drain_idle_key = key
+            return False
+        rows = self.runner.read_rows(
+            gid, self.daemon.idx, gen, end,
+            min(shard_end,
+                end + self.runner.max_depth * self.runner.batch),
+            window=shard_end - end > self.runner.batch)
+        if not rows:
+            st.drain_idle_key = key
+            return False
+        appended = 0
+        with self.daemon.lock:
+            if node.is_leader or node.current_term != term:
+                return False
+            for e in rows:
+                if e.term != term or e.idx != node.log.end \
+                        or node.log.near_full(1):
+                    break
+                node.log.write(e)
+                appended += 1
+        self.stats["drained"] += appended
+        return appended > 0
+
+    def _make_election_hook(self, gid: int):
+        """pre_election_hook closure: absorb this group's shard into
+        the host log before this replica votes or campaigns in that
+        group (the device quorum attests SHARD placement)."""
+
+        def hook():
+            node = self.daemon.group_node(gid)
+            if node is None \
+                    or not self.runner.covers_replica(self.daemon.idx):
+                return
+            while True:
+                gen = self.runner.generations[gid]
+                if gen == 0:
+                    return
+                term = node.current_term
+                end = node.log.end
+                prev = node.log.get(end - 1)
+                if prev is None or prev.term != term:
+                    return
+                shard_end = self.runner.shard_end(gid, self.daemon.idx,
+                                                  gen)
+                if shard_end is None or shard_end <= end:
+                    return
+                rows = self.runner.read_rows(
+                    gid, self.daemon.idx, gen, end,
+                    min(shard_end, end + self.runner.max_depth
+                        * self.runner.batch),
+                    window=shard_end - end > self.runner.batch)
+                if not rows:
+                    return
+                appended = 0
+                for e in rows:
+                    if e.term != term or e.idx != node.log.end \
+                            or node.log.near_full(1):
+                        break
+                    node.log.write(e)
+                    appended += 1
+                self.stats["drained"] += appended
+                if appended == 0:
+                    return
+
+        return hook

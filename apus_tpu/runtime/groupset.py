@@ -1,0 +1,309 @@
+"""GroupSet: N independent consensus groups multiplexed over one daemon.
+
+The Multi-Raft substrate (ROADMAP "Multi-group sharded consensus"):
+the keyspace is sharded into ``spec.groups`` independent consensus
+groups — per-group ``Node`` state (log, state machine, endpoint DB,
+cid/config epochs, leases, incarnation) — multiplexed over the SAME
+daemon set, listen sockets, transport connections, fault plane, clock
+seam, and (when enabled) device plane.  DXRAM-style range partitioning
+reaches scale exactly this way: many small replication groups per node,
+one infrastructure set (PAPERS.md).
+
+Shared vs per-group state:
+
+    shared (one per daemon)            per group (one per gid)
+    -------------------------------    --------------------------------
+    PeerServer ingest loop + socket    Node (log, sm, epdb, cid, sid)
+    NetTransport connections/backoff   GroupTransport view (OP_GROUP)
+    FaultPlane (one schedule)          leases (leader + follower)
+    SkewClock (one time domain)        incarnation / fence tables
+    failure-evidence (dial/timeout)    election timers (same envelope,
+    tick thread + node lock              per-group rng phase)
+    heartbeat COALESCER (OP_HB_MULTI)  REP_ACK / vote / HB regions
+    obs hub (counters aggregate;       pending client requests/reads
+      per-group gauges at scrape)      snapshots / catch-up state
+
+Wire: group 0 frames are never wrapped (``groups == 1`` stays
+byte-identical to the single-group protocol); groups 1..N-1 ride
+``wire.OP_GROUP | gid | <inner frame>`` through the same sockets, and
+the PeerServer demuxes on gid (``PeerServer.group_ref``).
+
+Heartbeat coalescing: each leader-role node registers its HB round with
+the daemon-level coalescer (``Node.hb_sink``) instead of fanning out
+per-group ctrl writes; after the tick pass the GroupSet flushes ONE
+``OP_HB_MULTI`` frame per peer carrying every registered group's
+(term, commit, lease, incarnation) vector, and distributes the
+per-group reply echoes back into each node's lease-renewal accounting
+(``Node.hb_round_finish``) — N groups' failure detection and lease
+renewal ride one frame per peer per period.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from apus_tpu.core.cid import Cid
+from apus_tpu.core.node import Node
+from apus_tpu.models.kvs import KvsStateMachine
+from apus_tpu.parallel import wire
+from apus_tpu.parallel.net import GroupTransport
+
+
+class GroupPort:
+    """What ``PeerServer.group_ref(gid)`` returns: the group's node for
+    one-sided ops plus its two-sided handler table (client, membership,
+    flr ops bound to that node)."""
+
+    __slots__ = ("node", "extra_ops")
+
+    def __init__(self, node: Node, extra_ops: dict):
+        self.node = node
+        self.extra_ops = extra_ops
+
+
+class GroupSet:
+    """All extra consensus groups (gid 1..n-1) of one daemon.  Group 0
+    stays the daemon's primary ``daemon.node`` — membership service
+    discovery, persistence, and the app bridge keep riding it — but is
+    also reachable through port(0) so uniformly group-wrapped clients
+    work."""
+
+    def __init__(self, daemon, n_groups: int,
+                 cids: Optional[dict] = None,
+                 sm_factory=KvsStateMachine):
+        assert n_groups >= 2, n_groups
+        self.daemon = daemon
+        self.n_groups = n_groups
+        self.nodes: list[Node] = [daemon.node]
+        self._ports: dict[int, GroupPort] = {}
+        self._hb_items: list[tuple] = []      # (node, my_sid, t0)
+        self._wake: tuple = ()
+        self._last_roles: dict[int, tuple] = {}
+        cids = cids or {}
+        cfg0 = daemon._node_cfg
+        for gid in range(1, n_groups):
+            # Per-group election phase: same timing envelope, distinct
+            # rng stream per (daemon, gid) so different groups tend to
+            # elect leaders on different daemons (the load-spreading
+            # the sharding exists for), while the ENVELOPE — and the
+            # clock seam every timer reads — stays shared.
+            cfg = dataclasses.replace(cfg0, seed=cfg0.seed + 7919 * gid)
+            gt = GroupTransport(daemon.transport, gid)
+            cid = cids.get(gid) or Cid.initial(daemon.spec.group_size)
+            node = Node(cfg, cid, sm_factory(), gt)
+            node.gid = gid
+            node.clock = daemon.clock
+            node.async_snap_push = True
+            if cids.get(gid) is not None:
+                node.incarnation = cid.epoch
+            gt.incarnation_of = (lambda n=node: n.incarnation)
+            if daemon.obs is not None:
+                node.attach_obs(daemon.obs)
+            # Same cold-start election grace as the primary node.
+            node._last_hb_seen = (daemon.clock()
+                                  + node.rng.random()
+                                  * node.cfg.elect_high)
+            node.hb_sink = self.hb_sink
+            self._install_flr(node, gt)
+            self.nodes.append(node)
+        # Group 0 heartbeats coalesce into the same per-peer frames.
+        daemon.node.hb_sink = self.hb_sink
+        self._build_ports()
+
+    # -- ports (PeerServer demux) -----------------------------------------
+
+    def _build_ports(self) -> None:
+        from apus_tpu.runtime.client import make_client_ops
+        from apus_tpu.runtime.flr import make_flr_ops
+        from apus_tpu.runtime.membership import make_membership_ops
+        for gid, node in enumerate(self.nodes):
+            ops = {**make_client_ops(self.daemon, node=node),
+                   **make_membership_ops(self.daemon, node=node),
+                   **make_flr_ops(self.daemon, node=node)}
+            self._ports[gid] = GroupPort(node, ops)
+
+    def port(self, gid: int) -> Optional[GroupPort]:
+        return self._ports.get(gid)
+
+    def node(self, gid: int) -> Optional[Node]:
+        return self.nodes[gid] if 0 <= gid < len(self.nodes) else None
+
+    # -- tick integration (runs under the daemon lock) ---------------------
+
+    def tick(self, now: float) -> None:
+        """Tick every EXTRA group (the daemon ticks group 0 itself),
+        drain their upcalls, and record role edges.  Called under the
+        daemon lock from the tick thread, after group 0's tick."""
+        for node in self.nodes[1:]:
+            node.tick(now)
+            self._drain_group_upcalls(node)
+            self._log_role(node)
+
+    def wake_state(self) -> tuple:
+        """Extra groups' contribution to the daemon's waiter-predicate
+        wake tuple (apply/commit/end/role/term/reads per group)."""
+        return tuple((n.log.apply, n.log.commit, n.log.end, n.role,
+                      n.current_term, n.reads_done)
+                     for n in self.nodes[1:])
+
+    def begin_drain(self) -> None:
+        """Graceful leave: stop every group's voting/acking (the daemon
+        flips group 0 itself)."""
+        for node in self.nodes[1:]:
+            node.draining = True
+
+    def _log_role(self, node: Node) -> None:
+        role = (node.role, node.current_term)
+        if role != self._last_roles.get(node.gid):
+            self._last_roles[node.gid] = role
+            if self.daemon.obs is not None:
+                self.daemon.obs.flight.note(
+                    "role", node.role.name, gid=node.gid,
+                    term=node.current_term, commit=node.log.commit)
+            self.daemon.logger.info("[g%d T%d] %s", node.gid,
+                                    node.current_term, node.role.name)
+
+    def _drain_group_upcalls(self, node: Node) -> None:
+        # Extra groups carry no persistence (restart recovery is
+        # snapshot catch-up from peers — their durability is
+        # replication) and no app bridge, so committed/snapshot
+        # upcalls are consumed without observers.
+        if node.committed_upcalls:
+            node.committed_upcalls.clear()
+        if node.snapshot_upcalls:
+            node.snapshot_upcalls.clear()
+        if node.config_upcalls:
+            cfgs, node.config_upcalls = node.config_upcalls, []
+            for e in cfgs:
+                self._group_config(node, e)
+
+    def _group_config(self, node: Node, e) -> None:
+        """Applied CONFIG entry in an extra group: learn peer addresses
+        into the SHARED peer table/transport.  Guarded on address
+        change — group 0 applies the same join and owns the full
+        set_peer (connection + established-state reset); re-running it
+        per group would drop the shared connection N times."""
+        if not e.data or e.data.startswith(b"leave "):
+            return
+        try:
+            slot_s, addr = e.data.decode().split(" ", 1)
+            slot = int(slot_s)
+        except ValueError:
+            return
+        peers = self.daemon.spec.peers
+        known = peers[slot] if slot < len(peers) else ""
+        if addr == known:
+            return
+        if slot != self.daemon.idx:
+            host, port_s = addr.rsplit(":", 1)
+            self.daemon.transport.set_peer(slot, (host, int(port_s)))
+        while len(peers) <= slot:
+            peers.append("")
+        peers[slot] = addr
+
+    # -- follower read leases (per group) ----------------------------------
+
+    def _install_flr(self, node: Node, gt: GroupTransport) -> None:
+        from apus_tpu.runtime.flr import OP_FLR_LEASE
+        daemon = self.daemon
+
+        def request(leader_idx: int, node=node, gt=gt):
+            payload = (wire.u8(OP_FLR_LEASE) + wire.u8(daemon.idx)
+                       + wire.u32(node.incarnation))
+            resp = gt.request(leader_idx, payload)
+            if not resp or resp[0] != wire.ST_OK or len(resp) < 33:
+                return None
+            rr = wire.Reader(resp[1:])
+            return {"term": rr.u64(), "epoch": rr.u64(),
+                    "floor": rr.u64(), "dur": rr.u64() / 1e6}
+
+        node.lease_requester = request
+
+    # -- coalesced heartbeats ----------------------------------------------
+
+    def hb_sink(self, node: Node, my, t0: float) -> None:
+        """Node._send_heartbeats registration point (under the daemon
+        lock, inside that node's tick)."""
+        self._hb_items.append((node, my, t0))
+
+    def flush_heartbeats(self) -> None:
+        """One OP_HB_MULTI frame per peer carrying every group
+        registered this tick pass; per-group results distributed back
+        into Node.hb_round_finish.  Called under the daemon lock after
+        ALL groups ticked; the transport yields the lock on the wire
+        (hb_round_finish re-validates leadership before renewing)."""
+        items, self._hb_items = self._hb_items, []
+        if not items:
+            return
+        daemon = self.daemon
+        fresh = daemon.clock()
+        # peer -> [(item_pos_in_frame, node, my, t0)]
+        per_peer: dict[int, list] = {}
+        frames: dict[int, list] = {}
+        for node, my, t0 in items:
+            lease_us = max(0, min(0xFFFFFFFF,
+                                  int((node._lease_until - fresh) * 1e6)))
+            for peer in node._replication_targets():
+                lst = frames.setdefault(peer, [])
+                per_peer.setdefault(peer, []).append(
+                    (len(lst), node, my, t0))
+                lst.append((node.gid, my.word, node.log.commit,
+                            lease_us, node.incarnation))
+        daemon.node.bump("hb_coalesced_groups", len(items))
+        # node -> {peer: (status, echo)}
+        results: dict[int, dict] = {id(n): {} for n, _m, _t in items}
+        for peer, lst in frames.items():
+            payload = wire.encode_hb_multi(daemon.idx, lst)
+            resp = daemon.transport.request(peer, payload)
+            echoes = (wire.decode_hb_echoes(resp, len(lst))
+                      if resp is not None else None)
+            for pos, node, my, t0 in per_peer[peer]:
+                if echoes is None:
+                    results[id(node)][peer] = ("fail", None)
+                    continue
+                st, word = echoes[pos]
+                if st == wire.ST_FENCED:
+                    results[id(node)][peer] = ("fenced", None)
+                elif st == wire.ST_OK:
+                    results[id(node)][peer] = ("ok", word)
+                else:
+                    results[id(node)][peer] = ("fail", None)
+        for node, my, t0 in items:
+            node.hb_round_finish(my, t0, results[id(node)])
+
+    # -- observability ------------------------------------------------------
+
+    def status_view(self) -> dict:
+        """The OP_STATUS ``groups`` view: per-group role/term/offsets/
+        config — callers assert per-group convergence over the wire
+        instead of log-scraping.  Under the daemon lock."""
+        out = {}
+        for gid, n in enumerate(self.nodes):
+            out[str(gid)] = {
+                "role": n.role.name,
+                "is_leader": n.is_leader,
+                "term": n.current_term,
+                "leader_hint": n.leader_hint,
+                "commit": n.log.commit,
+                "apply": n.log.apply,
+                "end": n.log.end,
+                "epoch": n.cid.epoch,
+                "cid_state": n.cid.state.name,
+                "members": [i for i in range(n.cid.extended_group_size)
+                            if n.cid.contains(i)],
+            }
+        return out
+
+    def scrape_gauges(self, registry) -> None:
+        """Per-group dimension for the OP_METRICS scrape: a small fixed
+        set of per-group namespaced gauges (``nodeg<gid>_*``), mirrored
+        at scrape time like the daemon_* gauges."""
+        for gid, n in enumerate(self.nodes):
+            p = f"nodeg{gid}"
+            registry.gauge(f"{p}_term").set(n.current_term)
+            registry.gauge(f"{p}_commit").set(n.log.commit)
+            registry.gauge(f"{p}_apply").set(n.log.apply)
+            registry.gauge(f"{p}_end").set(n.log.end)
+            registry.gauge(f"{p}_is_leader").set(1 if n.is_leader else 0)
+            registry.gauge(f"{p}_epoch").set(n.cid.epoch)

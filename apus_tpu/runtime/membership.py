@@ -73,23 +73,48 @@ class LeaveRefusedError(RuntimeError):
     could never commit or elect again)."""
 
 
-def make_membership_ops(daemon) -> dict:
+def make_membership_ops(daemon, node=None) -> dict:
     """Extra PeerServer ops: JOIN + LEAVE (run on per-connection
-    threads)."""
+    threads).  ``node`` binds the handlers to one consensus group's
+    node (multi-group daemons admit a joiner into EVERY group — the
+    joiner runs the join protocol per group, each against that group's
+    leader); None = the primary group."""
+    node = node if node is not None else daemon.node
 
     def join(r: wire.Reader) -> bytes:
         addr = r.blob().decode()
         want_slot = r.u8() if r.remaining else None
         with daemon.lock:
-            pj = daemon.node.handle_join(addr, want_slot=want_slot)
-            reason = daemon.node.last_join_refusal
+            if want_slot is not None and node.is_leader \
+                    and node.cid.contains(want_slot) \
+                    and want_slot < len(daemon.spec.peers) \
+                    and daemon.spec.peers[want_slot] == addr:
+                # Already a member at its OWN address: idempotent
+                # admission, no CONFIG.  This is the multi-group
+                # rejoin case — a daemon evicted from SOME groups
+                # rejoins every group, and a group whose failure
+                # detector never fired still lists the slot (bound to
+                # this exact address, so the stranger-demands-a-bound-
+                # slot refusal below does not apply).
+                import dataclasses as _dc
+                daemon.logger.info("JOIN[g%d] %s already member at "
+                                   "slot %d (idempotent)", node.gid,
+                                   addr, want_slot)
+                return (wire.u8(wire.ST_OK) + wire.u8(want_slot)
+                        + wire.encode_cid(node.cid)
+                        + wire.blob(json.dumps(
+                            daemon.spec.peers).encode())
+                        + wire.blob(json.dumps(
+                            _dc.asdict(daemon.spec)).encode()))
+            pj = node.handle_join(addr, want_slot=want_slot)
+            reason = node.last_join_refusal
         if pj is None:
             if reason is None:
-                return _not_leader(daemon)
+                return _not_leader(daemon, node=node)
             # We ARE the leader but refused: answer typed, never
             # NOT_LEADER — a hint-chase for a leader the joiner
             # already found stalls it for its whole deadline.
-            transient = reason in daemon.node.TRANSIENT_REFUSALS
+            transient = reason in node.TRANSIENT_REFUSALS
             return (wire.u8(ST_RETRY if transient else ST_REFUSED)
                     + wire.blob(reason.encode()))
         deadline = time.monotonic() + daemon.client_op_timeout
@@ -102,8 +127,8 @@ def make_membership_ops(daemon) -> dict:
                     return (wire.u8(ST_RETRY)
                             + wire.blob(b"resize_aborted"))
                 if pj.done:
-                    daemon.logger.info("JOIN %s -> slot %d (%r)", addr,
-                                       pj.slot, daemon.node.cid)
+                    daemon.logger.info("JOIN[g%d] %s -> slot %d (%r)",
+                                       node.gid, addr, pj.slot, node.cid)
                     # The reply carries the full peer table AND the
                     # cluster spec: a seed-bootstrapped joiner (daemon
                     # --seed host:port, no config file) learns the
@@ -113,13 +138,13 @@ def make_membership_ops(daemon) -> dict:
                     # (dare_ibv_ud.c:1451-1498).
                     import dataclasses as _dc
                     return (wire.u8(wire.ST_OK) + wire.u8(pj.slot)
-                            + wire.encode_cid(daemon.node.cid)
+                            + wire.encode_cid(node.cid)
                             + wire.blob(json.dumps(
                                 daemon.spec.peers).encode())
                             + wire.blob(json.dumps(
                                 _dc.asdict(daemon.spec)).encode()))
-                if not daemon.node.is_leader:
-                    return _not_leader(daemon)
+                if not node.is_leader:
+                    return _not_leader(daemon, node=node)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT)
@@ -140,22 +165,22 @@ def make_membership_ops(daemon) -> dict:
             daemon.begin_drain("operator notify")
             return wire.u8(wire.ST_OK)
         with daemon.lock:
-            pl = daemon.node.handle_leave(slot)
+            pl = node.handle_leave(slot)
         if pl is None:
-            return _not_leader(daemon)
+            return _not_leader(daemon, node=node)
         if isinstance(pl, str):
-            transient = pl in daemon.node.TRANSIENT_REFUSALS
+            transient = pl in node.TRANSIENT_REFUSALS
             return (wire.u8(ST_RETRY if transient else ST_REFUSED)
                     + wire.blob(pl.encode()))
         deadline = time.monotonic() + daemon.client_op_timeout
         with daemon.commit_cond:
             while True:
                 if pl.done:
-                    daemon.logger.info("LEAVE slot %d committed (%r)",
-                                       slot, daemon.node.cid)
+                    daemon.logger.info("LEAVE[g%d] slot %d committed "
+                                       "(%r)", node.gid, slot, node.cid)
                     return wire.u8(wire.ST_OK) + wire.u8(slot)
-                if not daemon.node.is_leader:
-                    return _not_leader(daemon)
+                if not node.is_leader:
+                    return _not_leader(daemon, node=node)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return wire.u8(ST_TIMEOUT)
@@ -239,20 +264,20 @@ def request_join_spec(peers: list[str], my_addr: str,
     raise TimeoutError(f"join of {my_addr} not admitted in {timeout}s")
 
 
-def request_leave(peers: list[str], slot: int,
-                  timeout: float = 15.0,
-                  victim_addr: Optional[str] = None) -> bool:
-    """Operator side of the graceful leave: find the leader, have it
-    commit the removal of ``slot``, then best-effort notify the
-    drained replica (mode-1 OP_LEAVE) so it exits clean even if the
-    removal committed without reaching it.  Returns True once the
-    removal is committed.  Raises :class:`LeaveRefusedError` on a
-    permanent typed refusal and TimeoutError past the deadline."""
-    payload = wire.u8(OP_LEAVE) + wire.u8(slot)
+def request_join_group(peers: list[str], my_addr: str, gid: int,
+                       want_slot: int,
+                       timeout: float = 15.0) -> Cid:
+    """Joiner side for ONE extra consensus group (gid > 0): run the
+    join protocol against THAT group's leader (group-wrapped OP_JOIN,
+    chasing that group's NOT_LEADER hints) at exactly ``want_slot`` —
+    slots must agree across groups, since a daemon's identity (peer
+    table index, transport endpoint) is slot-keyed.  Returns the
+    group's admission cid."""
+    payload = (wire.u8(wire.OP_GROUP) + wire.u8(gid)
+               + wire.u8(OP_JOIN) + wire.blob(my_addr.encode())
+               + wire.u8(want_slot))
     deadline = time.monotonic() + timeout
-    candidates = [p for p in peers if p]
-    if victim_addr is None and slot < len(peers):
-        victim_addr = peers[slot]
+    candidates = list(peers)
     rng = random.Random()
     backoff = _Backoff(rng)
     i = 0
@@ -265,9 +290,14 @@ def request_leave(peers: list[str], slot: int,
             continue
         st = resp[0]
         if st == wire.ST_OK:
-            if victim_addr:
-                _notify_drained(victim_addr, slot)
-            return True
+            r = wire.Reader(resp[1:])
+            slot = r.u8()
+            cid = wire.decode_cid(r)
+            if slot != want_slot:
+                raise JoinRefusedError(
+                    f"group {gid} admitted {my_addr} at slot {slot} != "
+                    f"wanted {want_slot}")
+            return cid
         if st == ST_NOT_LEADER:
             hint = wire.Reader(resp[1:]).blob().decode() \
                 if len(resp) > 1 else ""
@@ -279,10 +309,96 @@ def request_leave(peers: list[str], slot: int,
             time.sleep(0.01)
             continue
         if st == ST_REFUSED:
-            raise LeaveRefusedError(
-                f"leave of slot {slot} refused: {_reason(resp)}")
+            raise JoinRefusedError(
+                f"group {gid} join of {my_addr} refused: "
+                f"{_reason(resp)} (want_slot={want_slot})")
         backoff.sleep(deadline)
-    raise TimeoutError(f"leave of slot {slot} not committed in {timeout}s")
+    raise TimeoutError(f"group {gid} join of {my_addr} not admitted "
+                       f"in {timeout}s")
+
+
+def request_join_all_groups(peers: list[str], my_addr: str, slot: int,
+                            n_groups: int,
+                            timeout: float = 30.0) -> dict:
+    """Join every EXTRA group (1..n_groups-1) at ``slot`` (group 0's
+    assignment).  Returns {gid: cid} — possibly MISSING groups whose
+    join timed out (a group mid-election/mid-resize under churn can
+    stall past any reasonable boot budget; the daemon finishes those
+    admissions in the background via
+    ``ReplicaDaemon.retry_group_joins`` instead of dying at boot).  A
+    PERMANENT refusal still propagates — the daemon must not serve a
+    group it was denied."""
+    cids = {}
+    for gid in range(1, n_groups):
+        try:
+            cids[gid] = request_join_group(peers, my_addr, gid, slot,
+                                           timeout=timeout)
+        except TimeoutError:
+            continue                     # deferred (retry thread)
+    return cids
+
+
+def request_leave(peers: list[str], slot: int,
+                  timeout: float = 15.0,
+                  victim_addr: Optional[str] = None,
+                  groups: int = 1) -> bool:
+    """Operator side of the graceful leave: find the leader, have it
+    commit the removal of ``slot``, then best-effort notify the
+    drained replica (mode-1 OP_LEAVE) so it exits clean even if the
+    removal committed without reaching it.  Returns True once the
+    removal is committed.  Raises :class:`LeaveRefusedError` on a
+    permanent typed refusal and TimeoutError past the deadline.
+
+    ``groups > 1``: the removal is committed in EVERY consensus group
+    — group 0 first (its "leave" marker is what drains the victim
+    daemon), then each extra group via group-wrapped OP_LEAVE against
+    THAT group's leader.  An extra group that already evicted the slot
+    (auto-removal raced) answers done idempotently."""
+    deadline = time.monotonic() + timeout
+    candidates = [p for p in peers if p]
+    if victim_addr is None and slot < len(peers):
+        victim_addr = peers[slot]
+    rng = random.Random()
+
+    def _leave_one(payload: bytes, tag: str) -> None:
+        backoff = _Backoff(rng)
+        i = 0
+        cands = list(candidates)
+        while time.monotonic() < deadline:
+            target = cands[i % len(cands)]
+            i += 1
+            resp = _roundtrip(target, payload, deadline)
+            if resp is None:
+                backoff.sleep(deadline)
+                continue
+            st = resp[0]
+            if st == wire.ST_OK:
+                return
+            if st == ST_NOT_LEADER:
+                hint = wire.Reader(resp[1:]).blob().decode() \
+                    if len(resp) > 1 else ""
+                if hint and hint not in cands:
+                    cands.append(hint)
+                if hint:
+                    i = cands.index(hint)
+                    backoff.reset()
+                time.sleep(0.01)
+                continue
+            if st == ST_REFUSED:
+                raise LeaveRefusedError(
+                    f"leave of slot {slot} ({tag}) refused: "
+                    f"{_reason(resp)}")
+            backoff.sleep(deadline)
+        raise TimeoutError(f"leave of slot {slot} ({tag}) not "
+                           f"committed in {timeout}s")
+
+    _leave_one(wire.u8(OP_LEAVE) + wire.u8(slot), "g0")
+    for gid in range(1, max(1, groups)):
+        _leave_one(wire.u8(wire.OP_GROUP) + wire.u8(gid)
+                   + wire.u8(OP_LEAVE) + wire.u8(slot), f"g{gid}")
+    if victim_addr:
+        _notify_drained(victim_addr, slot)
+    return True
 
 
 def _notify_drained(victim_addr: str, slot: int,

@@ -427,7 +427,8 @@ class ProcCluster:
                  if p and i != idx and i < len(self.procs)
                  and self.procs[i] is not None]
         request_leave(peers, idx, timeout=timeout,
-                      victim_addr=self.spec.peers[idx])
+                      victim_addr=self.spec.peers[idx],
+                      groups=getattr(self.spec, "groups", 1))
         p = self.procs[idx]
         if p is not None:
             try:

@@ -1,0 +1,35 @@
+"""Key -> consensus-group router (Multi-Raft client side).
+
+The keyspace is sharded across ``spec.groups`` independent consensus
+groups by a STABLE hash of the key bytes: every client (and every
+harness, in every process, on every run) must route a given key to the
+same group, or exactly-once breaks — the per-group endpoint DBs dedup
+(clt_id, req_id) pairs, so a retry that hopped groups would re-execute.
+CRC32 is stable across Python versions/processes (zlib), cheap, and
+well-mixed enough after the golden-ratio spread for small group counts.
+
+Contract (pinned by tests/test_multigroup.py):
+- ``group_of_key(key, 1) == 0`` for every key (single-group routing is
+  the identity — zero-cost back-compat);
+- deterministic: same key, same group count -> same group, forever
+  (changing this function is a WIRE-LEVEL compatibility break for any
+  deployment with persisted multi-group state);
+- all groups reachable (the test pins a coverage distribution).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+#: 32-bit golden-ratio multiplier: spreads CRC32's low-bit structure
+#: before the modulo so tiny group counts still see all groups.
+_SPREAD = 0x9E3779B1
+_MASK = 0xFFFFFFFF
+
+
+def group_of_key(key: bytes, groups: int) -> int:
+    """Stable key -> group id in [0, groups)."""
+    if groups <= 1:
+        return 0
+    h = (zlib.crc32(key) * _SPREAD) & _MASK
+    return (h >> 16) % groups

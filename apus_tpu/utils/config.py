@@ -23,6 +23,16 @@ class ClusterSpec:
     """Shared cluster configuration (nodes.local.cfg analog)."""
 
     group_size: int = 3
+    # Multi-group sharded consensus (Multi-Raft): the keyspace is
+    # sharded into ``groups`` independent consensus groups multiplexed
+    # over the SAME daemon set, sockets, fault plane, and device plane
+    # (runtime/groupset.py).  Group 0 is the primary (membership
+    # service, persistence, bridge); groups 1..N-1 ride OP_GROUP-
+    # wrapped frames and coalesced per-peer heartbeats (OP_HB_MULTI).
+    # groups == 1 (default) is ZERO-COST: no group machinery is built
+    # and every wire frame is byte-identical to the single-group
+    # protocol.
+    groups: int = 1
     # timing (seconds; reference DEBUG values: hb=10ms, elect=100-300ms,
     # nodes.local.cfg:22-37)
     hb_period: float = 0.010

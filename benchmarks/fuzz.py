@@ -33,6 +33,7 @@ import json
 import os
 import random
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -520,9 +521,102 @@ def _check_linear_resolving(recorder, stats: dict):
     return res
 
 
+
+def _keys_covering(prefix: bytes, n_min: int, groups: int,
+                   rng: random.Random) -> list:
+    """Key set of >= n_min keys that REACHES every consensus group
+    (multi-group trials must drive traffic through every group's log,
+    or the per-group audit proves nothing about the groups it missed)."""
+    from apus_tpu.runtime.router import group_of_key
+    keys: list = []
+    seen: set = set()
+    i = 0
+    while len(keys) < n_min or len(seen) < max(1, groups):
+        k = prefix + b"%d" % i
+        i += 1
+        keys.append(k)
+        seen.add(group_of_key(k, groups))
+        if i > 4096:
+            raise AssertionError("router never covered all groups")
+    return keys
+
+
+def _group_leader_idx(pc, gid: int, timeout: float = 15.0) -> int:
+    """Daemon index currently leading consensus group ``gid`` (the
+    churn nemesis's seeded victim-group pick)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for i in range(len(pc.procs)):
+            if pc.procs[i] is None:
+                continue
+            st = pc.status(i, timeout=0.5) or {}
+            gv = (st.get("groups") or {}).get(str(gid))
+            if gid == 0 and gv is None and st.get("is_leader"):
+                return i
+            if gv is not None and gv.get("is_leader"):
+                return i
+        time.sleep(0.05)
+    raise AssertionError(f"no leader for group {gid} within {timeout}s")
+
+
+def _wait_groups_converged(pc, groups: int,
+                           timeout: float = 60.0,
+                           same_members: bool = False) -> dict:
+    """Every group converged: one agreed (epoch, members) STABLE view
+    across all live replicas and exactly one leader per group —
+    asserted over the OP_STATUS ``groups`` view, per group."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        per_group: dict = {}
+        ok = True
+        live = [i for i in range(len(pc.procs))
+                if pc.procs[i] is not None]
+        for i in live:
+            st = pc.status(i, timeout=1.0)
+            if not st or "groups" not in st:
+                ok = False
+                break
+            for g, gv in st["groups"].items():
+                per_group.setdefault(g, []).append(gv)
+        if ok and len(per_group) == groups:
+            done = True
+            for g, vs in per_group.items():
+                if len(vs) != len(live):
+                    done = False
+                    break
+                views = {(v["epoch"], tuple(v["members"]),
+                          v["cid_state"]) for v in vs}
+                if len(views) != 1 \
+                        or next(iter(views))[2] != "STABLE":
+                    done = False
+                    break
+                if sum(1 for v in vs if v["is_leader"]) != 1:
+                    done = False
+                    break
+            if done and same_members:
+                # Symmetric membership: an operation that must land in
+                # EVERY group (e.g. a graceful leave) needs each
+                # group's member set caught up to the same view first
+                # (a group whose deferred rejoin is still in flight
+                # would refuse the removal on its quorum floor).
+                sets = {tuple(sorted(vs[0]["members"]))
+                        for vs in per_group.values()}
+                if len(sets) != 1:
+                    done = False
+            if done:
+                return {g: vs[0] for g, vs in per_group.items()}
+        last = {g: [(v["epoch"], v["cid_state"], v["is_leader"])
+                    for v in vs] for g, vs in per_group.items()}
+        time.sleep(0.2)
+    raise AssertionError(
+        f"groups never converged within {timeout}s: {last}")
+
+
 def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                        dump_obs: "str | None" = None,
-                       time_nemesis: bool = False) -> dict:
+                       time_nemesis: bool = False,
+                       groups: int = 1) -> dict:
     """One CONSISTENCY-AUDIT chaos trial on the deployment shape: a
     3-replica ProcCluster with the live fault plane, concurrent client
     workers (serial AND pipelined paths) recording every op's
@@ -562,8 +656,10 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
     # Fixed membership: eviction/rejoin semantics are the simulator
     # campaign's subject; here a killed member must stay a member so
     # its restart exercises store recovery, not the join protocol.
-    spec = _dc.replace(PROC_SPEC, auto_remove=False)
-    keys = [b"ak%d" % i for i in range(rng.randint(4, 7))]
+    spec = _dc.replace(PROC_SPEC, auto_remove=False, groups=groups)
+    keys = (_keys_covering(b"ak", rng.randint(4, 7), groups, rng)
+            if groups > 1
+            else [b"ak%d" % i for i in range(rng.randint(4, 7))])
     recorder = HistoryRecorder(capacity=1 << 18)
     stop = threading.Event()
     n_workers = 3
@@ -577,7 +673,8 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
         # worker 0 stays leader-routed for contrast.
         policy = "spread" if time_nemesis and wid > 0 else "leader"
         with ApusClient(peers, timeout=6.0, attempt_timeout=1.0,
-                        history=recorder, read_policy=policy) as c:
+                        history=recorder, read_policy=policy,
+                        groups=groups) as c:
             while not stop.is_set():
                 try:
                     roll = wrng.random()
@@ -587,16 +684,21 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                     elif roll < 0.8:
                         c.get(wrng.choice(keys))
                     else:
+                        # Raw pipeline ops carry their gid explicitly
+                        # (2-tuple ops route to group 0 by contract —
+                        # only the KVS helpers hash the key).
                         ops = []
                         for _ in range(wrng.randint(4, 12)):
+                            k = wrng.choice(keys)
                             if wrng.random() < 0.5:
                                 n += 1
                                 ops.append((OP_CLT_WRITE, encode_put(
-                                    wrng.choice(keys),
-                                    b"w%d.%d" % (wid, n))))
+                                    k, b"w%d.%d" % (wid, n)),
+                                    c.group_of(k)))
                             else:
-                                ops.append((OP_CLT_READ, encode_get(
-                                    wrng.choice(keys))))
+                                ops.append((OP_CLT_READ,
+                                            encode_get(k),
+                                            c.group_of(k)))
                         c.pipeline(ops)
                 except (TimeoutError, RuntimeError, OSError,
                         ConnectionError):
@@ -660,8 +762,15 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                 _dbg(f"pause round done ({nemesis['pauses']})")
 
             # Phase 2: leader SIGKILL mid-group-commit, restart with a
-            # seeded disk fault on the recovery path.
-            kill_restart(pc.leader_idx(timeout=15.0))
+            # seeded disk fault on the recovery path.  Multi-group:
+            # the nemesis picks its VICTIM GROUP seeded and kills THAT
+            # group's leader (different groups may lead elsewhere).
+            if groups > 1:
+                vg = rng.randrange(groups)
+                _dbg(f"victim group {vg}")
+                kill_restart(_group_leader_idx(pc, vg, timeout=15.0))
+            else:
+                kill_restart(pc.leader_idx(timeout=15.0))
             _dbg("phase2 leader kill/restart done")
             _time.sleep(rng.uniform(1.0, 2.0))
             if time_nemesis and rng.random() < 0.7:
@@ -701,9 +810,11 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
             # write is a linearizability violation too.  Under the time
             # nemesis it runs SPREAD, so the final reads exercise the
             # healed followers' leases as well.
+            gview = (_wait_groups_converged(pc, groups, timeout=60.0)
+                     if groups > 1 else None)
             with ApusClient(peers, timeout=10.0, history=recorder,
                             read_policy="spread" if time_nemesis
-                            else "leader") as c:
+                            else "leader", groups=groups) as c:
                 for k in keys:
                     c.get(k)
     _dbg(f"checking {len(recorder.events())} events")
@@ -712,6 +823,9 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
              "recorded": len(recorder.events()),
              "obs_events": _obs_event_count(obs_dumps),
              **nemesis, **flr}
+    if groups > 1 and gview is not None:
+        stats["groups"] = groups
+        stats["group_terms"] = {g: v["term"] for g, v in gview.items()}
     res = _check_linear_resolving(recorder, stats)
     stats["ops_checked"] = res.ops_checked
     stats["keys"] = res.keys
@@ -751,7 +865,8 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                        minutes: float = 0.0,
                        state_size: int = 0,
                        dump_obs: "str | None" = None,
-                       time_nemesis: bool = False) -> dict:
+                       time_nemesis: bool = False,
+                       groups: int = 1) -> dict:
     """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
     3-replica fault-plane ProcCluster with auto-removal ON, concurrent
     recorded clients (serial + pipelined), and a seeded nemesis that
@@ -813,8 +928,13 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                   flush=True)
 
     rng = random.Random(fault_seed ^ 0xC0C0)
-    spec = _dc.replace(PROC_SPEC)          # auto_remove stays ON
-    keys = [b"ck%d" % i for i in range(rng.randint(4, 7))]
+    # auto_remove stays ON; groups > 1 runs every arm across N
+    # independent consensus groups (joins/leaves admit into every
+    # group; each group's own failure detector evicts the dead).
+    spec = _dc.replace(PROC_SPEC, groups=groups)
+    keys = (_keys_covering(b"ck", rng.randint(4, 7), groups, rng)
+            if groups > 1
+            else [b"ck%d" % i for i in range(rng.randint(4, 7))])
     recorder = HistoryRecorder(capacity=1 << 18) if check_linear else None
     stop = threading.Event()
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
@@ -827,7 +947,8 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
         n = 0
         policy = "spread" if time_nemesis and wid > 0 else "leader"
         with ApusClient(peers, timeout=6.0, attempt_timeout=1.0,
-                        history=recorder, read_policy=policy) as c:
+                        history=recorder, read_policy=policy,
+                        groups=groups) as c:
             while not stop.is_set():
                 try:
                     roll = wrng.random()
@@ -837,16 +958,21 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                     elif roll < 0.8:
                         c.get(wrng.choice(keys))
                     else:
+                        # Raw pipeline ops carry their gid explicitly
+                        # (2-tuple ops route to group 0 by contract —
+                        # only the KVS helpers hash the key).
                         ops = []
                         for _ in range(wrng.randint(4, 12)):
+                            k = wrng.choice(keys)
                             if wrng.random() < 0.5:
                                 n += 1
                                 ops.append((OP_CLT_WRITE, encode_put(
-                                    wrng.choice(keys),
-                                    b"c%d.%d" % (wid, n))))
+                                    k, b"c%d.%d" % (wid, n)),
+                                    c.group_of(k)))
                             else:
-                                ops.append((OP_CLT_READ, encode_get(
-                                    wrng.choice(keys))))
+                                ops.append((OP_CLT_READ,
+                                            encode_get(k),
+                                            c.group_of(k)))
                         c.pipeline(ops)
                 except (TimeoutError, RuntimeError, OSError,
                         ConnectionError):
@@ -891,7 +1017,8 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                 # real multi-chunk snapshot stream.
                 val = bytes(32768)
                 nkeys = max(1, state_size // len(val))
-                with ApusClient(peers, timeout=60.0) as c:
+                with ApusClient(peers, timeout=60.0,
+                                groups=groups) as c:
                     for lo in range(0, nkeys, 16):
                         c.pipeline_puts(
                             [(b"bulk%06d" % i, val)
@@ -943,10 +1070,16 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                     or (mid_kill is None and rng.random() < 0.7)):
                 delay = rng.uniform(0.0, 0.15)
 
+                # Multi-group: the churn nemesis picks its VICTIM
+                # GROUP seeded — the kill lands on THAT group's
+                # leader, which may or may not also lead group 0.
+                vg = rng.randrange(groups) if groups > 1 else 0
+
                 def kill_leader_soon() -> None:
                     _time.sleep(delay)
                     try:
-                        v = pc.leader_idx(timeout=5.0)
+                        v = (_group_leader_idx(pc, vg, timeout=5.0)
+                             if vg else pc.leader_idx(timeout=5.0))
                         pc.kill(v)
                         killed.append(v)
                     except AssertionError:
@@ -1039,6 +1172,13 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
 
             # Phase 4: GRACEFUL LEAVE of a live follower + zombie probe
             # + re-admission of a fresh process into the freed slot.
+            # Multi-group: wait for EVERY group's membership to catch
+            # up to one symmetric view first — the leave must commit
+            # in every group, and a group whose deferred rejoin is
+            # still in flight would refuse it on its quorum floor.
+            if groups > 1:
+                _wait_groups_converged(pc, groups, timeout=90.0,
+                                       same_members=True)
             lead = pc.leader_idx(timeout=15.0)
             lvictim = rng.choice(
                 [i for i in range(len(pc.procs))
@@ -1066,7 +1206,9 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             _dbg("workers joined")
             pc.wait_converged(timeout=60.0)
             view = pc.wait_config_converged(timeout=60.0)
-            _dbg(f"converged: {view}")
+            gview = (_wait_groups_converged(pc, groups, timeout=90.0)
+                     if groups > 1 else None)
+            _dbg(f"converged: {view} groups: {gview}")
             # Snapshot-transfer evidence over the wire (resume vs
             # restart-from-zero), summed across live replicas.
             churn["snap_resumes"] = (
@@ -1078,11 +1220,23 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             ops_checked = 0
             if recorder is not None:
                 with ApusClient(list(pc.spec.peers), timeout=10.0,
-                                history=recorder) as c:
+                                history=recorder, groups=groups) as c:
                     for k in keys:
                         c.get(k)
     stats = {"configs_traversed": view["epoch"], **churn,
              "obs_events": _obs_event_count(obs_dumps)}
+    if gview is not None:
+        # Per-group traversal pin: every group must have moved through
+        # at least one config epoch (the multi-group join/evict/leave
+        # arms bump every group) or a leader change — a group the
+        # churn never touched proves nothing.
+        for g, v in gview.items():
+            assert v["epoch"] > 0 or v["term"] > 1, \
+                f"group {g} traversed no epoch/leader change: {v}"
+        stats["groups"] = groups
+        stats["group_epochs"] = {g: v["epoch"]
+                                 for g, v in gview.items()}
+        stats["group_terms"] = {g: v["term"] for g, v in gview.items()}
     if recorder is not None:
         res = _check_linear_resolving(recorder, stats)
         ops_checked = res.ops_checked
@@ -1205,6 +1359,15 @@ def main() -> int:
                          "apus_tpu.obs.timeline (default: "
                          "./obs-fail-<mode>-<seed>).  Violations AND "
                          "wedges dump; repro lines carry the flag")
+    ap.add_argument("--groups", type=int, default=1,
+                    help="with --check-linear/--churn: shard the "
+                         "keyspace across N consensus groups "
+                         "(Multi-Raft) — workers route by the stable "
+                         "key->group hash, the churn nemesis picks "
+                         "its victim group seeded, convergence and "
+                         "the per-key audit run per group, and every "
+                         "group must traverse >= 1 config epoch or "
+                         "leader change")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -1226,7 +1389,8 @@ def main() -> int:
         + (["--check-linear"] if args.check_linear else []) \
         + (["--time-nemesis"] if args.time_nemesis else []) \
         + (["--state-size", str(args.state_size)]
-           if args.state_size else [])
+           if args.state_size else []) \
+        + (["--groups", str(args.groups)] if args.groups > 1 else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
@@ -1252,7 +1416,8 @@ def main() -> int:
                                         check_linear=args.check_linear,
                                         state_size=args.state_size,
                                         dump_obs=args.dump_obs,
-                                        time_nemesis=args.time_nemesis)
+                                        time_nemesis=args.time_nemesis,
+                                        groups=args.groups)
                 for k in ("joins", "auto_removes", "graceful_leaves",
                           "leader_kills", "configs_traversed",
                           "ops_checked", "receiver_kills",
@@ -1266,7 +1431,8 @@ def main() -> int:
             elif args.check_linear:
                 st = run_audit_schedule(fault_seed,
                                         dump_obs=args.dump_obs,
-                                        time_nemesis=args.time_nemesis)
+                                        time_nemesis=args.time_nemesis,
+                                        groups=args.groups)
                 for k in ("ops_checked", "keys", "ambiguous",
                           "recorded", "obs_events", "pauses",
                           "clock_cmds", "flr_local_reads",
@@ -1329,6 +1495,7 @@ def main() -> int:
                    "device_plane": args.device_plane,
                    "proc": args.proc,
                    "time_nemesis": args.time_nemesis,
+                   "groups": args.groups,
                    # Audit campaign evidence (banked via eval.py): how
                    # much history the checker proved linearizable, and
                    # under which seeds.  violations is structurally 0

@@ -95,6 +95,22 @@ def _run_throughput(out) -> None:
                 bench="bench_throughput")
 
 
+def _run_groups_throughput(out) -> None:
+    """Multi-group (Multi-Raft) aggregate throughput ladder
+    (bench.py --throughput --groups 1,2,4): per-group write-service
+    gated rungs + the group-major dispatch evidence phase (ISSUE 10
+    headline)."""
+    print("bench.py --throughput --groups 1,2,4: multi-group "
+          "sharded-consensus ladder")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--throughput", "--groups", "1,2,4"],
+                         timeout=420):
+        _record(out, rec,
+                replicas=rec.get("detail", {}).get("replicas", 3),
+                bench="bench_throughput_groups")
+
+
 def _run_single_window(out) -> None:
     """Single-window (un-amortized) latency: depth-1/depth-4 windows
     through the windowed commit engine, wall p50 + profiler-derived
@@ -198,6 +214,11 @@ def cmd_run(args) -> int:
             # Fast churn re-campaign: skip the cluster suite.
             _run_churn(out, trials=getattr(args, "churn_trials", 5),
                        state_size=getattr(args, "churn_state_size", 0))
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "groups_only", False):
+            # Multi-group ladder re-measure: skip the cluster suite.
+            _run_groups_throughput(out)
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "throughput_only", False):
@@ -670,6 +691,26 @@ def cmd_report(args) -> int:
             f"(max_batch=1 control); lease GETs "
             f"{_fmt(d.get('gets_lease_ops_per_sec'))} ops/sec vs "
             f"read-index {_fmt(d.get('gets_readindex_ops_per_sec'))}")
+    mg = [r for r in runs if r.get("bench") == "bench_throughput_groups"
+          and isinstance(r.get("value"), (int, float))]
+    if mg:
+        last = mg[-1]
+        d = last["detail"]
+        ev = d.get("group_major_evidence") or {}
+        lines.append(
+            f"- MULTI-GROUP sharded consensus (Multi-Raft): aggregate "
+            f"pipelined SET {_fmt(last['value'])} ops/sec at "
+            f"{max(d.get('groups_ladder', [0]))} groups — "
+            f"{last.get('vs_baseline')}x the 1-group rung "
+            f"(scaling {d.get('scaling_vs_1group')}) under the "
+            f"per-group write-svc gate "
+            f"({d.get('emulated_write_svc_ms')} ms/op/group); "
+            f"group-major dispatch evidence ({ev.get('groups')} "
+            f"groups, ungated): {ev.get('dispatches')} dispatches "
+            f"carried {ev.get('group_windows_carried')} group-windows "
+            f"(mean {ev.get('mean_groups_per_dispatch')}/dispatch, "
+            f"p50 multi-group: {ev.get('p50_multi_group')}), "
+            f"recompile sentinel {ev.get('recompile_sentinel')}")
     aud = [r for r in runs if r.get("metric") == "linear_audit_clean_pct"
            and isinstance(r.get("value"), (int, float))]
     if aud:
@@ -941,6 +982,10 @@ def main() -> int:
                        help="run ONLY the single-window latency "
                             "microbench (fast latency-path re-measure; "
                             "skips the cluster suite)")
+        p.add_argument("--groups-only", action="store_true",
+                       help="run ONLY the multi-group throughput "
+                            "ladder (bench.py --throughput --groups "
+                            "1,2,4)")
         p.add_argument("--throughput-only", action="store_true",
                        help="run ONLY the pipelined-throughput bench "
                             "(bench.py --throughput; skips the cluster "

@@ -63,8 +63,19 @@ _PINS = [
      "NetTransport reply-echo stamps (peer_sid_seen) must use the "
      "daemon-installed clock (lease renewal round comparison)"),
     ("apus_tpu/runtime/daemon.py",
-     "self.node.tick(self.clock())",
+     "now = self.clock()",
+     "the daemon must take its tick stamp from the SkewClock seam"),
+    ("apus_tpu/runtime/daemon.py",
+     "self.node.tick(now)",
      "the daemon must tick the node from its SkewClock seam"),
+    ("apus_tpu/runtime/daemon.py",
+     "self.groupset.tick(now)",
+     "extra consensus groups must tick from the SAME SkewClock stamp "
+     "as the primary (one skewable time domain per daemon)"),
+    ("apus_tpu/runtime/groupset.py",
+     "node.clock = daemon.clock",
+     "extra groups' nodes must share the daemon's SkewClock as their "
+     "fresh clock (lease validity, one time domain)"),
     ("apus_tpu/runtime/daemon.py",
      "self.node.clock = self.clock",
      "the daemon must install its SkewClock as the node's fresh clock"),

@@ -42,9 +42,11 @@ NAMESPACE_OF = {
     "apus_tpu/core/node.py": "node",
     "apus_tpu/parallel/onesided.py": "node",
     "apus_tpu/runtime/bridge.py": "node",
-    # device_plane.py is mixed: node.bump -> node_*, the runner's
-    # self.stats.bump -> dev_* (resolved per call below).
+    # device_plane.py / group_plane.py are mixed: node.bump -> node_*,
+    # the runner's self.stats.bump -> dev_* (resolved per call below).
     "apus_tpu/runtime/device_plane.py": None,
+    "apus_tpu/runtime/group_plane.py": None,
+    "apus_tpu/runtime/groupset.py": "node",
     "apus_tpu/runtime/mesh_plane.py": "node",
     "apus_tpu/parallel/net.py": None,     # mixed: resolved per call
     "apus_tpu/parallel/faults.py": "fault",
@@ -98,7 +100,8 @@ def collect_bumps() -> list[tuple[str, str, str]]:
                     ns_here = "net"
                 out.append((rel, ns_here, name))
             continue
-        if rel == "apus_tpu/runtime/device_plane.py":
+        if rel in ("apus_tpu/runtime/device_plane.py",
+                   "apus_tpu/runtime/group_plane.py"):
             for m in _RECV.finditer(src):
                 owner = m.group(1)
                 ns_here = "node" if owner.startswith("node") else "dev"

@@ -44,11 +44,13 @@ LAST = os.path.join(REPO, "eval", "results", "perfgate_last.json")
 #: budget factor per check: measured-at-bank-time * factor = budget.
 FACTORS = {
     "depth1_window_wall_p50_us": 2.0,
+    "group4_dispatch_wall_p50_us": 2.0,
     "unsampled_obs_check_ns": 3.0,
     "hist_observe_ns": 3.0,
 }
 UNITS = {
     "depth1_window_wall_p50_us": "us",
+    "group4_dispatch_wall_p50_us": "us",
     "unsampled_obs_check_ns": "ns",
     "hist_observe_ns": "ns",
 }
@@ -100,6 +102,65 @@ def _measure_depth1_window(repeats: int = 3, iters: int = 40) -> float:
     return round(best, 2)
 
 
+def _measure_group_dispatch(repeats: int = 3, iters: int = 30) -> float:
+    """Wall p50 of ONE group-major dispatch carrying 4 groups' windows
+    (gate geometry) — the Multi-Raft dispatch-amortization budget: a
+    regression that makes the group-major step degenerate toward
+    per-group dispatch cost (G x the single-window wall) blows this
+    budget loudly."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apus_tpu.ops.commit import (GroupCommitControl,
+                                     build_group_window_step)
+    from apus_tpu.ops.logplane import make_group_device_log
+    from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+
+    G, R, S, SB, B, MD = 4, 3, 128, 512, 16, 1
+    mesh = replica_mesh(R, devices=jax.devices()[:1])
+    sh = NamedSharding(mesh, P(None, REPLICA_AXIS))
+    ssh = NamedSharding(mesh, P(None, None, REPLICA_AXIS))
+    step = build_group_window_step(mesh, G, R, S, SB, B, MD)
+    gl = make_group_device_log(G, R, S, SB, B, sharding=sh)
+    import jax.numpy as jnp
+    i32 = lambda v: jnp.asarray(v, jnp.int32)          # noqa: E731
+    from apus_tpu.core.quorum import quorum_size
+    mask = np.ones((G, R), np.int32)
+
+    def ctrl(e0):
+        return GroupCommitControl(
+            i32(np.zeros(G, np.int32)), i32(np.ones(G, np.int32)),
+            i32(np.full(G, e0, np.int32)), i32(np.ones(G, np.int32)),
+            i32(mask), i32(np.zeros((G, R), np.int32)),
+            i32(np.full(G, quorum_size(R), np.int32)),
+            i32(np.zeros(G, np.int32)))
+
+    # Open every group's fence for leader 0 @ term 1.
+    gl = type(gl)(gl.data, gl.meta, gl.offs,
+                  jax.device_put(np.tile(np.array([0, 1], np.int32),
+                                         (G, R, 1)), sh))
+    sdata = jax.device_put(np.zeros((MD, G, R, B, SB), np.uint8), ssh)
+    smeta = jax.device_put(np.zeros((MD, G, R, B, 4), np.int32), ssh)
+    e0 = 1
+    for _ in range(3):                    # compile + chained warm
+        gl, commits = step(gl, sdata, smeta, ctrl(e0))
+        jax.block_until_ready(commits)
+        e0 += B
+    best = float("inf")
+    for _ in range(repeats):
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            gl, commits = step(gl, sdata, smeta, ctrl(e0))
+            int(np.asarray(commits)[0, 0])     # result readback
+            walls.append((time.perf_counter_ns() - t0) / 1e3)
+            e0 += B
+        best = min(best, statistics.median(walls))
+    return round(best, 2)
+
+
 def _measure_obs_fast_path(n: int = 300_000) -> tuple[float, float]:
     """(unsampled check ns/op, histogram observe ns/sample), each the
     best of 3 passes."""
@@ -132,6 +193,7 @@ def measure(fast: bool = False) -> dict:
     out = {"unsampled_obs_check_ns": chk, "hist_observe_ns": obs}
     if not fast:
         out["depth1_window_wall_p50_us"] = _measure_depth1_window()
+        out["group4_dispatch_wall_p50_us"] = _measure_group_dispatch()
     return out
 
 

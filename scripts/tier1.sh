@@ -62,5 +62,14 @@ if [ "$smoke" -eq 1 ]; then
         echo "large-state churn smoke FAILED (rc=$src)" >&2
         exit "$src"
     fi
+    echo "== multi-group smoke (2 groups, live ProcCluster, leader "
+    echo "   kill, per-group audit; 1 trial) =="
+    env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \
+        --check-linear --groups 2 --trials 1 --seed-base 9450
+    mrc=$?
+    if [ "$mrc" -ne 0 ]; then
+        echo "multi-group smoke FAILED (rc=$mrc)" >&2
+        exit "$mrc"
+    fi
 fi
 echo "tier1.sh: all green"
