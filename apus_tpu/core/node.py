@@ -40,7 +40,8 @@ from apus_tpu.core.sid import AtomicSid, Sid
 from apus_tpu.core.types import (DEFAULT_LOG_SLOTS, MAX_SERVER_COUNT,
                                  PERMANENT_FAILURE, EntryType, Role)
 from apus_tpu.core import segment
-from apus_tpu.models.sm import Snapshot, StateMachine
+from apus_tpu.models.sm import (REFUSED_REPLY_PREFIX, Snapshot,
+                                StateMachine)
 from apus_tpu.obs.metrics import MetricsRegistry
 from apus_tpu.parallel.transport import (Region, Regions, Transport,
                                          WriteResult)
@@ -416,6 +417,10 @@ class Node:
         self._flr_next_req = 0.0
         self._flr_req_inflight = False
         self._flr_noted = False       # flight-recorder grant/lapse edge
+        # Fresh-leadership commit hold-off (see become_leader): commit
+        # may not advance before this stamp, so follower-lease windows
+        # granted by an unknown predecessor expire first.
+        self._flr_holdoff_until = -1.0
         #: Wire hook installed by the runtime (runtime.flr): callable
         #: (leader_idx) -> grant dict or None, one bounded roundtrip
         #: with the node lock yielded on the wire.  None on the
@@ -630,6 +635,21 @@ class Node:
         if not self._lease_valid(fnow):
             self.bump("flr_grant_refusals")
             return None
+        # Fresh-leadership read-index rule, applied to GRANTS: until
+        # our term-start blank entry COMMITS, our commit index may lag
+        # entries the previous term committed (we hold them — election
+        # restriction — but cannot know they committed).  A floor
+        # taken in that window can sit BELOW a client-acked write the
+        # grantee never replicated, and the grantee's
+        # end-at-registration guard would not cover it either (it only
+        # covers writes that needed the grantee's ack UNDER THIS
+        # grant's window) — the follower would then serve a read
+        # missing an acked write.  The leader read path has always
+        # waited for the blank (read(): wait_idx >= term_start + 1);
+        # grants must too.
+        if self.log.commit <= self._term_start_idx:
+            self.bump("flr_grant_refusals")
+            return None
         # Liveness guards: only a caught-up follower may hold a lease —
         # a laggard holding one would stall commit (blocker rule) for
         # the whole window while never serving a read — and a holder
@@ -684,6 +704,10 @@ class Node:
         AND by the device plane's commit adoption — grants are refused
         while external_commit is on, but a grant issued just before the
         flip must keep binding until it expires."""
+        if self._flr_holdoff_until > 0 \
+                and self._fresh_now() < self._flr_holdoff_until:
+            # Fresh-leadership hold-off (become_leader).
+            return self.log.commit
         blockers = self._flr_live_blockers(self._fresh_now())
         if not blockers:
             return None
@@ -1422,6 +1446,29 @@ class Node:
         self._fgrants.clear()
         self._flr_blocked_at.clear()
         self._flease_reset()
+        # PREDECESSOR-GRANT hold-off: the quorum-intersection argument
+        # above assumes the election quorum and the predecessor's
+        # lease-renewal quorum are measured against the SAME
+        # configuration, with every voter remembering the live leader.
+        # Config churn (a lease holder's group evicting/re-admitting
+        # members mid-window) or freshly-restarted voters can break
+        # both, electing us INSIDE a predecessor-granted follower
+        # window we know nothing about — its grant table died with the
+        # old leader, so our commits would outrun that holder's acks
+        # and it could serve a local read missing a client-acked write
+        # (the elastic campaign caught exactly this: one-write-stale
+        # follower reads, seeds 27100/27103).  Hold commit advancement
+        # for one maximal follower-lease window from election, so
+        # every such unknown window provably expires first.  Engaged
+        # only where follower leases can engage at all (live runtime —
+        # the sim never installs a lease requester).
+        if self._flr_enabled() and self.lease_requester is not None:
+            self._flr_holdoff_until = (
+                self._fresh_now()
+                + self._hb_timeout * (1.0
+                                      + 2.0 * self.cfg.lease_margin))
+        else:
+            self._flr_holdoff_until = -1.0
         self._election_deadline = None
         self._next_hb_send = now           # heartbeat immediately
         self._next_idx = {}
@@ -2202,6 +2249,13 @@ class Node:
         psum; cf. dare_ibv_rc.c:1725-1758)."""
         if self.external_commit:
             return          # the device-plane quorum owns commit
+        if self._flr_holdoff_until > 0:
+            # Fresh-leadership hold-off (become_leader): predecessor-
+            # granted follower-lease windows we cannot know about must
+            # expire before our first commit.
+            if self._fresh_now() < self._flr_holdoff_until:
+                return
+            self._flr_holdoff_until = -1.0
         acks = self.regions.ctrl[Region.REP_ACK]
         # Follower-lease write invalidation (Hermes on the log): while
         # a granted read-lease window is live, commit must not advance
@@ -2663,7 +2717,19 @@ class Node:
                     reply = b""
                 else:
                     reply = self.sm.apply(e.idx, data)
-                    self.epdb.note_applied(e.clt_id, e.req_id, e.idx, reply)
+                    # Deterministic REFUSED applies (elastic-group
+                    # bucket fences: a write into a frozen/departed
+                    # migration bucket no-ops identically on every
+                    # replica) are never dedup-noted — the op did not
+                    # take effect, so the client's re-routed retry
+                    # must re-enter admission fresh instead of being
+                    # answered from a cached refusal (or, worse, a
+                    # LATER req_id's cached reply via the monotone
+                    # dedup rule).
+                    if reply is None or not reply.startswith(
+                            REFUSED_REPLY_PREFIX):
+                        self.epdb.note_applied(e.clt_id, e.req_id,
+                                               e.idx, reply)
                     # Upcalls observe the LOGICAL record (reassembled
                     # payload), never envelope chunks — persistence and
                     # proxy replay stay segmentation-oblivious.

@@ -13,7 +13,10 @@ Command wire format (ascii-ish, newline-free):
 
 from __future__ import annotations
 
-from apus_tpu.models.sm import Snapshot, StateMachine
+import json
+import struct
+
+from apus_tpu.models.sm import REFUSED_REPLY_PREFIX, Snapshot, StateMachine
 
 
 def encode_put(key: bytes, value: bytes) -> bytes:
@@ -26,6 +29,93 @@ def encode_get(key: bytes) -> bytes:
 
 def encode_delete(key: bytes) -> bytes:
     return b"D%d:%s" % (len(key), key)
+
+
+def decode_key(cmd: bytes) -> "bytes | None":
+    """Key of a P/G/D command, or None for any other payload (the
+    elastic-group admission check routes on it; non-KVS payloads are
+    never bucket-routed)."""
+    if cmd[:1] not in (b"P", b"G", b"D"):
+        return None
+    try:
+        klen_s, rest = cmd[1:].split(b":", 1)
+        return rest[:int(klen_s)]
+    except (ValueError, IndexError):
+        return None
+
+
+# -- elastic-group migration commands (replicated in the groups' own
+#    logs; see runtime/elastic.py for the protocol walkthrough) -----------
+#
+#   MB  (src log)  freeze a bucket set for migration ``mig_id`` to
+#                  ``dst_gid`` at shard-map epoch ``epoch``; from its
+#                  apply on, writes into those buckets deterministically
+#                  no-op with a REFUSED sentinel, so the capture any
+#                  later driver attempt takes is stable.
+#   MI  (dst log)  install the captured pairs (idempotent by mig_id —
+#                  a resumed driver may deliver it twice).
+#   MC  (src log)  commit the migration: delete the moved keys, flip
+#                  bucket ownership to dst, bump the shard-map epoch.
+#
+# State rides the RESERVED key below so it survives snapshot/delta
+# catch-up exactly like ordinary keys (a replica primed by snapshot
+# never re-applies the M entries themselves).
+
+MIG_STATE_KEY = b"\x00apus.migs"
+RESERVED_PREFIX = b"\x00apus."
+
+REFUSED_FROZEN = REFUSED_REPLY_PREFIX + b"frozen"
+REFUSED_DEPARTED = REFUSED_REPLY_PREFIX + b"departed"
+
+_U16 = struct.Struct("<H")
+
+
+def _enc_buckets(buckets) -> bytes:
+    bs = sorted(set(buckets))
+    return _U16.pack(len(bs)) + b"".join(_U16.pack(b) for b in bs)
+
+
+def _dec_buckets(buf: bytes, off: int) -> "tuple[list[int], int]":
+    (n,) = _U16.unpack_from(buf, off)
+    off += 2
+    out = [_U16.unpack_from(buf, off + 2 * i)[0] for i in range(n)]
+    return out, off + 2 * n
+
+
+def encode_mig_begin(mig_id: int, dst_gid: int, epoch: int,
+                     buckets, cid_size: int = 0,
+                     cid_mask: int = 0) -> bytes:
+    """``cid_size``/``cid_mask`` are the DST group's genesis
+    configuration (the src group's member set at split time), decided
+    ONCE here and replicated with the record — every daemon creates
+    the new group from the same bytes, so genesis cids can never
+    diverge (locally-projected cids did, and same-epoch disagreement
+    has no reconciliation path)."""
+    return (b"MB" + struct.pack("<QBIBH", mig_id, dst_gid, epoch,
+                                cid_size, cid_mask)
+            + _enc_buckets(buckets))
+
+
+def decode_mig_begin(cmd: bytes):
+    """-> (mig_id, dst_gid, epoch, cid_size, cid_mask, buckets)."""
+    mig_id, dst, epoch, size, mask = struct.unpack_from("<QBIBH",
+                                                        cmd, 2)
+    buckets, _ = _dec_buckets(cmd, 18)
+    return mig_id, dst, epoch, size, mask, buckets
+
+
+def encode_mig_install(mig_id: int, src_gid: int, epoch: int, buckets,
+                       pairs) -> bytes:
+    out = [b"MI", struct.pack("<QBI", mig_id, src_gid, epoch),
+           _enc_buckets(buckets), struct.pack("<I", len(pairs))]
+    for k, v in pairs:
+        out.append(struct.pack("<I", len(k)) + k
+                   + struct.pack("<I", len(v)) + v)
+    return b"".join(out)
+
+
+def encode_mig_commit(mig_id: int) -> bytes:
+    return b"MC" + struct.pack("<Q", mig_id)
 
 
 class KvsStateMachine(StateMachine):
@@ -53,29 +143,174 @@ class KvsStateMachine(StateMachine):
         self._mutations = 0
         self.dump_generation = 0
         self._rope = None          # (frames, starts, total, mutations)
+        # Elastic-group migration bookkeeping (mirrored into the
+        # reserved MIG_STATE_KEY so it rides snapshots and deltas like
+        # any other key; _mig_reload rebuilds these after an install).
+        # migs_out: mig_id(str) -> [dst_gid, epoch, state, buckets]
+        #   with state "frozen" -> "committed"; migs_in: mig_id(str) ->
+        #   [src_gid, epoch, buckets] (install dedup).
+        self.migs_out: dict[str, list] = {}
+        self.migs_in: dict[str, list] = {}
+        self._frozen: set[int] = set()
+        self._departed: dict[int, tuple[int, int]] = {}
+
+    # -- internal mutation helpers (delta bookkeeping in one place) --------
+
+    def _put_internal(self, idx: int, key: bytes, value: bytes) -> None:
+        self.store[key] = value
+        self._mutations += 1
+        if idx:
+            self._mod_idx[key] = idx
+            self._del_idx.pop(key, None)
+
+    def _del_internal(self, idx: int, key: bytes) -> None:
+        self.store.pop(key, None)
+        self._mutations += 1
+        if idx:
+            self._mod_idx.pop(key, None)
+            self._del_idx[key] = idx
 
     def apply(self, idx: int, cmd: bytes) -> bytes | None:
         op = cmd[:1]
+        if op == b"M":
+            return self._apply_mig(idx, cmd)
         klen_s, rest = cmd[1:].split(b":", 1)
         klen = int(klen_s)
         key, payload = rest[:klen], rest[klen:]
+        if op == b"P" or op == b"D":
+            # Elastic-group fence: a decided write into a FROZEN bucket
+            # (migration capture in flight) or a DEPARTED one (already
+            # owned by another group) deterministically no-ops with a
+            # REFUSED sentinel on every replica — admission refuses
+            # these up front; only entries that raced a leader change
+            # past an unapplied MB/MC reach here.  The refusal is never
+            # dedup-cached (see sm.REFUSED_REPLY_PREFIX), so the
+            # client's re-routed retry executes exactly once at the
+            # owner.
+            if (self._frozen or self._departed) \
+                    and not key.startswith(RESERVED_PREFIX):
+                from apus_tpu.runtime.router import bucket_of_key
+                b = bucket_of_key(key)
+                if b in self._departed:
+                    return REFUSED_DEPARTED
+                if b in self._frozen:
+                    return REFUSED_FROZEN
         if op == b"P":
-            self.store[key] = payload
-            self._mutations += 1
-            if idx:
-                self._mod_idx[key] = idx
-                self._del_idx.pop(key, None)
+            self._put_internal(idx, key, payload)
             return b"OK"
         if op == b"G":
             return self.store.get(key, b"")
         if op == b"D":
-            self.store.pop(key, None)
-            self._mutations += 1
-            if idx:
-                self._mod_idx.pop(key, None)
-                self._del_idx[key] = idx
+            self._del_internal(idx, key)
             return b"OK"
         raise ValueError(f"bad kvs op {op!r}")
+
+    # -- elastic-group migration ops ---------------------------------------
+
+    def _apply_mig(self, idx: int, cmd: bytes) -> bytes:
+        from apus_tpu.runtime.router import bucket_of_key
+        sub = cmd[1:2]
+        if sub == b"B":
+            mig_id, dst, epoch, size, mask, buckets = \
+                decode_mig_begin(cmd)
+            if str(mig_id) not in self.migs_out:
+                self.migs_out[str(mig_id)] = [dst, epoch, "frozen",
+                                              buckets, size, mask]
+                self._mig_commit_state(idx)
+            return b"OK"
+        if sub == b"I":
+            mig_id, src, epoch = struct.unpack_from("<QBI", cmd, 2)
+            buckets, off = _dec_buckets(cmd, 15)
+            if str(mig_id) in self.migs_in:
+                return b"OK"                  # resumed-driver duplicate
+            (npairs,) = struct.unpack_from("<I", cmd, off)
+            off += 4
+            # Replace bucket contents (exact even if an aborted earlier
+            # attempt of a DIFFERENT mig left strays): delete, then
+            # install the frozen capture.
+            bset = set(buckets)
+            for k in [k for k in self.store
+                      if not k.startswith(RESERVED_PREFIX)
+                      and bucket_of_key(k) in bset]:
+                self._del_internal(idx, k)
+            for _ in range(npairs):
+                (klen,) = struct.unpack_from("<I", cmd, off)
+                off += 4
+                k = cmd[off:off + klen]
+                off += klen
+                (vlen,) = struct.unpack_from("<I", cmd, off)
+                off += 4
+                self._put_internal(idx, k, cmd[off:off + vlen])
+                off += vlen
+            self.migs_in[str(mig_id)] = [src, epoch, buckets]
+            self._mig_commit_state(idx)
+            return b"OK"
+        if sub == b"C":
+            (mig_id,) = struct.unpack_from("<Q", cmd, 2)
+            rec = self.migs_out.get(str(mig_id))
+            if rec is None:
+                return b"NOMIG"
+            if rec[2] != "committed":
+                bset = set(rec[3])
+                for k in [k for k in self.store
+                          if not k.startswith(RESERVED_PREFIX)
+                          and bucket_of_key(k) in bset]:
+                    self._del_internal(idx, k)
+                rec[2] = "committed"
+                self._mig_commit_state(idx)
+            return b"OK"
+        raise ValueError(f"bad kvs migration op {cmd[:2]!r}")
+
+    def _mig_rederive(self) -> None:
+        """Per-bucket fence from the migration event history.  A bucket
+        is DEPARTED only while its latest event is an OUTBOUND commit —
+        a later inbound install (the bucket returned, e.g. split then
+        merged back) clears the fence; epochs strictly increase along a
+        bucket's ownership chain, so the max-epoch event decides."""
+        self._frozen = set()
+        self._departed = {}
+        out_ev: dict[int, tuple[int, int]] = {}
+        in_ev: dict[int, int] = {}
+        for rec in self.migs_out.values():
+            dst, epoch, state, buckets = rec[:4]
+            if state == "frozen":
+                self._frozen.update(buckets)
+            elif state == "committed":
+                for b in buckets:
+                    if epoch > out_ev.get(b, (0, -1))[1]:
+                        out_ev[b] = (dst, epoch)
+        for rec in self.migs_in.values():
+            src, epoch = rec[0], rec[1]
+            for b in (rec[2] if len(rec) > 2 else []):
+                in_ev[b] = max(in_ev.get(b, -1), epoch)
+        for b, (dst, epoch) in out_ev.items():
+            if epoch > in_ev.get(b, -1):
+                self._departed[b] = (dst, epoch)
+
+    def _mig_commit_state(self, idx: int) -> None:
+        """Re-derive the bucket fences and mirror the migration tables
+        into the reserved key (deterministic bytes: sorted keys), so
+        they survive snapshot/delta catch-up like ordinary state."""
+        self._mig_rederive()
+        blob = json.dumps({"out": self.migs_out, "in": self.migs_in},
+                          sort_keys=True,
+                          separators=(",", ":")).encode()
+        self._put_internal(idx, MIG_STATE_KEY, blob)
+
+    def _mig_reload(self) -> None:
+        """Rebuild the in-memory migration tables from the reserved key
+        after a snapshot/delta install replaced or merged state."""
+        blob = self.store.get(MIG_STATE_KEY)
+        if not blob:
+            if self.migs_out or self.migs_in:
+                self.migs_out, self.migs_in = {}, {}
+                self._frozen, self._departed = set(), {}
+            return
+        st = json.loads(blob.decode())
+        self.migs_out = {k: list(v) for k, v in st.get("out",
+                                                       {}).items()}
+        self.migs_in = {k: list(v) for k, v in st.get("in", {}).items()}
+        self._mig_rederive()
 
     # -- streamable snapshot rope (zero-copy capture) ----------------------
 
@@ -200,6 +435,7 @@ class KvsStateMachine(StateMachine):
         # any later delta_since(b >= delta_floor) still includes every
         # key modified after b (at worst a few extra).  The floor is
         # unchanged — history below it was already unknown.
+        self._mig_reload()
 
     def query(self, cmd: bytes) -> bytes | None:
         """GET without logging (linearizable-read path).  GET is
@@ -239,3 +475,6 @@ class KvsStateMachine(StateMachine):
             v = buf[j + 1:j + 1 + vlen]
             off = j + 1 + vlen
             self.store[k] = v
+        # A snapshot-primed replica never applies the covered M entries
+        # — the migration tables ride the reserved key instead.
+        self._mig_reload()

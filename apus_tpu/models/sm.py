@@ -4,6 +4,20 @@ from __future__ import annotations
 
 import dataclasses
 
+#: Deterministic REFUSED-apply reply marker (elastic groups): an SM
+#: whose apply deterministically REFUSES a decided command (a write
+#: into a frozen/departed migration bucket — every replica no-ops it
+#: identically) returns a reply with this prefix.  The apply path then
+#: SKIPS the endpoint-DB dedup note for the entry (core/node.py and
+#: the restart replay, runtime/persist.py): the op never took effect,
+#: so a retry must re-enter admission fresh — caching the refusal
+#: would wedge the client's re-routed attempt behind the dedup, and
+#: letting a LATER req_id's cached reply answer it is the exact
+#: monotone-dedup hazard the prefix exists to avoid.  The client
+#: service translates the sentinel into a typed bounce
+#: (MIGRATING / WRONG_GROUP), never into an OK reply.
+REFUSED_REPLY_PREFIX = b"\x00!"
+
 
 @dataclasses.dataclass
 class Snapshot:

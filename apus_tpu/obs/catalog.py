@@ -64,6 +64,10 @@ COUNTERS: dict[str, str] = {
     "node_devplane_commits": "commit advances adopted from the device quorum",
     # Multi-group sharded consensus (runtime/groupset.py).
     "node_hb_coalesced_groups": "groups carried by coalesced OP_HB_MULTI flushes",
+    # Elastic groups (runtime/elastic.py): online split/merge.
+    "node_migrations": "bucket migrations committed (split/merge flips)",
+    "node_wrong_group_hints": "ops bounced with a typed WRONG_GROUP + shard map",
+    "node_migrating_refusals": "writes refused on a frozen mid-migration bucket",
     "node_devplane_own_flips": "device-plane commit ownership flips (own/release)",
     "node_nack_ranges_dropped": "proxy NACK ranges dropped by the bridge",
     "node_proxy_spin_timeouts": "proxy spin-wait timeouts observed",
@@ -165,4 +169,5 @@ FLIGHT_CATEGORIES: dict[str, str] = {
     "persist": "persistence disablement (first I/O error of the session)",
     "fault": "scripted fault-plane commands landing on this replica",
     "devplane": "device-plane ownership flips (cause-tagged) + recompiles",
+    "elastic": "elastic-group migrations: begin/capture/committed edges",
 }

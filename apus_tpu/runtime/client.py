@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Optional
 
+from apus_tpu.models.sm import REFUSED_REPLY_PREFIX as _REFUSED_PREFIX
 from apus_tpu.parallel import wire
 
 ST_ERROR = wire.ST_ERROR
@@ -36,6 +37,39 @@ OP_MAINT_READS = 19   # flip the proxy's stale-follower-reads gate
 
 ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
+#: Elastic-group bounces (runtime/elastic.py).  WRONG_GROUP: the key's
+#: bucket is owned by another consensus group — the reply carries the
+#: owner gid AND the full shard map (epoch-versioned), so one bounce
+#: re-synchronizes a stale-epoch client; the server-side refusal is
+#: deterministic (the op never applied here), so the client re-routes
+#: under a FRESH req_id and exactly-once holds at the owner.
+#: MIGRATING: the bucket is frozen mid-migration — retry shortly, same
+#: group (the flip resolves it to OK or WRONG_GROUP).
+ST_WRONG_GROUP = 8
+ST_MIGRATING = 9
+
+
+def _elastic_bounce(daemon, node, req_id: int, verdict) -> bytes:
+    """Typed elastic bounce reply (caller holds the daemon lock)."""
+    if verdict[0] == "migrating":
+        return wire.u8(ST_MIGRATING) + wire.u64(req_id)
+    m = daemon.elastic.shard_map()
+    return (wire.u8(ST_WRONG_GROUP) + wire.u64(req_id)
+            + wire.u8(verdict[1]) + wire.blob(m.to_blob()))
+
+
+def _sentinel_bounce(daemon, node, req_id: int, data: bytes,
+                     reply: bytes) -> bytes:
+    """Translate a deterministic REFUSED apply (a write that raced a
+    leader change past an unapplied migration record and no-op'd at
+    apply; sm.REFUSED_REPLY_PREFIX) into the matching typed bounce.
+    Caller holds the daemon lock."""
+    from apus_tpu.models.kvs import REFUSED_DEPARTED
+    if reply == REFUSED_DEPARTED and daemon.elastic is not None:
+        v = daemon.elastic.departed(node, data)
+        if v is not None:
+            return _elastic_bounce(daemon, node, req_id, v)
+    return wire.u8(ST_MIGRATING) + wire.u64(req_id)
 
 
 def _svc_emulate(daemon, n_reads: int) -> None:
@@ -83,9 +117,23 @@ def make_client_ops(daemon, node=None) -> dict:
         traced = sp is not None and sp.sampled(req_id)
         if traced:
             sp.stamp(clt_id, req_id, "ingest")
+        el = daemon.elastic
         with daemon.lock:
             if traced:
                 sp.stamp(clt_id, req_id, "lock")
+            if el is not None:
+                # Elastic-group admission fence: bucket owned by
+                # another group (WRONG_GROUP + map) or frozen
+                # mid-migration (MIGRATING).  Dedup still wins: a
+                # retried already-applied req answers from the cache
+                # via submit below (admit only refuses keys this
+                # group cannot serve NOW, and an applied write's key
+                # was owned when it applied).
+                if node.epdb.duplicate_of_applied(clt_id, req_id) \
+                        is None:
+                    v = el.admit(node, data)
+                    if v is not None:
+                        return _elastic_bounce(daemon, node, req_id, v)
             pr = node.submit(req_id, clt_id, data)
             if traced:
                 sp.stamp(clt_id, req_id, "admit")
@@ -98,6 +146,12 @@ def make_client_ops(daemon, node=None) -> dict:
                 # entry applied) — apply position alone can be satisfied
                 # by a different entry after truncation.
                 if pr.reply is not None:
+                    if el is not None and pr.reply.startswith(
+                            _REFUSED_PREFIX):
+                        # Raced a leader change past an unapplied
+                        # migration record; deterministically no-op'd.
+                        return _sentinel_bounce(daemon, node, req_id,
+                                                data, pr.reply)
                     if traced:
                         sp.stamp(clt_id, req_id, "reply", idx=pr.idx)
                         sp.finish(clt_id, req_id)
@@ -115,7 +169,15 @@ def make_client_ops(daemon, node=None) -> dict:
     def clt_read(r: wire.Reader) -> bytes:
         req_id, clt_id = r.u64(), r.u64()
         data = r.blob()
+        el = daemon.elastic
         with daemon.lock:
+            if el is not None:
+                # Ownership fence: reads on FROZEN buckets still serve
+                # (nothing can modify them anywhere until the flip);
+                # buckets owned elsewhere bounce with the map.
+                v = el.admit(node, data)
+                if v is not None and v[0] == "wrong_group":
+                    return _elastic_bounce(daemon, node, req_id, v)
             rr = node.read(req_id, clt_id, data)
             if rr is None:
                 # Not the leader: try the follower-lease local-read
@@ -130,6 +192,15 @@ def make_client_ops(daemon, node=None) -> dict:
                 if rr.done:
                     if rr.error:
                         return wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+                    if el is not None:
+                        # Reply-time re-check: the bucket may have
+                        # DEPARTED while the read was parked — serving
+                        # the locally-applied value past the flip
+                        # would be a stale read.
+                        v = el.departed(node, data)
+                        if v is not None:
+                            return _elastic_bounce(daemon, node,
+                                                   req_id, v)
                     break           # served; svc gate OUTSIDE the lock
                 if getattr(rr, "refused", False):
                     # Lease lapsed/invalidated under the parked read:
@@ -312,6 +383,14 @@ def make_client_ops(daemon, node=None) -> dict:
             st["n_groups"] = getattr(daemon, "n_groups", 1)
             if getattr(daemon, "groupset", None) is not None:
                 st["groups"] = daemon.groupset.status_view()
+            # Elastic-group observability: the derived shard-map epoch
+            # (the client router's "hash epoch") and every migration
+            # record any local SM knows, with its state — harnesses
+            # assert split/merge completion over the wire on these.
+            el = getattr(daemon, "elastic", None)
+            if el is not None:
+                st["router_epoch"] = el.shard_map().epoch
+                st["migrations"] = el.migrations_view()
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
             refusals = getattr(daemon, "misdirect_refusals", None)
@@ -429,6 +508,14 @@ def make_client_batch_hook(daemon):
             if node is None:
                 registered[i] = True      # unknown gid: resolves ERROR
                 return
+            el = daemon.elastic
+            if el is not None:
+                v = el.admit(node, parsed[i][3])
+                if v is not None and v[0] == "wrong_group":
+                    replies[i] = _elastic_bounce(daemon, node,
+                                                 parsed[i][1], v)
+                    registered[i] = True
+                    return
             floor = 0
             for j in range(i):
                 h = handles[j]
@@ -454,8 +541,20 @@ def make_client_batch_hook(daemon):
                     sp.stamp(parsed[i][2], parsed[i][1], "lock",
                              t=t_lock)
             flush_nodes = []
+            el = daemon.elastic
             for i, (op, req_id, clt_id, data, _gid) in enumerate(parsed):
                 if op == OP_CLT_WRITE and nodes[i] is not None:
+                    if el is not None and nodes[i].epdb \
+                            .duplicate_of_applied(clt_id, req_id) \
+                            is None:
+                        # Elastic admission fence, exactly as the
+                        # single-op path (dedup-first).
+                        v = el.admit(nodes[i], data)
+                        if v is not None:
+                            replies[i] = _elastic_bounce(
+                                daemon, nodes[i], req_id, v)
+                            registered[i] = True
+                            continue
                     handles[i] = nodes[i].submit(req_id, clt_id, data)
                     registered[i] = True
                     if nodes[i] not in flush_nodes:
@@ -499,6 +598,11 @@ def make_client_batch_hook(daemon):
                 # apply position alone can be satisfied by a DIFFERENT
                 # entry after truncation.
                 if h.reply is not None:
+                    if daemon.elastic is not None \
+                            and h.reply.startswith(_REFUSED_PREFIX):
+                        replies[i] = _sentinel_bounce(
+                            daemon, node, req_id, _d, h.reply)
+                        return True
                     replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
                                   + wire.blob(h.reply))
                     if sp is not None and sp.sampled(req_id):
@@ -519,6 +623,13 @@ def make_client_batch_hook(daemon):
                 if h.error:
                     replies[i] = wire.u8(wire.ST_ERROR) + wire.u64(req_id)
                 else:
+                    if daemon.elastic is not None:
+                        # Reply-time departed re-check (see clt_read).
+                        v = daemon.elastic.departed(node, _d)
+                        if v is not None:
+                            replies[i] = _elastic_bounce(
+                                daemon, node, req_id, v)
+                            return True
                     replies[i] = (wire.u8(wire.ST_OK) + wire.u64(req_id)
                                   + wire.blob(h.reply or b""))
                 return True
@@ -547,8 +658,23 @@ def make_client_batch_hook(daemon):
                     if op == OP_CLT_WRITE and replies[i] is not None \
                             and replies[i][:1] == wire.u8(wire.ST_OK):
                         per_gid[gid] = per_gid.get(gid, 0) + 1
-                for gid, n in per_gid.items():
-                    _wsvc_emulate(daemon, gid, n)
+                if len(per_gid) <= 1:
+                    for gid, n in per_gid.items():
+                        _wsvc_emulate(daemon, gid, n)
+                else:
+                    # Different groups' service runs on DIFFERENT
+                    # emulated cores even when one daemon leads both
+                    # (a burst spanning groups must not serialize the
+                    # per-group gates in this one handler thread —
+                    # that would model one shared core, the opposite
+                    # of what the gate exists to model).
+                    ts = [threading.Thread(
+                        target=_wsvc_emulate, args=(daemon, gid, n),
+                        daemon=True) for gid, n in per_gid.items()]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
             return replies
 
         deadline = time.monotonic() + daemon.client_op_timeout
@@ -691,6 +817,17 @@ class ApusClient:
         #: client.
         self.groups = max(1, groups)
         self._leaders: dict[int, Optional[int]] = {}
+        #: Elastic routing: the last shard map learned from a typed
+        #: WRONG_GROUP bounce (epoch-versioned; runtime/router.ShardMap).
+        #: None until the first bounce — a client of a never-migrated
+        #: cluster routes by the pinned hash and pays nothing.
+        self.shard = None
+        # Cross-group re-dispatch state for pipeline(): ops bounced
+        #: WRONG_GROUP leave their sub-pipeline and re-dispatch under
+        #: fresh req_ids (see _pipeline_attempt / pipeline).
+        self._regroup: list = []
+        self._regroup_ids: set = set()
+        self._alias: dict[int, int] = {}
         #: Read routing: "leader" (default — every op chases the
         #: leader) or "spread" — GETs rotate across ALL replicas and
         #: are served from follower read leases where live
@@ -765,12 +902,36 @@ class ApusClient:
         self._leaders[gid] = v
 
     def group_of(self, key: bytes) -> int:
-        """Stable key -> group id (runtime/router.py); 0 when this
-        client is single-group."""
+        """Stable key -> group id (runtime/router.py): the learned
+        shard map when one exists (elastic clusters), else the pinned
+        hash; 0 when this client is single-group."""
+        if self.shard is not None:
+            return self.shard.group_of_key(key)
         if self.groups <= 1:
             return 0
         from apus_tpu.runtime.router import group_of_key
         return group_of_key(key, self.groups)
+
+    def _learn_map(self, resp: bytes) -> "tuple[int, int]":
+        """Parse a WRONG_GROUP reply (offset 9: status + echoed req_id
+        precede): adopt the carried map when it is at least as new as
+        ours, and return (owner gid, reply map epoch).  A reply epoch
+        BELOW our map's means the answering replica's view lags a flip
+        we already know about — the caller must WAIT for it to catch
+        up, not re-route by its stale hint (bouncing between a
+        flipped src and a lagging dst with no backoff was a
+        CPU-saturating ping-pong storm under load)."""
+        r = wire.Reader(resp[9:])
+        owner = r.u8()
+        try:
+            from apus_tpu.runtime.router import ShardMap
+            m = ShardMap.from_blob(r.blob())
+        except (ValueError, IndexError):
+            return owner, -1
+        if self.shard is None or m.epoch >= self.shard.epoch:
+            self.shard = m
+            self.groups = max(self.groups, m.n_groups)
+        return owner, m.epoch
 
     @staticmethod
     def _wrap(gid: int, payload: bytes) -> bytes:
@@ -862,32 +1023,37 @@ class ApusClient:
         by_gid: dict[int, list] = {}
         for it in items:
             by_gid.setdefault(it[3], []).append(it)
+        # Fresh cross-group re-dispatch state per pipeline call
+        # (ops bounced WRONG_GROUP re-dispatch below).
+        self._regroup = []
+        self._regroup_ids = set()
+        self._alias = {}
         try:
-            if len(by_gid) == 1:
-                gid, sub = next(iter(by_gid.items()))
-                self._pipeline_group(gid, sub, results, deadline, window)
-            else:
-                # Concurrent per-group sub-pipelines: connections are
-                # keyed (gid, target), so threads never share a socket
-                # even when two groups' leaders are the same daemon.
-                errs: list[BaseException] = []
-
-                def run(gid, sub):
-                    try:
-                        self._pipeline_group(gid, sub, results,
-                                             deadline, window)
-                    except BaseException as e:   # noqa: BLE001
-                        errs.append(e)
-
-                threads = [threading.Thread(target=run, args=(g, s),
-                                            daemon=True)
-                           for g, s in by_gid.items()]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-                if errs:
-                    raise errs[0]
+            self._run_group_pipelines(by_gid, results, deadline, window)
+            # Elastic re-dispatch rounds: ops bounced WRONG_GROUP get
+            # FRESH req_ids at their owner group (the refusal was
+            # deterministic — they never applied at the bouncer), with
+            # results and history keyed back to the original op.
+            for _round in range(6):
+                regroup, self._regroup = self._regroup, []
+                if not regroup:
+                    break
+                by_gid2: dict[int, list] = {}
+                for (op, rid, data, _g), owner in regroup:
+                    orig = self._alias.get(rid, rid)
+                    self._req_seq += 1
+                    nrid = self._req_seq
+                    self._alias[nrid] = orig
+                    by_gid2.setdefault(owner, []).append(
+                        (op, nrid, data, owner))
+                self._run_group_pipelines(by_gid2, results, deadline,
+                                          window)
+            missing = [rid for _op, rid, _d, _g in items
+                       if rid not in results]
+            if missing:
+                raise TimeoutError(
+                    f"{len(missing)} of {len(items)} pipelined ops "
+                    f"unresolved after cross-group re-dispatch")
         except BaseException:
             # Unresolved ops are ambiguous: a retry MAY already have
             # landed (the reply was simply never read).
@@ -898,6 +1064,37 @@ class ApusClient:
                                               "ambiguous")
             raise
         return [results[req_id] for _op, req_id, _d, _g in items]
+
+    def _run_group_pipelines(self, by_gid: dict, results: dict,
+                             deadline: float, window: int) -> None:
+        """Drive one round of per-group sub-pipelines (concurrent when
+        more than one group has ops; connections are keyed
+        (gid, target), so threads never share a socket even when two
+        groups' leaders are the same daemon)."""
+        if not by_gid:
+            return
+        if len(by_gid) == 1:
+            gid, sub = next(iter(by_gid.items()))
+            self._pipeline_group(gid, sub, results, deadline, window)
+            return
+        errs: list[BaseException] = []
+
+        def run(gid, sub):
+            try:
+                self._pipeline_group(gid, sub, results, deadline,
+                                     window)
+            except BaseException as e:   # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(g, s),
+                                    daemon=True)
+                   for g, s in by_gid.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
 
     def _pipeline_group(self, gid: int, items: list,
                         results: dict, deadline: float,
@@ -925,8 +1122,11 @@ class ApusClient:
             outcome, hint = self._pipeline_attempt(
                 target, pending, results, deadline, window,
                 learn_leader=not spread, gid=gid)
-            pending = [it for it in pending if it[1] not in results]
-            if outcome == "hint":
+            pending = [it for it in pending if it[1] not in results
+                       and it[1] not in self._regroup_ids]
+            if outcome == "migrating":
+                time.sleep(0.02)         # freeze window; same target
+            elif outcome == "hint":
                 target = self._peer_index(hint) if hint \
                     else (self._gleader(gid) if spread
                           and self._gleader(gid) is not None
@@ -967,6 +1167,7 @@ class ApusClient:
             return "conn", None
         queue = list(items)
         inflight: dict[int, tuple] = {}
+        migrating = False
         try:
             while queue or inflight:
                 if queue and len(inflight) < window:
@@ -997,16 +1198,43 @@ class ApusClient:
                 if st == wire.ST_OK:
                     if learn_leader:
                         self._set_gleader(gid, target)
-                    results[rid] = wire.Reader(resp[9:]).blob()
+                    val = wire.Reader(resp[9:]).blob()
+                    # Cross-group re-dispatches resolve under their
+                    # ORIGINAL req_id too (the caller's op order and
+                    # the history interval are keyed by it).
+                    orig = self._alias.get(rid, rid)
+                    results[rid] = val
+                    results[orig] = val
                     del inflight[rid]
                     if self.history is not None:
-                        self.history.complete(self.clt_id, rid, "ok",
-                                              results[rid])
-                    if self.tracer is not None \
+                        self.history.complete(self.clt_id, orig, "ok",
+                                              val)
+                    if self.tracer is not None and orig == rid \
                             and self.tracer.sampled(rid):
                         self.tracer.stamp(self.clt_id, rid,
                                           "client_reply")
                         self.tracer.finish(self.clt_id, rid)
+                elif st == ST_MIGRATING:
+                    # Bucket frozen mid-migration: leave unresolved;
+                    # the caller retries this target after a short
+                    # backoff (the flip resolves it).
+                    del inflight[rid]
+                    migrating = True
+                elif st == ST_WRONG_GROUP:
+                    owner, repoch = self._learn_map(resp)
+                    if self.shard is not None \
+                            and repoch < self.shard.epoch:
+                        # Lagging replica (see _op_raw): retry here
+                        # after the caller's backoff, same req_id.
+                        del inflight[rid]
+                        migrating = True
+                    else:
+                        # Owned by another group: hand the op to the
+                        # pipeline-level re-dispatcher (fresh req_id
+                        # at the owner; see pipeline()).
+                        it = inflight.pop(rid)
+                        self._regroup_ids.add(rid)
+                        self._regroup.append((it, owner))
                 elif st == ST_NOT_LEADER:
                     hint = wire.Reader(resp[9:]).blob().decode() \
                         if len(resp) > 9 else ""
@@ -1017,7 +1245,7 @@ class ApusClient:
                     return "rotate", None
                 else:
                     raise RuntimeError(f"server error (status {st})")
-            return "ok", None
+            return ("migrating" if migrating else "ok"), None
         except (OSError, ConnectionError, ValueError):
             self._drop(target, gid)
             return "conn", None
@@ -1131,6 +1359,37 @@ class ApusClient:
                 # same req_id is exactly-once wherever it lands, and a
                 # healthy majority may be one hop away.
                 target = self._next(target, gid)
+                continue
+            if st == ST_MIGRATING:
+                # Bucket frozen mid-migration: the flip resolves this
+                # to OK or WRONG_GROUP within the migration's (short)
+                # freeze window.  Same target, small backoff.
+                time.sleep(0.02)
+                continue
+            if st == ST_WRONG_GROUP:
+                owner, repoch = self._learn_map(resp)
+                if self.shard is not None \
+                        and repoch < self.shard.epoch:
+                    # The answering replica's map LAGS ours: its view
+                    # of this flip hasn't applied yet — wait it out on
+                    # the same group instead of chasing the stale hint
+                    # (the src/dst ping-pong storm).
+                    time.sleep(0.02)
+                    continue
+                # The bucket is owned by another group (the reply
+                # carried the map).  The refusal is deterministic — the
+                # op never applied here — so re-route under a FRESH
+                # req_id: per-(client, group) req_id streams stay
+                # monotone on both sides and the owner executes it
+                # exactly once.
+                gid = owner
+                self._req_seq += 1
+                req_id = self._req_seq
+                payload = self._wrap(gid, wire.u8(op) + wire.u64(req_id)
+                                     + wire.u64(self.clt_id)
+                                     + wire.blob(data))
+                target = self._gleader(gid)
+                time.sleep(0.01)
                 continue
             raise RuntimeError(f"server error (status {st})")
         raise TimeoutError(f"request {req_id} not served in {self.timeout}s")

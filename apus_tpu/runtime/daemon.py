@@ -64,7 +64,8 @@ class ReplicaDaemon:
                  seed: int = 0,
                  device_runner=None,
                  group_cids: Optional[dict] = None,
-                 group_sm_factory=None):
+                 group_sm_factory=None,
+                 live_groups: Optional[int] = None):
         self.idx = idx
         self.spec = spec
         self.lock = threading.RLock()
@@ -209,7 +210,20 @@ class ReplicaDaemon:
         # nothing is built, no hb_sink is installed, and every wire
         # frame stays byte-identical to the single-group protocol.
         self.n_groups = max(1, int(getattr(spec, "groups", 1) or 1))
+        if group_cids:
+            # Elastic groups: a joiner admitted into split-born groups
+            # beyond the static config builds nodes for them too.
+            self.n_groups = max(self.n_groups, max(group_cids) + 1)
+        if live_groups:
+            # ...including groups whose admission timed out at boot
+            # (the background retry finishes those; their nodes must
+            # exist to receive catch-up replication meanwhile).
+            self.n_groups = max(self.n_groups, live_groups)
         self.groupset = None
+        #: Elastic-group plane (runtime/elastic.py): shard-map view,
+        #: bucket-ownership admission fence, and the migration driver.
+        #: None on single-group daemons — zero cost there.
+        self.elastic = None
         if self.n_groups > 1:
             from apus_tpu.runtime.groupset import GroupSet
             gs_kwargs = {}
@@ -280,6 +294,24 @@ class ReplicaDaemon:
                                              node=self.node)
             self.on_commit.append(self._persist_commit)
             self.on_snapshot.append(self._persist_snapshot)
+            if self.groupset is not None:
+                # Per-group durability (elastic-group plane): every
+                # extra group gets its own store under the same db dir
+                # and replays/re-bases independently; store files
+                # beyond the static count re-create their (split-born)
+                # groups first.
+                self.groupset.attach_persistence(db_dir)
+
+        # Elastic groups (runtime/elastic.py): online SPLIT/MERGE of
+        # the bucketed keyspace across consensus groups.  Built only
+        # with the multi-group runtime; constructed AFTER persistence
+        # replay so the first shard-map recompute sees recovered
+        # migration state.
+        if self.groupset is not None:
+            from apus_tpu.runtime.elastic import (ElasticPlane,
+                                                  make_elastic_ops)
+            self.elastic = ElasticPlane(self)
+            self.server._extra_ops.update(make_elastic_ops(self))
 
         # Device plane (runtime.device_plane): the jitted commit step as
         # the primary replication/quorum engine, host TCP as control
@@ -371,6 +403,11 @@ class ReplicaDaemon:
             self._compact_thread = cw
         if self.device_driver is not None:
             self.device_driver.start()
+        if self.elastic is not None:
+            # Migration driver: resumes any open migration this daemon
+            # comes to lead (leader kill mid-migration moves the driver
+            # with the leadership).
+            self.elastic.start()
         # Arm any loaded fault schedule now that the daemon serves —
         # schedule time 0 is "daemon up", not "object constructed".
         if hasattr(self.transport, "arm"):
@@ -379,6 +416,8 @@ class ReplicaDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.elastic is not None:
+            self.elastic.stop()
         if self.device_driver is not None:
             self.device_driver.stop()
             if hasattr(self.device_driver.runner, "stop"):
@@ -406,6 +445,11 @@ class ReplicaDaemon:
         if self.groupset is not None:
             for gnode in self.groupset.nodes[1:]:
                 _snap_session_close(gnode)
+            for p in self.groupset.persists.values():
+                try:
+                    p.close()
+                except OSError:
+                    pass
 
     def begin_drain(self, why: str) -> None:
         """Graceful leave: our removal is COMMITTED cluster-wide
@@ -472,10 +516,23 @@ class ReplicaDaemon:
                 self.obs.flight.note("watchdog", "exclusion_rejoin",
                                      slot=self.idx)
             try:
-                slot, cid, _peers = request_join(
+                slot, cid, jpeers = request_join(
                     [p for i, p in enumerate(self.spec.peers)
                      if p and i != self.idx], my_addr, timeout=5.0,
                     want_slot=self.idx)
+                # Adopt the reply's peer table: members that joined
+                # after our boot config (their addresses are needed to
+                # probe/rejoin the EXTRA groups, whose leaders may
+                # live there).
+                for i, p in enumerate(jpeers):
+                    if not p or i == self.idx:
+                        continue
+                    while len(self.spec.peers) <= i:
+                        self.spec.peers.append("")
+                    if self.spec.peers[i] != p:
+                        self.spec.peers[i] = p
+                        host, port_s = p.rsplit(":", 1)
+                        self.transport.set_peer(i, (host, int(port_s)))
                 if slot != self.idx:
                     self.logger.error(
                         "rejoin assigned slot %d != ours (%d); leaving "
@@ -502,7 +559,16 @@ class ReplicaDaemon:
         """Finish deferred extra-group admissions in the background
         (request_join_all_groups skips groups whose join timed out at
         boot — a group mid-election/mid-resize under churn): keep
-        retrying each until admitted or permanently refused."""
+        retrying each until admitted or permanently refused.
+
+        A typed refusal is treated as permanent only after it REPEATS:
+        right after a slot re-admission, an extra group's leader can
+        still hold the slot's OLD address binding (its peer table
+        updates when the group-0 re-add CONFIG applies there), so the
+        first few ``slot_bound`` answers are expected convergence
+        noise, not a verdict — giving up on the first one left the
+        joiner silently outside the group forever (the elastic
+        campaign's seed 27103 wedge)."""
         from apus_tpu.runtime.membership import (JoinRefusedError,
                                                  request_join_group)
         gids = sorted(gids)
@@ -511,6 +577,7 @@ class ReplicaDaemon:
 
         def run():
             left = list(gids)
+            refusals: dict[int, int] = {}
             while left and not self._stop.is_set():
                 for gid in list(left):
                     peers = [p for i, p in enumerate(self.spec.peers)
@@ -519,13 +586,17 @@ class ReplicaDaemon:
                         cid = request_join_group(peers, my_addr, gid,
                                                  self.idx, timeout=10.0)
                     except JoinRefusedError as e:
-                        self.logger.error(
-                            "group %d join permanently refused: %s",
-                            gid, e)
-                        left.remove(gid)
+                        refusals[gid] = refusals.get(gid, 0) + 1
+                        if refusals[gid] >= 8:
+                            self.logger.error(
+                                "group %d join permanently refused "
+                                "(%d consecutive): %s", gid,
+                                refusals[gid], e)
+                            left.remove(gid)
                         continue
                     except Exception:        # noqa: BLE001
                         continue             # retry next round
+                    refusals.pop(gid, None)
                     gnode = self.group_node(gid)
                     if gnode is not None:
                         with self.lock:
@@ -591,31 +662,45 @@ class ReplicaDaemon:
         retain = getattr(self.spec, "compact_retain", 0)
         while not self._stop.is_set():
             self._stop.wait(period)
-            if self._stop.is_set() or self.persist_disabled:
+            if self._stop.is_set():
                 return
-            p = self.persistence
-            if p is None or p.entries_since_base <= retain:
-                continue
-            cap = None
-            try:
-                with self.lock:
-                    cap = p.begin_compact(self.node)
-                if cap is None:
+            # Per-group compaction floors (elastic-group durability):
+            # group 0 plus every extra group's store, each folded
+            # independently against the same retention window.
+            stores = []
+            if not self.persist_disabled and self.persistence is not None:
+                stores.append((self.node, self.persistence))
+            if self.groupset is not None:
+                for gid, p in self.groupset.persists.items():
+                    if not self.groupset.persist_disabled.get(gid):
+                        stores.append((self.groupset.nodes[gid], p))
+            for node, p in stores:
+                if self._stop.is_set():
+                    return
+                if p.entries_since_base <= retain:
                     continue
-                p.prepare_compact(cap)
-                with self.lock:
-                    p.finish_compact(cap)
-                if self.obs is not None:
-                    self.obs.flight.note(
-                        "watchdog", "compaction",
-                        floor=p.compaction_floor)
-            except OSError as exc:
-                # A failed compaction leaves the OLD store authoritative
-                # (abort drains the queued appends back into it) — log
-                # and retry later; never disable persistence for it.
-                self.logger.warning("store compaction failed: %s", exc)
-                with self.lock:
-                    p.abort_compact(cap)
+                cap = None
+                try:
+                    with self.lock:
+                        cap = p.begin_compact(node)
+                    if cap is None:
+                        continue
+                    p.prepare_compact(cap)
+                    with self.lock:
+                        p.finish_compact(cap)
+                    if self.obs is not None:
+                        self.obs.flight.note(
+                            "watchdog", "compaction", gid=node.gid,
+                            floor=p.compaction_floor)
+                except OSError as exc:
+                    # A failed compaction leaves the OLD store
+                    # authoritative (abort drains the queued appends
+                    # back into it) — log and retry later; never
+                    # disable persistence for it.
+                    self.logger.warning("store compaction failed "
+                                        "(g%d): %s", node.gid, exc)
+                    with self.lock:
+                        p.abort_compact(cap)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -718,6 +803,10 @@ class ReplicaDaemon:
         if self.node.snapshot_upcalls:
             snaps, self.node.snapshot_upcalls = \
                 self.node.snapshot_upcalls, []
+            if self.elastic is not None:
+                # An install may have replaced group 0's migration
+                # tables wholesale (they ride the reserved key).
+                self.elastic.dirty = True
             for snap, ep_dump in snaps:
                 # A FILE-backed capture is only streamable while the
                 # SM's dump generation still matches (another install
@@ -736,6 +825,18 @@ class ReplicaDaemon:
         if self.node.committed_upcalls:
             entries, self.node.committed_upcalls = \
                 self.node.committed_upcalls, []
+            if self.elastic is not None:
+                for e in entries:
+                    if e.data[:1] != b"M":
+                        continue
+                    # Migration record applied in group 0: the derived
+                    # shard map must recompute before the next
+                    # admission; a split's freeze record additionally
+                    # creates the dst group from its replicated
+                    # genesis cid.
+                    self.elastic.dirty = True
+                    if e.data[:2] == b"MB":
+                        self.elastic.ensure_from_begin(e.data)
             for e in entries:
                 for cb in self.on_commit:
                     cb(e)
@@ -998,13 +1099,27 @@ def main(argv: Optional[list] = None) -> int:
         # slot fences immediately.
         group_cids = None
         missing_groups = []
+        live_groups = None
         if getattr(spec, "groups", 1) > 1:
+            from apus_tpu.runtime.client import probe_status
             from apus_tpu.runtime.membership import \
                 request_join_all_groups
+            # Elastic groups: a split may have grown the group count
+            # past the static config — learn the LIVE count from any
+            # member so the joiner enters every group that exists.
+            live_groups = spec.groups
+            for p in spec.peers:
+                if not p or p == my_addr:
+                    continue
+                st = probe_status(p, timeout=1.0)
+                if st is not None:
+                    live_groups = max(live_groups,
+                                      st.get("n_groups", 1))
+                    break
             group_cids = request_join_all_groups(
                 [p for i, p in enumerate(spec.peers)
-                 if p and i != slot], my_addr, slot, spec.groups)
-            missing_groups = sorted(set(range(1, spec.groups))
+                 if p and i != slot], my_addr, slot, live_groups)
+            missing_groups = sorted(set(range(1, live_groups))
                                     - set(group_cids))
         join_my_addr = my_addr
         # Mesh-capable joiners carry a DETACHED runner: the leader's
@@ -1018,7 +1133,8 @@ def main(argv: Optional[list] = None) -> int:
                                tick_interval=args.tick_interval,
                                log_file=args.log_file, db_dir=args.db_dir,
                                device_runner=mesh_runner,
-                               group_cids=group_cids)
+                               group_cids=group_cids,
+                               live_groups=live_groups)
     else:
         # Multi-controller mesh plane (runtime.mesh_plane): static
         # members 0..mesh_n-1 each own one device of the global mesh.
@@ -1314,17 +1430,33 @@ def _excluded_by_live_leader(daemon: "ReplicaDaemon", spec) -> bool:
     """True iff some reachable peer is a leader (at a term >= ours)
     whose membership does NOT contain our slot — the affirmative signal
     that the failure detector removed us.  A mere partition (no leader
-    reachable, or a leader that still lists us) never triggers."""
+    reachable, or a leader that still lists us) never triggers.
+
+    Probes FOLLOW leader hints: the current leader may be a replica
+    that joined after our boot config was written (an elastic/churn
+    cluster grows), so a followers-only peer table must still find it
+    through their ``leader_addr`` answers — without the hop, a victim
+    restarted while a joiner led sat unexcluded-looking forever (the
+    wedge the first elastic campaign caught)."""
     from apus_tpu.runtime.client import probe_status
     my_addr = spec.peers[daemon.idx] if daemon.idx < len(spec.peers) else ""
-    for addr in spec.peers:
-        if not addr or addr == my_addr:
+    seen: set = set()
+    queue = [a for a in spec.peers if a and a != my_addr]
+    while queue:
+        addr = queue.pop(0)
+        if addr in seen:
             continue
+        seen.add(addr)
         st = probe_status(addr, timeout=0.3)
-        if (st is not None and st.get("is_leader")
+        if st is None:
+            continue
+        if (st.get("is_leader")
                 and st.get("term", 0) >= daemon.node.current_term
                 and daemon.idx not in st.get("members", [])):
             return True
+        la = st.get("leader_addr")
+        if la and la != my_addr and la not in seen:
+            queue.append(la)
     return False
 
 

@@ -1,0 +1,642 @@
+"""Elastic groups: online SPLIT/MERGE of the Multi-Raft keyspace.
+
+PR 10's shards are static — fixed count, fixed key->group hash.  This
+module makes the group plane ELASTIC: the keyspace is quantized into
+``router.NBUCKETS`` hash buckets, a versioned :class:`router.ShardMap`
+assigns buckets to groups, and whole bucket sets migrate between groups
+online, under load, with every decision FENCED by replicated records in
+the participating groups' own logs ("Reconfigurable Atomic Transaction
+Commit"'s discipline: a reconfiguration decision must survive the
+failure of whoever drove it).
+
+Protocol (three replicated records; see models/kvs.py for encodings):
+
+    MB  (src group's log)   freeze the bucket set.  From MB-apply on,
+        every replica of src deterministically NO-OPS writes into those
+        buckets with a REFUSED sentinel (admission refuses them up
+        front with a typed MIGRATING answer; the sentinel covers
+        entries that raced a leader change past an unapplied MB).
+        Because SM apply order == log order, ANY capture taken after
+        MB applies is stable — there is nothing a resumed driver can
+        miss.
+    MI  (dst group's log)   install the captured pairs.  Idempotent by
+        mig_id: a driver resumed on a new src leader re-captures
+        (bit-identical — frozen) and re-installs harmlessly.
+    MC  (src group's log)   commit: delete the moved keys at src, flip
+        bucket ownership to dst, bump the shard-map epoch.
+
+Single-ownership invariant: src owns a bucket until MB applies
+(refusing writes from then on), NOBODY completes a write in
+[MB-apply, MC-apply), and dst owns it from MC-apply on.  Every daemon
+hosts a replica of BOTH groups, so each daemon's ownership view
+(:meth:`ElasticPlane.shard_map`) is derived locally from its applied
+SMs — the same source restart replay and snapshot catch-up rebuild.
+
+Exactly-once across the flip WITHOUT moving the endpoint DB: a write
+refused at src (frozen/departed) provably never applied there, so the
+client re-routes it under a FRESH req_id and the dst group executes it
+once; a write that DID apply at src pre-freeze keeps answering from
+src's retained dedup cache.  Monotone per-(client, group) req_id
+streams are preserved on both sides — the dedup-merge hazards of
+shipping epdb state across groups never arise (DESIGN.md "Elastic
+groups" walks the counterexample).
+
+The DRIVER is a per-daemon watchdog thread: whichever daemon currently
+leads a group with an open (frozen) migration drives/resumes it — a
+leader kill mid-migration just moves the driver with the leadership.
+
+Clients learn the map lazily: a server answering an op for a bucket it
+does not own replies with a typed WRONG_GROUP hint carrying the new
+epoch AND the full map, so one bounce re-synchronizes a stale-epoch
+client.
+"""
+
+from __future__ import annotations
+
+import secrets
+import socket
+import threading
+import time
+from typing import Optional
+
+from apus_tpu.core.cid import Cid, CidState
+from apus_tpu.parallel import wire
+from apus_tpu.runtime.router import ShardMap, bucket_of_key
+
+#: admin/control ops on the daemon's PeerServer (top-level, never
+#: group-wrapped: the payload names the group it operates on)
+OP_SPLIT = 27      # u8 src_gid -> split half of src's buckets into a
+                   # NEW group (the leader of src commits MB)
+OP_MERGE = 28      # u8 src_gid | u8 dst_gid -> migrate ALL of src's
+                   # buckets into dst (src keeps running, owns nothing)
+OP_GCTL = 29       # u8 gid | cid -> ensure consensus group gid exists
+                   # on this daemon (idempotent; driver broadcast)
+OP_SHARDMAP = 30   # -> current shard map + group count
+
+#: cap on dynamically-created groups (gid is a wire u8; 64 is far past
+#: any box this runs on)
+MAX_GROUPS = 64
+
+
+class ElasticPlane:
+    """Per-daemon elastic-group state: the derived shard map, the
+    admission fence, and the migration driver.  Attached by the daemon
+    when the multi-group runtime is built (``daemon.elastic``); all map
+    reads/recomputes run under the daemon lock."""
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self.base_groups = max(1, getattr(daemon.spec, "groups", 1))
+        #: set by the upcall drains whenever a migration record applied
+        #: (or a snapshot install may have changed SM migration state);
+        #: the next map read recomputes.
+        self.dirty = True
+        #: False until any migration exists — the admission fast path
+        #: is one attribute read on clusters that never migrate.
+        self.active = False
+        self._map = ShardMap.initial(self.base_groups)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._driver_clt = None
+        # Driver-submitted records ride the normal client-write path
+        # with a plane-owned client identity (epdb dedup for driver
+        # retries); one monotone counter covers every group's stream.
+        self._sys_clt = secrets.randbits(62) | (1 << 62)
+        self._sys_req = 0
+        self._sys_lock = threading.Lock()
+
+    def _next_req(self) -> int:
+        with self._sys_lock:
+            self._sys_req += 1
+            return self._sys_req
+
+    # -- derived ownership view --------------------------------------------
+
+    def _nodes(self):
+        d = self.daemon
+        if d.groupset is not None:
+            return list(enumerate(d.groupset.nodes))
+        return [(0, d.node)]
+
+    def _recompute(self) -> None:
+        migs = []
+        any_open = False
+        for _gid, n in self._nodes():
+            sm = n.sm
+            for rec in getattr(sm, "migs_out", {}).values():
+                dst, epoch, state, buckets = rec[:4]
+                if state == "committed":
+                    migs.append((epoch, tuple(buckets), dst))
+                else:
+                    any_open = True
+            if getattr(sm, "migs_in", None):
+                any_open = True
+        m = ShardMap.initial(self.base_groups)
+        for epoch, buckets, dst in sorted(migs):
+            m = m.move(buckets, dst, epoch)
+        self._map = m
+        self.active = bool(migs) or any_open
+        self.dirty = False
+
+    def shard_map(self) -> ShardMap:
+        """Current bucket->group assignment, derived from the applied
+        SMs (caller holds the daemon lock)."""
+        if self.dirty:
+            self._recompute()
+        return self._map
+
+    def ensure_from_begin(self, data: bytes) -> None:
+        """MB applied in a local group (upcall drain, under the daemon
+        lock): create the dst group HERE from the record's REPLICATED
+        genesis cid — every daemon of the src group applies the same
+        bytes, so genesis configurations cannot diverge.  The driver's
+        GCTL broadcast remains the catch-up path for daemons that were
+        down through the apply."""
+        from apus_tpu.models.kvs import decode_mig_begin
+        try:
+            _mig, dst, _epoch, size, mask, _buckets = \
+                decode_mig_begin(data)
+        except Exception:                             # noqa: BLE001
+            return
+        if not size or self.daemon.groupset is None \
+                or dst < self.daemon.n_groups or dst >= MAX_GROUPS:
+            return
+        self._ensure_local(dst, Cid(epoch=0, state=CidState.STABLE,
+                                    size=size, new_size=0,
+                                    bitmask=mask))
+
+    def genesis_cid_for(self, gid: int) -> "Cid | None":
+        """Genesis cid of a split-born group, recovered from the MB
+        record in the (already-replayed) src group's SM — the boot
+        store-scan path (caller holds the daemon lock or runs at
+        construction)."""
+        for _g, n in self._nodes():
+            for rec in getattr(n.sm, "migs_out", {}).values():
+                if rec[0] == gid and len(rec) > 5 and rec[4]:
+                    return Cid(epoch=0, state=CidState.STABLE,
+                               size=rec[4], new_size=0,
+                               bitmask=rec[5])
+        return None
+
+    # -- admission fence (client.py handlers, under the daemon lock) ------
+
+    def admit(self, node, data: bytes):
+        """Ownership check for a client op against group ``node.gid``:
+        None = serve; ("wrong_group", owner_gid) = typed bounce with
+        the map; ("migrating",) = bucket frozen mid-migration, client
+        retries shortly.  Reads on FROZEN buckets serve (values cannot
+        change anywhere until the flip; the reply-time ``departed``
+        re-check guards the flip itself)."""
+        if self.dirty:
+            self._recompute()
+        if not self.active:
+            return None
+        from apus_tpu.models.kvs import RESERVED_PREFIX, decode_key
+        key = decode_key(data)
+        if key is None or key.startswith(RESERVED_PREFIX):
+            return None
+        b = bucket_of_key(key)
+        owner = self._map.assign[b]
+        if owner != node.gid:
+            node.bump("wrong_group_hints")
+            return ("wrong_group", owner)
+        if data[:1] != b"G" and b in getattr(node.sm, "_frozen", ()):
+            node.bump("migrating_refusals")
+            return ("migrating",)
+        return None
+
+    def departed(self, node, data: bytes) -> "tuple | None":
+        """Reply-time read re-check: ("wrong_group", owner) when the
+        key's bucket left this node's group while the read was parked
+        (serving the locally-applied value would be a stale read past
+        the flip); None to serve.  Caller holds the daemon lock."""
+        if self.dirty:
+            self._recompute()
+        if not self.active:
+            return None
+        from apus_tpu.models.kvs import RESERVED_PREFIX, decode_key
+        key = decode_key(data)
+        if key is None or key.startswith(RESERVED_PREFIX):
+            return None
+        owner = self._map.assign[bucket_of_key(key)]
+        if owner != node.gid:
+            node.bump("wrong_group_hints")
+            return ("wrong_group", owner)
+        return None
+
+    # -- status / scrape ----------------------------------------------------
+
+    def migrations_view(self) -> list:
+        """OP_STATUS: every migration record any local SM knows, with
+        its state (caller holds the daemon lock)."""
+        out = []
+        for gid, n in self._nodes():
+            for mid, rec in getattr(n.sm, "migs_out", {}).items():
+                out.append({"mig": int(mid), "src": gid, "dst": rec[0],
+                            "epoch": rec[1], "state": rec[2],
+                            "buckets": len(rec[3])})
+        return out
+
+    # -- migration driver ---------------------------------------------------
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._run, daemon=True,
+                             name=f"apus-elastic-{self.daemon.idx}")
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self._driver_clt is not None:
+            try:
+                self._driver_clt.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        probe_at = 0.0
+        while not self._stop.wait(0.05):
+            try:
+                self._pass()
+            except Exception:                         # noqa: BLE001
+                self.daemon.logger.exception(
+                    "elastic driver pass failed")
+            now = time.monotonic()
+            if now >= probe_at:
+                probe_at = now + 2.0
+                try:
+                    self._learn_groups()
+                except Exception:                     # noqa: BLE001
+                    pass
+
+    def _pass(self) -> None:
+        """Resume every open migration whose SRC group this daemon
+        currently leads (leader kill mid-migration moves the driver
+        with the leadership; every step below is idempotent)."""
+        d = self.daemon
+        work = []
+        with d.lock:
+            for gid, node in self._nodes():
+                if not node.is_leader:
+                    continue
+                for mid, rec in getattr(node.sm, "migs_out",
+                                        {}).items():
+                    if rec[2] == "frozen":
+                        work.append((gid, node, int(mid), rec[0],
+                                     rec[1], list(rec[3]),
+                                     rec[4] if len(rec) > 4 else 0,
+                                     rec[5] if len(rec) > 5 else 0))
+        for gid, node, mig_id, dst, epoch, buckets, csize, cmask \
+                in work:
+            if self._stop.is_set():
+                return
+            self._drive(gid, node, mig_id, dst, epoch, buckets,
+                        csize, cmask)
+
+    def _drive(self, gid: int, node, mig_id: int, dst: int,
+               epoch: int, buckets: list, csize: int = 0,
+               cmask: int = 0) -> None:
+        from apus_tpu.models.kvs import (RESERVED_PREFIX,
+                                         encode_mig_commit,
+                                         encode_mig_install)
+        d = self.daemon
+        # 1. The dst group must exist on every daemon (idempotent
+        # re-broadcast each pass: a peer that was down during the
+        # split learns it here or via its own _learn_groups probe).
+        # The genesis cid is the one REPLICATED in the MB record —
+        # never a locally-projected member set, which could diverge
+        # across daemons at the same epoch with no reconciliation.
+        with d.lock:
+            cid = (Cid(epoch=0, state=CidState.STABLE, size=csize,
+                       new_size=0, bitmask=cmask)
+                   if csize else _stable_projection(node.cid))
+            self._ensure_local(dst, cid)
+        payload = wire.u8(OP_GCTL) + wire.u8(dst) + wire.encode_cid(cid)
+        for i, addr in enumerate(d.spec.peers):
+            if addr and i != d.idx:
+                _oneshot(addr, payload, timeout=2.0)
+        # 2. Capture the frozen range (stable from MB-apply on — see
+        # module docstring; any two captures are identical).
+        with d.lock:
+            if not node.is_leader:
+                return
+            bset = set(buckets)
+            pairs = [(k, v) for k, v in node.sm.store.items()
+                     if not k.startswith(RESERVED_PREFIX)
+                     and bucket_of_key(k) in bset]
+        if d.obs is not None:
+            d.obs.flight.note("elastic", "capture", gid=gid,
+                              mig=mig_id, dst=dst, keys=len(pairs))
+        # 3. Install at dst, 4. commit at src — both through the
+        # ordinary replicated client-write path (the records are
+        # majority-acked in their group before the driver proceeds;
+        # MI is idempotent by mig_id, MC by state).
+        if not self._group_write(
+                dst, encode_mig_install(mig_id, gid, epoch, buckets,
+                                        pairs)):
+            return                       # retried on the next pass
+        if not self._group_write(gid, encode_mig_commit(mig_id)):
+            return
+        node.bump("migrations")
+        if d.obs is not None:
+            d.obs.flight.note("elastic", "committed", gid=gid,
+                              mig=mig_id, dst=dst, epoch=epoch)
+        d.logger.info("elastic: migration %d committed — %d buckets "
+                      "g%d -> g%d (router epoch %d)", mig_id,
+                      len(buckets), gid, dst, epoch)
+        with d.lock:
+            self.dirty = True
+
+    def _ensure_local(self, gid: int, cid: Cid) -> None:
+        """Create missing groups up to ``gid`` on THIS daemon (caller
+        holds the daemon lock)."""
+        d = self.daemon
+        if d.groupset is None:
+            raise RuntimeError("elastic groups need the multi-group "
+                               "runtime (spec.groups >= 2)")
+        while d.n_groups <= gid:
+            d.groupset.ensure_group(d.n_groups, cid)
+            self.dirty = True
+
+    def _group_write(self, gid: int, data: bytes,
+                     timeout: float = 15.0) -> bool:
+        from apus_tpu.runtime.client import OP_CLT_WRITE, ApusClient
+        c = self._driver_clt
+        if c is None:
+            c = ApusClient([p for p in self.daemon.spec.peers if p],
+                           clt_id=self._sys_clt, timeout=timeout,
+                           attempt_timeout=3.0)
+            self._driver_clt = c
+        try:
+            rid = self._next_req()
+            c._req_seq = rid
+            reply = c._op(OP_CLT_WRITE, rid, data, gid=gid)
+            return reply == b"OK"
+        except (TimeoutError, RuntimeError, OSError, ConnectionError):
+            return False
+
+    def _learn_groups(self) -> None:
+        """A daemon that missed a split (down while it happened) learns
+        the new groups from any peer's status and creates them locally
+        with the peer's reported configuration — the per-group catch-up
+        replication then fills its log."""
+        from apus_tpu.runtime.client import probe_status
+        d = self.daemon
+        if d.groupset is None:
+            return
+        for i, addr in enumerate(d.spec.peers):
+            if not addr or i == d.idx:
+                continue
+            st = probe_status(addr, timeout=0.5)
+            if st is None:
+                continue
+            theirs = st.get("n_groups", 1)
+            if theirs <= d.n_groups:
+                return
+            for gid in range(d.n_groups, min(theirs, MAX_GROUPS)):
+                gv = (st.get("groups") or {}).get(str(gid))
+                if gv is None:
+                    continue
+                members = gv.get("members", [])
+                cid = Cid(epoch=gv.get("epoch", 0),
+                          state=CidState.STABLE, size=len(members),
+                          new_size=0,
+                          bitmask=sum(1 << m for m in members))
+                with d.lock:
+                    if d.n_groups == gid:
+                        d.groupset.ensure_group(gid, cid)
+                        self.dirty = True
+                d.logger.info("elastic: learned group %d from %s",
+                              gid, addr)
+            return
+
+
+def _stable_projection(cid: Cid) -> Cid:
+    """The src group's CURRENT member set as a fresh STABLE cid — the
+    genesis configuration of a split's new group (same daemons, own
+    epochs from 0)."""
+    return Cid(epoch=0, state=CidState.STABLE,
+               size=cid.extended_group_size, new_size=0,
+               bitmask=cid.bitmask)
+
+
+# -- daemon-side admin ops --------------------------------------------------
+
+def make_elastic_ops(daemon) -> dict:
+    from apus_tpu.runtime.client import _not_leader
+    from apus_tpu.runtime.membership import ST_REFUSED, ST_RETRY
+
+    plane = daemon.elastic
+
+    def _refused(reason: bytes, transient: bool = False) -> bytes:
+        return (wire.u8(ST_RETRY if transient else ST_REFUSED)
+                + wire.blob(reason))
+
+    def _start(src: int, dst_req: "int | None") -> bytes:
+        from apus_tpu.models.kvs import encode_mig_begin
+        node = daemon.group_node(src)
+        if node is None:
+            return _refused(b"unknown_src_group")
+        with daemon.lock:
+            if not node.is_leader:
+                return _not_leader(daemon, node=node)
+            if daemon.groupset is None:
+                return _refused(b"single_group_daemon")
+            m = plane.shard_map()
+            owned = m.owned(src)
+            for rec in node.sm.migs_out.values():
+                if rec[2] == "frozen":
+                    return _refused(b"migration_in_flight",
+                                    transient=True)
+            if dst_req is None:
+                if len(owned) < 2:
+                    return _refused(b"too_few_buckets")
+                dst = daemon.n_groups
+                if dst >= MAX_GROUPS:
+                    return _refused(b"group_cap")
+                buckets = ShardMap.split_buckets(owned)
+            else:
+                dst = dst_req
+                if dst == src or dst >= daemon.n_groups:
+                    return _refused(b"bad_dst_group")
+                if not owned:
+                    return _refused(b"src_owns_nothing")
+                buckets = owned
+            epoch = m.epoch + 1
+            mig_id = (epoch << 8) | src
+            csize = cmask = 0
+            if dst_req is None:
+                # SPLIT: decide the new group's genesis configuration
+                # ONCE (the src group's member set now) and replicate
+                # it inside MB — every daemon then creates the group
+                # from the same bytes at MB-apply.
+                gcid = _stable_projection(node.cid)
+                csize, cmask = gcid.size, gcid.bitmask
+                plane._ensure_local(dst, gcid)
+            pr = node.submit(plane._next_req(), plane._sys_clt,
+                             encode_mig_begin(mig_id, dst, epoch,
+                                              buckets, csize, cmask))
+            if pr is None:
+                return _not_leader(daemon, node=node)
+            node.flush_pending()
+        if daemon.obs is not None:
+            daemon.obs.flight.note("elastic", "begin", gid=src,
+                                   mig=mig_id, dst=dst, epoch=epoch,
+                                   buckets=len(buckets))
+        deadline = time.monotonic() + daemon.client_op_timeout
+        with daemon.commit_cond:
+            while True:
+                if pr.reply is not None:
+                    return (wire.u8(wire.ST_OK) + wire.u64(mig_id)
+                            + wire.u8(dst) + wire.u32(epoch))
+                if not node.is_leader:
+                    return _not_leader(daemon, node=node)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return _refused(b"begin_timeout", transient=True)
+                daemon.commit_cond.wait(min(left, 0.25))
+
+    def split(r: wire.Reader) -> bytes:
+        return _start(r.u8(), None)
+
+    def merge(r: wire.Reader) -> bytes:
+        return _start(r.u8(), r.u8())
+
+    def gctl(r: wire.Reader) -> bytes:
+        gid = r.u8()
+        cid = wire.decode_cid(r)
+        if gid >= MAX_GROUPS:
+            return wire.u8(wire.ST_ERROR)
+        with daemon.lock:
+            if daemon.groupset is None:
+                return wire.u8(wire.ST_ERROR)
+            try:
+                plane._ensure_local(gid, cid)
+            except RuntimeError:
+                return wire.u8(wire.ST_ERROR)
+        return wire.u8(wire.ST_OK)
+
+    def shardmap(r: wire.Reader) -> bytes:
+        with daemon.lock:
+            m = plane.shard_map()
+        return (wire.u8(wire.ST_OK) + wire.blob(m.to_blob())
+                + wire.u8(daemon.n_groups))
+
+    return {OP_SPLIT: split, OP_MERGE: merge, OP_GCTL: gctl,
+            OP_SHARDMAP: shardmap}
+
+
+# -- operator/harness side --------------------------------------------------
+
+def _oneshot(addr: str, payload: bytes,
+             timeout: float = 2.0) -> Optional[bytes]:
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(timeout)
+            conn.sendall(wire.frame(payload))
+            return wire.read_frame(conn)
+    except (OSError, ConnectionError, ValueError):
+        return None
+
+
+def _request_mig(peers: list, payload: bytes, what: str,
+                 timeout: float) -> dict:
+    """Find the src group's leader and start the migration; returns
+    {mig, dst, epoch} once MB committed."""
+    from apus_tpu.runtime.client import ST_NOT_LEADER
+    from apus_tpu.runtime.membership import (ST_REFUSED, ST_RETRY,
+                                             _Backoff)
+    import random as _random
+    deadline = time.monotonic() + timeout
+    cands = [p for p in peers if p]
+    backoff = _Backoff(_random.Random())
+    i = 0
+    while time.monotonic() < deadline:
+        target = cands[i % len(cands)]
+        i += 1
+        resp = _oneshot(target, payload,
+                        timeout=max(0.2, min(6.0,
+                                             deadline
+                                             - time.monotonic())))
+        if resp is None:
+            backoff.sleep(deadline)
+            continue
+        st = resp[0]
+        if st == wire.ST_OK:
+            r = wire.Reader(resp[1:])
+            return {"mig": r.u64(), "dst": r.u8(), "epoch": r.u32()}
+        if st == ST_NOT_LEADER:
+            hint = wire.Reader(resp[1:]).blob().decode() \
+                if len(resp) > 1 else ""
+            if hint and hint not in cands:
+                cands.append(hint)
+            if hint:
+                i = cands.index(hint)
+                backoff.reset()
+            time.sleep(0.01)
+            continue
+        if st == ST_REFUSED:
+            reason = wire.Reader(resp[1:]).blob().decode()
+            raise RuntimeError(f"{what} refused: {reason}")
+        if st == ST_RETRY:
+            backoff.sleep(deadline)
+            continue
+        backoff.sleep(deadline)
+    raise TimeoutError(f"{what} not started within {timeout}s")
+
+
+def request_split(peers: list, src_gid: int,
+                  timeout: float = 30.0) -> dict:
+    """Start a SPLIT of ``src_gid`` into a new group.  Returns
+    {mig, dst, epoch} once the freeze record (MB) committed; poll
+    :func:`wait_router_epoch` for completion."""
+    return _request_mig(peers, wire.u8(OP_SPLIT) + wire.u8(src_gid),
+                        f"split of group {src_gid}", timeout)
+
+
+def request_merge(peers: list, src_gid: int, dst_gid: int,
+                  timeout: float = 30.0) -> dict:
+    """Start a MERGE of all of ``src_gid``'s buckets into
+    ``dst_gid``."""
+    return _request_mig(peers,
+                        wire.u8(OP_MERGE) + wire.u8(src_gid)
+                        + wire.u8(dst_gid),
+                        f"merge g{src_gid} -> g{dst_gid}", timeout)
+
+
+def fetch_shard_map(addr: str, timeout: float = 2.0):
+    """(ShardMap, n_groups) from one daemon, or None."""
+    resp = _oneshot(addr, wire.u8(OP_SHARDMAP), timeout=timeout)
+    if not resp or resp[0] != wire.ST_OK:
+        return None
+    r = wire.Reader(resp[1:])
+    m = ShardMap.from_blob(r.blob())
+    n = r.u8() if r.remaining else m.n_groups
+    return m, n
+
+
+def wait_router_epoch(peers: list, epoch: int,
+                      timeout: float = 60.0) -> None:
+    """Block until EVERY reachable daemon reports shard-map epoch >=
+    ``epoch`` (the migration committed and the flip propagated to all
+    members' local views)."""
+    deadline = time.monotonic() + timeout
+    last: list = []
+    while time.monotonic() < deadline:
+        views = []
+        for addr in [p for p in peers if p]:
+            got = fetch_shard_map(addr, timeout=1.0)
+            if got is not None:
+                views.append(got[0].epoch)
+        last = views
+        if views and all(v >= epoch for v in views):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"router epoch {epoch} never reached all members within "
+        f"{timeout}s (saw {last})")

@@ -41,6 +41,7 @@ renewal ride one frame per peer per period.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 from apus_tpu.core.cid import Cid
@@ -75,53 +76,188 @@ class GroupSet:
         assert n_groups >= 2, n_groups
         self.daemon = daemon
         self.n_groups = n_groups
+        self.sm_factory = sm_factory
         self.nodes: list[Node] = [daemon.node]
         self._ports: dict[int, GroupPort] = {}
         self._hb_items: list[tuple] = []      # (node, my_sid, t0)
         self._wake: tuple = ()
         self._last_roles: dict[int, tuple] = {}
+        # Per-group durable stores (elastic-group plane): gid ->
+        # Persistence, with the daemon's disk-fault containment policy
+        # applied PER GROUP (one group's dead disk path never disables
+        # a sibling's).  Attached by the daemon when it has a db_dir.
+        self.db_dir: Optional[str] = None
+        self.persists: dict = {}
+        self.persist_disabled: dict[int, bool] = {}
+        self.persist_errors: dict[int, int] = {}
         cids = cids or {}
-        cfg0 = daemon._node_cfg
+        self._build_port(0)
         for gid in range(1, n_groups):
-            # Per-group election phase: same timing envelope, distinct
-            # rng stream per (daemon, gid) so different groups tend to
-            # elect leaders on different daemons (the load-spreading
-            # the sharding exists for), while the ENVELOPE — and the
-            # clock seam every timer reads — stays shared.
-            cfg = dataclasses.replace(cfg0, seed=cfg0.seed + 7919 * gid)
-            gt = GroupTransport(daemon.transport, gid)
-            cid = cids.get(gid) or Cid.initial(daemon.spec.group_size)
-            node = Node(cfg, cid, sm_factory(), gt)
-            node.gid = gid
-            node.clock = daemon.clock
-            node.async_snap_push = True
-            if cids.get(gid) is not None:
-                node.incarnation = cid.epoch
-            gt.incarnation_of = (lambda n=node: n.incarnation)
-            if daemon.obs is not None:
-                node.attach_obs(daemon.obs)
-            # Same cold-start election grace as the primary node.
-            node._last_hb_seen = (daemon.clock()
-                                  + node.rng.random()
-                                  * node.cfg.elect_high)
-            node.hb_sink = self.hb_sink
-            self._install_flr(node, gt)
-            self.nodes.append(node)
+            self._make_group(gid, cids.get(gid),
+                             adopt_incarnation=cids.get(gid)
+                             is not None)
         # Group 0 heartbeats coalesce into the same per-peer frames.
         daemon.node.hb_sink = self.hb_sink
-        self._build_ports()
+
+    def _make_group(self, gid: int, cid: Optional[Cid],
+                    adopt_incarnation: bool = False) -> Node:
+        daemon = self.daemon
+        cfg0 = daemon._node_cfg
+        # Per-group election phase: same timing envelope, distinct
+        # rng stream per (daemon, gid) so different groups tend to
+        # elect leaders on different daemons (the load-spreading
+        # the sharding exists for), while the ENVELOPE — and the
+        # clock seam every timer reads — stays shared.
+        cfg = dataclasses.replace(cfg0, seed=cfg0.seed + 7919 * gid)
+        gt = GroupTransport(daemon.transport, gid)
+        if cid is None:
+            cid = Cid.initial(daemon.spec.group_size)
+        node = Node(cfg, cid, self.sm_factory(), gt)
+        node.gid = gid
+        node.clock = daemon.clock
+        node.async_snap_push = True
+        if adopt_incarnation:
+            node.incarnation = cid.epoch
+        gt.incarnation_of = (lambda n=node: n.incarnation)
+        if daemon.obs is not None:
+            node.attach_obs(daemon.obs)
+        # Same cold-start election grace as the primary node.
+        node._last_hb_seen = (daemon.clock()
+                              + node.rng.random()
+                              * node.cfg.elect_high)
+        node.hb_sink = self.hb_sink
+        self._install_flr(node, gt)
+        assert gid == len(self.nodes), (gid, len(self.nodes))
+        self.nodes.append(node)
+        self._build_port(gid)
+        return node
+
+    def ensure_group(self, gid: int, cid: Optional[Cid]) -> Node:
+        """Create consensus group ``gid`` ONLINE (the elastic SPLIT
+        path / a daemon learning a group it missed).  Sequential gids
+        only; idempotent for existing ones.  Caller holds the daemon
+        lock; the new group's store attaches immediately (empty — it
+        was just born) when this daemon persists."""
+        if gid < len(self.nodes):
+            return self.nodes[gid]
+        node = self._make_group(gid, cid)
+        self.n_groups = len(self.nodes)
+        self.daemon.n_groups = self.n_groups
+        if self.db_dir is not None:
+            self._attach_store(gid)
+        self.daemon.logger.info("group %d created online (%r)", gid,
+                                node.cid)
+        return node
+
+    # -- per-group durable stores (elastic-group durability) ---------------
+
+    def attach_persistence(self, db_dir: str) -> None:
+        """Give every EXTRA group its own durable store under the
+        replica's db dir (``apus_records.<idx>.g<gid>.db``) and replay
+        it: each group's SM/epdb rebuild independently and its log
+        RE-BASES at its own replay point — a whole-group quorum
+        SIGKILL + restart now recovers every acked write of every
+        group from disk, exactly like group 0 (the ROADMAP's "extra
+        groups carry NO durable store" hole).  Called once at daemon
+        construction, before serving.  Store files beyond the static
+        group count re-create their groups first (a split survives a
+        full-cluster restart)."""
+        import re
+
+        self.db_dir = db_dir
+        pat = re.compile(
+            rf"apus_records\.{self.daemon.idx}\.g(\d+)\.db$")
+        found = []
+        try:
+            for name in os.listdir(db_dir):
+                m = pat.match(name)
+                if m:
+                    found.append(int(m.group(1)))
+        except OSError:
+            pass
+        # Static groups replay FIRST: split-born groups' genesis cids
+        # are recovered from the MB records in their (replayed) src
+        # groups' SMs below.
+        for gid in range(1, self.n_groups):
+            self._attach_store(gid)
+        # Dynamic groups born by splits: their store files are the
+        # durable evidence they existed — re-create them (ascending,
+        # so a second-generation split's src is replayed before its
+        # dst) with the REPLICATED genesis cid where the replayed MB
+        # record carries it; ensure_group replays each store.
+        for gid in sorted(found):
+            while gid >= self.n_groups:
+                self.ensure_group(self.n_groups,
+                                  self._genesis_cid(self.n_groups))
+
+    def _genesis_cid(self, gid: int) -> Optional[Cid]:
+        """Genesis cid of a split-born group from the MB record in any
+        replayed local SM (None -> Cid.initial fallback)."""
+        from apus_tpu.core.cid import CidState
+        for n in self.nodes:
+            for rec in getattr(n.sm, "migs_out", {}).values():
+                if rec[0] == gid and len(rec) > 5 and rec[4]:
+                    return Cid(epoch=0, state=CidState.STABLE,
+                               size=rec[4], new_size=0,
+                               bitmask=rec[5])
+        return None
+
+    def _attach_store(self, gid: int) -> None:
+        from apus_tpu.runtime.persist import (Persistence,
+                                              daemon_store_path)
+        if gid in self.persists:
+            return
+        daemon = self.daemon
+        node = self.nodes[gid]
+        # Per-group snapshot spool subdir: inbound stream partials of
+        # different groups must never collide on the deterministic
+        # per-slot file name.
+        spool = os.path.join(self.db_dir, f"g{gid}")
+        try:
+            os.makedirs(spool, exist_ok=True)
+            node.snap_spool_dir = spool
+        except OSError:
+            pass
+        p = Persistence(
+            daemon_store_path(self.db_dir, daemon.idx, gid=gid),
+            sync_policy=getattr(daemon.spec, "sync_policy", "batch"),
+            logger=daemon.logger)
+        self.persists[gid] = p
+        self.persist_disabled[gid] = False
+        self.persist_errors[gid] = 0
+        if p.store.count:
+            p.replay_into(node.sm, node.epdb, node=node)
+            daemon.logger.info(
+                "group %d store replayed: apply floor %d "
+                "(re-based)", gid, node.log.apply)
+
+    def _persist_fail(self, gid: int, stage: str, exc: OSError) -> None:
+        """Group-scoped arm of the daemon's first-error-disables
+        policy (daemon._persist_fail rationale)."""
+        self.persist_errors[gid] = self.persist_errors.get(gid, 0) + 1
+        if self.persist_disabled.get(gid):
+            return
+        self.persist_disabled[gid] = True
+        if self.daemon.obs is not None:
+            self.daemon.obs.flight.note("persist", "disabled",
+                                        gid=gid, stage=stage,
+                                        error=repr(exc))
+        self.daemon.logger.error(
+            "group %d PERSISTENCE DISABLED for this session: %s "
+            "failed (%s); the group keeps serving — durability of "
+            "acked writes remains replication", gid, stage, exc)
 
     # -- ports (PeerServer demux) -----------------------------------------
 
-    def _build_ports(self) -> None:
+    def _build_port(self, gid: int) -> None:
         from apus_tpu.runtime.client import make_client_ops
         from apus_tpu.runtime.flr import make_flr_ops
         from apus_tpu.runtime.membership import make_membership_ops
-        for gid, node in enumerate(self.nodes):
-            ops = {**make_client_ops(self.daemon, node=node),
-                   **make_membership_ops(self.daemon, node=node),
-                   **make_flr_ops(self.daemon, node=node)}
-            self._ports[gid] = GroupPort(node, ops)
+        node = self.nodes[gid]
+        ops = {**make_client_ops(self.daemon, node=node),
+               **make_membership_ops(self.daemon, node=node),
+               **make_flr_ops(self.daemon, node=node)}
+        self._ports[gid] = GroupPort(node, ops)
 
     def port(self, gid: int) -> Optional[GroupPort]:
         return self._ports.get(gid)
@@ -139,6 +275,15 @@ class GroupSet:
             node.tick(now)
             self._drain_group_upcalls(node)
             self._log_role(node)
+        # Batch sync policy, per group: one fdatasync per drain window
+        # per group that appended (exactly daemon._persist_flush).
+        for gid, p in self.persists.items():
+            if self.persist_disabled.get(gid):
+                continue
+            try:
+                p.flush_window()
+            except OSError as exc:
+                self._persist_fail(gid, "fsync", exc)
 
     def wake_state(self) -> tuple:
         """Extra groups' contribution to the daemon's waiter-predicate
@@ -165,14 +310,51 @@ class GroupSet:
                                     node.current_term, node.role.name)
 
     def _drain_group_upcalls(self, node: Node) -> None:
-        # Extra groups carry no persistence (restart recovery is
-        # snapshot catch-up from peers — their durability is
-        # replication) and no app bridge, so committed/snapshot
-        # upcalls are consumed without observers.
-        if node.committed_upcalls:
-            node.committed_upcalls.clear()
+        # Per-group durability: committed entries and installed
+        # snapshots land in THIS group's store (group 0's drain is
+        # daemon._drain_upcalls); extra groups still carry no app
+        # bridge.  Elastic migration records (M*) additionally mark
+        # the daemon's derived shard map dirty.
+        gid = node.gid
+        p = self.persists.get(gid)
+        disabled = self.persist_disabled.get(gid, False)
         if node.snapshot_upcalls:
-            node.snapshot_upcalls.clear()
+            snaps, node.snapshot_upcalls = node.snapshot_upcalls, []
+            if self.daemon.elastic is not None:
+                # A snapshot install may have replaced SM migration
+                # state wholesale.
+                self.daemon.elastic.dirty = True
+            if p is not None and not disabled:
+                for snap, ep_dump in snaps:
+                    # Stale file-backed captures are skipped exactly as
+                    # in daemon._drain_upcalls (generation fence).
+                    if snap.data_path is not None and snap.data_gen \
+                            != getattr(node.sm, "dump_generation", 0):
+                        continue
+                    try:
+                        p.on_snapshot(snap, ep_dump)
+                    except OSError as exc:
+                        self._persist_fail(gid, "snapshot record", exc)
+                        break
+        if node.committed_upcalls:
+            entries, node.committed_upcalls = \
+                node.committed_upcalls, []
+            if self.daemon.elastic is not None:
+                for e in entries:
+                    if e.data[:1] != b"M":
+                        continue
+                    self.daemon.elastic.dirty = True
+                    if e.data[:2] == b"MB":
+                        # Split freeze applied: create the dst group
+                        # from the record's replicated genesis cid.
+                        self.daemon.elastic.ensure_from_begin(e.data)
+            if p is not None and not self.persist_disabled.get(gid):
+                for e in entries:
+                    try:
+                        p.on_commit(e)
+                    except OSError as exc:
+                        self._persist_fail(gid, "entry append", exc)
+                        break
         if node.config_upcalls:
             cfgs, node.config_upcalls = node.config_upcalls, []
             for e in cfgs:
@@ -279,8 +461,10 @@ class GroupSet:
         config — callers assert per-group convergence over the wire
         instead of log-scraping.  Under the daemon lock."""
         out = {}
+        elastic = self.daemon.elastic
+        shard = elastic.shard_map() if elastic is not None else None
         for gid, n in enumerate(self.nodes):
-            out[str(gid)] = {
+            gv = {
                 "role": n.role.name,
                 "is_leader": n.is_leader,
                 "term": n.current_term,
@@ -293,6 +477,28 @@ class GroupSet:
                 "members": [i for i in range(n.cid.extended_group_size)
                             if n.cid.contains(i)],
             }
+            # Per-group durability view (elastic-group plane): group
+            # 0's numbers come from the daemon's own store.
+            if gid == 0:
+                p = getattr(self.daemon, "persistence", None)
+                dis = getattr(self.daemon, "persist_disabled", False)
+                errs = getattr(self.daemon, "persist_errors", 0)
+            else:
+                p = self.persists.get(gid)
+                dis = self.persist_disabled.get(gid, False)
+                errs = self.persist_errors.get(gid, 0)
+            if p is not None:
+                gv["persist_floor"] = p.compaction_floor
+                gv["records_since_base"] = p.entries_since_base
+                gv["compactions"] = p.compactions
+                gv["persist_disabled"] = dis
+                gv["persist_errors"] = errs
+            if shard is not None:
+                gv["owned_buckets"] = sum(
+                    1 for g in shard.assign if g == gid)
+                gv["frozen_buckets"] = len(
+                    getattr(n.sm, "_frozen", ()) or ())
+            out[str(gid)] = gv
         return out
 
     def scrape_gauges(self, registry) -> None:
@@ -307,3 +513,13 @@ class GroupSet:
             registry.gauge(f"{p}_end").set(n.log.end)
             registry.gauge(f"{p}_is_leader").set(1 if n.is_leader else 0)
             registry.gauge(f"{p}_epoch").set(n.cid.epoch)
+            # Per-group durability gauges (elastic-group plane).
+            store = (getattr(self.daemon, "persistence", None)
+                     if gid == 0 else self.persists.get(gid))
+            if store is not None:
+                registry.gauge(f"{p}_persist_floor").set(
+                    store.compaction_floor)
+                registry.gauge(f"{p}_persist_records_since_base").set(
+                    store.entries_since_base)
+                registry.gauge(f"{p}_persist_compactions").set(
+                    store.compactions)

@@ -21,7 +21,8 @@ import struct
 
 from apus_tpu.core.epdb import EndpointDB
 from apus_tpu.core.log import LogEntry
-from apus_tpu.models.sm import Snapshot, StateMachine
+from apus_tpu.models.sm import (REFUSED_REPLY_PREFIX, Snapshot,
+                                StateMachine)
 from apus_tpu.parallel import wire
 from apus_tpu.utils.store import open_store
 
@@ -462,8 +463,14 @@ class Persistence:
             for kind, payload in decoded:
                 if kind == "entry":
                     reply = sm.apply(payload.idx, payload.data)
-                    epdb.note_applied(payload.clt_id, payload.req_id,
-                                      payload.idx, reply)
+                    # Deterministic REFUSED applies (elastic-group
+                    # bucket fences) are never dedup-noted — exactly
+                    # as the live apply path (core/node.py).
+                    if reply is None or not reply.startswith(
+                            REFUSED_REPLY_PREFIX):
+                        epdb.note_applied(payload.clt_id,
+                                          payload.req_id,
+                                          payload.idx, reply)
                     nxt = payload.idx + 1
                     last_det = (payload.idx, payload.term)
                 elif kind == "delta":
@@ -630,6 +637,12 @@ def decode_record(rec: bytes):
         f"{DELTA_MAGIC!r}); refusing to decode")
 
 
-def daemon_store_path(db_dir: str, idx: int) -> str:
+def daemon_store_path(db_dir: str, idx: int, gid: int = 0) -> str:
+    """Replica ``idx``'s durable store file; ``gid`` > 0 namespaces one
+    consensus group's store (elastic-group durability — each group
+    replays and re-bases independently).  Group 0 keeps the legacy name
+    so existing stores replay unchanged."""
     os.makedirs(db_dir, exist_ok=True)
+    if gid:
+        return os.path.join(db_dir, f"apus_records.{idx}.g{gid}.db")
     return os.path.join(db_dir, f"apus_records.{idx}.db")

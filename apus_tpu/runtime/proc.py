@@ -426,9 +426,19 @@ class ProcCluster:
         peers = [p for i, p in enumerate(self.spec.peers)
                  if p and i != idx and i < len(self.procs)
                  and self.procs[i] is not None]
+        # Elastic groups: the removal must commit in EVERY LIVE group,
+        # including split-born ones beyond the static config — learn
+        # the live count over the wire (a group the leave misses keeps
+        # a dead member on its quorum floor forever).
+        groups = getattr(self.spec, "groups", 1)
+        for p in peers:
+            st = probe_status(p, timeout=1.0)
+            if st is not None:
+                groups = max(groups, st.get("n_groups", 1))
+                break
         request_leave(peers, idx, timeout=timeout,
                       victim_addr=self.spec.peers[idx],
-                      groups=getattr(self.spec, "groups", 1))
+                      groups=groups)
         p = self.procs[idx]
         if p is not None:
             try:

@@ -19,12 +19,22 @@ Contract (pinned by tests/test_multigroup.py):
 
 from __future__ import annotations
 
+import struct
 import zlib
 
 #: 32-bit golden-ratio multiplier: spreads CRC32's low-bit structure
 #: before the modulo so tiny group counts still see all groups.
 _SPREAD = 0x9E3779B1
 _MASK = 0xFFFFFFFF
+
+#: Elastic routing granularity: the keyspace is quantized into this
+#: many fixed BUCKETS (hash slots); a shard map assigns each bucket to
+#: a consensus group and SPLIT/MERGE migrations move whole buckets.
+#: 840 = lcm(1..8), so the INITIAL assignment ``bucket % n`` composes
+#: to exactly ``group_of_key(key, n)`` for every genesis group count
+#: the benches use — a cluster that never migrates routes identically
+#: to the pre-elastic (pinned) hash, at every layer.
+NBUCKETS = 840
 
 
 def group_of_key(key: bytes, groups: int) -> int:
@@ -33,3 +43,68 @@ def group_of_key(key: bytes, groups: int) -> int:
         return 0
     h = (zlib.crc32(key) * _SPREAD) & _MASK
     return (h >> 16) % groups
+
+
+def bucket_of_key(key: bytes) -> int:
+    """Stable key -> hash bucket in [0, NBUCKETS) — the migration unit
+    of the elastic-group plane (same spread hash as group_of_key)."""
+    h = (zlib.crc32(key) * _SPREAD) & _MASK
+    return (h >> 16) % NBUCKETS
+
+
+class ShardMap:
+    """Versioned bucket -> group assignment (the client router's "hash
+    epoch").  ``epoch`` bumps on every committed migration; a server
+    answering a stale-epoch op sends the whole map back with the typed
+    WRONG_GROUP hint, so one bounce re-synchronizes the client.
+    Immutable; ``move`` returns a new map."""
+
+    __slots__ = ("epoch", "assign")
+
+    def __init__(self, epoch: int, assign: "tuple[int, ...]"):
+        assert len(assign) == NBUCKETS, len(assign)
+        self.epoch = epoch
+        self.assign = tuple(assign)
+
+    @staticmethod
+    def initial(n_groups: int) -> "ShardMap":
+        n = max(1, n_groups)
+        return ShardMap(0, tuple(b % n for b in range(NBUCKETS)))
+
+    @property
+    def n_groups(self) -> int:
+        return max(self.assign) + 1
+
+    def group_of_key(self, key: bytes) -> int:
+        return self.assign[bucket_of_key(key)]
+
+    def owner(self, bucket: int) -> int:
+        return self.assign[bucket]
+
+    def owned(self, gid: int) -> "list[int]":
+        return [b for b, g in enumerate(self.assign) if g == gid]
+
+    def move(self, buckets, dst_gid: int, epoch: int) -> "ShardMap":
+        assign = list(self.assign)
+        for b in buckets:
+            assign[b] = dst_gid
+        return ShardMap(max(self.epoch, epoch), tuple(assign))
+
+    @staticmethod
+    def split_buckets(owned: "list[int]") -> "list[int]":
+        """The half of ``owned`` a SPLIT ships to the new group
+        (alternating, so a skewed contiguous hot range splits too)."""
+        return sorted(owned)[1::2]
+
+    # -- wire form (WRONG_GROUP hints, OP_SHARDMAP) ------------------------
+
+    def to_blob(self) -> bytes:
+        return (struct.pack("<IH", self.epoch, NBUCKETS)
+                + bytes(self.assign))
+
+    @staticmethod
+    def from_blob(blob: bytes) -> "ShardMap":
+        epoch, n = struct.unpack_from("<IH", blob)
+        if n != NBUCKETS or len(blob) < 6 + n:
+            raise ValueError(f"bad shard-map blob (n={n})")
+        return ShardMap(epoch, tuple(blob[6:6 + n]))

@@ -311,11 +311,25 @@ def _collect_obs(pc) -> list:
     """Best-effort OP_OBS_DUMP sweep across a live ProcCluster — the
     flight/span rings of every reachable replica, fetched BEFORE
     teardown so a post-mortem check can still ship the cluster's last
-    seconds with the repro."""
+    seconds with the repro.  Multi-group clusters additionally attach
+    each replica's per-group view (groups status + router epoch +
+    migration records), so a migration-window violation's timeline
+    carries the per-group state it happened under."""
     try:
-        from apus_tpu.obs.service import collect_cluster_dumps
-        return collect_cluster_dumps(
-            [p for p in pc.spec.peers if p], timeout=2.0)
+        from apus_tpu.obs.service import fetch_obs_dump
+        from apus_tpu.runtime.client import probe_status
+        out = []
+        for addr in [p for p in pc.spec.peers if p]:
+            d = fetch_obs_dump(addr, timeout=2.0)
+            if d is None:
+                continue
+            st = probe_status(addr, timeout=1.0) or {}
+            if st.get("groups") is not None:
+                d["groups_view"] = st.get("groups")
+                d["router_epoch"] = st.get("router_epoch")
+                d["migrations"] = st.get("migrations")
+            out.append(d)
+        return out
     except Exception:                                 # noqa: BLE001
         return []
 
@@ -866,7 +880,9 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                        state_size: int = 0,
                        dump_obs: "str | None" = None,
                        time_nemesis: bool = False,
-                       groups: int = 1) -> dict:
+                       groups: int = 1,
+                       split_merge: bool = False,
+                       group_quorum_kill: bool = False) -> dict:
     """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
     3-replica fault-plane ProcCluster with auto-removal ON, concurrent
     recorded clients (serial + pipelined), and a seeded nemesis that
@@ -940,7 +956,11 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
              "leader_kills": 0, "receiver_kills": 0, "snap_resumes": 0,
              "snap_chunks_acked": 0, "delta_snapshots": 0,
-             "chunkfile_faults": 0, "pauses": 0, "clock_cmds": 0}
+             "chunkfile_faults": 0, "pauses": 0, "clock_cmds": 0,
+             "splits": 0, "merges": 0, "mig_leader_kills": 0,
+             "group_quorum_kills": 0, "router_epoch": 0}
+    #: live group count — grows when the split arm fires
+    cur_groups = groups
 
     def worker(wid: int, peers: list) -> None:
         wrng = random.Random((fault_seed << 4) ^ wid)
@@ -1058,6 +1078,32 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                  "hi": round(rng.uniform(0.001, 0.008), 4)}]))
             _dbg("phase1 net fault armed")
 
+            # Phase 1.5 (ELASTIC): whole-group quorum SIGKILL +
+            # restart — EVERY daemon dies simultaneously (no survivor
+            # holds any group's state), so the trial's final read
+            # round proves per-group DURABLE recovery: before the
+            # per-gid stores, a non-zero group lost its acked writes
+            # here.  Runs before any membership churn so every slot
+            # restarts at its boot endpoint.
+            if group_quorum_kill:
+                victims = [i for i in range(3)
+                           if pc.procs[i] is not None]
+                for v in victims:
+                    pc.kill(v)
+                churn["group_quorum_kills"] += 1
+                _dbg(f"group quorum SIGKILL {victims}")
+                _time.sleep(rng.uniform(0.2, 0.6))
+                for v in victims:
+                    pc.restart(v)
+                pc.wait_converged(timeout=60.0)
+                # The restart wiped the phase-1 fault plane state on
+                # every replica; re-arm the low-grade burst so the
+                # join ladder still runs under network faults.
+                send_fault(peers[fvictim], {
+                    "cmd": "drop", "peer": "*",
+                    "p": round(rng.uniform(0.03, 0.1), 3)})
+                _dbg("group quorum restarted + converged")
+
             # Phase 2: JOIN under load, usually with the leader killed
             # while the resize ladder is in flight.  Large-state
             # trials pick a MID-STREAM victim instead: the SENDER
@@ -1163,6 +1209,87 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             wait_member(pc, victim)
             _dbg(f"phase3 evicted+rejoined {victim}")
 
+            # Phase 3.5 (ELASTIC): live SPLIT under load — seeded
+            # victim group, usually with the src-group leader
+            # SIGKILLed right after the freeze record commits (the
+            # driver must move with the leadership and RESUME the
+            # migration), stale-epoch client traffic straddling the
+            # flip (the workers keep their old maps until bounced
+            # WRONG_GROUP), and a seeded MERGE back.
+            if split_merge and groups > 1:
+                from apus_tpu.runtime.elastic import (request_merge,
+                                                      request_split,
+                                                      wait_router_epoch)
+                _wait_groups_converged(pc, cur_groups, timeout=90.0)
+                # DOUBLING ladder under sustained load: split EVERY
+                # static group once (N -> 2N live groups), with ONE
+                # seeded src-leader SIGKILL mid-migration (the driver
+                # must move with the leadership and resume) and the
+                # workers' stale maps straddling every flip.
+                kill_at = rng.randrange(groups) \
+                    if rng.random() < 0.7 else -1
+                pairs = []
+                for step in range(groups):
+                    res = request_split(
+                        [p for i, p in enumerate(pc.spec.peers)
+                         if p and i < len(pc.procs)
+                         and pc.procs[i] is not None],
+                        step, timeout=60.0)
+                    churn["splits"] += 1
+                    cur_groups += 1
+                    pairs.append((step, res["dst"]))
+                    _dbg(f"split g{step} -> g{res['dst']} "
+                         f"(mig {res['mig']})")
+                    mv = None
+                    if step == kill_at:
+                        try:
+                            mv = _group_leader_idx(pc, step,
+                                                   timeout=10.0)
+                            # Only boot slots restart at their
+                            # config-file endpoint; a joiner-held
+                            # slot would come back at a dead address
+                            # (ProcCluster.restart contract).
+                            if mv < 3:
+                                pc.kill(mv)
+                                churn["mig_leader_kills"] += 1
+                                _dbg(f"killed src leader {mv} "
+                                     f"mid-migration")
+                            else:
+                                mv = None
+                        except AssertionError:
+                            mv = None
+                    wait_router_epoch(
+                        [p for i, p in enumerate(pc.spec.peers)
+                         if p and i != mv and i < len(pc.procs)
+                         and pc.procs[i] is not None],
+                        res["epoch"], timeout=120.0)
+                    churn["router_epoch"] = max(
+                        churn["router_epoch"], res["epoch"])
+                    if mv is not None:
+                        wait_evicted(pc, mv, timeout=60.0)
+                        churn["auto_removes"] += 1
+                        pc.restart(mv)
+                        wait_member(pc, mv, timeout=90.0)
+                        _dbg(f"mid-migration victim {mv} rejoined")
+                _dbg(f"doubling ladder done: {groups} -> "
+                     f"{cur_groups} groups")
+                if rng.random() < 0.5:
+                    # Seeded MERGE back of one split-born group.
+                    src, dst = rng.choice([(d, s)
+                                           for s, d in pairs])
+                    res2 = request_merge(
+                        [p for p in pc.spec.peers if p], src, dst,
+                        timeout=60.0)
+                    churn["merges"] += 1
+                    wait_router_epoch(
+                        [p for i, p in enumerate(pc.spec.peers)
+                         if p and i < len(pc.procs)
+                         and pc.procs[i] is not None],
+                        res2["epoch"], timeout=120.0)
+                    churn["router_epoch"] = max(
+                        churn["router_epoch"], res2["epoch"])
+                    _dbg(f"merged g{src} back into g{dst}")
+
             if time_nemesis:
                 # Pause round between churn phases: a lease-holding
                 # member freezes past expiry while the membership
@@ -1177,7 +1304,7 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             # in every group, and a group whose deferred rejoin is
             # still in flight would refuse it on its quorum floor.
             if groups > 1:
-                _wait_groups_converged(pc, groups, timeout=90.0,
+                _wait_groups_converged(pc, cur_groups, timeout=90.0,
                                        same_members=True)
             lead = pc.leader_idx(timeout=15.0)
             lvictim = rng.choice(
@@ -1206,7 +1333,8 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             _dbg("workers joined")
             pc.wait_converged(timeout=60.0)
             view = pc.wait_config_converged(timeout=60.0)
-            gview = (_wait_groups_converged(pc, groups, timeout=90.0)
+            gview = (_wait_groups_converged(pc, cur_groups,
+                                            timeout=90.0)
                      if groups > 1 else None)
             _dbg(f"converged: {view} groups: {gview}")
             # Snapshot-transfer evidence over the wire (resume vs
@@ -1229,8 +1357,12 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
         # Per-group traversal pin: every group must have moved through
         # at least one config epoch (the multi-group join/evict/leave
         # arms bump every group) or a leader change — a group the
-        # churn never touched proves nothing.
+        # churn never touched proves nothing.  Split-born groups (gid
+        # >= the static count) are exempt: they were CREATED mid-trial
+        # and their first term/epoch is the traversal.
         for g, v in gview.items():
+            if int(g) >= groups:
+                continue
             assert v["epoch"] > 0 or v["term"] > 1, \
                 f"group {g} traversed no epoch/leader change: {v}"
         stats["groups"] = groups
@@ -1359,6 +1491,24 @@ def main() -> int:
                          "apus_tpu.obs.timeline (default: "
                          "./obs-fail-<mode>-<seed>).  Violations AND "
                          "wedges dump; repro lines carry the flag")
+    ap.add_argument("--split-merge", action="store_true",
+                    help="with --churn --groups N: arm the ELASTIC "
+                         "split/merge nemesis — a live SPLIT of a "
+                         "seeded victim group under load (usually "
+                         "with the src-group leader SIGKILLed "
+                         "mid-migration; the driver must resume), "
+                         "stale-epoch client traffic straddling the "
+                         "hash-epoch flip, and a seeded MERGE back; "
+                         "composed with --check-linear, a lost write "
+                         "or stale read across the flip is a "
+                         "linearizability violation")
+    ap.add_argument("--group-quorum-kill", action="store_true",
+                    help="with --churn: SIGKILL EVERY daemon "
+                         "simultaneously and restart them — no "
+                         "survivor holds any group's state, so the "
+                         "final read round proves per-group DURABLE "
+                         "recovery (pre-elastic, non-zero groups "
+                         "lost their acked writes here)")
     ap.add_argument("--groups", type=int, default=1,
                     help="with --check-linear/--churn: shard the "
                          "keyspace across N consensus groups "
@@ -1390,7 +1540,9 @@ def main() -> int:
         + (["--time-nemesis"] if args.time_nemesis else []) \
         + (["--state-size", str(args.state_size)]
            if args.state_size else []) \
-        + (["--groups", str(args.groups)] if args.groups > 1 else [])
+        + (["--groups", str(args.groups)] if args.groups > 1 else []) \
+        + (["--split-merge"] if args.split_merge else []) \
+        + (["--group-quorum-kill"] if args.group_quorum_kill else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
@@ -1408,24 +1560,33 @@ def main() -> int:
              "snap_chunks_acked": 0, "delta_snapshots": 0,
              "chunkfile_faults": 0, "obs_events": 0, "pauses": 0,
              "clock_cmds": 0, "undecided_keys": 0,
-             "undecided_retried": 0, "seeds": []}
+             "undecided_retried": 0, "splits": 0, "merges": 0,
+             "mig_leader_kills": 0, "group_quorum_kills": 0,
+             "router_epoch": 0, "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
             if args.churn:
-                st = run_churn_schedule(fault_seed,
-                                        check_linear=args.check_linear,
-                                        state_size=args.state_size,
-                                        dump_obs=args.dump_obs,
-                                        time_nemesis=args.time_nemesis,
-                                        groups=args.groups)
+                st = run_churn_schedule(
+                    fault_seed,
+                    check_linear=args.check_linear,
+                    state_size=args.state_size,
+                    dump_obs=args.dump_obs,
+                    time_nemesis=args.time_nemesis,
+                    groups=args.groups,
+                    split_merge=args.split_merge,
+                    group_quorum_kill=args.group_quorum_kill)
                 for k in ("joins", "auto_removes", "graceful_leaves",
                           "leader_kills", "configs_traversed",
                           "ops_checked", "receiver_kills",
                           "snap_resumes", "snap_chunks_acked",
                           "delta_snapshots", "chunkfile_faults",
                           "obs_events", "pauses", "clock_cmds",
-                          "undecided_keys", "undecided_retried"):
+                          "undecided_keys", "undecided_retried",
+                          "splits", "merges", "mig_leader_kills",
+                          "group_quorum_kills"):
                     churn[k] += st.get(k, 0)
+                churn["router_epoch"] = max(churn["router_epoch"],
+                                            st.get("router_epoch", 0))
                 churn["seeds"].append(fault_seed)
                 r = "ok"
             elif args.check_linear:
@@ -1496,6 +1657,8 @@ def main() -> int:
                    "proc": args.proc,
                    "time_nemesis": args.time_nemesis,
                    "groups": args.groups,
+                   "split_merge": args.split_merge,
+                   "group_quorum_kill": args.group_quorum_kill,
                    # Audit campaign evidence (banked via eval.py): how
                    # much history the checker proved linearizable, and
                    # under which seeds.  violations is structurally 0

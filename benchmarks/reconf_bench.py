@@ -571,6 +571,112 @@ def rejoin_ladder(state_mbs, kill_mid_stream: bool = True) -> list:
     return results
 
 
+# -- hot-shard-relief ladder (elastic-group plane) -------------------------
+
+def hot_shard_split_ladder(writes: int = 600, svc_us: int = 3000,
+                           groups: int = 2) -> dict:
+    """Hot-shard relief: aggregate pipelined SET throughput on a
+    SKEWED keyspace — every hot key hashes into ONE group — measured
+    BEFORE and AFTER a live split of that group, under the per-group
+    write service-capacity gate (APUS_WRITE_SVC_US: each group's
+    leader owns one core; the PR 10 svc-gate methodology, so the
+    1-core box models a deployment instead of measuring GIL
+    timesharing).  Pre-split, every op serializes through the hot
+    group's gate; the live split moves half its buckets to a NEW
+    group, and the SAME workload then runs two concurrent per-group
+    sub-pipelines — the relief the elastic plane exists to buy.
+    The split happens ONLINE with the measuring client's map going
+    stale (it re-learns via WRONG_GROUP bounces, fresh req_ids)."""
+    import dataclasses as _dc
+
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.elastic import (request_split,
+                                          wait_router_epoch)
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+    from apus_tpu.runtime.router import ShardMap, bucket_of_key
+
+    hot_gid = groups - 1
+    base = ShardMap.initial(groups)
+    moved = set(ShardMap.split_buckets(base.owned(hot_gid)))
+    # Hot keys: all in the hot group, HALF in the bucket set a split
+    # will move — post-split they spread evenly over two groups.
+    hot_a, hot_b = [], []
+    i = 0
+    while (len(hot_a) < 32 or len(hot_b) < 32) and i < 65536:
+        k = b"hot%05d" % i
+        i += 1
+        if base.group_of_key(k) != hot_gid:
+            continue
+        (hot_b if bucket_of_key(k) in moved else hot_a).append(k)
+    keys = [k for pair in zip(hot_a[:32], hot_b[:32]) for k in pair]
+    spec = _dc.replace(PROC_SPEC, auto_remove=False, groups=groups)
+    env = {i: {"APUS_WRITE_SVC_US": str(svc_us)} for i in range(3)}
+    val = b"v" * 64
+
+    def phase(c: ApusClient, tag: str) -> float:
+        n = 0
+        t0 = time.perf_counter()
+        while n < writes:
+            # 128-op blocks: after the split each group's sub-pipeline
+            # carries one full 64-op in-flight window, so per-call
+            # overhead is amortized identically in both phases.
+            burst = [(keys[(n + j) % len(keys)],
+                      b"%s%d" % (tag.encode(), n + j))
+                     for j in range(min(128, writes - n))]
+            for rep in c.pipeline_puts(burst):
+                assert rep == b"OK", rep
+            n += len(burst)
+        return writes / (time.perf_counter() - t0)
+
+    with ProcCluster(3, spec=spec, extra_env=env) as pc:
+        peers = list(pc.spec.peers)
+        with ApusClient(peers, timeout=60.0, groups=groups) as c:
+            for k in keys:
+                assert c.put(k, val) == b"OK"
+            pre = phase(c, "pre")
+            res = request_split(peers, hot_gid, timeout=30.0)
+            wait_router_epoch(peers, res["epoch"], timeout=60.0)
+            # Re-learn the map outside the measured window (the
+            # stale-epoch bounce path is the chaos plane's subject;
+            # here we measure steady-state relief).
+            for k in keys:
+                assert c.put(k, val) == b"OK"
+            post = phase(c, "post")
+            st = pc.status(pc.leader_idx()) or {}
+        # Recompile sentinel: summed over the health verdicts (this is
+        # a host-path bench — any recompile would be a bug regardless).
+        recompiles = 0
+        try:
+            from apus_tpu.obs.service import fetch_obs_dump
+            for addr in peers:
+                d = fetch_obs_dump(addr, timeout=1.0) or {}
+                if "dev_recompiles" in (d.get("health") or {}).get(
+                        "flags", []):
+                    recompiles += 1
+        except Exception:                             # noqa: BLE001
+            pass
+    gain = round(post / pre, 2) if pre else 0.0
+    return {
+        "metric": "split_relief_gain", "value": gain, "unit": "x",
+        "detail": {
+            "pre_split_ops_per_sec": round(pre, 1),
+            "post_split_ops_per_sec": round(post, 1),
+            "writes_per_phase": writes,
+            "hot_keys": len(keys),
+            "emulated_write_svc_ms": svc_us / 1000.0,
+            "groups_before": groups,
+            "groups_after": st.get("n_groups"),
+            "router_epoch": st.get("router_epoch"),
+            "migrations": st.get("migrations"),
+            "recompile_sentinel": recompiles,
+            "note": "skewed keyspace: every hot key in one group; "
+                    "live split under the per-group write-svc gate; "
+                    "client re-learns the map via WRONG_GROUP "
+                    "bounces mid-run",
+        },
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=3)
@@ -598,6 +704,14 @@ def main() -> int:
     ap.add_argument("--no-midstream-kill", action="store_true",
                     help="with --ladder: skip the mid-stream receiver "
                          "kill resume check")
+    ap.add_argument("--split", action="store_true",
+                    help="hot-shard-relief ladder (elastic groups): "
+                         "pre-split vs post-split aggregate pipelined "
+                         "SET throughput on a skewed keyspace under "
+                         "the per-group write-svc gate; the hot group "
+                         "is split LIVE mid-run")
+    ap.add_argument("--split-writes", type=int, default=600)
+    ap.add_argument("--split-svc-us", type=int, default=3000)
     ap.add_argument("--reconf", action="store_true",
                     help="with --proc: run the reconfiguration "
                          "scenarios (Upsize: grow a FULL group's size "
@@ -607,6 +721,19 @@ def main() -> int:
                          "admission/catch-up rows "
                          "(reconf_bench.sh:147-180)")
     args = ap.parse_args()
+
+    if args.split:
+        r = hot_shard_split_ladder(writes=args.split_writes,
+                                   svc_us=args.split_svc_us)
+        d = r["detail"]
+        print(f"hot-shard relief: pre {d['pre_split_ops_per_sec']} "
+              f"-> post {d['post_split_ops_per_sec']} ops/s "
+              f"({r['value']}x) under "
+              f"{d['emulated_write_svc_ms']} ms/op/group gate; "
+              f"router epoch {d['router_epoch']}, recompile sentinel "
+              f"{d['recompile_sentinel']}")
+        print(json.dumps(r))
+        return 0
 
     if args.ladder:
         sizes = [int(x) for x in args.state_mb.split(",") if x]

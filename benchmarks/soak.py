@@ -167,6 +167,12 @@ def main() -> int:
                          "--audit — the side stream records into the "
                          "same history, so the final linearizability "
                          "verdict covers every follower-served read")
+    ap.add_argument("--groups", type=int, default=1,
+                    help="with --kv: shard the daemons (and route the "
+                         "soak's SET/GET stream) across N consensus "
+                         "groups — the elastic/multi-group deployment "
+                         "shape; failure dumps then carry each "
+                         "replica's per-group view")
     ap.add_argument("--audit", action="store_true",
                     help="record every SET/GET of the soak stream as a "
                          "timed history (apus_tpu.audit.HistoryRecorder"
@@ -190,7 +196,8 @@ def main() -> int:
         from apus_tpu.runtime.client import ApusClient
         app_argv = None
         mk = lambda addr: ApusClient(  # noqa: E731
-            ["%s:%d" % addr], timeout=15.0)
+            ["%s:%d" % addr], timeout=15.0,
+            groups=max(1, args.groups))
         do_set = lambda c, k, v: (  # noqa: E731
             c.put(k.encode(), v.encode()) == b"OK")
         do_get = lambda c, k: (  # noqa: E731
@@ -281,6 +288,15 @@ def main() -> int:
     # ProcCluster.graceful_leave) with a failure-detector eviction
     # (SIGKILL + wait for removal), each followed by a fresh join into
     # the freed slot.  Seeded by --fault-seed when given.
+    if args.groups > 1:
+        if not args.kv:
+            print("--groups needs --kv (the bridged app path is "
+                  "single-group)", file=sys.stderr)
+            return 2
+        import dataclasses as _dc
+        from apus_tpu.runtime.proc import PROC_SPEC
+        base = mesh_spec if mesh_spec is not None else PROC_SPEC
+        mesh_spec = _dc.replace(base, groups=args.groups)
     churn_rng = _random.Random((args.fault_seed or 0) ^ 0xC4)
     next_churn = (time.monotonic() + args.churn_every
                   if args.churn else float("inf"))
@@ -323,7 +339,8 @@ def main() -> int:
             from apus_tpu.runtime.client import ApusClient
             val = bytes(32768)
             nkeys = max(1, args.state_size // len(val))
-            with ApusClient(list(pc.spec.peers), timeout=120.0) as sc:
+            with ApusClient(list(pc.spec.peers), timeout=120.0,
+                            groups=max(1, args.groups)) as sc:
                 for lo in range(0, nkeys, 16):
                     sc.pipeline_puts(
                         [(b"bulk%06d" % i, val)
@@ -729,9 +746,20 @@ def main() -> int:
         # ships every replica's flight/span rings with the verdict.
         obs_dumps: list = []
         try:
-            from apus_tpu.obs.service import collect_cluster_dumps
-            obs_dumps = collect_cluster_dumps(
-                [p for p in pc.spec.peers if p], timeout=2.0)
+            from apus_tpu.obs.service import fetch_obs_dump
+            from apus_tpu.runtime.client import probe_status
+            for addr in [p for p in pc.spec.peers if p]:
+                d = fetch_obs_dump(addr, timeout=2.0)
+                if d is None:
+                    continue
+                if args.groups > 1:
+                    # Per-group context rides the failure dump
+                    # (elastic-group plane), as in fuzz._collect_obs.
+                    st = probe_status(addr, timeout=1.0) or {}
+                    d["groups_view"] = st.get("groups")
+                    d["router_epoch"] = st.get("router_epoch")
+                    d["migrations"] = st.get("migrations")
+                obs_dumps.append(d)
         except Exception:                        # noqa: BLE001
             pass
 
@@ -854,7 +882,10 @@ def main() -> int:
               + (f" --churn --churn-every {args.churn_every}"
                  if args.churn else "")
               + (f" --state-size {args.state_size}"
-                 if args.state_size else ""),
+                 if args.state_size else "")
+              + (" --kv" if args.kv and not args.read_local else "")
+              + (f" --groups {args.groups}" if args.groups > 1
+                 else ""),
               file=sys.stderr)
     return 0 if ok else 1
 

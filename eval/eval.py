@@ -162,6 +162,24 @@ def _run_churn(out, trials: int = 5, state_size: int = 0) -> None:
                 else "churn_campaign")
 
 
+def _run_elastic(out, trials: int = 5) -> None:
+    """Elastic-group chaos campaign (fuzz.py --churn --check-linear
+    --groups 4 --split-merge --group-quorum-kill): 4 -> 8 live
+    doubling under churn + faults with a seeded src-leader SIGKILL
+    mid-migration, stale-epoch clients straddling every flip, and a
+    whole-quorum SIGKILL + restart durability arm, every trial's
+    history checked linearizable.  Banks the campaign as one record."""
+    print(f"fuzz.py --churn --check-linear --groups 4 --split-merge "
+          f"--group-quorum-kill: elastic campaign ({trials} trials)")
+    argv = [sys.executable,
+            os.path.join(REPO, "benchmarks", "fuzz.py"),
+            "--churn", "--check-linear", "--groups", "4",
+            "--split-merge", "--group-quorum-kill",
+            "--trials", str(trials), "--seed-base", "27100"]
+    for rec in _run_tool(argv, timeout=600 * trials):
+        _record(out, rec, replicas=3, bench="elastic_campaign")
+
+
 def _run_breakdown(out) -> None:
     """Per-stage latency decomposition of the pipelined PUT path
     (bench.py --breakdown): exact stitched stage p50/p99 from the span
@@ -175,6 +193,20 @@ def _run_breakdown(out) -> None:
         _record(out, rec,
                 replicas=rec.get("detail", {}).get("replicas", 3),
                 bench="bench_breakdown")
+
+
+def _run_split(out) -> None:
+    """Hot-shard-relief ladder (elastic groups): pre-split vs
+    post-split aggregate throughput on a skewed keyspace with a LIVE
+    split mid-run, under the per-group write-svc gate
+    (reconf_bench.py --split)."""
+    print("reconf_bench --split: hot-shard-relief ladder (live split)")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "benchmarks",
+                                       "reconf_bench.py"),
+                          "--split"],
+                         timeout=600):
+        _record(out, rec, replicas=3, bench="split_relief")
 
 
 def _run_ladder(out, state_mb: str = "10,100") -> None:
@@ -230,6 +262,17 @@ def cmd_run(args) -> int:
             # Large-state rejoin ladder only: skip the cluster suite.
             _run_ladder(out, state_mb=getattr(args, "ladder_mb",
                                               "10,100"))
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "split_only", False):
+            # Elastic hot-shard-relief ladder only: skip the suite.
+            _run_split(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "elastic_only", False):
+            # Elastic chaos campaign only: skip the cluster suite.
+            _run_elastic(out, trials=getattr(args, "elastic_trials",
+                                             5))
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -711,6 +754,21 @@ def cmd_report(args) -> int:
             f"(mean {ev.get('mean_groups_per_dispatch')}/dispatch, "
             f"p50 multi-group: {ev.get('p50_multi_group')}), "
             f"recompile sentinel {ev.get('recompile_sentinel')}")
+    spl = [r for r in runs if r.get("metric") == "split_relief_gain"
+           and isinstance(r.get("value"), (int, float))]
+    if spl:
+        last = spl[-1]
+        d = last.get("detail", {})
+        lines.append(
+            f"- ELASTIC hot-shard relief (live split under load): "
+            f"aggregate SET {_fmt(d.get('pre_split_ops_per_sec'))} -> "
+            f"{_fmt(d.get('post_split_ops_per_sec'))} ops/sec = "
+            f"{last['value']}x post/pre on the skewed keyspace under "
+            f"the per-group write-svc gate "
+            f"({d.get('emulated_write_svc_ms')} ms/op/group); "
+            f"router epoch {d.get('router_epoch')}, "
+            f"{d.get('groups_before')} -> {d.get('groups_after')} "
+            f"groups, recompile sentinel {d.get('recompile_sentinel')}")
     aud = [r for r in runs if r.get("metric") == "linear_audit_clean_pct"
            and isinstance(r.get("value"), (int, float))]
     if aud:
@@ -748,6 +806,14 @@ def cmd_report(args) -> int:
                f"{c.get('snap_resumes')} stream resumes, "
                f"{c.get('delta_snapshots')} delta snapshots"
                if c.get("state_size") else "")
+            + (f"; elastic: {c.get('splits')} live splits / "
+               f"{c.get('merges', 0)} merges / "
+               f"{c.get('mig_leader_kills', 0)} leader kills "
+               f"mid-migration / {c.get('group_quorum_kills', 0)} "
+               f"whole-quorum kill+restarts (router epoch "
+               f"{c.get('router_epoch', 0)})"
+               if c.get("splits") or c.get("group_quorum_kills")
+               else "")
             + f"; seeds {c.get('seeds')}")
     brk = [r for r in runs
            if r.get("metric") == "pipelined_put_stage_breakdown"
@@ -1010,6 +1076,19 @@ def main() -> int:
                        help="with --churn-only: pre-populate this many "
                             "BYTES of state per trial and arm the "
                             "mid-stream nemesis (fuzz --state-size)")
+        p.add_argument("--elastic-only", action="store_true",
+                       help="run ONLY the elastic chaos campaign "
+                            "(4->8 live doubling under churn, "
+                            "leader-kill mid-migration, whole-quorum "
+                            "kill+restart, linearizability-checked) "
+                            "and bank the row")
+        p.add_argument("--elastic-trials", type=int, default=5,
+                       help="trial count for --elastic-only")
+        p.add_argument("--split-only", action="store_true",
+                       help="run ONLY the elastic hot-shard-relief "
+                            "ladder (reconf_bench --split: pre- vs "
+                            "post-live-split throughput on a skewed "
+                            "keyspace) and bank the row")
         p.add_argument("--ladder-only", action="store_true",
                        help="run ONLY the large-state rejoin ladder "
                             "(reconf_bench.py --ladder; skips the "

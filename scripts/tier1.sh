@@ -71,5 +71,16 @@ if [ "$smoke" -eq 1 ]; then
         echo "multi-group smoke FAILED (rc=$mrc)" >&2
         exit "$mrc"
     fi
+    echo "== elastic smoke (live split ladder under light load +"
+    echo "   whole-group quorum SIGKILL/restart durable recovery,"
+    echo "   linearizability-checked; 1 churn trial) =="
+    env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \
+        --churn --check-linear --groups 2 --split-merge \
+        --group-quorum-kill --trials 1 --seed-base 9480
+    erc=$?
+    if [ "$erc" -ne 0 ]; then
+        echo "elastic smoke FAILED (rc=$erc)" >&2
+        exit "$erc"
+    fi
 fi
 echo "tier1.sh: all green"

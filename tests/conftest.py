@@ -64,6 +64,11 @@ def pytest_configure(config):
         "selectable with -m largestate")
     config.addinivalue_line(
         "markers",
+        "elastic: elastic-group suite — per-group durability, shard "
+        "map, online split/merge, migration fences; selectable with "
+        "-m elastic")
+    config.addinivalue_line(
+        "markers",
         "flr: follower-read-lease suite — linearizable local reads at "
         "followers, lease grant/invalidation rules, the adversarial-"
         "time nemesis (pause/skew), and the planted-stale-lease "

@@ -1,0 +1,346 @@
+"""Elastic-group plane: per-group durability + online split/merge.
+
+- ShardMap unit contract: initial-assignment identity with the pinned
+  hash, move/epoch semantics, wire-blob roundtrip.
+- KVS migration SM unit drive: freeze/install/commit determinism,
+  refused-write sentinels, idempotent installs, bucket-return fence
+  clearing (split then merge back), snapshot survival of migration
+  state.
+- Live ProcCluster e2e: split under load with STALE-epoch clients
+  (WRONG_GROUP reroute, fresh req_ids, exactly-once), merge back,
+  leader-kill-mid-migration resume.
+- The acceptance pin: whole-group quorum SIGKILL + restart recovers
+  EVERY group's acked writes from its per-gid durable store (before
+  this plane, non-zero groups lost theirs here).
+- The PR 10 deferred background join-retry thread, covered
+  deterministically: a joiner whose extra-group admission misses boot
+  (group mid-election) is admitted to every group by the retry
+  thread — no silent partial membership.
+"""
+
+from __future__ import annotations
+
+import dataclasses as dc
+import tempfile
+import time
+
+import pytest
+
+from apus_tpu.models.kvs import (MIG_STATE_KEY, REFUSED_DEPARTED,
+                                 REFUSED_FROZEN, KvsStateMachine,
+                                 encode_get, encode_mig_begin,
+                                 encode_mig_commit, encode_mig_install,
+                                 encode_put)
+from apus_tpu.runtime.router import (NBUCKETS, ShardMap, bucket_of_key,
+                                     group_of_key)
+
+pytestmark = pytest.mark.elastic
+
+
+# -- unit: shard map -------------------------------------------------------
+
+def test_shard_map_initial_matches_pinned_hash():
+    # 840 = lcm(1..8): a never-migrated cluster routes byte-identically
+    # to the pinned group_of_key hash at every genesis group count.
+    for n in range(1, 9):
+        m = ShardMap.initial(n)
+        for i in range(512):
+            k = b"smk%d" % i
+            assert m.group_of_key(k) == group_of_key(k, n), (n, k)
+
+
+def test_shard_map_move_epoch_and_blob_roundtrip():
+    m = ShardMap.initial(2)
+    owned = m.owned(1)
+    half = ShardMap.split_buckets(owned)
+    assert 0 < len(half) < len(owned)
+    m2 = m.move(half, 2, epoch=1)
+    assert m2.epoch == 1 and m2.n_groups == 3
+    assert set(m2.owned(2)) == set(half)
+    assert set(m2.owned(1)) == set(owned) - set(half)
+    m3 = ShardMap.from_blob(m2.to_blob())
+    assert m3.epoch == m2.epoch and m3.assign == m2.assign
+    assert len(m2.assign) == NBUCKETS
+
+
+# -- unit: KVS migration state machine -------------------------------------
+
+def _populate(sm: KvsStateMachine, n: int = 60, prefix=b"uk"):
+    keys = [b"%s%d" % (prefix, i) for i in range(n)]
+    for i, k in enumerate(keys):
+        sm.apply(i + 1, encode_put(k, b"v%d" % i))
+    return keys
+
+
+def test_kvs_migration_freeze_install_commit():
+    src, dst = KvsStateMachine(), KvsStateMachine()
+    keys = _populate(src)
+    buckets = ShardMap.split_buckets(ShardMap.initial(1).owned(0))
+    bset = set(buckets)
+    moved = [k for k in keys if bucket_of_key(k) in bset]
+    assert moved
+    assert src.apply(100, encode_mig_begin(7, 1, 1, buckets)) == b"OK"
+    # Frozen bucket: decided writes deterministically no-op with the
+    # REFUSED sentinel (never an OK, never a state change).
+    before = dict(src.store)
+    assert src.apply(101, encode_put(moved[0], b"X")) == REFUSED_FROZEN
+    assert src.store[moved[0]] == before[moved[0]]
+    # Capture is stable under the freeze; install is idempotent.
+    pairs = [(k, v) for k, v in src.store.items()
+             if not k.startswith(b"\x00apus.")
+             and bucket_of_key(k) in bset]
+    assert dst.apply(1, encode_mig_install(7, 0, 1, buckets,
+                                           pairs)) == b"OK"
+    assert dst.apply(2, encode_mig_install(7, 0, 1, buckets,
+                                           [])) == b"OK"  # dup: no-op
+    for k in moved:
+        assert dst.store[k] == before[k]
+    assert src.apply(102, encode_mig_commit(7)) == b"OK"
+    for k in moved:
+        assert k not in src.store
+    assert src.apply(103, encode_put(moved[0], b"X")) == REFUSED_DEPARTED
+    # Unmoved buckets keep serving normally.
+    kept = [k for k in keys if bucket_of_key(k) not in bset]
+    assert src.apply(104, encode_put(kept[0], b"Y")) == b"OK"
+
+
+def test_kvs_migration_state_survives_snapshot():
+    src = KvsStateMachine()
+    _populate(src)
+    buckets = ShardMap.split_buckets(ShardMap.initial(1).owned(0))
+    src.apply(100, encode_mig_begin(9, 1, 2, buckets))
+    src.apply(101, encode_mig_commit(9))
+    assert MIG_STATE_KEY in src.store
+    snap = src.create_snapshot(101, 1)
+    fresh = KvsStateMachine()
+    fresh.apply_snapshot(snap)
+    # A snapshot-primed replica never applies the covered M entries —
+    # the fences must rebuild from the reserved key.
+    moved = next(k for k in src.store
+                 if not k.startswith(b"\x00apus.")
+                 and bucket_of_key(k) in set(buckets)) \
+        if any(bucket_of_key(k) in set(buckets) for k in src.store
+               if not k.startswith(b"\x00apus.")) else b"uk0"
+    probe = next(b"uk%d" % i for i in range(200)
+                 if bucket_of_key(b"uk%d" % i) in set(buckets))
+    assert fresh.apply(102, encode_put(probe, b"X")) == REFUSED_DEPARTED
+    assert fresh.migs_out["9"][2] == "committed"
+
+
+def test_kvs_bucket_return_clears_fence():
+    """Split g1 -> g2, then merge the buckets BACK: the old outbound
+    fence must clear (event-epoch rule), or writes to returned buckets
+    would refuse forever — the live bug the first merge smoke caught."""
+    g1, g2 = KvsStateMachine(), KvsStateMachine()
+    keys = _populate(g1)
+    buckets = ShardMap.split_buckets(ShardMap.initial(1).owned(0))
+    bset = set(buckets)
+    moved = [k for k in keys if bucket_of_key(k) in bset]
+    pairs = [(k, g1.store[k]) for k in moved]
+    g1.apply(100, encode_mig_begin(0x101, 2, 1, buckets))
+    g2.apply(1, encode_mig_install(0x101, 1, 1, buckets, pairs))
+    g1.apply(101, encode_mig_commit(0x101))
+    assert g1.apply(102, encode_put(moved[0], b"X")) == REFUSED_DEPARTED
+    # merge back: g2 -> g1 at epoch 2
+    pairs2 = [(k, g2.store[k]) for k in moved]
+    g2.apply(2, encode_mig_begin(0x202, 1, 2, buckets))
+    g1.apply(103, encode_mig_install(0x202, 2, 2, buckets, pairs2))
+    g2.apply(3, encode_mig_commit(0x202))
+    # The returned bucket serves at g1 again...
+    assert g1.apply(104, encode_put(moved[0], b"back")) == b"OK"
+    assert g1.store[moved[0]] == b"back"
+    # ...and is departed at g2.
+    assert g2.apply(4, encode_put(moved[0], b"z")) == REFUSED_DEPARTED
+
+
+# -- live e2e --------------------------------------------------------------
+
+def _proc_spec(groups: int):
+    from apus_tpu.runtime.proc import PROC_SPEC
+    return dc.replace(PROC_SPEC, auto_remove=False, groups=groups)
+
+
+def _group_leader_idx(pc, gid: int, timeout: float = 20.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for i in range(len(pc.procs)):
+            if pc.procs[i] is None:
+                continue
+            st = pc.status(i, timeout=0.5) or {}
+            gv = (st.get("groups") or {}).get(str(gid))
+            if gv and gv.get("is_leader"):
+                return i
+        time.sleep(0.05)
+    raise AssertionError(f"no leader for group {gid}")
+
+
+@pytest.mark.elastic
+def test_live_split_merge_stale_clients_and_leader_kill():
+    """One live ladder: split under a stale-map client, src-leader
+    SIGKILL mid-migration (driver resumes on the new leader), merge
+    back — every acked write readable throughout, exactly-once held
+    (distinct per-op values; a re-executed write would surface as a
+    wrong read)."""
+    from apus_tpu.runtime import elastic as EL
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with tempfile.TemporaryDirectory(prefix="apus-t-el1") as td:
+        with ProcCluster(3, workdir=td, spec=_proc_spec(2)) as pc:
+            peers = list(pc.spec.peers)
+            acked = {}
+            with ApusClient(peers, timeout=12.0, groups=2) as c:
+                for i in range(60):
+                    k, v = b"lk%d" % i, b"lv%d" % i
+                    assert c.put(k, v) == b"OK"
+                    acked[k] = v
+                res = EL.request_split(peers, 1, timeout=30.0)
+                victim = _group_leader_idx(pc, 1)
+                pc.kill(victim)
+                EL.wait_router_epoch(
+                    [p for i, p in enumerate(peers) if i != victim],
+                    res["epoch"], timeout=90.0)
+                pc.restart(victim)
+                pc.wait_converged(timeout=60.0)
+                # Stale-map client (this one) re-learns via
+                # WRONG_GROUP; every acked write reads back.
+                for k, v in acked.items():
+                    assert c.get(k) == v, k
+                # Writes across the flip stay exactly-once.
+                for i in range(60):
+                    assert c.put(b"lk%d" % i, b"l2%d" % i) == b"OK"
+                res2 = EL.request_merge(peers, res["dst"], 1,
+                                        timeout=30.0)
+                EL.wait_router_epoch(peers, res2["epoch"],
+                                     timeout=60.0)
+                for i in range(60):
+                    assert c.get(b"lk%d" % i) == b"l2%d" % i, i
+                st = pc.status(pc.leader_idx())
+                assert st["router_epoch"] == res2["epoch"]
+                assert st["groups"][str(res["dst"])]["owned_buckets"] \
+                    == 0
+            # A COLD client (no map, static hash) also reroutes.
+            with ApusClient(peers, timeout=12.0, groups=2) as c2:
+                for i in range(60):
+                    assert c2.get(b"lk%d" % i) == b"l2%d" % i, i
+
+
+@pytest.mark.elastic
+def test_group_quorum_kill_recovers_every_group():
+    """THE durability acceptance pin: SIGKILL every daemon at once (no
+    survivor holds any group's state), restart, and every acked write
+    of EVERY group — including a split-born one — reads back from the
+    per-gid durable stores.  Pre-elastic, non-zero groups lost their
+    acked writes here (ROADMAP known limitation, now a passing
+    test)."""
+    from apus_tpu.runtime import elastic as EL
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with tempfile.TemporaryDirectory(prefix="apus-t-el2") as td:
+        with ProcCluster(3, workdir=td, spec=_proc_spec(2)) as pc:
+            peers = list(pc.spec.peers)
+            acked = {}
+            with ApusClient(peers, timeout=12.0, groups=2) as c:
+                for i in range(50):
+                    k, v = b"qk%d" % i, b"qv%d" % i
+                    assert c.put(k, v) == b"OK"
+                    acked[k] = v
+                res = EL.request_split(peers, 1, timeout=30.0)
+                EL.wait_router_epoch(peers, res["epoch"], timeout=60.0)
+                for i in range(50, 80):
+                    k, v = b"qk%d" % i, b"qv%d" % i
+                    assert c.put(k, v) == b"OK"
+                    acked[k] = v
+            for i in range(3):
+                pc.kill(i)
+            time.sleep(0.3)
+            for i in range(3):
+                pc.restart(i)
+            pc.wait_converged(timeout=60.0)
+            st = pc.status(pc.leader_idx())
+            # The split survived the full restart (store files
+            # re-created the dynamic group; replayed migration records
+            # rebuilt the map).
+            assert st["n_groups"] == 3
+            assert st["router_epoch"] == res["epoch"]
+            lost = []
+            with ApusClient(peers, timeout=15.0, groups=2) as c:
+                for k, v in acked.items():
+                    if c.get(k) != v:
+                        lost.append(k)
+            assert not lost, f"acked writes lost: {lost[:5]}"
+            # Per-group durability view over the wire.
+            for gid, gv in st["groups"].items():
+                assert "records_since_base" in gv, gid
+
+
+# -- deferred group-join retry thread (PR 10 satellite coverage) -----------
+
+@pytest.mark.churn
+def test_deferred_group_join_retry_thread_admits_all_groups():
+    """A joiner whose extra-group admission missed boot (the group was
+    mid-election) starts with PARTIAL membership; the background retry
+    thread (ReplicaDaemon.retry_group_joins) must finish the admission
+    once the group elects — no silent partial membership."""
+    from apus_tpu.parallel.net import PeerServer
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.daemon import ReplicaDaemon
+    from apus_tpu.runtime.membership import request_join
+
+    with LocalCluster(3, groups=2) as c:
+        c.wait_for_leader()
+
+        def g1_members() -> set:
+            out = set()
+            for d in c.live():
+                n = d.group_node(1)
+                if n is not None and n.is_leader:
+                    out = {i for i in
+                           range(n.cid.extended_group_size)
+                           if n.cid.contains(i)}
+            return out
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not g1_members():
+            time.sleep(0.05)
+        assert g1_members() == {0, 1, 2}
+
+        sock = PeerServer.reserve()
+        host, port = sock.getsockname()
+        my_addr = f"{host}:{port}"
+        slot, cid, _peers = request_join(
+            [p for p in c.spec.peers if p], my_addr, timeout=10.0)
+        while len(c.spec.peers) <= slot:
+            c.spec.peers.append("")
+        c.spec.peers[slot] = my_addr
+        # Boot WITHOUT the group-1 admission (the timed-out-join
+        # shape: group_cids empty) — partial membership on purpose.
+        d = ReplicaDaemon(slot, c.spec, cid=cid, listen_sock=sock,
+                          recovery_start=True)
+        d.start()
+        try:
+            time.sleep(0.3)
+            assert slot not in g1_members(), \
+                "test setup: joiner must start outside group 1"
+            # Make group 1 MID-ELECTION for the retry's first
+            # attempts: kill its leader; the survivors re-elect.
+            g1_leader = next(
+                i for i, dd in enumerate(c.daemons)
+                if dd is not None and dd.group_node(1) is not None
+                and dd.group_node(1).is_leader)
+            c.kill(g1_leader)
+            d.retry_group_joins(my_addr, [1])
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if slot in g1_members():
+                    break
+                time.sleep(0.1)
+            assert slot in g1_members(), \
+                "retry thread never finished the group-1 admission"
+            # The joiner's own group-1 node adopted the admission
+            # incarnation (its ctrl writes clear the fences).
+            gn = d.group_node(1)
+            assert gn is not None and gn.incarnation > 0
+        finally:
+            d.stop()
