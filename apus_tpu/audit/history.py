@@ -42,23 +42,35 @@ OP_CLT_WRITE = 16
 OP_CLT_READ = 17
 
 
+#: ops whose OBSERVED REPLY constrains the search (read-modify-write:
+#: the reply pins the pre-state) — stored in the event's "ret" field
+RMW_OPS = ("incr", "getset", "sadd", "srem")
+#: read ops — observed value stored in "value", like "get"
+READ_OPS = ("get", "smembers")
+
+_TAG_OPS = {b"P": "put", b"G": "get", b"D": "delete", b"C": "incr",
+            b"X": "getset", b"SA": "sadd", b"SR": "srem",
+            b"SM": "smembers"}
+
+
 def decode_kvs(data: bytes) -> Optional[tuple[str, bytes, bytes]]:
     """Decode a KVS wire command (models.kvs) into ``(op, key, value)``
-    with op in {"put", "get", "delete"}; None for non-KVS payloads."""
+    with op in {"put", "get", "delete"} or a typed RDT op; None for
+    non-KVS payloads."""
     try:
-        tag = data[:1]
-        klen_s, rest = data[1:].split(b":", 1)
+        tag = data[:2] if data[:1] == b"S" else data[:1]
+        op = _TAG_OPS.get(tag)
+        if op is None:
+            return None
+        hdr = len(tag)
+        klen_s, rest = data[hdr:].split(b":", 1)
         klen = int(klen_s)
         key, payload = rest[:klen], rest[klen:]
     except (ValueError, IndexError):
         return None
-    if tag == b"P":
-        return "put", key, payload
-    if tag == b"G" and not payload:
-        return "get", key, b""
-    if tag == b"D" and not payload:
-        return "delete", key, b""
-    return None
+    if op in ("get", "delete", "smembers") and payload:
+        return None
+    return op, key, payload
 
 
 class HistoryRecorder:
@@ -94,10 +106,51 @@ class HistoryRecorder:
         """Direct capture for app-level harnesses (e.g. the soak's
         SET/GET stream, which never speaks the KVS wire format)."""
         ev = {"clt": clt_id, "req": req_id, "op": op,
-              "key": key, "value": value if op != "get" else None,
+              "key": key, "value": value if op not in READ_OPS
+              else None,
               "status": "ambiguous", "t0": self.clock(), "t1": None}
         with self._lock:
             self._open[(clt_id, req_id)] = ev
+
+    def invoke_txn(self, clt_id: int, req_id: int,
+                   cmds: "list[bytes]") -> None:
+        """Record an atomic multi-key transaction invocation: ONE
+        event whose ``subs`` are the decoded sub-ops (applied — or
+        not — as ONE atomic multi-sub-op action; the strict-
+        serializability checker treats it so).  Internal fresh-req_id
+        retries after deterministic aborts stay inside this one
+        interval — aborted attempts never applied anywhere."""
+        subs = []
+        for c in cmds:
+            kv = decode_kvs(c)
+            if kv is None:
+                subs.append({"op": "other", "key": b"", "value": b""})
+            else:
+                subs.append({"op": kv[0], "key": kv[1],
+                             "value": kv[2]})
+        ev = {"clt": clt_id, "req": req_id, "op": "txn", "key": b"",
+              "value": None, "subs": subs, "rets": None,
+              "status": "ambiguous", "t0": self.clock(), "t1": None}
+        with self._lock:
+            self._open[(clt_id, req_id)] = ev
+
+    def complete_txn(self, clt_id: int, req_id: int, status: str,
+                     rets: "Optional[list]" = None) -> None:
+        """Close an open transaction; ``rets`` is the per-sub reply
+        list on "ok" (the reads' observed values constrain the
+        checker)."""
+        t1 = self.clock()
+        with self._lock:
+            ev = self._open.pop((clt_id, req_id), None)
+            if ev is None:
+                return
+            ev["status"] = status
+            ev["t1"] = t1
+            if status == "ok" and rets is not None:
+                ev["rets"] = list(rets)
+            if len(self._done) == self._done.maxlen:
+                self.dropped += 1
+            self._done.append(ev)
 
     def complete(self, clt_id: int, req_id: int, status: str,
                  reply: Optional[bytes] = None) -> None:
@@ -111,8 +164,11 @@ class HistoryRecorder:
                 return
             ev["status"] = status
             ev["t1"] = t1
-            if ev["op"] == "get" and status == "ok":
-                ev["value"] = reply if reply is not None else b""
+            if status == "ok":
+                if ev["op"] in READ_OPS:
+                    ev["value"] = reply if reply is not None else b""
+                elif ev["op"] in RMW_OPS:
+                    ev["ret"] = reply if reply is not None else b""
             if len(self._done) == self._done.maxlen:
                 self.dropped += 1
             self._done.append(ev)
@@ -153,6 +209,15 @@ def encode_event(e: dict) -> dict:
     out["key"] = e["key"].decode("latin-1")
     out["value"] = None if e["value"] is None \
         else e["value"].decode("latin-1")
+    if e.get("ret") is not None:
+        out["ret"] = e["ret"].decode("latin-1")
+    if e.get("subs") is not None:
+        out["subs"] = [{"op": s["op"],
+                        "key": s["key"].decode("latin-1"),
+                        "value": s["value"].decode("latin-1")}
+                       for s in e["subs"]]
+    if e.get("rets") is not None:
+        out["rets"] = [r.decode("latin-1") for r in e["rets"]]
     return out
 
 
@@ -161,4 +226,13 @@ def decode_event(e: dict) -> dict:
     out["key"] = e["key"].encode("latin-1")
     out["value"] = None if e.get("value") is None \
         else e["value"].encode("latin-1")
+    if e.get("ret") is not None:
+        out["ret"] = e["ret"].encode("latin-1")
+    if e.get("subs") is not None:
+        out["subs"] = [{"op": s["op"],
+                        "key": s["key"].encode("latin-1"),
+                        "value": s["value"].encode("latin-1")}
+                       for s in e["subs"]]
+    if e.get("rets") is not None:
+        out["rets"] = [r.encode("latin-1") for r in e["rets"]]
     return out

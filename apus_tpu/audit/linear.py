@@ -1,4 +1,4 @@
-"""Linearizability checker for captured KVS histories.
+"""Strict-serializability checker for captured KVS histories.
 
 Algorithm: Wing & Gong's linearization search with the two standard
 refinements Porcupine popularized —
@@ -12,6 +12,25 @@ refinements Porcupine popularized —
   register value); revisiting an equivalent node via a different
   linearization order is pruned.  The done-set is a bitmask, so the
   memo key is an (int, bytes) pair.
+
+**Transactions + typed ops (PR 12)**: multi-key transactions break
+per-KEY partitioning — a txn is one atomic action on several keys at
+once — but locality still applies at the granularity of the objects
+the operations actually touch.  The checker therefore partitions keys
+into CONNECTED COMPONENTS under "co-occur in some transaction": each
+component is one composite object, checked by a generalized search
+whose state is the component's key->value map and whose events are
+atomic multi-sub-op actions (a single-key op is a 1-sub event; a
+transaction is an N-sub event whose reads observe earlier same-txn
+writes).  Strict serializability of the whole history = linearizability
+of each component's sub-history (Herlihy & Wing, with components as
+the objects) — and keys in NO transaction with only register ops keep
+riding the original per-key fast path.  Typed replicated-data-type
+ops (INCR/GETSET/SADD/SREM/SMEMBERS) are modeled by the SAME
+``models.kvs.eval_subop`` the state machine executes, so the model
+and the implementation cannot drift; a read-modify-write's observed
+reply pins its pre-state (two INCRs both returning 1 is a lost
+update — no valid order exists — and is REJECTED).
 
 Ambiguity (Knossos/Porcupine "info" ops): an op whose ack was lost —
 client timeout, crash mid-op, server error on a write — MAY have been
@@ -68,12 +87,24 @@ class Violation:
                  + (" (any initial value)" if self.unknown_init else "")]
         for e in self.window:
             t1 = e.get("t1")
+            if e["op"] == "txn":
+                subs = ", ".join(
+                    f"{s['op']}({s['key']!r}"
+                    + (f", {s['value']!r}" if s.get("value") else "")
+                    + ")" for s in (e.get("subs") or []))
+                body = f"txn[{subs}]" + (
+                    f" rets={e['rets']!r}" if e.get("rets") is not None
+                    else "")
+            else:
+                body = (f"{e['op']}({e['key']!r}"
+                        + (f", {e['value']!r}"
+                           if e.get("value") is not None else "")
+                        + ")"
+                        + (f" ret={e['ret']!r}"
+                           if e.get("ret") is not None else ""))
             lines.append(
-                f"  clt={e['clt']} req={e['req']} {e['op']}"
-                f"({e['key']!r}"
-                + (f", {e['value']!r}" if e.get("value") is not None
-                   else "")
-                + f") status={e['status']} "
+                f"  clt={e['clt']} req={e['req']} {body} "
+                f"status={e['status']} "
                 f"[{e['t0']:.6f}, {'inf' if t1 is None else f'{t1:.6f}'}]")
         return "\n".join(lines)
 
@@ -234,30 +265,321 @@ def _shrink(events: list[dict], init: bytes,
     return evs, unknown
 
 
+# -- generalized (component) search: transactions + typed ops ---------------
+
+#: register ops the per-key fast path understands
+_REGISTER_OPS = ("put", "get", "delete")
+#: typed read-modify-write ops: observed reply ("ret") pins pre-state
+_RMW_OPS = ("incr", "getset", "sadd", "srem")
+_READ_OPS = ("get", "smembers")
+_ALL_OPS = _REGISTER_OPS + _RMW_OPS + ("smembers",)
+
+
+def _event_subs(e: dict):
+    """Normalize an event to its sub-op list [(op, key, arg, obs)]
+    with obs the OBSERVED reply constraint (None = unconstrained), or
+    None for an event the checker cannot model."""
+    op = e["op"]
+    if op == "txn":
+        subs = e.get("subs") or []
+        rets = e.get("rets")
+        out = []
+        for i, s in enumerate(subs):
+            sop = s["op"]
+            if sop not in _ALL_OPS:
+                return None
+            obs = rets[i] if (rets is not None and i < len(rets)) \
+                else None
+            if sop in ("put", "delete"):
+                obs = None              # replies carry no information
+            out.append((sop, s["key"], s["value"], obs))
+        return out
+    if op not in _ALL_OPS:
+        return None
+    if op in _READ_OPS:
+        return [(op, e["key"], b"", e.get("value"))]
+    if op in _RMW_OPS:
+        return [(op, e["key"], e["value"], e.get("ret"))]
+    return [(op, e["key"], e["value"], None)]
+
+
+def _encode_sub(sop: str, key: bytes, arg) -> bytes:
+    from apus_tpu.models import kvs
+    if sop == "put":
+        return kvs.encode_put(key, arg or b"")
+    if sop == "get":
+        return kvs.encode_get(key)
+    if sop == "delete":
+        return kvs.encode_delete(key)
+    if sop == "incr":
+        try:
+            delta = int(arg) if arg else 1
+        except ValueError:
+            delta = 1
+        return kvs.encode_incr(key, delta)
+    if sop == "getset":
+        return kvs.encode_getset(key, arg or b"")
+    if sop == "sadd":
+        return kvs.encode_sadd(key, arg or b"")
+    if sop == "srem":
+        return kvs.encode_srem(key, arg or b"")
+    return kvs.encode_smembers(key)
+
+
+def _transition(state: dict, subs, check_obs: bool):
+    """Apply one atomic event's subs in order over ``state`` (a dict
+    key -> bytes | _UNKNOWN).  Semantics come from the SAME
+    ``models.kvs.eval_subop`` the state machine runs.  Returns the new
+    state dict, or None when a certain observation contradicts it.
+    _UNKNOWN values (front-shrunk windows) are pinned by reads and
+    conservatively widened otherwise — lenient handling can only make
+    a reported minimal window larger, never create a false
+    violation."""
+    from apus_tpu.models.kvs import eval_subop
+    st = dict(state)
+    for sop, key, arg, obs in subs:
+        cur = st.get(key, b"")
+        if cur is _UNKNOWN:
+            if sop == "get":
+                if check_obs and obs is not None:
+                    st[key] = obs       # first read pins the register
+                continue
+            if sop == "smembers":
+                if check_obs and obs is not None:
+                    st[key] = obs       # canonical encoding pins it
+                continue
+            if sop in ("put", "getset"):
+                st[key] = arg or b""
+                continue
+            if sop == "delete":
+                st[key] = b""
+                continue
+            if sop == "incr":
+                # Pinned by the observed new value when we have one;
+                # otherwise the result is any int — stays unknown.
+                if check_obs and obs is not None \
+                        and obs != b"!notint":
+                    st[key] = obs
+                continue
+            # sadd/srem on unknown membership: stays unknown (partial
+            # set knowledge is not tracked; shrink-only leniency).
+            continue
+        try:
+            _k, reply, write = eval_subop(
+                lambda k, _s=st: (_s.get(k, b"")
+                                  if _s.get(k, b"") is not _UNKNOWN
+                                  else b""),
+                _encode_sub(sop, key, arg))
+        except ValueError:
+            continue
+        if check_obs and obs is not None and reply != obs:
+            return None
+        if write is not None:
+            st[key] = write[1] if write[0] == "P" else b""
+    return st
+
+
+def _to_general_events(events: list[dict]):
+    """Event dicts -> [(subs, t0, t1, certain, event)] sorted by t0,
+    applying the ambiguity rules: certain = completed "ok"
+    (observations checked); timed-out/errored events with any write
+    sub are optional maybe-applied (observations ignored); ambiguous
+    pure-read events carry no information and are dropped."""
+    out = []
+    for e in events:
+        subs = _event_subs(e)
+        if subs is None:
+            continue
+        certain = e["status"] == "ok"
+        if not certain and all(s[0] in _READ_OPS for s in subs):
+            continue
+        t1 = e["t1"] if (certain and e.get("t1") is not None) else INF
+        out.append((subs, e["t0"], t1, certain, e))
+    out.sort(key=lambda o: (o[1], o[2]))
+    return out
+
+
+def _state_key(st: dict, keys: tuple) -> tuple:
+    return tuple(st.get(k, b"") for k in keys)
+
+
+def _general_search(gevents, comp_keys: tuple, init,
+                    max_nodes: int) -> str:
+    """Wing&Gong over atomic multi-sub-op events; state = the
+    component's key->value map.  Frontier scan identical to the
+    register search (t0-sorted, running min-response cutoff, ``lo``
+    skips the linearized prefix).  ``init``: bytes (every key starts
+    there — fresh store) or _UNKNOWN (front-shrunk windows)."""
+    n = len(gevents)
+    if n == 0:
+        return "ok"
+    certain_mask = 0
+    for i, g in enumerate(gevents):
+        if g[3]:
+            certain_mask |= 1 << i
+    if certain_mask == 0:
+        return "ok"
+    init_state = {k: init for k in comp_keys}
+    seen = {(0, _state_key(init_state, comp_keys))}
+    stack = [(0, 0, init_state)]
+    nodes = 0
+    while stack:
+        mask, lo, state = stack.pop()
+        if mask & certain_mask == certain_mask:
+            return "ok"
+        nodes += 1
+        if nodes > max_nodes:
+            return "undecided"
+        while lo < n and (mask >> lo) & 1:
+            lo += 1
+        cands = []
+        min_ret = INF
+        i = lo
+        while i < n:
+            if not (mask >> i) & 1:
+                g = gevents[i]
+                if g[1] > min_ret:
+                    break
+                cands.append(i)
+                if g[2] < min_ret:
+                    min_ret = g[2]
+            i += 1
+        for i in sorted(cands, key=lambda j: (gevents[j][3],
+                                              -gevents[j][1])):
+            subs, _t0, _t1, certain, _e = gevents[i]
+            ns = _transition(state, subs, check_obs=certain)
+            if ns is None:
+                continue
+            key = (mask | (1 << i), _state_key(ns, comp_keys))
+            if key not in seen:
+                seen.add(key)
+                stack.append((mask | (1 << i), lo, ns))
+    return "fail"
+
+
+def _shrink_general(events: list[dict], comp_keys: tuple, init,
+                    max_nodes: int) -> tuple[list[dict], bool]:
+    """Minimal failing window over a component's events — the same
+    verified geometric shrink as the register path, with the all-keys
+    _UNKNOWN initial state for front-shrunk windows."""
+    evs = sorted(events, key=lambda e: e["t0"])
+
+    def fails(sub: list[dict], ini) -> bool:
+        return _general_search(_to_general_events(sub), comp_keys,
+                               ini, max_nodes) == "fail"
+
+    step = max(1, len(evs) // 2)
+    while len(evs) > 1:
+        if len(evs) - step >= 1 and fails(evs[:-step], init):
+            evs = evs[:-step]
+        elif step > 1:
+            step //= 2
+        else:
+            break
+    unknown = False
+    step = max(1, len(evs) // 2)
+    while len(evs) > 1:
+        if len(evs) - step >= 1 and fails(evs[step:], _UNKNOWN):
+            evs = evs[step:]
+            unknown = True
+        elif step > 1:
+            step //= 2
+        else:
+            break
+    return evs, unknown
+
+
+def _classify(events: list[dict]):
+    """Partition the history: (plain {key: [events]}, components
+    [(keys_tuple, [events])], checked, skipped).  A key rides the
+    per-key register fast path iff NO transaction touches it and
+    every op on it is put/get/delete; keys co-occurring in a
+    transaction union into one component (the composite object the
+    locality theorem applies to), and a key with typed RDT ops forms
+    at least a singleton component."""
+    parent: dict[bytes, bytes] = {}
+
+    def find(k: bytes) -> bytes:
+        while parent.get(k, k) != k:
+            parent[k] = parent.get(parent[k], parent[k])
+            k = parent[k]
+        return k
+
+    def union(a: bytes, b: bytes) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+
+    key_events: dict[bytes, list] = {}
+    txn_events: list[dict] = []
+    general_keys: set[bytes] = set()
+    skipped = 0
+    checked = 0
+    for e in events:
+        op = e["op"]
+        if op == "txn":
+            subs = _event_subs(e)
+            if subs is None or not subs:
+                skipped += 1
+                continue
+            keys = sorted({s[1] for s in subs})
+            for k in keys:
+                parent.setdefault(k, k)
+                general_keys.add(k)
+            for k in keys[1:]:
+                union(keys[0], k)
+            txn_events.append(e)
+            checked += 1
+            continue
+        if op not in _ALL_OPS:
+            skipped += 1
+            continue
+        if op in _READ_OPS and e["status"] != "ok":
+            skipped += 1
+            continue
+        key_events.setdefault(e["key"], []).append(e)
+        if op not in _REGISTER_OPS:
+            parent.setdefault(e["key"], e["key"])
+            general_keys.add(e["key"])
+        checked += 1
+    plain: dict[bytes, list] = {}
+    comp_keys: dict[bytes, list] = {}
+    for k in general_keys:
+        comp_keys.setdefault(find(k), []).append(k)
+    comp_of: dict[bytes, bytes] = {}
+    for root, ks in comp_keys.items():
+        for k in ks:
+            comp_of[k] = root
+    for k, evs in key_events.items():
+        root = comp_of.get(k)
+        if root is None:
+            plain[k] = evs
+    comps: list[tuple] = []
+    for root in sorted(comp_keys):
+        ks = tuple(sorted(comp_keys[root]))
+        evs = [e for k in ks for e in key_events.get(k, [])]
+        evs += [e for e in txn_events
+                if comp_of.get(_event_subs(e)[0][1]) == root]
+        comps.append((ks, sorted(evs, key=lambda e: e["t0"])))
+    return plain, comps, checked, skipped
+
+
 # -- public API -------------------------------------------------------------
 
 def check_history(events: list[dict], initial: bytes = b"",
                   max_nodes_per_key: int = 500_000) -> AuditResult:
     """Check a captured history (HistoryRecorder.events() /
-    load_jsonl() shape) for linearizability against the per-key KVS
-    register model.  ``initial`` is the fresh-store register value
-    (b"" — a KVS get of a never-written key observes the empty
-    value)."""
-    by_key: dict[bytes, list[dict]] = {}
-    skipped = 0
-    checked = 0
-    for e in events:
-        if e["op"] not in ("put", "get", "delete"):
-            skipped += 1
-            continue
-        if e["op"] == "get" and e["status"] != "ok":
-            skipped += 1
-            continue
-        by_key.setdefault(e["key"], []).append(e)
-        checked += 1
+    load_jsonl() shape) for strict serializability: per-key register
+    search for keys no transaction touches, component-wise generalized
+    search (transactions as atomic multi-sub-op events, typed RDT
+    semantics from models.kvs.eval_subop) for the rest.  ``initial``
+    is the fresh-store register value (b"" — a KVS get of a
+    never-written key observes the empty value)."""
+    plain, comps, checked, skipped = _classify(events)
     violations: list[Violation] = []
     undecided: list[bytes] = []
-    for key, evs in sorted(by_key.items()):
+    nkeys = len(plain)
+    for key, evs in sorted(plain.items()):
         ops = _to_search_ops(evs)
         verdict = _search(ops, initial, max_nodes_per_key)
         if verdict == "undecided":
@@ -272,8 +594,26 @@ def check_history(events: list[dict], initial: bytes = b"",
         violations.append(Violation(
             key=key, window=window, unknown_init=unknown,
             t_lo=window[0]["t0"], t_hi=t_hi))
+    for ks, evs in comps:
+        nkeys += len(ks)
+        rep = ks[0]
+        verdict = _general_search(_to_general_events(evs), ks,
+                                  initial, max_nodes_per_key)
+        if verdict == "undecided":
+            undecided.append(rep)
+            continue
+        if verdict == "ok":
+            continue
+        window, unknown = _shrink_general(evs, ks, initial,
+                                          max_nodes_per_key)
+        window = sorted(window, key=lambda e: e["t0"])
+        t_hi = max((e["t1"] for e in window
+                    if e.get("t1") is not None), default=INF)
+        violations.append(Violation(
+            key=rep, window=window, unknown_init=unknown,
+            t_lo=window[0]["t0"], t_hi=t_hi))
     return AuditResult(ok=not violations, ops_checked=checked,
-                       keys=len(by_key), violations=violations,
+                       keys=nkeys, violations=violations,
                        undecided=undecided, skipped=skipped)
 
 
@@ -291,33 +631,44 @@ def resolve_undecided(events: list[dict], res: AuditResult,
     reports them distinctly and does NOT fail on them)."""
     if not res.undecided:
         return res
-    by_key: dict[bytes, list[dict]] = {}
+    plain, comps, _checked, _skipped = _classify(events)
     want = set(res.undecided)
-    for e in events:
-        if e["op"] not in ("put", "get", "delete"):
-            continue
-        if e["op"] == "get" and e["status"] != "ok":
-            continue
-        if e["key"] in want:
-            by_key.setdefault(e["key"], []).append(e)
     violations = list(res.violations)
     still: list[bytes] = []
     for key in res.undecided:
-        evs = by_key.get(key, [])
-        verdict = _search(_to_search_ops(evs), initial,
-                          max_nodes_per_key)
-        if verdict == "ok":
-            continue
-        if verdict == "undecided":
-            still.append(key)
-            continue
-        window, unknown = _shrink(evs, initial, max_nodes_per_key)
+        if key in plain:
+            evs = plain[key]
+            verdict = _search(_to_search_ops(evs), initial,
+                              max_nodes_per_key)
+            if verdict == "ok":
+                continue
+            if verdict == "undecided":
+                still.append(key)
+                continue
+            window, unknown = _shrink(evs, initial,
+                                      max_nodes_per_key)
+        else:
+            unit = next(((ks, evs) for ks, evs in comps
+                         if ks and ks[0] == key), None)
+            if unit is None:
+                continue              # classification moved; benign
+            ks, evs = unit
+            verdict = _general_search(_to_general_events(evs), ks,
+                                      initial, max_nodes_per_key)
+            if verdict == "ok":
+                continue
+            if verdict == "undecided":
+                still.append(key)
+                continue
+            window, unknown = _shrink_general(evs, ks, initial,
+                                              max_nodes_per_key)
         window = sorted(window, key=lambda e: e["t0"])
         t_hi = max((e["t1"] for e in window
                     if e.get("t1") is not None), default=INF)
         violations.append(Violation(
             key=key, window=window, unknown_init=unknown,
             t_lo=window[0]["t0"], t_hi=t_hi))
+    del want
     return dataclasses.replace(res, ok=not violations,
                                violations=violations, undecided=still)
 

@@ -9,6 +9,25 @@ Command wire format (ascii-ish, newline-free):
     b"P<klen>:<key><value>"  put
     b"G<klen>:<key>"         get (reply = value or empty)
     b"D<klen>:<key>"         delete
+
+Typed replicated-data-type commands (PR 12; SafarDB's typed-op half —
+mergeable counters and sets instead of opaque blobs, riding the same
+log/snapshot/delta machinery because their state IS an ordinary store
+value in a canonical encoding):
+    b"C<klen>:<key><delta>"   counter add (delta = ascii signed int);
+                              reply = the NEW value, ascii
+    b"X<klen>:<key><value>"   getset; reply = the OLD value
+    b"SA<klen>:<key><member>" set add; reply b"1" added / b"0" present
+    b"SR<klen>:<key><member>" set remove; reply b"1" / b"0"
+    b"SM<klen>:<key>"         set members; reply = canonical encoding
+
+Transactions (PR 12; ``runtime/txn.py`` has the protocol walkthrough):
+    b"TM..."  single-group MULTI batch — N sub-commands applied
+              atomically at ONE log index (atomic visibility for free
+              from log order); reply = packed per-sub replies
+    b"TB/TP/TC/TA/TD/TF"  cross-group atomic-commit records (begin /
+              prepare / commit / abort / decide / finish) — see the
+              encoders below and runtime/txn.py
 """
 
 from __future__ import annotations
@@ -31,17 +50,162 @@ def encode_delete(key: bytes) -> bytes:
     return b"D%d:%s" % (len(key), key)
 
 
-def decode_key(cmd: bytes) -> "bytes | None":
-    """Key of a P/G/D command, or None for any other payload (the
-    elastic-group admission check routes on it; non-KVS payloads are
-    never bucket-routed)."""
-    if cmd[:1] not in (b"P", b"G", b"D"):
+def encode_incr(key: bytes, delta: int = 1) -> bytes:
+    return b"C%d:%s%d" % (len(key), key, delta)
+
+
+def encode_getset(key: bytes, value: bytes) -> bytes:
+    return b"X%d:%s%s" % (len(key), key, value)
+
+
+def encode_sadd(key: bytes, member: bytes) -> bytes:
+    return b"SA%d:%s%s" % (len(key), key, member)
+
+
+def encode_srem(key: bytes, member: bytes) -> bytes:
+    return b"SR%d:%s%s" % (len(key), key, member)
+
+
+def encode_smembers(key: bytes) -> bytes:
+    return b"SM%d:%s" % (len(key), key)
+
+
+#: single-key command tags -> (header length, is_read, is_write)
+_KEYED_TAGS = {b"P": (1, False, True), b"G": (1, True, False),
+               b"D": (1, False, True), b"C": (1, False, True),
+               b"X": (1, False, True), b"SA": (2, False, True),
+               b"SR": (2, False, True), b"SM": (2, True, False)}
+
+
+def _parse_keyed(cmd: bytes):
+    """-> (tag, key, payload) for any single-key command, else None."""
+    tag = cmd[:2] if cmd[:1] == b"S" else cmd[:1]
+    info = _KEYED_TAGS.get(tag)
+    if info is None:
         return None
     try:
-        klen_s, rest = cmd[1:].split(b":", 1)
-        return rest[:int(klen_s)]
+        klen_s, rest = cmd[info[0]:].split(b":", 1)
+        klen = int(klen_s)
+        return tag, rest[:klen], rest[klen:]
     except (ValueError, IndexError):
         return None
+
+
+def decode_key(cmd: bytes) -> "bytes | None":
+    """Key of a single-key KVS command (P/G/D and the typed RDT ops),
+    or None for any other payload (the elastic-group admission check
+    routes on it; non-keyed payloads are never bucket-routed)."""
+    p = _parse_keyed(cmd)
+    return p[1] if p is not None else None
+
+
+def decode_keys(cmd: bytes) -> "list[bytes] | None":
+    """EVERY key a command touches: [key] for single-key commands, all
+    sub-command keys for TM/TP transaction records (admission must
+    check each), [] for keyless records (TB/TC/TA/TD/TF — reserved,
+    never bucket-routed), None for non-KVS payloads."""
+    if cmd[:2] in (b"TM", b"TP"):
+        try:
+            subs = (decode_txn_multi(cmd) if cmd[:2] == b"TM"
+                    else decode_txn_prepare(cmd)[4])
+        except (ValueError, IndexError, _struct_error):
+            return None
+        out = []
+        for sub in subs:
+            c = sub if isinstance(sub, bytes) else sub[1]
+            k = decode_key(c)
+            if k is None:
+                return None
+            out.append(k)
+        return out
+    if cmd[:1] == b"T":
+        return []
+    k = decode_key(cmd)
+    return [k] if k is not None else None
+
+
+def cmd_is_read(cmd: bytes) -> bool:
+    """True for side-effect-free single-key commands (G, SM)."""
+    tag = cmd[:2] if cmd[:1] == b"S" else cmd[:1]
+    info = _KEYED_TAGS.get(tag)
+    return info is not None and info[1]
+
+
+# -- canonical set encoding (the set RDT's stored representation) ----------
+
+SET_MAGIC = b"S!"
+
+
+def set_decode(value: bytes) -> "set[bytes]":
+    """Canonical stored value -> member set.  b"" (absent) and any
+    non-set value decode as the empty set (set ops overwrite plain
+    values deterministically; the checker uses this SAME function, so
+    model and SM can never disagree)."""
+    if not value.startswith(SET_MAGIC):
+        return set()
+    out = set()
+    off = 2
+    try:
+        while off < len(value):
+            (n,) = _U32.unpack_from(value, off)
+            off += 4
+            out.add(value[off:off + n])
+            off += n
+    except _struct_error:
+        return set()
+    return out
+
+
+def set_encode(members) -> bytes:
+    return SET_MAGIC + b"".join(_U32.pack(len(m)) + m
+                                for m in sorted(members))
+
+
+def eval_subop(view, cmd: bytes):
+    """Pure single-key command semantics, shared by THREE consumers so
+    they cannot drift: the SM apply path, the transaction prepare
+    simulation (models the op against store + txn scratch), and the
+    strict-serializability checker (models it against search state).
+
+    ``view(key) -> bytes`` is the current value (b"" absent).  Returns
+    ``(key, reply, write)`` with write None (read) or ("P", value) /
+    ("D",) — the mutation to install if the command takes effect."""
+    p = _parse_keyed(cmd)
+    if p is None:
+        raise ValueError(f"bad kvs op {cmd[:2]!r}")
+    tag, key, payload = p
+    if tag == b"P":
+        return key, b"OK", ("P", payload)
+    if tag == b"G":
+        return key, view(key), None
+    if tag == b"D":
+        return key, b"OK", ("D",)
+    if tag == b"C":
+        cur = view(key)
+        try:
+            base = int(cur) if cur else 0
+            delta = int(payload)
+        except ValueError:
+            return key, b"!notint", None
+        new = b"%d" % (base + delta)
+        return key, new, ("P", new)
+    if tag == b"X":
+        return key, view(key), ("P", payload)
+    if tag == b"SA":
+        s = set_decode(view(key))
+        if payload in s:
+            return key, b"0", None
+        s.add(payload)
+        return key, b"1", ("P", set_encode(s))
+    if tag == b"SR":
+        s = set_decode(view(key))
+        if payload not in s:
+            return key, b"0", None
+        s.discard(payload)
+        return key, b"1", ("P", set_encode(s))
+    if tag == b"SM":
+        return key, set_encode(set_decode(view(key))), None
+    raise ValueError(f"bad kvs op {tag!r}")
 
 
 # -- elastic-group migration commands (replicated in the groups' own
@@ -62,12 +226,15 @@ def decode_key(cmd: bytes) -> "bytes | None":
 # never re-applies the M entries themselves).
 
 MIG_STATE_KEY = b"\x00apus.migs"
+TXN_STATE_KEY = b"\x00apus.txns"
 RESERVED_PREFIX = b"\x00apus."
 
 REFUSED_FROZEN = REFUSED_REPLY_PREFIX + b"frozen"
 REFUSED_DEPARTED = REFUSED_REPLY_PREFIX + b"departed"
 
 _U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_struct_error = struct.error
 
 
 def _enc_buckets(buckets) -> bytes:
@@ -118,6 +285,200 @@ def encode_mig_commit(mig_id: int) -> bytes:
     return b"MC" + struct.pack("<Q", mig_id)
 
 
+# -- transaction records (PR 12; runtime/txn.py drives the protocol) -------
+#
+# A transaction's identity is the ORIGINATING CLIENT's (clt_id,
+# req_id) pair — 16 bytes on the wire, "clt.req" as the SM table key —
+# so the coordinator group's ordinary endpoint-DB dedup gives the
+# whole cross-group transaction exactly-once semantics: the TD record
+# is submitted under the CLIENT's identity and its apply-time reply is
+# epdb-noted like any single op's (abort outcomes return a REFUSED-
+# prefixed sentinel, which the apply path never notes — a retried
+# transaction re-enters fresh under a new req_id).
+
+REFUSED_LOCKED = REFUSED_REPLY_PREFIX + b"locked"
+#: prepare/decide refusals that must reach the txn DRIVER verbatim
+#: (the client service passes them through as OK-status replies
+#: instead of translating them into typed bounces)
+REFUSED_TX = REFUSED_REPLY_PREFIX + b"tx:"
+REFUSED_TX_ABORTED = REFUSED_TX + b"aborted"
+
+#: transaction reply blobs lead with this tag (never collides with the
+#: REFUSED prefix or a bare b"OK")
+TXN_REPLY_MAGIC = b"TR"
+
+
+def txn_key(clt_id: int, req_id: int) -> str:
+    return "%d.%d" % (clt_id, req_id)
+
+
+def parse_txn_key(tk: str) -> "tuple[int, int]":
+    c, r = tk.split(".")
+    return int(c), int(r)
+
+
+def _enc_subs(subs) -> bytes:
+    """[(pos, cmd)] -> packed bytes."""
+    return _U16.pack(len(subs)) + b"".join(
+        _U16.pack(p) + _U32.pack(len(c)) + c for p, c in subs)
+
+
+def _dec_subs(buf: bytes, off: int):
+    (n,) = _U16.unpack_from(buf, off)
+    off += 2
+    out = []
+    for _ in range(n):
+        (p,) = _U16.unpack_from(buf, off)
+        (ln,) = _U32.unpack_from(buf, off + 2)
+        off += 6
+        out.append((p, buf[off:off + ln]))
+        off += ln
+    return out, off
+
+
+def pack_replies(replies) -> bytes:
+    """[(pos, reply_bytes)] -> the TR reply blob (position-keyed so the
+    coordinator reassembles cross-group replies in client sub order)."""
+    return TXN_REPLY_MAGIC + _enc_subs(sorted(replies))
+
+
+def unpack_replies(blob: bytes) -> "list[tuple[int, bytes]]":
+    if not blob.startswith(TXN_REPLY_MAGIC):
+        raise ValueError(f"bad txn reply blob {blob[:2]!r}")
+    out, _ = _dec_subs(blob, 2)
+    return out
+
+
+def encode_txn_multi(cmds) -> bytes:
+    """Single-group MULTI/EXEC batch: sub-commands applied atomically
+    at one log index."""
+    return b"TM" + _enc_subs(list(enumerate(cmds)))
+
+
+def decode_txn_multi(cmd: bytes) -> "list[bytes]":
+    subs, _ = _dec_subs(cmd, 2)
+    return [c for _p, c in sorted(subs)]
+
+
+_TXNID = struct.Struct("<QQ")
+
+
+def _enc_txnid(clt_id: int, req_id: int) -> bytes:
+    return _TXNID.pack(clt_id, req_id)
+
+
+def encode_txn_begin(clt_id: int, req_id: int, epoch: int,
+                     groups: "dict[int, list]") -> bytes:
+    """TB (coordinator group's log): the durable 2PC intent record —
+    replicated BEFORE any prepare is sent, so whoever comes to lead
+    the coordinator group can resume/decide the transaction.
+    ``groups``: gid -> [(pos, subcmd)]."""
+    out = [b"TB", _enc_txnid(clt_id, req_id), _U32.pack(epoch),
+           struct.pack("<B", len(groups))]
+    for gid in sorted(groups):
+        out.append(struct.pack("<B", gid) + _enc_subs(groups[gid]))
+    return b"".join(out)
+
+
+def decode_txn_begin(cmd: bytes):
+    """-> (clt_id, req_id, epoch, {gid: [(pos, subcmd)]})."""
+    clt, req = _TXNID.unpack_from(cmd, 2)
+    (epoch,) = _U32.unpack_from(cmd, 18)
+    ngroups = cmd[22]
+    off = 23
+    groups = {}
+    for _ in range(ngroups):
+        gid = cmd[off]
+        subs, off = _dec_subs(cmd, off + 1)
+        groups[gid] = subs
+    return clt, req, epoch, groups
+
+
+def encode_txn_prepare(clt_id: int, req_id: int, coord_gid: int,
+                       epoch: int, subs) -> bytes:
+    """TP (participant group's log): lock this group's keys, evaluate
+    the sub-ops against the locked state (replies + buffered writes
+    recorded, so the later TC is a pure install), survive leader kills
+    by living in the group's own log."""
+    return (b"TP" + _enc_txnid(clt_id, req_id)
+            + struct.pack("<BI", coord_gid, epoch) + _enc_subs(subs))
+
+
+def decode_txn_prepare(cmd: bytes):
+    """-> (clt_id, req_id, coord_gid, epoch, [(pos, subcmd)])."""
+    clt, req = _TXNID.unpack_from(cmd, 2)
+    coord, epoch = struct.unpack_from("<BI", cmd, 18)
+    subs, _ = _dec_subs(cmd, 23)
+    return clt, req, coord, epoch, subs
+
+
+def encode_txn_commit(clt_id: int, req_id: int) -> bytes:
+    return b"TC" + _enc_txnid(clt_id, req_id)
+
+
+def encode_txn_abort(clt_id: int, req_id: int) -> bytes:
+    return b"TA" + _enc_txnid(clt_id, req_id)
+
+
+def encode_txn_finish(clt_id: int, req_id: int) -> bytes:
+    return b"TF" + _enc_txnid(clt_id, req_id)
+
+
+def encode_txn_decide(clt_id: int, req_id: int, commit: bool,
+                      reply: bytes = b"") -> bytes:
+    """TD (coordinator group's log): THE single decision point.  The
+    first TD for a transaction in the coordinator log's order wins on
+    every replica; it is submitted under the CLIENT's identity so a
+    commit's apply-time reply lands in the endpoint DB (exactly-once
+    for the whole transaction), while an abort returns a REFUSED
+    sentinel that is never noted."""
+    return (b"TD" + _enc_txnid(clt_id, req_id)
+            + struct.pack("<B", 1 if commit else 0)
+            + struct.pack("<I", len(reply)) + reply)
+
+
+def decode_txn_decide(cmd: bytes):
+    clt, req = _TXNID.unpack_from(cmd, 2)
+    commit = cmd[18] != 0
+    (ln,) = _U32.unpack_from(cmd, 19)
+    return clt, req, commit, cmd[23:23 + ln]
+
+
+def _dec_txnid(cmd: bytes) -> "tuple[int, int]":
+    return _TXNID.unpack_from(cmd, 2)
+
+
+# writes_blob codec: the buffered mutations a prepared txn installs at
+# commit — [(key, ("P", value) | ("D",))] packed.
+
+def _enc_writes(writes) -> bytes:
+    out = [_U16.pack(len(writes))]
+    for key, w in writes:
+        kind = w[0].encode()
+        val = w[1] if len(w) > 1 else b""
+        out.append(_U32.pack(len(key)) + key + kind
+                   + _U32.pack(len(val)) + val)
+    return b"".join(out)
+
+
+def _dec_writes(buf: bytes):
+    (n,) = _U16.unpack_from(buf, 0)
+    off = 2
+    out = []
+    for _ in range(n):
+        (klen,) = _U32.unpack_from(buf, off)
+        off += 4
+        key = buf[off:off + klen]
+        off += klen
+        kind = buf[off:off + 1].decode()
+        (vlen,) = _U32.unpack_from(buf, off + 1)
+        off += 5
+        val = buf[off:off + vlen]
+        off += vlen
+        out.append((key, ("P", val) if kind == "P" else ("D",)))
+    return out
+
+
 class KvsStateMachine(StateMachine):
     def __init__(self) -> None:
         self.store: dict[bytes, bytes] = {}
@@ -153,6 +514,22 @@ class KvsStateMachine(StateMachine):
         self.migs_in: dict[str, list] = {}
         self._frozen: set[int] = set()
         self._departed: dict[int, tuple[int, int]] = {}
+        # Transaction bookkeeping (PR 12; mirrored into TXN_STATE_KEY
+        # so it survives snapshot/delta catch-up AND restart replay).
+        # txns_in: txn_key -> [coord_gid, epoch, state, subs_s,
+        #   replies_s, writes_s, last_idx] with state
+        #   "prepared" -> "done" | "aborted" (latin-1 strings — the
+        #   JSON mirror roundtrips bytes losslessly).
+        # txns_coord: txn_key -> [state, epoch, groups_s, reply_s,
+        #   last_idx] with state "open" -> "committed"|"aborted" ->
+        #   "done".
+        # _locks (derived): key -> (txn_key, "r"|"w") for every key a
+        #   PREPARED txn touches — exclusive 2PL; write-locked keys
+        #   refuse reads too (a committed-but-uninstalled write must
+        #   never be read around), read-locked keys serve reads.
+        self.txns_in: dict[str, list] = {}
+        self.txns_coord: dict[str, list] = {}
+        self._locks: dict[bytes, tuple] = {}
 
     # -- internal mutation helpers (delta bookkeeping in one place) --------
 
@@ -174,10 +551,14 @@ class KvsStateMachine(StateMachine):
         op = cmd[:1]
         if op == b"M":
             return self._apply_mig(idx, cmd)
-        klen_s, rest = cmd[1:].split(b":", 1)
-        klen = int(klen_s)
-        key, payload = rest[:klen], rest[klen:]
-        if op == b"P" or op == b"D":
+        if op == b"T":
+            return self._apply_txn(idx, cmd)
+        p = _parse_keyed(cmd)
+        if p is None:
+            raise ValueError(f"bad kvs op {cmd[:2]!r}")
+        _tag, key, _payload = p
+        is_read = cmd_is_read(cmd)
+        if not key.startswith(RESERVED_PREFIX):
             # Elastic-group fence: a decided write into a FROZEN bucket
             # (migration capture in flight) or a DEPARTED one (already
             # owned by another group) deterministically no-ops with a
@@ -187,23 +568,30 @@ class KvsStateMachine(StateMachine):
             # dedup-cached (see sm.REFUSED_REPLY_PREFIX), so the
             # client's re-routed retry executes exactly once at the
             # owner.
-            if (self._frozen or self._departed) \
-                    and not key.startswith(RESERVED_PREFIX):
+            if not is_read and (self._frozen or self._departed):
                 from apus_tpu.runtime.router import bucket_of_key
                 b = bucket_of_key(key)
                 if b in self._departed:
                     return REFUSED_DEPARTED
                 if b in self._frozen:
                     return REFUSED_FROZEN
-        if op == b"P":
-            self._put_internal(idx, key, payload)
-            return b"OK"
-        if op == b"G":
-            return self.store.get(key, b"")
-        if op == b"D":
-            self._del_internal(idx, key)
-            return b"OK"
-        raise ValueError(f"bad kvs op {op!r}")
+            # Transaction lock fence (exclusive 2PL): writes refuse on
+            # ANY lock; reads refuse only on WRITE locks (a prepared
+            # txn's buffered write must not be read around — between
+            # the coordinator's decided-commit and the participant's
+            # TC apply, the old value is a stale read).
+            if self._locks:
+                lk = self._locks.get(key)
+                if lk is not None and (not is_read or lk[1] == "w"):
+                    return REFUSED_LOCKED
+        key2, reply, write = eval_subop(
+            lambda k: self.store.get(k, b""), cmd)
+        if write is not None:
+            if write[0] == "P":
+                self._put_internal(idx, key2, write[1])
+            else:
+                self._del_internal(idx, key2)
+        return reply
 
     # -- elastic-group migration ops ---------------------------------------
 
@@ -213,6 +601,20 @@ class KvsStateMachine(StateMachine):
         if sub == b"B":
             mig_id, dst, epoch, size, mask, buckets = \
                 decode_mig_begin(cmd)
+            if self._locks:
+                # A WRITE-locked key (open prepared transaction) in the
+                # requested bucket set defers the freeze: the txn's
+                # buffered writes must land HERE before the capture, or
+                # the migration would ship a value the committed txn
+                # then overwrites only at src (lost update at dst).
+                # Deterministic REFUSED — the elastic driver retries
+                # the split after the txn resolves.  Read locks don't
+                # defer: a migration moves the value unchanged.
+                bset = set(buckets)
+                from apus_tpu.runtime.router import bucket_of_key
+                for k, lk in self._locks.items():
+                    if lk[1] == "w" and bucket_of_key(k) in bset:
+                        return REFUSED_LOCKED
             if str(mig_id) not in self.migs_out:
                 self.migs_out[str(mig_id)] = [dst, epoch, "frozen",
                                               buckets, size, mask]
@@ -311,6 +713,256 @@ class KvsStateMachine(StateMachine):
                                                        {}).items()}
         self.migs_in = {k: list(v) for k, v in st.get("in", {}).items()}
         self._mig_rederive()
+
+    # -- transactions (PR 12; runtime/txn.py drives the protocol) ----------
+
+    #: completed-transaction tombstones retained for late-duplicate
+    #: idempotence (a TP/TC/TA from an abandoned earlier driver attempt
+    #: may commit after the txn resolved); beyond this, oldest pruned.
+    TXN_TOMBSTONES = 128
+
+    def _view_with(self, scratch: dict):
+        """Store view overlaid with a txn's in-flight scratch writes —
+        sub-op i observes sub-ops < i of the same transaction."""
+        def view(k: bytes) -> bytes:
+            if k in scratch:
+                w = scratch[k]
+                return w[1] if w[0] == "P" else b""
+            return self.store.get(k, b"")
+        return view
+
+    def _simulate_subs(self, subs):
+        """Evaluate [(pos, cmd)] in position order against store +
+        scratch.  -> (replies [(pos, bytes)], writes [(key, w)])."""
+        scratch: dict[bytes, tuple] = {}
+        view = self._view_with(scratch)
+        replies = []
+        for pos, c in sorted(subs):
+            key, reply, write = eval_subop(view, c)
+            replies.append((pos, reply))
+            if write is not None:
+                scratch[key] = write
+        return replies, list(scratch.items())
+
+    def _txn_fence(self, subs, tk: "str | None" = None):
+        """Deterministic admission fence for a txn's key set: departed
+        / frozen (elastic) and lock conflicts (other open txns).
+        Returns None (clear) or the REFUSED reason tag bytes."""
+        from apus_tpu.runtime.router import bucket_of_key
+        for _pos, c in subs:
+            key = decode_key(c)
+            if key is None or key.startswith(RESERVED_PREFIX):
+                continue
+            if self._frozen or self._departed:
+                b = bucket_of_key(key)
+                if b in self._departed:
+                    return b"departed"
+                if not cmd_is_read(c) and b in self._frozen:
+                    return b"frozen"
+            lk = self._locks.get(key)
+            if lk is not None and (tk is None or lk[0] != tk):
+                return b"locked"
+        return None
+
+    def _apply_txn(self, idx: int, cmd: bytes) -> bytes:
+        sub = cmd[1:2]
+        if sub == b"M":
+            return self._apply_txn_multi(idx, cmd)
+        if sub == b"P":
+            return self._apply_txn_prepare(idx, cmd)
+        if sub == b"C":
+            return self._apply_txn_close(idx, cmd, commit=True)
+        if sub == b"A":
+            return self._apply_txn_close(idx, cmd, commit=False)
+        if sub == b"B":
+            return self._apply_txn_begin(idx, cmd)
+        if sub == b"D":
+            return self._apply_txn_decide(idx, cmd)
+        if sub == b"F":
+            return self._apply_txn_finish(idx, cmd)
+        raise ValueError(f"bad kvs txn op {cmd[:2]!r}")
+
+    def _apply_txn_multi(self, idx: int, cmd: bytes) -> bytes:
+        """TM: within-group atomic batch — ONE log entry, sub-ops
+        evaluated in order (later subs observe earlier ones), all
+        mutations installed at this index.  Atomic visibility is free
+        from log order; the whole batch refuses deterministically when
+        any key is fenced (frozen/departed/locked), so the client's
+        retry re-enters admission fresh, exactly-once intact."""
+        subs = list(enumerate(decode_txn_multi(cmd)))
+        why = self._txn_fence(subs)
+        if why == b"departed":
+            return REFUSED_DEPARTED
+        if why == b"frozen":
+            return REFUSED_FROZEN
+        if why is not None:
+            return REFUSED_LOCKED
+        replies, writes = self._simulate_subs(subs)
+        for key, w in writes:
+            if w[0] == "P":
+                self._put_internal(idx, key, w[1])
+            else:
+                self._del_internal(idx, key)
+        return pack_replies(replies)
+
+    def _apply_txn_prepare(self, idx: int, cmd: bytes) -> bytes:
+        """TP: lock the keys, evaluate the sub-ops against the locked
+        state (replies AND final writes recorded — TC is then a pure
+        install, so the value a prepare computed is exactly the value
+        commit publishes), all replicated in THIS group's log so a
+        leader kill moves the prepared state with the leadership.
+        Idempotent by txn id; refusals are REFUSED_TX-prefixed
+        (epdb-note skipped, passed through to the driver verbatim)."""
+        clt, req, coord, epoch, subs = decode_txn_prepare(cmd)
+        tk = txn_key(clt, req)
+        rec = self.txns_in.get(tk)
+        if rec is not None:
+            if rec[2] in ("prepared", "done"):
+                return rec[4].encode("latin-1")   # stored TR replies
+            return REFUSED_TX_ABORTED             # aborted tombstone
+        why = self._txn_fence(subs, tk=tk)
+        if why is not None:
+            return REFUSED_TX + why
+        replies, writes = self._simulate_subs(subs)
+        reply_blob = pack_replies(replies)
+        self.txns_in[tk] = [
+            coord, epoch, "prepared",
+            _enc_subs(subs).decode("latin-1"),
+            reply_blob.decode("latin-1"),
+            _enc_writes(writes).decode("latin-1"), idx]
+        self._txn_commit_state(idx)
+        return reply_blob
+
+    def _apply_txn_close(self, idx: int, cmd: bytes,
+                         commit: bool) -> bytes:
+        """TC/TA: resolve a prepared transaction — install the buffered
+        writes (commit) or drop them (abort), release the locks either
+        way.  A TA for an UNKNOWN txn records an aborted tombstone so a
+        straggler TP from an abandoned driver attempt can never lock
+        keys after the decision (the tombstone refuses it)."""
+        clt, req = _dec_txnid(cmd)
+        tk = txn_key(clt, req)
+        rec = self.txns_in.get(tk)
+        if rec is None:
+            if not commit:
+                self.txns_in[tk] = [0, 0, "aborted", "", "", "", idx]
+                self._txn_commit_state(idx)
+            return b"OK"
+        if rec[2] != "prepared":
+            return b"OK"                          # duplicate close
+        if commit:
+            for key, w in _dec_writes(rec[5].encode("latin-1")):
+                if w[0] == "P":
+                    self._put_internal(idx, key, w[1])
+                else:
+                    self._del_internal(idx, key)
+            rec[2] = "done"
+        else:
+            rec[2] = "aborted"
+        rec[5] = ""                               # writes installed/dropped
+        rec[6] = idx
+        self._txn_commit_state(idx)
+        return b"OK"
+
+    def _apply_txn_begin(self, idx: int, cmd: bytes) -> bytes:
+        clt, req, epoch, groups = decode_txn_begin(cmd)
+        tk = txn_key(clt, req)
+        if tk not in self.txns_coord:
+            groups_s = json.dumps(
+                {str(g): _enc_subs(s).decode("latin-1")
+                 for g, s in groups.items()}, sort_keys=True)
+            self.txns_coord[tk] = ["open", epoch, groups_s, None, idx]
+            self._txn_commit_state(idx)
+        return b"OK"
+
+    def _apply_txn_decide(self, idx: int, cmd: bytes) -> bytes:
+        """TD: the decision point.  First TD in this group's log order
+        wins on every replica; its reply is what the apply path
+        epdb-notes under the CLIENT's identity (commit) or skips
+        (abort — REFUSED sentinel)."""
+        clt, req, commit, reply = decode_txn_decide(cmd)
+        tk = txn_key(clt, req)
+        rec = self.txns_coord.get(tk)
+        if rec is None:
+            rec = self.txns_coord[tk] = ["open", 0, "{}", None, idx]
+        if rec[0] == "open":
+            rec[0] = "committed" if commit else "aborted"
+            rec[3] = reply.decode("latin-1") if commit else None
+            rec[4] = idx
+            self._txn_commit_state(idx)
+        if rec[0] in ("committed", "done") and rec[3] is not None:
+            return rec[3].encode("latin-1")
+        return REFUSED_TX_ABORTED
+
+    def _apply_txn_finish(self, idx: int, cmd: bytes) -> bytes:
+        """TF: every participant acked its TC/TA — stop re-driving."""
+        clt, req = _dec_txnid(cmd)
+        rec = self.txns_coord.get(txn_key(clt, req))
+        if rec is not None and rec[0] in ("committed", "aborted"):
+            rec[0] = "done"
+            rec[4] = idx
+            self._txn_commit_state(idx)
+        return b"OK"
+
+    def _txn_rederive(self) -> None:
+        """Lock table from the open-prepared transactions."""
+        self._locks = {}
+        for tk, rec in self.txns_in.items():
+            if rec[2] != "prepared":
+                continue
+            try:
+                subs, _ = _dec_subs(rec[3].encode("latin-1"), 0)
+            except (ValueError, IndexError, _struct_error):
+                continue
+            for _pos, c in subs:
+                k = decode_key(c)
+                if k is None:
+                    continue
+                kind = "r" if cmd_is_read(c) else "w"
+                prev = self._locks.get(k)
+                if prev is None or kind == "w":
+                    self._locks[k] = (tk, kind)
+
+    def _txn_prune(self) -> None:
+        """Bound the completed-txn tombstone tables (oldest-resolved
+        first, by completion index)."""
+        for table, done_states in ((self.txns_in, ("done", "aborted")),
+                                   (self.txns_coord, ("done",))):
+            done = [(rec[-1], tk) for tk, rec in table.items()
+                    if rec[2 if table is self.txns_in else 0]
+                    in done_states]
+            if len(done) > self.TXN_TOMBSTONES:
+                done.sort()
+                for _i, tk in done[:len(done) - self.TXN_TOMBSTONES]:
+                    table.pop(tk, None)
+
+    def _txn_commit_state(self, idx: int) -> None:
+        """Re-derive locks and mirror the txn tables into the reserved
+        key (deterministic bytes), so they survive snapshot/delta
+        catch-up and restart replay like ordinary state."""
+        self._txn_prune()
+        self._txn_rederive()
+        blob = json.dumps({"in": self.txns_in,
+                           "coord": self.txns_coord},
+                          sort_keys=True,
+                          separators=(",", ":")).encode()
+        self._put_internal(idx, TXN_STATE_KEY, blob)
+
+    def _txn_reload(self) -> None:
+        """Rebuild the in-memory txn tables from the reserved key after
+        a snapshot/delta install replaced or merged state."""
+        blob = self.store.get(TXN_STATE_KEY)
+        if not blob:
+            if self.txns_in or self.txns_coord:
+                self.txns_in, self.txns_coord = {}, {}
+                self._locks = {}
+            return
+        st = json.loads(blob.decode())
+        self.txns_in = {k: list(v) for k, v in st.get("in",
+                                                      {}).items()}
+        self.txns_coord = {k: list(v)
+                           for k, v in st.get("coord", {}).items()}
+        self._txn_rederive()
 
     # -- streamable snapshot rope (zero-copy capture) ----------------------
 
@@ -436,12 +1088,16 @@ class KvsStateMachine(StateMachine):
         # key modified after b (at worst a few extra).  The floor is
         # unchanged — history below it was already unknown.
         self._mig_reload()
+        self._txn_reload()
 
     def query(self, cmd: bytes) -> bytes | None:
-        """GET without logging (linearizable-read path).  GET is
-        side-effect-free, so it shares apply's decode+lookup."""
-        if cmd[:1] != b"G":
-            raise ValueError("only GET is a read-only command")
+        """Read without logging (linearizable-read path): GET and
+        SMEMBERS are side-effect-free, so they share apply's
+        decode+lookup — including the txn WRITE-lock fence (a locked
+        key's read refuses with the REFUSED sentinel; the client
+        service bounces it as a transient retry)."""
+        if not cmd_is_read(cmd):
+            raise ValueError("only GET/SMEMBERS are read-only commands")
         return self.apply(0, cmd)
 
     def create_snapshot(self, last_idx: int, last_term: int) -> Snapshot:
@@ -475,6 +1131,7 @@ class KvsStateMachine(StateMachine):
             v = buf[j + 1:j + 1 + vlen]
             off = j + 1 + vlen
             self.store[k] = v
-        # A snapshot-primed replica never applies the covered M entries
-        # — the migration tables ride the reserved key instead.
+        # A snapshot-primed replica never applies the covered M/T
+        # entries — the migration and txn tables ride reserved keys.
         self._mig_reload()
+        self._txn_reload()

@@ -68,6 +68,14 @@ COUNTERS: dict[str, str] = {
     "node_migrations": "bucket migrations committed (split/merge flips)",
     "node_wrong_group_hints": "ops bounced with a typed WRONG_GROUP + shard map",
     "node_migrating_refusals": "writes refused on a frozen mid-migration bucket",
+    # Cross-group transactions (runtime/txn.py 2PC coordinator).
+    "node_txn_prepared": "participant prepares collected by this coordinator",
+    "node_txn_decided": "transactions decided COMMIT (TD records applied)",
+    "node_txn_aborted": "transactions decided ABORT",
+    "node_txn_resumed": "open transactions adopted by a driver that did not begin them",
+    "node_txn_lock_conflicts": "prepares refused on a lock conflict (txn aborted)",
+    "node_txn_epoch_aborts": "prepares refused on the frozen/departed epoch fence",
+    "node_txn_batches": "within-group TM MULTI batches served",
     "node_devplane_own_flips": "device-plane commit ownership flips (own/release)",
     "node_nack_ranges_dropped": "proxy NACK ranges dropped by the bridge",
     "node_proxy_spin_timeouts": "proxy spin-wait timeouts observed",
@@ -170,4 +178,5 @@ FLIGHT_CATEGORIES: dict[str, str] = {
     "fault": "scripted fault-plane commands landing on this replica",
     "devplane": "device-plane ownership flips (cause-tagged) + recompiles",
     "elastic": "elastic-group migrations: begin/capture/committed edges",
+    "txn": "cross-group transactions: begin/resumed/decided/closed edges",
 }

@@ -72,6 +72,25 @@ def _sentinel_bounce(daemon, node, req_id: int, data: bytes,
     return wire.u8(ST_MIGRATING) + wire.u64(req_id)
 
 
+def _txn_passthrough(reply: "bytes | None") -> bool:
+    """True for REFUSED_TX-prefixed replies (transaction-prepare/
+    decide refusals): these must reach the txn DRIVER verbatim as
+    OK-status replies — translating them into typed bounces would
+    strand the driver in a retry loop with no refusal reason."""
+    from apus_tpu.models.kvs import REFUSED_TX
+    return reply is not None and reply.startswith(REFUSED_TX)
+
+
+def _read_locked(reply: "bytes | None") -> bool:
+    """True when a read resolved to the txn WRITE-lock sentinel: the
+    key sits under a prepared transaction's buffered write, so serving
+    the pre-txn value could be a stale read (the txn may already be
+    decided-commit at the coordinator).  Exact equality, not prefix —
+    GET replies are raw stored values and must never be misbounced."""
+    from apus_tpu.models.kvs import REFUSED_LOCKED
+    return reply == REFUSED_LOCKED
+
+
 def _svc_emulate(daemon, n_reads: int) -> None:
     """Per-replica read service-capacity emulation (bench.py
     --throughput follower-read rows): each served read holds this
@@ -146,10 +165,15 @@ def make_client_ops(daemon, node=None) -> dict:
                 # entry applied) — apply position alone can be satisfied
                 # by a different entry after truncation.
                 if pr.reply is not None:
-                    if el is not None and pr.reply.startswith(
-                            _REFUSED_PREFIX):
+                    if _txn_passthrough(pr.reply):
+                        # Prepare/decide refusal: verbatim to the txn
+                        # driver (OK status; never a bounce).
+                        return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                                + wire.blob(pr.reply))
+                    if pr.reply.startswith(_REFUSED_PREFIX):
                         # Raced a leader change past an unapplied
-                        # migration record; deterministically no-op'd.
+                        # migration/lock record; deterministically
+                        # no-op'd.
                         return _sentinel_bounce(daemon, node, req_id,
                                                 data, pr.reply)
                     if traced:
@@ -192,6 +216,11 @@ def make_client_ops(daemon, node=None) -> dict:
                 if rr.done:
                     if rr.error:
                         return wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+                    if _read_locked(rr.reply):
+                        # Key under a prepared txn's buffered write:
+                        # transient bounce, retried past the TC/TA.
+                        return (wire.u8(ST_MIGRATING)
+                                + wire.u64(req_id))
                     if el is not None:
                         # Reply-time re-check: the bucket may have
                         # DEPARTED while the read was parked — serving
@@ -391,6 +420,23 @@ def make_client_ops(daemon, node=None) -> dict:
             if el is not None:
                 st["router_epoch"] = el.shard_map().epoch
                 st["migrations"] = el.migrations_view()
+            # Transaction observability (runtime/txn.py): open/decided
+            # coordinator records + prepared participant records +
+            # lock counts (failure dumps attach this beside the
+            # groups/router views), and the 2PC counters.
+            txn = getattr(daemon, "txn", None)
+            if txn is not None:
+                st["txns"] = txn.txns_view()
+                _tn = (daemon.groupset.nodes
+                       if daemon.groupset is not None else [n])
+                # Distinct stats views only: with a shared obs hub
+                # every group's node rebinds onto ONE "node" view, and
+                # summing per node would multiply the counts.
+                _tv = list({id(x.stats): x.stats for x in _tn}.values())
+                for f in ("txn_prepared", "txn_decided", "txn_aborted",
+                          "txn_resumed", "txn_lock_conflicts",
+                          "txn_epoch_aborts", "txn_batches"):
+                    st[f] = sum(v.get(f, 0) for v in _tv)
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
             refusals = getattr(daemon, "misdirect_refusals", None)
@@ -598,8 +644,12 @@ def make_client_batch_hook(daemon):
                 # apply position alone can be satisfied by a DIFFERENT
                 # entry after truncation.
                 if h.reply is not None:
-                    if daemon.elastic is not None \
-                            and h.reply.startswith(_REFUSED_PREFIX):
+                    if _txn_passthrough(h.reply):
+                        replies[i] = (wire.u8(wire.ST_OK)
+                                      + wire.u64(req_id)
+                                      + wire.blob(h.reply))
+                        return True
+                    if h.reply.startswith(_REFUSED_PREFIX):
                         replies[i] = _sentinel_bounce(
                             daemon, node, req_id, _d, h.reply)
                         return True
@@ -622,6 +672,10 @@ def make_client_batch_hook(daemon):
             if h.done:
                 if h.error:
                     replies[i] = wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+                elif _read_locked(h.reply):
+                    # Key under a prepared txn's buffered write.
+                    replies[i] = (wire.u8(ST_MIGRATING)
+                                  + wire.u64(req_id))
                 else:
                     if daemon.elastic is not None:
                         # Reply-time departed re-check (see clt_read).
@@ -803,7 +857,8 @@ class ApusClient:
     def __init__(self, peers: list[str], clt_id: Optional[int] = None,
                  timeout: float = 5.0, attempt_timeout: float = 2.0,
                  history=None, tracer=None,
-                 read_policy: str = "leader", groups: int = 1):
+                 read_policy: str = "leader", groups: int = 1,
+                 wrong_group_refuses: bool = False):
         self.peers = [self._parse(p) for p in peers]
         #: Multi-group routing (Multi-Raft): KVS ops hash their key to
         #: one of ``groups`` consensus groups (runtime/router.py) and
@@ -836,6 +891,13 @@ class ApusClient:
         #: the read falls back to the leader.  Writes always chase the
         #: leader regardless.
         self.read_policy = read_policy
+        #: WRONG_GROUP answers raise instead of transparently
+        #: re-routing to the owner group (the txn plane's driver
+        #: client: a 2PC record's group binding is PART OF THE
+        #: PROTOCOL — a prepare silently re-routed past a mid-2PC
+        #: split would lock keys at a group the coordinator's intent
+        #: record never names, and the close could never reach them).
+        self.wrong_group_refuses = wrong_group_refuses
         # Desynchronized start: clients constructed together must not
         # herd their spread reads onto the same replica each round.
         self._read_rotor = (secrets.randbits(16) % len(self.peers)
@@ -995,7 +1057,12 @@ class ApusClient:
         call (the server floors each read's wait index past the burst's
         earlier writes; it may additionally observe later writes that
         applied in the same commit window).  Ops routed to different
-        groups interleave freely — each group is an independent log.
+        groups interleave freely — each group is an independent log,
+        so a cross-group write-then-read pair in ONE burst carries no
+        ordering promise (tests/test_txn.py pins this at the wire);
+        callers needing cross-group read-your-write or atomic
+        visibility use :meth:`txn`, the stated cross-group
+        alternative.
         A multi-group burst splits per group and the sub-pipelines run
         CONCURRENTLY (each on its own (group, peer) connections),
         replies merged back in op order.  Failover-safe: unresolved
@@ -1270,6 +1337,164 @@ class ApusClient:
         return self._op(OP_CLT_WRITE, self._req_seq,
                         encode_delete(key), gid=self.group_of(key))
 
+    # -- typed replicated-data-type ops (PR 12) ---------------------------
+
+    def incr(self, key: bytes, delta: int = 1) -> int:
+        """Counter add (redis INCR/DECR/INCRBY); returns the NEW
+        value.  Rides the ordinary write path — typed state is an
+        ordinary store value in a canonical encoding."""
+        from apus_tpu.models.kvs import encode_incr
+        self._req_seq += 1
+        r = self._op(OP_CLT_WRITE, self._req_seq,
+                     encode_incr(key, delta), gid=self.group_of(key))
+        return int(r)
+
+    def getset(self, key: bytes, value: bytes) -> bytes:
+        """Set ``value``, return the OLD value (b"" if absent)."""
+        from apus_tpu.models.kvs import encode_getset
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq,
+                        encode_getset(key, value),
+                        gid=self.group_of(key))
+
+    def sadd(self, key: bytes, member: bytes) -> bool:
+        from apus_tpu.models.kvs import encode_sadd
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq,
+                        encode_sadd(key, member),
+                        gid=self.group_of(key)) == b"1"
+
+    def srem(self, key: bytes, member: bytes) -> bool:
+        from apus_tpu.models.kvs import encode_srem
+        self._req_seq += 1
+        return self._op(OP_CLT_WRITE, self._req_seq,
+                        encode_srem(key, member),
+                        gid=self.group_of(key)) == b"1"
+
+    def smembers(self, key: bytes) -> "set[bytes]":
+        from apus_tpu.models.kvs import encode_smembers, set_decode
+        self._req_seq += 1
+        return set_decode(self._op(OP_CLT_READ, self._req_seq,
+                                   encode_smembers(key),
+                                   gid=self.group_of(key)))
+
+    # -- transactions (PR 12; runtime/txn.py) ------------------------------
+
+    @staticmethod
+    def _encode_sub(sub) -> bytes:
+        from apus_tpu.models import kvs
+        op = sub[0]
+        key = sub[1]
+        arg = sub[2] if len(sub) > 2 else None
+        if op == "put":
+            return kvs.encode_put(key, arg)
+        if op == "get":
+            return kvs.encode_get(key)
+        if op == "delete":
+            return kvs.encode_delete(key)
+        if op == "incr":
+            return kvs.encode_incr(key, arg if arg is not None else 1)
+        if op == "getset":
+            return kvs.encode_getset(key, arg)
+        if op == "sadd":
+            return kvs.encode_sadd(key, arg)
+        if op == "srem":
+            return kvs.encode_srem(key, arg)
+        if op == "smembers":
+            return kvs.encode_smembers(key)
+        raise ValueError(f"unknown txn sub-op {op!r}")
+
+    def txn(self, subs) -> "list[bytes]":
+        """Atomic multi-key transaction: ``subs`` is a list of
+        ``(op, key[, arg])`` with op in {"put", "get", "delete",
+        "incr", "getset", "sadd", "srem", "smembers"}.  Returns the
+        per-sub reply bytes in order.
+
+        Atomic visibility ACROSS groups: keys hashing to one group
+        commit as ONE log entry; keys spanning groups ride the
+        replicated 2PC (runtime/txn.py) — this is the stated
+        cross-group alternative to pipelined read-your-write, which
+        remains a WITHIN-group contract.  Reads observe earlier
+        same-txn writes.  Exactly-once: the decision record carries
+        this client's (clt_id, req_id), deduped by the coordinator
+        group's endpoint DB; deterministic aborts (lock conflicts, a
+        split/merge racing the 2PC) retry under a FRESH req_id."""
+        from apus_tpu.models.kvs import unpack_replies
+        from apus_tpu.runtime.txn import (OP_TXN, ST_TXN_ABORTED,
+                                          encode_txn_subs)
+        cmds = [self._encode_sub(s) for s in subs]
+        blob = encode_txn_subs(cmds)
+        self._req_seq += 1
+        orig = req_id = self._req_seq
+        if self.history is not None:
+            self.history.invoke_txn(self.clt_id, orig, cmds)
+        # First target: the cached leader of the expected coordinator
+        # group (min participant gid under OUR map; the server replans
+        # under its own — NOT_LEADER hints re-aim us).
+        gids = {self.group_of(s[1]) for s in subs}
+        target = self._gleader(min(gids)) if gids else None
+        deadline = time.monotonic() + self.timeout
+        rng_backoff = 0.01
+        try:
+            while time.monotonic() < deadline:
+                if target is None:
+                    target = self._probe_any(deadline)
+                    if target is None:
+                        continue
+                payload = (wire.u8(OP_TXN) + wire.u64(req_id)
+                           + wire.u64(self.clt_id) + wire.blob(blob))
+                resp = self._roundtrip(target, payload, deadline,
+                                       req_id)
+                if resp is None:
+                    target = self._next(target)
+                    continue
+                st = resp[0]
+                if st == wire.ST_OK:
+                    reply = wire.Reader(resp[9:]).blob()
+                    rets = [r for _p, r in
+                            sorted(unpack_replies(reply))]
+                    if self.history is not None:
+                        self.history.complete_txn(self.clt_id, orig,
+                                                  "ok", rets)
+                    return rets
+                if st == ST_NOT_LEADER:
+                    hint = wire.Reader(resp[9:]).blob().decode() \
+                        if len(resp) > 9 else ""
+                    target = self._peer_index(hint) if hint \
+                        else self._next(target)
+                    time.sleep(0.01)
+                    continue
+                if st == ST_TXN_ABORTED or st == ST_WRONG_GROUP \
+                        or st == ST_MIGRATING:
+                    # Deterministic refusal — nothing applied
+                    # anywhere; retry the WHOLE transaction under a
+                    # fresh req_id (jittered: lock-conflict livelock
+                    # is broken by desynchronized retries).
+                    if st == ST_WRONG_GROUP:
+                        self._learn_map(resp)
+                    self._req_seq += 1
+                    req_id = self._req_seq
+                    time.sleep(rng_backoff
+                               * (0.5 + secrets.randbits(8) / 256.0))
+                    rng_backoff = min(0.16, rng_backoff * 2)
+                    continue
+                if st == ST_TIMEOUT:
+                    target = self._next(target)
+                    continue
+                if self.history is not None:
+                    self.history.complete_txn(self.clt_id, orig,
+                                              "error")
+                raise RuntimeError(f"txn refused (status {st})")
+        except BaseException:
+            if self.history is not None:
+                self.history.complete_txn(self.clt_id, orig,
+                                          "ambiguous")
+            raise
+        if self.history is not None:
+            self.history.complete_txn(self.clt_id, orig, "ambiguous")
+        raise TimeoutError(
+            f"txn {orig} not decided in {self.timeout}s")
+
     # -- internals --------------------------------------------------------
 
     def _op(self, op: int, req_id: int, data: bytes,
@@ -1367,6 +1592,8 @@ class ApusClient:
                 time.sleep(0.02)
                 continue
             if st == ST_WRONG_GROUP:
+                if self.wrong_group_refuses:
+                    raise RuntimeError("wrong_group")
                 owner, repoch = self._learn_map(resp)
                 if self.shard is not None \
                         and repoch < self.shard.epoch:

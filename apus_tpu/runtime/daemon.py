@@ -313,6 +313,14 @@ class ReplicaDaemon:
             self.elastic = ElasticPlane(self)
             self.server._extra_ops.update(make_elastic_ops(self))
 
+        # Transaction plane (runtime/txn.py): the OP_TXN service runs
+        # on EVERY daemon (single-group MULTI batches are one TM log
+        # entry, no 2PC); the cross-group coordinator/recovery driver
+        # starts only with the multi-group runtime.
+        from apus_tpu.runtime.txn import TxnPlane, make_txn_ops
+        self.txn = TxnPlane(self)
+        self.server._extra_ops.update(make_txn_ops(self))
+
         # Device plane (runtime.device_plane): the jitted commit step as
         # the primary replication/quorum engine, host TCP as control
         # plane + catch-up (the RC-data/UD-control split of the
@@ -408,6 +416,11 @@ class ReplicaDaemon:
             # comes to lead (leader kill mid-migration moves the driver
             # with the leadership).
             self.elastic.start()
+        if self.txn is not None and self.groupset is not None:
+            # 2PC recovery driver: resumes any open coordinator txn
+            # this daemon comes to lead (same idiom as the elastic
+            # driver — a coordinator kill mid-2PC moves the driver).
+            self.txn.start()
         # Arm any loaded fault schedule now that the daemon serves —
         # schedule time 0 is "daemon up", not "object constructed".
         if hasattr(self.transport, "arm"):
@@ -416,6 +429,8 @@ class ReplicaDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.txn is not None:
+            self.txn.stop()
         if self.elastic is not None:
             self.elastic.stop()
         if self.device_driver is not None:

@@ -186,23 +186,31 @@ class ElasticPlane:
         the map; ("migrating",) = bucket frozen mid-migration, client
         retries shortly.  Reads on FROZEN buckets serve (values cannot
         change anywhere until the flip; the reply-time ``departed``
-        re-check guards the flip itself)."""
+        re-check guards the flip itself).  Multi-key commands (TM
+        batches, TP prepares) check EVERY key — the whole command is
+        admitted only where every key is owned."""
         if self.dirty:
             self._recompute()
         if not self.active:
             return None
-        from apus_tpu.models.kvs import RESERVED_PREFIX, decode_key
-        key = decode_key(data)
-        if key is None or key.startswith(RESERVED_PREFIX):
+        from apus_tpu.models.kvs import (RESERVED_PREFIX, cmd_is_read,
+                                         decode_keys)
+        keys = decode_keys(data)
+        if not keys:
             return None
-        b = bucket_of_key(key)
-        owner = self._map.assign[b]
-        if owner != node.gid:
-            node.bump("wrong_group_hints")
-            return ("wrong_group", owner)
-        if data[:1] != b"G" and b in getattr(node.sm, "_frozen", ()):
-            node.bump("migrating_refusals")
-            return ("migrating",)
+        is_read = cmd_is_read(data)
+        frozen = getattr(node.sm, "_frozen", ())
+        for key in keys:
+            if key.startswith(RESERVED_PREFIX):
+                continue
+            b = bucket_of_key(key)
+            owner = self._map.assign[b]
+            if owner != node.gid:
+                node.bump("wrong_group_hints")
+                return ("wrong_group", owner)
+            if not is_read and b in frozen:
+                node.bump("migrating_refusals")
+                return ("migrating",)
         return None
 
     def departed(self, node, data: bytes) -> "tuple | None":
@@ -214,14 +222,15 @@ class ElasticPlane:
             self._recompute()
         if not self.active:
             return None
-        from apus_tpu.models.kvs import RESERVED_PREFIX, decode_key
-        key = decode_key(data)
-        if key is None or key.startswith(RESERVED_PREFIX):
-            return None
-        owner = self._map.assign[bucket_of_key(key)]
-        if owner != node.gid:
-            node.bump("wrong_group_hints")
-            return ("wrong_group", owner)
+        from apus_tpu.models.kvs import RESERVED_PREFIX, decode_keys
+        keys = decode_keys(data)
+        for key in keys or ():
+            if key.startswith(RESERVED_PREFIX):
+                continue
+            owner = self._map.assign[bucket_of_key(key)]
+            if owner != node.gid:
+                node.bump("wrong_group_hints")
+                return ("wrong_group", owner)
         return None
 
     # -- status / scrape ----------------------------------------------------
@@ -453,7 +462,25 @@ def make_elastic_ops(daemon) -> dict:
             if dst_req is None:
                 if len(owned) < 2:
                     return _refused(b"too_few_buckets")
-                dst = daemon.n_groups
+                # Prefer an EXISTING empty dynamic group over a fresh
+                # gid: a split whose MB raced a txn write-lock (apply-
+                # time REFUSED, retried) has already created its dst
+                # locally — always allocating anew leaked one orphan
+                # group per refused attempt (trial 28101: nine groups
+                # where eight belonged).  Empty = owns no buckets and
+                # is not the dst of an in-flight (frozen) migration;
+                # merged-away groups qualify too (bucket return is a
+                # supported ownership chain).
+                static_n = max(1, int(getattr(daemon.spec, "groups",
+                                              1) or 1))
+                busy = {rec[0] for _g, n2 in plane._nodes()
+                        for rec in getattr(n2.sm, "migs_out",
+                                           {}).values()
+                        if rec[2] == "frozen"}
+                dst = next(
+                    (g for g in range(static_n, daemon.n_groups)
+                     if not m.owned(g) and g not in busy),
+                    daemon.n_groups)
                 if dst >= MAX_GROUPS:
                     return _refused(b"group_cap")
                 buckets = ShardMap.split_buckets(owned)
@@ -464,6 +491,18 @@ def make_elastic_ops(daemon) -> dict:
                 if not owned:
                     return _refused(b"src_owns_nothing")
                 buckets = owned
+            locks = getattr(node.sm, "_locks", None)
+            if locks:
+                # Open prepared transaction write-locking a key in the
+                # bucket set: the freeze must wait (submit-time check,
+                # BEFORE the dst group is created — the apply-time
+                # REFUSED in models/kvs.py stays as the backstop for
+                # entries that raced a leader change, but refusing
+                # here avoids allocating an orphan dst gid per retry).
+                bset = set(buckets)
+                for k, lk in locks.items():
+                    if lk[1] == "w" and bucket_of_key(k) in bset:
+                        return _refused(b"txn_locked", transient=True)
             epoch = m.epoch + 1
             mig_id = (epoch << 8) | src
             csize = cmask = 0
@@ -489,6 +528,14 @@ def make_elastic_ops(daemon) -> dict:
         with daemon.commit_cond:
             while True:
                 if pr.reply is not None:
+                    from apus_tpu.models.sm import REFUSED_REPLY_PREFIX
+                    if pr.reply.startswith(REFUSED_REPLY_PREFIX):
+                        # MB deferred: a write-locked key (open
+                        # prepared transaction) sits in the bucket set
+                        # — the freeze must wait for the txn to
+                        # resolve (models/kvs.py MB apply).  Transient
+                        # typed refusal; request_split retries.
+                        return _refused(b"txn_locked", transient=True)
                     return (wire.u8(wire.ST_OK) + wire.u64(mig_id)
                             + wire.u8(dst) + wire.u32(epoch))
                 if not node.is_leader:

@@ -1,0 +1,560 @@
+"""Cross-group atomic transactions (2PC over the groups' own logs).
+
+PR 12's second tentpole piece: the "Reconfigurable Atomic Transaction
+Commit" discipline (PAPERS.md) made concrete — an atomic-commit
+protocol whose EVERY decision lives in a replicated log, so it
+survives the failure of whoever drove it, and whose every fence is a
+config/shard-map epoch, so reconfiguration and a concurrent
+SPLIT/MERGE mid-2PC abort or complete cleanly instead of wedging or
+double-applying.
+
+Protocol (records encoded in models/kvs.py; all idempotent by the
+transaction id = the originating client's (clt_id, req_id)):
+
+    TB  (coordinator group's log)   the durable intent: participant
+        gids + each group's sub-ops, replicated BEFORE any prepare is
+        sent — whoever comes to lead the coordinator group resumes the
+        transaction (elastic.py-driver style; a coordinator SIGKILL
+        between PREPARE and DECIDED just moves the driver).
+    TP  (each participant group's log)   prepare: lock the keys
+        (exclusive 2PL — write-locked keys refuse reads too), evaluate
+        the sub-ops against the locked state and record replies +
+        buffered writes.  Locks live in the SM, mirrored through
+        snapshots/deltas/restart replay, so prepared state survives
+        leader kills AND whole-quorum SIGKILLs.  Deterministic
+        refusals (frozen/departed bucket, lock conflict) are
+        REFUSED_TX-prefixed — never dedup-noted, passed through to the
+        driver verbatim.
+    TD  (coordinator group's log)   THE decision point: first TD in
+        the coordinator log's order wins on every replica.  Submitted
+        under the CLIENT's identity, so a commit's apply-time reply is
+        epdb-noted exactly like a single op's — the whole cross-group
+        transaction inherits exactly-once from the ordinary dedup
+        machinery (aborts return a REFUSED sentinel, never noted; the
+        client retries under a fresh req_id).
+    TC/TA  (participant logs)   install the buffered writes / drop
+        them; release the locks either way.  TA for an unknown txn
+        records an aborted tombstone so a straggler TP from an
+        abandoned driver attempt can never lock keys post-decision.
+    TF  (coordinator log)   every participant acked its close — stop
+        re-driving (tombstone, pruned).
+
+Why split/merge cannot race a 2PC into a wedge or a double-apply: the
+freeze record (MB) and the prepare (TP) serialize through the SAME
+per-group log — MB defers (deterministic REFUSED, elastic driver
+retries) while any write-locked key sits in its bucket set, and TP
+refuses on frozen/departed buckets (the coordinator aborts and the
+client retries against the fresh map).  Mutual exclusion through log
+order, no cross-plane locks.
+
+Client surface: ``ApusClient.txn([...])`` ships the whole sub-op list
+to the coordinator (OP_TXN, a top-level op — the SERVER plans the
+grouping against its own shard map).  Single-group transactions
+bypass 2PC entirely: one TM log entry gives atomic visibility for
+free from log order.  This is also the stated CROSS-GROUP alternative
+to pipelined read-your-write, which remains a within-group contract
+(DESIGN.md "Transactions & replicated data types").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Optional
+
+from apus_tpu.models.kvs import (REFUSED_TX, TXN_REPLY_MAGIC,
+                                 _dec_subs, encode_txn_abort,
+                                 encode_txn_begin, encode_txn_commit,
+                                 encode_txn_decide, encode_txn_finish,
+                                 encode_txn_multi, encode_txn_prepare,
+                                 parse_txn_key, txn_key,
+                                 unpack_replies)
+from apus_tpu.parallel import wire
+
+#: client op: submit a whole transaction (top-level — never
+#: group-wrapped; the payload's keys decide the participant groups)
+OP_TXN = 31
+
+#: typed bounce: the transaction was DECIDED ABORT (deterministic —
+#: nothing applied anywhere); the client retries under a fresh req_id
+ST_TXN_ABORTED = 10
+
+
+def encode_txn_subs(cmds) -> bytes:
+    """Client-side sub-op list -> OP_TXN payload blob."""
+    from apus_tpu.models.kvs import _enc_subs
+    return _enc_subs(list(enumerate(cmds)))
+
+
+def decode_txn_subs(blob: bytes) -> "list[bytes]":
+    subs, _ = _dec_subs(blob, 0)
+    return [c for _p, c in sorted(subs)]
+
+
+def _is_read(cmd: bytes) -> bool:
+    from apus_tpu.models.kvs import cmd_is_read
+    return cmd_is_read(cmd)
+
+
+class TxnPlane:
+    """Per-daemon transaction plane: the OP_TXN service plus the
+    recovery DRIVER — a watchdog thread that resumes any open
+    coordinator transaction whose group this daemon currently leads
+    (a coordinator kill mid-2PC moves the driver with the
+    leadership; every step is idempotent)."""
+
+    #: an open txn older than this (first seen by THIS driver) is
+    #: adopted by the background pass — the inline fast path in the
+    #: client handler normally resolves far sooner
+    RESUME_AGE = 0.5
+    #: an open txn the driver cannot collect prepares for within this
+    #: window is decided ABORT (a dead participant group blocks only
+    #: its own transactions, and only this long)
+    ABORT_AGE = 8.0
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # PER-THREAD driver clients (the inline fast path runs on
+        # per-connection server threads, the recovery driver on its
+        # own): the endpoint-DB dedup is MONOTONE per client id, so
+        # two concurrent transactions sharing one identity could have
+        # a delayed prepare's apply deduped against the other's later
+        # req — and answered with the WRONG reply (observed as
+        # "badreply" aborts + wedged prepared participants before
+        # this was per-thread).
+        self._tl = threading.local()
+        self._clts: list = []
+        self._clts_lock = threading.Lock()
+        # Driver-submitted records (TB/TF and the participant-side
+        # TP/TC/TA) ride the normal client-write path under a
+        # plane-owned identity; TD alone carries the CLIENT's identity
+        # (see module docstring).
+        self._sys_clt = secrets.randbits(62) | (1 << 61)
+        self._sys_req = 0
+        self._sys_lock = threading.Lock()
+        #: tk -> first-seen monotonic (age for resume/abort decisions)
+        self._seen: dict[str, float] = {}
+        #: tks this plane instance BEGAN (an adopted one it didn't is
+        #: a RESUMED txn — the mid-2PC takeover evidence)
+        self._started: set[str] = set()
+        #: tks currently being driven by some thread of this plane
+        self._driving: set[str] = set()
+        self._drv_lock = threading.Lock()
+        # Nemesis window widener (benchmarks/fuzz.py --txn): hold the
+        # 2PC between collected prepares and the decide record for
+        # this many seconds, so a seeded coordinator SIGKILL lands
+        # mid-2PC deterministically often.  0 (default) = off.
+        try:
+            self.prep_hold = float(
+                os.environ.get("APUS_TXN_PREP_HOLD", "0") or 0)
+        except ValueError:
+            self.prep_hold = 0.0
+
+    def _next_req(self) -> int:
+        with self._sys_lock:
+            self._sys_req += 1
+            return self._sys_req
+
+    # -- planning (under the daemon lock) -----------------------------------
+
+    def plan(self, cmds: "list[bytes]"):
+        """Sub-op commands -> ({gid: [(pos, cmd)]}, map_epoch), or
+        None for an unroutable payload.  Grouping uses THIS daemon's
+        derived shard map — the freshest view it can have; a stale
+        grouping is caught by the participants' own fences (prepare
+        refuses on departed/frozen) and aborts cleanly."""
+        from apus_tpu.models.kvs import decode_key
+        d = self.daemon
+        shard = (d.elastic.shard_map() if d.elastic is not None
+                 else None)
+        groups: dict[int, list] = {}
+        for pos, c in enumerate(cmds):
+            key = decode_key(c)
+            if key is None:
+                return None
+            if shard is not None:
+                gid = shard.group_of_key(key)
+            elif d.n_groups > 1:
+                from apus_tpu.runtime.router import group_of_key
+                gid = group_of_key(key, d.n_groups)
+            else:
+                gid = 0
+            groups.setdefault(gid, []).append((pos, c))
+        epoch = shard.epoch if shard is not None else 0
+        return groups, epoch
+
+    # -- observability -------------------------------------------------------
+
+    def _tnote(self, msg: str, **fields) -> None:
+        if self.daemon.obs is not None:
+            self.daemon.obs.flight.note("txn", msg, **fields)
+
+    def txns_view(self) -> dict:
+        """OP_STATUS view: every unresolved transaction any local SM
+        knows — open/decided coordinator records and prepared
+        participant records with their lock counts (the failure dumps
+        attach this beside the groups/router views).  Caller holds
+        the daemon lock."""
+        coord, prepared = [], []
+        for gid, node in self._nodes():
+            sm = node.sm
+            for tk, rec in getattr(sm, "txns_coord", {}).items():
+                if rec[0] != "done":
+                    coord.append({"txn": tk, "gid": gid,
+                                  "state": rec[0], "epoch": rec[1]})
+            for tk, rec in getattr(sm, "txns_in", {}).items():
+                if rec[2] == "prepared":
+                    prepared.append({"txn": tk, "gid": gid,
+                                     "coord": rec[0], "epoch": rec[1]})
+        locks = sum(len(getattr(n.sm, "_locks", ()) or ())
+                    for _g, n in self._nodes())
+        return {"coord_open": coord, "prepared": prepared,
+                "locked_keys": locks}
+
+    def _nodes(self):
+        d = self.daemon
+        if d.groupset is not None:
+            return list(enumerate(d.groupset.nodes))
+        return [(0, d.node)]
+
+    # -- recovery driver -----------------------------------------------------
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._run, daemon=True,
+                             name=f"apus-txn-{self.daemon.idx}")
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self._clts_lock:
+            clts, self._clts = self._clts, []
+        for c in clts:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(0.1):
+            try:
+                self._pass()
+            except Exception:                     # noqa: BLE001
+                self.daemon.logger.exception("txn driver pass failed")
+
+    def _pass(self) -> None:
+        """Adopt every unresolved coordinator transaction whose group
+        this daemon currently leads."""
+        d = self.daemon
+        now = time.monotonic()
+        work = []
+        with d.lock:
+            live = set()
+            for gid, node in self._nodes():
+                if not node.is_leader:
+                    continue
+                for tk, rec in getattr(node.sm, "txns_coord",
+                                       {}).items():
+                    if rec[0] == "done":
+                        continue
+                    live.add(tk)
+                    first = self._seen.setdefault(tk, now)
+                    if rec[0] != "open" \
+                            or now - first >= self.RESUME_AGE:
+                        work.append((gid, node, tk))
+            for tk in [t for t in self._seen if t not in live]:
+                self._seen.pop(tk, None)
+                self._started.discard(tk)
+        for gid, node, tk in work:
+            if self._stop.is_set():
+                return
+            self.drive(tk, gid, node)
+
+    # -- the 2PC drive (idempotent; inline fast path + recovery) ------------
+
+    def drive(self, tk: str, gid: int, node) -> None:
+        with self._drv_lock:
+            if tk in self._driving:
+                return
+            self._driving.add(tk)
+        if tk not in self._started:
+            # Adopting a transaction THIS plane did not begin — the
+            # mid-2PC takeover evidence (coordinator kill between
+            # PREPARE and DECIDED; the new leader resumes it).
+            node.bump("txn_resumed")
+            self._tnote("resumed", txn=tk, gid=gid)
+            self._started.add(tk)
+        try:
+            self._drive_txn(tk, gid, node)
+        finally:
+            with self._drv_lock:
+                self._driving.discard(tk)
+
+    def _drive_txn(self, tk: str, gid: int, node) -> None:
+        d = self.daemon
+        clt, req = parse_txn_key(tk)
+        with d.lock:
+            rec = node.sm.txns_coord.get(tk)
+            if rec is None or rec[0] == "done":
+                return
+            state, epoch = rec[0], rec[1]
+            groups = {int(g): _dec_subs(s.encode("latin-1"), 0)[0]
+                      for g, s in json.loads(rec[2]).items()}
+        obs = d.obs
+        sp = obs.spans if obs is not None else None
+        if state == "open":
+            replies: dict[int, bytes] = {}
+            outcome = True
+            reason = b""
+            if sp is not None and sp.sampled(req):
+                sp.stamp(clt, req, "txn_prepare")
+            for pgid in sorted(groups):
+                resp = self._group_write(
+                    pgid, encode_txn_prepare(clt, req, gid, epoch,
+                                             groups[pgid]))
+                if resp is None:
+                    # Participant unreachable: retry on a later pass
+                    # (its prepared state, if any, is idempotent) —
+                    # abort only past the blocking window.
+                    age = time.monotonic() - self._seen.get(
+                        tk, time.monotonic())
+                    if age < self.ABORT_AGE:
+                        return
+                    outcome, reason = False, b"unreachable"
+                    break
+                if resp.startswith(REFUSED_TX):
+                    outcome = False
+                    reason = resp[len(REFUSED_TX):]
+                    break
+                if not resp.startswith(TXN_REPLY_MAGIC):
+                    outcome, reason = False, b"badreply"
+                    break
+                node.bump("txn_prepared")
+                replies.update(dict(unpack_replies(resp)))
+            if self.prep_hold:
+                time.sleep(self.prep_hold)
+            if not outcome:
+                if reason == b"locked":
+                    node.bump("txn_lock_conflicts")
+                elif reason in (b"frozen", b"departed"):
+                    node.bump("txn_epoch_aborts")
+            from apus_tpu.models.kvs import pack_replies
+            blob = pack_replies(sorted(replies.items())) if outcome \
+                else b""
+            # TD under the CLIENT's identity: apply notes the epdb for
+            # (clt, req) with the assembled reply — exactly-once for
+            # the whole transaction via the ordinary dedup machinery.
+            with d.lock:
+                if not node.is_leader:
+                    return
+                pr = node.submit(req, clt,
+                                 encode_txn_decide(clt, req, outcome,
+                                                   blob))
+                if pr is None:
+                    return
+                node.flush_pending()
+            deadline = time.monotonic() + 5.0
+            with d.commit_cond:
+                while pr.reply is None:
+                    if not node.is_leader \
+                            or time.monotonic() >= deadline:
+                        return            # retried on a later pass
+                    d.commit_cond.wait(0.25)
+            node.bump("txn_decided" if outcome else "txn_aborted")
+            if sp is not None and sp.sampled(req):
+                sp.stamp(clt, req, "txn_decide")
+            self._tnote("decided", txn=tk,
+                       outcome="commit" if outcome else "abort",
+                       reason=reason.decode("latin-1", "replace"))
+            state = "committed" if outcome else "aborted"
+        if state in ("committed", "aborted"):
+            close = (encode_txn_commit if state == "committed"
+                     else encode_txn_abort)
+            for pgid in sorted(groups):
+                if self._group_write(pgid, close(clt, req)) != b"OK":
+                    return                # retried on a later pass
+            with d.lock:
+                if not node.is_leader:
+                    return
+                pr = node.submit(self._next_req(), self._sys_clt,
+                                 encode_txn_finish(clt, req))
+                if pr is not None:
+                    node.flush_pending()
+            self._tnote("closed", txn=tk, state=state)
+
+    def _group_write(self, gid: int,
+                     data: bytes) -> "bytes | None":
+        """One replicated write into group ``gid`` through the
+        ordinary client path (leader chase + exactly-once under the
+        plane identity).  Returns the reply bytes — including
+        REFUSED_TX-prefixed refusals, which the client service passes
+        through verbatim — or None on timeout/unreachable."""
+        from apus_tpu.runtime.client import OP_CLT_WRITE, ApusClient
+        c = getattr(self._tl, "clt", None)
+        if c is None:
+            c = ApusClient([p for p in self.daemon.spec.peers if p],
+                           clt_id=secrets.randbits(62) | (1 << 61),
+                           timeout=6.0, attempt_timeout=2.0,
+                           wrong_group_refuses=True)
+            self._tl.clt = c
+            with self._clts_lock:
+                self._clts.append(c)
+        try:
+            c._req_seq += 1
+            return c._op(OP_CLT_WRITE, c._req_seq, data, gid=gid)
+        except RuntimeError as e:
+            if "wrong_group" in str(e):
+                # The record's target group no longer owns the keys (a
+                # split/merge committed mid-2PC): a deterministic
+                # epoch-fence refusal — the coordinator aborts and the
+                # client replans against the fresh map.
+                return REFUSED_TX + b"departed"
+            return None
+        except (TimeoutError, OSError, ConnectionError):
+            return None
+
+
+# -- daemon-side client op ---------------------------------------------------
+
+def make_txn_ops(daemon) -> dict:
+    from apus_tpu.models.sm import REFUSED_REPLY_PREFIX
+    from apus_tpu.runtime.client import (ST_MIGRATING, ST_TIMEOUT,
+                                         _elastic_bounce, _not_leader)
+
+    plane = daemon.txn
+
+    def clt_txn(r: wire.Reader) -> bytes:
+        req_id, clt_id = r.u64(), r.u64()
+        cmds = decode_txn_subs(r.blob())
+        obs = daemon.obs
+        sp = obs.spans if obs is not None else None
+        traced = sp is not None and sp.sampled(req_id)
+        if traced:
+            sp.stamp(clt_id, req_id, "ingest")
+        with daemon.lock:
+            planned = plane.plan(cmds)
+            if planned is None or not cmds:
+                return wire.u8(wire.ST_ERROR) + wire.u64(req_id)
+            groups, epoch = planned
+            coord_gid = min(groups)
+            node = daemon.group_node(coord_gid)
+            if node is None or not node.is_leader:
+                return _not_leader(daemon, req_id,
+                                   node=node or daemon.node)
+            if traced:
+                sp.stamp(clt_id, req_id, "lock")
+            el = daemon.elastic
+            tk = txn_key(clt_id, req_id)
+            dup = node.epdb.duplicate_of_applied(clt_id, req_id)
+            if dup is not None and dup.last_req_id == req_id:
+                return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                        + wire.blob(dup.last_reply or b""))
+            if len(groups) == 1:
+                # WITHIN-GROUP fast path: one TM log entry, atomic
+                # visibility from log order — no 2PC, no locks.
+                data = encode_txn_multi(cmds)
+                if el is not None and dup is None:
+                    v = el.admit(node, data)
+                    if v is not None:
+                        return _elastic_bounce(daemon, node, req_id,
+                                               v)
+                pr = node.submit(req_id, clt_id, data)
+                if pr is None:
+                    return _not_leader(daemon, req_id, node=node)
+                node.flush_pending()
+                mode = "multi"
+            else:
+                # CROSS-GROUP: replicate the durable TB intent, then
+                # drive the 2PC inline (the recovery driver adopts it
+                # if this handler/daemon dies mid-protocol).
+                if node.sm.txns_coord.get(tk) is None:
+                    pr0 = node.submit(
+                        plane._next_req(), plane._sys_clt,
+                        encode_txn_begin(clt_id, req_id, epoch,
+                                         groups))
+                    if pr0 is None:
+                        return _not_leader(daemon, req_id, node=node)
+                    node.flush_pending()
+                    plane._started.add(tk)
+                    plane._seen.setdefault(tk, time.monotonic())
+                    plane._tnote("begin", txn=tk, groups=len(groups))
+                pr = None
+                mode = "2pc"
+        deadline = time.monotonic() + daemon.client_op_timeout
+        if mode == "multi":
+            node.bump("txn_batches")
+            n_writes = sum(1 for c0 in cmds
+                           if not _is_read(c0))
+            with daemon.commit_cond:
+                while True:
+                    if pr.reply is not None:
+                        if pr.reply.startswith(REFUSED_REPLY_PREFIX):
+                            # Raced a leader change past an unapplied
+                            # migration/lock record and no-op'd: typed
+                            # bounce, exactly as the single-op path.
+                            if daemon.elastic is not None:
+                                from apus_tpu.runtime.client import \
+                                    _sentinel_bounce
+                                return _sentinel_bounce(
+                                    daemon, node, req_id, cmds[0],
+                                    pr.reply)
+                            return (wire.u8(ST_MIGRATING)
+                                    + wire.u64(req_id))
+                        if traced:
+                            sp.stamp(clt_id, req_id, "reply",
+                                     idx=pr.idx)
+                            sp.finish(clt_id, req_id)
+                        # Same per-group write service-capacity gate
+                        # as the single-op/batch paths (bench.py
+                        # methodology) — a TM batch pays per write.
+                        from apus_tpu.runtime.client import \
+                            _wsvc_emulate
+                        _wsvc_emulate(daemon, node.gid, n_writes)
+                        return (wire.u8(wire.ST_OK) + wire.u64(req_id)
+                                + wire.blob(pr.reply))
+                    if not node.is_leader:
+                        return _not_leader(daemon, req_id, node=node)
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
+                    daemon.commit_cond.wait(min(left, 0.25))
+        # 2PC: wait for TB to apply, drive inline, then wait for the
+        # decision (TD apply notes the epdb / flips the record state).
+        with daemon.commit_cond:
+            while node.sm.txns_coord.get(tk) is None:
+                if not node.is_leader:
+                    return _not_leader(daemon, req_id, node=node)
+                if time.monotonic() >= deadline:
+                    return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
+                daemon.commit_cond.wait(0.25)
+        plane.drive(tk, coord_gid, node)
+        with daemon.commit_cond:
+            while True:
+                rec = node.sm.txns_coord.get(tk)
+                if rec is not None:
+                    if rec[0] in ("committed", "done") \
+                            and rec[3] is not None:
+                        reply = rec[3].encode("latin-1")
+                        if traced:
+                            sp.stamp(clt_id, req_id, "reply")
+                            sp.finish(clt_id, req_id)
+                        return (wire.u8(wire.ST_OK)
+                                + wire.u64(req_id) + wire.blob(reply))
+                    if rec[0] == "aborted" or (rec[0] == "done"
+                                               and rec[3] is None):
+                        return (wire.u8(ST_TXN_ABORTED)
+                                + wire.u64(req_id))
+                if not node.is_leader:
+                    return _not_leader(daemon, req_id, node=node)
+                if time.monotonic() >= deadline:
+                    return wire.u8(ST_TIMEOUT) + wire.u64(req_id)
+                daemon.commit_cond.wait(0.25)
+
+    return {OP_TXN: clt_txn}

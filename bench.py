@@ -1197,6 +1197,147 @@ def _bench_throughput_groups(groups_list) -> None:
     print(json.dumps(result), flush=True)
 
 
+def _bench_txn() -> None:
+    """--txn mode: transaction throughput — single-group MULTI batches
+    vs cross-group 2PC cost (PR 12), under the SAME per-group write
+    service-capacity gate as the multi-group ladder (every rung pays
+    APUS_WRITE_SVC_US per write at its group's leader; the 2PC rung
+    additionally pays its prepare/commit records there, so the
+    reported ratio IS the protocol's cost under the deployment model
+    the gate emulates).  The group-major device plane runs throughout
+    and the recompile sentinel must read zero — transaction records
+    are ordinary log entries, no new dispatch shapes.
+
+    Env knobs: APUS_TXN_CLIENTS (8), APUS_TXN_SECONDS (3.0),
+    APUS_TXN_WSVC_MS (1.5)."""
+    import dataclasses
+    import threading
+
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.router import group_of_key
+    from apus_tpu.utils.config import ClusterSpec
+
+    P = int(os.environ.get("APUS_TXN_CLIENTS", "8"))
+    seconds = float(os.environ.get("APUS_TXN_SECONDS", "3.0"))
+    R = 3
+    wsvc_ms = float(os.environ.get("APUS_TXN_WSVC_MS", "1.5"))
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150, groups=2)
+    os.environ["APUS_WRITE_SVC_US"] = str(int(wsvc_ms * 1000))
+    k_of = {g: [k for k in (b"b%d" % i for i in range(64))
+                if group_of_key(k, 2) == g][:16] for g in (0, 1)}
+    rungs: dict[str, dict] = {}
+    try:
+        with LocalCluster(R, spec=spec, groups=2, device_plane=True,
+                          device_batch=16, group_major=True) as c:
+            c.wait_for_group_leaders(timeout=30.0)
+            peers = list(c.spec.peers)
+            from apus_tpu.runtime.device_plane import \
+                unexpected_compiles
+            for mode, label in (("multi", "single-group MULTI batch"),
+                                ("2pc", "cross-group 2PC")):
+                done = [0] * P
+                fails = [0] * P
+                stop_at = time.monotonic() + seconds
+
+                def worker(w, mode=mode, stop_at=stop_at):
+                    with ApusClient(peers, groups=2, timeout=30.0,
+                                    attempt_timeout=10.0) as cl:
+                        i = 0
+                        while time.monotonic() < stop_at:
+                            i += 1
+                            g = (w + i) % 2
+                            ks = k_of[g]
+                            try:
+                                if mode == "multi":
+                                    # 4 writes, ONE group, one TM
+                                    # entry.
+                                    cl.txn([
+                                        ("put", ks[(i + j) % len(ks)],
+                                         b"v%d" % i)
+                                        for j in range(4)])
+                                    done[w] += 4
+                                else:
+                                    # 2 writes SPANNING groups: the
+                                    # replicated 2PC.
+                                    cl.txn([
+                                        ("put",
+                                         k_of[0][(w + i) % 16],
+                                         b"v%d" % i),
+                                        ("put",
+                                         k_of[1][(w + i) % 16],
+                                         b"v%d" % i)])
+                                    done[w] += 2
+                            except (TimeoutError, RuntimeError):
+                                fails[w] += 1
+                                if fails[w] > 5:
+                                    return
+
+                _mark(f"txn rung '{label}': {P} clients, "
+                      f"{seconds:.1f}s, write-svc {wsvc_ms:.2f} "
+                      f"ms/op/group")
+                t0 = time.monotonic()
+                threads = [threading.Thread(target=worker, args=(w,))
+                           for w in range(P)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                elapsed = time.monotonic() - t0
+                sweep = {f: 0 for f in ("txn_decided", "txn_batches",
+                                        "txn_aborted",
+                                        "txn_lock_conflicts")}
+                for addr in peers:
+                    st = probe_status(addr, timeout=2.0) or {}
+                    for f in sweep:
+                        sweep[f] += st.get(f, 0) or 0
+                rungs[mode] = {
+                    "label": label,
+                    "write_subs_per_sec": round(sum(done) / elapsed,
+                                                1),
+                    "txns_per_sec": round(
+                        sum(done) / (4 if mode == "multi" else 2)
+                        / elapsed, 1),
+                    "elapsed_s": round(elapsed, 3),
+                    "client_failures": sum(fails),
+                    "counters": sweep,
+                }
+                _mark(f"  {label}: "
+                      f"{rungs[mode]['txns_per_sec']:.0f} txns/s "
+                      f"({rungs[mode]['write_subs_per_sec']:.0f} "
+                      f"write subs/s)")
+            sentinel = unexpected_compiles()
+    finally:
+        os.environ.pop("APUS_WRITE_SVC_US", None)
+    multi = rungs["multi"]["txns_per_sec"] or 1.0
+    cross = rungs["2pc"]["txns_per_sec"]
+    result = {
+        "metric": f"txn_throughput_{P}c_{R}rep",
+        "value": cross,
+        "unit": "cross-group txns/s",
+        "vs_baseline": round(cross / multi, 3),
+        "detail": {
+            "mode": "txn",
+            "replicas": R, "clients": P,
+            "seconds_per_rung": seconds,
+            "emulated_write_svc_ms": wsvc_ms,
+            "rungs": rungs,
+            "single_group_txns_per_sec": multi,
+            "cross_group_2pc_txns_per_sec": cross,
+            "cost_ratio_2pc_vs_multi": round(multi / max(cross, 0.1),
+                                             2),
+            "recompile_sentinel": sentinel,
+            "note": ("both rungs pay the identical per-group write "
+                     "service gate; the 2PC rung's extra TP/TC "
+                     "records pay it too, so the ratio reports the "
+                     "protocol's real amplification under the "
+                     "gate-emulated multi-core deployment"),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
 def _bench_breakdown() -> None:
     """--breakdown mode: per-stage latency decomposition of the
     pipelined PUT path (the paper's per-stage evaluation axis, and the
@@ -1559,6 +1700,22 @@ def main() -> None:
                 "value": None, "unit": "us (server e2e p50)",
                 "vs_baseline": 0.0,
                 "detail": {"mode": "breakdown", "error": repr(e)},
+            }), flush=True)
+        return
+    if "--txn" in sys.argv[1:]:
+        # Transaction throughput (PR 12): single-group MULTI batch vs
+        # cross-group 2PC under the per-group write-svc gate, with the
+        # group-major device plane on (recompile sentinel banked).
+        try:
+            _bench_txn()
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "metric": "txn_throughput",
+                "value": None, "unit": "cross-group txns/s",
+                "vs_baseline": 0.0,
+                "detail": {"mode": "txn", "error": repr(e)},
             }), flush=True)
         return
     if "--throughput" in sys.argv[1:]:

@@ -328,6 +328,11 @@ def _collect_obs(pc) -> list:
                 d["groups_view"] = st.get("groups")
                 d["router_epoch"] = st.get("router_epoch")
                 d["migrations"] = st.get("migrations")
+            if st.get("txns") is not None:
+                # Open-txn tables per replica (coordinator records,
+                # prepared participants, lock counts) travel with the
+                # failure dump beside the groups/router views.
+                d["txns"] = st.get("txns")
             out.append(d)
         return out
     except Exception:                                 # noqa: BLE001
@@ -519,6 +524,56 @@ def _flr_sweep(pc, fields=("flr_local_reads", "flr_forwards",
     return out
 
 
+#: txn counters summed over live replicas (coverage + resumption
+#: evidence: a --txn trial must commit cross-group transactions, and
+#: a coordinator kill mid-2PC shows up as txn_resumed > 0)
+_TXN_FIELDS = ("txn_prepared", "txn_decided", "txn_aborted",
+               "txn_resumed", "txn_lock_conflicts",
+               "txn_epoch_aborts", "txn_batches")
+
+
+def _txn_sweep(pc) -> dict:
+    out = {f: 0 for f in _TXN_FIELDS}
+    for i in range(len(pc.procs)):
+        if pc.procs[i] is None:
+            continue
+        st = pc.status(i, timeout=0.5)
+        if st:
+            for f in _TXN_FIELDS:
+                out[f] += st.get(f, 0) or 0
+    return out
+
+
+def _txn_roll(c, wrng, tkeys, wid: int, seq: list) -> None:
+    """One recorded transactional op: a 2-4 sub-op txn over the txn
+    key pool (puts/gets/incrs/sadds — usually spanning groups), or a
+    single typed op.  The txn pool is DISJOINT from the register
+    pools, so plain keys keep riding the checker's per-key fast
+    path."""
+    roll = wrng.random()
+    if roll < 0.25:
+        seq[0] += 1
+        c.incr(wrng.choice(tkeys) + b".c", wrng.choice([1, 1, 2, -1]))
+        return
+    if roll < 0.35:
+        c.sadd(wrng.choice(tkeys) + b".s", b"m%d" % wrng.randint(0, 5))
+        return
+    subs = []
+    for k in wrng.sample(tkeys, k=min(len(tkeys),
+                                      wrng.randint(2, 4))):
+        r2 = wrng.random()
+        if r2 < 0.45:
+            seq[0] += 1
+            subs.append(("put", k, b"t%d.%d" % (wid, seq[0])))
+        elif r2 < 0.7:
+            subs.append(("get", k))
+        elif r2 < 0.9:
+            subs.append(("incr", k + b".c", 1))
+        else:
+            subs.append(("sadd", k + b".s", b"m%d" % wrng.randint(0, 5)))
+    c.txn(subs)
+
+
 def _check_linear_resolving(recorder, stats: dict):
     """Shared campaign verdict: full check, then the UNDECIDED keys
     retried offline with a 16x search budget — undecided is a missing
@@ -630,7 +685,8 @@ def _wait_groups_converged(pc, groups: int,
 def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                        dump_obs: "str | None" = None,
                        time_nemesis: bool = False,
-                       groups: int = 1) -> dict:
+                       groups: int = 1,
+                       txn: bool = False) -> dict:
     """One CONSISTENCY-AUDIT chaos trial on the deployment shape: a
     3-replica ProcCluster with the live fault plane, concurrent client
     workers (serial AND pipelined paths) recording every op's
@@ -674,6 +730,11 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
     keys = (_keys_covering(b"ak", rng.randint(4, 7), groups, rng)
             if groups > 1
             else [b"ak%d" % i for i in range(rng.randint(4, 7))])
+    # --txn: a DISJOINT txn key pool, covering >= 2 groups so most
+    # transactions run the cross-group 2PC (the register pools stay
+    # on the checker's per-key fast path).
+    tkeys = (_keys_covering(b"tk", rng.randint(3, 5), groups, rng)
+             if txn else [])
     recorder = HistoryRecorder(capacity=1 << 18)
     stop = threading.Event()
     n_workers = 3
@@ -682,6 +743,7 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
     def worker(wid: int, peers: list) -> None:
         wrng = random.Random((fault_seed << 4) ^ wid)
         n = 0
+        tseq = [0]
         # With the time nemesis armed, follower reads are the subject:
         # most workers route GETs across replicas (follower leases);
         # worker 0 stays leader-routed for contrast.
@@ -692,7 +754,9 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
             while not stop.is_set():
                 try:
                     roll = wrng.random()
-                    if roll < 0.45:
+                    if txn and roll < 0.30:
+                        _txn_roll(c, wrng, tkeys, wid, tseq)
+                    elif roll < 0.45:
                         n += 1
                         c.put(wrng.choice(keys), b"w%d.%d" % (wid, n))
                     elif roll < 0.8:
@@ -715,11 +779,40 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                                             c.group_of(k)))
                         c.pipeline(ops)
                 except (TimeoutError, RuntimeError, OSError,
-                        ConnectionError):
+                        ConnectionError, ValueError):
                     _time.sleep(0.05)   # recorded as ambiguous; go on
 
     obs_dumps: list = []
     armed_persist_fault: list = []   # enospc/fsync_eio armed this trial
+    if txn:
+        # Widen the 2PC's prepare->decide window on every daemon so
+        # the seeded leader kill below lands MID-2PC with usable
+        # probability (the nemesis pins the RATC claim: a coordinator
+        # death between PREPARE and DECIDED must be resumed, never
+        # wedge or double-apply).
+        os.environ["APUS_TXN_PREP_HOLD"] = "0.05"
+    try:
+        return _run_audit_body(
+            fault_seed, minutes, dump_obs, time_nemesis, groups, txn,
+            rng, spec, keys, tkeys, recorder, stop, n_workers,
+            nemesis, worker, obs_dumps, armed_persist_fault, _dbg)
+    finally:
+        if txn:
+            os.environ.pop("APUS_TXN_PREP_HOLD", None)
+
+
+def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
+                    groups, txn, rng, spec, keys, tkeys, recorder,
+                    stop, n_workers, nemesis, worker, obs_dumps,
+                    armed_persist_fault, _dbg) -> dict:
+    import tempfile
+    import threading
+    import time as _time
+
+    from apus_tpu.parallel.faults import heal_all, isolate, send_fault
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+
     with tempfile.TemporaryDirectory(prefix="apus-audit") as td:
         with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
                          fault_seed=fault_seed) as pc, \
@@ -779,8 +872,12 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
             # seeded disk fault on the recovery path.  Multi-group:
             # the nemesis picks its VICTIM GROUP seeded and kills THAT
             # group's leader (different groups may lead elsewhere).
+            # --txn biases the victim to the COORDINATOR group (min
+            # participant gid = group 0 for pools covering it): with
+            # the prepare->decide hold armed and txn traffic flowing,
+            # this is the coordinator-kill-mid-2PC arm.
             if groups > 1:
-                vg = rng.randrange(groups)
+                vg = 0 if txn else rng.randrange(groups)
                 _dbg(f"victim group {vg}")
                 kill_restart(_group_leader_idx(pc, vg, timeout=15.0))
             else:
@@ -826,17 +923,27 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
             # healed followers' leases as well.
             gview = (_wait_groups_converged(pc, groups, timeout=60.0)
                      if groups > 1 else None)
+            txn_stats = _txn_sweep(pc) if txn else {}
             with ApusClient(peers, timeout=10.0, history=recorder,
                             read_policy="spread" if time_nemesis
                             else "leader", groups=groups) as c:
                 for k in keys:
                     c.get(k)
+                # Txn pool final reads: a lost acked transactional
+                # write (base key, counter, or set) is a strict-
+                # serializability violation too.  MIGRATING-bounce
+                # retries inside get() wait out any still-draining
+                # lock.
+                for k in tkeys:
+                    c.get(k)
+                    c.get(k + b".c")
+                    c.get(k + b".s")
     _dbg(f"checking {len(recorder.events())} events")
     stats = {"ambiguous": sum(1 for e in recorder.events()
                               if e["status"] != "ok"),
              "recorded": len(recorder.events()),
              "obs_events": _obs_event_count(obs_dumps),
-             **nemesis, **flr}
+             **nemesis, **flr, **txn_stats}
     if groups > 1 and gview is not None:
         stats["groups"] = groups
         stats["group_terms"] = {g: v["term"] for g, v in gview.items()}
@@ -865,6 +972,12 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
             f"time-nemesis trial served 0 follower-lease reads "
             f"(sweep: {flr}) — the campaign did not exercise its "
             f"subject")
+    if txn and groups > 1 and not txn_stats.get("txn_decided"):
+        # Coverage pin: a --txn trial that never decided one
+        # cross-group 2PC never attacked its subject.
+        raise AssertionError(
+            f"txn trial decided 0 cross-group transactions "
+            f"(sweep: {txn_stats})")
     # Teardown health verdict: hard degradation flags the schedule
     # cannot explain (recompiles always; persist_disabled unless this
     # trial armed a live enospc/fsync-eio fault) fail the trial.
@@ -882,7 +995,34 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                        time_nemesis: bool = False,
                        groups: int = 1,
                        split_merge: bool = False,
-                       group_quorum_kill: bool = False) -> dict:
+                       group_quorum_kill: bool = False,
+                       txn: bool = False) -> dict:
+    if not txn:
+        return _run_churn_body(fault_seed, check_linear, minutes,
+                               state_size, dump_obs, time_nemesis,
+                               groups, split_merge,
+                               group_quorum_kill, txn)
+    # --txn: widen the 2PC prepare->decide window on every daemon so
+    # the seeded kills land MID-2PC (see run_audit_schedule).
+    os.environ["APUS_TXN_PREP_HOLD"] = "0.05"
+    try:
+        return _run_churn_body(fault_seed, check_linear, minutes,
+                               state_size, dump_obs, time_nemesis,
+                               groups, split_merge,
+                               group_quorum_kill, txn)
+    finally:
+        os.environ.pop("APUS_TXN_PREP_HOLD", None)
+
+
+def _run_churn_body(fault_seed: int, check_linear: bool = True,
+                    minutes: float = 0.0,
+                    state_size: int = 0,
+                    dump_obs: "str | None" = None,
+                    time_nemesis: bool = False,
+                    groups: int = 1,
+                    split_merge: bool = False,
+                    group_quorum_kill: bool = False,
+                    txn: bool = False) -> dict:
     """One MEMBERSHIP-CHURN chaos trial on the deployment shape: a
     3-replica fault-plane ProcCluster with auto-removal ON, concurrent
     recorded clients (serial + pipelined), and a seeded nemesis that
@@ -951,6 +1091,11 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
     keys = (_keys_covering(b"ck", rng.randint(4, 7), groups, rng)
             if groups > 1
             else [b"ck%d" % i for i in range(rng.randint(4, 7))])
+    # --txn: a DISJOINT txn key pool covering >= 2 groups (see
+    # run_audit_schedule) — transactional traffic now straddles
+    # joins, evictions, leaves, AND split/merge flips.
+    tkeys = (_keys_covering(b"tk", rng.randint(3, 5), groups, rng)
+             if txn else [])
     recorder = HistoryRecorder(capacity=1 << 18) if check_linear else None
     stop = threading.Event()
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
@@ -965,6 +1110,7 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
     def worker(wid: int, peers: list) -> None:
         wrng = random.Random((fault_seed << 4) ^ wid)
         n = 0
+        tseq = [0]
         policy = "spread" if time_nemesis and wid > 0 else "leader"
         with ApusClient(peers, timeout=6.0, attempt_timeout=1.0,
                         history=recorder, read_policy=policy,
@@ -972,7 +1118,9 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             while not stop.is_set():
                 try:
                     roll = wrng.random()
-                    if roll < 0.45:
+                    if txn and roll < 0.30:
+                        _txn_roll(c, wrng, tkeys, wid, tseq)
+                    elif roll < 0.45:
                         n += 1
                         c.put(wrng.choice(keys), b"c%d.%d" % (wid, n))
                     elif roll < 0.8:
@@ -995,7 +1143,7 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                                             c.group_of(k)))
                         c.pipeline(ops)
                 except (TimeoutError, RuntimeError, OSError,
-                        ConnectionError):
+                        ConnectionError, ValueError):
                     _time.sleep(0.05)   # recorded as ambiguous; go on
 
     def wait_evicted(pc, victim: int, timeout: float = 30.0) -> None:
@@ -1119,7 +1267,11 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                 # Multi-group: the churn nemesis picks its VICTIM
                 # GROUP seeded — the kill lands on THAT group's
                 # leader, which may or may not also lead group 0.
-                vg = rng.randrange(groups) if groups > 1 else 0
+                # --txn biases it to the coordinator group (the
+                # coordinator-kill-mid-2PC arm; prepare->decide hold
+                # armed above).
+                vg = (0 if txn else rng.randrange(groups)) \
+                    if groups > 1 else 0
 
                 def kill_leader_soon() -> None:
                     _time.sleep(delay)
@@ -1236,7 +1388,10 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
                          and pc.procs[i] is not None],
                         step, timeout=60.0)
                     churn["splits"] += 1
-                    cur_groups += 1
+                    # The dst may REUSE an empty dynamic group (an
+                    # MB refused on a txn lock and retried): the live
+                    # group count is max(dst)+1, not splits+static.
+                    cur_groups = max(cur_groups, res["dst"] + 1)
                     pairs.append((step, res["dst"]))
                     _dbg(f"split g{step} -> g{res['dst']} "
                          f"(mig {res['mig']})")
@@ -1345,14 +1500,25 @@ def run_churn_schedule(fault_seed: int, check_linear: bool = True,
             churn["snap_chunks_acked"] = \
                 snap_stat_sum("snap_chunks_acked")
             churn["delta_snapshots"] = snap_stat_sum("delta_snapshots")
+            txn_stats = _txn_sweep(pc) if txn else {}
             ops_checked = 0
             if recorder is not None:
                 with ApusClient(list(pc.spec.peers), timeout=10.0,
                                 history=recorder, groups=groups) as c:
                     for k in keys:
                         c.get(k)
+                    for k in tkeys:
+                        # Lost acked transactional writes across every
+                        # remove/rejoin/split are violations too.
+                        c.get(k)
+                        c.get(k + b".c")
+                        c.get(k + b".s")
     stats = {"configs_traversed": view["epoch"], **churn,
-             "obs_events": _obs_event_count(obs_dumps)}
+             "obs_events": _obs_event_count(obs_dumps), **txn_stats}
+    if txn and groups > 1 and not txn_stats.get("txn_decided"):
+        raise AssertionError(
+            f"txn churn trial decided 0 cross-group transactions "
+            f"(sweep: {txn_stats})")
     if gview is not None:
         # Per-group traversal pin: every group must have moved through
         # at least one config epoch (the multi-group join/evict/leave
@@ -1518,6 +1684,19 @@ def main() -> int:
                          "the per-key audit run per group, and every "
                          "group must traverse >= 1 config epoch or "
                          "leader change")
+    ap.add_argument("--txn", action="store_true",
+                    help="with --check-linear/--churn: compose "
+                         "TRANSACTIONAL workers (multi-key txns over "
+                         "a dedicated cross-group key pool — "
+                         "puts/gets/INCR/SADD — plus typed single "
+                         "ops) with the existing nemeses, arm the "
+                         "prepare->decide hold so seeded leader "
+                         "kills land mid-2PC (coordinator kill "
+                         "between PREPARE and DECIDED, resumed by "
+                         "whoever comes to lead), and check the "
+                         "mixed history STRICT-SERIALIZABLE "
+                         "(transactions as atomic multi-sub-op "
+                         "events; audit/linear.py component search)")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -1542,7 +1721,8 @@ def main() -> int:
            if args.state_size else []) \
         + (["--groups", str(args.groups)] if args.groups > 1 else []) \
         + (["--split-merge"] if args.split_merge else []) \
-        + (["--group-quorum-kill"] if args.group_quorum_kill else [])
+        + (["--group-quorum-kill"] if args.group_quorum_kill else []) \
+        + (["--txn"] if args.txn else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
@@ -1553,7 +1733,8 @@ def main() -> int:
              "recorded": 0, "obs_events": 0, "pauses": 0,
              "clock_cmds": 0, "flr_local_reads": 0, "flr_forwards": 0,
              "flr_grants": 0, "flr_pause_lapses": 0,
-             "undecided_keys": 0, "undecided_retried": 0, "seeds": []}
+             "undecided_keys": 0, "undecided_retried": 0,
+             **{f: 0 for f in _TXN_FIELDS}, "seeds": []}
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
              "leader_kills": 0, "configs_traversed": 0,
              "ops_checked": 0, "receiver_kills": 0, "snap_resumes": 0,
@@ -1562,7 +1743,8 @@ def main() -> int:
              "clock_cmds": 0, "undecided_keys": 0,
              "undecided_retried": 0, "splits": 0, "merges": 0,
              "mig_leader_kills": 0, "group_quorum_kills": 0,
-             "router_epoch": 0, "seeds": []}
+             "router_epoch": 0, **{f: 0 for f in _TXN_FIELDS},
+             "seeds": []}
     for trial, fault_seed in enumerate(seeds):
         try:
             if args.churn:
@@ -1574,7 +1756,8 @@ def main() -> int:
                     time_nemesis=args.time_nemesis,
                     groups=args.groups,
                     split_merge=args.split_merge,
-                    group_quorum_kill=args.group_quorum_kill)
+                    group_quorum_kill=args.group_quorum_kill,
+                    txn=args.txn)
                 for k in ("joins", "auto_removes", "graceful_leaves",
                           "leader_kills", "configs_traversed",
                           "ops_checked", "receiver_kills",
@@ -1583,7 +1766,7 @@ def main() -> int:
                           "obs_events", "pauses", "clock_cmds",
                           "undecided_keys", "undecided_retried",
                           "splits", "merges", "mig_leader_kills",
-                          "group_quorum_kills"):
+                          "group_quorum_kills") + _TXN_FIELDS:
                     churn[k] += st.get(k, 0)
                 churn["router_epoch"] = max(churn["router_epoch"],
                                             st.get("router_epoch", 0))
@@ -1593,13 +1776,14 @@ def main() -> int:
                 st = run_audit_schedule(fault_seed,
                                         dump_obs=args.dump_obs,
                                         time_nemesis=args.time_nemesis,
-                                        groups=args.groups)
+                                        groups=args.groups,
+                                        txn=args.txn)
                 for k in ("ops_checked", "keys", "ambiguous",
                           "recorded", "obs_events", "pauses",
                           "clock_cmds", "flr_local_reads",
                           "flr_forwards", "flr_grants",
                           "flr_pause_lapses", "undecided_keys",
-                          "undecided_retried"):
+                          "undecided_retried") + _TXN_FIELDS:
                     audit[k] += st.get(k, 0)
                 audit["seeds"].append(fault_seed)
                 r = "ok"
@@ -1659,6 +1843,7 @@ def main() -> int:
                    "groups": args.groups,
                    "split_merge": args.split_merge,
                    "group_quorum_kill": args.group_quorum_kill,
+                   "txn": args.txn,
                    # Audit campaign evidence (banked via eval.py): how
                    # much history the checker proved linearizable, and
                    # under which seeds.  violations is structurally 0

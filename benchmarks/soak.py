@@ -173,6 +173,18 @@ def main() -> int:
                          "groups — the elastic/multi-group deployment "
                          "shape; failure dumps then carry each "
                          "replica's per-group view")
+    ap.add_argument("--txn", action="store_true",
+                    help="per-iteration TRANSACTIONAL side stream: a "
+                         "MULTI/EXEC batch (two SETs + a GET, "
+                         "atomicity verified) and an INCR (strict "
+                         "monotonicity verified) — through the "
+                         "interposer path this is redis MULTI/EXEC "
+                         "and INCR served by the UNMODIFIED app "
+                         "(RespClient), closing the reference's "
+                         "workload loop; --kv runs ApusClient.txn "
+                         "cross-group transactions instead, and "
+                         "--audit folds both streams into the "
+                         "strict-serializability verdict")
     ap.add_argument("--audit", action="store_true",
                     help="record every SET/GET of the soak stream as a "
                          "timed history (apus_tpu.audit.HistoryRecorder"
@@ -484,6 +496,95 @@ def main() -> int:
                 rs = c.pipeline_cmds([("SET", k, v) for k, v in kvs])
             return all(r == "OK" for r in rs)
 
+        # --txn: the transactional side stream.  Keys stay inside a
+        # SMALL slice (toyserver's 4096-slot table bounds the total
+        # keyspace) and the counter is one key, so strict INCR
+        # monotonicity doubles as a durability check across failovers.
+        txn_rounds = txn_incrs = 0
+        last_cnt = [0]
+
+        def do_txn_round(c, seq: int) -> int:
+            """One MULTI(2xSET + GET) + one INCR through the active
+            protocol; returns ops completed (raises on wire trouble,
+            bumps errors via return 0 on a verification failure)."""
+            nonlocal txn_rounds, txn_incrs, errors
+            k1 = f"soakt:{seq % 25}"
+            k2 = f"soakt:{25 + seq % 25}"
+            v1, v2 = f"t{seq}a", f"t{seq}b"
+            arid = None
+            if audit_rec is not None:
+                from apus_tpu.models.kvs import (encode_get,
+                                                 encode_put)
+                audit_req[0] += 1
+                arid = audit_req[0]
+                audit_rec.invoke_txn(1, arid, [
+                    encode_put(k1.encode(), v1.encode()),
+                    encode_put(k2.encode(), v2.encode()),
+                    encode_get(k2.encode())])
+            try:
+                if args.kv:
+                    rets = c.txn([("put", k1.encode(), v1.encode()),
+                                  ("put", k2.encode(), v2.encode()),
+                                  ("get", k2.encode())])
+                    got = rets[2]
+                elif args.toyserver:
+                    rs = c.pipeline_cmds(
+                        ["MULTI", f"SET {k1} {v1}", f"SET {k2} {v2}",
+                         f"GET {k2}", "EXEC"])
+                    parts = rs[-1].split("|")
+                    got = parts[-1].encode() if len(parts) == 3 \
+                        else None
+                    rets = [b"OK", b"OK", got or b""]
+                else:
+                    rs = c.pipeline_cmds(
+                        [("MULTI",), ("SET", k1, v1), ("SET", k2, v2),
+                         ("GET", k2), ("EXEC",)])
+                    ex = rs[-1]
+                    got = ex[2] if isinstance(ex, list) \
+                        and len(ex) == 3 else None
+                    rets = [b"OK", b"OK", got or b""]
+            except (OSError, ConnectionError, RuntimeError,
+                    TimeoutError):
+                if arid is not None:
+                    audit_rec.complete_txn(1, arid, "ambiguous")
+                raise
+            if arid is not None:
+                audit_rec.complete_txn(1, arid, "ok", rets)
+            if got != v2.encode():
+                errors += 1
+                return 0
+            txn_rounds += 1
+            # INCR: reply strictly greater than the last observed one
+            # (single soak client; exactly-once keeps retries from
+            # double-bumping, and a regression here is a lost or
+            # double-applied transactional write).
+            arid = None
+            if audit_rec is not None:
+                audit_req[0] += 1
+                arid = audit_req[0]
+                audit_rec.invoke_kv(1, arid, "incr",
+                                    b"soakc:0", b"1")
+            try:
+                if args.kv:
+                    n = c.incr(b"soakc:0")
+                elif args.toyserver:
+                    n = int(c.cmd("INCR soakc:0"))
+                else:
+                    n = int(c.cmd("INCR", "soakc:0"))
+            except (OSError, ConnectionError, RuntimeError,
+                    TimeoutError, ValueError):
+                if arid is not None:
+                    audit_rec.complete(1, arid, "ambiguous")
+                raise
+            if arid is not None:
+                audit_rec.complete(1, arid, "ok", b"%d" % n)
+            if n <= last_cnt[0]:
+                errors += 1
+                return 0
+            last_cnt[0] = n
+            txn_incrs += 1
+            return 4
+
         t0 = time.monotonic()
         next_obs = (time.monotonic() + args.obs_every
                     if args.obs_every > 0 else float("inf"))
@@ -641,6 +742,8 @@ def main() -> int:
                         else:
                             ops += 2
                             last_acked = (k, v)
+                if args.txn:
+                    ops += do_txn_round(client, seq)
             except (OSError, ConnectionError, RuntimeError):
                 # In-flight recorded ops are ambiguous (maybe applied).
                 if audit_rec is not None:
@@ -759,6 +862,10 @@ def main() -> int:
                     d["groups_view"] = st.get("groups")
                     d["router_epoch"] = st.get("router_epoch")
                     d["migrations"] = st.get("migrations")
+                if args.txn:
+                    # Open-txn tables ride the failure dump too.
+                    st = probe_status(addr, timeout=1.0) or {}
+                    d["txns"] = st.get("txns")
                 obs_dumps.append(d)
         except Exception:                        # noqa: BLE001
             pass
@@ -840,6 +947,11 @@ def main() -> int:
                 "rejoins": churn_rejoins,
                 "churn_errors": churn_errors,
             }} if args.churn else {}),
+            **({"txn": {
+                "rounds": txn_rounds,
+                "incrs": txn_incrs,
+                "last_counter": last_cnt[0],
+            }} if args.txn else {}),
             **({"fault_seed": args.fault_seed,
                 "faults_injected": faults_injected}
                if args.fault_seed is not None else {}),
@@ -884,6 +996,7 @@ def main() -> int:
               + (f" --state-size {args.state_size}"
                  if args.state_size else "")
               + (" --kv" if args.kv and not args.read_local else "")
+              + (" --txn" if args.txn else "")
               + (f" --groups {args.groups}" if args.groups > 1
                  else ""),
               file=sys.stderr)

@@ -180,6 +180,36 @@ def _run_elastic(out, trials: int = 5) -> None:
         _record(out, rec, replicas=3, bench="elastic_campaign")
 
 
+def _run_txn(out, trials: int = 5) -> None:
+    """Transaction chaos campaign (fuzz.py --txn --check-linear
+    --groups 4 --churn --split-merge): transactional workers (cross-
+    group 2PC + TM batches + typed ops) composed with membership
+    churn, live split/merge racing open 2PCs, and coordinator kills
+    mid-prepare, every trial's mixed history checked STRICT-
+    SERIALIZABLE.  Banks the campaign as one record."""
+    print(f"fuzz.py --txn --check-linear --groups 4 --churn "
+          f"--split-merge --group-quorum-kill: txn campaign "
+          f"({trials} trials)")
+    argv = [sys.executable,
+            os.path.join(REPO, "benchmarks", "fuzz.py"),
+            "--churn", "--check-linear", "--groups", "4",
+            "--split-merge", "--group-quorum-kill", "--txn",
+            "--trials", str(trials), "--seed-base", "28100"]
+    for rec in _run_tool(argv, timeout=600 * trials):
+        _record(out, rec, replicas=3, bench="txn_campaign")
+
+
+def _run_txn_bench(out) -> None:
+    """Transaction throughput row (bench.py --txn): single-group MULTI
+    batch vs cross-group 2PC cost under the per-group write-svc
+    gate."""
+    print("bench.py --txn: MULTI batch vs cross-group 2PC throughput")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"), "--txn"],
+                         timeout=240):
+        _record(out, rec, replicas=3, bench="bench_txn")
+
+
 def _run_breakdown(out) -> None:
     """Per-stage latency decomposition of the pipelined PUT path
     (bench.py --breakdown): exact stitched stage p50/p99 from the span
@@ -273,6 +303,12 @@ def cmd_run(args) -> int:
             # Elastic chaos campaign only: skip the cluster suite.
             _run_elastic(out, trials=getattr(args, "elastic_trials",
                                              5))
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "txn_only", False):
+            # Transaction campaign + throughput row only.
+            _run_txn(out, trials=getattr(args, "txn_trials", 5))
+            _run_txn_bench(out)
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -754,6 +790,41 @@ def cmd_report(args) -> int:
             f"(mean {ev.get('mean_groups_per_dispatch')}/dispatch, "
             f"p50 multi-group: {ev.get('p50_multi_group')}), "
             f"recompile sentinel {ev.get('recompile_sentinel')}")
+    txc = [r for r in runs
+           if r.get("bench") == "txn_campaign"
+           and isinstance(r.get("value"), (int, float))]
+    if txc:
+        last = txc[-1]
+        c = last.get("detail", {}).get("churn", {})
+        lines.append(
+            f"- TRANSACTIONS under reconfiguration chaos: "
+            f"{_fmt(last['value'])}% clean over "
+            f"{last.get('detail', {}).get('trials')} seeded trials "
+            f"(--txn --groups 4 --churn --split-merge) — "
+            f"{c.get('txn_decided')} cross-group 2PC commits / "
+            f"{c.get('txn_batches')} MULTI batches / "
+            f"{c.get('txn_resumed')} mid-2PC takeovers resumed / "
+            f"{c.get('txn_lock_conflicts')} lock-conflict aborts / "
+            f"{c.get('txn_epoch_aborts')} epoch-fence aborts "
+            f"(splits racing open 2PCs), {c.get('splits')} live "
+            f"splits, {_fmt(c.get('ops_checked'))} ops "
+            f"strict-serializability-checked; violations="
+            f"{c.get('violations', '?')}, wedges="
+            f"{c.get('wedges', '?')}; seeds {c.get('seeds')}")
+    txb = [r for r in runs if r.get("bench") == "bench_txn"
+           and isinstance(r.get("value"), (int, float))]
+    if txb:
+        last = txb[-1]
+        d = last.get("detail", {})
+        lines.append(
+            f"- TXN throughput (per-group write-svc gate, "
+            f"{d.get('emulated_write_svc_ms')} ms/op/group): "
+            f"single-group MULTI batch "
+            f"{_fmt(d.get('single_group_txns_per_sec'))} txns/sec vs "
+            f"cross-group 2PC "
+            f"{_fmt(d.get('cross_group_2pc_txns_per_sec'))} txns/sec "
+            f"(cost ratio {d.get('cost_ratio_2pc_vs_multi')}x), "
+            f"recompile sentinel {d.get('recompile_sentinel')}")
     spl = [r for r in runs if r.get("metric") == "split_relief_gain"
            and isinstance(r.get("value"), (int, float))]
     if spl:
@@ -1084,6 +1155,16 @@ def main() -> int:
                             "and bank the row")
         p.add_argument("--elastic-trials", type=int, default=5,
                        help="trial count for --elastic-only")
+        p.add_argument("--txn-only", action="store_true",
+                       help="run ONLY the transaction campaign "
+                            "(fuzz --txn --check-linear --groups 4 "
+                            "--churn --split-merge: cross-group 2PC "
+                            "under churn + split/merge, strict-"
+                            "serializability-checked) plus the "
+                            "bench.py --txn throughput row, and bank "
+                            "both")
+        p.add_argument("--txn-trials", type=int, default=5,
+                       help="trial count for --txn-only")
         p.add_argument("--split-only", action="store_true",
                        help="run ONLY the elastic hot-shard-relief "
                             "ladder (reconf_bench --split: pre- vs "

@@ -12,8 +12,18 @@
  *   SET <key> <value>   -> OK
  *   GET <key>           -> <value> | NIL
  *   DEL <key>           -> OK | NIL
+ *   INCR <key>          -> <new value> | ERR (non-numeric)
  *   COUNT               -> <number of keys>
  *   PING                -> PONG
+ *   MULTI               -> OK      (start queueing, per connection)
+ *   <cmd> ...           -> QUEUED  (while in MULTI)
+ *   EXEC                -> <r1>|<r2>|...  (queued results, one line)
+ *   DISCARD             -> OK      (drop the queue)
+ *
+ * INCR and MULTI/EXEC mirror redis's transactional surface shape (the
+ * PR 12 soak drives the same commands at real redis via RESP); state
+ * is per-connection, which the interposer's per-conn_id replay
+ * preserves, so follower replay stays deterministic.
  *
  * Usage: toyserver <port>
  */
@@ -71,10 +81,16 @@ static int kv_count(void) {
   return n;
 }
 
+#define MULTI_MAX 16
+#define MULTI_CMD 512
+
 struct client {
   int fd;
   char buf[BUF_SIZE];
   size_t len;
+  int in_multi;
+  int qn;
+  char q[MULTI_MAX][MULTI_CMD];
 };
 
 static void reply(int fd, const char* s) {
@@ -88,42 +104,106 @@ static void reply(int fd, const char* s) {
   }
 }
 
-static void handle_line(int fd, char* line) {
+static void run_cmd(char* line, char* out, size_t outsz) {
   char* sp = strchr(line, ' ');
   if (strcmp(line, "PING") == 0) {
-    reply(fd, "PONG\n");
+    snprintf(out, outsz, "PONG");
   } else if (strcmp(line, "COUNT") == 0) {
-    char out[32];
-    snprintf(out, sizeof(out), "%d\n", kv_count());
-    reply(fd, out);
+    snprintf(out, outsz, "%d", kv_count());
   } else if (sp != NULL && strncmp(line, "SET ", 4) == 0) {
     char* key = line + 4;
     char* val = strchr(key, ' ');
     if (val == NULL) {
-      reply(fd, "ERR\n");
+      snprintf(out, outsz, "ERR");
       return;
     }
     *val++ = '\0';
-    reply(fd, kv_set(key, val) == 0 ? "OK\n" : "ERR\n");
+    snprintf(out, outsz, "%s", kv_set(key, val) == 0 ? "OK" : "ERR");
   } else if (sp != NULL && strncmp(line, "GET ", 4) == 0) {
     struct kv* e = kv_find(line + 4);
-    if (e == NULL) {
-      reply(fd, "NIL\n");
-    } else {
-      reply(fd, e->val);
-      reply(fd, "\n");
-    }
+    snprintf(out, outsz, "%s", e == NULL ? "NIL" : e->val);
   } else if (sp != NULL && strncmp(line, "DEL ", 4) == 0) {
     struct kv* e = kv_find(line + 4);
     if (e == NULL) {
-      reply(fd, "NIL\n");
+      snprintf(out, outsz, "NIL");
     } else {
       e->used = 0;
-      reply(fd, "OK\n");
+      snprintf(out, outsz, "OK");
     }
+  } else if (sp != NULL && strncmp(line, "INCR ", 5) == 0) {
+    struct kv* e = kv_find(line + 5);
+    char* end = NULL;
+    long v = 0;
+    if (e != NULL) {
+      v = strtol(e->val, &end, 10);
+      if (end == e->val || *end != '\0') {
+        snprintf(out, outsz, "ERR");
+        return;
+      }
+    }
+    char num[32];
+    snprintf(num, sizeof(num), "%ld", v + 1);
+    if (kv_set(line + 5, num) != 0) {
+      snprintf(out, outsz, "ERR");
+      return;
+    }
+    snprintf(out, outsz, "%s", num);
   } else {
-    reply(fd, "ERR\n");
+    snprintf(out, outsz, "ERR");
   }
+}
+
+static void handle_line(struct client* c, char* line) {
+  if (strcmp(line, "MULTI") == 0) {
+    c->in_multi = 1;
+    c->qn = 0;
+    reply(c->fd, "OK\n");
+    return;
+  }
+  if (strcmp(line, "DISCARD") == 0) {
+    c->in_multi = 0;
+    c->qn = 0;
+    reply(c->fd, "OK\n");
+    return;
+  }
+  if (strcmp(line, "EXEC") == 0) {
+    if (!c->in_multi) {
+      reply(c->fd, "ERR\n");
+      return;
+    }
+    /* All queued commands execute back to back in the single-threaded
+     * loop — atomic with respect to every other connection, exactly
+     * redis's MULTI/EXEC contract.  Results joined on ONE line so the
+     * pipelined soak client keeps its 1-reply-per-command framing. */
+    static char out[MULTI_MAX * (MAX_VAL + 8) + 8];
+    size_t off = 0;
+    for (int i = 0; i < c->qn && off + MAX_VAL + 8 < sizeof(out); i++) {
+      char r[MAX_VAL + 8];
+      run_cmd(c->q[i], r, sizeof(r));
+      off += (size_t)snprintf(out + off, sizeof(out) - off, "%s%s",
+                              i ? "|" : "", r);
+    }
+    c->in_multi = 0;
+    c->qn = 0;
+    reply(c->fd, out);
+    reply(c->fd, "\n");
+    return;
+  }
+  if (c->in_multi) {
+    if (c->qn >= MULTI_MAX || strlen(line) >= MULTI_CMD) {
+      c->in_multi = 0;
+      c->qn = 0;
+      reply(c->fd, "ERR\n");
+      return;
+    }
+    snprintf(c->q[c->qn++], MULTI_CMD, "%s", line);
+    reply(c->fd, "QUEUED\n");
+    return;
+  }
+  char out[MAX_VAL + 8];
+  run_cmd(line, out, sizeof(out));
+  reply(c->fd, out);
+  reply(c->fd, "\n");
 }
 
 static void drain(struct client* c) {
@@ -132,7 +212,7 @@ static void drain(struct client* c) {
   while ((nl = memchr(start, '\n', c->len - (size_t)(start - c->buf)))) {
     *nl = '\0';
     if (nl > start && nl[-1] == '\r') nl[-1] = '\0';
-    handle_line(c->fd, start);
+    handle_line(c, start);
     start = nl + 1;
   }
   size_t rest = c->len - (size_t)(start - c->buf);
@@ -186,6 +266,8 @@ int main(int argc, char** argv) {
           if (clients[i].fd < 0) {
             clients[i].fd = fd;
             clients[i].len = 0;
+            clients[i].in_multi = 0;
+            clients[i].qn = 0;
             placed = 1;
             break;
           }

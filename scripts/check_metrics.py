@@ -48,6 +48,7 @@ NAMESPACE_OF = {
     "apus_tpu/runtime/group_plane.py": None,
     "apus_tpu/runtime/groupset.py": "node",
     "apus_tpu/runtime/elastic.py": "node",
+    "apus_tpu/runtime/txn.py": "node",
     "apus_tpu/runtime/mesh_plane.py": "node",
     "apus_tpu/parallel/net.py": None,     # mixed: resolved per call
     "apus_tpu/parallel/faults.py": "fault",

@@ -82,5 +82,25 @@ if [ "$smoke" -eq 1 ]; then
         echo "elastic smoke FAILED (rc=$erc)" >&2
         exit "$erc"
     fi
+    echo "== txn smoke (cross-group 2PC traffic + coordinator kill"
+    echo "   mid-prepare on a live ProcCluster, strict-serializability-"
+    echo "   checked; 1 trial) =="
+    env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \
+        --check-linear --groups 2 --txn --trials 1 --seed-base 9520
+    trc=$?
+    if [ "$trc" -ne 0 ]; then
+        echo "txn smoke FAILED (rc=$trc)" >&2
+        exit "$trc"
+    fi
+    echo "== txn checker unit slice (planted dirty-read / lost-update /"
+    echo "   fractured-read histories REJECTED, clean txn history"
+    echo "   ACCEPTED) =="
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_txn.py -q \
+        -k "checker" -p no:cacheprovider
+    crc=$?
+    if [ "$crc" -ne 0 ]; then
+        echo "txn checker slice FAILED (rc=$crc)" >&2
+        exit "$crc"
+    fi
 fi
 echo "tier1.sh: all green"

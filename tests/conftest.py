@@ -73,3 +73,9 @@ def pytest_configure(config):
         "followers, lease grant/invalidation rules, the adversarial-"
         "time nemesis (pause/skew), and the planted-stale-lease "
         "harness; selectable with -m flr")
+    config.addinivalue_line(
+        "markers",
+        "txn: transaction suite — typed RDT ops, within-group TM "
+        "batches, cross-group 2PC (locks, epoch fences, coordinator "
+        "kill recovery), and the strict-serializability checker "
+        "generalization; selectable with -m txn")
