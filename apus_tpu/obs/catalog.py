@@ -101,6 +101,15 @@ COUNTERS: dict[str, str] = {
     "srv_ingest_batches": "multi-frame bursts drained off one connection",
     "srv_ingest_frames": "frames ingested through burst drains",
     "srv_ingest_solo": "single-frame (non-burst) requests served",
+    # Native serving data plane, Python-side events (parallel/
+    # native_plane.py; the C loop's own counters are the srv_native_*
+    # GAUGES below, mirrored at scrape time).
+    "srv_native_adopted": "client connections adopted by the native plane",
+    "srv_native_fallbacks": "native bursts the batch hook declined (sequential dispatch)",
+    "srv_native_errors": "native upcall batches that raised (answered ST_ERROR)",
+    "srv_native_unavailable": "native plane requested but extension absent (Python fallback)",
+    "srv_native_view_poisoned": "applied-view mirrors poisoned (untrackable op / oversized)",
+    "srv_native_merged_bursts": "connection bursts coalesced into shared admission calls",
     # -- dev_*: device-plane engine (runtime/device_plane.py runner;
     #    process-wide registry merged into every replica's scrape) ----
     "dev_rounds": "device commit rounds executed",
@@ -136,6 +145,23 @@ GAUGES: dict[str, str] = {
     "devd_async_windows": "deep windows enqueued without blocking",
     "devd_partial_deferrals": "partial windows deferred for queued admissions",
     "devd_group_windows": "per-group windows carried by this daemon's group-major dispatches",
+    # Native serving data plane: the C++ loop's atomics, mirrored as
+    # gauges at OP_METRICS scrape / OP_STATUS time (the loop itself
+    # never touches the registry — it never holds the GIL).
+    "srv_native_ingest_batches": "recv bursts the native epoll loop drained",
+    "srv_native_ingest_frames": "frames the native loop parsed off the wire",
+    "srv_native_replies": "replies flushed by the native loop (all paths)",
+    "srv_native_dedup_hits": "duplicate writes answered from the native reply cache",
+    "srv_native_get_serves": "GETs served from the native applied view",
+    "srv_native_upcall_batches": "bursts handed across the GIL admission boundary",
+    "srv_native_upcall_frames": "frames in those upcall bursts",
+    "srv_native_raw_batches": "upcall bursts demoted to raw-frame mode (non-client op seen)",
+    "srv_native_bytes_in": "bytes the native loop read off client sockets",
+    "srv_native_bytes_out": "bytes the native loop flushed to client sockets",
+    "srv_native_conns_adopted": "connections the native loop has ever owned",
+    "srv_native_gil_released_ns": "native loop busy time (all of it GIL-free), ns",
+    "srv_native_gate_misses": "GETs that fell to Python on a closed read gate",
+    "srv_native_view_poisons": "applied views the native side marked stale",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -179,4 +205,5 @@ FLIGHT_CATEGORIES: dict[str, str] = {
     "devplane": "device-plane ownership flips (cause-tagged) + recompiles",
     "elastic": "elastic-group migrations: begin/capture/committed edges",
     "txn": "cross-group transactions: begin/resumed/decided/closed edges",
+    "native": "native data plane activation / loud fallback edges",
 }

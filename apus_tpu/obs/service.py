@@ -53,6 +53,12 @@ def _refresh_daemon_gauges(daemon) -> None:
                   "quorum_gated", "qfail_timeouts", "async_windows",
                   "partial_deferrals", "group_windows"):
             g(f"devd_{k}").set(drv.stats.get(k, 0))
+    # Native data plane: the C loop's atomics mirrored as srv_native_*
+    # gauges (the loop never holds the GIL, so it cannot touch the
+    # registry itself).
+    native = getattr(daemon, "native", None)
+    if native is not None:
+        native.sync_gauges(hub.registry)
 
 
 def _merged_snapshot(daemon) -> dict:

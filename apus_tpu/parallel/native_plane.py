@@ -1,0 +1,438 @@
+"""Native serving data plane: Python control surface (ISSUE 13).
+
+``native/dataplane.cpp`` owns the leader's serving hot path with the
+GIL released — epoll frame ingest, OP_GROUP demux, endpoint-DB dedup
+fast path, lease-GET serving from a native applied view, and vectored
+reply flush.  This module is the ONLY code that talks to it:
+
+- :func:`load_extension` finds/loads the compiled module
+  (``native/build/apus_dataplane.so``; ``APUS_DATAPLANE_SO`` overrides
+  — the sanitizer test points it at the ASAN flavor);
+- :class:`NativePlaneService` glues one plane to one ``ReplicaDaemon``:
+  worker threads pull bursts from ``plane.next_work()`` (blocking with
+  the GIL released) and run the daemon's group-commit batch hook — the
+  node-lock admission boundary is the ONE place the hot path crosses
+  back into Python, so election/membership/reconfiguration/txn control
+  stay in ``core/node.py`` untouched;
+- gate publishing: every daemon tick re-publishes, per consensus
+  group, whether the native side may serve GETs (leader lease live or
+  follower lease live, log fully applied, no txn locks / elastic
+  fences) and whether the dedup fast path may answer (leader as of the
+  tick).  Any inbound log write / truncation / snapshot op closes the
+  read gate SYNCHRONOUSLY (``on_peer_write`` from the PeerServer) —
+  the Hermes-style write invalidation that makes a between-tick
+  follower serve impossible; a scripted clock jump closes every gate
+  through the SkewClock's ``on_skew`` hook.
+
+Safety argument (DESIGN.md "Native data plane" has the long form):
+the native read gate is a CONSERVATIVE projection of exactly the
+checks Python's lease read paths make — published under the node lock
+each tick with a deadline of at most half the remaining lease window
+(so clock-rate skew inside the documented lease_margin envelope cannot
+stretch it past the real expiry), and killed synchronously by every
+event that could make the applied view stale before the next tick.
+Replies are byte-identical to the Python plane's by construction
+(``tests/test_native_plane.py`` pins it on live tapes).
+
+Fallback: when the extension is absent (or ``APUS_NATIVE_PLANE=0``)
+the daemon keeps the pure-Python plane — same wire behavior, this
+module never loads the .so, and enabling the spec knob merely logs
+loudly + notes the flight ring.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import threading
+from typing import Optional
+
+_EXT = None
+_EXT_ERR: Optional[str] = None
+_EXT_LOCK = threading.Lock()
+
+
+def _default_so_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "native", "build", "apus_dataplane.so")
+
+
+def load_extension():
+    """The compiled dataplane module, or None (reason in
+    :func:`load_error`).  Cached; ``APUS_DATAPLANE_SO`` overrides the
+    default build path (the module name follows the file stem, so the
+    ASAN flavor coexists with the standard one)."""
+    global _EXT, _EXT_ERR
+    with _EXT_LOCK:
+        if _EXT is not None or _EXT_ERR is not None:
+            return _EXT
+        path = os.environ.get("APUS_DATAPLANE_SO") or _default_so_path()
+        if not os.path.exists(path):
+            _EXT_ERR = f"extension not built ({path} missing); " \
+                       f"run `make -C native dataplane`"
+            return None
+        name = os.path.basename(path).split(".")[0]
+        try:
+            loader = importlib.machinery.ExtensionFileLoader(name, path)
+            spec = importlib.util.spec_from_loader(name, loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except (ImportError, OSError) as e:    # pragma: no cover
+            _EXT_ERR = f"extension load failed: {e}"
+            return None
+        _EXT = mod
+        return _EXT
+
+
+def load_error() -> Optional[str]:
+    return _EXT_ERR
+
+
+def plane_requested(spec) -> bool:
+    """Is the native plane requested for this daemon?  The env var
+    overrides the spec both ways (``APUS_NATIVE_PLANE=1`` arms it on
+    stock specs — the fuzz/soak ``--native-plane`` plumbing — and
+    ``=0`` force-disables it)."""
+    env = os.environ.get("APUS_NATIVE_PLANE")
+    if env is not None and env != "":
+        return env not in ("0", "false", "no")
+    return bool(getattr(spec, "native_plane", False))
+
+
+#: SM attributes whose non-emptiness means the applied view cannot be
+#: served (txn 2PL locks, elastic migration fences) — mirrors the
+#: refusal fences at the top of KvsStateMachine.apply.
+_SM_FENCES = ("_locks", "_frozen", "_departed")
+
+#: Rebuild (rather than permanently poison) the applied view after a
+#: snapshot install when the store is at most this many items.
+_VIEW_REBUILD_MAX = int(os.environ.get("APUS_NATIVE_VIEW_REBUILD_MAX",
+                                       "200000"))
+
+
+class NativePlaneService:
+    """One daemon's native data plane: plane object + worker pool +
+    gate publishing + applied-view maintenance."""
+
+    def __init__(self, daemon, ext, workers: Optional[int] = None):
+        from apus_tpu.parallel.net import PeerServer
+        self.daemon = daemon
+        self.ext = ext
+        self.stats = daemon.server.stats      # srv_* registry view
+        # Dedup fast-path answers skip the bench's write-service
+        # emulation gate; keep byte-AND-timing parity when that gate
+        # is armed by routing every write through Python.
+        dedup = not getattr(daemon, "write_svc", 0.0)
+        self._reads_ok = (daemon.elastic is None
+                          and not getattr(daemon, "read_svc", 0.0))
+        self.plane = ext.Plane(max_burst=PeerServer.MAX_BURST,
+                               dedup=dedup)
+        self._workers: list[threading.Thread] = []
+        self._nworkers = workers if workers is not None else int(
+            os.environ.get("APUS_NATIVE_WORKERS", "16"))
+        self._stopped = threading.Event()
+        self._gid_reads_seen: dict[int, int] = {}
+        self._view_ok: dict[int, bool] = {}
+        self.running = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self.plane.start()
+        self.running = True
+        for i in range(max(1, self._nworkers)):
+            t = threading.Thread(target=self._worker,
+                                 name=f"apus-nplane-{self.daemon.idx}-{i}",
+                                 daemon=True)
+            t.start()
+            self._workers.append(t)
+        # Initial applied view (post-replay state) for group 0; extra
+        # groups never serve native reads (the elastic plane owns
+        # bucket routing there — see publish_gates).
+        if self._reads_ok:
+            self._load_view(0)
+        # Scripted clock jumps must close the read gates through the
+        # same seam the lease math skews on.
+        clock = getattr(self.daemon, "clock", None)
+        if clock is not None:
+            clock.on_skew = self.plane.invalidate
+        if self.daemon.obs is not None:
+            self.daemon.obs.flight.note(
+                "native", "plane_active",
+                workers=self._nworkers,
+                reads=bool(self._reads_ok))
+
+    def stop(self) -> None:
+        self.running = False
+        self._stopped.set()
+        clock = getattr(self.daemon, "clock", None)
+        if clock is not None and getattr(clock, "on_skew", None) \
+                == self.plane.invalidate:
+            clock.on_skew = None
+        self.plane.stop()
+
+    # -- connection adoption (PeerServer hands clients over) -----------
+
+    def adopt_socket(self, conn, first_frame: bytes, stream) -> bool:
+        """Take ownership of a client connection: the already-read
+        first frame plus whatever the FrameStream had buffered seed the
+        native recv buffer; the Python socket object is detached (the
+        plane owns the fd from here)."""
+        from apus_tpu.parallel import wire
+        if not self.running:
+            return False
+        initial = wire.frame(first_frame) + stream.detach_buffer()
+        fd = conn.detach()
+        if not self.plane.adopt(fd, initial):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            return True          # plane stopping: the conn dies with it
+        self.stats.bump("native_adopted")
+        return True
+
+    @staticmethod
+    def is_client_frame(req: bytes) -> bool:
+        from apus_tpu.runtime.client import OP_CLT_READ, OP_CLT_WRITE
+        from apus_tpu.parallel import wire
+        if not req:
+            return False
+        op = req[0]
+        if op == wire.OP_GROUP and len(req) >= 3:
+            op = req[2]
+        return op in (OP_CLT_WRITE, OP_CLT_READ)
+
+    # -- worker pool (the GIL-crossing admission boundary) -------------
+
+    #: cross-connection merge bound: one worker coalesces queued
+    #: bursts from SEVERAL connections into one admission call (one
+    #: node-lock acquisition + one commit wait for all of them — the
+    #: group-commit drain amortized past what the per-connection
+    #: Python plane can reach), up to this many frames.
+    MERGE_FRAMES = 512
+
+    def _worker(self) -> None:
+        plane = self.plane
+        daemon = self.daemon
+        while not self._stopped.is_set():
+            try:
+                work = plane.next_work(0.5)
+            except Exception:
+                return                      # plane torn down
+            if work is None:
+                continue
+            # Cross-conn merge: drain more PARSED bursts non-blocking.
+            # Raw bursts never merge (their frames dispatch alone).
+            merged = [work]
+            if work[1]:
+                total = len(work[2])
+                while total < self.MERGE_FRAMES:
+                    try:
+                        more = plane.next_work(0.0)
+                    except Exception:
+                        more = None
+                    if more is None:
+                        break
+                    merged.append(more)
+                    total += len(more[2])
+                    if not more[1]:
+                        break               # raw burst: stop merging
+            for batch_id, parsed, items in self._run_merged(merged):
+                try:
+                    plane.complete(batch_id, items)
+                except Exception:
+                    return
+
+    def _run_merged(self, merged):
+        """Run a list of (batch_id, parsed, items) through admission —
+        parsed bursts concatenated into ONE hook call — and yield
+        (batch_id, _, replies) per input batch (reply order within
+        each burst preserved; the wire stays byte-identical because
+        each connection's replies are exactly its requests', in
+        order)."""
+        from apus_tpu.parallel import wire
+        daemon = self.daemon
+        parsed_batches = [(bid, items) for bid, p, items in merged if p]
+        raw_batches = [(bid, items) for bid, p, items in merged
+                       if not p]
+        out = []
+        if parsed_batches:
+            if len(parsed_batches) > 1:
+                self.stats.bump("native_merged_bursts",
+                                len(parsed_batches))
+            all_items = []
+            for _bid, items in parsed_batches:
+                all_items.extend(items)
+            try:
+                replies = daemon.server.batch_hook.run_parsed(all_items)
+            except Exception:
+                daemon.logger.exception("native-plane batch failed")
+                self.stats.bump("native_errors")
+                replies = [wire.u8(wire.ST_ERROR) for _ in all_items]
+            off = 0
+            for bid, items in parsed_batches:
+                out.append((bid, None, replies[off:off + len(items)]))
+                off += len(items)
+        for bid, frames in raw_batches:
+            try:
+                replies = self._dispatch_raw(frames)
+            except Exception:
+                daemon.logger.exception("native-plane batch failed")
+                self.stats.bump("native_errors")
+                replies = [wire.u8(wire.ST_ERROR) for _ in frames]
+            out.append((bid, None, replies))
+        return out
+
+    def _dispatch_raw(self, frames: list) -> list:
+        """Bursts carrying any non-client frame: exactly the Python
+        plane's path — the batch hook if it accepts, else sequential
+        dispatch (order preserved)."""
+        hook = self.daemon.server.batch_hook
+        replies = None
+        if hook is not None and len(frames) > 1:
+            replies = hook(frames)
+        if replies is None:
+            self.stats.bump("native_fallbacks")
+            replies = [self.daemon.server._dispatch(f) for f in frames]
+        return replies
+
+    # -- per-tick gate publishing (called under the node lock) ---------
+
+    def publish_gates(self) -> None:
+        daemon = self.daemon
+        plane = self.plane
+        for gid in range(getattr(daemon, "n_groups", 1)):
+            node = daemon.group_node(gid)
+            if node is None:
+                continue
+            leaderish = node.is_leader
+            valid_ns = 0
+            if self._reads_ok and gid == 0 \
+                    and self._view_ok.get(gid, gid == 0) \
+                    and node.log.apply == node.log.end \
+                    and not any(getattr(node.sm, a, None)
+                                for a in _SM_FENCES):
+                fnow = node._fresh_now()
+                if leaderish:
+                    if node._lease_valid(fnow):
+                        valid_ns = self._deadline(
+                            node._lease_until - fnow)
+                elif node.role.name == "FOLLOWER" \
+                        and not node.draining \
+                        and node._flr_enabled() \
+                        and node.lease_requester is not None \
+                        and node.log.apply >= node._flease_floor:
+                    ok, _why = node._flease_ok(fnow)
+                    if ok:
+                        valid_ns = self._deadline(
+                            node._flease_until - fnow)
+            plane.publish(gid, leaderish, valid_ns)
+            # Fold native read serves into the node's own lease-read
+            # accounting (OP_STATUS / campaign coverage pins keep
+            # meaning either plane), and keep the follower lease warm
+            # while the native side is the one serving.
+            served = plane.gid_reads(gid)
+            delta = served - self._gid_reads_seen.get(gid, 0)
+            if delta:
+                self._gid_reads_seen[gid] = served
+                node.reads_done += delta
+                if leaderish:
+                    node.bump("lease_reads", delta)
+                else:
+                    node.bump("flr_local_reads", delta)
+                    node._flr_hot_until = node._fresh_now() + 1.0
+
+    def _deadline(self, remaining_s: float) -> int:
+        """Published gate validity: at most HALF the remaining lease
+        window (absorbs clock-rate skew far beyond the lease_margin
+        envelope) and at most one heartbeat period (so a gate never
+        outlives the conditions by more than a tick-ish horizon)."""
+        if remaining_s <= 0:
+            return 0
+        cap = min(remaining_s * 0.5, self.daemon.spec.hb_period)
+        return max(0, int(cap * 1e9))
+
+    # -- synchronous invalidation (peer writes, Hermes-style) ----------
+
+    def on_peer_write(self, node) -> None:
+        """An inbound log write / truncation / snapshot op landed on
+        ``node``: its group's applied view may be about to change —
+        close the read gate NOW (re-published next tick once applied
+        catches up).  Called from PeerServer handler threads under the
+        node lock."""
+        self.plane.invalidate(getattr(node, "gid", 0))
+
+    # -- applied-view maintenance (under the node lock, apply time) ----
+
+    def on_entry_applied(self, e) -> None:
+        """Group-0 committed-entry observer (daemon.on_commit): mirror
+        the applied command into the native view.  Any command the
+        mirror cannot track (typed RDT ops, txn/migration records)
+        poisons it — the read gate then stays closed for the session
+        and GETs simply keep their Python path."""
+        if not self._reads_ok or not self._view_ok.get(0, True):
+            return
+        if self.plane.view_apply(0, e.data):
+            self._view_ok[0] = False
+            self.stats.bump("native_view_poisoned")
+
+    def on_snapshot_installed(self, snap, ep_dump) -> None:
+        """A snapshot replaced group-0 state wholesale: rebuild the
+        view from the store (bounded), else poison it."""
+        if not self._reads_ok:
+            return
+        self.plane.invalidate(0)
+        self._load_view(0)
+
+    def _load_view(self, gid: int) -> None:
+        node = self.daemon.group_node(gid)
+        store = getattr(node.sm, "store", None) if node is not None \
+            else None
+        if store is None or len(store) > _VIEW_REBUILD_MAX \
+                or any(getattr(node.sm, a, None) for a in _SM_FENCES):
+            self.plane.view_poison(gid)
+            self._view_ok[gid] = False
+            if store is not None:
+                self.stats.bump("native_view_poisoned")
+            return
+        poisoned = self.plane.view_load(gid, list(store.items()))
+        self._view_ok[gid] = not poisoned
+
+    # -- observability -------------------------------------------------
+
+    def sync_gauges(self, registry) -> None:
+        """Mirror the plane's C counters as srv_native_* gauges (scrape
+        time / OP_STATUS, like the daemon/persistence scalars)."""
+        for name, v in self.plane.counters().items():
+            registry.gauge(f"srv_native_{name}").set(v)
+
+    def status_view(self) -> dict:
+        c = self.plane.counters()
+        c["conns"] = self.plane.conn_count()
+        c["workers"] = len(self._workers)
+        c["reads_enabled"] = bool(self._reads_ok)
+        return c
+
+
+def maybe_build(daemon):
+    """Build + install the native plane for a daemon when requested.
+    Returns the service or None; an absent extension degrades LOUDLY
+    to the Python plane (log + flight note + counter)."""
+    if not plane_requested(daemon.spec):
+        return None
+    ext = load_extension()
+    if ext is None:
+        daemon.logger.error(
+            "NATIVE PLANE REQUESTED BUT UNAVAILABLE (%s); "
+            "falling back to the pure-Python serving plane",
+            load_error())
+        daemon.server.stats.bump("native_unavailable")
+        if daemon.obs is not None:
+            daemon.obs.flight.note("native", "plane_unavailable",
+                                   reason=load_error() or "")
+        return None
+    svc = NativePlaneService(daemon, ext)
+    return svc

@@ -90,6 +90,12 @@ class PeerServer:
         #: ops share one lock acquisition + one commit wait instead of
         #: serializing: op i+1 is admitted before op i's commit.
         self.batch_hook = None
+        #: Native serving data plane (parallel.native_plane), installed
+        #: by the daemon when enabled: connections whose FIRST frame is
+        #: a client op are handed to its GIL-released C++ loop and
+        #: never return to this thread; peer/control connections stay
+        #: here.  None (default) = the pure-Python plane, unchanged.
+        self.native_plane = None
         self._stop = threading.Event()
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
@@ -168,6 +174,19 @@ class PeerServer:
                 req = stream.next_frame()
                 if req is None or self._stop.is_set():
                     return
+                # Native-plane adoption: a connection that OPENS with a
+                # client op is a client connection (clients dedicate
+                # their sockets to CLT ops) — hand the fd, the frame,
+                # and the stream's buffered remainder to the C++ loop
+                # and retire this thread.  Decided on the first frame
+                # only; peer/control traffic never matches.
+                np = self.native_plane
+                if np is not None and np.running \
+                        and np.is_client_frame(req):
+                    if np.adopt_socket(conn, req, stream):
+                        with self._conns_lock:
+                            self._conns.discard(conn)
+                        return
                 # Pipelined clients write many frames before reading
                 # replies: drain whatever is ALREADY queued (buffered
                 # by the stream's large recv, or a zero-wait poll — a
@@ -293,9 +312,20 @@ class PeerServer:
             echoes.append((wire.ST_OK, node.sid.word))
         return wire.encode_hb_echoes(echoes)
 
+    #: ops whose application can change a node's log/applied state —
+    #: each closes the native plane's read gate for that group BEFORE
+    #: applying (Hermes-style write invalidation: a follower must never
+    #: serve a native GET between an inbound write and the tick that
+    #: re-validates its lease/applied conditions).
+    _GATE_WRITES = frozenset((wire.OP_LOG_WRITE, wire.OP_LOG_SET_END,
+                              wire.OP_SNAP_PUSH, wire.OP_SNAP_BEGIN,
+                              wire.OP_SNAP_CHUNK, wire.OP_SNAP_END))
+
     def _apply(self, op: int, r: wire.Reader, node=None) -> bytes:
         if node is None:
             node = self._node_ref()
+        if self.native_plane is not None and op in self._GATE_WRITES:
+            self.native_plane.on_peer_write(node)
         if op == wire.OP_CTRL_WRITE:
             region = wire.REGION_LIST[r.u8()]
             slot = r.u8()

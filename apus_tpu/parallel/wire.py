@@ -500,6 +500,16 @@ class FrameStream:
     def at_eof(self) -> bool:
         return self._eof and not self._buf
 
+    def detach_buffer(self) -> bytes:
+        """Hand off every buffered-but-unparsed byte and retire this
+        stream (native-plane connection adoption: the C++ loop owns
+        the socket from here, so bytes buffered in Python must move
+        with it — they are invisible to any other reader)."""
+        buf = bytes(self._buf)
+        self._buf = bytearray()
+        self._eof = True
+        return buf
+
 
 def _recv_exact(sock, n: int) -> Optional[bytes]:
     chunks = []

@@ -437,6 +437,12 @@ def make_client_ops(daemon, node=None) -> dict:
                           "txn_resumed", "txn_lock_conflicts",
                           "txn_epoch_aborts", "txn_batches"):
                     st[f] = sum(v.get(f, 0) for v in _tv)
+            # Native data-plane observability (parallel/native_plane):
+            # the C loop's counter snapshot + adoption state, so
+            # harnesses assert "the native path actually engaged"
+            # over the wire instead of poking daemon internals.
+            if getattr(daemon, "native", None) is not None:
+                st["native_plane"] = daemon.native.status_view()
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
             refusals = getattr(daemon, "misdirect_refusals", None)
@@ -516,7 +522,6 @@ def make_client_batch_hook(daemon):
         # the WHOLE burst, so the leader's group-commit drain
         # amortizes across every group with queued ops.
         parsed = []
-        nodes = []
         for f in frames:
             r = wire.Reader(f)
             op = r.u8()
@@ -527,7 +532,19 @@ def make_client_batch_hook(daemon):
             if op not in (OP_CLT_WRITE, OP_CLT_READ):
                 return None
             parsed.append((op, r.u64(), r.u64(), r.blob(), gid))
-            nodes.append(daemon.group_node(gid))
+        return run(parsed)
+
+    def run_parsed(items):
+        """Native-plane entry (parallel.native_plane): the C++ ingest
+        loop hands bursts PRE-PARSED — ``(gid, op, req_id, clt_id,
+        data)`` with the payload slices already cut — so admission
+        skips the Python wire re-parse entirely.  Same admission, same
+        replies, byte-identical wire behavior."""
+        return run([(op, rid, cid, data, gid)
+                    for gid, op, rid, cid, data in items])
+
+    def run(parsed):
+        nodes = [daemon.group_node(g) for (_o, _r, _c, _d, g) in parsed]
         handles: list = [None] * len(parsed)
         registered = [False] * len(parsed)
         # Per-op stage spans (write ops, req_id-sampled): the whole
@@ -748,6 +765,7 @@ def make_client_batch_hook(daemon):
                 daemon.commit_cond.wait(min(left, 0.25))
         return _finish()
 
+    hook.run_parsed = run_parsed
     return hook
 
 

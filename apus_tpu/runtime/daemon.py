@@ -321,6 +321,22 @@ class ReplicaDaemon:
         self.txn = TxnPlane(self)
         self.server._extra_ops.update(make_txn_ops(self))
 
+        # Native serving data plane (parallel/native_plane.py +
+        # native/dataplane.cpp): the GIL-released C++ hot path for
+        # client ingest -> dedup -> group-commit -> reply.  Built only
+        # when ClusterSpec.native_plane / APUS_NATIVE_PLANE asks for it
+        # and the extension is present (absent = LOUD fallback to the
+        # pure-Python plane — identical wire behavior either way).
+        from apus_tpu.parallel.native_plane import maybe_build
+        self.native = maybe_build(self)
+        if self.native is not None:
+            # Applied-view maintenance + per-tick gate publishing run
+            # under the node lock at apply/tick time; snapshot installs
+            # rebuild the view (or poison it at large state).
+            self.on_commit.append(self.native.on_entry_applied)
+            self.on_snapshot.append(self.native.on_snapshot_installed)
+            self.on_tick.append(self.native.publish_gates)
+
         # Device plane (runtime.device_plane): the jitted commit step as
         # the primary replication/quorum engine, host TCP as control
         # plane + catch-up (the RC-data/UD-control split of the
@@ -393,6 +409,11 @@ class ReplicaDaemon:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
+        if self.native is not None:
+            # Armed before the listener: a client connection accepted
+            # on the very first frame must find the plane running.
+            self.native.start()
+            self.server.native_plane = self.native
         self.server.start()
         t = threading.Thread(target=self._run, name=f"apus-tick-{self.idx}",
                              daemon=True)
@@ -442,6 +463,10 @@ class ReplicaDaemon:
         if self._excl_thread is not None:
             self._excl_thread.join(timeout=2.0)
         self.server.stop()
+        if self.native is not None:
+            # RST-closes every adopted client connection (crash-fault
+            # fidelity, matching PeerServer.stop) and joins the loop.
+            self.native.stop()
         if hasattr(self.transport, "stop"):
             self.transport.stop()       # fault-plane schedule thread
         self.transport.close()

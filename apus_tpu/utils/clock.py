@@ -52,6 +52,12 @@ class SkewClock:
         self._anchor_real = base()
         self._anchor_val = self._anchor_real
         self._last = self._anchor_val
+        #: Skew-event hook (no args): fired after every scripted
+        #: set_rate/jump, OUTSIDE the clock lock.  The native data
+        #: plane installs its read-gate invalidator here — a gate
+        #: deadline projected onto raw CLOCK_MONOTONIC is only valid
+        #: while this clock's mapping to real time stands still.
+        self.on_skew: "Callable[[], None] | None" = None
 
     def __call__(self) -> float:
         with self._lock:
@@ -70,12 +76,18 @@ class SkewClock:
             self._anchor_val += (real - self._anchor_real) * self._rate
             self._anchor_real = real
             self._rate = max(0.0, float(rate))
+        cb = self.on_skew
+        if cb is not None:
+            cb()
 
     def jump(self, seconds: float) -> None:
         """One-time step.  Negative steps are absorbed by the monotone
         clamp (the clock freezes until real time catches up)."""
         with self._lock:
             self._anchor_val += float(seconds)
+        cb = self.on_skew
+        if cb is not None:
+            cb()
 
     def reset(self) -> None:
         """Back to real rate (offset kept; see module docstring)."""

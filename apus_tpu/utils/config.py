@@ -147,6 +147,17 @@ class ClusterSpec:
     # from ``follower_reads`` below, which gates STALE app-level reads
     # at the proxy.
     follower_read_leases: bool = True
+    # Native serving data plane (native/dataplane.cpp via
+    # apus_tpu/parallel/native_plane.py): client connections are handed
+    # to a GIL-released C++ epoll loop that does frame ingest, OP_GROUP
+    # demux, endpoint-DB dedup fast-path answers, lease-GET serving
+    # from a native applied view, and vectored reply flush — crossing
+    # into Python only at the node-lock admission boundary (the
+    # group-commit batch hook).  Off by default; APUS_NATIVE_PLANE=1/0
+    # overrides the spec either way, and a missing extension falls back
+    # LOUDLY to the pure-Python plane (byte-identical wire behavior,
+    # pinned by tests/test_native_plane.py).
+    native_plane: bool = False
     # Misdirection gate: False (default) = a non-leader's proxy REFUSES
     # client bytes to its raw app (the client reconnects and finds the
     # leader — structurally no unreplicated reads/writes; beyond the

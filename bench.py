@@ -839,6 +839,81 @@ def _bench_throughput() -> None:
             base_spec, read_lease=False)) as c:
         run_variant(c, "gets_readindex", pipelined=True, reads=True)
 
+    # -- NATIVE DATA PLANE rows (ISSUE 13) -----------------------------
+    # Two methodologies, both apples-to-apples:
+    #   *_native      — the EXACT Python-client variants above, against
+    #                   a native-plane cluster (client CPU shared, so
+    #                   on one box this understates the server gain);
+    #   ldgen_*       — the native pipelined load generator
+    #                   (dataplane.loadgen, GIL-released) against BOTH
+    #                   planes: the server data plane's capacity
+    #                   without a Python-client bottleneck.  raw and
+    #                   RTT-gated rows for each.
+    from apus_tpu.parallel.native_plane import load_extension
+    _ext = load_extension()
+    native_counters = {}
+
+    def ldgen(cluster, name, op, link_rtt=0.0, threads=4):
+        import threading as _th
+        leader = cluster.wait_for_leader(30.0)
+        host, port = leader.server.addr
+        # Pre-populate the key pool (and for GET rows, settle apply)
+        # so GETs measure real lookups.
+        _ext.loadgen(host, port, seconds=0.3, window=W, op="put",
+                     nkeys=256, vlen=64, prefix="nlg")
+        time.sleep(0.1)
+        out = [None] * threads
+
+        def drive_one(i):
+            out[i] = _ext.loadgen(host, port, seconds=seconds,
+                                  window=W, op=op, nkeys=256, vlen=64,
+                                  rtt_us=int(link_rtt * 1e6),
+                                  prefix="nlg")
+
+        ts = [_th.Thread(target=drive_one, args=(i,))
+              for i in range(threads)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = max(time.monotonic() - t0, 1e-6)
+        ok = sum(r["ok"] for r in out if r)
+        fails = sum(r["fails"] + r["not_leader"] for r in out if r)
+        results[name] = {"ops_per_sec": round(ok / elapsed, 1),
+                         "ops": ok, "fails": fails,
+                         "elapsed_s": round(elapsed, 3)}
+        _mark(f"  {name}: {results[name]['ops_per_sec']:.0f} ops/s"
+              + (f" ({fails} fails)" if fails else ""))
+
+    if _ext is not None:
+        with LocalCluster(R, spec=dataclasses.replace(base_spec)) as c:
+            ldgen(c, "ldgen_put_python", "put")
+            ldgen(c, "ldgen_get_python", "get")
+            if rtt > 0:
+                ldgen(c, "ldgen_put_python_rtt", "put", link_rtt=rtt)
+                ldgen(c, "ldgen_get_python_rtt", "get", link_rtt=rtt)
+        with LocalCluster(R, spec=dataclasses.replace(
+                base_spec, native_plane=True)) as c:
+            run_variant(c, "serial_raw_native", pipelined=False)
+            run_variant(c, "pipelined_raw_native", pipelined=True)
+            if rtt > 0:
+                run_variant(c, "pipelined_rtt_native", pipelined=True,
+                            link_rtt=rtt)
+            run_variant(c, "gets_lease_native", pipelined=True,
+                        reads=True)
+            ldgen(c, "ldgen_put_native", "put")
+            ldgen(c, "ldgen_get_native", "get")
+            if rtt > 0:
+                ldgen(c, "ldgen_put_native_rtt", "put", link_rtt=rtt)
+                ldgen(c, "ldgen_get_native_rtt", "get", link_rtt=rtt)
+            ld = c.wait_for_leader(10.0)
+            if ld.native is not None:
+                native_counters = ld.native.plane.counters()
+    else:
+        _mark("  native rows SKIPPED (extension not built: "
+              "make -C native dataplane)")
+
     def ops(name):
         return results[name]["ops_per_sec"] if name in results else None
 
@@ -889,6 +964,40 @@ def _bench_throughput() -> None:
             "gets_leader_svc_ops_per_sec": ops("gets_leader_svc"),
             "gets_follower_svc_ops_per_sec": ops("gets_follower_svc"),
             "emulated_read_svc_ms": svc_ms,
+            # Native data plane (ISSUE 13): Python-client rows against
+            # the native-plane cluster, native-loadgen rows against
+            # BOTH planes (raw + RTT-gated), and the gain axes.  The
+            # ldgen_* pairs are the server-capacity comparison (same
+            # native client against both planes — the clients above
+            # share the box's CPU with the server, understating it).
+            "pipelined_raw_native_ops_per_sec":
+                ops("pipelined_raw_native"),
+            "serial_raw_native_ops_per_sec": ops("serial_raw_native"),
+            "pipelined_rtt_native_ops_per_sec":
+                ops("pipelined_rtt_native"),
+            "gets_lease_native_ops_per_sec": ops("gets_lease_native"),
+            "ldgen_put_python_ops_per_sec": ops("ldgen_put_python"),
+            "ldgen_put_native_ops_per_sec": ops("ldgen_put_native"),
+            "ldgen_get_python_ops_per_sec": ops("ldgen_get_python"),
+            "ldgen_get_native_ops_per_sec": ops("ldgen_get_native"),
+            "ldgen_put_python_rtt_ops_per_sec":
+                ops("ldgen_put_python_rtt"),
+            "ldgen_put_native_rtt_ops_per_sec":
+                ops("ldgen_put_native_rtt"),
+            "ldgen_get_python_rtt_ops_per_sec":
+                ops("ldgen_get_python_rtt"),
+            "ldgen_get_native_rtt_ops_per_sec":
+                ops("ldgen_get_native_rtt"),
+            "native_pipelined_gain_pyclient": round(
+                (ops("pipelined_raw_native") or 0.0)
+                / (piped_raw or 1.0), 2),
+            "native_put_gain_ldgen": round(
+                (ops("ldgen_put_native") or 0.0)
+                / (ops("ldgen_put_python") or 1.0), 2),
+            "native_get_gain_ldgen": round(
+                (ops("ldgen_get_native") or 0.0)
+                / (ops("ldgen_get_python") or 1.0), 2),
+            "native_counters": native_counters or None,
             "follower_read_gain": round(
                 (ops("gets_follower_svc") or 0.0)
                 / (ops("gets_leader_svc") or 1.0), 2),

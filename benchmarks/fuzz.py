@@ -524,6 +524,37 @@ def _flr_sweep(pc, fields=("flr_local_reads", "flr_forwards",
     return out
 
 
+def _native_armed() -> bool:
+    return os.environ.get("APUS_NATIVE_PLANE", "") \
+        not in ("", "0", "false", "no")
+
+
+def _native_sweep(pc) -> dict:
+    """Sum native-data-plane counters over live replicas (coverage
+    evidence: a --native-plane trial whose daemons ingested 0 frames
+    natively silently exercised the Python plane instead)."""
+    out = {"native_frames": 0, "native_conns": 0,
+           "native_get_serves": 0, "native_dedup_hits": 0}
+    for i in range(len(pc.procs)):
+        if pc.procs[i] is None:
+            continue
+        st = pc.status(i, timeout=0.5)
+        npd = (st or {}).get("native_plane") or {}
+        out["native_frames"] += npd.get("ingest_frames", 0) or 0
+        out["native_conns"] += npd.get("conns_adopted", 0) or 0
+        out["native_get_serves"] += npd.get("get_serves", 0) or 0
+        out["native_dedup_hits"] += npd.get("dedup_hits", 0) or 0
+    return out
+
+
+def _assert_native_coverage(nsw: dict, tag: str) -> None:
+    if nsw and not nsw.get("native_frames"):
+        raise AssertionError(
+            f"--native-plane trial ingested 0 frames through the "
+            f"native plane ({tag}; sweep: {nsw}) — the campaign "
+            f"exercised the Python plane instead")
+
+
 #: txn counters summed over live replicas (coverage + resumption
 #: evidence: a --txn trial must commit cross-group transactions, and
 #: a coordinator kill mid-2PC shows up as txn_resumed > 0)
@@ -917,6 +948,7 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
             pc.wait_converged(timeout=45.0)
             _dbg("converged")
             flr = _flr_sweep(pc) if time_nemesis else {}
+            native_sw = _native_sweep(pc) if _native_armed() else {}
             # Final read round: with these in the history, a lost acked
             # write is a linearizability violation too.  Under the time
             # nemesis it runs SPREAD, so the final reads exercise the
@@ -972,6 +1004,8 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
             f"time-nemesis trial served 0 follower-lease reads "
             f"(sweep: {flr}) — the campaign did not exercise its "
             f"subject")
+    _assert_native_coverage(native_sw, f"audit-{fault_seed}")
+    stats.update(native_sw)
     if txn and groups > 1 and not txn_stats.get("txn_decided"):
         # Coverage pin: a --txn trial that never decided one
         # cross-group 2PC never attacked its subject.
@@ -1501,6 +1535,9 @@ def _run_churn_body(fault_seed: int, check_linear: bool = True,
                 snap_stat_sum("snap_chunks_acked")
             churn["delta_snapshots"] = snap_stat_sum("delta_snapshots")
             txn_stats = _txn_sweep(pc) if txn else {}
+            native_sw = _native_sweep(pc) if _native_armed() else {}
+            _assert_native_coverage(native_sw, f"churn-{fault_seed}")
+            churn.update(native_sw)
             ops_checked = 0
             if recorder is not None:
                 with ApusClient(list(pc.spec.peers), timeout=10.0,
@@ -1648,6 +1685,17 @@ def main() -> int:
                          "complete — resumed when the snapshot point "
                          "held still — and membership must never "
                          "wedge).  Suggested: 10000000 (10 MB)")
+    ap.add_argument("--native-plane", action="store_true",
+                    help="run every replica daemon with the NATIVE "
+                         "serving data plane (native/dataplane.cpp: "
+                         "GIL-released client ingest/dedup/group-"
+                         "commit/reply; APUS_NATIVE_PLANE=1 is "
+                         "exported, so ProcCluster children and "
+                         "in-process daemons alike pick it up).  "
+                         "Refuses to run when the extension is not "
+                         "built — a chaos campaign that silently "
+                         "exercised the Python plane would prove "
+                         "nothing.  Repro lines carry the flag")
     ap.add_argument("--dump-obs", default=None, metavar="DIR",
                     help="with --check-linear/--churn: directory for "
                          "the failure-triggered observability dump — "
@@ -1707,6 +1755,16 @@ def main() -> int:
                          "any violation dumps the history JSONL and "
                          "prints the seeded one-command repro")
     args = ap.parse_args()
+    if args.native_plane:
+        from apus_tpu.parallel.native_plane import (load_error,
+                                                    load_extension)
+        if load_extension() is None:
+            print(f"--native-plane: {load_error()}", file=sys.stderr)
+            return 2
+        # Children (ProcCluster daemons) and in-process daemons alike
+        # read the env; the spec stays untouched so restart paths
+        # cannot lose the setting.
+        os.environ["APUS_NATIVE_PLANE"] = "1"
     if args.one_devplane_trial is not None:
         verdict = run_devplane_schedule(args.one_devplane_trial, True)
         print(f"APUS_FUZZ_VERDICT: {verdict}", flush=True)
@@ -1722,7 +1780,8 @@ def main() -> int:
         + (["--groups", str(args.groups)] if args.groups > 1 else []) \
         + (["--split-merge"] if args.split_merge else []) \
         + (["--group-quorum-kill"] if args.group_quorum_kill else []) \
-        + (["--txn"] if args.txn else [])
+        + (["--txn"] if args.txn else []) \
+        + (["--native-plane"] if args.native_plane else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
     else:
@@ -1844,6 +1903,7 @@ def main() -> int:
                    "split_merge": args.split_merge,
                    "group_quorum_kill": args.group_quorum_kill,
                    "txn": args.txn,
+                   "native_plane": args.native_plane,
                    # Audit campaign evidence (banked via eval.py): how
                    # much history the checker proved linearizable, and
                    # under which seeds.  violations is structurally 0

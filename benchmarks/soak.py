@@ -193,7 +193,22 @@ def main() -> int:
                          "bursts included; a violation fails the soak "
                          "and dumps the history JSONL for "
                          "`python -m apus_tpu.audit.linear <dump>`")
+    ap.add_argument("--native-plane", action="store_true",
+                    help="run every replica with the NATIVE serving "
+                         "data plane (native/dataplane.cpp; "
+                         "APUS_NATIVE_PLANE=1 exported to ProcCluster "
+                         "children).  Refuses to run when the "
+                         "extension is not built; the repro line "
+                         "carries the flag")
     args = ap.parse_args()
+
+    if args.native_plane:
+        from apus_tpu.parallel.native_plane import (load_error,
+                                                    load_extension)
+        if load_extension() is None:
+            print(f"--native-plane: {load_error()}", file=sys.stderr)
+            return 2
+        os.environ["APUS_NATIVE_PLANE"] = "1"
 
     from apus_tpu.runtime.appcluster import RespClient, LineClient
     from apus_tpu.runtime.proc import ProcCluster
@@ -998,7 +1013,8 @@ def main() -> int:
               + (" --kv" if args.kv and not args.read_local else "")
               + (" --txn" if args.txn else "")
               + (f" --groups {args.groups}" if args.groups > 1
-                 else ""),
+                 else "")
+              + (" --native-plane" if args.native_plane else ""),
               file=sys.stderr)
     return 0 if ok else 1
 

@@ -770,6 +770,21 @@ def cmd_report(args) -> int:
             f"(max_batch=1 control); lease GETs "
             f"{_fmt(d.get('gets_lease_ops_per_sec'))} ops/sec vs "
             f"read-index {_fmt(d.get('gets_readindex_ops_per_sec'))}")
+        if d.get("ldgen_get_native_ops_per_sec"):
+            # Native data plane (ISSUE 13): server-capacity rows via
+            # the native load generator against BOTH planes.
+            lines.append(
+                f"- NATIVE data plane (GIL-released C++ serving path): "
+                f"raw pipelined GET serving "
+                f"{_fmt(d.get('ldgen_get_native_ops_per_sec'))} ops/sec"
+                f" native vs "
+                f"{_fmt(d.get('ldgen_get_python_ops_per_sec'))} Python "
+                f"({d.get('native_get_gain_ldgen')}x, native loadgen "
+                f"both planes); raw pipelined SET "
+                f"{_fmt(d.get('ldgen_put_native_ops_per_sec'))} vs "
+                f"{_fmt(d.get('ldgen_put_python_ops_per_sec'))} "
+                f"({d.get('native_put_gain_ldgen')}x — write path "
+                f"still bounded by the Python consensus engine)")
     mg = [r for r in runs if r.get("bench") == "bench_throughput_groups"
           and isinstance(r.get("value"), (int, float))]
     if mg:

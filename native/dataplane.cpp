@@ -1,0 +1,1356 @@
+// Native serving data plane: the leader's ingest -> dedup ->
+// group-commit -> reply hot path as a CPython extension with the GIL
+// released (ISSUE 13; the reference is 7k LoC of C precisely because
+// the RSM hot path cannot afford an interpreter — PAPER.md, and
+// ROADMAP "Native hot path").
+//
+// Ownership boundary (what crosses the GIL, what never does):
+//
+//   NEVER holds the GIL (the epoll loop thread, pure C++):
+//     - frame ingest: epoll-driven buffered reads, FrameStream-
+//       equivalent parsing (u32 LE length + payload, 128 MB cap);
+//     - OP_GROUP demux (u8 25 | gid | inner frame);
+//     - endpoint-DB dedup fast path: a retried already-applied
+//       (clt_id, req_id) answers from the native reply cache — the
+//       exact bytes Python's epdb path would produce;
+//     - lease GET serving: CLT_READ GETs answered from the native
+//       applied view while the Python side's published read gate is
+//       live (leader lease or follower lease, Hermes-style write
+//       invalidation: any log write closes the gate synchronously);
+//     - vectored reply flush (one write per reply burst, request
+//       order preserved per connection).
+//
+//   CROSSES the GIL (the node-lock admission boundary, and only it):
+//     - bursts that need consensus (new writes, gate-closed reads,
+//       any non-client op) are handed — pre-parsed, payload slices
+//       only — to Python worker threads pulling from next_work();
+//       they run the daemon's group-commit batch hook (ONE lock
+//       acquisition + ONE commit wait for the burst) and post the
+//       replies back through complete().  Election, membership,
+//       reconfiguration and txn control stay in core/node.py,
+//       untouched.
+//
+// Python control surface (apus_tpu/parallel/native_plane.py is the
+// only caller): Plane(max_burst=...), adopt(fd, initial), next_work,
+// complete, publish/invalidate (read/write gates), view_apply /
+// view_load / view_clear / view_poison (applied view), dedup_put,
+// counters, gid_reads.  Module function loadgen() is a native
+// pipelined load generator used by bench.py to measure the server's
+// data-plane capacity without a Python client bottleneck (run against
+// BOTH planes, so the comparison stays apples-to-apples).
+//
+// Wire layouts mirrored from apus_tpu/parallel/wire.py and
+// runtime/client.py (the compat surface the cross-impl equivalence
+// suite pins byte-identical):
+//   frame:       u32 LE len | payload
+//   client op:   u8 op(16 write / 17 read) | u64 req_id | u64 clt_id
+//                | u32 dlen | data            (optionally OP_GROUP-
+//                wrapped: u8 25 | u8 gid | inner)
+//   reply:       u8 status | u64 req_id | u32 rlen | reply
+//   KVS GET:     data = "G<klen>:<key>"; PUT = "P<klen>:<key><value>"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <arpa/inet.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+#ifndef APUS_MODNAME
+#define APUS_MODNAME apus_dataplane
+#endif
+#define APUS_STR2(x) #x
+#define APUS_STR(x) APUS_STR2(x)
+#define APUS_INIT2(n) PyInit_##n
+#define APUS_INIT1(n) APUS_INIT2(n)
+#define APUS_INIT APUS_INIT1(APUS_MODNAME)
+
+namespace {
+
+constexpr uint8_t OP_CLT_WRITE = 16;
+constexpr uint8_t OP_CLT_READ = 17;
+constexpr uint8_t OP_GROUP = 25;
+constexpr uint8_t ST_OK = 0;
+constexpr uint32_t MAX_FRAME = 1u << 27;   // wire.py's 128 MB sanity cap
+constexpr size_t RECV_CHUNK = 1 << 16;     // FrameStream.RECV parity
+constexpr int MAX_GIDS = 256;              // gid is a u8 on the wire
+
+inline uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+
+inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;                                  // little-endian hosts only
+}
+
+inline uint64_t rd_u64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+inline void put_u32(std::string& s, uint32_t v) {
+  s.append(reinterpret_cast<const char*>(&v), 4);
+}
+
+inline void put_u64(std::string& s, uint64_t v) {
+  s.append(reinterpret_cast<const char*>(&v), 8);
+}
+
+// -- counters --------------------------------------------------------------
+
+enum Counter {
+  C_INGEST_BATCHES = 0,   // bursts drained off connections
+  C_INGEST_FRAMES,        // frames ingested (all paths)
+  C_REPLIES,              // replies answered fully natively
+  C_DEDUP_HITS,           // duplicate writes served from the reply cache
+  C_GET_SERVES,           // GETs served from the applied view
+  C_UPCALL_BATCHES,       // bursts handed across the GIL boundary
+  C_UPCALL_FRAMES,        // frames in those bursts
+  C_RAW_BATCHES,          // upcall bursts that fell to raw-frame mode
+  C_BYTES_IN,
+  C_BYTES_OUT,
+  C_CONNS_ADOPTED,
+  C_GIL_RELEASED_NS,      // loop busy time (never holds the GIL)
+  C_GATE_MISSES,          // GETs that fell to Python (gate closed)
+  C_VIEW_POISONS,         // applied views poisoned (non-P/D op seen)
+  N_COUNTERS,
+};
+
+const char* const COUNTER_NAMES[N_COUNTERS] = {
+    "ingest_batches", "ingest_frames", "replies", "dedup_hits",
+    "get_serves",     "upcall_batches", "upcall_frames", "raw_batches",
+    "bytes_in",       "bytes_out",      "conns_adopted",
+    "gil_released_ns", "gate_misses",   "view_poisons",
+};
+
+// -- parsed client op ------------------------------------------------------
+
+struct ParsedOp {
+  uint8_t op;
+  uint8_t gid;
+  uint64_t req_id;
+  uint64_t clt_id;
+  std::string data;
+};
+
+// Parse one client frame payload.  Returns true iff it is a well-formed
+// CLT_WRITE/CLT_READ (possibly OP_GROUP-wrapped).
+bool parse_client(const uint8_t* p, size_t n, ParsedOp* out) {
+  if (n < 1) return false;
+  size_t off = 0;
+  uint8_t gid = 0;
+  uint8_t op = p[0];
+  if (op == OP_GROUP) {
+    if (n < 3) return false;
+    gid = p[1];
+    op = p[2];
+    off = 2;
+  }
+  if (op != OP_CLT_WRITE && op != OP_CLT_READ) return false;
+  if (n < off + 1 + 8 + 8 + 4) return false;
+  out->op = op;
+  out->gid = gid;
+  out->req_id = rd_u64(p + off + 1);
+  out->clt_id = rd_u64(p + off + 9);
+  uint32_t dlen = rd_u32(p + off + 17);
+  if (off + 21 + (size_t)dlen != n) return false;  // exact-length frames only
+  out->data.assign(reinterpret_cast<const char*>(p + off + 21), dlen);
+  return true;
+}
+
+// Key of a "G<klen>:<key>" command (the only read the native view
+// serves); false for anything else, including SMEMBERS (falls to
+// Python, which knows the canonical set encoding).
+bool parse_get_key(const std::string& d, std::string* key) {
+  if (d.size() < 3 || d[0] != 'G') return false;
+  size_t colon = d.find(':', 1);
+  if (colon == std::string::npos || colon == 1) return false;
+  uint64_t klen = 0;
+  for (size_t i = 1; i < colon; i++) {
+    if (d[i] < '0' || d[i] > '9') return false;
+    klen = klen * 10 + (d[i] - '0');
+    if (klen > d.size()) return false;
+  }
+  if (d.size() - colon - 1 != klen) return false;  // G frames carry key only
+  key->assign(d, colon + 1, klen);
+  return true;
+}
+
+// -- per-group state -------------------------------------------------------
+
+struct GidState {
+  // applied view (KVS mirror, maintained by view_apply/view_load under
+  // the plane mutex at apply time)
+  std::unordered_map<std::string, std::string> view;
+  size_t view_bytes = 0;
+  bool poisoned = false;       // a non-P/D/G apply made the mirror stale
+  bool loaded = false;         // view_load ran (serve empty-view GETs)
+  // read gate: absolute CLOCK_MONOTONIC ns deadline published by the
+  // Python tick while the lease is live and applied == end; 0 = closed.
+  // Any log write / truncation / snapshot install invalidates it
+  // synchronously (Hermes-style write invalidation on the log).
+  std::atomic<uint64_t> read_deadline_ns{0};
+  // write gate: leader as of the last tick — the dedup fast path only
+  // answers while it would answer identically to Python's submit().
+  std::atomic<bool> write_gate{false};
+  std::atomic<uint64_t> reads_served{0};
+  // dedup reply cache: clt_id -> (last applied req_id, last reply) —
+  // exactly epdb's per-client monotone rule; populated from replies
+  // this plane delivered, so it is always a subset of epdb state.
+  std::unordered_map<uint64_t, std::pair<uint64_t, std::string>> dedup;
+};
+
+// -- connection ------------------------------------------------------------
+
+struct Conn {
+  uint64_t id;
+  int fd;
+  std::string in;              // unparsed inbound bytes
+  std::deque<std::string> pending;  // complete frame payloads, FIFO
+  std::string out;             // framed reply bytes awaiting flush
+  bool busy = false;           // a Python batch is outstanding
+  bool eof = false;
+  bool dead = false;
+  bool want_write = false;
+};
+
+struct BatchRec {
+  uint64_t conn_id;
+  size_t nframes;
+  // parsed mode: ops[i] mirrors frames[i]; raw mode: ops empty
+  std::vector<ParsedOp> ops;
+  std::vector<std::string> frames;   // raw payloads (raw mode only)
+  bool parsed = false;
+  bool taken = false;                // popped by a worker
+};
+
+struct Done {
+  uint64_t batch_id;
+  std::vector<std::string> replies;
+};
+
+// -- the plane -------------------------------------------------------------
+
+struct Plane {
+  PyObject_HEAD
+  int epfd = -1;
+  int evfd = -1;
+  std::thread* loop = nullptr;
+  std::mutex mu;
+  std::condition_variable work_cv;
+  bool running = false;
+  bool stopping = false;
+  int max_burst = 256;
+  bool dedup_enabled = true;
+  size_t dedup_max_reply = 1 << 16;
+  size_t view_max_bytes = size_t(256) << 20;
+
+  uint64_t next_conn_id = 1;
+  uint64_t next_batch_id = 1;
+  std::unordered_map<uint64_t, Conn*> conns;        // id -> conn
+  std::unordered_map<int, uint64_t> by_fd;
+  std::deque<uint64_t> work_q;                      // batch ids awaiting a worker
+  std::unordered_map<uint64_t, BatchRec*> batches;  // outstanding batches
+  std::deque<Done> done_q;                          // completions for the loop
+  GidState* gids[MAX_GIDS] = {nullptr};
+
+  std::atomic<uint64_t> counters[N_COUNTERS];
+
+  GidState* gid_state(uint8_t g) {
+    GidState* s = gids[g];
+    if (s == nullptr) {
+      s = new GidState();
+      gids[g] = s;
+    }
+    return s;
+  }
+
+  void bump(Counter c, uint64_t n = 1) {
+    counters[c].fetch_add(n, std::memory_order_relaxed);
+  }
+};
+
+void wake_loop(Plane* p) {
+  uint64_t one = 1;
+  ssize_t r = write(p->evfd, &one, 8);
+  (void)r;
+}
+
+void conn_close(Plane* p, Conn* c, bool rst) {
+  if (c->fd >= 0) {
+    if (rst) {
+      // RST-close (linger 0), matching PeerServer.stop's crash-fault
+      // fidelity: a stopped replica's clients see a dead peer, and the
+      // port is immediately rebindable.
+      struct linger lg = {1, 0};
+      setsockopt(c->fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    }
+    epoll_ctl(p->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    p->by_fd.erase(c->fd);
+    c->fd = -1;
+  }
+  c->dead = true;
+}
+
+// Try to flush c->out; register EPOLLOUT interest on partial writes.
+void conn_flush(Plane* p, Conn* c) {
+  while (!c->out.empty() && c->fd >= 0) {
+    ssize_t n = send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+    if (n > 0) {
+      p->bump(C_BYTES_OUT, (uint64_t)n);
+      c->out.erase(0, (size_t)n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    conn_close(p, c, false);
+    return;
+  }
+  bool want = !c->out.empty();
+  if (want != c->want_write && c->fd >= 0) {
+    c->want_write = want;
+    struct epoll_event ev;
+    ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
+    ev.data.u64 = c->id;
+    epoll_ctl(p->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+}
+
+// Append one framed reply to the out buffer.
+void enqueue_reply(Conn* c, const std::string& reply) {
+  put_u32(c->out, (uint32_t)reply.size());
+  c->out.append(reply);
+}
+
+// Classify one frame for the fully-native fast path.  Returns true
+// (with *reply built) iff it can be answered without Python.
+bool try_native_answer(Plane* p, const std::string& frame,
+                       std::string* reply) {
+  ParsedOp op;
+  if (!parse_client(reinterpret_cast<const uint8_t*>(frame.data()),
+                    frame.size(), &op))
+    return false;
+  GidState* g = p->gids[op.gid];
+  if (g == nullptr) return false;
+  if (op.op == OP_CLT_WRITE) {
+    // epdb dedup fast path: duplicate_of_applied semantics —
+    // req_id <= last applied req_id answers the cached last reply.
+    if (!p->dedup_enabled ||
+        !g->write_gate.load(std::memory_order_acquire))
+      return false;
+    auto it = g->dedup.find(op.clt_id);
+    if (it == g->dedup.end() || op.req_id > it->second.first)
+      return false;
+    reply->clear();
+    reply->push_back((char)ST_OK);
+    put_u64(*reply, op.req_id);
+    put_u32(*reply, (uint32_t)it->second.second.size());
+    reply->append(it->second.second);
+    p->bump(C_DEDUP_HITS);
+    return true;
+  }
+  // CLT_READ: GETs from the applied view while the read gate is live.
+  std::string key;
+  if (!parse_get_key(op.data, &key)) return false;
+  if (g->poisoned || !g->loaded) return false;
+  uint64_t dl = g->read_deadline_ns.load(std::memory_order_acquire);
+  if (dl == 0 || now_ns() >= dl) {
+    p->bump(C_GATE_MISSES);
+    return false;
+  }
+  auto it = g->view.find(key);
+  const std::string* val = it == g->view.end() ? nullptr : &it->second;
+  reply->clear();
+  reply->push_back((char)ST_OK);
+  put_u64(*reply, op.req_id);
+  put_u32(*reply, val ? (uint32_t)val->size() : 0);
+  if (val) reply->append(*val);
+  p->bump(C_GET_SERVES);
+  g->reads_served.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// Drive a connection forward: answer native-answerable frames in
+// order, hand the next burst to Python, flush.  Caller holds p->mu.
+void process_conn(Plane* p, Conn* c) {
+  while (!c->dead && !c->busy && !c->pending.empty()) {
+    // Greedy native prefix: answered immediately, in request order.
+    std::string reply;
+    bool burst = false;
+    while (!c->pending.empty() &&
+           try_native_answer(p, c->pending.front(), &reply)) {
+      enqueue_reply(c, reply);
+      c->pending.pop_front();
+      p->bump(C_REPLIES);
+      burst = true;
+    }
+    if (c->pending.empty()) {
+      if (burst) conn_flush(p, c);
+      break;
+    }
+    // The head frame needs Python: assemble a burst (MAX_BURST
+    // semantics preserved — whatever is already queued, capped) and
+    // hand it across the admission boundary.
+    BatchRec* b = new BatchRec();
+    b->conn_id = c->id;
+    b->parsed = true;
+    size_t take = c->pending.size();
+    if ((int)take > p->max_burst) take = (size_t)p->max_burst;
+    b->nframes = take;
+    b->ops.reserve(take);
+    for (size_t i = 0; i < take; i++) {
+      std::string& f = c->pending.front();
+      ParsedOp op;
+      if (b->parsed &&
+          parse_client(reinterpret_cast<const uint8_t*>(f.data()),
+                       f.size(), &op)) {
+        b->ops.push_back(std::move(op));
+      } else {
+        // A non-client frame anywhere in the burst drops the whole
+        // burst to raw mode (Python dispatches it correctly).
+        b->parsed = false;
+        b->ops.clear();
+      }
+      b->frames.push_back(std::move(f));
+      c->pending.pop_front();
+    }
+    if (b->parsed) b->frames.clear();   // payloads live in ops[].data
+    uint64_t bid = p->next_batch_id++;
+    p->batches[bid] = b;
+    c->busy = true;
+    p->bump(C_UPCALL_BATCHES);
+    p->bump(C_UPCALL_FRAMES, b->nframes);
+    if (!b->parsed) p->bump(C_RAW_BATCHES);
+    p->work_q.push_back(bid);
+    p->work_cv.notify_one();
+    break;
+  }
+  conn_flush(p, c);
+  if (!c->dead && c->eof && !c->busy && c->pending.empty() &&
+      c->out.empty())
+    conn_close(p, c, false);
+}
+
+// Parse complete frames out of c->in into c->pending.  Returns false
+// on a protocol error (oversized frame).
+bool parse_frames(Plane* p, Conn* c) {
+  size_t off = 0;
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(c->in.data());
+  size_t navail = c->in.size();
+  bool got = false;
+  while (navail - off >= 4) {
+    uint32_t n = rd_u32(base + off);
+    if (n > MAX_FRAME) return false;
+    if (navail - off - 4 < n) break;
+    c->pending.emplace_back(reinterpret_cast<const char*>(base + off + 4),
+                            (size_t)n);
+    off += 4 + n;
+    got = true;
+  }
+  if (off > 0) c->in.erase(0, off);
+  if (got) {
+    p->bump(C_INGEST_BATCHES);
+  }
+  return true;
+}
+
+void conn_readable(Plane* p, Conn* c) {
+  size_t nparsed0 = c->pending.size();
+  while (c->fd >= 0) {
+    size_t old = c->in.size();
+    c->in.resize(old + RECV_CHUNK);
+    ssize_t n = recv(c->fd, &c->in[old], RECV_CHUNK, 0);
+    if (n > 0) {
+      c->in.resize(old + (size_t)n);
+      p->bump(C_BYTES_IN, (uint64_t)n);
+      if ((size_t)n < RECV_CHUNK) break;   // drained the socket
+      continue;
+    }
+    c->in.resize(old);
+    if (n == 0) {
+      c->eof = true;
+      break;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    conn_close(p, c, false);
+    return;
+  }
+  if (!parse_frames(p, c)) {
+    conn_close(p, c, false);
+    return;
+  }
+  p->bump(C_INGEST_FRAMES, c->pending.size() - nparsed0);
+  process_conn(p, c);
+  if (c->eof && !c->dead && !c->busy && c->pending.empty() &&
+      c->out.empty())
+    conn_close(p, c, false);
+}
+
+void drain_done(Plane* p) {
+  while (!p->done_q.empty()) {
+    Done d = std::move(p->done_q.front());
+    p->done_q.pop_front();
+    auto bit = p->batches.find(d.batch_id);
+    if (bit == p->batches.end()) continue;
+    BatchRec* b = bit->second;
+    p->batches.erase(bit);
+    auto cit = p->conns.find(b->conn_id);
+    if (cit != p->conns.end()) {
+      Conn* c = cit->second;
+      c->busy = false;
+      if (!c->dead) {
+        for (auto& r : d.replies) {
+          enqueue_reply(c, r);
+          p->bump(C_REPLIES);
+        }
+        process_conn(p, c);
+      }
+      if (c->dead) {
+        p->conns.erase(cit);
+        delete c;
+      }
+    }
+    delete b;
+  }
+}
+
+void loop_main(Plane* p) {
+  constexpr int MAXEV = 64;
+  struct epoll_event evs[MAXEV];
+  for (;;) {
+    int n = epoll_wait(p->epfd, evs, MAXEV, 100);
+    uint64_t t0 = now_ns();
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->stopping) break;
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.u64 == 0) {        // eventfd wake
+        uint64_t buf;
+        ssize_t r = read(p->evfd, &buf, 8);
+        (void)r;
+        continue;
+      }
+      auto it = p->conns.find(evs[i].data.u64);
+      if (it == p->conns.end()) continue;
+      Conn* c = it->second;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        conn_close(p, c, false);
+      } else {
+        if (evs[i].events & EPOLLOUT) conn_flush(p, c);
+        if (evs[i].events & EPOLLIN) conn_readable(p, c);
+      }
+      if (c->dead && !c->busy) {
+        p->conns.erase(c->id);
+        delete c;
+      }
+    }
+    drain_done(p);
+    p->bump(C_GIL_RELEASED_NS, now_ns() - t0);
+  }
+}
+
+// -- Plane Python type -----------------------------------------------------
+
+PyObject* plane_new(PyTypeObject* type, PyObject*, PyObject*) {
+  Plane* self = (Plane*)type->tp_alloc(type, 0);
+  if (self == nullptr) return nullptr;
+  // tp_alloc zero-fills; placement-construct the C++ members.
+  new (&self->mu) std::mutex();
+  new (&self->work_cv) std::condition_variable();
+  new (&self->conns) std::unordered_map<uint64_t, Conn*>();
+  new (&self->by_fd) std::unordered_map<int, uint64_t>();
+  new (&self->work_q) std::deque<uint64_t>();
+  new (&self->batches) std::unordered_map<uint64_t, BatchRec*>();
+  new (&self->done_q) std::deque<Done>();
+  self->epfd = -1;
+  self->evfd = -1;
+  self->loop = nullptr;
+  self->running = false;
+  self->stopping = false;
+  self->max_burst = 256;
+  self->dedup_enabled = true;
+  self->dedup_max_reply = 1 << 16;
+  self->view_max_bytes = size_t(256) << 20;
+  self->next_conn_id = 1;
+  self->next_batch_id = 1;
+  for (int i = 0; i < MAX_GIDS; i++) self->gids[i] = nullptr;
+  for (int i = 0; i < N_COUNTERS; i++)
+    self->counters[i].store(0, std::memory_order_relaxed);
+  return (PyObject*)self;
+}
+
+int plane_init(PyObject* raw, PyObject* args, PyObject* kwargs) {
+  Plane* p = (Plane*)raw;
+  static const char* kws[] = {"max_burst", "dedup", "view_max_bytes",
+                              nullptr};
+  int max_burst = 256;
+  int dedup = 1;
+  unsigned long long view_max = (unsigned long long)(size_t(256) << 20);
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|ipK",
+                                   const_cast<char**>(kws), &max_burst,
+                                   &dedup, &view_max))
+    return -1;
+  p->max_burst = max_burst > 0 ? max_burst : 256;
+  p->dedup_enabled = dedup != 0;
+  p->view_max_bytes = (size_t)view_max;
+  return 0;
+}
+
+void plane_stop_impl(Plane* p) {
+  std::thread* t = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (!p->running) return;
+    p->stopping = true;
+    p->running = false;
+    t = p->loop;
+    p->loop = nullptr;
+    p->work_cv.notify_all();
+  }
+  wake_loop(p);
+  if (t != nullptr) {
+    Py_BEGIN_ALLOW_THREADS
+    t->join();
+    Py_END_ALLOW_THREADS
+    delete t;
+  }
+  std::unique_lock<std::mutex> lk(p->mu);
+  for (auto& kv : p->conns) {
+    conn_close(p, kv.second, true);
+    delete kv.second;
+  }
+  p->conns.clear();
+  p->by_fd.clear();
+  for (auto& kv : p->batches) delete kv.second;
+  p->batches.clear();
+  p->work_q.clear();
+  p->done_q.clear();
+  if (p->epfd >= 0) close(p->epfd);
+  if (p->evfd >= 0) close(p->evfd);
+  p->epfd = -1;
+  p->evfd = -1;
+}
+
+void plane_dealloc(PyObject* raw) {
+  Plane* p = (Plane*)raw;
+  plane_stop_impl(p);
+  for (int i = 0; i < MAX_GIDS; i++) delete p->gids[i];
+  p->conns.~unordered_map();
+  p->by_fd.~unordered_map();
+  p->work_q.~deque();
+  p->batches.~unordered_map();
+  p->done_q.~deque();
+  p->work_cv.~condition_variable();
+  p->mu.~mutex();
+  Py_TYPE(raw)->tp_free(raw);
+}
+
+PyObject* plane_start(PyObject* raw, PyObject*) {
+  Plane* p = (Plane*)raw;
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->running) Py_RETURN_NONE;
+  p->epfd = epoll_create1(EPOLL_CLOEXEC);
+  p->evfd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (p->epfd < 0 || p->evfd < 0) {
+    PyErr_SetFromErrno(PyExc_OSError);
+    return nullptr;
+  }
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;                       // 0 = the eventfd
+  epoll_ctl(p->epfd, EPOLL_CTL_ADD, p->evfd, &ev);
+  p->stopping = false;
+  p->running = true;
+  p->loop = new std::thread(loop_main, p);
+  Py_RETURN_NONE;
+}
+
+PyObject* plane_stop(PyObject* raw, PyObject*) {
+  plane_stop_impl((Plane*)raw);
+  Py_RETURN_NONE;
+}
+
+PyObject* plane_adopt(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int fd;
+  Py_buffer initial;
+  if (!PyArg_ParseTuple(args, "iy*", &fd, &initial)) return nullptr;
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (!p->running) {
+    PyBuffer_Release(&initial);
+    Py_RETURN_FALSE;
+  }
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  Conn* c = new Conn();
+  c->id = p->next_conn_id++;
+  c->fd = fd;
+  if (initial.len > 0)
+    c->in.assign((const char*)initial.buf, (size_t)initial.len);
+  PyBuffer_Release(&initial);
+  p->conns[c->id] = c;
+  p->by_fd[fd] = c->id;
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = c->id;
+  if (epoll_ctl(p->epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+    p->conns.erase(c->id);
+    p->by_fd.erase(fd);
+    close(fd);
+    delete c;
+    Py_RETURN_FALSE;
+  }
+  p->bump(C_CONNS_ADOPTED);
+  // Any bytes the Python FrameStream had buffered (the adoption frame
+  // included) are processed by the loop on this wake.
+  if (!c->in.empty()) {
+    if (!parse_frames(p, c)) {
+      conn_close(p, c, false);
+      p->conns.erase(c->id);
+      delete c;
+      Py_RETURN_FALSE;
+    }
+    p->bump(C_INGEST_FRAMES, c->pending.size());
+    process_conn(p, c);
+    if (c->dead && !c->busy) {
+      p->conns.erase(c->id);
+      delete c;
+      lk.unlock();
+      wake_loop(p);
+      Py_RETURN_TRUE;
+    }
+  }
+  lk.unlock();
+  wake_loop(p);
+  Py_RETURN_TRUE;
+}
+
+// next_work(timeout) -> None | (batch_id, parsed: bool, items)
+//   parsed:  items = [(gid, op, req_id, clt_id, data-bytes), ...]
+//   raw:     items = [frame-bytes, ...]
+PyObject* plane_next_work(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  double timeout = 0.5;
+  if (!PyArg_ParseTuple(args, "|d", &timeout)) return nullptr;
+  BatchRec* b = nullptr;
+  uint64_t bid = 0;
+  // The mutex MUST be released before Py_END_ALLOW_THREADS reacquires
+  // the GIL (hence the explicit scope): holding it across the GIL
+  // acquire inverts against every GIL-holding caller of publish/
+  // invalidate/view_apply and wedges the whole daemon.
+  Py_BEGIN_ALLOW_THREADS
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->work_q.empty() && !p->stopping && timeout > 0) {
+      p->work_cv.wait_for(lk, std::chrono::duration<double>(timeout),
+                          [&] {
+                            return !p->work_q.empty() || p->stopping;
+                          });
+    }
+    if (!p->work_q.empty() && !p->stopping) {
+      bid = p->work_q.front();
+      p->work_q.pop_front();
+      auto it = p->batches.find(bid);
+      if (it != p->batches.end()) {
+        b = it->second;
+        b->taken = true;
+      }
+    }
+  }
+  Py_END_ALLOW_THREADS
+  if (b == nullptr) Py_RETURN_NONE;
+  // Build the Python view OUTSIDE the plane mutex: the batch is
+  // exclusively this worker's until complete().
+  PyObject* items = PyList_New((Py_ssize_t)b->nframes);
+  if (items == nullptr) return nullptr;
+  if (b->parsed) {
+    for (size_t i = 0; i < b->ops.size(); i++) {
+      ParsedOp& op = b->ops[i];
+      PyObject* tup = Py_BuildValue(
+          "(BBKKy#)", op.gid, op.op, (unsigned long long)op.req_id,
+          (unsigned long long)op.clt_id, op.data.data(),
+          (Py_ssize_t)op.data.size());
+      if (tup == nullptr) {
+        Py_DECREF(items);
+        return nullptr;
+      }
+      PyList_SET_ITEM(items, (Py_ssize_t)i, tup);
+    }
+  } else {
+    for (size_t i = 0; i < b->frames.size(); i++) {
+      PyObject* f = PyBytes_FromStringAndSize(
+          b->frames[i].data(), (Py_ssize_t)b->frames[i].size());
+      if (f == nullptr) {
+        Py_DECREF(items);
+        return nullptr;
+      }
+      PyList_SET_ITEM(items, (Py_ssize_t)i, f);
+    }
+  }
+  PyObject* out = Py_BuildValue("(KNN)", (unsigned long long)bid,
+                                PyBool_FromLong(b->parsed ? 1 : 0), items);
+  return out;
+}
+
+// complete(batch_id, replies: list[bytes]) — post replies for a batch;
+// ALSO records dedup cache entries for OK write replies (parsed
+// batches), so the native fast path learns exactly what this plane
+// itself acked.
+PyObject* plane_complete(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  unsigned long long bid;
+  PyObject* replies;
+  if (!PyArg_ParseTuple(args, "KO", &bid, &replies)) return nullptr;
+  if (!PyList_Check(replies)) {
+    PyErr_SetString(PyExc_TypeError, "replies must be a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(replies);
+  Done d;
+  d.batch_id = bid;
+  d.replies.reserve((size_t)n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* r = PyList_GET_ITEM(replies, i);
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) return nullptr;
+    d.replies.emplace_back(buf, (size_t)len);
+  }
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    auto it = p->batches.find(bid);
+    if (it != p->batches.end() && it->second->parsed &&
+        p->dedup_enabled) {
+      BatchRec* b = it->second;
+      size_t m = b->ops.size() < d.replies.size() ? b->ops.size()
+                                                  : d.replies.size();
+      for (size_t i = 0; i < m; i++) {
+        ParsedOp& op = b->ops[i];
+        const std::string& r = d.replies[i];
+        // reply: u8 ST_OK | u64 req | u32 rlen | body
+        if (op.op != OP_CLT_WRITE || r.size() < 13 ||
+            (uint8_t)r[0] != ST_OK)
+          continue;
+        size_t body = r.size() - 13;
+        if (body > p->dedup_max_reply) continue;
+        GidState* g = p->gid_state(op.gid);
+        auto& slot = g->dedup[op.clt_id];
+        if (op.req_id >= slot.first) {
+          slot.first = op.req_id;
+          slot.second.assign(r, 13, body);
+        }
+      }
+    }
+    p->done_q.push_back(std::move(d));
+  }
+  wake_loop(p);
+  Py_RETURN_NONE;
+}
+
+// publish(gid, leaderish, read_valid_ns): per-tick gate refresh.
+PyObject* plane_publish(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  int leaderish;
+  unsigned long long valid_ns;
+  if (!PyArg_ParseTuple(args, "ipK", &gid, &leaderish, &valid_ns))
+    return nullptr;
+  if (gid < 0 || gid >= MAX_GIDS) {
+    PyErr_SetString(PyExc_ValueError, "gid out of range");
+    return nullptr;
+  }
+  GidState* g;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    g = p->gid_state((uint8_t)gid);
+  }
+  g->write_gate.store(leaderish != 0, std::memory_order_release);
+  g->read_deadline_ns.store(
+      valid_ns == 0 ? 0 : now_ns() + valid_ns, std::memory_order_release);
+  Py_RETURN_NONE;
+}
+
+// invalidate(gid=-1): synchronous read-gate kill (log write arrived,
+// role/config moved, clock jumped).  gid -1 = every group.
+PyObject* plane_invalidate(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid = -1;
+  if (!PyArg_ParseTuple(args, "|i", &gid)) return nullptr;
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (gid >= 0 && gid < MAX_GIDS) {
+    GidState* g = p->gids[gid];
+    if (g != nullptr)
+      g->read_deadline_ns.store(0, std::memory_order_release);
+  } else {
+    for (int i = 0; i < MAX_GIDS; i++)
+      if (p->gids[i] != nullptr)
+        p->gids[i]->read_deadline_ns.store(0, std::memory_order_release);
+  }
+  Py_RETURN_NONE;
+}
+
+// view_apply(gid, data) -> 0 applied/ignored, 1 poisoned.  Mirrors
+// KvsStateMachine.apply for P (put) and D (delete); read ops are
+// no-ops; ANYTHING else makes the mirror stale -> poison (the read
+// gate then never serves this group again until view_load rebuilds).
+PyObject* plane_view_apply(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  Py_buffer data;
+  if (!PyArg_ParseTuple(args, "iy*", &gid, &data)) return nullptr;
+  const char* d = (const char*)data.buf;
+  size_t n = (size_t)data.len;
+  int poisoned = 0;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    GidState* g = p->gid_state((uint8_t)(gid & 0xff));
+    auto poison = [&]() {
+      if (!g->poisoned) {
+        g->poisoned = true;
+        g->view.clear();
+        g->view_bytes = 0;
+        p->bump(C_VIEW_POISONS);
+      }
+      g->read_deadline_ns.store(0, std::memory_order_release);
+      poisoned = 1;
+    };
+    if (g->poisoned) {
+      poisoned = 1;
+    } else if (n == 0) {
+      poison();
+    } else if (d[0] == 'G') {
+      // read: no-op
+    } else if (d[0] == 'P' || d[0] == 'D') {
+      size_t colon = 0;
+      uint64_t klen = 0;
+      bool ok = false;
+      for (size_t i = 1; i < n && i < 24; i++) {
+        if (d[i] == ':') {
+          colon = i;
+          ok = i > 1;
+          break;
+        }
+        if (d[i] < '0' || d[i] > '9') break;
+        klen = klen * 10 + (uint64_t)(d[i] - '0');
+      }
+      if (!ok || colon + 1 + klen > n) {
+        poison();                 // Python's apply would have raised
+      } else {
+        std::string key(d + colon + 1, (size_t)klen);
+        if (d[0] == 'P') {
+          std::string val(d + colon + 1 + klen, n - colon - 1 - klen);
+          auto it = g->view.find(key);
+          if (it != g->view.end()) {
+            g->view_bytes -= it->second.size();
+            g->view_bytes += val.size();
+            it->second = std::move(val);
+          } else {
+            g->view_bytes += key.size() + val.size();
+            g->view.emplace(std::move(key), std::move(val));
+          }
+          if (g->view_bytes > p->view_max_bytes) poison();
+        } else {
+          auto it = g->view.find(key);
+          if (it != g->view.end()) {
+            g->view_bytes -= it->first.size() + it->second.size();
+            g->view.erase(it);
+          }
+        }
+      }
+    } else {
+      // typed RDT / txn / migration / unknown op: the mirror cannot
+      // track it — poison, Python serves this group's reads from here.
+      poison();
+    }
+  }
+  PyBuffer_Release(&data);
+  return PyLong_FromLong(poisoned);
+}
+
+// view_load(gid, items): bulk (re)load from the SM store; clears the
+// poison flag and marks the view serveable.
+PyObject* plane_view_load(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  PyObject* items;
+  if (!PyArg_ParseTuple(args, "iO", &gid, &items)) return nullptr;
+  PyObject* seq = PySequence_Fast(items, "items must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::unordered_map<std::string, std::string> fresh;
+  size_t bytes = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* kv = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* k;
+    PyObject* v;
+    if (!PyTuple_Check(kv) || PyTuple_GET_SIZE(kv) != 2) {
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_TypeError, "items must be (key, value) pairs");
+      return nullptr;
+    }
+    k = PyTuple_GET_ITEM(kv, 0);
+    v = PyTuple_GET_ITEM(kv, 1);
+    char *kb, *vb;
+    Py_ssize_t kl, vl;
+    if (PyBytes_AsStringAndSize(k, &kb, &kl) != 0 ||
+        PyBytes_AsStringAndSize(v, &vb, &vl) != 0) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    bytes += (size_t)kl + (size_t)vl;
+    fresh[std::string(kb, (size_t)kl)] = std::string(vb, (size_t)vl);
+  }
+  Py_DECREF(seq);
+  std::unique_lock<std::mutex> lk(p->mu);
+  GidState* g = p->gid_state((uint8_t)(gid & 0xff));
+  if (bytes > p->view_max_bytes) {
+    g->poisoned = true;
+    g->view.clear();
+    g->view_bytes = 0;
+    g->read_deadline_ns.store(0, std::memory_order_release);
+    return PyLong_FromLong(1);
+  }
+  g->view = std::move(fresh);
+  g->view_bytes = bytes;
+  g->poisoned = false;
+  g->loaded = true;
+  return PyLong_FromLong(0);
+}
+
+PyObject* plane_view_clear(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  if (!PyArg_ParseTuple(args, "i", &gid)) return nullptr;
+  std::unique_lock<std::mutex> lk(p->mu);
+  GidState* g = p->gid_state((uint8_t)(gid & 0xff));
+  g->view.clear();
+  g->view_bytes = 0;
+  g->loaded = false;
+  g->read_deadline_ns.store(0, std::memory_order_release);
+  Py_RETURN_NONE;
+}
+
+PyObject* plane_view_poison(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  if (!PyArg_ParseTuple(args, "i", &gid)) return nullptr;
+  std::unique_lock<std::mutex> lk(p->mu);
+  GidState* g = p->gid_state((uint8_t)(gid & 0xff));
+  if (!g->poisoned) {
+    g->poisoned = true;
+    p->bump(C_VIEW_POISONS);
+  }
+  g->view.clear();
+  g->view_bytes = 0;
+  g->read_deadline_ns.store(0, std::memory_order_release);
+  Py_RETURN_NONE;
+}
+
+PyObject* plane_dedup_put(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  unsigned long long clt, req;
+  Py_buffer reply;
+  if (!PyArg_ParseTuple(args, "iKKy*", &gid, &clt, &req, &reply))
+    return nullptr;
+  if ((size_t)reply.len <= p->dedup_max_reply) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    GidState* g = p->gid_state((uint8_t)(gid & 0xff));
+    auto& slot = g->dedup[(uint64_t)clt];
+    if ((uint64_t)req >= slot.first) {
+      slot.first = (uint64_t)req;
+      slot.second.assign((const char*)reply.buf, (size_t)reply.len);
+    }
+  }
+  PyBuffer_Release(&reply);
+  Py_RETURN_NONE;
+}
+
+PyObject* plane_counters(PyObject* raw, PyObject*) {
+  Plane* p = (Plane*)raw;
+  PyObject* d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (int i = 0; i < N_COUNTERS; i++) {
+    PyObject* v = PyLong_FromUnsignedLongLong(
+        p->counters[i].load(std::memory_order_relaxed));
+    if (v == nullptr || PyDict_SetItemString(d, COUNTER_NAMES[i], v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+PyObject* plane_gid_reads(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int gid;
+  if (!PyArg_ParseTuple(args, "i", &gid)) return nullptr;
+  uint64_t v = 0;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (gid >= 0 && gid < MAX_GIDS && p->gids[gid] != nullptr)
+      v = p->gids[gid]->reads_served.load(std::memory_order_relaxed);
+  }
+  return PyLong_FromUnsignedLongLong(v);
+}
+
+PyObject* plane_conn_count(PyObject* raw, PyObject*) {
+  Plane* p = (Plane*)raw;
+  std::unique_lock<std::mutex> lk(p->mu);
+  return PyLong_FromSize_t(p->conns.size());
+}
+
+PyMethodDef plane_methods[] = {
+    {"start", plane_start, METH_NOARGS, "start the epoll loop thread"},
+    {"stop", plane_stop, METH_NOARGS,
+     "stop the loop and RST-close every adopted connection"},
+    {"adopt", plane_adopt, METH_VARARGS,
+     "adopt(fd, initial_bytes) -> bool: take ownership of a client "
+     "connection (fd must be detached by the caller)"},
+    {"next_work", plane_next_work, METH_VARARGS,
+     "next_work(timeout) -> None | (batch_id, parsed, items): worker "
+     "pull; blocks with the GIL released"},
+    {"complete", plane_complete, METH_VARARGS,
+     "complete(batch_id, replies): post a batch's replies (also feeds "
+     "the dedup reply cache for OK writes)"},
+    {"publish", plane_publish, METH_VARARGS,
+     "publish(gid, leaderish, read_valid_ns): per-tick gate refresh"},
+    {"invalidate", plane_invalidate, METH_VARARGS,
+     "invalidate(gid=-1): synchronous read-gate kill"},
+    {"view_apply", plane_view_apply, METH_VARARGS,
+     "view_apply(gid, data) -> poisoned: mirror one applied command"},
+    {"view_load", plane_view_load, METH_VARARGS,
+     "view_load(gid, [(k, v), ...]) -> poisoned: bulk (re)load"},
+    {"view_clear", plane_view_clear, METH_VARARGS, "drop a group's view"},
+    {"view_poison", plane_view_poison, METH_VARARGS,
+     "mark a group's view permanently stale"},
+    {"dedup_put", plane_dedup_put, METH_VARARGS,
+     "dedup_put(gid, clt_id, req_id, reply): seed the reply cache"},
+    {"counters", plane_counters, METH_NOARGS, "counter snapshot dict"},
+    {"gid_reads", plane_gid_reads, METH_VARARGS,
+     "native GETs served for one group"},
+    {"conn_count", plane_conn_count, METH_NOARGS, "adopted live conns"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject PlaneType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+// -- loadgen ---------------------------------------------------------------
+// Native pipelined load generator: drives `window`-deep bursts of PUT
+// or GET client ops at one endpoint for `seconds`, counting OK
+// replies.  Runs entirely with the GIL released.  bench.py uses it to
+// measure the SERVER data plane's capacity against both planes without
+// a Python-client CPU bottleneck; rtt_us adds one sleep per window
+// (the emulated-link methodology of bench --throughput).
+
+ssize_t send_all(int fd, const char* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = send(fd, buf + off, n - off, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    off += (size_t)w;
+  }
+  return (ssize_t)off;
+}
+
+PyObject* mod_loadgen(PyObject*, PyObject* args, PyObject* kwargs) {
+  static const char* kws[] = {"host",   "port",   "seconds", "window",
+                              "op",     "gid",    "nkeys",   "vlen",
+                              "rtt_us", "prefix", nullptr};
+  const char* host;
+  int port;
+  double seconds = 2.0;
+  int window = 64;
+  const char* opname = "put";
+  int gid = 0;
+  int nkeys = 64;
+  int vlen = 64;
+  long rtt_us = 0;
+  const char* prefix = "nlg";
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "si|disiiils", const_cast<char**>(kws), &host,
+          &port, &seconds, &window, &opname, &gid, &nkeys, &vlen, &rtt_us,
+          &prefix))
+    return nullptr;
+  bool puts = strcmp(opname, "put") == 0;
+  if (!puts && strcmp(opname, "get") != 0) {
+    PyErr_SetString(PyExc_ValueError, "op must be 'put' or 'get'");
+    return nullptr;
+  }
+  if (window < 1) window = 1;
+  if (nkeys < 1) nkeys = 1;
+
+  uint64_t ok = 0, fails = 0, notleader = 0;
+  double elapsed = 0.0;
+  int err = 0;
+
+  Py_BEGIN_ALLOW_THREADS {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &sa.sin_addr) != 1 ||
+        connect(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+      err = 1;
+    } else {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint64_t clt_id = now_ns() | 1;    // fresh per call (epdb identity)
+      uint64_t req_seq = 0;
+      std::string value((size_t)(vlen > 0 ? vlen : 1), 'v');
+      uint64_t t_end = now_ns() + (uint64_t)(seconds * 1e9);
+      uint64_t t0 = now_ns();
+      std::string sendbuf;
+      std::vector<uint64_t> reqids((size_t)window);
+      std::string rbuf;
+      while (now_ns() < t_end && err == 0) {
+        sendbuf.clear();
+        for (int i = 0; i < window; i++) {
+          uint64_t rid = ++req_seq;
+          reqids[(size_t)i] = rid;
+          char keybuf[96];
+          int klen = snprintf(keybuf, sizeof(keybuf), "%s-%d", prefix,
+                              (int)(rid % (uint64_t)nkeys));
+          char cmdhdr[112];
+          int hl;
+          if (puts)
+            hl = snprintf(cmdhdr, sizeof(cmdhdr), "P%d:%s", klen, keybuf);
+          else
+            hl = snprintf(cmdhdr, sizeof(cmdhdr), "G%d:%s", klen, keybuf);
+          uint32_t dlen = (uint32_t)hl + (puts ? (uint32_t)value.size() : 0);
+          uint32_t payload_len = 21 + dlen + (gid > 0 ? 2 : 0);
+          put_u32(sendbuf, payload_len);
+          if (gid > 0) {
+            sendbuf.push_back((char)OP_GROUP);
+            sendbuf.push_back((char)gid);
+          }
+          sendbuf.push_back((char)(puts ? OP_CLT_WRITE : OP_CLT_READ));
+          put_u64(sendbuf, rid);
+          put_u64(sendbuf, clt_id);
+          put_u32(sendbuf, dlen);
+          sendbuf.append(cmdhdr, (size_t)hl);
+          if (puts) sendbuf.append(value);
+        }
+        if (send_all(fd, sendbuf.data(), sendbuf.size()) < 0) {
+          err = 2;
+          break;
+        }
+        // Read `window` replies (order-preserving stream).
+        int got = 0;
+        while (got < window && err == 0) {
+          char chunk[1 << 16];
+          ssize_t r = recv(fd, chunk, sizeof(chunk), 0);
+          if (r <= 0) {
+            err = 3;
+            break;
+          }
+          rbuf.append(chunk, (size_t)r);
+          size_t off = 0;
+          while (rbuf.size() - off >= 4) {
+            uint32_t n = rd_u32((const uint8_t*)rbuf.data() + off);
+            if (rbuf.size() - off - 4 < n) break;
+            const uint8_t* rp = (const uint8_t*)rbuf.data() + off + 4;
+            if (n >= 1 && rp[0] == ST_OK)
+              ok++;
+            else if (n >= 1 && rp[0] == 4)    // ST_NOT_LEADER
+              notleader++;
+            else
+              fails++;
+            off += 4 + n;
+            got++;
+          }
+          if (off > 0) rbuf.erase(0, off);
+        }
+        if (notleader > 0) break;     // wrong endpoint: caller re-aims
+        if (rtt_us > 0) {
+          struct timespec ts = {rtt_us / 1000000,
+                                (rtt_us % 1000000) * 1000};
+          nanosleep(&ts, nullptr);
+        }
+      }
+      elapsed = (double)(now_ns() - t0) / 1e9;
+    }
+    if (fd >= 0) close(fd);
+  }
+  Py_END_ALLOW_THREADS
+
+  return Py_BuildValue("{s:K,s:K,s:K,s:d,s:i}", "ok",
+                       (unsigned long long)ok, "fails",
+                       (unsigned long long)fails, "not_leader",
+                       (unsigned long long)notleader, "elapsed",
+                       elapsed, "err", err);
+}
+
+PyMethodDef mod_methods[] = {
+    {"loadgen", (PyCFunction)mod_loadgen, METH_VARARGS | METH_KEYWORDS,
+     "native pipelined client load generator (GIL released)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT,
+    APUS_STR(APUS_MODNAME),
+    "apus native serving data plane (ISSUE 13)",
+    -1,
+    mod_methods,
+    nullptr,
+    nullptr,
+    nullptr,
+    nullptr,
+};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC APUS_INIT(void) {
+  PlaneType.tp_name = APUS_STR(APUS_MODNAME) ".Plane";
+  PlaneType.tp_basicsize = sizeof(Plane);
+  PlaneType.tp_flags = Py_TPFLAGS_DEFAULT;
+  PlaneType.tp_doc = "native serving data plane";
+  PlaneType.tp_new = plane_new;
+  PlaneType.tp_init = plane_init;
+  PlaneType.tp_dealloc = plane_dealloc;
+  PlaneType.tp_methods = plane_methods;
+  if (PyType_Ready(&PlaneType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&moduledef);
+  if (m == nullptr) return nullptr;
+  Py_INCREF(&PlaneType);
+  if (PyModule_AddObject(m, "Plane", (PyObject*)&PlaneType) < 0) {
+    Py_DECREF(&PlaneType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  PyModule_AddIntConstant(m, "OP_CLT_WRITE", OP_CLT_WRITE);
+  PyModule_AddIntConstant(m, "OP_CLT_READ", OP_CLT_READ);
+  PyModule_AddIntConstant(m, "OP_GROUP", OP_GROUP);
+  return m;
+}

@@ -51,6 +51,10 @@ NAMESPACE_OF = {
     "apus_tpu/runtime/txn.py": "node",
     "apus_tpu/runtime/mesh_plane.py": "node",
     "apus_tpu/parallel/net.py": None,     # mixed: resolved per call
+    # Native-plane binding layer: its bumps land on the daemon's
+    # PeerServer view (srv_*); the C loop's own counters arrive as
+    # srv_native_* gauges via the scrape mirror, cataloged in GAUGES.
+    "apus_tpu/parallel/native_plane.py": "srv",
     "apus_tpu/parallel/faults.py": "fault",
     "apus_tpu/runtime/client.py": "srv",
     "apus_tpu/runtime/daemon.py": "node",
@@ -107,6 +111,15 @@ def collect_bumps() -> list[tuple[str, str, str]]:
             for m in _RECV.finditer(src):
                 owner = m.group(1)
                 ns_here = "node" if owner.startswith("node") else "dev"
+                out.append((rel, ns_here, m.group(2)))
+            continue
+        if rel == "apus_tpu/parallel/native_plane.py":
+            # Mixed like net.py: self.stats -> the daemon's srv view;
+            # node.bump -> node_* (the publish-time fold of native
+            # read serves into the node's lease-read accounting).
+            for m in _RECV.finditer(src):
+                owner = m.group(1)
+                ns_here = "node" if owner.startswith("node") else "srv"
                 out.append((rel, ns_here, m.group(2)))
             continue
         for m in _RECV.finditer(src):

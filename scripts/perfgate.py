@@ -47,12 +47,14 @@ FACTORS = {
     "group4_dispatch_wall_p50_us": 2.0,
     "unsampled_obs_check_ns": 3.0,
     "hist_observe_ns": 3.0,
+    "native_ingest_op_p50_us": 3.0,
 }
 UNITS = {
     "depth1_window_wall_p50_us": "us",
     "group4_dispatch_wall_p50_us": "us",
     "unsampled_obs_check_ns": "ns",
     "hist_observe_ns": "ns",
+    "native_ingest_op_p50_us": "us",
 }
 
 
@@ -188,9 +190,78 @@ def _measure_obs_fast_path(n: int = 300_000) -> tuple[float, float]:
     return round(best_chk, 1), round(best_obs, 1)
 
 
+def _measure_native_ingest(repeats: int = 3, iters: int = 30,
+                           window: int = 64) -> "float | None":
+    """Per-op p50 of the NATIVE data plane's fully-native path
+    (ISSUE 13): `window`-deep bursts of dedup-hit writes through a
+    socketpair-adopted connection — frame parse, epdb-cache lookup,
+    reply build, vectored flush, zero GIL.  The budget this banks is
+    the ingest->reply cost the native plane exists to bound; a
+    regression (an accidental upcall, a copy in the parse loop) blows
+    it loudly.  None (check skipped) when the extension is not
+    built."""
+    from apus_tpu.parallel.native_plane import load_extension
+    ext = load_extension()
+    if ext is None:
+        return None
+    import socket
+    import struct
+
+    plane = ext.Plane()
+    plane.start()
+    a, b = socket.socketpair()
+    try:
+        assert plane.adopt(b.detach(), b"")
+        plane.publish(0, True, 0)            # write gate open (leader)
+        plane.dedup_put(0, 7, 1 << 40, b"OK")
+        data = b"P2:kkvvvvvvvv"
+        frames = b"".join(
+            struct.pack("<I", 21 + len(data)) + bytes([16])
+            + struct.pack("<QQ", rid + 1, 7)
+            + struct.pack("<I", len(data)) + data
+            for rid in range(window))
+        a.settimeout(10.0)
+        buf = b""
+
+        def roundtrip():
+            nonlocal buf
+            a.sendall(frames)
+            need = window
+            while need > 0:
+                if len(buf) >= 4:
+                    (ln,) = struct.unpack_from("<I", buf, 0)
+                    if len(buf) - 4 >= ln:
+                        buf = buf[4 + ln:]
+                        need -= 1
+                        continue
+                chunk = a.recv(1 << 16)
+                if not chunk:
+                    raise ConnectionError("plane closed the pair")
+                buf += chunk
+
+        for _ in range(3):
+            roundtrip()                      # warm
+        best = float("inf")
+        for _ in range(repeats):
+            walls = []
+            for _ in range(iters):
+                t0 = time.perf_counter_ns()
+                roundtrip()
+                walls.append((time.perf_counter_ns() - t0)
+                             / 1e3 / window)
+            best = min(best, statistics.median(walls))
+        return round(best, 3)
+    finally:
+        a.close()
+        plane.stop()
+
+
 def measure(fast: bool = False) -> dict:
     chk, obs = _measure_obs_fast_path()
     out = {"unsampled_obs_check_ns": chk, "hist_observe_ns": obs}
+    native = _measure_native_ingest()
+    if native is not None:
+        out["native_ingest_op_p50_us"] = native
     if not fast:
         out["depth1_window_wall_p50_us"] = _measure_depth1_window()
         out["group4_dispatch_wall_p50_us"] = _measure_group_dispatch()

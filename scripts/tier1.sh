@@ -17,6 +17,17 @@ if [ "${1:-}" = "--no-smoke" ]; then
     smoke=0
 fi
 
+echo "== native data-plane extension build (ISSUE 13) =="
+if command -v python3-config >/dev/null 2>&1 \
+        && make -C native dataplane >/tmp/_t1_native.log 2>&1; then
+    echo "   built native/build/apus_dataplane.so"
+else
+    echo "!! NATIVE DATAPLANE BUILD SKIPPED/FAILED — the native-plane" >&2
+    echo "!! equivalence suite will SKIP and daemons fall back to the" >&2
+    echo "!! pure-Python serving plane (tail of /tmp/_t1_native.log):" >&2
+    tail -5 /tmp/_t1_native.log 2>/dev/null >&2 || true
+fi
+
 echo "== metrics-consistency lint =="
 python scripts/check_metrics.py || exit $?
 

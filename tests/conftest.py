@@ -79,3 +79,11 @@ def pytest_configure(config):
         "batches, cross-group 2PC (locks, epoch fences, coordinator "
         "kill recovery), and the strict-serializability checker "
         "generalization; selectable with -m txn")
+    config.addinivalue_line(
+        "markers",
+        "native: native serving-data-plane suite (native/dataplane.cpp "
+        "via apus_tpu/parallel/native_plane.py) — cross-impl "
+        "byte-equivalence tapes, native dedup/lease-GET fast-path "
+        "coverage, FaultPlane exactly-once on the native path, and the "
+        "slow ASAN-flavor tape; selectable with -m native (skips "
+        "cleanly when the extension is not built)")
