@@ -14,8 +14,28 @@ Redesign notes:
   leader reconstructs it and client retries stay exactly-once across
   failovers (the reference gets this implicitly because commands carry
   ``req_id``/``clt_id`` in the log entry, dare_log.h:38-40);
-- the last committed reply is cached per endpoint so a duplicate of an
-  already-committed request is answered without re-executing it.
+- dedup is EXACT over a sliding window of the last ``WINDOW`` applied
+  req_ids per client, not merely monotone.  A pipelined client's
+  stream legally applies with HOLES: an elastic MIGRATING bounce (or a
+  leader change mid-burst) makes the client retry op N individually
+  while ops N+1.. from the same burst commit first, and a reply to a
+  cross-group op consumes a req_id this group never sees at all.  The
+  reference's monotone rule (``req_id <= last_req_id`` => duplicate)
+  would answer such a retry from the cache of a DIFFERENT, later
+  request — acking a write that never applied (a lost update, caught
+  as a stale read by the linearizability checker; churn seed 9480).
+  An in-window req_id that was never applied here is a hole and
+  re-enters admission fresh; only an exact hit answers from cache.
+- the committed reply is cached per applied request in the window so a
+  duplicate of an already-committed request is answered without
+  re-executing it — with ITS OWN reply, never a later request's.
+
+Requests below the window floor (``last_req_id - WINDOW``) cannot be
+classified exactly any more; they conservatively answer from the
+highwater cache, as the reference does.  That path is unreachable for
+live clients: a client only ever retries ops inside its in-flight
+pipeline window (<= 64 ops, ApusClient.pipeline_window), far smaller
+than WINDOW.
 """
 
 from __future__ import annotations
@@ -34,6 +54,22 @@ class Endpoint:
     last_reply: Optional[bytes] = None
     # join-request dedup (used by the membership service)
     committed: bool = False
+    #: exact applied window: req_id -> (idx, reply) for every applied
+    #: request above the eviction floor (EndpointDB.WINDOW wide)
+    applied: dict = dataclasses.field(default_factory=dict)
+    #: req_ids <= evict_floor have been evicted from ``applied``
+    evict_floor: int = 0
+
+
+@dataclasses.dataclass
+class DupHit:
+    """Exact-window duplicate: the applied request's OWN idx/reply.
+    Field names mirror Endpoint so dedup consumers (Node.submit, the
+    apply path, the txn plane) read either shape identically."""
+
+    last_req_id: int
+    last_idx: int
+    last_reply: Optional[bytes]
 
 
 @dataclasses.dataclass
@@ -61,6 +97,13 @@ class EndpointDB:
     """In-memory endpoint table (std dict replaces the kernel rbtree the
     reference vendors, utils/rbtree/)."""
 
+    #: Exact-dedup span: per client, the last WINDOW applied req_ids
+    #: are tracked individually (reply cached per request).  Must
+    #: exceed any client's maximum in-flight pipeline depth so a
+    #: retried op is never below the floor (64 in ApusClient; 16x
+    #: headroom).  The native plane's reply cache uses the same span.
+    WINDOW = 1024
+
     def __init__(self) -> None:
         self._eps: dict[int, Endpoint] = {}
 
@@ -82,14 +125,24 @@ class EndpointDB:
 
     # -- write dedup ------------------------------------------------------
 
-    def duplicate_of_applied(self, clt_id: int,
-                             req_id: int) -> Optional[Endpoint]:
-        """If (clt_id, req_id) was already applied, return the endpoint
-        (whose cached reply answers the duplicate); else None.  Client
-        req_ids are per-client monotone, as in the reference
-        (handle_server_join_request dedup, dare_ibv_ud.c:988-1006)."""
+    def duplicate_of_applied(self, clt_id: int, req_id: int) \
+            -> "Optional[DupHit | Endpoint]":
+        """If (clt_id, req_id) itself was already applied, return its
+        cached idx/reply (a :class:`DupHit`); else None.  An in-window
+        req_id below the highwater that was NOT applied is a hole
+        (bounced/re-routed out of a pipelined burst) and is NOT a
+        duplicate — answering it from a later request's cache would
+        ack a write that never happened.  Below the window floor the
+        highwater endpoint answers conservatively (ancient duplicate;
+        unreachable for live clients, see module docstring)."""
         ep = self._eps.get(clt_id)
-        if ep is not None and req_id <= ep.last_req_id:
+        if ep is None:
+            return None
+        hit = ep.applied.get(req_id)
+        if hit is not None:
+            idx, reply = hit
+            return DupHit(req_id, idx, reply)
+        if 0 < req_id <= ep.evict_floor and req_id <= ep.last_req_id:
             return ep
         return None
 
@@ -98,22 +151,51 @@ class EndpointDB:
         """Record an applied request (called from the apply path, so every
         replica — and any future leader — has identical dedup state)."""
         ep = self.insert(clt_id)
+        if req_id > ep.evict_floor:
+            ep.applied[req_id] = (idx, reply)
         if req_id >= ep.last_req_id:
             ep.last_req_id = req_id
             ep.last_idx = idx
             ep.last_reply = reply
             ep.committed = True
+            floor = req_id - self.WINDOW
+            if floor > ep.evict_floor:
+                if floor - ep.evict_floor > 3 * self.WINDOW:
+                    # Huge highwater jump: rebuild instead of walking
+                    # the gap one req_id at a time.
+                    ep.applied = {r: v for r, v in ep.applied.items()
+                                  if r > floor}
+                else:
+                    for r in range(ep.evict_floor + 1, floor + 1):
+                        ep.applied.pop(r, None)
+                ep.evict_floor = floor
 
     # -- snapshot support --------------------------------------------------
 
-    def dump(self) -> list[tuple[int, int, int, Optional[bytes]]]:
+    def dump(self) -> list:
         """Dedup state for inclusion in snapshots: without it, a
         duplicate request straddling a snapshot boundary (first instance
-        inside, retry after) would double-apply on the installer."""
-        return [(ep.clt_id, ep.last_req_id, ep.last_idx, ep.last_reply)
+        inside, retry after) would double-apply on the installer.  Each
+        record carries the FULL applied window — the highwater alone
+        would turn every in-window hole into a false duplicate on the
+        installer (exactly the monotone-rule bug this class fixes)."""
+        return [(ep.clt_id, ep.last_req_id, ep.last_idx, ep.last_reply,
+                 sorted((r, iv[0], iv[1])
+                        for r, iv in ep.applied.items()))
                 for ep in self._eps.values()]
 
-    def load(self, entries: list[tuple[int, int, int, Optional[bytes]]]) \
-            -> None:
-        for clt_id, req_id, idx, reply in entries:
-            self.note_applied(clt_id, req_id, idx, reply)
+    def load(self, entries: list) -> None:
+        for rec in entries:
+            if len(rec) >= 5:
+                clt_id, req_id, idx, reply, window = rec[:5]
+            else:                 # legacy 4-tuple record (no window)
+                clt_id, req_id, idx, reply = rec[:4]
+                window = [(req_id, idx, reply)] if req_id else []
+            for r, i, rep in window:
+                self.note_applied(clt_id, r, i, rep)
+            # Join-only endpoints (committed flag, no applied window)
+            # and the highwater itself when the window list is empty.
+            if req_id:
+                self.note_applied(clt_id, req_id, idx, reply)
+            else:
+                self.insert(clt_id)

@@ -307,10 +307,24 @@ def decode_value(r: Reader) -> Any:
 # -- endpoint-DB dump (travels with snapshots for exactly-once) -----------
 
 def encode_ep_dump(entries: list) -> bytes:
+    # Each record carries the endpoint's exact applied window (req_id,
+    # idx, reply triples) alongside the highwater: the installer must
+    # distinguish in-window holes (never applied -> fresh) from true
+    # duplicates, so the window travels with every snapshot.
     out = [u32(len(entries))]
-    for clt_id, req_id, idx, reply in entries:
+    for rec in entries:
+        if len(rec) >= 5:
+            clt_id, req_id, idx, reply, window = rec[:5]
+        else:                     # legacy 4-tuple record (no window)
+            clt_id, req_id, idx, reply = rec
+            window = [(req_id, idx, reply)] if req_id else []
         out.append(_U64.pack(clt_id) + _U64.pack(req_id) + _U64.pack(idx))
         out.append(u8(1) + blob(reply) if reply is not None else u8(0))
+        out.append(u32(len(window)))
+        for wreq, widx, wreply in window:
+            out.append(_U64.pack(wreq) + _U64.pack(widx))
+            out.append(u8(1) + blob(wreply) if wreply is not None
+                       else u8(0))
     return b"".join(out)
 
 
@@ -320,7 +334,12 @@ def decode_ep_dump(r: Reader) -> list:
     for _ in range(n):
         clt_id, req_id, idx = r.u64(), r.u64(), r.u64()
         reply = r.blob() if r.u8() else None
-        out.append((clt_id, req_id, idx, reply))
+        window = []
+        for _w in range(r.u32()):
+            wreq, widx = r.u64(), r.u64()
+            wreply = r.blob() if r.u8() else None
+            window.append((wreq, widx, wreply))
+        out.append((clt_id, req_id, idx, reply, window))
     return out
 
 

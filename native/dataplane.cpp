@@ -11,8 +11,10 @@
 //       equivalent parsing (u32 LE length + payload, 128 MB cap);
 //     - OP_GROUP demux (u8 25 | gid | inner frame);
 //     - endpoint-DB dedup fast path: a retried already-applied
-//       (clt_id, req_id) answers from the native reply cache — the
-//       exact bytes Python's epdb path would produce;
+//       (clt_id, req_id) answers from the native reply cache — an
+//       EXACT per-request hit only (windowed, like epdb: a pipelined
+//       client's in-window holes are fresh writes, not duplicates),
+//       with the exact bytes Python's epdb path would produce;
 //     - lease GET serving: CLT_READ GETs answered from the native
 //       applied view while the Python side's published read gate is
 //       live (leader lease or follower lease, Hermes-style write
@@ -56,6 +58,7 @@
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -92,6 +95,20 @@ constexpr uint8_t ST_OK = 0;
 constexpr uint32_t MAX_FRAME = 1u << 27;   // wire.py's 128 MB sanity cap
 constexpr size_t RECV_CHUNK = 1 << 16;     // FrameStream.RECV parity
 constexpr int MAX_GIDS = 256;              // gid is a u8 on the wire
+// Exact-dedup span per client, matching EndpointDB.WINDOW: replies for
+// req_ids below (highwater - WINDOW) are evicted; such requests fall
+// through to Python admission.
+constexpr uint64_t DEDUP_WINDOW = 1024;
+// models/sm.py REFUSED_REPLY_PREFIX: deterministic refusal bodies ride
+// OK-status replies but are never dedup-cached (the op did not take
+// effect; a retry must re-enter admission, exactly as Python's apply
+// path skips note_applied for them).
+constexpr char REFUSED_PREFIX[2] = {'\x00', '!'};
+
+inline bool refused_body(const std::string& r, size_t off) {
+  return r.size() >= off + 2 && r[off] == REFUSED_PREFIX[0] &&
+         r[off + 1] == REFUSED_PREFIX[1];
+}
 
 inline uint64_t now_ns() {
   struct timespec ts;
@@ -217,10 +234,18 @@ struct GidState {
   // answers while it would answer identically to Python's submit().
   std::atomic<bool> write_gate{false};
   std::atomic<uint64_t> reads_served{0};
-  // dedup reply cache: clt_id -> (last applied req_id, last reply) —
-  // exactly epdb's per-client monotone rule; populated from replies
-  // this plane delivered, so it is always a subset of epdb state.
-  std::unordered_map<uint64_t, std::pair<uint64_t, std::string>> dedup;
+  // dedup reply cache: clt_id -> exact applied window (req_id ->
+  // reply), mirroring epdb's EXACT windowed rule — populated from
+  // replies this plane delivered, so it is always a subset of epdb
+  // state.  A hit requires the req_id ITSELF in the window: a
+  // pipelined client's stream applies with holes (elastic bounces,
+  // cross-group routing), and answering a hole from a later request's
+  // cache would ack a write that never applied (churn seed 9480).
+  struct EpCache {
+    uint64_t hi = 0;                        // highwater applied req_id
+    std::map<uint64_t, std::string> byreq;  // exact window replies
+  };
+  std::unordered_map<uint64_t, EpCache> dedup;
 };
 
 // -- connection ------------------------------------------------------------
@@ -356,19 +381,22 @@ bool try_native_answer(Plane* p, const std::string& frame,
   GidState* g = p->gids[op.gid];
   if (g == nullptr) return false;
   if (op.op == OP_CLT_WRITE) {
-    // epdb dedup fast path: duplicate_of_applied semantics —
-    // req_id <= last applied req_id answers the cached last reply.
+    // epdb dedup fast path: EXACT duplicate_of_applied semantics —
+    // only the req_id's OWN cached reply answers; anything else
+    // (fresh, in-window hole, below the window) falls through to
+    // Python admission, which decides with full epdb state.
     if (!p->dedup_enabled ||
         !g->write_gate.load(std::memory_order_acquire))
       return false;
     auto it = g->dedup.find(op.clt_id);
-    if (it == g->dedup.end() || op.req_id > it->second.first)
-      return false;
+    if (it == g->dedup.end()) return false;
+    auto rit = it->second.byreq.find(op.req_id);
+    if (rit == it->second.byreq.end()) return false;
     reply->clear();
     reply->push_back((char)ST_OK);
     put_u64(*reply, op.req_id);
-    put_u32(*reply, (uint32_t)it->second.second.size());
-    reply->append(it->second.second);
+    put_u32(*reply, (uint32_t)rit->second.size());
+    reply->append(rit->second);
     p->bump(C_DEDUP_HITS);
     return true;
   }
@@ -855,12 +883,16 @@ PyObject* plane_complete(PyObject* raw, PyObject* args) {
           continue;
         size_t body = r.size() - 13;
         if (body > p->dedup_max_reply) continue;
+        // Refusal bodies (elastic fence / txn passthrough) are never
+        // cached: Python re-admits their retries fresh.
+        if (refused_body(r, 13)) continue;
         GidState* g = p->gid_state(op.gid);
         auto& slot = g->dedup[op.clt_id];
-        if (op.req_id >= slot.first) {
-          slot.first = op.req_id;
-          slot.second.assign(r, 13, body);
-        }
+        slot.byreq[op.req_id].assign(r, 13, body);
+        if (op.req_id > slot.hi) slot.hi = op.req_id;
+        while (!slot.byreq.empty() &&
+               slot.byreq.begin()->first + DEDUP_WINDOW <= slot.hi)
+          slot.byreq.erase(slot.byreq.begin());
       }
     }
     p->done_q.push_back(std::move(d));
@@ -1075,14 +1107,19 @@ PyObject* plane_dedup_put(PyObject* raw, PyObject* args) {
   Py_buffer reply;
   if (!PyArg_ParseTuple(args, "iKKy*", &gid, &clt, &req, &reply))
     return nullptr;
-  if ((size_t)reply.len <= p->dedup_max_reply) {
+  if ((size_t)reply.len <= p->dedup_max_reply &&
+      !(reply.len >= 2 &&
+        ((const char*)reply.buf)[0] == REFUSED_PREFIX[0] &&
+        ((const char*)reply.buf)[1] == REFUSED_PREFIX[1])) {
     std::unique_lock<std::mutex> lk(p->mu);
     GidState* g = p->gid_state((uint8_t)(gid & 0xff));
     auto& slot = g->dedup[(uint64_t)clt];
-    if ((uint64_t)req >= slot.first) {
-      slot.first = (uint64_t)req;
-      slot.second.assign((const char*)reply.buf, (size_t)reply.len);
-    }
+    slot.byreq[(uint64_t)req].assign((const char*)reply.buf,
+                                     (size_t)reply.len);
+    if ((uint64_t)req > slot.hi) slot.hi = (uint64_t)req;
+    while (!slot.byreq.empty() &&
+           slot.byreq.begin()->first + DEDUP_WINDOW <= slot.hi)
+      slot.byreq.erase(slot.byreq.begin());
   }
   PyBuffer_Release(&reply);
   Py_RETURN_NONE;

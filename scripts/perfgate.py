@@ -213,7 +213,10 @@ def _measure_native_ingest(repeats: int = 3, iters: int = 30,
     try:
         assert plane.adopt(b.detach(), b"")
         plane.publish(0, True, 0)            # write gate open (leader)
-        plane.dedup_put(0, 7, 1 << 40, b"OK")
+        # Dedup is EXACT per req_id (windowed): seed every req the
+        # burst replays so each frame is a native cache hit.
+        for rid in range(window):
+            plane.dedup_put(0, 7, rid + 1, b"OK")
         data = b"P2:kkvvvvvvvv"
         frames = b"".join(
             struct.pack("<I", 21 + len(data)) + bytes([16])

@@ -18,9 +18,70 @@ def test_epdb_dedup():
     assert ep is not None and ep.last_reply == b"OK" and ep.last_idx == 5
     assert db.duplicate_of_applied(7, 2) is None     # newer req: not a dup
     db.note_applied(7, 2, idx=6, reply=b"r2")
-    assert db.duplicate_of_applied(7, 1).last_reply == b"r2"  # stale dup
+    # Exact dedup: each applied request answers with its OWN reply,
+    # never a later request's.
+    assert db.duplicate_of_applied(7, 1).last_reply == b"OK"
+    assert db.duplicate_of_applied(7, 2).last_reply == b"r2"
     db.erase(7)
     assert db.search(7) is None
+
+
+def test_epdb_pipelined_hole_is_not_a_duplicate():
+    """Churn seed 9480 regression: a pipelined client's burst applies
+    with a hole (op N bounced by an elastic fence while op N+2 from
+    the same burst committed).  The retried op N must NOT be answered
+    from the dedup cache — the monotone rule (req <= highwater =>
+    duplicate) acked the never-applied put(ck3) with a later request's
+    cached reply, losing the write (a stale read under
+    --check-linear)."""
+    db = EndpointDB()
+    db.note_applied(20, 1024, idx=830, reply=b"a")
+    db.note_applied(20, 1026, idx=838, reply=b"b")   # 1025 is a hole
+    # The hole re-enters admission fresh on retry.
+    assert db.duplicate_of_applied(20, 1025) is None
+    # Once it actually applies, it dedups with its own reply.
+    db.note_applied(20, 1025, idx=845, reply=b"late")
+    hit = db.duplicate_of_applied(20, 1025)
+    assert hit.last_reply == b"late" and hit.last_idx == 845
+    # Highwater stays the max applied req.
+    assert db.search(20).last_req_id == 1026
+
+
+def test_epdb_window_eviction_and_ancient_retry():
+    db = EndpointDB()
+    w = EndpointDB.WINDOW
+    for r in range(1, w + 10):
+        db.note_applied(3, r, idx=r, reply=b"r%d" % r)
+    ep = db.search(3)
+    assert ep.evict_floor == ep.last_req_id - w
+    assert all(r > ep.evict_floor for r in ep.applied)
+    # In-window exact hits keep their own replies.
+    assert db.duplicate_of_applied(3, w + 9).last_reply == b"r%d" % (w + 9)
+    assert db.duplicate_of_applied(3, 20).last_reply == b"r20"
+    # Below the floor: conservative highwater answer (ancient retries
+    # are outside any live client's pipeline window).
+    anc = db.duplicate_of_applied(3, 2)
+    assert anc is not None and anc.last_req_id == w + 9
+    # Never-applied future reqs are fresh.
+    assert db.duplicate_of_applied(3, w + 100) is None
+
+
+def test_epdb_dump_load_round_trips_holes():
+    """The snapshot dump must carry the applied window: an installer
+    rebuilt from highwater alone would turn every in-window hole into
+    a false duplicate."""
+    db = EndpointDB()
+    db.note_applied(9, 100, idx=1, reply=b"x")
+    db.note_applied(9, 103, idx=2, reply=b"y")       # 101/102 holes
+    db2 = EndpointDB()
+    from apus_tpu.parallel import wire
+    db2.load(wire.decode_ep_dump(wire.Reader(
+        wire.encode_ep_dump(db.dump()))))
+    assert db2.dump() == db.dump()
+    assert db2.duplicate_of_applied(9, 101) is None
+    assert db2.duplicate_of_applied(9, 102) is None
+    assert db2.duplicate_of_applied(9, 100).last_reply == b"x"
+    assert db2.duplicate_of_applied(9, 103).last_reply == b"y"
 
 
 def test_sim_submit_dedup_exactly_once():

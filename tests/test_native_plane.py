@@ -263,6 +263,57 @@ def test_equivalence_dup_and_reorder_replay_tape():
         f"native dedup fast path never engaged: {nat}"
 
 
+def test_pipelined_hole_retry_is_admitted_fresh():
+    """Churn seed 9480 regression, at the wire: a pipelined client's
+    stream applies with a hole (an op bounced out of a burst and
+    retried after its successors committed — elastic fences and
+    failovers both produce this).  The delayed req_id must be ADMITTED
+    as a fresh write on BOTH planes, never answered from a later
+    request's dedup cache: under the old monotone rule the retry got a
+    fake OK and the write was silently lost (a stale read under
+    --check-linear)."""
+    clt = 0x9480
+    script = [
+        # reqs 1,2 then 4,5 commit; req 3 is the hole.
+        ("send", [(OP_CLT_WRITE, 1, clt, encode_put(b"hk", b"h1"), 0),
+                  (OP_CLT_WRITE, 2, clt, encode_put(b"ho", b"o1"), 0)]),
+        ("recv", 2),
+        ("send", [(OP_CLT_WRITE, 4, clt, encode_put(b"ho", b"o2"), 0),
+                  (OP_CLT_WRITE, 5, clt, encode_put(b"ho", b"o3"), 0)]),
+        ("recv", 2),
+        # The delayed retry of req 3 arrives LAST: it must execute.
+        ("send", [(OP_CLT_WRITE, 3, clt, encode_put(b"hk", b"h2"), 0)]),
+        ("recv", 1),
+        # Reads observe req 3's effect (h2) — a monotone-dedup fake-OK
+        # would leave h1 and diverge here.
+        ("send", [(OP_CLT_READ, 6, clt, encode_get(b"hk"), 0),
+                  (OP_CLT_READ, 7, clt, encode_get(b"ho"), 0)]),
+        ("recv", 2),
+        # True duplicates of 3 and 5 still dedup to their OWN replies.
+        ("send", [(OP_CLT_WRITE, 3, clt, encode_put(b"hk", b"h2"), 0),
+                  (OP_CLT_WRITE, 5, clt, encode_put(b"ho", b"o3"), 0)]),
+        ("recv", 2),
+        ("send", [(OP_CLT_READ, 8, clt, encode_get(b"hk"), 0)]),
+        ("recv", 1),
+    ]
+    nat = _assert_equivalent([script])
+    assert nat.get("dedup_hits", 0) > 0, nat
+    # Semantic pin (byte-equivalence alone can't catch both planes
+    # being identically wrong): req 3's effect is visible to reads.
+    replies = {}
+    stream = _run_plane(True, [script])[0]
+    off = 0
+    while off < len(stream):
+        n = struct.unpack_from("<I", stream, off)[0]
+        rid = struct.unpack_from("<Q", stream, off + 5)[0]
+        rlen = struct.unpack_from("<I", stream, off + 13)[0]
+        replies[rid] = stream[off + 17:off + 17 + rlen]
+        off += 4 + n
+    assert replies[6] == b"h2", replies
+    assert replies[8] == b"h2", replies
+    assert replies[7] == b"o3", replies
+
+
 def test_native_get_fast_path_engages():
     """GET-heavy tape on the native plane: the applied-view fast path
     must serve reads natively (gate open: leader lease live, log fully
