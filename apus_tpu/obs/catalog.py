@@ -123,6 +123,7 @@ COUNTERS: dict[str, str] = {
     "dev_recompiles": "post-warmup XLA recompiles on live executables",
     # Group-major dispatch (runtime/group_plane.py).
     "dev_group_major_windows": "group-major device dispatches (many groups per window)",
+    "dev_async_overlap_windows": "group-major windows staged while the previous window was still executing (async-beat overlap)",
 }
 
 GAUGES: dict[str, str] = {
@@ -136,6 +137,7 @@ GAUGES: dict[str, str] = {
     # Device-plane gauges: dev_* mirrors runner scalars, devd_* mirrors
     # the per-daemon driver's stats dict at OP_METRICS scrape time.
     "dev_max_dispatch_ms": "slowest blocked device-result wait observed (ms)",
+    "dev_devices": "devices in the group-major runner's (group, replica) mesh",
     "devd_rounds": "device rounds this daemon's driver dispatched",
     "devd_drained": "device rows drained into the host log (follower path)",
     "devd_holes": "device-ineligible spans handed to the host path",
@@ -183,6 +185,7 @@ HISTOGRAMS: dict[str, str] = {
     "dev_window_rounds_run": "rounds actually executed per resolved window",
     "dev_staging_wait_us": "HostStagingRing acquire consumer-edge block",
     "dev_groups_per_dispatch": "consensus groups carried per group-major dispatch",
+    "dev_groups_per_device_max": "groups landing on the busiest device shard per group-major dispatch",
 }
 
 CATALOG: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

@@ -756,6 +756,18 @@ def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
     vote cover every group per round, so device throughput scales with
     group count instead of drowning in per-dispatch overhead.
 
+    MULTI-DEVICE (ROADMAP "multi-device group-major dispatch"): when
+    ``mesh`` carries a GROUP axis (ops.mesh.group_replica_mesh), the
+    group dimension of every operand is device-SHARDED along it — each
+    device shard runs its own block of groups' windows concurrently
+    inside the ONE SPMD program.  Groups are mutually independent, so
+    no group-axis collective exists anywhere in the body: the program
+    text per shard is identical to the 1-device case over a smaller
+    group block, which is why the same builder serves a 1-device bench,
+    a virtual CPU test mesh, and a TPU pod slice unchanged.  On a mesh
+    without a group axis the group dimension stays replicated layout
+    (the pre-multi-device behavior, bit-for-bit).
+
     Semantics per (group, round) are exactly ``_commit_body``'s,
     vectorized over the leading group axis (each group has its OWN
     leader, term, end0, membership masks, and quorum thresholds —
@@ -769,11 +781,19 @@ def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
     commits [MD,G] i32)`` where ``commits[i, g]`` is group g's global
     commit index after round i (0 for rounds past ``rounds[g]``).
     The input devlog is donated (in-place HBM update)."""
+    from apus_tpu.ops.mesh import GROUP_AXIS
     _check_geometry(mesh, n_replicas, n_slots, batch)
     G, MD, B, S = n_groups, max_depth, batch, n_slots
+    group_sharded = GROUP_AXIS in mesh.axis_names
+    if group_sharded and n_groups % mesh.shape[GROUP_AXIS] != 0:
+        raise ValueError(f"{n_groups} groups on "
+                         f"{mesh.shape[GROUP_AXIS]}-wide group axis")
 
     def pipe(log_data, log_meta, offs, fence, sdata, smeta, ctrl):
-        _g, K, rows, SB = log_data.shape
+        # Gl: this shard's group block (== G on a group-replicated
+        # mesh); every per-group computation below runs on the local
+        # block only.
+        Gl, K, rows, SB = log_data.shape
         a = lax.axis_index(REPLICA_AXIS)
         rid = a * K + jnp.arange(K, dtype=jnp.int32)        # [K]
         is_leader = rid[None, :] == ctrl.leader[:, None]    # [G,K]
@@ -808,12 +828,12 @@ def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
             entry_idx = end0[:, None] + j[None, :]          # [G,B]
             fresh_meta = jnp.stack([
                 entry_idx,
-                jnp.broadcast_to(ctrl.term[:, None], (G, B)),
+                jnp.broadcast_to(ctrl.term[:, None], (Gl, B)),
                 bcast_m[:, :, 0], bcast_m[:, :, 1],
                 bcast_m[:, :, 2], bcast_m[:, :, 3],
-            ], axis=-1)                                     # [G,B,6]
+            ], axis=-1)                                     # [Gl,B,6]
             zero = jnp.int32(0)
-            for g in range(G):
+            for g in range(Gl):
                 for k in range(K):
                     log_data = lax.dynamic_update_slice(
                         log_data, bcast_d[g][None, None],
@@ -824,8 +844,8 @@ def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
             # (4) acks + per-group (dual-)majority quorum — ONE gather,
             # one vectorized vote for all groups.
             new_end = jnp.where(do_write, end0[:, None] + B, own_end)
-            acks = lax.all_gather(new_end, REPLICA_AXIS)    # [axis,G,K]
-            acks = jnp.moveaxis(acks, 0, 1).reshape(G, -1)  # [G,R]
+            acks = lax.all_gather(new_end, REPLICA_AXIS)   # [axis,Gl,K]
+            acks = jnp.moveaxis(acks, 0, 1).reshape(Gl, -1)  # [Gl,R]
             leader_ack = end0 + B                           # [G]
             cand = jnp.minimum(acks, leader_ack[:, None])   # [G,R]
             ge = acks[:, None, :] >= cand[:, :, None]       # [G,R,R]
@@ -855,15 +875,30 @@ def build_group_window_step(mesh: Mesh, n_groups: int, n_replicas: int,
             jnp.arange(MD, dtype=jnp.int32))
         return log_data, log_meta, offs, fence, commits
 
-    sharded = P(None, REPLICA_AXIS)
-    staged = P(None, None, REPLICA_AXIS)
-    repl = P()
-    ctrl_specs = GroupCommitControl(*([repl] * 8))
+    if group_sharded:
+        # Group axis device-sharded: state [G,R,...] splits its group
+        # dim across the mesh's group axis; per-group control vectors
+        # ([G] scalars, [G,R] masks) travel with their group shard;
+        # the per-round commit outputs come back [MD, G] with the
+        # group dim re-assembled from the shards.
+        sharded = P(GROUP_AXIS, REPLICA_AXIS)
+        staged = P(None, GROUP_AXIS, REPLICA_AXIS)
+        gvec = P(GROUP_AXIS)
+        gmask = P(GROUP_AXIS, None)
+        commits_spec = P(None, GROUP_AXIS)
+        ctrl_specs = GroupCommitControl(
+            leader=gvec, term=gvec, end0=gvec, rounds=gvec,
+            mask_old=gmask, mask_new=gmask, q_old=gvec, q_new=gvec)
+    else:
+        sharded = P(None, REPLICA_AXIS)
+        staged = P(None, None, REPLICA_AXIS)
+        commits_spec = P()
+        ctrl_specs = GroupCommitControl(*([P()] * 8))
     fn = shard_map(
         pipe, mesh=mesh,
         in_specs=(sharded, sharded, sharded, sharded, staged, staged,
                   ctrl_specs),
-        out_specs=(sharded, sharded, sharded, sharded, repl))
+        out_specs=(sharded, sharded, sharded, sharded, commits_spec))
 
     from apus_tpu.ops.logplane import GroupDeviceLog
 

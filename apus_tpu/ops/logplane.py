@@ -234,6 +234,66 @@ class HostStagingRing:
         slot.inflight = device_arrays
 
 
+class GroupStagingRing:
+    """Reusable host staging for GROUP-MAJOR windows ([MD, G, R, B, SB]
+    data + [MD, G, R, B, 4] meta pairs) — the HostStagingRing contract
+    extended to the group-major dispatch shape, one fixed geometry per
+    ring (the group runner's window shape never varies).
+
+    This is what makes the async dispatch beat possible: the driver
+    encodes window N+1 into the next ring pair while the device
+    executes window N's (donated, device-resident) arrays.  ``acquire``
+    blocks ONLY on the consumer edge — readiness of the device arrays
+    staged from that same pair ``nbuf`` windows ago — so the ring never
+    rewrites bytes an in-flight transfer still reads.  On a sharded
+    mesh the staged device arrays are split across every device shard
+    (ops.mesh.group_staged_sharding); the host pair serves all shards
+    of one window."""
+
+    def __init__(self, max_depth: int, n_groups: int, n_replicas: int,
+                 batch: int, slot_bytes: int, nbuf: int = 2):
+        self.nbuf = nbuf
+        self._lock = threading.Lock()
+        shape = (max_depth, n_groups, n_replicas, batch)
+        self._slots = [self._StageSlot(shape, slot_bytes)
+                       for _ in range(nbuf)]
+        self._cursor = 0
+        #: optional obs Histogram (anything with .observe()) of the
+        #: consumer-edge block per acquire, in µs.
+        self.wait_hist = None
+
+    class _StageSlot:
+        __slots__ = ("data", "meta", "inflight")
+
+        def __init__(self, shape, slot_bytes):
+            self.data = np.zeros(shape + (slot_bytes,), np.uint8)
+            self.meta = np.zeros(shape + (4,), np.int32)
+            self.inflight = None
+
+    def acquire(self) -> "GroupStagingRing._StageSlot":
+        """Next reusable pair, zeroed, consumer edge awaited."""
+        with self._lock:
+            slot = self._slots[self._cursor]
+            self._cursor = (self._cursor + 1) % self.nbuf
+        if slot.inflight is not None:
+            t0 = time.perf_counter() if self.wait_hist is not None \
+                else 0.0
+            jax.block_until_ready(slot.inflight)
+            if self.wait_hist is not None:
+                self.wait_hist.observe(
+                    int((time.perf_counter() - t0) * 1e6))
+            slot.inflight = None
+        # memset, not realloc: encoders only write each entry's wire
+        # bytes; zero rows are the NOOP/non-leader broadcast contract.
+        slot.data.fill(0)
+        slot.meta.fill(0)
+        return slot
+
+    def staged(self, slot: "GroupStagingRing._StageSlot",
+               device_arrays) -> None:
+        slot.inflight = device_arrays
+
+
 def host_batch_to_device(requests: list[bytes], slot_bytes: int,
                          req_ids: list[int] | None = None,
                          clt_ids: list[int] | None = None,

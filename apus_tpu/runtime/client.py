@@ -597,6 +597,7 @@ def make_client_batch_hook(daemon):
                 handles[i] = node.follower_read(req_id, clt_id, data)
             registered[i] = True
 
+        replies: list = [None] * len(parsed)
         with daemon.lock:
             if traced:
                 t_lock = sp.now()
@@ -634,7 +635,6 @@ def make_client_batch_hook(daemon):
             for i, (op, *_rest) in enumerate(parsed):
                 if op == OP_CLT_READ:
                     _register_read(i)
-        replies: list = [None] * len(parsed)
 
         def _resolve(i: int) -> bool:
             """Reply for op i if it is decided (under the lock)."""
@@ -652,6 +652,8 @@ def make_client_batch_hook(daemon):
                 _register_read(i)
                 if not registered[i]:
                     return False
+                if replies[i] is not None:
+                    return True     # registration bounced (wrong_group)
             h = handles[i]
             if h is None:
                 replies[i] = _not_leader(daemon, req_id, node=node)

@@ -1,4 +1,5 @@
-"""Group-major device plane: one dispatch commits MANY groups' windows.
+"""Group-major device plane: one dispatch commits MANY groups' windows,
+sharded across a real multi-device mesh with async, overlapped windows.
 
 The Multi-Raft payoff on the device (ROADMAP "group-major device
 dispatch"): the single-group engine (runtime.device_plane) amortizes
@@ -12,12 +13,32 @@ dual-majority vote for every group, with per-group early-exit masks
 (``GroupCommitControl.rounds``) letting shallow-backlog groups ride a
 deep dispatch without paying its rounds.
 
+MULTI-DEVICE (ISSUE 14): the runner builds a 2-D ``(group, replica)``
+mesh (ops.mesh.group_replica_mesh — groups sharded across devices,
+graceful fold when devices are scarce, ``APUS_DEV_MESH_DEVICES`` caps
+the budget) and shards the devlog + staged windows along it, so the
+ONE SPMD program runs G groups' windows CONCURRENTLY across devices
+instead of timesharing one — the mesh analog of the reference's
+passive parallel replication on the NIC.  Groups are mutually
+independent (no group-axis collective exists in the step), so
+cross-device results are byte-identical to the single-device fold.
+
+ASYNC DISPATCH: ``dispatch_groups`` stages (reusable GroupStagingRing
+pair -> sharded device_put -> donated step call) and advances the
+per-group cursors WITHOUT waiting on device results;
+``adopt_window`` is the ADOPTION FENCE — the only blocking point.
+The driver beat dispatches window N+1 before fencing window N, so
+host staging for N+1 overlaps device execution of N and commit
+adoption is batched per beat (``dev_async_overlap_windows`` counts
+the overlapped windows).
+
 ``GroupPlaneDriver`` is one thread per daemon serving ALL of its
 groups: each driver pass collects every led group's clean window under
 the daemon lock, dispatches them as ONE group-major window (the
 leader's group-commit drain amortizing one lock + one dispatch across
-every group with queued ops), and adopts each group's device commit
-under the same safety rules as the single-group driver:
+every group with queued ops), and — at the fence — adopts each
+group's device commit under the same safety rules as the single-group
+driver:
 
 1. commit chaining — a group's device results are adopted only once
    host commit covered the prefix below that group's device base;
@@ -83,13 +104,23 @@ class GroupDeviceRunner:
         self.stats = self.metrics.view("dev")
         for k in ("rounds", "resets", "quorum_fail_rounds",
                   "entries_devplane", "group_major_windows",
-                  "recompiles"):
+                  "recompiles", "async_overlap_windows"):
             self.stats.setdefault(k, 0)
         self._groups_per_dispatch = self.metrics.histogram(
             "dev_groups_per_dispatch")
+        self._groups_per_device = self.metrics.histogram(
+            "dev_groups_per_device_max")
         self._dispatch_wait_hist = self.metrics.histogram(
             "dev_dispatch_wait_us")
+        self._staging_wait_hist = self.metrics.histogram(
+            "dev_staging_wait_us")
         self._max_dispatch = self.metrics.gauge("dev_max_dispatch_ms")
+        self._devices_gauge = self.metrics.gauge("dev_devices")
+        #: dispatched-but-unadopted windows (under self.lock): >0 at
+        #: dispatch time means this window's staging OVERLAPPED the
+        #: previous window's device execution — the async-beat win the
+        #: critpath tool attributes (dev_async_overlap_windows).
+        self._open_windows = 0
         self._built = False
         self._build()
 
@@ -100,24 +131,44 @@ class GroupDeviceRunner:
             return
         _ensure_compile_listener()
         compiles_at_start = _COMPILES["count"]
+        import os
         import jax
         import jax.numpy as jnp
         import functools
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from apus_tpu.ops.commit import build_group_window_step
         from apus_tpu.ops.logplane import (GroupDeviceLog,
+                                           GroupStagingRing,
                                            make_group_device_log)
-        from apus_tpu.ops.mesh import REPLICA_AXIS, replica_mesh
+        from apus_tpu.ops.mesh import (GROUP_AXIS, group_replica_mesh,
+                                       group_sharding,
+                                       group_staged_sharding)
 
         self._jax = jax
         devices = self._devices
         if devices is None:
-            devices = jax.devices()[:1]
-        self._mesh = replica_mesh(self.n_replicas, devices=devices)
-        self._sharding = NamedSharding(self._mesh, P(None, REPLICA_AXIS))
-        self._staged_sharding = NamedSharding(
-            self._mesh, P(None, None, REPLICA_AXIS))
+            # Default mesh budget: every local device (group-major
+            # dispatch shards groups across them); APUS_DEV_MESH_DEVICES
+            # caps it (bench ladders pin exact device counts this way,
+            # "1" reproduces the pre-multi-device single-chip fold).
+            cap = int(os.environ.get("APUS_DEV_MESH_DEVICES", "0") or 0)
+            devices = jax.devices()
+            if cap > 0:
+                devices = devices[:cap]
+        self._mesh = group_replica_mesh(self.n_groups, self.n_replicas,
+                                        devices=devices)
+        self.group_axis_size = int(self._mesh.shape[GROUP_AXIS])
+        self.n_devices = int(np.prod(list(self._mesh.shape.values())))
+        #: contiguous block of groups per device shard along the group
+        #: axis (group g lives on shard row ``g // groups_per_shard``).
+        self.groups_per_shard = self.n_groups // self.group_axis_size
+        self._devices_gauge.set(self.n_devices)
+        self._sharding = group_sharding(self._mesh)
+        self._staged_sharding = group_staged_sharding(self._mesh)
+        self._staging = GroupStagingRing(
+            self.max_depth, self.n_groups, self.n_replicas, self.batch,
+            self.slot_bytes)
+        self._staging.wait_hist = self._staging_wait_hist
         self._step = build_group_window_step(
             self._mesh, self.n_groups, self.n_replicas, self.n_slots,
             self.slot_bytes, self.batch, self.max_depth)
@@ -286,15 +337,28 @@ class GroupDeviceRunner:
                                   i32(rounds), i32(mask_old),
                                   i32(mask_new), i32(q_old), i32(q_new))
 
-    def commit_groups(self, work: list) -> Optional[dict]:
-        """ONE group-major dispatch.  ``work`` = [(gid, gen, end0,
-        entries, cid, live)] with ``len(entries) = n_g * batch``,
-        1 <= n_g <= max_depth, entries idx-contiguous from end0.
-        Returns {gid: device_commit} for the non-stale items (a gid
-        whose generation moved between collection and dispatch is
-        silently dropped), or None when nothing was dispatchable."""
-        B, MD, G, R, SB = (self.batch, self.max_depth, self.n_groups,
-                           self.n_replicas, self.slot_bytes)
+    def device_of_group(self, gid: int) -> int:
+        """Device-shard row (along the mesh's group axis) that executes
+        group ``gid``'s windows — the static block assignment of the
+        group-sharded layout."""
+        return gid // self.groups_per_shard
+
+    def dispatch_groups(self, work: list) -> Optional["_InFlightWindow"]:
+        """Stage + enqueue ONE group-major dispatch WITHOUT waiting for
+        its device results.  ``work`` = [(gid, gen, end0, entries, cid,
+        live)] with ``len(entries) = n_g * batch``, 1 <= n_g <=
+        max_depth, entries idx-contiguous from end0.
+
+        The per-group cursors (``_next_end0``) advance at DISPATCH, so
+        the driver's next collection pass chains window N+1 on top of
+        window N while N still executes — the async overlap beat.  The
+        only blocking edge on this path is the staging ring's consumer
+        edge (a buffer pair is not rewritten until the transfer that
+        read it completed); device results are fenced later, in
+        ``adopt_window``.  Returns the in-flight handle, or None when
+        nothing was dispatchable (every item's generation/cursor moved
+        between collection and dispatch)."""
+        B, MD = self.batch, self.max_depth
         with self.lock:
             live_work = []
             for gid, gen, end0, entries, cid, live in work:
@@ -306,9 +370,11 @@ class GroupDeviceRunner:
                 return None
         # Host staging with the runner lock released (encode is the
         # slow part); leader-row-only expansion host-side (CPU-backend
-        # deployment; mirrors place_batch's rationale).
-        sdata = np.zeros((MD, G, R, B, SB), np.uint8)
-        smeta = np.zeros((MD, G, R, B, 4), np.int32)
+        # deployment; mirrors place_batch's rationale).  The ring pair
+        # is reused window over window — acquire blocks only on the
+        # consumer edge of the pair's previous transfer.
+        slot = self._staging.acquire()
+        sdata, smeta = slot.data, slot.meta
         items = []
         for gid, gen, end0, entries, cid, live in live_work:
             n = len(entries) // B
@@ -325,6 +391,7 @@ class GroupDeviceRunner:
         ctrl = self._make_ctrl(items)
         jd = self._jax.device_put(sdata, self._staged_sharding)
         jm = self._jax.device_put(smeta, self._staged_sharding)
+        self._staging.staged(slot, (jd, jm))
         with self.lock:
             # Re-validate under the lock right before the (donating)
             # step: a reset that raced the staging discards this work.
@@ -345,25 +412,44 @@ class GroupDeviceRunner:
             self._devlog, commits = self._step(self._devlog, jd, jm,
                                                ctrl)
             total_rounds = 0
+            shard_load: dict[int, int] = {}
             for gid, _l, _t, end0, _c, _lv, n in final:
                 self._next_end0[gid] = end0 + n * B
                 total_rounds += n
+                row = self.device_of_group(gid)
+                shard_load[row] = shard_load.get(row, 0) + 1
             self.stats.bump("rounds", total_rounds)
             self.stats.bump("entries_devplane", total_rounds * B)
             self.stats.bump("group_major_windows")
+            if self._open_windows > 0:
+                self.stats.bump("async_overlap_windows")
+            self._open_windows += 1
             self._groups_per_dispatch.observe(len(final))
-            gen_snapshot = {it[0]: self.generations[it[0]]
-                            for it in final}
+            # Busiest device shard this dispatch: 1 means the window's
+            # groups spread perfectly across the mesh; == len(final)
+            # means they all landed on one device (the 1-device fold).
+            self._groups_per_device.observe(max(shard_load.values()))
+            gens = {it[0]: self.generations[it[0]] for it in final}
+        return _InFlightWindow(items=final, commits=commits, gens=gens)
+
+    def adopt_window(self, win: "_InFlightWindow") -> dict:
+        """The ADOPTION FENCE: block until ``win``'s device commits are
+        host-readable and fold them into {gid: device_commit}, dropping
+        any group whose generation moved since dispatch.  This is the
+        only ``block_until_ready``-equivalent on the async critical
+        path."""
+        B = self.batch
         t0 = time.monotonic()
-        commits_host = np.asarray(commits)          # [MD, G]
+        commits_host = np.asarray(win.commits)      # [MD, G]
         wait = time.monotonic() - t0
         self._dispatch_wait_hist.observe(int(wait * 1e6))
         if wait * 1e3 > self._max_dispatch.value:
             self._max_dispatch.set(wait * 1e3)
         out = {}
         with self.lock:
-            for gid, _l, _t, end0, _c, _lv, n in final:
-                if self.generations[gid] != gen_snapshot[gid]:
+            self._open_windows = max(0, self._open_windows - 1)
+            for gid, _l, _t, end0, _c, _lv, n in win.items:
+                if self.generations[gid] != win.gens[gid]:
                     continue                 # reset since dispatch
                 commit = int(commits_host[n - 1, gid])
                 qf = sum(int(commits_host[k, gid]) < end0 + (k + 1) * B
@@ -372,6 +458,16 @@ class GroupDeviceRunner:
                     self.stats.bump("quorum_fail_rounds", qf)
                 out[gid] = commit
         return out
+
+    def commit_groups(self, work: list) -> Optional[dict]:
+        """Synchronous dispatch: stage, run, and adopt ONE group-major
+        window (the pre-async contract; tests and single-shot callers).
+        Returns {gid: device_commit} for the non-stale items, or None
+        when nothing was dispatchable."""
+        win = self.dispatch_groups(work)
+        if win is None:
+            return None
+        return self.adopt_window(win)
 
     # -- follower shard readback ------------------------------------------
 
@@ -423,6 +519,20 @@ class GroupDeviceRunner:
         return out
 
 
+class _InFlightWindow:
+    """Handle for one dispatched-but-not-yet-adopted group-major
+    window: the device arrays carrying its per-round commits, the
+    work items it carried, and the per-group generations at dispatch
+    (adoption drops groups whose generation moved)."""
+
+    __slots__ = ("items", "commits", "gens")
+
+    def __init__(self, items, commits, gens):
+        self.items = items      # [(gid, ldr, trm, end0, cid, live, n)]
+        self.commits = commits  # device array [MD, G]
+        self.gens = gens        # {gid: generation at dispatch}
+
+
 class _GState:
     """Per-group driver-side cursor state."""
 
@@ -455,6 +565,10 @@ class GroupPlaneDriver:
         self._thread: Optional[threading.Thread] = None
         self._g = {gid: _GState()
                    for gid in range(runner.n_groups)}
+        #: the one dispatched-but-unadopted window of the async beat
+        #: ((_InFlightWindow, terms) or None) — owned by the driver
+        #: thread only.
+        self._inflight = None
         self.stats = {"rounds": 0, "drained": 0, "holes": 0,
                       "fallbacks": 0, "partial_deferrals": 0,
                       "group_windows": 0}
@@ -528,6 +642,7 @@ class GroupPlaneDriver:
                     time.sleep(poll)
             except Exception:
                 self.logger.exception("group-plane driver error")
+                self._inflight = None
                 with self.daemon.lock:
                     for gid in self._g:
                         node = self.daemon.group_node(gid)
@@ -573,9 +688,24 @@ class GroupPlaneDriver:
                         if item is not None:
                             work.append(item)
                             terms[gid] = node.current_term
+        # The ASYNC BEAT: dispatch window N+1 (host staging + enqueue,
+        # no device wait) BEFORE fencing window N, so N's device
+        # execution overlapped this pass's collection AND N+1's
+        # staging; then adopt N's commits at the one fence.  With no
+        # new work the in-flight window is adopted immediately, so a
+        # lone window's commit latency is one fence, not one beat.
+        prev = self._inflight
+        self._inflight = None
         did = False
         if work:
-            did = self._dispatch(work, terms)
+            # (the runner's _open_windows tracking bumps
+            # dev_async_overlap_windows when this dispatch's staging
+            # overlapped prev's execution)
+            self._inflight = self._dispatch_async(work, terms)
+            did = True
+        if prev is not None:
+            self._adopt_inflight(prev)
+            did = True
         # Follower drains (outside the daemon lock for the gathers).
         for gid in self._g:
             if self._follower_drain(gid):
@@ -716,23 +846,45 @@ class GroupPlaneDriver:
                         "leadership_reset")
         node.device_covered_from = base
 
-    def _dispatch(self, work: list, terms: dict) -> bool:
-        """The group-major dispatch: runs OUTSIDE the daemon lock, then
-        adopts every group's device commit under it."""
-        res = self.runner.commit_groups(work)
+    def _dispatch_async(self, work: list, terms: dict):
+        """Stage + enqueue the group-major window OUTSIDE the daemon
+        lock, then advance the driver cursors for whatever the runner
+        accepted — the chaining edge that lets the next collection
+        pass build window N+1 while N executes.  Returns the in-flight
+        (window, terms) pair for ``_adopt_inflight``, or None."""
+        win = self.runner.dispatch_groups(work)
         self.stats["dispatches"] = self.stats.get("dispatches", 0) + 1
         with self.daemon.lock:
             self._check_recompiles()
+            dispatched = set() if win is None \
+                else {it[0] for it in win.items}
             for gid, gen, end0, entries, _cid, _live in work:
                 st = self._g[gid]
-                node = self.daemon.group_node(gid)
-                n = len(entries) // self.runner.batch
-                if res is None or gid not in res:
+                if gid not in dispatched:
                     st.gen = None       # stale: re-base next pass
                     continue
+                n = len(entries) // self.runner.batch
                 st.next = end0 + n * self.runner.batch
                 self.stats["rounds"] += n
                 self.stats["group_windows"] += 1
+        if win is None:
+            return None
+        return (win, terms)
+
+    def _adopt_inflight(self, inflight) -> None:
+        """The adoption fence: wait for the window's device commits
+        (the ONE blocking point of the beat), then adopt each group's
+        result under the daemon lock with the per-group safety rules
+        (commit chaining, flr cap, term pin) unchanged."""
+        win, terms = inflight
+        res = self.runner.adopt_window(win)
+        with self.daemon.lock:
+            for gid, _l, _t, end0, _c, _lv, n in win.items:
+                st = self._g[gid]
+                node = self.daemon.group_node(gid)
+                if gid not in res:
+                    st.gen = None       # reset mid-flight: re-base
+                    continue
                 if node is None or self._stop.is_set() \
                         or not (node.is_leader
                                 and node.current_term == terms[gid]):
@@ -740,7 +892,6 @@ class GroupPlaneDriver:
                     continue
                 self._adopt_commit(gid, st, node, res[gid])
                 self._note_quorum(gid, st, node, res[gid] > end0)
-        return True
 
     def _check_recompiles(self) -> None:
         for name, old, new in self.runner.check_recompiles():

@@ -111,6 +111,23 @@ def _run_groups_throughput(out) -> None:
                 bench="bench_throughput_groups")
 
 
+def _run_devices(out) -> None:
+    """Multi-device group-window throughput ladder (bench.py
+    --devices 1,2,4): the 4-group group-major engine on real
+    (group, replica) meshes of 1/2/4 virtual CPU devices, async
+    dispatch beat, per-device window service gate (ISSUE 14
+    headline)."""
+    print("bench.py --devices 1,2,4: multi-device group-major "
+          "dispatch ladder")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--devices", "1,2,4"],
+                         timeout=420):
+        _record(out, rec,
+                replicas=rec.get("detail", {}).get("replicas", 3),
+                bench="bench_devices")
+
+
 def _run_single_window(out) -> None:
     """Single-window (un-amortized) latency: depth-1/depth-4 windows
     through the windowed commit engine, wall p50 + profiler-derived
@@ -281,6 +298,11 @@ def cmd_run(args) -> int:
         if getattr(args, "groups_only", False):
             # Multi-group ladder re-measure: skip the cluster suite.
             _run_groups_throughput(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "devices_only", False):
+            # Multi-device dispatch ladder only: skip the suite.
+            _run_devices(out)
             print(f"results appended to {RUNS}")
             return 0
         if getattr(args, "throughput_only", False):
@@ -805,6 +827,28 @@ def cmd_report(args) -> int:
             f"(mean {ev.get('mean_groups_per_dispatch')}/dispatch, "
             f"p50 multi-group: {ev.get('p50_multi_group')}), "
             f"recompile sentinel {ev.get('recompile_sentinel')}")
+    md = [r for r in runs if r.get("bench") == "bench_devices"
+          and isinstance(r.get("value"), (int, float))]
+    if md:
+        last = md[-1]
+        d = last["detail"]
+        top = str(max(d.get("devices_ladder", [0])))
+        rung = (d.get("rungs") or {}).get(top, {})
+        lines.append(
+            f"- MULTI-DEVICE group-major dispatch: "
+            f"{_fmt(last['value'])} group-windows/sec at "
+            f"{top} devices x {d.get('groups')} groups — "
+            f"{last.get('vs_baseline')}x the 1-device rung "
+            f"(scaling {d.get('scaling_vs_1device')}) under the "
+            f"per-device window-svc gate "
+            f"({d.get('emulated_device_window_svc_ms')} ms/group-"
+            f"window/device, async dispatch beat, host staging "
+            f"overlapped); mesh {rung.get('mesh')}, "
+            f"{rung.get('async_overlap_windows')} overlapped windows, "
+            f"ungated dispatch overhead p50 "
+            f"{rung.get('dispatch_overhead_p50_us')} us, recompile "
+            f"sentinel {rung.get('recompile_sentinel')} across every "
+            f"rung")
     txc = [r for r in runs
            if r.get("bench") == "txn_campaign"
            and isinstance(r.get("value"), (int, float))]
@@ -1137,6 +1181,10 @@ def main() -> int:
         p.add_argument("--groups-only", action="store_true",
                        help="run ONLY the multi-group throughput "
                             "ladder (bench.py --throughput --groups "
+                            "1,2,4)")
+        p.add_argument("--devices-only", action="store_true",
+                       help="run ONLY the multi-device group-window "
+                            "dispatch ladder (bench.py --devices "
                             "1,2,4)")
         p.add_argument("--throughput-only", action="store_true",
                        help="run ONLY the pipelined-throughput bench "

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Tier-1 multi-device smoke (ISSUE 14): the GROUP-MAJOR dispatch
+path on a real 4-virtual-device ``(group, replica)`` mesh
+(``jax_num_cpu_devices`` / ``--xla_force_host_platform_device_count``),
+driven end-to-end by a live 2-group LocalCluster under pipelined load
+through the ASYNC dispatch beat.
+
+Asserts:
+- the mesh really shards groups across devices (>= 2 devices used),
+- group-major dispatches flowed and BOTH groups' commits were adopted
+  from the device plane,
+- the RECOMPILE SENTINEL reads zero (no live-path XLA compile past
+  build/warmup, across the warm and chained dispatch signatures the
+  traffic exercises).
+
+LOUD SKIP (exit 0 with a banner) when this jax cannot host virtual
+CPU devices — the tier-1 gate stays green on such boxes, but the skip
+is visible in the log.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 4:
+        print("!! MULTI-DEVICE SMOKE SKIPPED — this jax hosts "
+              f"{len(jax.devices())} CPU device(s); virtual-device "
+              "meshes unavailable (--xla_force_host_platform_device_"
+              "count ignored)", file=sys.stderr)
+        return 0
+
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.device_plane import unexpected_compiles
+
+    base = unexpected_compiles()
+    with LocalCluster(3, groups=2, device_plane=True, device_batch=16,
+                      group_major=True) as c:
+        c.wait_for_group_leaders(30.0)
+        runner = c.device_runner
+        assert runner.n_devices >= 2, \
+            f"mesh did not shard groups across devices " \
+            f"({runner.n_devices} device)"
+        with ApusClient(list(c.spec.peers), groups=2,
+                        timeout=30.0) as cl:
+            for r in range(6):
+                cl.pipeline_puts([(b"mdsmoke%d-%d" % (r, i), b"v" * 32)
+                                  for i in range(64)])
+        time.sleep(1.0)
+        snap = runner.metrics.snapshot()
+        windows = snap["dev_group_major_windows"]["value"]
+        assert windows > 0, "no group-major dispatches flowed"
+        devc = {gid: sum(d.group_node(gid).stats.get(
+                    "devplane_commits", 0) for d in c.live())
+                for gid in range(2)}
+        assert all(v > 0 for v in devc.values()), \
+            f"device-plane commits missing for a group: {devc}"
+        sentinel = unexpected_compiles() - base
+        assert sentinel == 0 and snap["dev_recompiles"]["value"] == 0, \
+            f"RECOMPILE SENTINEL nonzero: {sentinel}"
+        print(f"multidev smoke: OK — mesh "
+              f"{dict(runner._mesh.shape)}, {windows} group-major "
+              f"dispatches, async overlap "
+              f"{snap['dev_async_overlap_windows']['value']}, "
+              f"device commits {devc}, recompile sentinel 0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

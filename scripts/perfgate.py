@@ -45,6 +45,8 @@ LAST = os.path.join(REPO, "eval", "results", "perfgate_last.json")
 FACTORS = {
     "depth1_window_wall_p50_us": 2.0,
     "group4_dispatch_wall_p50_us": 2.0,
+    "group4_dev4_window_wall_p50_us": 2.0,
+    "group4_dev4_dispatch_per_gw": 2.0,
     "unsampled_obs_check_ns": 3.0,
     "hist_observe_ns": 3.0,
     "native_ingest_op_p50_us": 3.0,
@@ -52,6 +54,8 @@ FACTORS = {
 UNITS = {
     "depth1_window_wall_p50_us": "us",
     "group4_dispatch_wall_p50_us": "us",
+    "group4_dev4_window_wall_p50_us": "us",
+    "group4_dev4_dispatch_per_gw": "dispatches/group-window",
     "unsampled_obs_check_ns": "ns",
     "hist_observe_ns": "ns",
     "native_ingest_op_p50_us": "us",
@@ -163,6 +167,77 @@ def _measure_group_dispatch(repeats: int = 3, iters: int = 30) -> float:
     return round(best, 2)
 
 
+def _measure_multidev_dispatch(repeats: int = 3,
+                               iters: int = 30) -> dict:
+    """The ISSUE 14 dispatch-scaling budget: per-GROUP-WINDOW wall of
+    the ASYNC group-major beat (dispatch window N+1, adopt window N at
+    the fence) on a real 4-device ``(group, replica)`` mesh, ungated.
+    Two numbers:
+
+    - ``group4_dev4_window_wall_p50_us`` — steady-state per-dispatch
+      wall / 4 groups.  "Wall per group-window stays flat-ish as
+      devices grow": a regression that makes the sharded program pay
+      per-device dispatch cost (or adds a hidden sync to the async
+      path) blows this loudly.
+    - ``group4_dev4_dispatch_per_gw`` — dispatches per group-window
+      carried (the amortization floor, 0.25 when every dispatch
+      carries all 4 groups): degeneration toward per-group dispatch
+      doubles it.
+
+    Skipped (empty dict) when jax cannot host 4 virtual devices."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if len(jax.devices()) < 4:
+        return {}
+    from apus_tpu.core.cid import Cid
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.runtime.group_plane import GroupDeviceRunner
+
+    G, R, B = 4, 3, 16
+    runner = GroupDeviceRunner(n_groups=G, n_replicas=R, n_slots=128,
+                               slot_bytes=512, batch=B, max_depth=2,
+                               devices=jax.devices()[:4])
+    gens = [runner.reset_group(g, leader=0, term=1, first_idx=1)
+            for g in range(G)]
+    cid = Cid.initial(R)
+    live = set(range(R))
+    cursors = [1] * G
+
+    def work():
+        out = []
+        for g in range(G):
+            first = cursors[g]
+            es = [LogEntry(idx=first + j, term=1, req_id=j + 1,
+                           clt_id=1, type=EntryType.CSM, head=0,
+                           data=b"x" * 32) for j in range(B)]
+            out.append((g, gens[g], first, es, cid, live))
+            cursors[g] += B
+        return out
+
+    prev = runner.commit_groups(work()) and None     # warm sync shape
+    prev = runner.dispatch_groups(work())            # prime the beat
+    best = float("inf")
+    dispatches = gw = 0
+    for _ in range(repeats):
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            win = runner.dispatch_groups(work())
+            runner.adopt_window(prev)
+            prev = win
+            walls.append((time.perf_counter_ns() - t0) / 1e3)
+            dispatches += 1
+            gw += G
+        best = min(best, statistics.median(walls))
+    runner.adopt_window(prev)
+    return {
+        "group4_dev4_window_wall_p50_us": round(best / G, 2),
+        "group4_dev4_dispatch_per_gw": round(dispatches / gw, 3),
+    }
+
+
 def _measure_obs_fast_path(n: int = 300_000) -> tuple[float, float]:
     """(unsampled check ns/op, histogram observe ns/sample), each the
     best of 3 passes."""
@@ -268,6 +343,7 @@ def measure(fast: bool = False) -> dict:
     if not fast:
         out["depth1_window_wall_p50_us"] = _measure_depth1_window()
         out["group4_dispatch_wall_p50_us"] = _measure_group_dispatch()
+        out.update(_measure_multidev_dispatch())
     return out
 
 
@@ -299,6 +375,15 @@ def main(argv=None) -> int:
                     help="obs fast-path checks only (no jax compile) "
                          "— the tier-1 smoke shape")
     args = ap.parse_args(argv)
+
+    # The multi-device dispatch budget needs a 4-device virtual CPU
+    # mesh; the flag must land before anything imports jax.  The other
+    # checks pin their meshes to devices[:1] and are unaffected.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if not args.fast and "jax" not in sys.modules \
+            and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
 
     measured = measure(fast=args.fast)
     if args.rebase:

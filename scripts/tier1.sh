@@ -73,6 +73,15 @@ if [ "$smoke" -eq 1 ]; then
         echo "large-state churn smoke FAILED (rc=$src)" >&2
         exit "$src"
     fi
+    echo "== multi-device smoke (group-major dispatch on a 4-virtual-"
+    echo "   device (group, replica) mesh, async beat, sentinel-zero"
+    echo "   assert; loud skip if jax can't host virtual devices) =="
+    python scripts/multidev_smoke.py
+    mdrc=$?
+    if [ "$mdrc" -ne 0 ]; then
+        echo "multi-device smoke FAILED (rc=$mdrc)" >&2
+        exit "$mdrc"
+    fi
     echo "== multi-group smoke (2 groups, live ProcCluster, leader "
     echo "   kill, per-group audit; 1 trial) =="
     env JAX_PLATFORMS=cpu python benchmarks/fuzz.py \

@@ -81,6 +81,13 @@ def pytest_configure(config):
         "generalization; selectable with -m txn")
     config.addinivalue_line(
         "markers",
+        "multidevice: multi-device group-major dispatch suite "
+        "(ops.mesh.group_replica_mesh + the sharded group-window step "
+        "+ async dispatch) — sharding-spec pins, cross-device "
+        "equivalence, sentinel-zero across device counts; selectable "
+        "with -m multidevice")
+    config.addinivalue_line(
+        "markers",
         "native: native serving-data-plane suite (native/dataplane.cpp "
         "via apus_tpu/parallel/native_plane.py) — cross-impl "
         "byte-equivalence tapes, native dedup/lease-GET fast-path "

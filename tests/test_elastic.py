@@ -344,3 +344,106 @@ def test_deferred_group_join_retry_thread_admits_all_groups():
             assert gn is not None and gn.incarnation > 0
         finally:
             d.stop()
+
+
+def test_migrating_bounce_retry_reapplies_after_flip():
+    """Regression pin for the seed-9480 stale-read-during-live-SPLIT
+    failure (the post-PR-13 ROADMAP OPEN item, root-caused to the
+    MONOTONE epdb dedup rule): a pipelined put bounced out of its
+    burst by the elastic MIGRATING fence is RESENT with its ORIGINAL
+    req_id after its burst successors committed; the monotone rule
+    answered that retry from a LATER request's cached reply — a fake
+    OK for a put that never applied anywhere, observed by the checker
+    as a get returning a value hundreds of writes old.  Exact windowed
+    dedup re-admits the hole, so after the ownership flip the retry
+    re-routes (WRONG_GROUP, fresh req_id) and must REALLY apply at the
+    owner.  This drives the exact interleaving deterministically on
+    the pure-Python serving plane (the sibling native-plane tape is
+    tests/test_native_plane.py::test_pipelined_hole_retry_is_admitted_
+    fresh)."""
+    import threading
+
+    from apus_tpu.runtime.client import OP_CLT_WRITE, ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, groups=2) as c:
+        c.wait_for_group_leaders(timeout=30.0)
+        peers = [p for p in c.spec.peers if p]
+        with ApusClient(peers, groups=2, timeout=30.0,
+                        attempt_timeout=5.0) as cl, \
+             ApusClient(peers, groups=2, timeout=30.0,
+                        attempt_timeout=5.0,
+                        clt_id=(1 << 62) | 424242) as drv:
+            # A key owned by group 0, plus same-group fillers in OTHER
+            # buckets (the burst successors that commit past the
+            # bounced put).
+            k = next(b"mig-k%d" % i for i in range(256)
+                     if group_of_key(b"mig-k%d" % i, 2) == 0)
+            fillers = [kk for kk in (b"mig-f%d" % i
+                                     for i in range(4096))
+                       if group_of_key(kk, 2) == 0
+                       and bucket_of_key(kk) != bucket_of_key(k)][:6]
+            assert cl.put(k, b"old") == b"OK"
+            # Park every daemon's own migration driver: the test IS
+            # the driver here, and must hold the freeze window open
+            # across several client retry cycles (the admission
+            # fences are map reads — they keep working).
+            for d in c.live():
+                d.elastic._stop.set()
+            # Freeze k's bucket: MB at group 0 with dst = the existing
+            # group 1 (driver-identity write, elastic._group_write's
+            # exact shape).
+            mig, bucket = 424242, bucket_of_key(k)
+            drv._req_seq += 1
+            assert drv._op(OP_CLT_WRITE, drv._req_seq,
+                           encode_mig_begin(mig, 1, 1, [bucket]),
+                           gid=0) == b"OK"
+
+            def frozen_everywhere() -> bool:
+                return all(
+                    bucket in getattr(d.group_node(0).sm, "_frozen", ())
+                    for d in c.live())
+
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline \
+                    and not frozen_everywhere():
+                time.sleep(0.02)
+            assert frozen_everywhere()
+
+            done: dict = {}
+
+            def burst():
+                done["replies"] = cl.pipeline_puts(
+                    [(k, b"new")] + [(f, b"x") for f in fillers])
+
+            t = threading.Thread(target=burst, daemon=True)
+            t.start()
+            # Several MIGRATING bounce/retry cycles with the successors
+            # already committed — the epdb-hole window the monotone
+            # rule fake-acked from.
+            time.sleep(1.0)
+            assert t.is_alive(), \
+                "the frozen-bucket put must still be parked"
+            # Complete the migration: capture AFTER the freeze,
+            # install at group 1, commit (flip) at group 0.
+            src_leader = c.group_leader(0)
+            with src_leader.lock:
+                sm = src_leader.group_node(0).sm
+                pairs = [(kk, vv) for kk, vv in sm.store.items()
+                         if not kk.startswith(b"\x00")
+                         and bucket_of_key(kk) == bucket]
+            assert (k, b"old") in pairs, \
+                "capture must carry the frozen value"
+            drv._req_seq += 1
+            assert drv._op(OP_CLT_WRITE, drv._req_seq,
+                           encode_mig_install(mig, 0, 1, [bucket],
+                                              pairs), gid=1) == b"OK"
+            drv._req_seq += 1
+            assert drv._op(OP_CLT_WRITE, drv._req_seq,
+                           encode_mig_commit(mig), gid=0) == b"OK"
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "burst never resolved"
+            assert done["replies"] == [b"OK"] * (1 + len(fillers))
+            # THE PIN: the retried put REALLY applied at the owner.
+            # The monotone-dedup bug left b"old" here (fake OK).
+            assert cl.get(k) == b"new"
