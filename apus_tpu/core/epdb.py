@@ -91,6 +91,11 @@ class PendingRead:
     #: a hint and the client falls back to the leader.
     flr: bool = False
     refused: bool = False
+    #: Hash bucket of a follower read's key (core.node._read_bucket):
+    #: served under a bucket-scoped lease only while the granted read
+    #: set covers it.  None = no bucket discipline (bucket leases off);
+    #: -1 = unroutable payload (full-set leases only).
+    bucket: "int | None" = None
 
 
 class EndpointDB:

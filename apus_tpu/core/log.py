@@ -87,6 +87,12 @@ class SlotLog:
         self.commit = first_idx
         self.end = first_idx
         self._slots: list[Optional[LogEntry]] = [None] * n_slots
+        #: Entry-placement observer (``callable(entry)``), fired on BOTH
+        #: entry paths — leader ``append`` and follower ``write`` — the
+        #: one choke point every entry crosses to enter this log.  The
+        #: per-bucket follower-lease machinery (core.node) hangs its
+        #: bucket-footprint tracking here; None costs nothing.
+        self.on_entry = None
 
     # -- basic queries ----------------------------------------------------
 
@@ -152,6 +158,8 @@ class SlotLog:
                          type=type, data=data, cid=cid, head=head)
         self._slots[self.slot_of(idx)] = entry
         self.end = idx + 1
+        if self.on_entry is not None:
+            self.on_entry(entry)
         return idx
 
     def write(self, entry: LogEntry) -> None:
@@ -165,6 +173,8 @@ class SlotLog:
             raise LogFullError("follower log full")
         self._slots[self.slot_of(entry.idx)] = entry
         self.end = entry.idx + 1
+        if self.on_entry is not None:
+            self.on_entry(entry)
 
     def truncate(self, new_end: int) -> None:
         """Discard entries >= new_end (log adjustment SET_END step,

@@ -143,13 +143,72 @@ class NodeConfig:
     #   the grant, the log-end gate covers everything whose commit
     #   required our ack during the window.
     follower_read_leases: bool = True
+    # Bucket-granular follower leases (Hermes proper: per-KEY write
+    # invalidation, quantized to the elastic plane's 840 hash buckets).
+    # A follower's lease request carries the bucket set its flowing
+    # reads actually touch; the grant binds to that set, and commit
+    # only waits for a holder's ack on entries whose written buckets
+    # INTERSECT one of its live granted sets — a slow holder reading
+    # cold keys no longer stalls every write in the group, and a
+    # hot-key write stream no longer gates every cold-key follower
+    # read behind its apply.  The follower serve rule narrows the same
+    # way: a bucket-b read waits on max(grant floor, b's own log tail)
+    # instead of the whole log end (see follower_read).  False =
+    # whole-log gating (the pre-bucket behavior, kept as the measured
+    # baseline: APUS_FLR_BUCKETS=0).
+    flr_bucket_leases: bool = True
     #: Deliberately-broken lease for the planted-stale-read harness
     #: (set from APUS_FLR_PLANT by the daemon; NEVER in production):
     #: "expiry" skips the fresh-clock expiry check, "epoch" skips the
-    #: config-epoch fence — each makes the audit plane's checker the
-    #: only thing standing between the bug and a stale read, which is
-    #: exactly what the harness proves it catches.
+    #: config-epoch fence, "bucket" skips the granted-read-set
+    #: membership check (serves a bucket the grant never covered) —
+    #: matched by SUBSTRING so plants compose ("bucket,expiry" holds
+    #: the lease open while the bucket check is the bypassed guard).
+    #: Each makes the audit plane's checker the only thing standing
+    #: between the bug and a stale read, which is exactly what the
+    #: harness proves it catches.
     flr_plant: str = ""
+
+
+#: Sentinel bucket for reads whose payload has no routable key (non-KVS
+#: query shapes): they can only be served under a FULL-set lease.
+BUCKET_UNROUTABLE = -1
+
+
+def entry_bucket_footprint(e: "LogEntry"):
+    """Bucket footprint of a log entry — the hash buckets its APPLY can
+    write — for the per-bucket follower-lease invalidation rule.
+
+    Returns a frozenset of buckets (possibly empty: the entry writes
+    nothing, e.g. NOOP/HEAD blanks or pure reads) or ``None`` =
+    UNKNOWN, which callers must treat as "touches every bucket"
+    (conservative: commit then waits for every live lease holder,
+    exactly the whole-log rule).  Unknown covers CONFIG entries,
+    migration records, segment chunk envelopes, and every transaction
+    record except TM — a TC install mutates keys the record itself
+    does not name, so only the self-contained TM batch (all sub-op
+    keys in the payload) and plain single-key commands are exact.
+    Supersets are always safe; only a MISSING written bucket would be
+    a correctness bug."""
+    if e.type in (EntryType.NOOP, EntryType.HEAD):
+        return frozenset()
+    if e.type != EntryType.CSM or not e.data:
+        return None
+    data = e.data
+    if data[:1] == b"T" and data[:2] != b"TM":
+        return None
+    from apus_tpu.models.kvs import cmd_is_read, decode_keys
+    from apus_tpu.runtime.router import bucket_of_key
+    try:
+        keys = decode_keys(data)
+    except Exception:                                    # noqa: BLE001
+        return None
+    if keys is None:
+        return None
+    if not keys:
+        # Keyless-but-parsed: nothing here writes a routable key.
+        return frozenset() if cmd_is_read(data) else None
+    return frozenset(bucket_of_key(k) for k in keys)
 
 
 @dataclasses.dataclass
@@ -385,14 +444,22 @@ class Node:
         # handler).
         self.reads_done = 0
         # -- follower read leases (NodeConfig.follower_read_leases) ----
-        # Leader side: peer -> conservative expiry of the lease WE
-        # granted it, on OUR fresh clock (receipt-anchored + margin, so
-        # it real-time-outlives the grantee's own window under
-        # margin-bounded rate drift).  While live, _advance_commit
-        # requires the grantee's ack (write invalidation).  Pruned by
+        # Leader side: peer -> list of live granted WINDOWS, each
+        # ``(until, buckets)`` with ``until`` the conservative expiry
+        # on OUR fresh clock (receipt-anchored + margin, so it
+        # real-time-outlives the grantee's own window under
+        # margin-bounded rate drift) and ``buckets`` the granted READ
+        # SET (frozenset of hash buckets; None = every bucket — the
+        # whole-log grant shape).  While any window is live,
+        # _advance_commit requires the grantee's ack before passing an
+        # entry whose written buckets intersect that window's set (the
+        # per-key Hermes write invalidation, quantized to buckets).  A
+        # LIST because renewals may narrow/shift the set while an
+        # older window is still live at the holder — every live
+        # window's set keeps binding until its own expiry.  Pruned by
         # time only — membership changes must keep blocking until
         # expiry or a not-yet-aware removed holder could serve stale.
-        self._fgrants: dict[int, float] = {}
+        self._fgrants: dict[int, list] = {}
         # peer -> fresh-clock stamp of the last commit advance its
         # missing ack held back.  Liveness guard: a holder that blocks
         # commit is refused RENEWAL until it catches up, so a peer
@@ -408,6 +475,55 @@ class Node:
         self._flease_epoch = -1
         self._flease_floor = 0
         self._flease_dur = 0.0
+        # Granted read set of the held lease (frozenset of buckets;
+        # None = every bucket).  A read is served under the lease only
+        # when its key's bucket is IN this set — the leader's commit
+        # rule only waited for our ack on those buckets' writes.
+        self._flease_buckets = None
+        # Demand tracking for the NEXT lease request: bucket -> fresh-
+        # clock stamp of the last follower read that wanted it.  The
+        # request ships the recently-wanted set as a 105-byte bitmap
+        # (runtime.flr); entries idle past FLR_WANT_WINDOW decay out.
+        # A read with no routable key forces full-set requests for a
+        # want-window (it can only be served under a full-set lease).
+        self._flr_want: dict[int, float] = {}
+        self._flr_want_full_until = -1.0
+        # Set by runtimes whose serve path cannot check per-key bucket
+        # membership (the native data plane's C read gate): leases are
+        # then requested FULL-set, trading back the per-bucket commit
+        # relief for native-path serving.
+        self.flr_full_buckets = False
+        # Entry-placement bucket tails, BOTH roles (fed by the
+        # SlotLog.on_entry hook): bucket -> end-like index just past
+        # the last log entry whose footprint touches it, and the same
+        # for UNKNOWN-footprint entries (which count for every
+        # bucket).  The follower serve rule for a bucket-b read waits
+        # on max(grant floor, _bucket_tails[b], _bucket_tail_all)
+        # instead of the whole log end — a hot-key write stream no
+        # longer gates cold-key follower reads behind its apply.
+        # Over-approximation is safe (truncated entries leave a stale
+        # high tail: the read just waits longer); a missing tail for a
+        # log-resident write would be the bug, and the hook fires on
+        # every entry path (append AND follower write).
+        self._bucket_tails: dict[int, int] = {}
+        self._bucket_tail_all = 0
+        # idx -> footprint cache for the leader's commit-cap walk over
+        # (commit, end] (computing footprints per tick would re-parse
+        # every uncommitted payload); pruned below commit lazily.
+        self._entry_buckets: dict[int, object] = {}
+        self._entry_buckets_prunes = 0
+        # Leader per-bucket COMMIT floors for bucket-scoped grants:
+        # bucket -> end-like index just past the last committed entry
+        # touching it (same shape for unknown-footprint entries), fed
+        # incrementally from the commit cursor below.  A grant for
+        # read set S carries floor = max over S — with a hot writer
+        # OUTSIDE S, a cold-bucket grant's floor stays at the last
+        # cold write instead of chasing the hot commit index.
+        self._bucket_commits: dict[int, int] = {}
+        self._bucket_commit_all = 0
+        self._bucket_commit_cursor = 0
+        if cfg.flr_bucket_leases:
+            self.log.on_entry = self._note_entry_buckets
         # Reads parked on the lease (serve once applied covers them).
         self._flr_pending: list[PendingRead] = []
         # Lease-keeping is LAZY: requested only while follower reads
@@ -600,8 +716,84 @@ class Node:
     def _flr_enabled(self) -> bool:
         return self.cfg.read_lease and self.cfg.follower_read_leases
 
-    def grant_follower_lease(self, peer: int,
-                             incarnation: int = 0) -> Optional[dict]:
+    def _note_entry_buckets(self, e: "LogEntry") -> None:
+        """SlotLog.on_entry hook (every entry path, both roles): track
+        bucket tails + the leader walk's footprint cache."""
+        bs = entry_bucket_footprint(e)
+        end = e.idx + 1
+        self._entry_buckets[e.idx] = bs
+        if bs is None:
+            if end > self._bucket_tail_all:
+                self._bucket_tail_all = end
+        else:
+            for b in bs:
+                if end > self._bucket_tails.get(b, 0):
+                    self._bucket_tails[b] = end
+        # Lazy cache pruning: entries below commit never enter the cap
+        # walk again (the grant-floor walk reads the log directly).
+        self._entry_buckets_prunes += 1
+        if self._entry_buckets_prunes >= 1024:
+            self._entry_buckets_prunes = 0
+            c = self.log.commit
+            for idx in [i for i in self._entry_buckets if i < c]:
+                del self._entry_buckets[idx]
+
+    def _entry_footprint(self, idx: int):
+        """Cached footprint of the entry at ``idx`` (None = unknown =
+        every bucket; a missing entry is unknown too)."""
+        try:
+            return self._entry_buckets[idx]
+        except KeyError:
+            e = self.log.get(idx)
+            bs = entry_bucket_footprint(e) if e is not None else None
+            self._entry_buckets[idx] = bs
+            return bs
+
+    def _advance_bucket_commits(self) -> None:
+        """Advance the leader's per-bucket commit floors to the current
+        commit index (incremental walk from the cursor; pruned history
+        below the log head counts for every bucket — it is all applied,
+        so its floor contribution is <= head anyway)."""
+        c = self.log.commit
+        cur = self._bucket_commit_cursor
+        if cur >= c:
+            return
+        if cur < self.log.head:
+            self._bucket_commit_all = max(self._bucket_commit_all,
+                                          self.log.head)
+            cur = self.log.head
+        for e in self.log.entries(cur, c):
+            bs = self._entry_footprint(e.idx)
+            end = e.idx + 1
+            if bs is None:
+                self._bucket_commit_all = max(self._bucket_commit_all,
+                                              end)
+            else:
+                for b in bs:
+                    if end > self._bucket_commits.get(b, 0):
+                        self._bucket_commits[b] = end
+        self._bucket_commit_cursor = c
+
+    def _grant_floor(self, buckets) -> int:
+        """Commit floor for a grant with read set ``buckets`` (None =
+        every bucket): everything committed to those buckets so far.
+        The whole-log shape is simply ``log.commit``; a bucket-scoped
+        grant floors at the last committed write TOUCHING its set, so
+        an unrelated hot-key write stream stops dragging cold-bucket
+        grant floors (and with them every cold follower read's apply
+        wait) along behind it."""
+        if buckets is None or not self.cfg.flr_bucket_leases:
+            return self.log.commit
+        self._advance_bucket_commits()
+        floor = self._bucket_commit_all
+        for b in buckets:
+            f = self._bucket_commits.get(b, 0)
+            if f > floor:
+                floor = f
+        return floor
+
+    def grant_follower_lease(self, peer: int, incarnation: int = 0,
+                             buckets=None) -> Optional[dict]:
         """Leader side of OP_FLR_LEASE (called under the node lock by
         the lease wire op): grant ``peer`` a commit-index-bounded read
         lease nested inside our own leader lease, or refuse (None).
@@ -650,15 +842,25 @@ class Node:
         if self.log.commit <= self._term_start_idx:
             self.bump("flr_grant_refusals")
             return None
-        # Liveness guards: only a caught-up follower may hold a lease —
-        # a laggard holding one would stall commit (blocker rule) for
-        # the whole window while never serving a read — and a holder
-        # that RECENTLY blocked commit must fully catch up before it
-        # renews (see _flr_blocked_at: without this, an asymmetric
-        # partition that drops our entries but delivers its requests
-        # would let it renew itself into a permanent write stall).
+        if not self.cfg.flr_bucket_leases:
+            buckets = None
+        floor = self._grant_floor(buckets)
+        # Liveness guards: only a follower caught up ON THE REQUESTED
+        # READ SET may hold a lease — a laggard holding one would
+        # stall commit (blocker rule) for the whole window while never
+        # serving a read.  For a whole-log grant the set floor IS
+        # log.commit (the pre-bucket rule); a bucket-scoped grant only
+        # requires the holder to have replicated everything committed
+        # to its buckets (all it can serve, and all its window can
+        # block — commits outside the set bypass it), so replication-
+        # link lag on an unrelated hot stream no longer starves cold
+        # readers of leases.  A holder that RECENTLY blocked commit
+        # must fully catch up before it renews (see _flr_blocked_at:
+        # without this, an asymmetric partition that drops our entries
+        # but delivers its requests would let it renew itself into a
+        # permanent write stall).
         ack = self.regions.ctrl[Region.REP_ACK][peer]
-        if ack is None or ack < self.log.commit:
+        if ack is None or ack < floor:
             self.bump("flr_grant_refusals")
             return None
         if ack < self.log.end and \
@@ -671,49 +873,121 @@ class Node:
             self.bump("flr_grant_refusals")
             return None
         until = fnow + dur * (1.0 + self.cfg.lease_margin)
-        had_live = self._fgrants.get(peer, -1.0) > fnow
-        if until > self._fgrants.get(peer, -1.0):
-            self._fgrants[peer] = until
+        wins = self._fgrants.setdefault(peer, [])
+        had_live = any(u > fnow for u, _ in wins)
+        # Prune dead windows in place, then track the new one.  A
+        # same-set renewal extends the existing window instead of
+        # growing the list (the common steady-state shape).
+        wins[:] = [w for w in wins if w[0] > fnow]
+        for i, (u, bs) in enumerate(wins):
+            if bs == buckets:
+                wins[i] = (max(u, until), bs)
+                break
+        else:
+            wins.append((until, buckets))
         self.bump("flr_grants")
+        if buckets is not None:
+            self.bump("flr_bucket_grants")
         if not had_live:
             self._note("lease", "flr_grant", peer=peer,
-                       term=self.current_term, floor=self.log.commit)
+                       term=self.current_term, floor=floor,
+                       buckets=(-1 if buckets is None else len(buckets)))
         return {"term": self.current_term, "epoch": self.cid.epoch,
-                "floor": self.log.commit, "dur": dur}
+                "floor": floor, "dur": dur}
 
-    def _flr_live_blockers(self, fnow: float) -> list[int]:
-        """Peers whose granted lease window is still live on our clock:
-        commit must not advance past an index they have not acked.
-        Pruned by TIME only — a slot removed from the config keeps
-        blocking until its window expires (its ex-holder may not have
-        applied the removal yet and would serve reads missing anything
-        we committed without it)."""
+    def _flr_live_windows(self, fnow: float) -> dict:
+        """peer -> live granted windows ``[(until, buckets), ...]`` on
+        our clock (expired ones pruned in place): commit must not
+        advance past an entry a window's read set covers until its
+        holder acks it.  Pruned by TIME only — a slot removed from the
+        config keeps blocking until its windows expire (its ex-holder
+        may not have applied the removal yet and would serve reads
+        missing anything we committed without it)."""
         if not self._fgrants:
-            return []
-        live = []
-        for p, until in list(self._fgrants.items()):
-            if until <= fnow:
-                del self._fgrants[p]
+            return {}
+        out = {}
+        for p, wins in list(self._fgrants.items()):
+            live = [w for w in wins if w[0] > fnow]
+            if live:
+                self._fgrants[p] = live
+                out[p] = live
             else:
-                live.append(p)
-        return live
+                del self._fgrants[p]
+        return out
+
+    @staticmethod
+    def _windows_cover(wins, fp) -> bool:
+        """Does any window's read set intersect footprint ``fp``?
+        (fp None = unknown entry = every bucket; a window set of None
+        = whole-log grant = every bucket.)"""
+        for _, bs in wins:
+            if bs is None or fp is None:
+                return True
+            if not fp.isdisjoint(bs):
+                return True
+        return False
 
     def flr_commit_cap(self) -> Optional[int]:
         """Max index commit may advance to under outstanding follower
         leases (None = unconstrained).  Consulted by _advance_commit
         AND by the device plane's commit adoption — grants are refused
         while external_commit is on, but a grant issued just before the
-        flip must keep binding until it expires."""
-        if self._flr_holdoff_until > 0 \
-                and self._fresh_now() < self._flr_holdoff_until:
+        flip must keep binding until it expires.
+
+        Bucket-granular (NodeConfig.flr_bucket_leases): walking up
+        from commit, an entry blocks only the holders whose live
+        granted read set INTERSECTS its written buckets — the cap is
+        the first index such a holder has not acked.  Unknown
+        footprints (CONFIG, migration, non-TM txn records) block on
+        every live holder, which IS the whole-log rule; so does every
+        entry when the knob is off (every window's set is None)."""
+        fnow = self._fresh_now()
+        if self._flr_holdoff_until > 0 and fnow < self._flr_holdoff_until:
             # Fresh-leadership hold-off (become_leader).
             return self.log.commit
-        blockers = self._flr_live_blockers(self._fresh_now())
-        if not blockers:
+        wins = self._flr_live_windows(fnow)
+        if not wins:
             return None
         acks = self.regions.ctrl[Region.REP_ACK]
-        return min((acks[p] if acks[p] is not None else 0)
-                   for p in blockers)
+        bypassed = False
+        for idx in range(self.log.commit, self.log.end):
+            fp = self._entry_footprint(idx)
+            lagging = []
+            skipped = False
+            for p, pw in wins.items():
+                a = acks[p]
+                if a is not None and a >= idx + 1:
+                    continue
+                if self._windows_cover(pw, fp):
+                    lagging.append(p)
+                else:
+                    skipped = True
+            if lagging:
+                # Renewal embargo + accounting only when the entry has
+                # host-ack MAJORITY (the lease is then really what
+                # holds commit back — the pre-bucket rule stamped in
+                # exactly that case; a sub-majority entry wasn't going
+                # to commit anyway, and under device-owned commit the
+                # host ack view legitimately lags).
+                mask = 1 << self.idx
+                for peer, a in enumerate(acks):
+                    if a is not None and a >= idx + 1:
+                        mask |= 1 << peer
+                if have_majority(mask, self.cid):
+                    self.bump("flr_commit_blocked")
+                    for p in lagging:
+                        self._flr_blocked_at[p] = fnow
+                if bypassed:
+                    self.bump("flr_commit_bypass")
+                return idx
+            if skipped:
+                # A lagging holder's set was disjoint from this
+                # entry's buckets: the whole-log rule would have
+                # stopped here — the per-bucket relief, counted.
+                bypassed = True
+        if bypassed:
+            self.bump("flr_commit_bypass")
+        return None
 
     def _flease_ok(self, fnow: float) -> tuple[bool, str]:
         """Is OUR follower lease currently serveable?  Returns
@@ -731,9 +1005,9 @@ class Node:
             return False, "term"
         if self.cid.state != CidState.STABLE:
             return False, "config"
-        if self._flease_epoch != self.cid.epoch and plant != "epoch":
+        if self._flease_epoch != self.cid.epoch and "epoch" not in plant:
             return False, "epoch"
-        if fnow >= self._flease_until and plant != "expiry":
+        if fnow >= self._flease_until and "expiry" not in plant:
             if fnow - self._flease_until > self._flease_dur:
                 # Missed by more than a whole window: the process was
                 # paused or the clock jumped — the classic lease
@@ -741,6 +1015,71 @@ class Node:
                 return False, "pause_or_jump"
             return False, "expired"
         return True, "ok"
+
+    #: Demand-tracking window for the requested read set: a bucket a
+    #: follower read touched within this many seconds rides the next
+    #: lease request's bitmap (idle buckets decay out, narrowing the
+    #: set the leader's writes must invalidate against).
+    FLR_WANT_WINDOW = 2.0
+
+    def _read_bucket(self, data: bytes):
+        """Hash bucket of a follower read's key; BUCKET_UNROUTABLE for
+        payloads with no routable key (serveable only under a full-set
+        lease); None when bucket leases are off (no bucket discipline
+        — the pre-bucket whole-log behavior)."""
+        if not self.cfg.flr_bucket_leases:
+            return None
+        from apus_tpu.models.kvs import decode_key
+        from apus_tpu.runtime.router import bucket_of_key
+        k = decode_key(data)
+        return (bucket_of_key(k) if k is not None
+                else BUCKET_UNROUTABLE)
+
+    def _flease_covers(self, bucket) -> bool:
+        """Is ``bucket`` inside the held lease's granted read set?
+        (The 'bucket' plant skips this check — the planted-stale
+        harness proves the audit checker catches what it guards.)"""
+        if self._flease_buckets is None:
+            return True
+        if "bucket" in self.cfg.flr_plant:
+            return True
+        if bucket is None or bucket < 0:
+            return False
+        return bucket in self._flease_buckets
+
+    def _flr_wait_idx(self, bucket) -> int:
+        """Apply index a bucket-``bucket`` follower read must wait for.
+        Full-set leases keep the whole-log rule (everything in our log
+        at registration may have committed via our ack); bucket-scoped
+        leases only ever acked-gated writes TOUCHING the granted set,
+        so a bucket-b read needs only max(grant floor, b's own log
+        tail, the unknown-footprint tail) — the hot-key write stream's
+        apply stops gating cold-key reads."""
+        if self._flease_buckets is None or bucket is None or bucket < 0:
+            return max(self.log.end, self._flease_floor)
+        return max(self._flease_floor, self._bucket_tail_all,
+                   self._bucket_tails.get(bucket, 0))
+
+    def _flr_want_set(self, fnow: float):
+        """Read set for the next lease request (None = full set):
+        recently-wanted buckets, decayed past FLR_WANT_WINDOW."""
+        if not self.cfg.flr_bucket_leases or self.flr_full_buckets:
+            return None
+        if fnow < self._flr_want_full_until:
+            return None
+        cutoff = fnow - self.FLR_WANT_WINDOW
+        stale = [b for b, t in self._flr_want.items() if t < cutoff]
+        for b in stale:
+            del self._flr_want[b]
+        return frozenset(self._flr_want)
+
+    def _want_covered(self, fnow: float) -> bool:
+        """Does the held lease's set cover current read demand?"""
+        if self._flease_buckets is None:
+            return True
+        if fnow < self._flr_want_full_until:
+            return False
+        return all(b in self._flease_buckets for b in self._flr_want)
 
     def follower_read(self, req_id: int, clt_id: int,
                       data: bytes) -> Optional[PendingRead]:
@@ -764,18 +1103,28 @@ class Node:
             return None
         fnow = self._fresh_now()
         self._flr_hot_until = fnow + 1.0
+        bucket = self._read_bucket(data)
+        if bucket is None:
+            pass
+        elif bucket >= 0:
+            self._flr_want[bucket] = fnow
+        else:
+            self._flr_want_full_until = fnow + self.FLR_WANT_WINDOW
         ok, _why = self._flease_ok(fnow)
-        if not ok:
-            # Cold lease: one inline request (lock yielded on the
-            # wire) before parking the read — a cold GET then costs
-            # one extra roundtrip instead of a leader bounce.
+        covered = ok and self._flease_covers(bucket)
+        if not covered:
+            # Cold lease (or the held read set misses this bucket):
+            # one inline request (lock yielded on the wire) before
+            # parking the read — a cold GET then costs one extra
+            # roundtrip instead of a leader bounce.
             self._request_flease(fnow)
             fnow = self._fresh_now()
             ok, _why = self._flease_ok(fnow)
-        wait_idx = max(self.log.end, self._flease_floor)
+            covered = ok and self._flease_covers(bucket)
+        wait_idx = self._flr_wait_idx(bucket)
         rr = PendingRead(clt_id, req_id, data, wait_idx=wait_idx,
-                         registered_at=fnow, flr=True)
-        if ok and self.log.apply >= wait_idx:
+                         registered_at=fnow, flr=True, bucket=bucket)
+        if covered and self.log.apply >= wait_idx:
             try:
                 rr.reply = self.sm.query(data)
             except Exception:
@@ -816,8 +1165,9 @@ class Node:
                        term=self.current_term)
         still: list[PendingRead] = []
         for r in self._flr_pending:
-            if ok and self.log.apply >= max(r.wait_idx,
-                                            self._flease_floor):
+            covered = ok and self._flease_covers(r.bucket)
+            if covered and self.log.apply >= max(r.wait_idx,
+                                                 self._flease_floor):
                 try:
                     r.reply = self.sm.query(r.data)
                 except Exception:
@@ -826,11 +1176,16 @@ class Node:
                 r.done = True
                 self.reads_done += 1
                 self.bump("flr_local_reads")
-            elif not ok and fnow - r.registered_at \
+            elif not covered and fnow - r.registered_at \
                     > self.FLR_REFUSE_AFTER_HB * self._hb_timeout:
+                # Lease dead, or live but its granted read set still
+                # misses this read's bucket after a renewal window:
+                # bounce to the leader.
                 r.refused = True
                 self.reads_done += 1
                 self.bump("flr_forwards")
+                if ok:
+                    self.bump("flr_bucket_refusals")
             else:
                 still.append(r)
         self._flr_pending = still
@@ -859,7 +1214,8 @@ class Node:
         if fnow >= self._flr_hot_until and not self._flr_pending:
             return
         if self._flease_until - fnow > 0.5 * self._hb_timeout \
-                and self._flease_ok(fnow)[0]:
+                and self._flease_ok(fnow)[0] \
+                and self._want_covered(fnow):
             return
         if now < self._flr_next_req:
             return
@@ -878,10 +1234,11 @@ class Node:
                 or self._flr_req_inflight:
             return
         term0 = self.current_term
+        want = self._flr_want_set(t_req)
         self._flr_req_inflight = True
         try:
             self.bump("flr_requests")
-            grant = self.lease_requester(leader)
+            grant = self.lease_requester(leader, want)
         finally:
             self._flr_req_inflight = False
         if not grant:
@@ -893,7 +1250,12 @@ class Node:
             return
         until = t_req + float(grant.get("dur", 0.0))
         if until <= self._flease_until and \
-                grant.get("epoch") == self._flease_epoch:
+                grant.get("epoch") == self._flease_epoch and \
+                (self._flease_buckets is None
+                 or (want is not None
+                     and want <= self._flease_buckets)):
+            # Nothing new: shorter window, same epoch, and the held
+            # set already covers the requested one.
             return
         self._flease_until = until
         self._flease_term = int(grant["term"])
@@ -901,6 +1263,9 @@ class Node:
         self._flease_floor = max(self._flease_floor,
                                  int(grant["floor"]))
         self._flease_dur = float(grant.get("dur", 0.0))
+        # The grant binds to the set we REQUESTED (the leader granted
+        # exactly it); adopted atomically with the window.
+        self._flease_buckets = want
         self.bump("flr_renewals")
         if not self._flr_noted:
             self._flr_noted = True
@@ -913,6 +1278,7 @@ class Node:
         self._flease_term = -1
         self._flease_epoch = -1
         self._flease_floor = 0
+        self._flease_buckets = None
         self._flr_refuse_all("role_change")
 
     def flush_pending(self) -> None:
@@ -2257,36 +2623,33 @@ class Node:
                 return
             self._flr_holdoff_until = -1.0
         acks = self.regions.ctrl[Region.REP_ACK]
-        # Follower-lease write invalidation (Hermes on the log): while
-        # a granted read-lease window is live, commit must not advance
-        # past an index its holder has not acked — otherwise the holder
-        # could serve a local read missing a client-acked write.  A
-        # blocked candidate falls through to SMALLER candidates (the
-        # holder's own ack is in the candidate set), so commit still
-        # advances as far as every live lease holder has replicated;
-        # an unreachable holder stalls it for at most one lease window.
-        fnow = self._fresh_now() if self._fgrants else 0.0
-        blockers = (self._flr_live_blockers(fnow)
-                    if self._fgrants else [])
+        # Follower-lease write invalidation (Hermes, quantized to the
+        # 840-bucket shard map): while a granted read-lease window is
+        # live, commit must not advance past an entry WHOSE WRITTEN
+        # BUCKETS its holder's granted read set covers until that
+        # holder acks it — otherwise the holder could serve a local
+        # read missing a client-acked write.  Entries outside every
+        # live read set commit freely past a lagging holder (the
+        # per-key relief; whole-log grants and unknown footprints
+        # block on everyone, the pre-bucket rule).  flr_commit_cap
+        # walks (commit, end] and returns the first blocked index;
+        # blocked candidates fall through to smaller ones, so commit
+        # still advances as far as the leases allow, and an
+        # unreachable holder stalls a covered write for at most one
+        # lease window.
+        cap = self.flr_commit_cap() if self._fgrants else None
         candidates = sorted({a for a in acks if a is not None} | {self.log.end},
                             reverse=True)
         for c in candidates:
             if c <= self.log.commit:
                 break
+            if cap is not None and c > cap:
+                continue        # lease-blocked: try a smaller candidate
             mask = 1 << self.idx
             for peer, a in enumerate(acks):
                 if a is not None and a >= c:
                     mask |= 1 << peer
             if have_majority(mask, self.cid):
-                lagging = [p for p in blockers
-                           if acks[p] is None or acks[p] < c]
-                if lagging:
-                    self.bump("flr_commit_blocked")
-                    for p in lagging:
-                        # Renewal embargo until it catches up (grant
-                        # liveness guard).
-                        self._flr_blocked_at[p] = fnow
-                    continue    # try a smaller, holder-acked candidate
                 # Raft safety: only commit prefixes ending in our own term
                 # (the blank entry from become_leader guarantees progress).
                 last = self.log.get(c - 1)

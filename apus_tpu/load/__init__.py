@@ -1,0 +1,33 @@
+"""Open-loop SLO load harness (the "millions of users" evaluation).
+
+The serving-surface benchmark methodology, in three deterministic
+primitives plus one engine:
+
+- :mod:`apus_tpu.load.zipf` — seeded zipfian key-popularity sampler
+  (hot-key skew; YCSB/redis-benchmark methodology);
+- :mod:`apus_tpu.load.schedule` — OPEN-LOOP arrival schedules (fixed
+  arrival rate, Poisson or uniform gaps, optional fan-in bursts):
+  arrivals are decided BEFORE the run and never slowed by the server;
+- :mod:`apus_tpu.load.latency` — coordinated-omission-safe latency
+  accounting: every op's latency is measured from its SCHEDULED
+  arrival, so a server stall surfaces as the queueing delay every
+  virtual user would have seen (a closed-loop client silently stops
+  sampling exactly while the server is at its worst — the classic
+  p999 lie), plus p50/p99/p999 + windowed SLO-degradation reporting;
+- :mod:`apus_tpu.load.openloop` — the many-hundred-connection engine
+  (non-blocking sockets, one selector loop) speaking the KVS client
+  wire or RESP at an app gateway, with seeded connection churn.
+
+``python -m apus_tpu.load --help`` runs it standalone; bench.py --slo
+is the banked entry point.
+"""
+
+from apus_tpu.load.latency import LatencyRecorder, percentile
+from apus_tpu.load.openloop import OpenLoopConfig, run_open_loop
+from apus_tpu.load.schedule import (burst_schedule, poisson_schedule,
+                                    uniform_schedule)
+from apus_tpu.load.zipf import ZipfKeys
+
+__all__ = ["LatencyRecorder", "percentile", "OpenLoopConfig",
+           "run_open_loop", "poisson_schedule", "uniform_schedule",
+           "burst_schedule", "ZipfKeys"]

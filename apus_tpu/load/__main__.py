@@ -1,0 +1,3 @@
+from apus_tpu.load.openloop import main
+
+raise SystemExit(main())

@@ -1,0 +1,120 @@
+"""Coordinated-omission-safe latency accounting + SLO reporting.
+
+Every op records ``(scheduled_arrival, completion, ok)``; its latency
+is ``completion - scheduled_arrival`` — service time PLUS the queueing
+delay the open-loop schedule accumulated while the server was slow.
+Ops still unresolved when the run ends are completed AT the cutoff
+(their latency is a LOWER bound, counted as censored), so a stall near
+the end cannot vanish from the tail.
+
+The windowed view buckets samples by scheduled arrival and reports a
+per-window p99 plus the SLO verdict, from which the chaos-composed
+runs quantify the DEGRADATION WINDOW around a fault (first degraded
+window .. last degraded window).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def percentile(sorted_vals: "list[float]", q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (q in [0,1])."""
+    if not sorted_vals:
+        return 0.0
+    i = int(q * (len(sorted_vals) - 1) + 0.5)
+    return sorted_vals[min(i, len(sorted_vals) - 1)]
+
+
+@dataclasses.dataclass
+class SloReport:
+    ops: int
+    errors: int
+    censored: int                 # unresolved at cutoff (latency = lower bound)
+    duration_s: float
+    achieved_rate: float          # completed ops / duration
+    p50_ms: float
+    p90_ms: float
+    p99_ms: float
+    p999_ms: float
+    max_ms: float
+    #: per-window rows: (window_start_s, ops, p99_ms, degraded)
+    windows: "list[tuple]" = dataclasses.field(default_factory=list)
+    slo_ms: float = 0.0
+    #: contiguous degraded spans [(start_s, end_s), ...] on the
+    #: scheduled-arrival axis
+    degraded_spans: "list[tuple]" = dataclasses.field(default_factory=list)
+
+    @property
+    def degraded_s(self) -> float:
+        return sum(b - a for a, b in self.degraded_spans)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["degraded_s"] = self.degraded_s
+        return d
+
+
+class LatencyRecorder:
+    """Lock-free-enough sample sink (single driver thread)."""
+
+    def __init__(self) -> None:
+        #: (scheduled_t, latency_s, ok) triples
+        self.samples: "list[tuple[float, float, bool]]" = []
+        self.errors = 0
+        self.censored = 0
+
+    def record(self, sched_t: float, done_t: float,
+               ok: bool = True) -> None:
+        self.samples.append((sched_t, done_t - sched_t, ok))
+        if not ok:
+            self.errors += 1
+
+    def censor(self, sched_t: float, cutoff_t: float) -> None:
+        """An op still unresolved at the run cutoff: latency >= the
+        recorded value.  Counted in the tail, flagged in the report."""
+        self.samples.append((sched_t, max(0.0, cutoff_t - sched_t),
+                             False))
+        self.errors += 1
+        self.censored += 1
+
+    def report(self, duration_s: float, slo_ms: float = 0.0,
+               window_s: float = 0.5) -> SloReport:
+        lats = sorted(l for _, l, _ in self.samples)
+        n = len(lats)
+        rep = SloReport(
+            ops=n, errors=self.errors, censored=self.censored,
+            duration_s=duration_s,
+            achieved_rate=(n / duration_s if duration_s > 0 else 0.0),
+            p50_ms=percentile(lats, 0.50) * 1e3,
+            p90_ms=percentile(lats, 0.90) * 1e3,
+            p99_ms=percentile(lats, 0.99) * 1e3,
+            p999_ms=percentile(lats, 0.999) * 1e3,
+            max_ms=(lats[-1] * 1e3 if lats else 0.0),
+            slo_ms=slo_ms)
+        if window_s <= 0 or not self.samples:
+            return rep
+        buckets: dict[int, list] = {}
+        bad: dict[int, int] = {}
+        for t, lat, ok in self.samples:
+            w = int(t / window_s)
+            buckets.setdefault(w, []).append(lat)
+            if not ok:
+                bad[w] = bad.get(w, 0) + 1
+        span_start = None
+        prev_end = None
+        for w in sorted(buckets):
+            ls = sorted(buckets[w])
+            p99 = percentile(ls, 0.99) * 1e3
+            degraded = bool(bad.get(w)) or (slo_ms > 0 and p99 > slo_ms)
+            rep.windows.append((w * window_s, len(ls), p99, degraded))
+            if degraded:
+                if span_start is None:
+                    span_start = w * window_s
+                prev_end = (w + 1) * window_s
+            elif span_start is not None:
+                rep.degraded_spans.append((span_start, prev_end))
+                span_start = None
+        if span_start is not None:
+            rep.degraded_spans.append((span_start, prev_end))
+        return rep

@@ -1,0 +1,589 @@
+"""The open-loop load engine: many hundred non-blocking connections,
+one selector loop, a pre-computed arrival schedule.
+
+Protocols:
+
+- ``kvs`` — the daemons' client wire (OP_CLT_WRITE/OP_CLT_READ frames,
+  exactly the ApusClient protocol): writes chase the per-group leader
+  via NOT_LEADER hints, GETs rotate across replicas (follower-lease
+  spread), multi-group keys route through the pinned key->group hash;
+- ``resp`` — redis protocol SET/GET at an app serving gateway
+  (runtime/serve.py) or any RESP server: the gateway does its own
+  routing, the engine just paces, pairs FIFO replies, and measures.
+
+Identity discipline (kvs): every logical connection SLOT owns a client
+id and a req_id sequence; an op binds to its slot at first dispatch
+and a slot's identities only ever travel on that slot's socket, so
+reply pairing by echoed req_id cannot collide.  Socket death/churn
+reopens the slot's socket and resends its in-flight ops under their
+ORIGINAL identities (the server-side exact-window dedup keeps writes
+exactly-once, as for ApusClient failover).  An op that must MOVE to a
+different peer (leader bounce) re-dispatches under a fresh identity
+from a slot bound there — safe for refused ops, and for maybe-applied
+SETs the duplicate re-applies the same value (this harness measures
+latency; the audited linearizability campaigns use ApusClient).
+
+Coordinated-omission safety: every op's latency anchors at its
+SCHEDULED arrival (latency.py), retries included; ops unresolved at
+the cutoff are censored into the tail, never dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+import selectors
+import socket
+import struct
+import time
+from collections import deque
+from typing import Optional
+
+from apus_tpu.load.latency import LatencyRecorder, SloReport
+from apus_tpu.load.schedule import (burst_schedule, poisson_schedule,
+                                    uniform_schedule)
+from apus_tpu.load.zipf import ZipfKeys
+
+OP_CLT_WRITE = 16
+OP_CLT_READ = 17
+ST_OK = 0
+ST_NOT_LEADER = 4
+ST_TIMEOUT = 5
+ST_WRONG_GROUP = 8
+ST_MIGRATING = 9
+OP_GROUP = 25
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def raise_fd_limit(want: int) -> int:
+    """Best-effort RLIMIT_NOFILE raise (hundreds of sockets + the
+    server side share one box in the harness runs)."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < want:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+            soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        return soft
+    except Exception:                                    # noqa: BLE001
+        return -1
+
+
+@dataclasses.dataclass
+class OpenLoopConfig:
+    peers: "list[str]"            # host:port targets
+    connections: int = 512
+    rate: float = 2000.0          # arrivals/s (open loop)
+    duration: float = 10.0
+    seed: int = 0
+    nkeys: int = 10000
+    theta: float = 0.99           # zipfian skew (0 = uniform)
+    get_fraction: float = 0.9
+    value_size: int = 64
+    groups: int = 1
+    proto: str = "kvs"            # kvs | resp
+    arrival: str = "poisson"      # poisson | uniform
+    burst_every: float = 0.0      # fan-in bursts (schedule.py)
+    burst_size: int = 0
+    churn_every: float = 0.0      # close+reopen a slice of connections
+    churn_fraction: float = 0.05
+    slo_ms: float = 50.0
+    window_s: float = 0.5
+    read_spread: bool = True      # kvs GETs rotate across replicas
+    grace: float = 5.0            # post-deadline drain for stragglers
+    key_prefix: bytes = b"lk"
+    scramble: bool = True
+    max_attempts: int = 64
+
+
+class _Op:
+    __slots__ = ("sched", "key", "is_get", "gid", "clt", "req",
+                 "slot", "attempts", "done")
+
+    def __init__(self, sched: float, key: bytes, is_get: bool,
+                 gid: int):
+        self.sched = sched
+        self.key = key
+        self.is_get = is_get
+        self.gid = gid
+        self.clt = 0
+        self.req = 0
+        self.slot = -1
+        self.attempts = 0
+        self.done = False
+
+
+class _Slot:
+    """One logical connection: identity + socket + buffers."""
+
+    __slots__ = ("idx", "peer", "clt_id", "req_seq", "sock", "inbuf",
+                 "outbuf", "inflight", "fifo", "alive", "connected")
+
+    def __init__(self, idx: int, peer: int, clt_id: int):
+        self.idx = idx
+        self.peer = peer
+        self.clt_id = clt_id
+        self.req_seq = 0
+        self.sock: Optional[socket.socket] = None
+        self.inbuf = b""
+        self.outbuf = bytearray()
+        self.inflight: dict[int, _Op] = {}    # kvs: req -> op
+        self.fifo: deque = deque()            # resp: FIFO op order
+        self.alive = False
+        self.connected = False
+
+
+class OpenLoopEngine:
+    def __init__(self, cfg: OpenLoopConfig):
+        self.cfg = cfg
+        self.addrs = [(p.rsplit(":", 1)[0], int(p.rsplit(":", 1)[1]))
+                      for p in cfg.peers]
+        self.rec = LatencyRecorder()
+        self.sel = selectors.DefaultSelector()
+        self.slots: list[_Slot] = []
+        self.leaders: dict[int, Optional[int]] = {}
+        self.stats = {"sent": 0, "retries": 0, "bounces": 0,
+                      "reconnects": 0, "churns": 0, "conn_errors": 0,
+                      "wrong_group": 0}
+        self._peer_slots: dict[int, list[int]] = {}
+        self._rotors: dict[int, int] = {}
+        self._read_rotor = 0
+        self._resolved = 0
+        self._t0 = 0.0
+        import random
+        self._rng = random.Random(cfg.seed ^ 0x10AD)
+        base = secrets.randbits(40) << 20
+        for i in range(cfg.connections):
+            s = _Slot(i, i % len(self.addrs),
+                      (base + i) & ((1 << 63) - 1))
+            self.slots.append(s)
+            self._peer_slots.setdefault(s.peer, []).append(i)
+
+    # -- plan ----------------------------------------------------------
+
+    def _plan(self) -> "list[_Op]":
+        cfg = self.cfg
+        if cfg.arrival == "uniform":
+            sched = uniform_schedule(cfg.rate, cfg.duration)
+        else:
+            sched = poisson_schedule(cfg.rate, cfg.duration,
+                                     seed=cfg.seed)
+        if cfg.burst_every > 0 and cfg.burst_size > 0:
+            sched = burst_schedule(sched, cfg.burst_every,
+                                   cfg.burst_size, cfg.duration)
+        zipf = ZipfKeys(cfg.nkeys, theta=cfg.theta, seed=cfg.seed,
+                        scramble=cfg.scramble, prefix=cfg.key_prefix)
+        if cfg.groups > 1:
+            from apus_tpu.runtime.router import group_of_key
+        ops = []
+        for t in sched:
+            key = zipf.key()
+            gid = (group_of_key(key, cfg.groups)
+                   if cfg.groups > 1 else 0)
+            ops.append(_Op(t, key, self._rng.random()
+                           < cfg.get_fraction, gid))
+        return ops
+
+    # -- sockets -------------------------------------------------------
+
+    def _open(self, slot: _Slot) -> None:
+        self._close(slot)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.connect_ex(self.addrs[slot.peer])
+        except OSError:
+            sock.close()
+            slot.alive = False
+            return
+        slot.sock = sock
+        slot.inbuf = b""
+        slot.alive = True
+        slot.connected = False
+        self.sel.register(sock, selectors.EVENT_READ
+                          | selectors.EVENT_WRITE, slot)
+
+    def _close(self, slot: _Slot) -> None:
+        if slot.sock is not None:
+            try:
+                self.sel.unregister(slot.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                slot.sock.close()
+            except OSError:
+                pass
+        slot.sock = None
+        slot.alive = False
+        slot.connected = False
+
+    def _rebind(self, slot: _Slot, peer: int) -> None:
+        self._peer_slots[slot.peer].remove(slot.idx)
+        slot.peer = peer
+        self._peer_slots.setdefault(peer, []).append(slot.idx)
+
+    def _reconnect(self, slot: _Slot, rebind: bool = True) -> None:
+        """Reopen a dead slot (next peer if its own keeps failing) and
+        resend its unresolved ops under their original identities."""
+        self.stats["reconnects"] += 1
+        if rebind and not slot.connected and slot.sock is None:
+            self._rebind(slot, (slot.peer + 1) % len(self.addrs))
+        self._open(slot)
+        if not slot.alive:
+            return
+        slot.outbuf = bytearray()
+        if self.cfg.proto == "kvs":
+            for op in list(slot.inflight.values()):
+                slot.outbuf += self._encode(slot, op)
+        else:
+            for op in list(slot.fifo):
+                slot.outbuf += self._encode(slot, op)
+
+    def _pick_slot(self, peer: Optional[int]) -> _Slot:
+        """A live slot bound to ``peer`` (any live slot when None or
+        none bound there is alive)."""
+        if peer is not None:
+            idxs = self._peer_slots.get(peer, [])
+            if idxs:
+                r = self._rotors.get(peer, 0)
+                for k in range(len(idxs)):
+                    s = self.slots[idxs[(r + k) % len(idxs)]]
+                    if s.alive:
+                        self._rotors[peer] = (r + k + 1) % len(idxs)
+                        return s
+        for k in range(len(self.slots)):
+            s = self.slots[(self._read_rotor + k) % len(self.slots)]
+            if s.alive:
+                self._read_rotor = (self._read_rotor + k + 1) \
+                    % len(self.slots)
+                return s
+        # Nothing alive: revive slot 0 and hope.
+        self._reconnect(self.slots[0], rebind=True)
+        return self.slots[0]
+
+    # -- encode --------------------------------------------------------
+
+    def _encode(self, slot: _Slot, op: _Op) -> bytes:
+        if self.cfg.proto == "resp":
+            if op.is_get:
+                return (b"*2\r\n$3\r\nGET\r\n$%d\r\n%s\r\n"
+                        % (len(op.key), op.key))
+            val = self._value(op)
+            return (b"*3\r\n$3\r\nSET\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                    % (len(op.key), op.key, len(val), val))
+        from apus_tpu.models.kvs import encode_get, encode_put
+        data = (encode_get(op.key) if op.is_get
+                else encode_put(op.key, self._value(op)))
+        payload = (bytes([OP_CLT_READ if op.is_get else OP_CLT_WRITE])
+                   + _U64.pack(op.req) + _U64.pack(op.clt)
+                   + _U32.pack(len(data)) + data)
+        if op.gid:
+            payload = bytes([OP_GROUP, op.gid]) + payload
+        return _U32.pack(len(payload)) + payload
+
+    def _value(self, op: _Op) -> bytes:
+        n = self.cfg.value_size
+        return (op.key * (n // max(1, len(op.key)) + 1))[:n]
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch(self, op: _Op, fresh: bool = True) -> None:
+        """Assign the op a target slot (+ identity on first/refreshed
+        dispatch) and queue its frame."""
+        cfg = self.cfg
+        if cfg.proto == "resp":
+            peer = None
+        elif op.is_get and cfg.read_spread:
+            peer = None                      # rotate across replicas
+        else:
+            peer = self.leaders.get(op.gid)
+        slot = self._pick_slot(peer)
+        if fresh or op.slot != slot.idx:
+            # (Re)bind identity to the carrying slot: a slot's ids only
+            # ever travel on its own socket (pairing cannot collide).
+            old = self.slots[op.slot] if op.slot >= 0 else None
+            if old is not None:
+                old.inflight.pop(op.req, None)
+            slot.req_seq += 1
+            op.clt, op.req, op.slot = slot.clt_id, slot.req_seq, slot.idx
+        if cfg.proto == "kvs":
+            slot.inflight[op.req] = op
+        else:
+            slot.fifo.append(op)
+        if slot.alive:
+            slot.outbuf += self._encode(slot, op)
+        self.stats["sent"] += 1
+
+    def _retry(self, op: _Op, now: float, move_peer: bool) -> None:
+        op.attempts += 1
+        if op.attempts >= self.cfg.max_attempts:
+            op.done = True
+            self._resolved += 1
+            self.rec.record(op.sched, now - self._t0, ok=False)
+            return
+        self.stats["retries"] += 1
+        self._dispatch(op, fresh=move_peer)
+
+    # -- replies -------------------------------------------------------
+
+    def _on_kvs_frame(self, slot: _Slot, frame: bytes,
+                      now: float) -> None:
+        if len(frame) < 9:
+            return
+        st = frame[0]
+        req = _U64.unpack_from(frame, 1)[0]
+        op = slot.inflight.pop(req, None)
+        if op is None or op.done:
+            return
+        if st == ST_OK:
+            op.done = True
+            self._resolved += 1
+            self.rec.record(op.sched, now - self._t0, ok=True)
+            return
+        if st == ST_NOT_LEADER:
+            self.stats["bounces"] += 1
+            hint = b""
+            if len(frame) >= 13:
+                n = _U32.unpack_from(frame, 9)[0]
+                hint = frame[13:13 + n]
+            if hint:
+                try:
+                    h, p = hint.decode().rsplit(":", 1)
+                    target = self.addrs.index((h, int(p)))
+                    self.leaders[op.gid] = target
+                except (ValueError, IndexError):
+                    self.leaders[op.gid] = None
+            elif not op.is_get:
+                self.leaders[op.gid] = None
+            # Reads fall back to the (hinted) leader; writes chase it.
+            self._retry(op, now, move_peer=True)
+            return
+        if st == ST_TIMEOUT:
+            self.leaders[op.gid] = None
+            self._retry(op, now, move_peer=True)
+            return
+        if st == ST_MIGRATING:
+            self._retry(op, now, move_peer=False)
+            return
+        if st == ST_WRONG_GROUP:
+            # Learn the owner gid from the bounce (offset 9: u8 owner
+            # + shard-map blob) and re-route under a fresh identity
+            # (the refusal is deterministic — it never applied here).
+            self.stats["wrong_group"] += 1
+            if len(frame) >= 10:
+                op.gid = frame[9]
+            self._retry(op, now, move_peer=True)
+            return
+        op.done = True
+        self._resolved += 1
+        self.rec.record(op.sched, now - self._t0, ok=False)
+
+    def _on_resp_data(self, slot: _Slot, now: float) -> None:
+        """Pop complete RESP replies off slot.inbuf, FIFO-paired."""
+        while slot.fifo:
+            used = _resp_reply_len(slot.inbuf)
+            if used <= 0:
+                return
+            reply = slot.inbuf[:used]
+            slot.inbuf = slot.inbuf[used:]
+            op = slot.fifo.popleft()
+            if op.done:
+                continue
+            op.done = True
+            self._resolved += 1
+            self.rec.record(op.sched, now - self._t0,
+                            ok=not reply.startswith(b"-"))
+        # Replies with no waiter (post-reconnect stragglers): drop.
+        if not slot.fifo and slot.inbuf:
+            used = _resp_reply_len(slot.inbuf)
+            while used > 0:
+                slot.inbuf = slot.inbuf[used:]
+                used = _resp_reply_len(slot.inbuf)
+
+    def _pump(self, slot: _Slot, writable: bool, readable: bool,
+              now: float) -> None:
+        if slot.sock is None:
+            return
+        if writable:
+            slot.connected = True
+            if slot.outbuf:
+                try:
+                    n = slot.sock.send(
+                        memoryview(slot.outbuf)[:1 << 18])
+                    del slot.outbuf[:n]
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    self.stats["conn_errors"] += 1
+                    self._reconnect(slot)
+                    return
+        if readable:
+            try:
+                chunk = slot.sock.recv(1 << 18)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self.stats["conn_errors"] += 1
+                self._reconnect(slot)
+                return
+            if not chunk:
+                self.stats["conn_errors"] += 1
+                self._reconnect(slot)
+                return
+            slot.inbuf += chunk
+            if self.cfg.proto == "resp":
+                self._on_resp_data(slot, now)
+                return
+            while True:
+                if len(slot.inbuf) < 4:
+                    return
+                n = _U32.unpack_from(slot.inbuf)[0]
+                if len(slot.inbuf) < 4 + n:
+                    return
+                frame = slot.inbuf[4:4 + n]
+                slot.inbuf = slot.inbuf[4 + n:]
+                self._on_kvs_frame(slot, frame, now)
+
+    # -- run -----------------------------------------------------------
+
+    def run(self) -> "tuple[SloReport, dict]":
+        cfg = self.cfg
+        raise_fd_limit(cfg.connections + 256)
+        ops = self._plan()
+        for s in self.slots:
+            self._open(s)
+        t0 = time.monotonic()
+        self._t0 = t0
+        deadline = t0 + cfg.duration
+        drain_by = deadline + cfg.grace
+        next_i = 0
+        next_churn = (t0 + cfg.churn_every if cfg.churn_every > 0
+                      else float("inf"))
+        next_revive = t0 + 0.25
+        while True:
+            now = time.monotonic()
+            # Send everything due.
+            while next_i < len(ops) and t0 + ops[next_i].sched <= now:
+                self._dispatch(ops[next_i])
+                next_i += 1
+            if now >= next_churn:
+                self.stats["churns"] += 1
+                k = max(1, int(cfg.connections * cfg.churn_fraction))
+                for idx in self._rng.sample(range(len(self.slots)), k):
+                    self._reconnect(self.slots[idx], rebind=False)
+                next_churn = now + cfg.churn_every
+            if now >= next_revive:
+                # Dead slots with stranded ops (killed replica, refused
+                # connect): keep trying, rebinding to the next peer.
+                for s in self.slots:
+                    if not s.alive and (s.inflight or s.fifo):
+                        self._reconnect(s)
+                next_revive = now + 0.25
+            if next_i >= len(ops) and self._resolved >= len(ops):
+                break
+            if now >= drain_by:
+                break
+            timeout = 0.002
+            if next_i < len(ops):
+                timeout = min(timeout,
+                              max(0.0, t0 + ops[next_i].sched - now))
+            for key, mask in self.sel.select(timeout):
+                self._pump(key.data,
+                           bool(mask & selectors.EVENT_WRITE),
+                           bool(mask & selectors.EVENT_READ), now)
+        cut = time.monotonic()
+        for op in ops:
+            if not op.done:
+                self.rec.censor(op.sched, cut - t0)
+        for s in self.slots:
+            self._close(s)
+        self.sel.close()
+        rep = self.rec.report(cfg.duration, slo_ms=cfg.slo_ms,
+                              window_s=cfg.window_s)
+        return rep, dict(self.stats)
+
+
+def _resp_reply_len(buf: bytes) -> int:
+    """Bytes consumed by one complete RESP reply at the head of
+    ``buf`` (0 = incomplete).  Handles the simple/bulk/int/error
+    shapes the SET/GET workload sees, plus arrays for safety."""
+    eol = buf.find(b"\r\n")
+    if eol < 0 or not buf:
+        return 0
+    t = buf[:1]
+    if t in (b"+", b"-", b":"):
+        return eol + 2
+    if t == b"$":
+        try:
+            n = int(buf[1:eol])
+        except ValueError:
+            return eol + 2
+        if n < 0:
+            return eol + 2
+        total = eol + 2 + n + 2
+        return total if len(buf) >= total else 0
+    if t == b"*":
+        try:
+            cnt = int(buf[1:eol])
+        except ValueError:
+            return eol + 2
+        off = eol + 2
+        for _ in range(max(0, cnt)):
+            used = _resp_reply_len(buf[off:])
+            if used <= 0:
+                return 0
+            off += used
+        return off
+    return eol + 2
+
+
+def run_open_loop(cfg: OpenLoopConfig) -> "tuple[SloReport, dict]":
+    return OpenLoopEngine(cfg).run()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="open-loop SLO load harness (coordinated-omission-"
+                    "safe; see apus_tpu/load/__init__.py)")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated host:port targets")
+    ap.add_argument("--connections", type=int, default=512)
+    ap.add_argument("--rate", type=float, default=2000.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nkeys", type=int, default=10000)
+    ap.add_argument("--theta", type=float, default=0.99)
+    ap.add_argument("--get-fraction", type=float, default=0.9)
+    ap.add_argument("--value-size", type=int, default=64)
+    ap.add_argument("--groups", type=int, default=1)
+    ap.add_argument("--proto", choices=("kvs", "resp"), default="kvs")
+    ap.add_argument("--arrival", choices=("poisson", "uniform"),
+                    default="poisson")
+    ap.add_argument("--burst-every", type=float, default=0.0)
+    ap.add_argument("--burst-size", type=int, default=0)
+    ap.add_argument("--churn-every", type=float, default=0.0)
+    ap.add_argument("--slo-ms", type=float, default=50.0)
+    args = ap.parse_args(argv)
+    cfg = OpenLoopConfig(
+        peers=args.peers.split(","), connections=args.connections,
+        rate=args.rate, duration=args.duration, seed=args.seed,
+        nkeys=args.nkeys, theta=args.theta,
+        get_fraction=args.get_fraction, value_size=args.value_size,
+        groups=args.groups, proto=args.proto, arrival=args.arrival,
+        burst_every=args.burst_every, burst_size=args.burst_size,
+        churn_every=args.churn_every, slo_ms=args.slo_ms)
+    rep, stats = run_open_loop(cfg)
+    print(json.dumps({"report": rep.to_dict(), "stats": stats},
+                     indent=2, default=str))
+    return 0 if rep.censored == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

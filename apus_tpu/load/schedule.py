@@ -1,0 +1,63 @@
+"""Open-loop arrival schedules.
+
+An OPEN-LOOP load generator decides every op's arrival time BEFORE the
+run from a fixed arrival-rate process, and the schedule never slows
+down because the server did — the defining property that makes the
+latency accounting coordinated-omission-safe (latency.py anchors each
+op at its scheduled arrival).  A closed-loop client (fixed concurrency,
+next op after the previous reply) inhales exactly the samples a stalled
+server would have made slow, and its p999 measures the CLIENT's
+politeness, not the user's experience.
+
+All schedules are offsets in seconds from the run start, sorted,
+deterministic in their arguments.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+
+def poisson_schedule(rate: float, duration: float,
+                     seed: int = 0) -> "list[float]":
+    """Poisson arrivals at ``rate``/s for ``duration`` s (exponential
+    inter-arrival gaps) — the standard open-loop arrival process
+    (independent users don't coordinate their clicks)."""
+    if rate <= 0 or duration <= 0:
+        return []
+    rng = random.Random(seed)
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += -math.log(1.0 - rng.random()) / rate
+        if t >= duration:
+            return out
+        out.append(t)
+
+
+def uniform_schedule(rate: float, duration: float) -> "list[float]":
+    """Evenly spaced arrivals (the redis-benchmark/wrk2 fixed-rate
+    shape): exactly ``floor(rate*duration)`` ops, gap 1/rate."""
+    n = int(rate * duration)
+    gap = 1.0 / rate
+    return [i * gap for i in range(n)]
+
+
+def burst_schedule(base: "list[float]", burst_every: float,
+                   burst_size: int, duration: float) -> "list[float]":
+    """Overlay FAN-IN bursts on a base schedule: every ``burst_every``
+    seconds, ``burst_size`` arrivals at the SAME instant (a thundering
+    herd — cache expiry, push notification, synchronized retry).  The
+    burst ops are part of the open-loop contract like any other
+    arrival: their latency anchors at the burst instant, so the queue
+    they build is measured, not excused."""
+    if burst_every <= 0 or burst_size <= 0:
+        return list(base)
+    out = list(base)
+    t = burst_every
+    while t < duration:
+        out.extend([t] * burst_size)
+        t += burst_every
+    out.sort()
+    return out

@@ -1,0 +1,82 @@
+"""Seeded zipfian key sampler (hot-key skew for the SLO harness).
+
+The YCSB ZipfianGenerator closed form (Gray et al., "Quickly
+Generating Billion-Record Synthetic Databases"): rank popularity
+follows ``P(rank=k) ~ 1/k^theta`` with one uniform draw per sample —
+no per-sample search — after an O(n) zeta precompute.  theta=0.99 is
+the YCSB default ("zipfian constant"); theta=0 degenerates to uniform.
+
+Ranks are SCRAMBLED onto the keyspace by default (FNV-1a), so the
+hottest keys are spread across hash buckets / consensus groups instead
+of clustering at one end — exactly how YCSB's ScrambledZipfian keeps a
+skewed workload from aliasing with the store's own layout.  With
+``scramble=False`` rank r maps to key index r directly (rank 0 = the
+single hottest key), which the hot/cold split benches rely on.
+
+Deterministic: same (n, theta, seed) -> same key sequence, forever
+(pinned by tests/test_load.py).
+"""
+
+from __future__ import annotations
+
+import random
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def _fnv64(v: int) -> int:
+    h = _FNV_OFFSET
+    for _ in range(8):
+        h = ((h ^ (v & 0xFF)) * _FNV_PRIME) & _MASK64
+        v >>= 8
+    return h
+
+
+class ZipfKeys:
+    """Zipfian sampler over ``n`` keys; ``sample()`` returns a key
+    index in [0, n), ``key()`` a formatted key."""
+
+    def __init__(self, n: int, theta: float = 0.99, seed: int = 0,
+                 scramble: bool = True, prefix: bytes = b"lk"):
+        if n <= 0:
+            raise ValueError("n must be positive")
+        self.n = n
+        self.theta = theta
+        self.scramble = scramble
+        self.prefix = prefix
+        self.rng = random.Random(seed)
+        if theta <= 0:
+            self._uniform = True
+            return
+        self._uniform = False
+        zetan = 0.0
+        for i in range(1, n + 1):
+            zetan += 1.0 / (i ** theta)
+        self._zetan = zetan
+        self._zeta2 = 1.0 + 0.5 ** theta
+        self._alpha = 1.0 / (1.0 - theta)
+        self._eta = ((1.0 - (2.0 / n) ** (1.0 - theta))
+                     / (1.0 - self._zeta2 / zetan))
+
+    def sample(self) -> int:
+        if self._uniform:
+            return self.rng.randrange(self.n)
+        u = self.rng.random()
+        uz = u * self._zetan
+        if uz < 1.0:
+            rank = 0
+        elif uz < self._zeta2:
+            rank = 1
+        else:
+            rank = int(self.n * (self._eta * u - self._eta + 1.0)
+                       ** self._alpha)
+            if rank >= self.n:
+                rank = self.n - 1
+        if not self.scramble:
+            return rank
+        return _fnv64(rank) % self.n
+
+    def key(self) -> bytes:
+        return b"%s%08d" % (self.prefix, self.sample())

@@ -44,6 +44,10 @@ COUNTERS: dict[str, str] = {
     "node_flr_pause_lapses": "lapses missed by a whole window (pause/clock jump)",
     "node_flr_epoch_refusals": "lapses on the config-epoch fence (membership moved)",
     "node_flr_commit_blocked": "commit advances held for a live lease holder's ack",
+    # Bucket-granular follower leases (per-key Hermes invalidation).
+    "node_flr_bucket_grants": "bucket-scoped (partial read set) lease grants",
+    "node_flr_bucket_refusals": "follower reads bounced: bucket outside the granted set",
+    "node_flr_commit_bypass": "commit advances a whole-log lease rule would have blocked",
     "node_graceful_leaves": "OP_LEAVE removals committed",
     "node_auto_removes": "failure-detector evictions committed",
     "node_resize_aborts": "EXTENDED-resize aborts (joiner died mid-catch-up)",
@@ -110,6 +114,16 @@ COUNTERS: dict[str, str] = {
     "srv_native_unavailable": "native plane requested but extension absent (Python fallback)",
     "srv_native_view_poisoned": "applied-view mirrors poisoned (untrackable op / oversized)",
     "srv_native_merged_bursts": "connection bursts coalesced into shared admission calls",
+    # Protocol-aware app serving surface (runtime/serve.py AppServer):
+    # RESP + memcached-text commands mapped onto the replicated KVS.
+    "srv_app_conns": "app-protocol client connections accepted by the gateway",
+    "srv_app_resp_cmds": "RESP commands parsed by the gateway",
+    "srv_app_mc_cmds": "memcached-text commands parsed by the gateway",
+    "srv_app_kvs_ops": "KVS ops the gateway pipelined into the cluster",
+    "srv_app_local_cmds": "commands answered locally (PING/ECHO/version...)",
+    "srv_app_errors": "protocol errors answered (unmapped, no relay backend)",
+    "srv_app_fallback_conns": "connections flipped to the opaque relay fallback",
+    "srv_app_fallback_bytes": "bytes carried through the opaque relay fallback",
     # -- dev_*: device-plane engine (runtime/device_plane.py runner;
     #    process-wide registry merged into every replica's scrape) ----
     "dev_rounds": "device commit rounds executed",

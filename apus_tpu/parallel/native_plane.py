@@ -153,6 +153,15 @@ class NativePlaneService:
         # bucket routing there — see publish_gates).
         if self._reads_ok:
             self._load_view(0)
+            # The C read gate cannot check per-key bucket membership,
+            # so this daemon's followers request FULL-SET leases —
+            # publish_gates only opens the follower gate for those
+            # (a bucket-scoped lease would let the native side serve
+            # keys outside the granted read set).
+            node = self.daemon.group_node(0) \
+                if hasattr(self.daemon, "group_node") else self.daemon.node
+            if node is not None:
+                node.flr_full_buckets = True
         # Scripted clock jumps must close the read gates through the
         # same seam the lease math skews on.
         clock = getattr(self.daemon, "clock", None)
@@ -324,6 +333,7 @@ class NativePlaneService:
                         and not node.draining \
                         and node._flr_enabled() \
                         and node.lease_requester is not None \
+                        and node._flease_buckets is None \
                         and node.log.apply >= node._flease_floor:
                     ok, _why = node._flease_ok(fnow)
                     if ok:

@@ -376,6 +376,18 @@ def make_client_ops(daemon, node=None) -> dict:
                                                   0),
                 "flr_commit_blocked": n.stats.get("flr_commit_blocked",
                                                   0),
+                # Bucket-granular lease view: commit advances a
+                # whole-log rule would have blocked, bucket-scoped
+                # grants issued, reads bounced for read-set coverage,
+                # and the held lease's set size (-1 = full set).
+                "flr_commit_bypass": n.stats.get("flr_commit_bypass",
+                                                 0),
+                "flr_bucket_grants": n.stats.get("flr_bucket_grants",
+                                                 0),
+                "flr_bucket_refusals": n.stats.get(
+                    "flr_bucket_refusals", 0),
+                "flr_lease_buckets": (-1 if n._flease_buckets is None
+                                      else len(n._flease_buckets)),
                 "flr_lease_live": bool(
                     n._flease_ok(n._fresh_now())[0]),
                 "clock_skewed": bool(getattr(daemon.clock, "skewed",

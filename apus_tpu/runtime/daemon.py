@@ -128,6 +128,13 @@ class ReplicaDaemon:
             read_lease=spec.read_lease, lease_margin=spec.lease_margin,
             follower_read_leases=getattr(spec, "follower_read_leases",
                                          True),
+            # Bucket-granular follower leases (per-key Hermes write
+            # invalidation); env overrides the spec either way so the
+            # A/B bench can pin the whole-log baseline per process.
+            flr_bucket_leases=(
+                os.environ["APUS_FLR_BUCKETS"] not in ("0", "false")
+                if "APUS_FLR_BUCKETS" in os.environ
+                else getattr(spec, "flr_bucket_leases", True)),
             # Planted-stale-lease harness knob (tests only): makes one
             # follower's lease deliberately wrong so the audit plane
             # must catch the resulting stale read.
@@ -1063,6 +1070,18 @@ def main(argv: Optional[list] = None) -> int:
                          "appended, run.sh style); requires --workdir")
     ap.add_argument("--app-port", type=int,
                     default=int(os.environ.get("APUS_APP_PORT", "0")) or None)
+    ap.add_argument("--serve-port", type=int,
+                    default=int(os.environ.get("APUS_SERVE_PORT",
+                                               "-1")),
+                    help="protocol-aware app serving gateway "
+                         "(runtime/serve.py): listen for RESP/"
+                         "memcached-text app clients on this port and "
+                         "serve the mapped GET/SET command set from "
+                         "the replicated KVS (group router + follower "
+                         "leases), with the interposed app as the "
+                         "opaque-relay fallback when --app runs.  0 = "
+                         "ephemeral (reported in the ready record); "
+                         "-1/unset = disabled (env APUS_SERVE_PORT)")
     ap.add_argument("--spin-timeout-ms", type=int, default=8000)
     ap.add_argument("--tick-interval", type=float, default=0.0005)
     ap.add_argument("--ready-file", default=None,
@@ -1225,6 +1244,7 @@ def main(argv: Optional[list] = None) -> int:
         from apus_tpu.runtime.mesh_plane import MeshReformer
         reformer = MeshReformer(daemon, daemon.device_driver.runner, spec)
         reformer.start()
+    app_server = None
     try:
         if bridged:
             from apus_tpu.runtime.bridge import Bridge, proxy_env
@@ -1239,10 +1259,26 @@ def main(argv: Optional[list] = None) -> int:
                                           f"proxy{daemon.idx}.log"),
                     spin_timeout_ms=args.spin_timeout_ms))
                 app_proc = subprocess.Popen(app_argv, env=app_env)
+        if args.serve_port is not None and args.serve_port >= 0:
+            from apus_tpu.runtime.serve import AppServer
+            app_server = AppServer(
+                [p for p in spec.peers if p],
+                port=args.serve_port,
+                groups=getattr(spec, "groups", 1),
+                fallback=(("127.0.0.1", args.app_port)
+                          if bridged and args.app else None),
+                stats=(daemon.obs.view("srv")
+                       if daemon.obs is not None else None),
+                logger=daemon.logger)
+            app_server.start()
+            daemon.logger.info("app serving gateway on %s:%d",
+                               *app_server.addr)
 
         addr = f"{daemon.server.addr[0]}:{daemon.server.addr[1]}"
         ready = {"idx": daemon.idx, "addr": addr, "pid": os.getpid(),
-                 "app_port": args.app_port if bridged else None}
+                 "app_port": args.app_port if bridged else None,
+                 "serve_port": (app_server.addr[1]
+                                if app_server is not None else None)}
         if args.ready_file:
             tmp = args.ready_file + ".tmp"
             with open(tmp, "w") as f:
@@ -1361,6 +1397,10 @@ def main(argv: Optional[list] = None) -> int:
                             rejoin += [flag, val]
                     if args.app_port:
                         rejoin += ["--app-port", str(args.app_port)]
+                    if args.serve_port is not None \
+                            and args.serve_port >= 0:
+                        rejoin += ["--serve-port",
+                                   str(args.serve_port)]
                     rejoin += ["--spin-timeout-ms",
                                str(args.spin_timeout_ms),
                                "--tick-interval", str(args.tick_interval)]
@@ -1370,6 +1410,8 @@ def main(argv: Optional[list] = None) -> int:
     finally:
         if reformer is not None:
             reformer.stop()
+        if app_server is not None:
+            app_server.stop()
         _stop_app(app_proc)
         if bridge is not None:
             bridge.stop()

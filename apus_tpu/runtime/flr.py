@@ -28,9 +28,46 @@ stay wire-free and clock-pure.
 from __future__ import annotations
 
 from apus_tpu.parallel import wire
+from apus_tpu.runtime.router import NBUCKETS
 
 #: PeerServer extra-op byte (after OP_OBS_DUMP=23).
 OP_FLR_LEASE = 24
+
+#: Read-set bitmap length: one bit per shard-map bucket (840/8).
+BITMAP_BYTES = (NBUCKETS + 7) // 8
+
+
+def buckets_to_bitmap(buckets) -> bytes:
+    """Frozenset of buckets -> the request's 105-byte bitmap."""
+    bm = bytearray(BITMAP_BYTES)
+    for b in buckets:
+        bm[b >> 3] |= 1 << (b & 7)
+    return bytes(bm)
+
+
+def bitmap_to_buckets(bm: bytes) -> "frozenset[int]":
+    return frozenset(b for b in range(NBUCKETS)
+                     if bm[b >> 3] & (1 << (b & 7)))
+
+
+def _request_payload(idx: int, incarnation: int, want) -> bytes:
+    """OP_FLR_LEASE request body.  ``want`` is the requested read set
+    (frozenset of buckets) or None = FULL set; full-set requests omit
+    the bitmap entirely — byte-identical to the pre-bucket wire shape,
+    and an old leader ignoring the trailer simply grants whole-log."""
+    payload = (wire.u8(OP_FLR_LEASE) + wire.u8(idx)
+               + wire.u32(incarnation))
+    if want is not None:
+        payload += buckets_to_bitmap(want)
+    return payload
+
+
+def _parse_grant(resp) -> "dict | None":
+    if not resp or resp[0] != wire.ST_OK or len(resp) < 33:
+        return None
+    rr = wire.Reader(resp[1:])
+    return {"term": rr.u64(), "epoch": rr.u64(),
+            "floor": rr.u64(), "dur": rr.u64() / 1e6}
 
 
 def make_flr_ops(daemon, node=None) -> dict:
@@ -42,9 +79,14 @@ def make_flr_ops(daemon, node=None) -> dict:
     def flr_lease(r: wire.Reader) -> bytes:
         peer = r.u8()
         incarnation = r.u32() if r.remaining >= 4 else 0
+        # Optional read-set bitmap trailer (bucket-granular leases):
+        # absent = full-set request (the pre-bucket wire shape).
+        buckets = None
+        if r.remaining >= BITMAP_BYTES:
+            buckets = bitmap_to_buckets(r.take(BITMAP_BYTES))
         with daemon.lock:
             g = node.grant_follower_lease(
-                peer, incarnation=incarnation)
+                peer, incarnation=incarnation, buckets=buckets)
         if g is None:
             return wire.u8(wire.ST_REFUSED)
         return (wire.u8(wire.ST_OK) + wire.u64(g["term"])
@@ -57,14 +99,10 @@ def make_flr_ops(daemon, node=None) -> dict:
 def install_flr(daemon) -> None:
     """Install the follower-side lease requester on ``daemon.node``."""
 
-    def request(leader_idx: int):
-        payload = (wire.u8(OP_FLR_LEASE) + wire.u8(daemon.idx)
-                   + wire.u32(daemon.node.incarnation))
+    def request(leader_idx: int, want=None):
+        payload = _request_payload(daemon.idx,
+                                   daemon.node.incarnation, want)
         resp = daemon.transport.request(leader_idx, payload)
-        if not resp or resp[0] != wire.ST_OK or len(resp) < 33:
-            return None
-        rr = wire.Reader(resp[1:])
-        return {"term": rr.u64(), "epoch": rr.u64(),
-                "floor": rr.u64(), "dur": rr.u64() / 1e6}
+        return _parse_grant(resp)
 
     daemon.node.lease_requester = request

@@ -387,18 +387,13 @@ class GroupSet:
     # -- follower read leases (per group) ----------------------------------
 
     def _install_flr(self, node: Node, gt: GroupTransport) -> None:
-        from apus_tpu.runtime.flr import OP_FLR_LEASE
+        from apus_tpu.runtime.flr import _parse_grant, _request_payload
         daemon = self.daemon
 
-        def request(leader_idx: int, node=node, gt=gt):
-            payload = (wire.u8(OP_FLR_LEASE) + wire.u8(daemon.idx)
-                       + wire.u32(node.incarnation))
-            resp = gt.request(leader_idx, payload)
-            if not resp or resp[0] != wire.ST_OK or len(resp) < 33:
-                return None
-            rr = wire.Reader(resp[1:])
-            return {"term": rr.u64(), "epoch": rr.u64(),
-                    "floor": rr.u64(), "dur": rr.u64() / 1e6}
+        def request(leader_idx: int, want=None, node=node, gt=gt):
+            payload = _request_payload(daemon.idx, node.incarnation,
+                                       want)
+            return _parse_grant(gt.request(leader_idx, payload))
 
         node.lease_requester = request
 

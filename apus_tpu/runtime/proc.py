@@ -81,7 +81,8 @@ class ProcCluster:
                  follower_reads: Optional[bool] = None,
                  fault_plane: bool = False,
                  fault_seed: int = 0,
-                 extra_env: Optional[dict] = None):
+                 extra_env: Optional[dict] = None,
+                 serve: bool = False):
         self.n = n
         #: per-replica extra environment for spawn/restart (slot ->
         #: {var: value}); chaos campaigns schedule disk faults by
@@ -127,6 +128,12 @@ class ProcCluster:
         self.app_ports: list[Optional[int]] = [
             _free_port() if app_argv is not None else None
             for _ in range(n)]
+        #: Per-replica protocol-aware app gateway (runtime/serve.py;
+        #: --serve-port): RESP/memcached-text app traffic served from
+        #: the replicated KVS, opaque relay to the interposed app as
+        #: the fallback.
+        self.serve_ports: list[Optional[int]] = [
+            _free_port() if serve else None for _ in range(n)]
         self.procs: list[Optional[subprocess.Popen]] = [None] * n
         #: replicas currently SIGSTOPped by the pause nemesis (resumed
         #: before teardown so SIGTERM is deliverable).
@@ -213,6 +220,8 @@ class ProcCluster:
                      "--app", shlex.join(self._app_argv),
                      "--app-port", str(self.app_ports[i]),
                      "--spin-timeout-ms", str(self._spin_timeout_ms)]
+        if self.serve_ports[i] is not None:
+            argv += ["--serve-port", str(self.serve_ports[i])]
         if self._logs[i] is None:
             self._logs[i] = open(
                 os.path.join(self.workdir, f"proc{tag}.out"), "ab")
@@ -387,6 +396,9 @@ class ProcCluster:
         self.procs.append(None)
         self.app_ports.append(
             _free_port() if self._app_argv is not None else None)
+        self.serve_ports.append(
+            _free_port() if any(p is not None
+                                for p in self.serve_ports) else None)
         self._logs.append(None)
         self._spawn(i, join=True)
         ready = self._wait_ready(i, time.monotonic() + timeout)
@@ -401,6 +413,7 @@ class ProcCluster:
             # proc bookkeeping aligned with slots.
             self.procs[slot], self.procs[i] = self.procs[i], None
             self.app_ports[slot] = self.app_ports[i]
+            self.serve_ports[slot] = self.serve_ports[i]
         # Trim the trailing placeholder a slot-reusing join leaves
         # behind — a permanent None tail would make every "all slots
         # live" gate (failover/churn pacing) false forever.  Closing
@@ -409,6 +422,7 @@ class ProcCluster:
                 and len(self.procs) > len(self.spec.peers):
             self.procs.pop()
             self.app_ports.pop()
+            self.serve_ports.pop()
             f = self._logs.pop()
             if f is not None:
                 f.close()
@@ -491,6 +505,12 @@ class ProcCluster:
     def app_addr(self, idx: int) -> tuple[str, int]:
         assert self.app_ports[idx] is not None
         return ("127.0.0.1", self.app_ports[idx])
+
+    def serve_addr(self, idx: int) -> tuple[str, int]:
+        """Replica ``idx``'s protocol-aware app gateway endpoint
+        (constructed with serve=True)."""
+        assert self.serve_ports[idx] is not None
+        return ("127.0.0.1", self.serve_ports[idx])
 
     def wait_converged(self, timeout: float = 30.0,
                        idxs: Optional[list[int]] = None) -> None:

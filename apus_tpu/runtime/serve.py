@@ -1,0 +1,490 @@
+"""Protocol-aware app serving surface: RESP + memcached text over the
+replicated KVS.
+
+The reference's deployment interposes UNMODIFIED apps and replicates
+their byte streams (runtime/bridge.py — the opaque relay).  This
+module is the serving mode BESIDE it: an :class:`AppServer` gateway
+per replica terminates real app protocols (RESP for redis/SSDB
+clients, memcached text) and maps the recognized command set straight
+onto the replicated KVS through an ``ApusClient`` — which routes each
+key to its consensus group (runtime/router.py), chases per-group
+leaders for writes, and spreads GETs across replicas onto follower
+read leases (linearizable; bucket-granular invalidation keeps a hot
+writer from gating them).  Pipelined app clients coalesce: every
+socket-read's worth of commands becomes ONE client pipeline call, so
+app bursts ride the daemons' group-commit drain exactly like native
+KVS bursts.
+
+The OPAQUE RELAY REMAINS THE FALLBACK: the first command outside the
+mapped set flips that connection to a transparent byte-stream proxy
+against the replica's interposed app (when one is configured), whose
+writes replicate through the capture path as before — so full app
+semantics are never lost, only unaccelerated.  Without a fallback
+backend the gateway answers a typed protocol error and keeps serving
+the mapped set.
+
+Mapped commands:
+
+- RESP: GET SET DEL EXISTS INCR DECR MGET MSET PING ECHO SELECT QUIT
+- memcached text: get (multi-key) set delete incr decr version quit
+  (flags/exptime accepted and ignored — flags echo as 0; ``noreply``
+  honored)
+
+Protocol is sniffed per connection from the first bytes (``*`` =
+RESP arrays; RESP inline commands and memcached text both parse as
+words-on-a-line).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Optional
+
+from apus_tpu.models.kvs import (encode_delete, encode_get, encode_incr,
+                                 encode_put)
+from apus_tpu.obs.metrics import bump as _bump
+from apus_tpu.runtime.client import OP_CLT_READ, OP_CLT_WRITE, ApusClient
+
+_NOT_NUM = b"!notint"
+
+
+class AppServer:
+    """One replica's protocol-aware app gateway (thread per
+    connection; a per-connection ApusClient owns the KVS routing)."""
+
+    def __init__(self, peers: "list[str]", host: str = "127.0.0.1",
+                 port: int = 0, groups: int = 1,
+                 fallback: "Optional[tuple[str, int]]" = None,
+                 stats=None, logger: Optional[logging.Logger] = None,
+                 client_timeout: float = 10.0):
+        self.peers = list(peers)
+        self.groups = max(1, groups)
+        self.fallback = fallback
+        self.stats = stats if stats is not None else {}
+        self.logger = logger or logging.getLogger("apus.serve")
+        self.client_timeout = client_timeout
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(512)
+        self._lsock.settimeout(0.2)
+        self.addr = self._lsock.getsockname()
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"apus-serve-{self.addr[1]}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def __enter__(self) -> "AppServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            _bump(self.stats, "app_conns")
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="apus-serve-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- per-connection loop -------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(0.5)
+        buf = b""
+        proto = None          # sticky per-connection: "resp" | "mc"
+        clt = ApusClient(list(self.peers), timeout=self.client_timeout,
+                         groups=self.groups, read_policy="spread")
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                # Parse every complete command in the buffer, then
+                # execute the whole batch as ONE pipeline (app-client
+                # pipelining -> one group-commit drain).
+                cmds, buf, opaque_from = self._parse_all(buf, proto)
+                if cmds and proto is None:
+                    proto = cmds[0][0]
+                if cmds:
+                    replies, close = self._execute(clt, cmds)
+                    if replies:
+                        conn.sendall(b"".join(replies))
+                    if close:
+                        return
+                if opaque_from is not None:
+                    # Unrecognized command: the rest of this
+                    # connection's life is the opaque relay (or a
+                    # typed error when no backend is configured).
+                    leftovers = opaque_from + buf
+                    if self.fallback is not None:
+                        _bump(self.stats, "app_fallback_conns")
+                        self._relay(conn, leftovers)
+                        return
+                    _bump(self.stats, "app_errors")
+                    kind = _sniff(leftovers)
+                    conn.sendall(
+                        b"-ERR unknown command (no relay backend)\r\n"
+                        if kind == "resp" else b"ERROR\r\n")
+                    buf = b""     # resync: drop the unparsed tail
+        except OSError:
+            return
+        finally:
+            clt.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- parsing -------------------------------------------------------
+
+    def _parse_all(self, buf: bytes, proto=None):
+        """-> (commands, remaining_buf, opaque_from).  Each command is
+        ("resp"|"mc", argv, extras...); opaque_from is the raw bytes of
+        the first UNRECOGNIZED command (fallback takes over there).
+        ``proto`` is the connection's sticky protocol once known —
+        line commands on a RESP connection parse as RESP inline, never
+        as memcached text."""
+        cmds: list = []
+        while buf:
+            if buf[:1] == b"*" and proto in (None, "resp"):
+                argv, used = _parse_resp(buf)
+                if used == 0:
+                    break
+                if argv is None:
+                    return cmds, buf, buf       # unparseable: opaque
+                if not _resp_known(argv):
+                    return cmds, buf[used:], buf[:used]
+                cmds.append(("resp", argv))
+                proto = "resp"
+                buf = buf[used:]
+                continue
+            eol = buf.find(b"\r\n")
+            nl = buf.find(b"\n")
+            if eol < 0 and nl < 0:
+                if len(buf) > (1 << 16):
+                    return cmds, buf, buf       # runaway line: opaque
+                break
+            line_end = eol if 0 <= eol <= (nl if nl >= 0 else eol) \
+                else nl
+            line = buf[:line_end].rstrip(b"\r")
+            consumed = line_end + (2 if line_end == eol else 1)
+            words = line.split()
+            if not words:
+                buf = buf[consumed:]
+                continue
+            if proto == "resp":
+                # RESP inline command on a RESP connection.
+                if _resp_known(words) \
+                        or _resp_known([w.upper() for w in words]):
+                    cmds.append(("resp", words))
+                    buf = buf[consumed:]
+                    continue
+                return cmds, buf[consumed:], buf[:consumed]
+            w0 = words[0].lower()
+            if w0 in (b"set", b"add") and len(words) >= 5:
+                # memcached storage command: needs the data block.
+                try:
+                    nbytes = int(words[4])
+                except ValueError:
+                    return cmds, buf, buf
+                noreply = len(words) >= 6 and words[5] == b"noreply"
+                total = consumed + nbytes + 2
+                if len(buf) < total:
+                    break
+                data = buf[consumed:consumed + nbytes]
+                if w0 == b"add":
+                    return cmds, buf[total:], buf[:total]
+                cmds.append(("mc", words, data, noreply))
+                buf = buf[total:]
+                continue
+            if w0 in (b"get", b"gets") and len(words) >= 2 \
+                    and w0 == b"get":
+                cmds.append(("mc", words, b"", False))
+                buf = buf[consumed:]
+                continue
+            if w0 in (b"delete", b"incr", b"decr", b"version",
+                      b"quit", b"stats"):
+                noreply = words[-1] == b"noreply"
+                cmds.append(("mc", words, b"", noreply))
+                buf = buf[consumed:]
+                continue
+            # RESP inline command (PING etc. typed raw)?
+            if _resp_known([w.upper() for w in words]) \
+                    or _resp_known(words):
+                cmds.append(("resp", words))
+                buf = buf[consumed:]
+                continue
+            return cmds, buf[consumed:], buf[:consumed]
+        return cmds, buf, None
+
+    # -- execution -----------------------------------------------------
+
+    def _execute(self, clt: ApusClient, cmds: list):
+        """Run a parsed batch: KVS-mapped ops coalesce into ONE
+        pipeline call; purely-local commands (PING, version...) answer
+        in place.  Returns (replies in command order, close_conn)."""
+        plan: list = []        # (reply-bytes-or-fn, close?) per command
+        ops: list = []         # (op, data, gid) pipeline entries
+        for c in cmds:
+            if c[0] == "resp":
+                plan.append(self._plan_resp(clt, c[1], ops))
+            else:
+                plan.append(self._plan_mc(clt, c[1], c[2], c[3], ops))
+        results = clt.pipeline(ops) if ops else []
+        _bump(self.stats, "app_kvs_ops", len(ops))
+        out: "list[bytes]" = []
+        close = False
+        for p in plan:
+            r = p[0](results) if callable(p[0]) else p[0]
+            if r:
+                out.append(r)
+            if len(p) > 1 and p[1]:
+                close = True
+                break
+        return out, close
+
+    # RESP command set we map; everything else falls back.
+    _RESP_OK = {b"GET", b"SET", b"DEL", b"EXISTS", b"INCR", b"DECR",
+                b"MGET", b"MSET", b"PING", b"ECHO", b"SELECT", b"QUIT"}
+
+    def _plan_resp(self, clt, argv, ops):
+        cmd = argv[0].upper()
+        _bump(self.stats, "app_resp_cmds")
+        if cmd == b"PING":
+            _bump(self.stats, "app_local_cmds")
+            return (b"+PONG\r\n",)
+        if cmd == b"ECHO" and len(argv) == 2:
+            _bump(self.stats, "app_local_cmds")
+            return (b"$%d\r\n%s\r\n" % (len(argv[1]), argv[1]),)
+        if cmd == b"SELECT":
+            _bump(self.stats, "app_local_cmds")
+            return (b"+OK\r\n",)
+        if cmd == b"QUIT":
+            return (b"+OK\r\n", True)
+        if cmd == b"SET" and len(argv) == 3:
+            i = self._push(clt, ops, OP_CLT_WRITE,
+                           encode_put(argv[1], argv[2]), argv[1])
+            return (lambda rs, i=i:
+                    b"+OK\r\n" if rs[i] == b"OK"
+                    else b"-ERR write failed\r\n",)
+        if cmd == b"GET" and len(argv) == 2:
+            i = self._push(clt, ops, OP_CLT_READ,
+                           encode_get(argv[1]), argv[1])
+            return (lambda rs, i=i: _resp_bulk(rs[i]),)
+        if cmd == b"DEL" and len(argv) >= 2:
+            idxs = [self._push(clt, ops, OP_CLT_WRITE,
+                               encode_delete(k), k)
+                    for k in argv[1:]]
+            return (lambda rs, idxs=idxs:
+                    b":%d\r\n" % sum(1 for i in idxs
+                                     if rs[i] == b"OK"),)
+        if cmd in (b"INCR", b"DECR") and len(argv) == 2:
+            delta = 1 if cmd == b"INCR" else -1
+            i = self._push(clt, ops, OP_CLT_WRITE,
+                           encode_incr(argv[1], delta), argv[1])
+            return (lambda rs, i=i:
+                    (b"-ERR value is not an integer\r\n"
+                     if rs[i] == _NOT_NUM
+                     else b":%d\r\n" % int(rs[i])),)
+        if cmd == b"MGET" and len(argv) >= 2:
+            idxs = [self._push(clt, ops, OP_CLT_READ,
+                               encode_get(k), k) for k in argv[1:]]
+            return (lambda rs, idxs=idxs:
+                    b"*%d\r\n" % len(idxs)
+                    + b"".join(_resp_bulk(rs[i]) for i in idxs),)
+        if cmd == b"MSET" and len(argv) >= 3 and len(argv) % 2 == 1:
+            idxs = [self._push(clt, ops, OP_CLT_WRITE,
+                               encode_put(argv[j], argv[j + 1]),
+                               argv[j])
+                    for j in range(1, len(argv), 2)]
+            return (lambda rs, idxs=idxs: b"+OK\r\n",)
+        if cmd == b"EXISTS" and len(argv) >= 2:
+            idxs = [self._push(clt, ops, OP_CLT_READ,
+                               encode_get(k), k) for k in argv[1:]]
+            return (lambda rs, idxs=idxs:
+                    b":%d\r\n" % sum(1 for i in idxs if rs[i]),)
+        _bump(self.stats, "app_errors")
+        return (b"-ERR wrong number of arguments\r\n",)
+
+    def _plan_mc(self, clt, words, data, noreply, ops):
+        cmd = words[0].lower()
+        _bump(self.stats, "app_mc_cmds")
+        if cmd == b"version":
+            _bump(self.stats, "app_local_cmds")
+            return (b"VERSION 1.4.21-apus\r\n",)
+        if cmd == b"quit":
+            return (b"", True)
+        if cmd == b"stats":
+            return (b"END\r\n",)
+        if cmd == b"set":
+            i = self._push(clt, ops, OP_CLT_WRITE,
+                           encode_put(words[1], data), words[1])
+            if noreply:
+                return (lambda rs, i=i: b"",)
+            return (lambda rs, i=i:
+                    b"STORED\r\n" if rs[i] == b"OK"
+                    else b"SERVER_ERROR write failed\r\n",)
+        if cmd == b"get":
+            keys = words[1:]
+            idxs = [self._push(clt, ops, OP_CLT_READ, encode_get(k), k)
+                    for k in keys]
+            def fmt(rs, keys=keys, idxs=idxs):
+                out = []
+                for k, i in zip(keys, idxs):
+                    v = rs[i]
+                    if v:
+                        out.append(b"VALUE %s 0 %d\r\n%s\r\n"
+                                   % (k, len(v), v))
+                out.append(b"END\r\n")
+                return b"".join(out)
+            return (fmt,)
+        if cmd == b"delete" and len(words) >= 2:
+            i = self._push(clt, ops, OP_CLT_READ,
+                           encode_get(words[1]), words[1])
+            j = self._push(clt, ops, OP_CLT_WRITE,
+                           encode_delete(words[1]), words[1])
+            if noreply:
+                return (lambda rs: b"",)
+            return (lambda rs, i=i, j=j:
+                    b"DELETED\r\n" if rs[i] else b"NOT_FOUND\r\n",)
+        if cmd in (b"incr", b"decr") and len(words) >= 3:
+            try:
+                delta = int(words[2])
+            except ValueError:
+                return (b"CLIENT_ERROR invalid numeric delta "
+                        b"argument\r\n",)
+            if cmd == b"decr":
+                delta = -delta
+            i = self._push(clt, ops, OP_CLT_WRITE,
+                           encode_incr(words[1], delta), words[1])
+            if noreply:
+                return (lambda rs: b"",)
+            return (lambda rs, i=i:
+                    (b"CLIENT_ERROR cannot increment or decrement "
+                     b"non-numeric value\r\n" if rs[i] == _NOT_NUM
+                     else b"%d\r\n" % max(0, int(rs[i]))),)
+        _bump(self.stats, "app_errors")
+        return (b"ERROR\r\n",)
+
+    def _push(self, clt: ApusClient, ops: list, op: int, data: bytes,
+              key: bytes) -> int:
+        ops.append((op, data, clt.group_of(key)))
+        return len(ops) - 1
+
+    # -- opaque relay fallback -----------------------------------------
+
+    def _relay(self, conn: socket.socket, pending: bytes) -> None:
+        """Transparent byte-stream proxy to the interposed app (the
+        PR-13-and-earlier serving surface): everything this connection
+        says from now on goes to the real app verbatim, and its
+        replies come back verbatim.  The app side is interposed, so
+        writes keep replicating through the capture path."""
+        try:
+            app = socket.create_connection(self.fallback, timeout=5.0)
+        except OSError:
+            _bump(self.stats, "app_errors")
+            return
+        app.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            if pending:
+                app.sendall(pending)
+            conn.settimeout(0.2)
+            app.settimeout(0.2)
+            import select as _select
+            while not self._stop.is_set():
+                r, _, _ = _select.select([conn, app], [], [], 0.2)
+                for s in r:
+                    try:
+                        chunk = s.recv(1 << 16)
+                    except socket.timeout:
+                        continue
+                    if not chunk:
+                        return
+                    _bump(self.stats, "app_fallback_bytes", len(chunk))
+                    (app if s is conn else conn).sendall(chunk)
+        except OSError:
+            return
+        finally:
+            try:
+                app.close()
+            except OSError:
+                pass
+
+
+def _sniff(buf: bytes) -> str:
+    return "resp" if buf[:1] == b"*" else "mc"
+
+
+def _resp_bulk(v: "bytes | None") -> bytes:
+    if not v:
+        return b"$-1\r\n"
+    return b"$%d\r\n%s\r\n" % (len(v), v)
+
+
+def _resp_known(argv) -> bool:
+    return bool(argv) and argv[0].upper() in AppServer._RESP_OK
+
+
+def _parse_resp(buf: bytes):
+    """One RESP array-of-bulk-strings command at the head of ``buf``
+    -> (argv | None, bytes_used); (None, >0) = malformed, ( _, 0) =
+    incomplete."""
+    eol = buf.find(b"\r\n")
+    if eol < 0:
+        return None, 0
+    try:
+        n = int(buf[1:eol])
+    except ValueError:
+        return None, eol + 2
+    off = eol + 2
+    argv = []
+    for _ in range(max(0, n)):
+        if buf[off:off + 1] != b"$":
+            return (None, off) if len(buf) > off else (None, 0)
+        eol = buf.find(b"\r\n", off)
+        if eol < 0:
+            return None, 0
+        try:
+            blen = int(buf[off + 1:eol])
+        except ValueError:
+            return None, eol + 2
+        start = eol + 2
+        if len(buf) < start + blen + 2:
+            return None, 0
+        argv.append(buf[start:start + blen])
+        off = start + blen + 2
+    return argv, off

@@ -147,6 +147,18 @@ class ClusterSpec:
     # from ``follower_reads`` below, which gates STALE app-level reads
     # at the proxy.
     follower_read_leases: bool = True
+    # Bucket-granular follower leases (core.node
+    # NodeConfig.flr_bucket_leases — Hermes proper, per-KEY write
+    # invalidation quantized to the elastic plane's 840 hash buckets):
+    # a follower's lease request carries the bucket set its reads
+    # touch, commit only waits for a holder's ack on writes whose
+    # buckets intersect a live granted set, and a bucket-b follower
+    # read waits on b's own log tail instead of the whole log end —
+    # one slow holder stops stalling every write in the group, and a
+    # hot-key write stream stops gating cold-key follower reads.
+    # False = whole-log gating (the measured baseline);
+    # APUS_FLR_BUCKETS=0/1 overrides either way.
+    flr_bucket_leases: bool = True
     # Native serving data plane (native/dataplane.cpp via
     # apus_tpu/parallel/native_plane.py): client connections are handed
     # to a GIL-released C++ epoll loop that does frame ingest, OP_GROUP

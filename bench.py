@@ -1896,6 +1896,310 @@ def _bench_breakdown() -> None:
     print(json.dumps(result), flush=True)
 
 
+def _bench_perkey() -> None:
+    """--perkey mode (ISSUE 15): per-bucket follower-lease
+    invalidation vs the whole-log baseline, measured where it matters —
+    follower-lease GET throughput on COLD keys while a concurrent
+    hot-key writer hammers ONE key in a different bucket.
+
+    Under whole-log gating every cold read at a follower waits for
+    apply to cover the follower's whole log end at registration (the
+    hot write stream drags that forward continuously) and every hot
+    commit waits on every lease holder's ack; under bucket-granular
+    leases the cold buckets decouple (grant floors and wait rules are
+    per bucket, commit bypasses disjoint-set holders —
+    node_flr_commit_bypass counts the relief).  Same per-replica read
+    service gate both rows (APUS_PK_READ_SVC_US -> APUS_READ_SVC_US,
+    the PR 9 methodology: each replica owns one core).
+
+    Env knobs: APUS_PK_SECONDS (3.0), APUS_PK_READERS (4),
+    APUS_PK_WRITERS (2), APUS_PK_READ_SVC_US (200), APUS_PK_WINDOW
+    (32).  Headline: value = bucketed cold-GET ops/s; vs_baseline =
+    bucketed/whole-log ratio (acceptance >= 2.0)."""
+    import dataclasses
+    import threading
+
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.runtime.router import bucket_of_key
+    from apus_tpu.utils.config import ClusterSpec
+
+    seconds = float(os.environ.get("APUS_PK_SECONDS", "3.0"))
+    readers = int(os.environ.get("APUS_PK_READERS", "2"))
+    writers = int(os.environ.get("APUS_PK_WRITERS", "1"))
+    svc_us = os.environ.get("APUS_PK_READ_SVC_US", "50")
+    W = int(os.environ.get("APUS_PK_WINDOW", "8"))
+    #: hot writer in-flight window: the depth of the uncommitted hot
+    #: tail a whole-log-gated cold read can find itself parked behind
+    #: — the "heavy write pressure" knob of the scenario.
+    WW = int(os.environ.get("APUS_PK_WRITE_WINDOW", "256"))
+    #: hot value size: follower APPLY cost per hot entry — the load a
+    #: whole-log-gated cold read waits behind.
+    hv = b"H" * int(os.environ.get("APUS_PK_VALUE", "2048"))
+    #: emulated replication-link latency (leader -> followers), ms.
+    repl_ms = float(os.environ.get("APUS_PK_REPL_MS", "4.0"))
+    # The PROXIED timing envelope (hb 10 ms / timeout 100 ms): python
+    # daemons GIL-starved by the hot writer + the emulated link delay
+    # flap the leader LEASE at tighter envelopes, which would measure
+    # lease churn, not the gating rule under test.
+    spec0 = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                        elect_low=0.150, elect_high=0.400)
+
+    hot = b"hot-key"
+    hot_b = bucket_of_key(hot)
+    cold: list[bytes] = []
+    i = 0
+    while len(cold) < readers * W:
+        k = b"cold-%05d" % i
+        i += 1
+        if bucket_of_key(k) != hot_b:
+            cold.append(k)
+
+    def run(bucketed: bool) -> dict:
+        os.environ["APUS_READ_SVC_US"] = svc_us
+        try:
+            spec = dataclasses.replace(spec0, fault_plane=True,
+                                       flr_bucket_leases=bucketed)
+            with LocalCluster(3, spec=spec) as c:
+                lead = c.wait_for_leader(30.0)
+                peers = list(c.spec.peers)
+                if repl_ms > 0:
+                    # Emulated replication-link latency (cross-AZ
+                    # deployment shape), leader -> both followers,
+                    # IDENTICAL in both rows: entries and commit
+                    # offsets reach followers one link delay late, so
+                    # a whole-log-gated cold read really does park
+                    # behind the hot stream's in-flight tail — the
+                    # coupling this bench measures.
+                    for f in range(3):
+                        if f != lead.idx:
+                            lead.transport.set_delay(f, repl_ms / 1e3)
+                with ApusClient(peers, timeout=20.0) as warm:
+                    warm.put(hot, b"h0")
+                    for lo in range(0, len(cold), 16):
+                        warm.pipeline_puts(
+                            [(k, b"c" * 64)
+                             for k in cold[lo:lo + 16]])
+                stop_at = time.monotonic() + seconds
+                reads_done = [0] * readers
+                writes_done = [0] * writers
+
+                def write_worker(w):
+                    from apus_tpu.models.kvs import encode_put
+                    from apus_tpu.runtime.client import OP_CLT_WRITE
+                    with ApusClient(peers, timeout=30.0) as cl:
+                        j = 0
+                        while time.monotonic() < stop_at:
+                            try:
+                                cl.pipeline(
+                                    [(OP_CLT_WRITE,
+                                      encode_put(hot, hv + b"%d-%d"
+                                                 % (w, j + k)))
+                                     for k in range(WW)], window=WW)
+                                writes_done[w] += WW
+                                j += WW
+                            except (TimeoutError, RuntimeError):
+                                return
+
+                def read_worker(r):
+                    keys = cold[r * W:(r + 1) * W]
+                    with ApusClient(peers, timeout=30.0,
+                                    read_policy="spread") as cl:
+                        while time.monotonic() < stop_at:
+                            try:
+                                cl.pipeline_gets(keys)
+                                reads_done[r] += len(keys)
+                            except (TimeoutError, RuntimeError):
+                                return
+
+                ts = [threading.Thread(target=write_worker, args=(w,))
+                      for w in range(writers)]
+                ts += [threading.Thread(target=read_worker, args=(r,))
+                       for r in range(readers)]
+                t0 = time.monotonic()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=seconds + 30.0)
+                elapsed = time.monotonic() - t0
+                lead_st = probe_status(peers[lead.idx],
+                                       timeout=2.0) or {}
+                flr_reads = 0
+                for p in peers:
+                    st = probe_status(p, timeout=2.0) or {}
+                    flr_reads += st.get("flr_local_reads", 0) or 0
+                return {
+                    "cold_get_ops_per_sec": round(
+                        sum(reads_done) / elapsed, 1),
+                    "cold_gets": sum(reads_done),
+                    "hot_writes": sum(writes_done),
+                    "hot_write_ops_per_sec": round(
+                        sum(writes_done) / elapsed, 1),
+                    "elapsed_s": round(elapsed, 3),
+                    "flr_local_reads": flr_reads,
+                    "flr_commit_bypass": lead_st.get(
+                        "flr_commit_bypass", 0),
+                    "flr_commit_blocked": lead_st.get(
+                        "flr_commit_blocked", 0),
+                    "flr_bucket_grants": lead_st.get(
+                        "flr_bucket_grants", 0),
+                }
+        finally:
+            os.environ.pop("APUS_READ_SVC_US", None)
+
+    _mark("perkey: bucket-granular row")
+    row_bucket = run(bucketed=True)
+    _mark("perkey: whole-log baseline row")
+    row_whole = run(bucketed=False)
+    ratio = (row_bucket["cold_get_ops_per_sec"]
+             / max(1e-9, row_whole["cold_get_ops_per_sec"]))
+    result = {
+        "metric": "perkey_invalidation_cold_get_gain",
+        "value": row_bucket["cold_get_ops_per_sec"],
+        "unit": "cold-key follower GET ops/s (bucket-granular row)",
+        "vs_baseline": round(ratio, 2),
+        "detail": {
+            "mode": "perkey",
+            "acceptance": "bucketed/whole-log >= 2.0 (ISSUE 15)",
+            "read_svc_us_both_rows": float(svc_us),
+            "readers": readers, "writers": writers, "window": W,
+            "hot_bucket": hot_b,
+            "bucket_granular": row_bucket,
+            "whole_log_baseline": row_whole,
+            "note": ("one hot-key pipelined writer stream vs "
+                     "cold-bucket spread GETs; same clusters, same "
+                     "per-replica read service gate, only "
+                     "flr_bucket_leases differs.  flr_commit_bypass "
+                     "counts commits the whole-log rule would have "
+                     "held for a lease holder's ack."),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
+def _bench_slo() -> None:
+    """--slo mode (ISSUE 15): the open-loop SLO harness headline.
+
+    Phase 1 (clean): >=512 open-loop connections at a fixed arrival
+    rate against a live 3-replica ProcCluster — zipfian hot-key skew,
+    seeded connection churn, periodic fan-in bursts — p50/p99/p999
+    measured coordinated-omission-safe (latency anchored at scheduled
+    arrivals; apus_tpu/load).  Phase 2 (chaos-composed): same load
+    with the LEADER SIGKILLED mid-run and restarted — the report's
+    windowed view quantifies the SLO degradation window around the
+    failover.
+
+    Env knobs: APUS_SLO_CONNS (512), APUS_SLO_RATE (1200 ops/s),
+    APUS_SLO_SECONDS (10), APUS_SLO_MS (100 — the per-window p99 SLO
+    threshold), APUS_SLO_VALUE (64), APUS_SLO_KEYS (20000)."""
+    import tempfile
+    import threading
+
+    from apus_tpu.load import OpenLoopConfig, run_open_loop
+    from apus_tpu.obs.service import fetch_metrics
+    from apus_tpu.runtime.proc import ProcCluster
+
+    conns = int(os.environ.get("APUS_SLO_CONNS", "512"))
+    rate = float(os.environ.get("APUS_SLO_RATE", "800"))
+    seconds = float(os.environ.get("APUS_SLO_SECONDS", "10"))
+    slo_ms = float(os.environ.get("APUS_SLO_MS", "400"))
+    value = int(os.environ.get("APUS_SLO_VALUE", "64"))
+    nkeys = int(os.environ.get("APUS_SLO_KEYS", "20000"))
+
+    def cfg(peers, seed):
+        return OpenLoopConfig(
+            peers=peers, connections=conns, rate=rate,
+            duration=seconds, seed=seed, nkeys=nkeys, theta=0.99,
+            get_fraction=0.9, value_size=value, churn_every=2.0,
+            churn_fraction=0.05, burst_every=2.5,
+            burst_size=max(32, conns // 8), slo_ms=slo_ms,
+            window_s=0.5, grace=20.0)
+
+    def slim(rep):
+        d = rep.to_dict()
+        d["windows"] = [(round(t, 2), n, round(p, 2), bad)
+                        for t, n, p, bad in d["windows"]]
+        return d
+
+    with tempfile.TemporaryDirectory(prefix="apus-slo") as td:
+        with ProcCluster(3, workdir=td) as pc:
+            pc.leader_idx(timeout=30.0)
+            peers = [p for p in pc.spec.peers if p]
+            _mark(f"slo: clean open-loop run ({conns} conns @ "
+                  f"{rate:.0f}/s x {seconds:.0f}s)")
+            clean_rep, clean_stats = run_open_loop(cfg(peers, seed=15))
+
+            _mark("slo: chaos-composed run (leader kill mid-load)")
+            kill_log: dict = {}
+
+            def nemesis():
+                time.sleep(seconds * 0.4)
+                try:
+                    lead = pc.leader_idx(timeout=5.0)
+                except AssertionError:
+                    return
+                kill_log["killed"] = lead
+                kill_log["t_kill_s"] = round(seconds * 0.4, 2)
+                pc.kill(lead)
+                time.sleep(2.0)
+                try:
+                    pc.restart(lead)
+                    kill_log["restarted"] = True
+                except AssertionError:
+                    kill_log["restarted"] = False
+
+            nt = threading.Thread(target=nemesis, daemon=True)
+            nt.start()
+            chaos_rep, chaos_stats = run_open_loop(cfg(peers, seed=16))
+            nt.join(timeout=30.0)
+
+            health = []
+            for p in peers:
+                m = fetch_metrics(p, timeout=2.0) or {}
+                met = m.get("metrics", {}) or {}
+                rc = met.get("dev_recompiles", 0)
+                if isinstance(rc, dict):
+                    rc = rc.get("value", 0)
+                health.append({
+                    "replica": m.get("replica"),
+                    "dev_recompiles": rc,
+                    "flags": (m.get("health") or {}).get("flags", []),
+                })
+
+    clean = slim(clean_rep)
+    chaos = slim(chaos_rep)
+    result = {
+        "metric": "open_loop_slo_get_set_p99",
+        "value": clean["p99_ms"],
+        "unit": "ms (clean-run p99, CO-safe, scheduled-arrival "
+                "anchored)",
+        "vs_baseline": round(clean["achieved_rate"] / rate, 3),
+        "detail": {
+            "mode": "slo",
+            "connections": conns, "rate_ops_s": rate,
+            "duration_s": seconds, "slo_ms": slo_ms,
+            "zipf_theta": 0.99, "nkeys": nkeys,
+            "get_fraction": 0.9,
+            "clean": {"report": clean, "stats": clean_stats},
+            "chaos": {"report": chaos, "stats": chaos_stats,
+                      "nemesis": kill_log,
+                      "degraded_s": chaos["degraded_s"],
+                      "degraded_spans": chaos["degraded_spans"]},
+            "recompile_sentinel": [h["dev_recompiles"] for h in health],
+            "health": health,
+            "note": ("open-loop: arrivals pre-scheduled at the target "
+                     "rate, never slowed by the server; latency = "
+                     "completion - scheduled arrival (coordinated-"
+                     "omission-safe), unresolved ops censored into "
+                     "the tail.  Chaos run composes seeded connection "
+                     "churn + fan-in bursts with a mid-run leader "
+                     "SIGKILL + restart; degraded_spans quantifies "
+                     "the SLO outage window."),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
 def _run_child(extra_env: dict, timeout_s: float) -> dict | None:
     """Run the measurement in a watched subprocess; return the parsed
     JSON result or None on failure/timeout (stderr passes through)."""
@@ -2005,6 +2309,33 @@ def main() -> None:
                 "value": None, "unit": "us (server e2e p50)",
                 "vs_baseline": 0.0,
                 "detail": {"mode": "breakdown", "error": repr(e)},
+            }), flush=True)
+        return
+    if "--perkey" in sys.argv[1:]:
+        # Per-bucket follower-lease invalidation A/B (ISSUE 15).
+        try:
+            _bench_perkey()
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "metric": "perkey_invalidation_cold_get_gain",
+                "value": None, "unit": "cold-key follower GET ops/s",
+                "vs_baseline": 0.0,
+                "detail": {"mode": "perkey", "error": repr(e)},
+            }), flush=True)
+        return
+    if "--slo" in sys.argv[1:]:
+        # Open-loop SLO serving harness (ISSUE 15).
+        try:
+            _bench_slo()
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "metric": "open_loop_slo_get_set_p99",
+                "value": None, "unit": "ms", "vs_baseline": 0.0,
+                "detail": {"mode": "slo", "error": repr(e)},
             }), flush=True)
         return
     if "--txn" in sys.argv[1:]:

@@ -89,6 +89,18 @@ def main() -> int:
     ap.add_argument("--toyserver", action="store_true",
                     help="drive the native toyserver instead of the "
                          "pinned real redis")
+    ap.add_argument("--memcached", action="store_true",
+                    help="drive the pinned real memcached under the "
+                         "interposer (memcached TEXT protocol set/get "
+                         "via McClient) — the reference's second app; "
+                         "LOUD skip (rc 2) when the tarball/binary "
+                         "cannot be built")
+    ap.add_argument("--ssdb", action="store_true",
+                    help="drive the pinned real SSDB under the "
+                         "interposer (SSDB speaks the redis protocol, "
+                         "so the RESP driver covers it) — the "
+                         "reference's third app; LOUD skip (rc 2) "
+                         "when the tarball/binary cannot be built")
     ap.add_argument("--failover-every", type=float, default=120.0,
                     help="kill the leader every N seconds (0 = never)")
     ap.add_argument("--tick-interval", type=float, default=None,
@@ -235,6 +247,46 @@ def main() -> int:
         do_set = lambda c, k, v: c.cmd(f"SET {k} {v}") == "OK"  # noqa: E731
         do_get = lambda c, k: (  # noqa: E731
             lambda v: None if v == "NIL" else v)(c.cmd(f"GET {k}"))
+    elif args.memcached:
+        from apus_tpu.runtime.appcluster import (MEMCACHED_RUN,
+                                                 McClient,
+                                                 build_memcached)
+        if args.txn:
+            print("--txn needs a MULTI/EXEC surface (redis/toyserver/"
+                  "--kv); memcached has none", file=sys.stderr)
+            return 2
+        if args.pipeline:
+            print("--pipeline's app side stream needs pipeline_cmds "
+                  "(RESP/line protocols); memcached text has none "
+                  "here", file=sys.stderr)
+            return 2
+        if not build_memcached():
+            print("SKIP: pinned memcached unavailable (no tarball / "
+                  "build failed) — the memcached soak smoke needs "
+                  "apps/memcached/mk to succeed", file=sys.stderr)
+            return 2
+        app_argv = [MEMCACHED_RUN]
+        mk = lambda addr: McClient(addr, timeout=15.0)  # noqa: E731
+        do_set = lambda c, k, v: c.set(k, v)  # noqa: E731
+        do_get = lambda c, k: (  # noqa: E731
+            lambda r: r.decode() if r is not None else None)(c.get(k))
+    elif args.ssdb:
+        from apus_tpu.runtime.appcluster import SSDB_RUN, build_ssdb
+        if args.txn:
+            print("--txn needs a MULTI/EXEC surface (redis/toyserver/"
+                  "--kv); ssdb has none", file=sys.stderr)
+            return 2
+        if not build_ssdb():
+            print("SKIP: pinned ssdb unavailable (no tarball / build "
+                  "failed) — the ssdb soak smoke needs apps/ssdb/mk "
+                  "to succeed", file=sys.stderr)
+            return 2
+        app_argv = [SSDB_RUN]
+        mk = lambda addr: RespClient(addr, timeout=15.0)  # noqa: E731
+        do_set = lambda c, k, v: c.cmd("SET", k, v) in ("OK", 1)  # noqa: E731
+        do_get = lambda c, k: (  # noqa: E731  (RESP bulk replies are bytes)
+            lambda r: r.decode() if isinstance(r, bytes) else r)(
+                c.cmd("GET", k))
     else:
         from apus_tpu.runtime.appcluster import REDIS_RUN, build_redis
         if not build_redis():
@@ -509,7 +561,8 @@ def main() -> int:
                 rs = c.pipeline_cmds([f"SET {k} {v}" for k, v in kvs])
             else:
                 rs = c.pipeline_cmds([("SET", k, v) for k, v in kvs])
-            return all(r == "OK" for r in rs)
+            # ssdb's RESP SET answers :1 where redis answers +OK.
+            return all(r in ("OK", 1) for r in rs)
 
         # --txn: the transactional side stream.  Keys stay inside a
         # SMALL slice (toyserver's 4096-slot table bounds the total
@@ -951,7 +1004,9 @@ def main() -> int:
             "peak_rss_kb": peak_rss,
             "converged": converged,
             "app": ("kv" if args.kv else
-                    "toyserver" if args.toyserver else "redis"),
+                    "toyserver" if args.toyserver else
+                    "memcached" if args.memcached else
+                    "ssdb" if args.ssdb else "redis"),
             "replicas": args.replicas,
             **({"pipeline_window": PIPE_W,
                 "pipeline_windows": pipe_windows}
@@ -1004,6 +1059,8 @@ def main() -> int:
               f"--fault-seed {args.fault_seed}"
               + (" --mesh" if args.mesh else "")
               + (" --toyserver" if args.toyserver else "")
+              + (" --memcached" if args.memcached else "")
+              + (" --ssdb" if args.ssdb else "")
               + (" --audit" if args.audit else "")
               + (" --read-local" if args.read_local else "")
               + (f" --churn --churn-every {args.churn_every}"

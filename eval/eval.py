@@ -216,6 +216,34 @@ def _run_txn(out, trials: int = 5) -> None:
         _record(out, rec, replicas=3, bench="txn_campaign")
 
 
+def _run_slo(out) -> None:
+    """Open-loop SLO serving harness (bench.py --slo): 512 open-loop
+    connections with zipfian skew + connection churn + fan-in bursts
+    against a live 3-replica ProcCluster, p50/p99/p999 coordinated-
+    omission-safe, one clean run and one chaos-composed run (leader
+    SIGKILL mid-load) with the degradation window quantified (ISSUE 15
+    headline)."""
+    print("bench.py --slo: open-loop SLO serving harness "
+          "(clean + leader-kill chaos)")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"), "--slo"],
+                         timeout=420):
+        _record(out, rec, replicas=3, bench="slo")
+
+
+def _run_perkey(out) -> None:
+    """Per-bucket follower-lease invalidation A/B (bench.py --perkey):
+    cold-key follower-lease GET throughput under a concurrent hot-key
+    writer, bucket-granular vs whole-log gating, same service gates
+    both rows (ISSUE 15 acceptance: >= 2x)."""
+    print("bench.py --perkey: bucket-granular vs whole-log lease "
+          "gating A/B")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"), "--perkey"],
+                         timeout=300):
+        _record(out, rec, replicas=3, bench="perkey")
+
+
 def _run_txn_bench(out) -> None:
     """Transaction throughput row (bench.py --txn): single-group MULTI
     batch vs cross-group 2PC cost under the per-group write-svc
@@ -331,6 +359,16 @@ def cmd_run(args) -> int:
             # Transaction campaign + throughput row only.
             _run_txn(out, trials=getattr(args, "txn_trials", 5))
             _run_txn_bench(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "slo_only", False):
+            # Open-loop SLO serving harness only: skip the suite.
+            _run_slo(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "perkey_only", False):
+            # Per-bucket invalidation A/B only: skip the suite.
+            _run_perkey(out)
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -884,6 +922,44 @@ def cmd_report(args) -> int:
             f"{_fmt(d.get('cross_group_2pc_txns_per_sec'))} txns/sec "
             f"(cost ratio {d.get('cost_ratio_2pc_vs_multi')}x), "
             f"recompile sentinel {d.get('recompile_sentinel')}")
+    slo = [r for r in runs if r.get("bench") == "slo"
+           and isinstance(r.get("value"), (int, float))]
+    if slo:
+        last = slo[-1]
+        d = last.get("detail", {})
+        cl = (d.get("clean") or {}).get("report", {})
+        ch = (d.get("chaos") or {}).get("report", {})
+        lines.append(
+            f"- OPEN-LOOP SLO serving harness ({d.get('connections')} "
+            f"connections @ {_fmt(d.get('rate_ops_s'))} ops/sec "
+            f"arrivals, zipfian theta {d.get('zipf_theta')}, "
+            f"connection churn + fan-in bursts, coordinated-omission-"
+            f"safe): clean p50/p99/p999 {_fmt(cl.get('p50_ms'), 1)}/"
+            f"{_fmt(cl.get('p99_ms'), 1)}/{_fmt(cl.get('p999_ms'), 1)}"
+            f" ms ({cl.get('errors')} errors, {cl.get('censored')} "
+            f"censored); leader-kill chaos run p99 "
+            f"{_fmt(ch.get('p99_ms'), 1)} ms with "
+            f"{_fmt(ch.get('degraded_s'), 1)} s total SLO degradation "
+            f"(spans {ch.get('degraded_spans')}); recompile sentinel "
+            f"{d.get('recompile_sentinel')}")
+    pk = [r for r in runs if r.get("bench") == "perkey"
+          and isinstance(r.get("value"), (int, float))]
+    if pk:
+        last = pk[-1]
+        d = last.get("detail", {})
+        b = d.get("bucket_granular", {})
+        w = d.get("whole_log_baseline", {})
+        lines.append(
+            f"- PER-BUCKET lease invalidation (Hermes proper): "
+            f"cold-key follower GETs {_fmt(last['value'])} ops/sec "
+            f"bucket-granular vs {_fmt(w.get('cold_get_ops_per_sec'))} "
+            f"whole-log ({last.get('vs_baseline')}x, acceptance >= "
+            f"2.0) under a concurrent hot-key writer "
+            f"({_fmt(b.get('hot_write_ops_per_sec'))} writes/sec, "
+            f"same gates both rows); "
+            f"{b.get('flr_commit_bypass')} commits bypassed a "
+            f"lagging disjoint-set holder, "
+            f"{b.get('flr_bucket_grants')} bucket-scoped grants")
     spl = [r for r in runs if r.get("metric") == "split_relief_gain"
            and isinstance(r.get("value"), (int, float))]
     if spl:
@@ -1237,6 +1313,18 @@ def main() -> int:
                        help="run ONLY the large-state rejoin ladder "
                             "(reconf_bench.py --ladder; skips the "
                             "cluster suite)")
+        p.add_argument("--slo-only", action="store_true",
+                       help="run ONLY the open-loop SLO serving "
+                            "harness (bench.py --slo: 512 open-loop "
+                            "connections, zipfian skew, connection "
+                            "churn, clean + leader-kill-chaos runs, "
+                            "CO-safe p99/p999) and bank the row")
+        p.add_argument("--perkey-only", action="store_true",
+                       help="run ONLY the per-bucket lease-"
+                            "invalidation A/B (bench.py --perkey: "
+                            "cold-key follower GETs under a hot-key "
+                            "writer, bucket-granular vs whole-log "
+                            "gating) and bank the row")
         p.add_argument("--ladder-mb", default="10,100",
                        help="rejoin-ladder state sizes, MB comma list")
     p_rep = sub.add_parser("report", help="aggregate results")

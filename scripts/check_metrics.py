@@ -55,6 +55,10 @@ NAMESPACE_OF = {
     # PeerServer view (srv_*); the C loop's own counters arrive as
     # srv_native_* gauges via the scrape mirror, cataloged in GAUGES.
     "apus_tpu/parallel/native_plane.py": "srv",
+    # App serving gateway: its counters land on the daemon's srv_*
+    # view (standalone gateways keep a plain dict; the _bump helper
+    # duck-types both).
+    "apus_tpu/runtime/serve.py": "srv",
     "apus_tpu/parallel/faults.py": "fault",
     "apus_tpu/runtime/client.py": "srv",
     "apus_tpu/runtime/daemon.py": "node",

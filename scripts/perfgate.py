@@ -50,6 +50,7 @@ FACTORS = {
     "unsampled_obs_check_ns": 3.0,
     "hist_observe_ns": 3.0,
     "native_ingest_op_p50_us": 3.0,
+    "lease_get_serve_p99_us": 3.0,
 }
 UNITS = {
     "depth1_window_wall_p50_us": "us",
@@ -59,6 +60,7 @@ UNITS = {
     "unsampled_obs_check_ns": "ns",
     "hist_observe_ns": "ns",
     "native_ingest_op_p50_us": "us",
+    "lease_get_serve_p99_us": "us",
 }
 
 
@@ -334,12 +336,52 @@ def _measure_native_ingest(repeats: int = 3, iters: int = 30,
         plane.stop()
 
 
+def _measure_lease_get_p99(repeats: int = 3, iters: int = 150,
+                           warm: int = 60) -> float:
+    """p99 of one lease-GET serve through the LIVE serving path
+    (ISSUE 15): spread GETs against a 3-replica in-process cluster —
+    wire roundtrip, follower-lease (or leader-lease) serve from local
+    applied state.  The production serving surface's read budget: a
+    regression here (a read re-verifying through the majority path, a
+    lease that stopped holding, a per-read allocation storm in the
+    handler) lands straight on app p99.  Pure host path, no jax."""
+    import dataclasses as _dc
+
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.utils.config import ClusterSpec
+
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150)
+    best = float("inf")
+    with LocalCluster(3, spec=_dc.replace(spec)) as c:
+        c.wait_for_leader(30.0)
+        peers = list(c.spec.peers)
+        with ApusClient(peers, timeout=20.0) as w, \
+                ApusClient(peers, timeout=20.0,
+                           read_policy="spread") as r:
+            assert w.put(b"pg", b"v") == b"OK"
+            for _ in range(warm):
+                r.get(b"pg")
+            for _ in range(repeats):
+                lats = []
+                for _ in range(iters):
+                    t0 = time.perf_counter_ns()
+                    r.get(b"pg")
+                    lats.append((time.perf_counter_ns() - t0) / 1e3)
+                lats.sort()
+                best = min(best, lats[min(len(lats) - 1,
+                                          int(len(lats) * 0.99))])
+    return round(best, 1)
+
+
 def measure(fast: bool = False) -> dict:
     chk, obs = _measure_obs_fast_path()
     out = {"unsampled_obs_check_ns": chk, "hist_observe_ns": obs}
     native = _measure_native_ingest()
     if native is not None:
         out["native_ingest_op_p50_us"] = native
+    out["lease_get_serve_p99_us"] = _measure_lease_get_p99()
     if not fast:
         out["depth1_window_wall_p50_us"] = _measure_depth1_window()
         out["group4_dispatch_wall_p50_us"] = _measure_group_dispatch()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Tier-1 SLO smoke (ISSUE 15): one SMALL open-loop run proving the
+serving-surface load harness end-to-end — a live 3-replica
+ProcCluster, ~100 open-loop connections with zipfian skew + connection
+churn + one fan-in burst, coordinated-omission-safe accounting — and
+asserting the invariants the banked BENCH_r15 methodology rests on:
+every scheduled op resolves (no censoring), zero errors, and the
+percentile chain is sane.  Seconds, not minutes; the full 512-conn
+clean + chaos runs live in `bench.py --slo` / `eval.py run --slo-only`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from apus_tpu.load import OpenLoopConfig, run_open_loop
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with tempfile.TemporaryDirectory(prefix="apus-slo-smoke") as td:
+        with ProcCluster(3, workdir=td) as pc:
+            pc.leader_idx(timeout=30.0)
+            cfg = OpenLoopConfig(
+                peers=[p for p in pc.spec.peers if p],
+                connections=96, rate=300.0, duration=3.0, seed=9415,
+                nkeys=2000, theta=0.99, get_fraction=0.9,
+                value_size=64, churn_every=1.0, churn_fraction=0.05,
+                burst_every=1.5, burst_size=48, slo_ms=400.0,
+                grace=20.0)
+            rep, stats = run_open_loop(cfg)
+    print(f"slo_smoke: ops={rep.ops} errors={rep.errors} "
+          f"censored={rep.censored} p50={rep.p50_ms:.1f}ms "
+          f"p99={rep.p99_ms:.1f}ms p999={rep.p999_ms:.1f}ms "
+          f"churns={stats['churns']} achieved="
+          f"{rep.achieved_rate:.0f}/s")
+    if rep.ops < 500:
+        print("slo_smoke: FAIL — too few ops resolved", file=sys.stderr)
+        return 1
+    if rep.censored or rep.errors:
+        print(f"slo_smoke: FAIL — {rep.errors} errors / "
+              f"{rep.censored} censored ops", file=sys.stderr)
+        return 1
+    if not (0.0 < rep.p50_ms <= rep.p99_ms <= rep.p999_ms):
+        print("slo_smoke: FAIL — percentile chain not monotone",
+              file=sys.stderr)
+        return 1
+    if stats["churns"] < 2:
+        print("slo_smoke: FAIL — connection churn never fired",
+              file=sys.stderr)
+        return 1
+    print("slo_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,6 +112,15 @@ if [ "$smoke" -eq 1 ]; then
         echo "txn smoke FAILED (rc=$trc)" >&2
         exit "$trc"
     fi
+    echo "== SLO harness smoke (small open-loop run: zipfian skew +"
+    echo "   connection churn + fan-in burst, CO-safe accounting,"
+    echo "   every op resolves) =="
+    env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+    slrc=$?
+    if [ "$slrc" -ne 0 ]; then
+        echo "SLO harness smoke FAILED (rc=$slrc)" >&2
+        exit "$slrc"
+    fi
     echo "== txn checker unit slice (planted dirty-read / lost-update /"
     echo "   fractured-read histories REJECTED, clean txn history"
     echo "   ACCEPTED) =="

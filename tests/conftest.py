@@ -94,3 +94,15 @@ def pytest_configure(config):
         "coverage, FaultPlane exactly-once on the native path, and the "
         "slow ASAN-flavor tape; selectable with -m native (skips "
         "cleanly when the extension is not built)")
+    config.addinivalue_line(
+        "markers",
+        "load: open-loop SLO load-harness suite (apus_tpu.load) — "
+        "seeded zipfian, open-loop arrival schedules, coordinated-"
+        "omission-safe latency accounting, and the live engine smoke; "
+        "selectable with -m load")
+    config.addinivalue_line(
+        "markers",
+        "serve: protocol-aware app serving surface (runtime/serve.py) "
+        "— RESP + memcached-text GET/SET mapped onto the replicated "
+        "KVS via the group router and follower leases, with the "
+        "opaque relay fallback; selectable with -m serve")

@@ -465,3 +465,173 @@ def test_undecided_resolver_surfaces_real_violation():
         pytest.skip("budget not exhausted on this search order")
     res2 = resolve_undecided(events, res, max_nodes_per_key=5_000_000)
     assert not res2.ok and res2.violations
+
+
+# -- bucket-granular leases (per-key Hermes invalidation) -------------------
+
+def test_flr_bitmap_roundtrip():
+    from apus_tpu.runtime.flr import (BITMAP_BYTES, bitmap_to_buckets,
+                                      buckets_to_bitmap)
+    from apus_tpu.runtime.router import NBUCKETS
+
+    assert BITMAP_BYTES == 105
+    for s in (frozenset(), frozenset({0}), frozenset({NBUCKETS - 1}),
+              frozenset({1, 7, 8, 100, 839}),
+              frozenset(range(0, NBUCKETS, 3))):
+        bm = buckets_to_bitmap(s)
+        assert len(bm) == BITMAP_BYTES
+        assert bitmap_to_buckets(bm) == s
+
+
+def test_entry_bucket_footprint():
+    """Footprint exactness and conservatism: single-key writes and TM
+    batches are exact; CONFIG / non-TM txn records / undecodable
+    payloads are UNKNOWN (= every bucket); blanks touch nothing."""
+    from apus_tpu.core.log import LogEntry
+    from apus_tpu.core.node import entry_bucket_footprint
+    from apus_tpu.core.types import EntryType
+    from apus_tpu.models.kvs import (encode_put, encode_txn_multi)
+    from apus_tpu.runtime.router import bucket_of_key
+
+    def e(data=b"", type=EntryType.CSM):
+        return LogEntry(idx=5, term=1, req_id=1, clt_id=1, type=type,
+                        data=data)
+
+    assert entry_bucket_footprint(e(type=EntryType.NOOP)) == frozenset()
+    assert entry_bucket_footprint(e(type=EntryType.HEAD)) == frozenset()
+    assert entry_bucket_footprint(e(type=EntryType.CONFIG)) is None
+    fp = entry_bucket_footprint(e(encode_put(b"alpha", b"v")))
+    assert fp == frozenset({bucket_of_key(b"alpha")})
+    tm = encode_txn_multi([encode_put(b"a", b"1"), encode_put(b"b", b"2")])
+    fp = entry_bucket_footprint(e(tm))
+    assert fp == frozenset({bucket_of_key(b"a"), bucket_of_key(b"b")})
+    # Non-TM txn records and unknown tags: unknown -> every bucket.
+    assert entry_bucket_footprint(e(b"TD\x00junk")) is None
+    assert entry_bucket_footprint(e(b"Zjunk")) is None
+
+
+def _two_keys_in_distinct_buckets():
+    from apus_tpu.runtime.router import bucket_of_key
+    cold = b"cold-key"
+    for i in range(1000):
+        hot = b"hot-%d" % i
+        if bucket_of_key(hot) != bucket_of_key(cold):
+            return cold, hot
+    raise AssertionError("unreachable")
+
+
+def test_bucket_disjoint_writes_commit_past_lagging_holder():
+    """The per-bucket relief itself: a lease holder whose granted read
+    set covers only the COLD bucket stops gating hot-bucket commits —
+    counter-proven by flr_commit_bypass, with the whole-log baseline
+    (flr_bucket_leases=False) as the control."""
+    from apus_tpu.runtime.client import ApusClient, probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    cold, hot = _two_keys_in_distinct_buckets()
+
+    def run(bucketed: bool) -> dict:
+        spec = dataclasses.replace(SPEC, fault_plane=True,
+                                   flr_bucket_leases=bucketed)
+        with LocalCluster(3, spec=spec) as c:
+            lead = c.wait_for_leader(20.0)
+            peers = list(c.spec.peers)
+            victim = [i for i in range(3) if i != lead.idx][0]
+            with ApusClient(peers) as w, \
+                    ApusClient([peers[victim]],
+                               read_policy="spread") as r:
+                assert w.put(cold, b"c0") == b"OK"
+                # Warm the VICTIM's lease with cold-bucket reads only.
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    r.get(cold)
+                    st = probe_status(peers[victim], timeout=2.0)
+                    if st and st.get("flr_local_reads", 0) > 0:
+                        break
+                st = probe_status(peers[victim], timeout=2.0)
+                assert st and st.get("flr_local_reads", 0) > 0
+                if bucketed:
+                    assert st.get("flr_lease_buckets") not in (-1, 0)
+                # Drop the leader's outbound to the holder (its acks
+                # stop), then drive hot-bucket writes.
+                lead.transport.block([victim])
+                for i in range(10):
+                    assert w.put(hot, b"h%d" % i) == b"OK"
+                lead.transport.heal()
+            return probe_status(peers[lead.idx], timeout=2.0)
+
+    st = run(bucketed=True)
+    assert st["flr_commit_bypass"] > 0, \
+        "no commit bypassed the lagging disjoint-set holder"
+    st0 = run(bucketed=False)
+    assert st0.get("flr_commit_bypass", 0) == 0, \
+        "whole-log baseline must never bypass"
+
+
+@pytest.mark.audit
+def test_planted_bucket_check_rejected_by_checker():
+    """The bucket-check plant: with the granted-read-set membership
+    check skipped (APUS_FLR_PLANT=bucket,expiry — expiry keeps the
+    lease from masking the subject) a holder whose set covers only the
+    cold bucket serves a HOT-bucket read from stale local state after
+    the leader committed past it, and the linearizability checker MUST
+    reject the history.  The expiry-only control proves the bucket
+    check is exactly what stands between that bug and the stale read."""
+    import tempfile
+
+    from apus_tpu.audit import HistoryRecorder, check_history
+    from apus_tpu.parallel.faults import heal_all, isolate
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import PROC_SPEC, ProcCluster
+
+    cold, hot = _two_keys_in_distinct_buckets()
+
+    def run(plant: str):
+        rec = HistoryRecorder(capacity=1 << 14)
+        spec = dataclasses.replace(PROC_SPEC, auto_remove=False)
+        env = {i: {"APUS_FLR_PLANT": plant} for i in range(3)}
+        got = None
+        with tempfile.TemporaryDirectory(prefix="apus-flr-bplant") as td:
+            with ProcCluster(3, workdir=td, spec=spec, fault_plane=True,
+                             extra_env=env) as pc:
+                peers = list(pc.spec.peers)
+                lead = pc.leader_idx(timeout=20.0)
+                victim = [i for i in range(3) if i != lead][0]
+                with ApusClient(peers, history=rec) as w, \
+                        ApusClient([peers[victim]],
+                                   read_policy="spread",
+                                   history=rec, timeout=8.0) as fr:
+                    assert w.put(hot, b"old") == b"OK"
+                    assert w.put(cold, b"c0") == b"OK"
+                    # Warm the victim's lease on the COLD bucket only.
+                    deadline = time.monotonic() + 5.0
+                    while time.monotonic() < deadline:
+                        fr.get(cold)
+                        if (pc.status(victim) or {}).get(
+                                "flr_local_reads", 0) > 0:
+                            break
+                    assert (pc.status(victim) or {}).get(
+                        "flr_local_reads", 0) > 0, "lease never warmed"
+                    # Cut replication TO the victim (inbound dropped;
+                    # its own client connections stay up), commit a
+                    # newer hot value past it (bucket-disjoint, so
+                    # commit does not wait), then read HOT at the
+                    # victim under the plant.
+                    assert isolate(peers, victim)
+                    time.sleep(0.1)
+                    assert w.put(hot, b"new") == b"OK"
+                    got = fr.get(hot)
+                    heal_all(peers)
+        res = check_history(rec.events())
+        return got, res
+
+    got, res = run("bucket,expiry")
+    assert got == b"old", \
+        f"planted bucket bypass did NOT serve stale ({got!r})"
+    assert not res.ok, "checker ACCEPTED a planted bucket-bypass read"
+    assert res.violations[0].key == hot
+    # Control: expiry plant alone — the bucket check refuses the
+    # uncovered read, the client falls back to the leader, no stale.
+    got, res = run("expiry")
+    assert got == b"new", got
+    assert res.ok, res.describe()

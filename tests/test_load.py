@@ -1,0 +1,183 @@
+"""The open-loop SLO load harness primitives (ISSUE 15).
+
+Pure-math pins first — the deterministic seeded zipfian, the open-loop
+arrival schedule, and the coordinated-omission-safe latency accounting
+(p999 correct when the server stalls) — then one small live end-to-end
+run of the engine against a LocalCluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.load
+
+
+# -- zipfian ---------------------------------------------------------------
+
+def test_zipf_deterministic_and_in_range():
+    from apus_tpu.load.zipf import ZipfKeys
+
+    a = ZipfKeys(1000, theta=0.99, seed=7)
+    b = ZipfKeys(1000, theta=0.99, seed=7)
+    xs = [a.sample() for _ in range(2000)]
+    assert xs == [b.sample() for _ in range(2000)]
+    assert all(0 <= x < 1000 for x in xs)
+    c = ZipfKeys(1000, theta=0.99, seed=8)
+    assert xs != [c.sample() for _ in range(2000)]
+
+
+def test_zipf_skew_concentrates_mass():
+    """theta=0.99 concentrates far more mass on the hottest keys than
+    uniform; unscrambled rank 0 is the single hottest key."""
+    from collections import Counter
+
+    from apus_tpu.load.zipf import ZipfKeys
+
+    z = ZipfKeys(1000, theta=0.99, seed=1, scramble=False)
+    counts = Counter(z.sample() for _ in range(20000))
+    top10 = sum(n for _, n in counts.most_common(10)) / 20000
+    assert top10 > 0.30, top10          # uniform would give ~0.01
+    assert counts.most_common(1)[0][0] == 0
+    u = ZipfKeys(1000, theta=0.0, seed=1)
+    ucounts = Counter(u.sample() for _ in range(20000))
+    utop10 = sum(n for _, n in ucounts.most_common(10)) / 20000
+    assert utop10 < 0.05, utop10
+
+
+def test_zipf_scramble_spreads_hot_ranks():
+    """Scrambled mode maps the hot ranks to spread-out key indices
+    (different buckets), deterministically."""
+    from apus_tpu.load.zipf import ZipfKeys
+    from apus_tpu.runtime.router import bucket_of_key
+
+    z = ZipfKeys(1000, theta=0.99, seed=3, scramble=True)
+    hot = {z.sample() for _ in range(200)}
+    buckets = {bucket_of_key(b"lk%08d" % k) for k in hot}
+    assert len(buckets) > 10
+
+
+# -- schedules -------------------------------------------------------------
+
+def test_poisson_schedule_rate_and_determinism():
+    from apus_tpu.load.schedule import poisson_schedule
+
+    s = poisson_schedule(1000.0, 10.0, seed=42)
+    assert s == poisson_schedule(1000.0, 10.0, seed=42)
+    assert all(0 <= t < 10.0 for t in s)
+    assert s == sorted(s)
+    # ~N(10000, 100): 6 sigma.
+    assert 9400 < len(s) < 10600, len(s)
+
+
+def test_uniform_schedule_exact():
+    from apus_tpu.load.schedule import uniform_schedule
+
+    s = uniform_schedule(100.0, 2.0)
+    assert len(s) == 200
+    assert s[0] == 0.0
+    assert s[1] == pytest.approx(0.01)
+
+
+def test_burst_schedule_overlays_fan_in():
+    from apus_tpu.load.schedule import burst_schedule, uniform_schedule
+
+    base = uniform_schedule(10.0, 3.0)
+    s = burst_schedule(base, burst_every=1.0, burst_size=50,
+                       duration=3.0)
+    assert len(s) == len(base) + 2 * 50
+    assert s == sorted(s)
+    assert sum(1 for t in s if t == 1.0) >= 50
+
+
+# -- CO-safe latency accounting --------------------------------------------
+
+def test_latency_percentiles_basic():
+    from apus_tpu.load.latency import LatencyRecorder
+
+    rec = LatencyRecorder()
+    for i in range(1000):
+        rec.record(i * 0.001, i * 0.001 + 0.002)      # 2 ms each
+    rep = rec.report(1.0, slo_ms=50.0)
+    assert rep.ops == 1000 and rep.errors == 0
+    assert rep.p50_ms == pytest.approx(2.0, abs=0.01)
+    assert rep.p999_ms == pytest.approx(2.0, abs=0.01)
+    assert not rep.degraded_spans
+
+
+def test_latency_co_safe_p999_sees_a_server_stall():
+    """The defining property: 10s run at 1000 ops/s with a 500 ms
+    server stall in the middle.  Anchored at SCHEDULED arrivals, the
+    ~500 stalled arrivals surface as up-to-500 ms latencies and p99 >
+    100 ms; anchored at SEND time (the coordinated-omission mistake) a
+    closed-loop client would have measured ~2 ms for every op it
+    deigned to send."""
+    from apus_tpu.load.latency import LatencyRecorder
+
+    rec = LatencyRecorder()
+    naive = []
+    stall_at, stall = 5.0, 0.5
+    for i in range(10000):
+        t = i * 0.001
+        if t < stall_at or t >= stall_at + stall:
+            done = t + 0.002
+        else:
+            done = stall_at + stall + 0.002   # served when stall ends
+        rec.record(t, done)
+        naive.append(0.002)                   # send-anchored fiction
+    rep = rec.report(10.0, slo_ms=50.0, window_s=0.25)
+    assert rep.p999_ms > 400.0, rep.p999_ms
+    assert rep.p99_ms > 100.0, rep.p99_ms
+    assert rep.p50_ms < 10.0
+    assert max(naive) * 1e3 < 3        # the lie CO-safety prevents
+    # The degradation window localizes the stall.
+    assert rep.degraded_spans, "stall invisible in the windowed view"
+    lo, hi = rep.degraded_spans[0]
+    assert lo <= stall_at + 0.25 and hi >= stall_at + stall - 0.25
+    assert rep.degraded_s < 2.0
+
+
+def test_latency_censoring_counts_unresolved_tail():
+    from apus_tpu.load.latency import LatencyRecorder
+
+    rec = LatencyRecorder()
+    for i in range(99):
+        rec.record(i * 0.01, i * 0.01 + 0.001)
+    rec.censor(0.5, 2.0)                      # stuck >= 1.5 s at cutoff
+    rep = rec.report(1.0)
+    assert rep.censored == 1 and rep.errors == 1
+    assert rep.max_ms >= 1500.0
+
+
+# -- engine e2e (small, live) ----------------------------------------------
+
+def test_open_loop_engine_live_smoke():
+    """64 connections, 2 s, against a live 3-replica LocalCluster:
+    every op resolves (no censoring), spread GETs land on follower
+    leases, and the report carries sane percentiles."""
+    from apus_tpu.load import OpenLoopConfig, run_open_loop
+    from apus_tpu.runtime.cluster import LocalCluster
+    from apus_tpu.utils.config import ClusterSpec
+
+    spec = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                       elect_low=0.050, elect_high=0.150)
+    with LocalCluster(3, spec=spec) as c:
+        c.wait_for_leader(20.0)
+        cfg = OpenLoopConfig(
+            peers=list(c.spec.peers), connections=64, rate=300.0,
+            duration=2.0, seed=5, nkeys=500, theta=0.99,
+            get_fraction=0.8, churn_every=0.7, burst_every=0.9,
+            burst_size=30, slo_ms=200.0, grace=10.0)
+        rep, stats = run_open_loop(cfg)
+    assert rep.ops > 500
+    assert rep.censored == 0, (rep.to_dict(), stats)
+    assert rep.errors == 0, (rep.to_dict(), stats)
+    assert 0.0 < rep.p50_ms < 1000.0
+    assert rep.p999_ms >= rep.p99_ms >= rep.p50_ms
+    assert stats["churns"] >= 2

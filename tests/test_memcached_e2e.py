@@ -57,3 +57,20 @@ def test_memcached_replicates_to_followers():
             with McClient(pc.app_addr(i)) as c:
                 assert c.get("mk:0") == b"mv:0"
                 assert c.stat("curr_items") == 20
+
+
+def test_memcached_soak_smoke():
+    """soak.py --memcached (ISSUE 15 satellite): the memcached app
+    path as a first-class soak scenario axis — text-protocol set/get
+    through the interposer, GET-after-SET verified, convergence
+    checked; 0.15-minute smoke through one failover-free window."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "soak.py"),
+         "--memcached", "--minutes", "0.15", "--failover-every", "0"],
+        capture_output=True, timeout=420)
+    assert r.returncode == 0, (r.returncode,
+                               r.stdout[-1500:], r.stderr[-1500:])

@@ -272,3 +272,24 @@ def test_non_leader_refuses_misdirected_clients(tmp_path):
                 pass
             time.sleep(0.2)
         assert got == b"mv:0", got
+
+
+def test_redis_soak_txn_smoke():
+    """soak.py --txn at the REAL redis (PR 12's remaining arm, ISSUE
+    15 satellite): the RESP MULTI/EXEC + INCR transactional side
+    stream served by the UNMODIFIED redis binary under the interposer
+    — batch atomicity and strict INCR monotonicity verified by the
+    soak itself; 0.15-minute smoke."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "soak.py"),
+         "--txn", "--minutes", "0.15", "--failover-every", "0"],
+        capture_output=True, timeout=420)
+    assert r.returncode == 0, (r.returncode,
+                               r.stdout[-1500:], r.stderr[-1500:])
+    out = r.stdout.decode(errors="replace")
+    assert '"txn": {"rounds": ' in out, out[-800:]
+    assert '"app": "redis"' in out, out[-800:]

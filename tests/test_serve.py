@@ -1,0 +1,244 @@
+"""Protocol-aware app serving surface (runtime/serve.py, ISSUE 15).
+
+RESP and memcached-text GET/SET mapped onto the replicated KVS via the
+key->group router and follower read leases, with the opaque relay as
+the per-connection fallback for unrecognized commands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apus_tpu.utils.config import ClusterSpec  # noqa: E402
+
+pytestmark = pytest.mark.serve
+
+SPEC = ClusterSpec(hb_period=0.005, hb_timeout=0.030,
+                   elect_low=0.050, elect_high=0.150)
+
+
+def _gateway(cluster, **kw):
+    from apus_tpu.runtime.serve import AppServer
+    return AppServer(list(cluster.spec.peers),
+                     groups=getattr(cluster.spec, "groups", 1), **kw)
+
+
+def test_resp_command_set_over_kvs():
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        c.wait_for_leader(20.0)
+        with _gateway(c) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1])) as r:
+            assert r.cmd("PING") == "PONG"
+            assert r.cmd("SET", "sk", "v1") == "OK"
+            assert r.cmd("GET", "sk") == b"v1"
+            assert r.cmd("GET", "missing") is None
+            assert r.cmd("INCR", "ctr") == 1
+            assert r.cmd("INCR", "ctr") == 2
+            assert r.cmd("DECR", "ctr") == 1
+            assert r.cmd("SET", "a", "1") == "OK"
+            assert r.cmd("SET", "b", "2") == "OK"
+            assert r.cmd("MGET", "a", "b", "nope") == [b"1", b"2", None]
+            assert r.cmd("DEL", "a") == 1
+            assert r.cmd("GET", "a") is None
+            assert r.cmd("EXISTS", "b") == 1
+            assert r.cmd("ECHO", "hello") == b"hello"
+            assert r.cmd("SELECT", "0") == "OK"
+            assert gw.stats.get("app_resp_cmds", 0) >= 14
+            assert gw.stats.get("app_kvs_ops", 0) >= 10
+
+
+def test_resp_pipelined_burst_coalesces():
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        c.wait_for_leader(20.0)
+        with _gateway(c) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1])) as r:
+            cmds = []
+            for i in range(32):
+                cmds.append(("SET", "pk%d" % i, "pv%d" % i))
+            for i in range(32):
+                cmds.append(("GET", "pk%d" % i))
+            replies = r.pipeline_cmds(cmds)
+            assert replies[:32] == ["OK"] * 32
+            assert replies[32:] == [b"pv%d" % i for i in range(32)]
+
+
+def test_memcached_text_over_kvs():
+    from apus_tpu.runtime.appcluster import McClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        c.wait_for_leader(20.0)
+        with _gateway(c) as gw, \
+                McClient(("127.0.0.1", gw.addr[1])) as m:
+            assert m.set("mk", "mv") is True
+            assert m.get("mk") == b"mv"
+            assert m.get("absent") is None
+            assert gw.stats.get("app_mc_cmds", 0) >= 3
+            # incr via the raw socket (McClient lacks the helper).
+            m.sock.sendall(b"set n 0 0 1\r\n5\r\n")
+            assert m._line() == b"STORED"
+            m.sock.sendall(b"incr n 3\r\n")
+            assert m._line() == b"8"
+            m.sock.sendall(b"decr n 10\r\n")
+            assert m._line() == b"0"          # memcached floors at 0
+            m.sock.sendall(b"delete mk\r\n")
+            assert m._line() == b"DELETED"
+            assert m.get("mk") is None
+            m.sock.sendall(b"version\r\n")
+            assert m._line().startswith(b"VERSION")
+
+
+def test_gateway_reads_ride_follower_leases():
+    """Gateway GETs use read_policy='spread': followers serve them
+    from leases (counter-proven), linearizably (read-your-write
+    through the gateway)."""
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.client import probe_status
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        lead = c.wait_for_leader(20.0)
+        with _gateway(c) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1])) as r:
+            for i in range(6):
+                assert r.cmd("SET", "rw", "v%d" % i) == "OK"
+                assert r.cmd("GET", "rw") == b"v%d" % i
+            for _ in range(24):
+                assert r.cmd("GET", "rw") == b"v5"
+        flr = 0
+        for i, p in enumerate(c.spec.peers):
+            st = probe_status(p, timeout=2.0)
+            if st and i != lead.idx:
+                flr += st.get("flr_local_reads", 0)
+        assert flr > 0, "no gateway GET was served from a follower lease"
+
+
+def test_unknown_command_without_backend_is_typed_error():
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        c.wait_for_leader(20.0)
+        with _gateway(c) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1])) as r:
+            assert r.cmd("SET", "k", "v") == "OK"
+            with pytest.raises(RuntimeError):
+                r.cmd("LPUSH", "list", "x")   # unmapped -> typed error
+            # The connection stays protocol-aware afterwards.
+            assert r.cmd("GET", "k") == b"v"
+            assert gw.stats.get("app_errors", 0) >= 1
+
+
+def test_unknown_command_falls_back_to_opaque_relay():
+    """With a backend configured, the FIRST unmapped command flips the
+    connection to the transparent byte-stream relay (both directions),
+    and it stays opaque."""
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    # A tiny RESP-speaking stand-in for the interposed app.
+    seen: list = []
+
+    def app_thread(lsock):
+        conn, _ = lsock.accept()
+        conn.settimeout(5.0)
+        buf = b""
+        while True:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            seen.append(chunk)
+            while b"\r\n" in buf:
+                # Echo one +OK per complete command (commands here are
+                # single inline lines for test simplicity).
+                line, buf = buf.split(b"\r\n", 1)
+                if line:
+                    conn.sendall(b"+RELAYED:%s\r\n" % line.split()[0])
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    app_port = lsock.getsockname()[1]
+    t = threading.Thread(target=app_thread, args=(lsock,), daemon=True)
+    t.start()
+
+    with LocalCluster(3, spec=dataclasses.replace(SPEC)) as c:
+        c.wait_for_leader(20.0)
+        with _gateway(c, fallback=("127.0.0.1", app_port)) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1])) as r:
+            assert r.cmd("SET", "k", "v") == "OK"      # mapped: KVS
+            r.sock.sendall(b"LPUSH mylist x\r\n")      # unmapped
+            assert r._line() == b"+RELAYED:LPUSH"
+            # Sticky: mapped-looking commands now relay too.
+            r.sock.sendall(b"GET k\r\n")
+            assert r._line() == b"+RELAYED:GET"
+            assert gw.stats.get("app_fallback_conns", 0) == 1
+            assert b"LPUSH" in b"".join(seen)
+    lsock.close()
+
+
+def test_gateway_multi_group_routing():
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.cluster import LocalCluster
+
+    spec = dataclasses.replace(SPEC, groups=2)
+    with LocalCluster(3, spec=spec) as c:
+        c.wait_for_leader(20.0)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if all(any(d is not None and d.group_node(g) is not None
+                       and d.group_node(g).is_leader
+                       for d in c.daemons)
+                   for g in range(2)):
+                break
+            time.sleep(0.05)
+        with _gateway(c) as gw, \
+                RespClient(("127.0.0.1", gw.addr[1]),
+                           timeout=20.0) as r:
+            for i in range(24):
+                assert r.cmd("SET", "gk%d" % i, "gv%d" % i) == "OK"
+            for i in range(24):
+                assert r.cmd("GET", "gk%d" % i) == b"gv%d" % i
+
+
+def test_proccluster_serve_wiring_e2e():
+    """Deployment shape: ProcCluster(serve=True) runs a gateway inside
+    every daemon process (--serve-port); RESP app traffic at any
+    replica's gateway serves from the replicated KVS and survives a
+    leader change."""
+    import tempfile
+
+    from apus_tpu.runtime.appcluster import RespClient
+    from apus_tpu.runtime.proc import ProcCluster
+
+    with tempfile.TemporaryDirectory(prefix="apus-serve-proc") as td:
+        with ProcCluster(3, workdir=td, serve=True) as pc:
+            lead = pc.leader_idx(timeout=20.0)
+            other = [i for i in range(3) if i != lead][0]
+            # Gateways at BOTH a leader and a follower replica serve.
+            with RespClient(pc.serve_addr(lead), timeout=15.0) as r:
+                assert r.cmd("SET", "pk", "v1") == "OK"
+                assert r.cmd("GET", "pk") == b"v1"
+            with RespClient(pc.serve_addr(other), timeout=15.0) as r:
+                assert r.cmd("GET", "pk") == b"v1"
+                assert r.cmd("SET", "pk", "v2") == "OK"
+                assert r.cmd("GET", "pk") == b"v2"

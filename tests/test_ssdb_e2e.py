@@ -61,3 +61,20 @@ def test_ssdb_replicates_to_followers():
             assert last == b"sv:19", (i, last)
             with RespClient(pc.app_addr(i)) as c:
                 assert c.cmd("get", "sk:0") == b"sv:0"
+
+
+def test_ssdb_soak_smoke():
+    """soak.py --ssdb (ISSUE 15 satellite): the SSDB app path as a
+    soak scenario axis — RESP set/get through the interposer,
+    GET-after-SET verified, convergence checked; 0.15-minute smoke."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "soak.py"),
+         "--ssdb", "--minutes", "0.15", "--failover-every", "0"],
+        capture_output=True, timeout=420)
+    assert r.returncode == 0, (r.returncode,
+                               r.stdout[-1500:], r.stderr[-1500:])
