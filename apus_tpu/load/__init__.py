@@ -16,7 +16,11 @@ primitives plus one engine:
   p999 lie), plus p50/p99/p999 + windowed SLO-degradation reporting;
 - :mod:`apus_tpu.load.openloop` — the many-hundred-connection engine
   (non-blocking sockets, one selector loop) speaking the KVS client
-  wire or RESP at an app gateway, with seeded connection churn.
+  wire or RESP at an app gateway, with seeded connection churn;
+- :mod:`apus_tpu.load.ramp` — the overload campaigns on top: the
+  saturation staircase (find the goodput knee), the metastability
+  probe (overload hold + bounded-recovery verdict), and multi-process
+  load sharding with sample-level CO-safe merging.
 
 ``python -m apus_tpu.load --help`` runs it standalone; bench.py --slo
 is the banked entry point.
@@ -24,10 +28,13 @@ is the banked entry point.
 
 from apus_tpu.load.latency import LatencyRecorder, percentile
 from apus_tpu.load.openloop import OpenLoopConfig, run_open_loop
+from apus_tpu.load.ramp import (run_metastability, run_saturation_ramp,
+                                run_sharded)
 from apus_tpu.load.schedule import (burst_schedule, poisson_schedule,
                                     uniform_schedule)
 from apus_tpu.load.zipf import ZipfKeys
 
 __all__ = ["LatencyRecorder", "percentile", "OpenLoopConfig",
-           "run_open_loop", "poisson_schedule", "uniform_schedule",
+           "run_open_loop", "run_saturation_ramp", "run_metastability",
+           "run_sharded", "poisson_schedule", "uniform_schedule",
            "burst_schedule", "ZipfKeys"]

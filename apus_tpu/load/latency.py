@@ -38,7 +38,16 @@ class SloReport:
     p99_ms: float
     p999_ms: float
     max_ms: float
-    #: per-window rows: (window_start_s, ops, p99_ms, degraded)
+    #: typed load sheds (ST_OVERLOAD / -BUSY): deterministic refusals,
+    #: distinct from errors AND from censored ambiguity — a shed op
+    #: provably never applied, so it is not a correctness event, only
+    #: capacity the server declined.  Sheds never count toward latency
+    #: percentiles or degraded verdicts.
+    sheds: int = 0
+    #: ok-completions / duration — the saturation campaigns' knee axis
+    #: (achieved_rate counts errors and censored completions too).
+    goodput_rate: float = 0.0
+    #: per-window rows: (window_start_s, ops, p99_ms, degraded, sheds)
     windows: "list[tuple]" = dataclasses.field(default_factory=list)
     slo_ms: float = 0.0
     #: contiguous degraded spans [(start_s, end_s), ...] on the
@@ -61,14 +70,26 @@ class LatencyRecorder:
     def __init__(self) -> None:
         #: (scheduled_t, latency_s, ok) triples
         self.samples: "list[tuple[float, float, bool]]" = []
+        #: (scheduled_t, turnaround_s) for typed sheds — kept OUT of
+        #: ``samples`` so a shed can never inflate a latency percentile
+        #: or flip a window degraded (it is the server keeping its
+        #: tail honest, not missing it).
+        self.shed_samples: "list[tuple[float, float]]" = []
         self.errors = 0
         self.censored = 0
+        self.sheds = 0
 
     def record(self, sched_t: float, done_t: float,
                ok: bool = True) -> None:
         self.samples.append((sched_t, done_t - sched_t, ok))
         if not ok:
             self.errors += 1
+
+    def record_shed(self, sched_t: float, done_t: float) -> None:
+        """A typed overload refusal (ST_OVERLOAD / -BUSY): resolved,
+        never applied, classified apart from errors and censored."""
+        self.shed_samples.append((sched_t, done_t - sched_t))
+        self.sheds += 1
 
     def censor(self, sched_t: float, cutoff_t: float) -> None:
         """An op still unresolved at the run cutoff: latency >= the
@@ -84,30 +105,39 @@ class LatencyRecorder:
         n = len(lats)
         rep = SloReport(
             ops=n, errors=self.errors, censored=self.censored,
+            sheds=self.sheds,
             duration_s=duration_s,
             achieved_rate=(n / duration_s if duration_s > 0 else 0.0),
+            goodput_rate=((n - self.errors) / duration_s
+                          if duration_s > 0 else 0.0),
             p50_ms=percentile(lats, 0.50) * 1e3,
             p90_ms=percentile(lats, 0.90) * 1e3,
             p99_ms=percentile(lats, 0.99) * 1e3,
             p999_ms=percentile(lats, 0.999) * 1e3,
             max_ms=(lats[-1] * 1e3 if lats else 0.0),
             slo_ms=slo_ms)
-        if window_s <= 0 or not self.samples:
+        if window_s <= 0 or not (self.samples or self.shed_samples):
             return rep
         buckets: dict[int, list] = {}
         bad: dict[int, int] = {}
+        shed_w: dict[int, int] = {}
         for t, lat, ok in self.samples:
             w = int(t / window_s)
             buckets.setdefault(w, []).append(lat)
             if not ok:
                 bad[w] = bad.get(w, 0) + 1
+        for t, _ in self.shed_samples:
+            w = int(t / window_s)
+            buckets.setdefault(w, [])
+            shed_w[w] = shed_w.get(w, 0) + 1
         span_start = None
         prev_end = None
         for w in sorted(buckets):
             ls = sorted(buckets[w])
             p99 = percentile(ls, 0.99) * 1e3
             degraded = bool(bad.get(w)) or (slo_ms > 0 and p99 > slo_ms)
-            rep.windows.append((w * window_s, len(ls), p99, degraded))
+            rep.windows.append((w * window_s, len(ls), p99, degraded,
+                                shed_w.get(w, 0)))
             if degraded:
                 if span_start is None:
                     span_start = w * window_s

@@ -51,6 +51,7 @@ ST_NOT_LEADER = 4
 ST_TIMEOUT = 5
 ST_WRONG_GROUP = 8
 ST_MIGRATING = 9
+ST_OVERLOAD = 10
 OP_GROUP = 25
 
 _U32 = struct.Struct("<I")
@@ -147,7 +148,7 @@ class OpenLoopEngine:
         self.leaders: dict[int, Optional[int]] = {}
         self.stats = {"sent": 0, "retries": 0, "bounces": 0,
                       "reconnects": 0, "churns": 0, "conn_errors": 0,
-                      "wrong_group": 0}
+                      "wrong_group": 0, "sheds": 0}
         self._peer_slots: dict[int, list[int]] = {}
         self._rotors: dict[int, int] = {}
         self._read_rotor = 0
@@ -369,6 +370,18 @@ class OpenLoopEngine:
         if st == ST_MIGRATING:
             self._retry(op, now, move_peer=False)
             return
+        if st == ST_OVERLOAD:
+            # Typed shed: the server refused BEFORE admission, so the
+            # op provably never applied.  The open loop does NOT retry
+            # it — a retrying load generator silently converts refused
+            # load into MORE offered load (the metastable amplification
+            # these campaigns exist to measure).  Record the shed in
+            # its own bucket and keep the offered schedule honest.
+            op.done = True
+            self._resolved += 1
+            self.stats["sheds"] += 1
+            self.rec.record_shed(op.sched, now - self._t0)
+            return
         if st == ST_WRONG_GROUP:
             # Learn the owner gid from the bounce (offset 9: u8 owner
             # + shard-map blob) and re-route under a fresh identity
@@ -395,8 +408,14 @@ class OpenLoopEngine:
                 continue
             op.done = True
             self._resolved += 1
-            self.rec.record(op.sched, now - self._t0,
-                            ok=not reply.startswith(b"-"))
+            if reply.startswith(b"-BUSY"):
+                # Gateway-translated shed (runtime/serve.py): same
+                # typed-refusal classification as a KVS ST_OVERLOAD.
+                self.stats["sheds"] += 1
+                self.rec.record_shed(op.sched, now - self._t0)
+            else:
+                self.rec.record(op.sched, now - self._t0,
+                                ok=not reply.startswith(b"-"))
         # Replies with no waiter (post-reconnect stragglers): drop.
         if not slot.fifo and slot.inbuf:
             used = _resp_reply_len(slot.inbuf)
@@ -570,6 +589,25 @@ def main(argv=None) -> int:
     ap.add_argument("--burst-size", type=int, default=0)
     ap.add_argument("--churn-every", type=float, default=0.0)
     ap.add_argument("--slo-ms", type=float, default=50.0)
+    ap.add_argument("--procs", type=int, default=1,
+                    help="shard the offered load across N worker "
+                         "processes (samples merged into ONE CO-safe "
+                         "report)")
+    ap.add_argument("--mode", choices=("fixed", "ramp", "meta"),
+                    default="fixed",
+                    help="fixed = one run at --rate; ramp = "
+                         "saturation staircase; meta = metastability "
+                         "probe (step to --overload-x, step back)")
+    ap.add_argument("--ramp-step", type=float, default=0.0,
+                    help="ramp: rate increment per step "
+                         "(default --rate/2)")
+    ap.add_argument("--ramp-steps", type=int, default=6)
+    ap.add_argument("--step-duration", type=float, default=5.0)
+    ap.add_argument("--overload-x", type=float, default=5.0,
+                    help="meta: overload-hold multiplier over --rate")
+    ap.add_argument("--base-s", type=float, default=5.0)
+    ap.add_argument("--overload-s", type=float, default=5.0)
+    ap.add_argument("--recover-s", type=float, default=10.0)
     args = ap.parse_args(argv)
     cfg = OpenLoopConfig(
         peers=args.peers.split(","), connections=args.connections,
@@ -579,8 +617,41 @@ def main(argv=None) -> int:
         groups=args.groups, proto=args.proto, arrival=args.arrival,
         burst_every=args.burst_every, burst_size=args.burst_size,
         churn_every=args.churn_every, slo_ms=args.slo_ms)
-    rep, stats = run_open_loop(cfg)
-    print(json.dumps({"report": rep.to_dict(), "stats": stats},
+    repro = (f"python -m apus_tpu.load --peers {args.peers} "
+             f"--mode {args.mode} --rate {args.rate:g} "
+             f"--duration {args.duration:g} --procs {args.procs} "
+             f"--seed {args.seed} --proto {args.proto} "
+             f"--connections {args.connections}")
+    if args.mode == "ramp":
+        from apus_tpu.load.ramp import run_saturation_ramp
+        out = run_saturation_ramp(
+            cfg, start_rate=args.rate,
+            step_rate=(args.ramp_step or args.rate / 2),
+            steps=args.ramp_steps, step_duration=args.step_duration,
+            procs=args.procs, log=lambda m: print(m, flush=True))
+        out["repro"] = (f"{repro} --ramp-steps {args.ramp_steps} "
+                        f"--step-duration {args.step_duration:g}")
+        print(json.dumps(out, indent=2, default=str))
+        return 0 if out["total_censored"] == 0 else 1
+    if args.mode == "meta":
+        from apus_tpu.load.ramp import run_metastability
+        out = run_metastability(
+            cfg, overload_x=args.overload_x, base_s=args.base_s,
+            overload_s=args.overload_s, recover_s=args.recover_s,
+            log=lambda m: print(m, flush=True))
+        out["repro"] = (f"{repro} --overload-x {args.overload_x:g} "
+                        f"--base-s {args.base_s:g} --overload-s "
+                        f"{args.overload_s:g} --recover-s "
+                        f"{args.recover_s:g}")
+        print(json.dumps(out, indent=2, default=str))
+        return 0 if out["recovered"] and out["censored"] == 0 else 1
+    if args.procs > 1:
+        from apus_tpu.load.ramp import run_sharded
+        rep, stats = run_sharded(cfg, args.procs)
+    else:
+        rep, stats = run_open_loop(cfg)
+    print(json.dumps({"report": rep.to_dict(), "stats": stats,
+                      "repro": repro},
                      indent=2, default=str))
     return 0 if rep.censored == 0 else 1
 

@@ -1,0 +1,240 @@
+"""Saturation + metastability campaign drivers over the open-loop
+engine, plus multi-process load sharding.
+
+Three entry points, all built on :mod:`apus_tpu.load.openloop`:
+
+- :func:`run_sharded` — split one offered-load schedule across N
+  worker processes (fork), then merge every shard's raw samples into
+  ONE coordinated-omission-safe recorder before reporting.  A single
+  Python selector loop saturates around a few tens of thousands of
+  arrivals/s; finding a server's knee needs offered load past that,
+  and merging at the SAMPLE level (not averaging per-shard reports)
+  keeps the percentile math exact.
+
+- :func:`run_saturation_ramp` — the staircase: fixed-duration steps at
+  increasing offered rate until goodput (ok-completions/s) stops
+  tracking the offer.  The KNEE is the step with peak goodput; the
+  campaign's verdict is that past the knee the server sheds typed
+  refusals rather than stalling (`sheds` climbs, `censored` stays 0).
+
+- :func:`run_metastability` — the recovery probe: baseline at a
+  comfortable rate, step to a multiple of it (the overload hold),
+  step BACK to baseline, and measure how long the tail stays degraded
+  after the offer drops.  A metastable server (retry storms, queues
+  that never drain) stays degraded after the load is gone; a server
+  with admission control recovers within a bounded settle window.
+  One CONTINUOUS engine run — same sockets, same schedule axis — so
+  recovery is observed through the connections that lived the
+  overload, not through a fresh cohort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing
+
+from apus_tpu.load.latency import LatencyRecorder
+from apus_tpu.load.openloop import OpenLoopConfig, OpenLoopEngine
+from apus_tpu.load.schedule import poisson_schedule, uniform_schedule
+from apus_tpu.load.zipf import ZipfKeys
+
+
+# -- multi-process sharding -------------------------------------------
+
+
+def _shard_worker(cfg_kw: dict, idx: int, q) -> None:
+    """Top-level (picklable) shard body: run one engine, ship the RAW
+    samples back so the parent merges one CO-safe recorder."""
+    eng = OpenLoopEngine(OpenLoopConfig(**cfg_kw))
+    try:
+        _, stats = eng.run()
+    except Exception as e:                               # noqa: BLE001
+        q.put((idx, None, None, 0, {"shard_error": repr(e)}))
+        return
+    q.put((idx, eng.rec.samples, eng.rec.shed_samples,
+           eng.rec.censored, stats))
+
+
+def run_sharded(cfg: OpenLoopConfig, procs: int):
+    """Run ``cfg``'s offered load split across ``procs`` forked
+    workers; -> (SloReport, stats) merged at the sample level."""
+    if procs <= 1:
+        return OpenLoopEngine(cfg).run()
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    kids = []
+    for i in range(procs):
+        kw = dataclasses.asdict(cfg)
+        kw["rate"] = cfg.rate / procs
+        kw["connections"] = max(8, cfg.connections // procs)
+        kw["seed"] = cfg.seed + 7919 * (i + 1)   # distinct schedules
+        p = ctx.Process(target=_shard_worker, args=(kw, i, q),
+                        daemon=True)
+        p.start()
+        kids.append(p)
+    rec = LatencyRecorder()
+    stats: dict = {"procs": procs}
+    for _ in kids:
+        _, samples, sheds, censored, st = q.get()
+        if samples is None:
+            stats["shard_errors"] = stats.get("shard_errors", 0) + 1
+            stats.setdefault("shard_error", st.get("shard_error"))
+            continue
+        rec.samples.extend(samples)
+        rec.shed_samples.extend(sheds)
+        rec.censored += censored
+        rec.sheds += len(sheds)
+        for k, v in st.items():
+            stats[k] = stats.get(k, 0) + v
+    for p in kids:
+        p.join(timeout=10.0)
+    rec.errors = sum(1 for _, _, ok in rec.samples if not ok)
+    rep = rec.report(cfg.duration, slo_ms=cfg.slo_ms,
+                     window_s=cfg.window_s)
+    return rep, stats
+
+
+# -- saturation staircase ---------------------------------------------
+
+
+def run_saturation_ramp(cfg: OpenLoopConfig, start_rate: float,
+                        step_rate: float, steps: int,
+                        step_duration: float, procs: int = 1,
+                        log=None) -> dict:
+    """Staircase the offered rate and locate the goodput knee.
+
+    Each step is an independent run (fresh schedule, fresh sockets) at
+    ``start_rate + i*step_rate`` for ``step_duration`` seconds.  The
+    knee is the peak-goodput step; ``saturated`` is True once a later
+    step's goodput fell measurably below the peak OR typed sheds
+    appeared (the server is refusing load instead of queueing it).
+    """
+    rows = []
+    for i in range(max(1, steps)):
+        rate = start_rate + i * step_rate
+        c = dataclasses.replace(cfg, rate=rate, duration=step_duration,
+                                seed=cfg.seed + 31 * i)
+        rep, stats = run_sharded(c, procs)
+        row = {"offered_rate": rate,
+               "goodput_rate": rep.goodput_rate,
+               "achieved_rate": rep.achieved_rate,
+               "p50_ms": rep.p50_ms, "p99_ms": rep.p99_ms,
+               "sheds": rep.sheds, "errors": rep.errors,
+               "censored": rep.censored}
+        rows.append(row)
+        if log is not None:
+            log(f"[ramp] step {i}: offered {rate:.0f}/s -> goodput "
+                f"{rep.goodput_rate:.0f}/s p99 {rep.p99_ms:.1f}ms "
+                f"sheds {rep.sheds}")
+    best = max(rows, key=lambda r: r["goodput_rate"])
+    saturated = (rows[-1]["goodput_rate"] < 0.95 * best["goodput_rate"]
+                 or any(r["sheds"] > 0 for r in rows))
+    return {"steps": rows,
+            "knee_rate": best["offered_rate"],
+            "knee_goodput": best["goodput_rate"],
+            "saturated": saturated,
+            "total_sheds": sum(r["sheds"] for r in rows),
+            "total_censored": sum(r["censored"] for r in rows)}
+
+
+# -- metastability probe ----------------------------------------------
+
+
+class _PhasedEngine(OpenLoopEngine):
+    """OpenLoopEngine driven by an explicit arrival schedule (the
+    three-phase baseline/overload/recovery composite)."""
+
+    def __init__(self, cfg: OpenLoopConfig, sched: "list[float]"):
+        super().__init__(cfg)
+        self._sched = sched
+
+    def _plan(self):
+        cfg = self.cfg
+        zipf = ZipfKeys(cfg.nkeys, theta=cfg.theta, seed=cfg.seed,
+                        scramble=cfg.scramble, prefix=cfg.key_prefix)
+        if cfg.groups > 1:
+            from apus_tpu.runtime.router import group_of_key
+        from apus_tpu.load.openloop import _Op
+        ops = []
+        for t in self._sched:
+            key = zipf.key()
+            gid = (group_of_key(key, cfg.groups)
+                   if cfg.groups > 1 else 0)
+            ops.append(_Op(t, key, self._rng.random()
+                           < cfg.get_fraction, gid))
+        return ops
+
+
+def _phase_sched(rate: float, duration: float, seed: int,
+                 arrival: str, offset: float) -> "list[float]":
+    s = (uniform_schedule(rate, duration) if arrival == "uniform"
+         else poisson_schedule(rate, duration, seed=seed))
+    return [offset + t for t in s]
+
+
+def run_metastability(cfg: OpenLoopConfig, overload_x: float = 5.0,
+                      base_s: float = 5.0, overload_s: float = 5.0,
+                      recover_s: float = 10.0, log=None) -> dict:
+    """Step to ``overload_x`` times the baseline rate, step back, and
+    verify the tail recovers within a bounded settle window.
+
+    -> dict with per-phase goodput/p99, ``recovery_settle_s`` (time
+    from the step-down edge to the LAST degraded window), and
+    ``recovered`` (recovery-phase goodput back within 80% of baseline
+    and the run's final window clean).
+    """
+    total = base_s + overload_s + recover_s
+    sched = (_phase_sched(cfg.rate, base_s, cfg.seed, cfg.arrival, 0.0)
+             + _phase_sched(cfg.rate * overload_x, overload_s,
+                            cfg.seed + 1, cfg.arrival, base_s)
+             + _phase_sched(cfg.rate, recover_s, cfg.seed + 2,
+                            cfg.arrival, base_s + overload_s))
+    c = dataclasses.replace(cfg, duration=total)
+    eng = _PhasedEngine(c, sched)
+    rep, stats = eng.run()
+    edges = (base_s, base_s + overload_s)
+
+    def phase_of(t: float) -> int:
+        return 0 if t < edges[0] else (1 if t < edges[1] else 2)
+
+    ok_by = [0, 0, 0]
+    lat_by: "list[list[float]]" = [[], [], []]
+    for t, lat, ok in eng.rec.samples:
+        p = phase_of(t)
+        lat_by[p].append(lat)
+        if ok:
+            ok_by[p] += 1
+    shed_by = [0, 0, 0]
+    for t, _ in eng.rec.shed_samples:
+        shed_by[phase_of(t)] += 1
+    from apus_tpu.load.latency import percentile
+    spans = [base_s, overload_s, recover_s]
+    phases = []
+    for p, name in enumerate(("baseline", "overload", "recovery")):
+        ls = sorted(lat_by[p])
+        phases.append({"phase": name,
+                       "offered_rate": (cfg.rate * overload_x
+                                        if p == 1 else cfg.rate),
+                       "goodput_rate": ok_by[p] / spans[p],
+                       "p99_ms": percentile(ls, 0.99) * 1e3,
+                       "sheds": shed_by[p]})
+    # Settle time: the last degraded window at-or-after the step-down
+    # edge bounds how long the overload's wake lasted.
+    settle = 0.0
+    for row in rep.windows:
+        if row[0] >= edges[1] - 1e-9 and row[3]:
+            settle = max(settle, row[0] + cfg.window_s - edges[1])
+    last_clean = not (rep.windows and rep.windows[-1][3])
+    base_good, rec_good = phases[0]["goodput_rate"], \
+        phases[2]["goodput_rate"]
+    recovered = (rec_good >= 0.8 * base_good and last_clean)
+    out = {"phases": phases, "overload_x": overload_x,
+           "recovery_settle_s": settle, "recovered": recovered,
+           "censored": rep.censored, "sheds": rep.sheds,
+           "report": rep.to_dict(), "stats": stats}
+    if log is not None:
+        log(f"[meta] baseline {base_good:.0f}/s -> overload x"
+            f"{overload_x:g} (sheds {phases[1]['sheds']}) -> recovery "
+            f"{rec_good:.0f}/s, settle {settle:.2f}s, "
+            f"recovered={recovered}")
+    return out

@@ -105,6 +105,13 @@ COUNTERS: dict[str, str] = {
     "srv_ingest_batches": "multi-frame bursts drained off one connection",
     "srv_ingest_frames": "frames ingested through burst drains",
     "srv_ingest_solo": "single-frame (non-burst) requests served",
+    # Overload control plane (runtime/overload.py policy, enforced in
+    # parallel/net.py admission + the group-commit drain deadline
+    # check): typed ST_OVERLOAD sheds, classified by cause.
+    "srv_ovl_admitted": "client ops admitted through the overload gate",
+    "srv_ovl_shed_global": "client ops shed: global in-flight budget full",
+    "srv_ovl_shed_conn": "client ops shed: per-connection budget full",
+    "srv_ovl_shed_deadline": "client ops shed at the drain: client deadline already expired",
     # Native serving data plane, Python-side events (parallel/
     # native_plane.py; the C loop's own counters are the srv_native_*
     # GAUGES below, mirrored at scrape time).
@@ -124,6 +131,7 @@ COUNTERS: dict[str, str] = {
     "srv_app_errors": "protocol errors answered (unmapped, no relay backend)",
     "srv_app_fallback_conns": "connections flipped to the opaque relay fallback",
     "srv_app_fallback_bytes": "bytes carried through the opaque relay fallback",
+    "srv_app_busy_replies": "app bursts answered protocol-native busy (cluster shed, retry budget dry)",
     # -- dev_*: device-plane engine (runtime/device_plane.py runner;
     #    process-wide registry merged into every replica's scrape) ----
     "dev_rounds": "device commit rounds executed",
@@ -178,6 +186,7 @@ GAUGES: dict[str, str] = {
     "srv_native_gil_released_ns": "native loop busy time (all of it GIL-free), ns",
     "srv_native_gate_misses": "GETs that fell to Python on a closed read gate",
     "srv_native_view_poisons": "applied views the native side marked stale",
+    "srv_native_sheds": "client frames the native loop shed pre-GIL (ST_OVERLOAD, budget full)",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -223,4 +232,5 @@ FLIGHT_CATEGORIES: dict[str, str] = {
     "elastic": "elastic-group migrations: begin/capture/committed edges",
     "txn": "cross-group transactions: begin/resumed/decided/closed edges",
     "native": "native data plane activation / loud fallback edges",
+    "overload": "shed-burst edges: first shed after an admitted span (reason + queue depth)",
 }

@@ -46,6 +46,7 @@ import importlib.machinery
 import importlib.util
 import os
 import threading
+import time
 from typing import Optional
 
 _EXT = None
@@ -129,6 +130,15 @@ class NativePlaneService:
                           and not getattr(daemon, "read_svc", 0.0))
         self.plane = ext.Plane(max_burst=PeerServer.MAX_BURST,
                                dedup=dedup)
+        # Native admission mirror (ISSUE 17): the C++ ingest loop
+        # counts in-flight client frames and sheds typed ST_OVERLOAD
+        # replies BEFORE crossing the GIL once the budget is hit —
+        # same bytes as runtime.overload.shed_reply (the equivalence
+        # tape pins it).  hasattr-guarded so an older .so still loads.
+        ovl = getattr(daemon, "overload", None)
+        if ovl is not None and hasattr(self.plane, "set_overload"):
+            self.plane.set_overload(ovl.max_native_inflight,
+                                    ovl.retry_after_ms)
         self._workers: list[threading.Thread] = []
         self._nworkers = workers if workers is not None else int(
             os.environ.get("APUS_NATIVE_WORKERS", "16"))
@@ -264,6 +274,11 @@ class NativePlaneService:
         order)."""
         from apus_tpu.parallel import wire
         daemon = self.daemon
+        # Arrival stamp for the drain's deadline shed (ISSUE 17): the
+        # node-lock wait from HERE counts against the client deadline
+        # (the native in-flight budget bounds queueing before this
+        # point, so worker-pull time is the dominant seam).
+        arrival = time.monotonic()
         parsed_batches = [(bid, items) for bid, p, items in merged if p]
         raw_batches = [(bid, items) for bid, p, items in merged
                        if not p]
@@ -276,7 +291,8 @@ class NativePlaneService:
             for _bid, items in parsed_batches:
                 all_items.extend(items)
             try:
-                replies = daemon.server.batch_hook.run_parsed(all_items)
+                replies = daemon.server.batch_hook.run_parsed(
+                    all_items, arrival)
             except Exception:
                 daemon.logger.exception("native-plane batch failed")
                 self.stats.bump("native_errors")

@@ -41,6 +41,35 @@ from apus_tpu.obs.metrics import MetricsRegistry
 from apus_tpu.parallel import onesided, wire
 from apus_tpu.parallel.transport import (LogState, Region, Transport,
                                          WriteResult)
+#: Client DATA ops — the only frames admission budgets ever count or
+#: shed.  Everything else (HB/vote/lease/CONFIG/snapshot/peer region
+#: ops) bypasses the gate: strict priority for control traffic, so
+#: overload can never burn a leadership.
+_CLIENT_OPS = frozenset((16, 17))          # OP_CLT_WRITE / OP_CLT_READ
+
+
+def _is_client_frame(f: bytes) -> bool:
+    if not f:
+        return False
+    if f[0] == wire.OP_GROUP:
+        return len(f) >= 3 and f[2] in _CLIENT_OPS
+    return f[0] in _CLIENT_OPS
+
+
+def _shed_frame_reply(f: bytes, retry_ms: int) -> bytes:
+    """Typed ST_OVERLOAD reply for a client frame refused admission
+    (echoes the req_id so reply pairing survives, exactly like every
+    other typed refusal)."""
+    # Late import: runtime/__init__ imports the daemon which imports
+    # this module — at module-import time runtime.overload is not yet
+    # reachable.  After first use this is one sys.modules lookup, and
+    # it only sits on the shed path.
+    from apus_tpu.runtime.overload import shed_reply as _shed_reply
+    off = 3 if f[0] == wire.OP_GROUP else 1
+    req_id = (int.from_bytes(f[off:off + 8], "little")
+              if len(f) >= off + 8 else 0)
+    return _shed_reply(req_id, retry_ms)
+
 
 _ST_OF_RESULT = {WriteResult.OK: wire.ST_OK,
                  WriteResult.DROPPED: wire.ST_DROPPED,
@@ -96,6 +125,12 @@ class PeerServer:
         #: never return to this thread; peer/control connections stay
         #: here.  None (default) = the pure-Python plane, unchanged.
         self.native_plane = None
+        #: Overload control plane (runtime.overload.OverloadPolicy),
+        #: installed by the daemon: bounded global + per-connection
+        #: in-flight budgets for client DATA ops, typed ST_OVERLOAD
+        #: sheds for the excess.  Control frames bypass the gate
+        #: entirely (strict priority).  None = admission unlimited.
+        self.overload = None
         self._stop = threading.Event()
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
@@ -203,24 +238,17 @@ class PeerServer:
                 eof = stream.at_eof
                 if len(batch) == 1:
                     self.stats.bump("ingest_solo")
-                    conn.sendall(wire.frame(self._dispatch(req)))
                 else:
                     self.stats.bump("ingest_batches")
                     self.stats.bump("ingest_frames", len(batch))
-                    replies = None
-                    hook = self.batch_hook
-                    if hook is not None:
-                        try:
-                            replies = hook(batch)
-                        except Exception:
-                            if self._logger is not None:
-                                self._logger.exception("batch hook failed")
-                            replies = None
-                    if replies is None:
-                        # Sequential fallback preserves request order —
-                        # the contract peer-transport exchanges rely on.
-                        replies = [self._dispatch(b) for b in batch]
-                    wire.send_frames(conn, replies)
+                ov = self.overload
+                if ov is None:
+                    if len(batch) == 1:
+                        conn.sendall(wire.frame(self._dispatch(req)))
+                    else:
+                        wire.send_frames(conn, self._run_burst(batch))
+                else:
+                    self._serve_gated(conn, batch, ov)
                 if eof:
                     return
         except (OSError, ConnectionError, ValueError):
@@ -232,6 +260,74 @@ class PeerServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _run_burst(self, batch: list) -> list:
+        replies = None
+        hook = self.batch_hook
+        if hook is not None:
+            try:
+                replies = hook(batch)
+            except Exception:
+                if self._logger is not None:
+                    self._logger.exception("batch hook failed")
+                replies = None
+        if replies is None:
+            # Sequential fallback preserves request order —
+            # the contract peer-transport exchanges rely on.
+            replies = [self._dispatch(b) for b in batch]
+        return replies
+
+    def _serve_gated(self, conn: socket.socket, batch: list,
+                     ov) -> None:
+        """Admission-controlled reply path: client DATA frames pass the
+        per-connection burst cap, then the global in-flight gate, in
+        arrival order (FIFO prefix); the excess is answered with a
+        typed ST_OVERLOAD shed WITHOUT ever reaching the consensus
+        engine — a shed op is provably never appended, so exactly-once
+        and the audit plane's ambiguity rules are untouched.  Control
+        frames (everything non-client: HB/vote/lease/CONFIG/snapshot/
+        region ops) are never counted or shed — strict priority, so
+        overload cannot burn a leadership."""
+        n = len(batch)
+        replies: list = [None] * n
+        clients = [i for i in range(n) if _is_client_frame(batch[i])]
+        keep = min(len(clients), ov.max_per_conn)
+        granted = ov.gate.acquire(keep) if keep else 0
+        try:
+            if granted < len(clients):
+                shed_g = clients[granted:keep]      # global budget
+                shed_c = clients[keep:]             # per-conn cap
+                if shed_g:
+                    ov.on_shed("global", len(shed_g))
+                if shed_c:
+                    ov.on_shed("conn", len(shed_c))
+                for i in shed_g:
+                    replies[i] = _shed_frame_reply(batch[i],
+                                                   ov.retry_after_ms)
+                for i in shed_c:
+                    replies[i] = _shed_frame_reply(batch[i],
+                                                   ov.retry_after_ms)
+            if granted:
+                ov.on_admitted(granted)
+            live = [i for i in range(n) if replies[i] is None]
+            if len(live) == n:
+                out = (self._run_burst(batch) if n > 1
+                       else [self._dispatch(batch[0])])
+            elif live:
+                frames = [batch[i] for i in live]
+                out = (self._run_burst(frames) if len(frames) > 1
+                       else [self._dispatch(frames[0])])
+            else:
+                out = []
+            for i, rep in zip(live, out):
+                replies[i] = rep
+            if n == 1:
+                conn.sendall(wire.frame(replies[0]))
+            else:
+                wire.send_frames(conn, replies)
+        finally:
+            if granted:
+                ov.gate.release(granted)
 
     def _dispatch(self, req: bytes) -> bytes:
         r = wire.Reader(req)

@@ -48,6 +48,16 @@ ST_TIMEOUT = 5
 ST_WRONG_GROUP = 8
 ST_MIGRATING = 9
 
+# Typed overload shed (ISSUE 17, runtime/overload.py): the op was
+# REFUSED admission before touching any log — a deterministic refusal
+# like WRONG_GROUP, retry-safe under the SAME req_id (nothing was
+# submitted, so exactly-once cannot double-apply).  The reply body
+# carries a u32 LE retry-after hint in milliseconds.
+from apus_tpu.runtime.overload import (ST_OVERLOAD,  # noqa: E402
+                                       CircuitBreaker, Overloaded,
+                                       RetryBudget, backoff_s,
+                                       parse_retry_after, shed_reply)
+
 
 def _elastic_bounce(daemon, node, req_id: int, verdict) -> bytes:
     """Typed elastic bounce reply (caller holds the daemon lock)."""
@@ -455,6 +465,13 @@ def make_client_ops(daemon, node=None) -> dict:
             # over the wire instead of poking daemon internals.
             if getattr(daemon, "native", None) is not None:
                 st["native_plane"] = daemon.native.status_view()
+            # Overload control plane (ISSUE 17): budgets, live/peak
+            # queue depth, shed-by-reason counters with the native
+            # plane's shed mirror folded in — the failure-dump and
+            # saturation-campaign assertion surface.
+            ovl = getattr(daemon, "overload", None)
+            if ovl is not None:
+                st["overload"] = ovl.status(st.get("native_plane"))
             # Misdirection-gate observability (bridged replicas): how
             # many non-leader client reads the proxy refused.
             refusals = getattr(daemon, "misdirect_refusals", None)
@@ -533,6 +550,7 @@ def make_client_batch_hook(daemon):
         # One lock acquisition and one commit-wait loop still cover
         # the WHOLE burst, so the leader's group-commit drain
         # amortizes across every group with queued ops.
+        arrival = time.monotonic()
         parsed = []
         for f in frames:
             r = wire.Reader(f)
@@ -544,18 +562,18 @@ def make_client_batch_hook(daemon):
             if op not in (OP_CLT_WRITE, OP_CLT_READ):
                 return None
             parsed.append((op, r.u64(), r.u64(), r.blob(), gid))
-        return run(parsed)
+        return run(parsed, arrival)
 
-    def run_parsed(items):
+    def run_parsed(items, arrival=None):
         """Native-plane entry (parallel.native_plane): the C++ ingest
         loop hands bursts PRE-PARSED — ``(gid, op, req_id, clt_id,
         data)`` with the payload slices already cut — so admission
         skips the Python wire re-parse entirely.  Same admission, same
         replies, byte-identical wire behavior."""
         return run([(op, rid, cid, data, gid)
-                    for gid, op, rid, cid, data in items])
+                    for gid, op, rid, cid, data in items], arrival)
 
-    def run(parsed):
+    def run(parsed, arrival=None):
         nodes = [daemon.group_node(g) for (_o, _r, _c, _d, g) in parsed]
         handles: list = [None] * len(parsed)
         registered = [False] * len(parsed)
@@ -611,6 +629,22 @@ def make_client_batch_hook(daemon):
 
         replies: list = [None] * len(parsed)
         with daemon.lock:
+            # Deadline-aware shed at the group-commit drain (ISSUE 17):
+            # the burst queued so long for the node lock that its
+            # client deadline already expired — submitting it would
+            # burn replication rounds on replies nobody will read,
+            # exactly the work amplification that makes overload
+            # metastable.  Dropped BEFORE admission: nothing entered
+            # any log, so exactly-once and the audit plane's ambiguity
+            # rules are untouched (the typed shed is a deterministic
+            # refusal; the client retries under the same req_id).
+            ovl = getattr(daemon, "overload", None)
+            if ovl is not None and arrival is not None \
+                    and ovl.deadline_s > 0 \
+                    and time.monotonic() - arrival >= ovl.deadline_s:
+                ovl.on_shed("deadline", len(parsed))
+                return [shed_reply(p[1], ovl.retry_after_ms)
+                        for p in parsed]
             if traced:
                 t_lock = sp.now()
                 for i in traced:
@@ -890,7 +924,11 @@ class ApusClient:
                  timeout: float = 5.0, attempt_timeout: float = 2.0,
                  history=None, tracer=None,
                  read_policy: str = "leader", groups: int = 1,
-                 wrong_group_refuses: bool = False):
+                 wrong_group_refuses: bool = False,
+                 retry_budget_rate: float = 10.0,
+                 retry_budget_burst: int = 20,
+                 breaker_threshold: int = 8,
+                 breaker_cooloff: float = 1.0):
         self.peers = [self._parse(p) for p in peers]
         #: Multi-group routing (Multi-Raft): KVS ops hash their key to
         #: one of ``groups`` consensus groups (runtime/router.py) and
@@ -970,8 +1008,22 @@ class ApusClient:
         # ingested in ~one recv.
         self._streams: dict[tuple, wire.FrameStream] = {}
         #: client-side fault observability (stale_replies = discarded
-        #: duplicated/reordered reply frames)
+        #: duplicated/reordered reply frames; sheds / retry_budget_denied
+        #: / breaker_fastfail = the overload cooperation half)
         self.stats: dict[str, int] = {}
+        # Overload cooperation (ISSUE 17): per-PEER retry budgets
+        # (token bucket — retries against an overloaded peer cannot
+        # amplify offered load) and per-peer circuit breakers (a run of
+        # consecutive sheds fails fast, typed, for a cooloff window).
+        # Seeded RNG so chaos campaigns replay the backoff schedule.
+        self._rb_rate = retry_budget_rate
+        self._rb_burst = retry_budget_burst
+        self._br_threshold = breaker_threshold
+        self._br_cooloff = breaker_cooloff
+        self._budgets: dict[int, RetryBudget] = {}
+        self._breakers: dict[int, CircuitBreaker] = {}
+        import random as _random
+        self._ovl_rng = _random.Random(self.clt_id & 0xFFFFFFFF)
 
     @staticmethod
     def _parse(addr: str) -> tuple[str, int]:
@@ -1209,6 +1261,7 @@ class ApusClient:
         if target is None:
             target = self._gleader(gid)
         pending = items
+        ovl_attempt = 0
         while pending:
             if time.monotonic() >= deadline:
                 raise TimeoutError(
@@ -1223,7 +1276,18 @@ class ApusClient:
                 learn_leader=not spread, gid=gid)
             pending = [it for it in pending if it[1] not in results
                        and it[1] not in self._regroup_ids]
-            if outcome == "migrating":
+            if outcome == "overload":
+                # Sheds in the burst: budgeted, jittered backoff, then
+                # retry the unresolved tail at the SAME target under
+                # the SAME req_ids; an exhausted budget surfaces typed.
+                ovl_attempt += 1
+                if not self._shed_retry_wait(target, ovl_attempt,
+                                             hint, deadline):
+                    raise Overloaded(
+                        f"{len(pending)} pipelined ops (group {gid}) "
+                        f"shed by peer {target} "
+                        f"(retry budget exhausted)", hint)
+            elif outcome == "migrating":
                 time.sleep(0.02)         # freeze window; same target
             elif outcome == "hint":
                 target = self._peer_index(hint) if hint \
@@ -1267,6 +1331,8 @@ class ApusClient:
         queue = list(items)
         inflight: dict[int, tuple] = {}
         migrating = False
+        shed_ms = None
+        any_ok = False
         try:
             while queue or inflight:
                 if queue and len(inflight) < window:
@@ -1297,6 +1363,7 @@ class ApusClient:
                 if st == wire.ST_OK:
                     if learn_leader:
                         self._set_gleader(gid, target)
+                    any_ok = True
                     val = wire.Reader(resp[9:]).blob()
                     # Cross-group re-dispatches resolve under their
                     # ORIGINAL req_id too (the caller's op order and
@@ -1313,6 +1380,12 @@ class ApusClient:
                         self.tracer.stamp(self.clt_id, rid,
                                           "client_reply")
                         self.tracer.finish(self.clt_id, rid)
+                elif st == ST_OVERLOAD:
+                    # Typed shed: leave unresolved (deterministic —
+                    # nothing applied; the caller's budgeted backoff
+                    # retries it under the SAME req_id).
+                    shed_ms = self._on_shed(target, resp)
+                    del inflight[rid]
                 elif st == ST_MIGRATING:
                     # Bucket frozen mid-migration: leave unresolved;
                     # the caller retries this target after a short
@@ -1344,6 +1417,13 @@ class ApusClient:
                     return "rotate", None
                 else:
                     raise RuntimeError(f"server error (status {st})")
+            if any_ok:
+                # The peer is (partially) serving: reset the breaker's
+                # consecutive-shed count — it must only trip on a peer
+                # shedding EVERYTHING.
+                self._breaker(target).record_ok()
+            if shed_ms is not None:
+                return "overload", shed_ms
             return ("migrating" if migrating else "ok"), None
         except (OSError, ConnectionError, ValueError):
             self._drop(target, gid)
@@ -1574,11 +1654,29 @@ class ApusClient:
         target = self._spread_target() if spread else self._gleader(gid)
         if target is None:
             target = self._gleader(gid)
+        ovl_attempt = 0
+        fastfails = 0
         while time.monotonic() < deadline:
             if target is None:
                 target = self._probe_any(deadline, gid)
                 if target is None:
                     continue
+            br = self._breaker(target)
+            if not br.allow():
+                # Breaker open for this peer: fail fast off the wire.
+                # Rotate WITHOUT clearing the cached leader (the peer
+                # is overloaded, not deposed); if every peer's breaker
+                # is open, surface the typed refusal instead of
+                # spinning until the deadline.
+                self.stats["breaker_fastfail"] = \
+                    self.stats.get("breaker_fastfail", 0) + 1
+                fastfails += 1
+                if fastfails >= max(4, 2 * len(self.peers)):
+                    raise Overloaded(
+                        f"request {req_id}: circuit open to all peers")
+                target = (target + 1) % len(self.peers)
+                time.sleep(0.005)
+                continue
             resp = self._roundtrip(target, payload, deadline, req_id,
                                    gid)
             if resp is None:
@@ -1592,7 +1690,22 @@ class ApusClient:
             if st == wire.ST_OK:
                 if not spread:
                     self._set_gleader(gid, target)
+                br.record_ok()
                 return wire.Reader(resp[9:]).blob()
+            if st == ST_OVERLOAD:
+                # Typed shed: deterministic refusal, nothing applied —
+                # retry the SAME target under the SAME req_id after a
+                # budgeted, jittered backoff honoring the server's
+                # retry-after hint.  An exhausted budget raises typed
+                # (Overloaded) instead of amplifying offered load.
+                retry_ms = self._on_shed(target, resp)
+                ovl_attempt += 1
+                if not self._shed_retry_wait(target, ovl_attempt,
+                                             retry_ms, deadline):
+                    raise Overloaded(
+                        f"request {req_id} shed by peer {target} "
+                        f"(retry budget exhausted)", retry_ms)
+                continue
             if st == ST_NOT_LEADER:
                 hint = wire.Reader(resp[9:]).blob().decode() if \
                     len(resp) > 9 else ""
@@ -1652,6 +1765,51 @@ class ApusClient:
                 continue
             raise RuntimeError(f"server error (status {st})")
         raise TimeoutError(f"request {req_id} not served in {self.timeout}s")
+
+    def _budget(self, target: int) -> RetryBudget:
+        b = self._budgets.get(target)
+        if b is None:
+            b = self._budgets[target] = RetryBudget(self._rb_rate,
+                                                    self._rb_burst)
+        return b
+
+    def _breaker(self, target: int) -> CircuitBreaker:
+        b = self._breakers.get(target)
+        if b is None:
+            b = self._breakers[target] = CircuitBreaker(
+                self._br_threshold, self._br_cooloff)
+        return b
+
+    def breaker_view(self) -> dict:
+        """Per-peer breaker/budget snapshot (failure dumps attach this
+        beside the server-side overload view)."""
+        return {t: {**self._breakers[t].snapshot(),
+                    "budget_tokens": round(self._budget(t).tokens, 1),
+                    "budget_denied": self._budget(t).denied}
+                for t in sorted(self._breakers)}
+
+    def _on_shed(self, target: int, resp: bytes) -> int:
+        """Account one typed shed from ``target``; returns the
+        server's retry-after hint (ms)."""
+        self.stats["sheds"] = self.stats.get("sheds", 0) + 1
+        self._breaker(target).record_shed()
+        return parse_retry_after(resp)
+
+    def _shed_retry_wait(self, target: int, attempt: int,
+                         retry_ms: int, deadline: float) -> bool:
+        """Spend one retry-budget token and sleep the jittered backoff;
+        False (caller raises Overloaded) when the budget is empty or
+        the deadline cannot absorb the wait — the amplification
+        brake."""
+        if not self._budget(target).try_spend():
+            self.stats["retry_budget_denied"] = \
+                self.stats.get("retry_budget_denied", 0) + 1
+            return False
+        wait = backoff_s(attempt, retry_ms, self._ovl_rng.random())
+        if time.monotonic() + wait >= deadline:
+            return False
+        time.sleep(wait)
+        return True
 
     def _peer_index(self, addr: str) -> int:
         """Index of ``addr`` in our peer list, learning it if new."""

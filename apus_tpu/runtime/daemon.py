@@ -260,6 +260,21 @@ class ReplicaDaemon:
         from apus_tpu.runtime.client import make_client_batch_hook
         self.server.batch_hook = make_client_batch_hook(self)
 
+        # Overload control plane (ISSUE 17; runtime/overload.py):
+        # bounded in-flight budgets + typed ST_OVERLOAD shedding for
+        # client data ops, enforced at the PeerServer ingest, the
+        # group-commit drain (deadline sheds), and — when enabled —
+        # natively in the C++ plane.  Budgets default generous (normal
+        # workloads never trip them); APUS_OVL_* shrinks them for
+        # saturation campaigns.  Control traffic NEVER passes through
+        # the gate: overload cannot burn a leadership.
+        from apus_tpu.runtime.overload import OverloadPolicy
+        self.overload = OverloadPolicy.from_env(
+            self.client_op_timeout,
+            stats=self.obs.view("srv") if self.obs is not None else None,
+            flight=self.obs.flight if self.obs is not None else None)
+        self.server.overload = self.overload
+
         # Committed-entry observers (proxy callback table analog):
         # each gets (LogEntry); registered by persistence/replay layers.
         self.on_commit: list[Callable[[LogEntry], None]] = []

@@ -1,0 +1,355 @@
+"""Overload control plane (ISSUE 17): typed admission, backpressure,
+and load shedding across the serving stack.
+
+Every queue in the serving path used to be effectively unbounded, so
+the first honest ramp past saturation produced the classic collapse:
+queues eat memory, latency blows past client deadlines, retries
+amplify offered load, and control traffic (HB/vote/lease) queues
+behind client bursts until a pure-overload condition burns a
+leadership.  This module makes overload a CONTROLLED, OBSERVABLE,
+TYPED condition instead:
+
+- ``ST_OVERLOAD`` — a typed wire status (value 10 in the client-op
+  status namespace, next free after WRONG_GROUP=8/MIGRATING=9).  A
+  shed reply carries a retry-after hint (u32 LE milliseconds in the
+  standard blob body) and is emitted BEFORE admission: a shed op is
+  provably never submitted to any log, so exactly-once and the audit
+  plane's ambiguity taxonomy are untouched (a shed is a deterministic
+  refusal, like WRONG_GROUP — not an ambiguous timeout).
+- :class:`AdmissionGate` — the server-side bounded in-flight budget
+  (global + per-connection), consulted by PeerServer's ingest path
+  and mirrored natively by ``native/dataplane.cpp`` (which counts
+  in-flight frames and sheds before crossing the GIL).
+- :class:`OverloadPolicy` — the per-daemon knob bundle (env-tunable:
+  ``APUS_OVL_*``), including the deadline-aware shed at the
+  group-commit drain (ops whose client deadline already expired by
+  the time the burst wins the node lock are dropped pre-admission).
+- :class:`RetryBudget` (token bucket) + :class:`CircuitBreaker` —
+  the client-side cooperation half: retries against an overloaded
+  peer are budgeted so retry amplification cannot multiply offered
+  load, and a run of consecutive sheds trips a breaker that fails
+  fast (typed) for a cooloff window instead of hammering the peer.
+
+Strict control-traffic priority is enforced at the call sites: only
+client data ops (OP_CLT_WRITE/OP_CLT_READ, bare or OP_GROUP-wrapped)
+are ever counted against budgets or shed — HB/vote/lease/CONFIG/
+snapshot frames bypass the gate entirely, so overload can never
+starve the consensus plane of its own control messages.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+#: Typed shed status, client-op namespace (NOT_LEADER=4, TIMEOUT=5,
+#: WRONG_GROUP=8, MIGRATING=9 are taken; 10 is the next free value).
+#: Mirrored in native/dataplane.cpp and apus_tpu/load/openloop.py.
+ST_OVERLOAD = 10
+
+#: Default retry-after hint carried by shed replies (milliseconds).
+DEFAULT_RETRY_AFTER_MS = 50
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def shed_reply(req_id: int, retry_after_ms: int = DEFAULT_RETRY_AFTER_MS
+               ) -> bytes:
+    """The canonical shed reply: ``u8 ST_OVERLOAD | u64 req_id |
+    u32 4 | u32 retry_after_ms``.  native/dataplane.cpp builds the
+    SAME bytes (the cross-impl equivalence tape pins it)."""
+    return (bytes([ST_OVERLOAD]) + _U64.pack(req_id)
+            + _U32.pack(4) + _U32.pack(max(0, int(retry_after_ms))))
+
+
+def parse_retry_after(resp: bytes) -> int:
+    """Retry-after hint (ms) from a shed reply; the default when the
+    body is absent/short (forward compat)."""
+    if len(resp) >= 17:
+        n = _U32.unpack_from(resp, 9)[0]
+        if n >= 4 and len(resp) >= 13 + 4:
+            return _U32.unpack_from(resp, 13)[0]
+    return DEFAULT_RETRY_AFTER_MS
+
+
+class Overloaded(TimeoutError):
+    """Raised by ApusClient when an op was typed-shed and the retry
+    budget/breaker refuses further attempts.  Subclasses TimeoutError
+    so existing deadline handlers keep working; carries the server's
+    retry-after hint for gateways that propagate backpressure."""
+
+    def __init__(self, msg: str,
+                 retry_after_ms: int = DEFAULT_RETRY_AFTER_MS):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class AdmissionGate:
+    """Bounded global in-flight budget for client data ops.
+
+    ``acquire(want)`` grants admission for the FIFO prefix of a burst
+    (0..want ops); the caller sheds the remainder with typed replies
+    and MUST ``release(granted)`` once the admitted ops have replied.
+    ``max_inflight <= 0`` disables the global bound (the gate still
+    tracks in-flight for the queue-depth gauge)."""
+
+    def __init__(self, max_inflight: int = 0):
+        self.max_inflight = max_inflight
+        self._mu = threading.Lock()
+        self._inflight = 0
+        #: High-water mark since last scrape (queue-depth evidence in
+        #: failure dumps even when the scrape races the burst).
+        self.peak_inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def acquire(self, want: int) -> int:
+        if want <= 0:
+            return 0
+        with self._mu:
+            if self.max_inflight > 0:
+                room = self.max_inflight - self._inflight
+                granted = max(0, min(want, room))
+            else:
+                granted = want
+            self._inflight += granted
+            if self._inflight > self.peak_inflight:
+                self.peak_inflight = self._inflight
+            return granted
+
+    def release(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._mu:
+            self._inflight = max(0, self._inflight - n)
+
+
+class OverloadPolicy:
+    """Per-daemon overload knobs + counters (one instance per daemon,
+    shared by PeerServer, the group-commit drain, and the native
+    plane's Python glue).
+
+    Budgets default generous — normal workloads never trip them —
+    and every knob is env-tunable so chaos campaigns can shrink them:
+
+    - ``APUS_OVL_MAX_INFLIGHT``  global admitted client ops (def 4096)
+    - ``APUS_OVL_MAX_PER_CONN``  per-connection burst budget (def 256)
+    - ``APUS_OVL_MAX_NATIVE``    native-plane in-flight frames budget
+                                 (def = global budget)
+    - ``APUS_OVL_DEADLINE_S``    drain-shed deadline (def = the
+                                 daemon's client_op_timeout; <=0 off)
+    - ``APUS_OVL_RETRY_MS``      retry-after hint (def 50)
+    """
+
+    def __init__(self, max_inflight: int = 4096, max_per_conn: int = 256,
+                 max_native_inflight: int = 0, deadline_s: float = 5.0,
+                 retry_after_ms: int = DEFAULT_RETRY_AFTER_MS,
+                 stats=None, flight=None):
+        self.gate = AdmissionGate(max_inflight)
+        self.max_per_conn = max_per_conn
+        self.max_native_inflight = (max_native_inflight
+                                    if max_native_inflight > 0
+                                    else max_inflight)
+        self.deadline_s = deadline_s
+        self.retry_after_ms = retry_after_ms
+        #: srv_* metrics view (daemon installs its ObsHub view; a bare
+        #: policy counts locally so tests need no hub).
+        self.stats = stats
+        self.flight = flight
+        self._mu = threading.Lock()
+        self.admitted = 0
+        self.shed_global = 0
+        self.shed_conn = 0
+        self.shed_deadline = 0
+        self._shed_note_edge = False
+
+    @classmethod
+    def from_env(cls, client_op_timeout: float = 5.0, stats=None,
+                 flight=None) -> "OverloadPolicy":
+        def _i(name, dflt):
+            try:
+                return int(os.environ.get(name, dflt))
+            except ValueError:
+                return dflt
+
+        def _f(name, dflt):
+            try:
+                return float(os.environ.get(name, dflt))
+            except ValueError:
+                return dflt
+
+        return cls(
+            max_inflight=_i("APUS_OVL_MAX_INFLIGHT", 4096),
+            max_per_conn=_i("APUS_OVL_MAX_PER_CONN", 256),
+            max_native_inflight=_i("APUS_OVL_MAX_NATIVE", 0),
+            deadline_s=_f("APUS_OVL_DEADLINE_S", client_op_timeout),
+            retry_after_ms=_i("APUS_OVL_RETRY_MS",
+                              DEFAULT_RETRY_AFTER_MS),
+            stats=stats, flight=flight)
+
+    # -- accounting --------------------------------------------------------
+
+    def on_admitted(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._mu:
+            self.admitted += n
+            self._shed_note_edge = False
+        if self.stats is not None:
+            self.stats.bump("ovl_admitted", n)
+
+    def _note_shed(self, reason: str, n: int) -> None:
+        """Flight-ring note, edge-triggered: the FIRST shed of a burst
+        episode is recorded (with the queue depth beside it), then the
+        edge re-arms on the next successful admission — a sustained
+        shed storm is one note, not a ring flood."""
+        if self.flight is None:
+            return
+        with self._mu:
+            if self._shed_note_edge:
+                return
+            self._shed_note_edge = True
+        try:
+            self.flight.note("overload", "shed", reason=reason, n=n,
+                             inflight=self.gate.inflight)
+        except Exception:                                 # noqa: BLE001
+            pass
+
+    def on_shed(self, reason: str, n: int) -> None:
+        if n <= 0:
+            return
+        with self._mu:
+            if reason == "conn":
+                self.shed_conn += n
+            elif reason == "deadline":
+                self.shed_deadline += n
+            else:
+                self.shed_global += n
+        if self.stats is not None:
+            self.stats.bump(f"ovl_shed_{reason}", n)
+        self._note_shed(reason, n)
+
+    def status(self, native_counters: "dict | None" = None) -> dict:
+        """The OP_STATUS / failure-dump view: budgets, queue depth,
+        shed-by-reason counters, native mirror."""
+        d = {"max_inflight": self.gate.max_inflight,
+             "max_per_conn": self.max_per_conn,
+             "deadline_s": self.deadline_s,
+             "retry_after_ms": self.retry_after_ms,
+             "inflight": self.gate.inflight,
+             "peak_inflight": self.gate.peak_inflight,
+             "admitted": self.admitted,
+             "shed_global": self.shed_global,
+             "shed_conn": self.shed_conn,
+             "shed_deadline": self.shed_deadline}
+        if native_counters:
+            d["shed_native"] = int(native_counters.get("sheds", 0))
+        d["shed_total"] = (d["shed_global"] + d["shed_conn"]
+                           + d["shed_deadline"]
+                           + d.get("shed_native", 0))
+        return d
+
+
+class RetryBudget:
+    """Per-peer client retry token bucket: ``rate`` tokens/s up to
+    ``burst``.  A retry against an overloaded peer spends one token;
+    an empty bucket means the client STOPS retrying (typed Overloaded
+    to the caller) instead of amplifying offered load — the
+    metastable-failure signature this PR exists to disprove."""
+
+    def __init__(self, rate: float = 10.0, burst: int = 20):
+        self.rate = rate
+        self.burst = max(1, burst)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+        self.denied = 0
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(float(self.burst),
+                               self._tokens + (now - self._last)
+                               * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            self.denied += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._mu:
+            return self._tokens
+
+
+class CircuitBreaker:
+    """Consecutive-shed circuit breaker: ``threshold`` sheds in a row
+    open the breaker for ``cooloff_s``; while open, calls fail fast
+    (typed) without touching the wire.  After the cooloff ONE probe is
+    allowed through (half-open); success closes, another shed re-opens
+    with the cooloff re-armed."""
+
+    def __init__(self, threshold: int = 8, cooloff_s: float = 1.0):
+        self.threshold = max(1, threshold)
+        self.cooloff_s = cooloff_s
+        self._mu = threading.Lock()
+        self._fails = 0
+        self._open_until = 0.0
+        self._half_open = False
+        self.trips = 0
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            if self._open_until <= 0:
+                return "closed"
+            if time.monotonic() < self._open_until:
+                return "open"
+            return "half-open"
+
+    def allow(self) -> bool:
+        with self._mu:
+            if self._open_until <= 0:
+                return True
+            now = time.monotonic()
+            if now < self._open_until:
+                return False
+            if self._half_open:
+                return False          # one probe already in flight
+            self._half_open = True
+            return True
+
+    def record_ok(self) -> None:
+        with self._mu:
+            self._fails = 0
+            self._open_until = 0.0
+            self._half_open = False
+
+    def record_shed(self) -> None:
+        with self._mu:
+            self._fails += 1
+            if self._half_open or self._fails >= self.threshold:
+                self._open_until = time.monotonic() + self.cooloff_s
+                self._half_open = False
+                self._fails = 0
+                self.trips += 1
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "trips": self.trips}
+
+
+def backoff_s(attempt: int, retry_after_ms: int, rng_u: float,
+              cap_s: float = 1.0) -> float:
+    """Jittered exponential backoff honoring the server hint: base is
+    the retry-after, doubled per attempt, full jitter in [0.5, 1.5),
+    capped.  ``rng_u`` is a uniform [0,1) draw (caller owns the RNG so
+    seeded harnesses stay deterministic)."""
+    base = max(0.001, retry_after_ms / 1000.0)
+    return min(cap_s, base * (1 << min(attempt, 8))) * (0.5 + rng_u)

@@ -46,6 +46,7 @@ from apus_tpu.models.kvs import (encode_delete, encode_get, encode_incr,
                                  encode_put)
 from apus_tpu.obs.metrics import bump as _bump
 from apus_tpu.runtime.client import OP_CLT_READ, OP_CLT_WRITE, ApusClient
+from apus_tpu.runtime.overload import Overloaded
 
 _NOT_NUM = b"!notint"
 
@@ -264,7 +265,29 @@ class AppServer:
                 plan.append(self._plan_resp(clt, c[1], ops))
             else:
                 plan.append(self._plan_mc(clt, c[1], c[2], c[3], ops))
-        results = clt.pipeline(ops) if ops else []
+        try:
+            results = clt.pipeline(ops) if ops else []
+        except Overloaded:
+            # Cluster shed the burst and the client's retry budget ran
+            # dry: answer a typed protocol-native busy per pending
+            # command instead of a silent stall.  Local commands
+            # (PING, version...) still answer normally; memcached
+            # ``noreply`` stays silent.
+            _bump(self.stats, "app_busy_replies")
+            out = []
+            close = False
+            for c, p in zip(cmds, plan):
+                if callable(p[0]):
+                    if not (c[0] == "mc" and c[3]):
+                        out.append(b"-BUSY busy try again later\r\n"
+                                   if c[0] == "resp"
+                                   else b"SERVER_ERROR busy\r\n")
+                elif p[0]:
+                    out.append(p[0])
+                if len(p) > 1 and p[1]:
+                    close = True
+                    break
+            return out, close
         _bump(self.stats, "app_kvs_ops", len(ops))
         out: "list[bytes]" = []
         close = False

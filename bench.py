@@ -2117,8 +2117,8 @@ def _bench_slo() -> None:
 
     def slim(rep):
         d = rep.to_dict()
-        d["windows"] = [(round(t, 2), n, round(p, 2), bad)
-                        for t, n, p, bad in d["windows"]]
+        d["windows"] = [(round(t, 2), n, round(p, 2), bad, sheds)
+                        for t, n, p, bad, sheds in d["windows"]]
         return d
 
     with tempfile.TemporaryDirectory(prefix="apus-slo") as td:
@@ -2195,6 +2195,166 @@ def _bench_slo() -> None:
                      "churn + fan-in bursts with a mid-run leader "
                      "SIGKILL + restart; degraded_spans quantifies "
                      "the SLO outage window."),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
+def _bench_overload() -> None:
+    """--overload mode (ISSUE 17): the overload-control headline.
+
+    Three phases against one live 3-replica ProcCluster with SHRUNK
+    admission budgets (so saturation is reachable in seconds on this
+    1-core box — the gating RULES under test are size-independent):
+
+    1. saturation ramp: staircase the offered rate and locate the
+       goodput knee; past the knee the servers must REFUSE load with
+       typed sheds, never ambiguous timeouts (0 censored);
+    2. metastability probe: step to ~5x the knee and back — goodput
+       under overload must hold >= ~70% of the knee (no congestion
+       collapse) and the tail must settle within a bounded window
+       after the step-down (no metastable wake);
+    3. chaos: the same flood composed with a mid-run leader SIGKILL +
+       restart — the degraded window is compared against the clean
+       serving baseline (PR 15 banked 5.5 s for the un-floodeed kill).
+
+    Env knobs: APUS_OVL_CONNS (64), APUS_OVL_START/STEP (300/300
+    ops/s), APUS_OVL_STEPS (6), APUS_OVL_STEP_S (4), APUS_OVL_X (5),
+    plus the admission budgets APUS_OVL_MAX_INFLIGHT (64) /
+    APUS_OVL_MAX_PER_CONN (16) / APUS_OVL_RETRY_MS (25) exported to
+    the daemons before spawn."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    from apus_tpu.load import (OpenLoopConfig, run_metastability,
+                               run_open_loop, run_saturation_ramp)
+    from apus_tpu.runtime.proc import ProcCluster
+    from apus_tpu.utils.config import ClusterSpec
+
+    conns = int(os.environ.get("APUS_OVL_CONNS", "64"))
+    start = float(os.environ.get("APUS_OVL_START", "300"))
+    step = float(os.environ.get("APUS_OVL_STEP", "300"))
+    steps = int(os.environ.get("APUS_OVL_STEPS", "6"))
+    step_s = float(os.environ.get("APUS_OVL_STEP_S", "4"))
+    over_x = float(os.environ.get("APUS_OVL_X", "5"))
+    # Shrunk admission budgets (children inherit os.environ).
+    os.environ.setdefault("APUS_OVL_MAX_INFLIGHT", "64")
+    os.environ.setdefault("APUS_OVL_MAX_PER_CONN", "16")
+    os.environ.setdefault("APUS_OVL_RETRY_MS", "25")
+    budgets = {k: os.environ[k] for k in
+               ("APUS_OVL_MAX_INFLIGHT", "APUS_OVL_MAX_PER_CONN",
+                "APUS_OVL_RETRY_MS")}
+
+    # PROXIED envelope (same rationale as --perkey / overload_smoke):
+    # GIL-starved daemons flap leaders at PROC_SPEC's 10 ms election
+    # timeout under a flood, which would measure timer tightness, not
+    # the admission gates.
+    spec = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                       elect_low=0.150, elect_high=0.400)
+
+    def cfg(peers, seed, rate):
+        return OpenLoopConfig(
+            peers=peers, connections=conns, rate=rate, duration=step_s,
+            seed=seed, nkeys=4096, theta=0.0, get_fraction=0.5,
+            value_size=64, slo_ms=200.0, window_s=0.5, grace=10.0)
+
+    def slim(d):
+        d = dict(d)
+        d["windows"] = [(round(t, 2), n, round(p, 2), bad, sheds)
+                        for t, n, p, bad, sheds in d["windows"]]
+        return d
+
+    with tempfile.TemporaryDirectory(prefix="apus-ovl") as td:
+        with ProcCluster(3, workdir=td, spec=spec) as pc:
+            pc.leader_idx(timeout=30.0)
+            peers = [p for p in pc.spec.peers if p]
+
+            _mark(f"overload: saturation ramp ({start:.0f}/s + "
+                  f"{steps}x{step:.0f}/s, {step_s:.0f}s steps)")
+            ramp = run_saturation_ramp(
+                cfg(peers, seed=1701, rate=start), start, step, steps,
+                step_s, log=_mark)
+
+            base = max(start, ramp["knee_rate"] * 0.5)
+            _mark(f"overload: metastability probe (base {base:.0f}/s "
+                  f"-> x{over_x:g} -> back)")
+            meta = run_metastability(
+                cfg(peers, seed=1777, rate=base), overload_x=over_x,
+                base_s=4.0, overload_s=4.0, recover_s=8.0, log=_mark)
+            meta_slim = dict(meta)
+            meta_slim["report"] = slim(meta["report"])
+
+            _mark("overload: chaos run (busy load + leader kill "
+                  "mid-run)")
+            # Sustainable-but-busy (half the knee) at the SAME window
+            # SLO the PR 15 serving baseline used (400 ms): the
+            # degraded window then ISOLATES the kill and is directly
+            # comparable to that banked 5.5 s; past-knee behavior is
+            # the metastability probe's job.
+            chaos_rate = ramp["knee_goodput"] * 0.5
+            chaos_s = 12.0
+            kill_log: dict = {}
+
+            def nemesis():
+                time.sleep(chaos_s * 0.4)
+                try:
+                    lead = pc.leader_idx(timeout=5.0)
+                except AssertionError:
+                    return
+                kill_log["killed"] = lead
+                kill_log["t_kill_s"] = round(chaos_s * 0.4, 2)
+                pc.kill(lead)
+                time.sleep(2.0)
+                try:
+                    pc.restart(lead)
+                    kill_log["restarted"] = True
+                except AssertionError:
+                    kill_log["restarted"] = False
+
+            ccfg = cfg(peers, seed=1801, rate=chaos_rate)
+            ccfg = dataclasses.replace(ccfg, duration=chaos_s,
+                                       grace=20.0, slo_ms=400.0)
+            nt = threading.Thread(target=nemesis, daemon=True)
+            nt.start()
+            chaos_rep, chaos_stats = run_open_loop(ccfg)
+            nt.join(timeout=30.0)
+
+            srv = {"admitted": 0, "shed_total": 0}
+            for i in range(3):
+                st = pc.status(i, timeout=1.0) or {}
+                ov = st.get("overload") or {}
+                srv["admitted"] += ov.get("admitted", 0) or 0
+                srv["shed_total"] += ov.get("shed_total", 0) or 0
+
+    chaos = slim(chaos_rep.to_dict())
+    good5x = next(p["goodput_rate"] for p in meta["phases"]
+                  if p["phase"] == "overload")
+    result = {
+        "metric": "overload_knee_goodput",
+        "value": round(ramp["knee_goodput"], 1),
+        "unit": "ops/s (peak goodput at the saturation knee, "
+                "CO-safe)",
+        "vs_baseline": round(good5x / max(ramp["knee_goodput"], 1e-9),
+                             3),
+        "detail": {
+            "mode": "overload", "connections": conns,
+            "admission_budgets": budgets,
+            "ramp": ramp,
+            "goodput_under_overload_x": round(good5x, 1),
+            "meta": meta_slim,
+            "chaos": {"rate_ops_s": chaos_rate, "report": chaos,
+                      "stats": chaos_stats, "nemesis": kill_log,
+                      "degraded_s": chaos["degraded_s"],
+                      "degraded_spans": chaos["degraded_spans"],
+                      "pr15_clean_kill_window_s": 5.5},
+            "server_overload": srv,
+            "note": ("vs_baseline = goodput under the ~5x overload "
+                     "step relative to the knee (>= ~0.7 means no "
+                     "congestion collapse).  Sheds are typed "
+                     "ST_OVERLOAD refusals counted OUTSIDE the "
+                     "latency percentiles; censored==0 everywhere "
+                     "means no op ever died an ambiguous timeout."),
         },
     }
     print(json.dumps(result), flush=True)
@@ -2336,6 +2496,21 @@ def main() -> None:
                 "metric": "open_loop_slo_get_set_p99",
                 "value": None, "unit": "ms", "vs_baseline": 0.0,
                 "detail": {"mode": "slo", "error": repr(e)},
+            }), flush=True)
+        return
+    if "--overload" in sys.argv[1:]:
+        # Overload control plane campaign (ISSUE 17): saturation ramp
+        # to the goodput knee, ~5x metastability probe, and the flood
+        # composed with a mid-run leader kill.
+        try:
+            _bench_overload()
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "metric": "overload_knee_goodput",
+                "value": None, "unit": "ops/s", "vs_baseline": 0.0,
+                "detail": {"mode": "overload", "error": repr(e)},
             }), flush=True)
         return
     if "--txn" in sys.argv[1:]:

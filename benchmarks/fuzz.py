@@ -333,6 +333,11 @@ def _collect_obs(pc) -> list:
                 # prepared participants, lock counts) travel with the
                 # failure dump beside the groups/router views.
                 d["txns"] = st.get("txns")
+            if st.get("overload") is not None:
+                # Admission-plane view (queue depth, peak in-flight,
+                # shed-by-reason counters): an overload-composed
+                # failure's dump shows how hard the gates were working.
+                d["overload"] = st.get("overload")
             out.append(d)
         return out
     except Exception:                                 # noqa: BLE001
@@ -605,6 +610,54 @@ def _txn_roll(c, wrng, tkeys, wid: int, seq: list) -> None:
     c.txn(subs)
 
 
+def _overload_sweep(pc) -> dict:
+    """Sum the overload-control-plane state over live replicas
+    (coverage evidence: an --overload trial that shed nothing never
+    saturated the admission gate; the per-reason split and peak
+    in-flight travel with failure dumps)."""
+    out = {"ovl_admitted": 0, "ovl_shed_global": 0,
+           "ovl_shed_conn": 0, "ovl_shed_deadline": 0,
+           "ovl_shed_native": 0, "ovl_shed_total": 0,
+           "ovl_peak_inflight": 0}
+    for i in range(len(pc.procs)):
+        if pc.procs[i] is None:
+            continue
+        st = pc.status(i, timeout=0.5)
+        ov = (st or {}).get("overload") or {}
+        out["ovl_admitted"] += ov.get("admitted", 0) or 0
+        out["ovl_shed_global"] += ov.get("shed_global", 0) or 0
+        out["ovl_shed_conn"] += ov.get("shed_conn", 0) or 0
+        out["ovl_shed_deadline"] += ov.get("shed_deadline", 0) or 0
+        out["ovl_shed_native"] += ov.get("shed_native", 0) or 0
+        out["ovl_shed_total"] += ov.get("shed_total", 0) or 0
+        out["ovl_peak_inflight"] = max(out["ovl_peak_inflight"],
+                                       ov.get("peak_inflight", 0) or 0)
+    return out
+
+
+def _overload_flood(peers: list, groups: int, duration: float,
+                    seed: int, out: dict) -> None:
+    """The overload nemesis' flood body (runs in a thread): an
+    open-loop burst well past the shrunk admission budgets, on a key
+    prefix DISJOINT from the recorded workers' — the flood pressures
+    the gates, the audited history stays the linearizability
+    subject.  Sheds are typed refusals the flood does NOT retry."""
+    from apus_tpu.load.openloop import OpenLoopConfig, OpenLoopEngine
+    cfg = OpenLoopConfig(
+        peers=list(peers), connections=32, rate=6000.0,
+        duration=duration, seed=seed, nkeys=64, theta=0.0,
+        get_fraction=0.2, value_size=64, groups=groups,
+        key_prefix=b"ov", slo_ms=0.0, grace=2.0, max_attempts=4,
+        burst_every=0.5, burst_size=512)
+    try:
+        rep, stats = OpenLoopEngine(cfg).run()
+    except Exception as e:                               # noqa: BLE001
+        out["flood_error"] = repr(e)
+        return
+    out.update({"flood_sheds": stats.get("sheds", 0),
+                "flood_ops": rep.ops, "flood_censored": rep.censored})
+
+
 def _check_linear_resolving(recorder, stats: dict):
     """Shared campaign verdict: full check, then the UNDECIDED keys
     retried offline with a 16x search budget — undecided is a missing
@@ -717,7 +770,8 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
                        dump_obs: "str | None" = None,
                        time_nemesis: bool = False,
                        groups: int = 1,
-                       txn: bool = False) -> dict:
+                       txn: bool = False,
+                       overload: bool = False) -> dict:
     """One CONSISTENCY-AUDIT chaos trial on the deployment shape: a
     3-replica ProcCluster with the live fault plane, concurrent client
     workers (serial AND pipelined paths) recording every op's
@@ -822,20 +876,33 @@ def run_audit_schedule(fault_seed: int, minutes: float = 0.0,
         # death between PREPARE and DECIDED must be resumed, never
         # wedge or double-apply).
         os.environ["APUS_TXN_PREP_HOLD"] = "0.05"
+    if overload:
+        # Shrink the admission budgets so the flood saturates the
+        # gate at harness-sized load (ProcCluster children inherit
+        # the env; the recorded workers ride the same shrunk gates).
+        os.environ["APUS_OVL_MAX_INFLIGHT"] = "64"
+        os.environ["APUS_OVL_MAX_PER_CONN"] = "32"
+        os.environ["APUS_OVL_RETRY_MS"] = "10"
     try:
         return _run_audit_body(
             fault_seed, minutes, dump_obs, time_nemesis, groups, txn,
             rng, spec, keys, tkeys, recorder, stop, n_workers,
-            nemesis, worker, obs_dumps, armed_persist_fault, _dbg)
+            nemesis, worker, obs_dumps, armed_persist_fault, _dbg,
+            overload=overload)
     finally:
         if txn:
             os.environ.pop("APUS_TXN_PREP_HOLD", None)
+        if overload:
+            for k in ("APUS_OVL_MAX_INFLIGHT", "APUS_OVL_MAX_PER_CONN",
+                      "APUS_OVL_RETRY_MS"):
+                os.environ.pop(k, None)
 
 
 def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
                     groups, txn, rng, spec, keys, tkeys, recorder,
                     stop, n_workers, nemesis, worker, obs_dumps,
-                    armed_persist_fault, _dbg) -> dict:
+                    armed_persist_fault, _dbg,
+                    overload: bool = False) -> dict:
     import tempfile
     import threading
     import time as _time
@@ -899,6 +966,22 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
                 _pause_round(pc, rng, nemesis)
                 _dbg(f"pause round done ({nemesis['pauses']})")
 
+            # --overload: start the saturating flood BEFORE the leader
+            # kill so the kill lands mid-overload — the composed claim
+            # is that shedding under election churn still never loses
+            # an acked write (flood keys are disjoint; the recorded
+            # history stays the linearizability subject).
+            flood_out: dict = {}
+            flood_t = None
+            if overload:
+                flood_t = threading.Thread(
+                    target=_overload_flood,
+                    args=(peers, groups, 4.0, fault_seed, flood_out),
+                    daemon=True)
+                flood_t.start()
+                _time.sleep(0.8)          # let the flood bite first
+                _dbg("overload flood armed")
+
             # Phase 2: leader SIGKILL mid-group-commit, restart with a
             # seeded disk fault on the recovery path.  Multi-group:
             # the nemesis picks its VICTIM GROUP seeded and kills THAT
@@ -915,6 +998,9 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
                 kill_restart(pc.leader_idx(timeout=15.0))
             _dbg("phase2 leader kill/restart done")
             _time.sleep(rng.uniform(1.0, 2.0))
+            if flood_t is not None:
+                flood_t.join(timeout=20.0)
+                _dbg(f"flood done: {flood_out}")
             if time_nemesis and rng.random() < 0.7:
                 _pause_round(pc, rng, nemesis)
 
@@ -949,6 +1035,7 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
             _dbg("converged")
             flr = _flr_sweep(pc) if time_nemesis else {}
             native_sw = _native_sweep(pc) if _native_armed() else {}
+            ovl_sw = _overload_sweep(pc) if overload else {}
             # Final read round: with these in the history, a lost acked
             # write is a linearizability violation too.  Under the time
             # nemesis it runs SPREAD, so the final reads exercise the
@@ -975,7 +1062,7 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
                               if e["status"] != "ok"),
              "recorded": len(recorder.events()),
              "obs_events": _obs_event_count(obs_dumps),
-             **nemesis, **flr, **txn_stats}
+             **nemesis, **flr, **txn_stats, **ovl_sw, **flood_out}
     if groups > 1 and gview is not None:
         stats["groups"] = groups
         stats["group_terms"] = {g: v["term"] for g, v in gview.items()}
@@ -1006,6 +1093,15 @@ def _run_audit_body(fault_seed, minutes, dump_obs, time_nemesis,
             f"subject")
     _assert_native_coverage(native_sw, f"audit-{fault_seed}")
     stats.update(native_sw)
+    if overload and not (stats.get("ovl_shed_total")
+                         or stats.get("flood_sheds")):
+        # Coverage pin: an --overload trial that never shed one op
+        # never saturated the admission gate — the campaign did not
+        # exercise its subject.
+        raise AssertionError(
+            f"overload trial observed 0 typed sheds "
+            f"(sweep: {ovl_sw}, flood: {flood_out}) — the flood "
+            f"never saturated the admission gates")
     if txn and groups > 1 and not txn_stats.get("txn_decided"):
         # Coverage pin: a --txn trial that never decided one
         # cross-group 2PC never attacked its subject.
@@ -1745,6 +1841,19 @@ def main() -> int:
                          "mixed history STRICT-SERIALIZABLE "
                          "(transactions as atomic multi-sub-op "
                          "events; audit/linear.py component search)")
+    ap.add_argument("--overload", action="store_true",
+                    help="with --check-linear: arm the OVERLOAD "
+                         "nemesis — shrink the admission budgets via "
+                         "env (APUS_OVL_MAX_INFLIGHT=64, per-conn 32) "
+                         "so a disjoint-key open-loop flood saturates "
+                         "the gates, then land the seeded leader "
+                         "SIGKILL MID-FLOOD; the recorded history is "
+                         "still checked linearizable (shedding under "
+                         "election churn must never lose an acked "
+                         "write), typed-shed coverage is asserted "
+                         "(> 0 sheds or the trial fails), and the "
+                         "per-reason shed sweep + flood stats travel "
+                         "with the verdict")
     ap.add_argument("--check-linear", action="store_true",
                     help="consistency-audit chaos trials: concurrent "
                          "recorded clients (serial + pipelined) on a "
@@ -1781,6 +1890,7 @@ def main() -> int:
         + (["--split-merge"] if args.split_merge else []) \
         + (["--group-quorum-kill"] if args.group_quorum_kill else []) \
         + (["--txn"] if args.txn else []) \
+        + (["--overload"] if args.overload else []) \
         + (["--native-plane"] if args.native_plane else [])
     if args.fault_seed is not None:
         seeds = [args.fault_seed]
@@ -1793,6 +1903,10 @@ def main() -> int:
              "clock_cmds": 0, "flr_local_reads": 0, "flr_forwards": 0,
              "flr_grants": 0, "flr_pause_lapses": 0,
              "undecided_keys": 0, "undecided_retried": 0,
+             "ovl_admitted": 0, "ovl_shed_global": 0,
+             "ovl_shed_conn": 0, "ovl_shed_deadline": 0,
+             "ovl_shed_native": 0, "ovl_shed_total": 0,
+             "flood_sheds": 0, "flood_ops": 0,
              **{f: 0 for f in _TXN_FIELDS}, "seeds": []}
     churn = {"joins": 0, "auto_removes": 0, "graceful_leaves": 0,
              "leader_kills": 0, "configs_traversed": 0,
@@ -1836,13 +1950,18 @@ def main() -> int:
                                         dump_obs=args.dump_obs,
                                         time_nemesis=args.time_nemesis,
                                         groups=args.groups,
-                                        txn=args.txn)
+                                        txn=args.txn,
+                                        overload=args.overload)
                 for k in ("ops_checked", "keys", "ambiguous",
                           "recorded", "obs_events", "pauses",
                           "clock_cmds", "flr_local_reads",
                           "flr_forwards", "flr_grants",
                           "flr_pause_lapses", "undecided_keys",
-                          "undecided_retried") + _TXN_FIELDS:
+                          "undecided_retried", "ovl_admitted",
+                          "ovl_shed_global", "ovl_shed_conn",
+                          "ovl_shed_deadline", "ovl_shed_native",
+                          "ovl_shed_total", "flood_sheds",
+                          "flood_ops") + _TXN_FIELDS:
                     audit[k] += st.get(k, 0)
                 audit["seeds"].append(fault_seed)
                 r = "ok"
@@ -1883,6 +2002,8 @@ def main() -> int:
                     else "churn_clean_pct") if args.churn
                    else "time_nemesis_linear_clean_pct"
                    if args.check_linear and args.time_nemesis
+                   else "overload_linear_clean_pct"
+                   if args.check_linear and args.overload
                    else "linear_audit_clean_pct" if args.check_linear
                    else "proc_devplane_fuzz_clean_pct"
                    if args.proc and args.device_plane
@@ -1903,6 +2024,7 @@ def main() -> int:
                    "split_merge": args.split_merge,
                    "group_quorum_kill": args.group_quorum_kill,
                    "txn": args.txn,
+                   "overload": args.overload,
                    "native_plane": args.native_plane,
                    # Audit campaign evidence (banked via eval.py): how
                    # much history the checker proved linearizable, and

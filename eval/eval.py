@@ -244,6 +244,33 @@ def _run_perkey(out) -> None:
         _record(out, rec, replicas=3, bench="perkey")
 
 
+def _run_overload(out, trials: int = 3) -> None:
+    """Overload control plane campaign (ISSUE 17): the bench.py
+    --overload headline (saturation ramp to the goodput knee, ~5x
+    metastability probe with bounded recovery, flood composed with a
+    mid-run leader kill) plus the overload chaos-audit campaign
+    (fuzz.py --check-linear --overload): shrunk admission budgets, a
+    saturating flood armed UNDER the leader-kill nemesis, every
+    trial's recorded history checked linearizable — sheds must never
+    cost exactly-once."""
+    print("bench.py --overload: saturation ramp + metastability probe "
+          "+ flood/leader-kill chaos")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "bench.py"),
+                          "--overload"],
+                         timeout=420):
+        _record(out, rec, replicas=3, bench="overload")
+    print(f"fuzz.py --check-linear --overload: overload chaos audit "
+          f"({trials} trials)")
+    for rec in _run_tool([sys.executable,
+                          os.path.join(REPO, "benchmarks", "fuzz.py"),
+                          "--check-linear", "--overload",
+                          "--trials", str(trials),
+                          "--seed-base", "29100"],
+                         timeout=300 * trials):
+        _record(out, rec, replicas=3, bench="overload_audit")
+
+
 def _run_txn_bench(out) -> None:
     """Transaction throughput row (bench.py --txn): single-group MULTI
     batch vs cross-group 2PC cost under the per-group write-svc
@@ -369,6 +396,12 @@ def cmd_run(args) -> int:
         if getattr(args, "perkey_only", False):
             # Per-bucket invalidation A/B only: skip the suite.
             _run_perkey(out)
+            print(f"results appended to {RUNS}")
+            return 0
+        if getattr(args, "overload_only", False):
+            # Overload control campaign only: skip the suite.
+            _run_overload(out, trials=getattr(args, "overload_trials",
+                                              3))
             print(f"results appended to {RUNS}")
             return 0
         # 1. Proxied app SET/GET + replication across replica counts
@@ -1325,6 +1358,15 @@ def main() -> int:
                             "cold-key follower GETs under a hot-key "
                             "writer, bucket-granular vs whole-log "
                             "gating) and bank the row")
+        p.add_argument("--overload-only", action="store_true",
+                       help="run ONLY the overload control campaign "
+                            "(bench.py --overload: saturation ramp "
+                            "to the goodput knee, ~5x metastability "
+                            "probe, flood + leader-kill chaos; plus "
+                            "fuzz --check-linear --overload) and "
+                            "bank the rows")
+        p.add_argument("--overload-trials", type=int, default=3,
+                       help="audit trial count for --overload-only")
         p.add_argument("--ladder-mb", default="10,100",
                        help="rejoin-ladder state sizes, MB comma list")
     p_rep = sub.add_parser("report", help="aggregate results")

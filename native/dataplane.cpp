@@ -92,6 +92,11 @@ constexpr uint8_t OP_CLT_WRITE = 16;
 constexpr uint8_t OP_CLT_READ = 17;
 constexpr uint8_t OP_GROUP = 25;
 constexpr uint8_t ST_OK = 0;
+// Typed overload shed (runtime/overload.py ST_OVERLOAD): client-op
+// status namespace, body = u32 LE retry-after hint (ms).  The bytes
+// built here must stay identical to Python's shed_reply (the
+// cross-impl equivalence tape pins it).
+constexpr uint8_t ST_OVERLOAD = 10;
 constexpr uint32_t MAX_FRAME = 1u << 27;   // wire.py's 128 MB sanity cap
 constexpr size_t RECV_CHUNK = 1 << 16;     // FrameStream.RECV parity
 constexpr int MAX_GIDS = 256;              // gid is a u8 on the wire
@@ -153,6 +158,7 @@ enum Counter {
   C_GIL_RELEASED_NS,      // loop busy time (never holds the GIL)
   C_GATE_MISSES,          // GETs that fell to Python (gate closed)
   C_VIEW_POISONS,         // applied views poisoned (non-P/D op seen)
+  C_SHEDS,                // client frames shed ST_OVERLOAD pre-GIL
   N_COUNTERS,
 };
 
@@ -160,7 +166,7 @@ const char* const COUNTER_NAMES[N_COUNTERS] = {
     "ingest_batches", "ingest_frames", "replies", "dedup_hits",
     "get_serves",     "upcall_batches", "upcall_frames", "raw_batches",
     "bytes_in",       "bytes_out",      "conns_adopted",
-    "gil_released_ns", "gate_misses",   "view_poisons",
+    "gil_released_ns", "gate_misses",   "view_poisons", "sheds",
 };
 
 // -- parsed client op ------------------------------------------------------
@@ -292,6 +298,14 @@ struct Plane {
   bool dedup_enabled = true;
   size_t dedup_max_reply = 1 << 16;
   size_t view_max_bytes = size_t(256) << 20;
+  // Overload admission (ISSUE 17): in-flight frames handed across the
+  // GIL, bounded by ovl_max_inflight (0 = unlimited).  Once the budget
+  // is hit, further CLIENT frames are answered ST_OVERLOAD right here
+  // — before crossing the GIL — with the retry-after hint; non-client
+  // frames are never shed (control priority).  All under mu.
+  int ovl_max_inflight = 0;
+  uint32_t ovl_retry_ms = 50;
+  size_t ovl_inflight = 0;
 
   uint64_t next_conn_id = 1;
   uint64_t next_batch_id = 1;
@@ -439,6 +453,34 @@ void process_conn(Plane* p, Conn* c) {
       if (burst) conn_flush(p, c);
       break;
     }
+    // Native admission (ISSUE 17): when the in-flight budget is
+    // exhausted, answer CLIENT frames ST_OVERLOAD right here — typed
+    // shed replies built without ever crossing the GIL, byte-identical
+    // to runtime.overload.shed_reply.  The scan stops at the first
+    // non-client frame: control traffic is NEVER shed (strict
+    // priority), it goes to Python below regardless of load.
+    if (p->ovl_max_inflight > 0 &&
+        p->ovl_inflight >= (size_t)p->ovl_max_inflight) {
+      bool shed_any = false;
+      while (!c->pending.empty()) {
+        ParsedOp op;
+        const std::string& f = c->pending.front();
+        if (!parse_client(reinterpret_cast<const uint8_t*>(f.data()),
+                          f.size(), &op))
+          break;
+        std::string reply;
+        reply.push_back((char)ST_OVERLOAD);
+        put_u64(reply, op.req_id);
+        put_u32(reply, 4);
+        put_u32(reply, p->ovl_retry_ms);
+        enqueue_reply(c, reply);
+        c->pending.pop_front();
+        p->bump(C_SHEDS);
+        shed_any = true;
+      }
+      if (shed_any) conn_flush(p, c);
+      if (c->pending.empty()) break;
+    }
     // The head frame needs Python: assemble a burst (MAX_BURST
     // semantics preserved — whatever is already queued, capped) and
     // hand it across the admission boundary.
@@ -447,6 +489,14 @@ void process_conn(Plane* p, Conn* c) {
     b->parsed = true;
     size_t take = c->pending.size();
     if ((int)take > p->max_burst) take = (size_t)p->max_burst;
+    if (p->ovl_max_inflight > 0) {
+      // Partial room: cap the burst at the remaining budget (the tail
+      // waits in pending — admitted or shed once this batch retires).
+      size_t room = (size_t)p->ovl_max_inflight > p->ovl_inflight
+                        ? (size_t)p->ovl_max_inflight - p->ovl_inflight
+                        : 1;
+      if (take > room) take = room;
+    }
     b->nframes = take;
     b->ops.reserve(take);
     for (size_t i = 0; i < take; i++) {
@@ -469,6 +519,7 @@ void process_conn(Plane* p, Conn* c) {
     uint64_t bid = p->next_batch_id++;
     p->batches[bid] = b;
     c->busy = true;
+    p->ovl_inflight += b->nframes;
     p->bump(C_UPCALL_BATCHES);
     p->bump(C_UPCALL_FRAMES, b->nframes);
     if (!b->parsed) p->bump(C_RAW_BATCHES);
@@ -545,6 +596,9 @@ void drain_done(Plane* p) {
     if (bit == p->batches.end()) continue;
     BatchRec* b = bit->second;
     p->batches.erase(bit);
+    p->ovl_inflight = p->ovl_inflight >= b->nframes
+                          ? p->ovl_inflight - b->nframes
+                          : 0;
     auto cit = p->conns.find(b->conn_id);
     if (cit != p->conns.end()) {
       Conn* c = cit->second;
@@ -1125,6 +1179,18 @@ PyObject* plane_dedup_put(PyObject* raw, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+PyObject* plane_set_overload(PyObject* raw, PyObject* args) {
+  Plane* p = (Plane*)raw;
+  int max_inflight;
+  unsigned int retry_ms;
+  if (!PyArg_ParseTuple(args, "iI", &max_inflight, &retry_ms))
+    return nullptr;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->ovl_max_inflight = max_inflight > 0 ? max_inflight : 0;
+  p->ovl_retry_ms = (uint32_t)retry_ms;
+  Py_RETURN_NONE;
+}
+
 PyObject* plane_counters(PyObject* raw, PyObject*) {
   Plane* p = (Plane*)raw;
   PyObject* d = PyDict_New();
@@ -1187,6 +1253,9 @@ PyMethodDef plane_methods[] = {
      "mark a group's view permanently stale"},
     {"dedup_put", plane_dedup_put, METH_VARARGS,
      "dedup_put(gid, clt_id, req_id, reply): seed the reply cache"},
+    {"set_overload", plane_set_overload, METH_VARARGS,
+     "set_overload(max_inflight, retry_after_ms): bound in-flight "
+     "client frames; excess shed ST_OVERLOAD before crossing the GIL"},
     {"counters", plane_counters, METH_NOARGS, "counter snapshot dict"},
     {"gid_reads", plane_gid_reads, METH_VARARGS,
      "native GETs served for one group"},

@@ -59,6 +59,10 @@ NAMESPACE_OF = {
     # view (standalone gateways keep a plain dict; the _bump helper
     # duck-types both).
     "apus_tpu/runtime/serve.py": "srv",
+    # Overload policy: its counters land on the daemon's srv_* view
+    # (the shed-by-reason bumps are f-strings — enumerated in the
+    # catalog, enforced by tests/test_overload.py).
+    "apus_tpu/runtime/overload.py": "srv",
     "apus_tpu/parallel/faults.py": "fault",
     "apus_tpu/runtime/client.py": "srv",
     "apus_tpu/runtime/daemon.py": "node",

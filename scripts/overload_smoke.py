@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Tier-1 overload smoke (ISSUE 17): one SMALL saturation probe
+proving the overload control plane end-to-end on a live 3-replica
+ProcCluster with deliberately SHRUNK admission budgets:
+
+1. a short open-loop flood well past the shrunk global in-flight
+   budget must produce TYPED sheds (ST_OVERLOAD, counted both by the
+   harness and by the servers' `srv_ovl_*` view) with ZERO censored
+   ops — every unserved op is a typed refusal, never an ambiguous
+   timeout;
+2. control traffic priority: the flood must not cost a leadership —
+   leader index and term are identical before and after saturation;
+3. recovery: a gentle run immediately after the flood completes
+   cleanly (no errors, no censored ops) — no metastable wake.
+
+Seconds, not minutes; the full staircase/metastability campaigns live
+in `python -m apus_tpu.load --mode ramp|meta` and `eval.py run
+--overload-only` (banked as BENCH_r16).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Shrink the budgets BEFORE the cluster spawns (children inherit).
+    os.environ["APUS_OVL_MAX_INFLIGHT"] = "48"
+    os.environ["APUS_OVL_MAX_PER_CONN"] = "24"
+    os.environ["APUS_OVL_RETRY_MS"] = "10"
+    from apus_tpu.load import OpenLoopConfig, run_open_loop
+    from apus_tpu.runtime.client import ApusClient
+    from apus_tpu.runtime.proc import ProcCluster
+    from apus_tpu.utils.config import ClusterSpec
+
+    # The PROXIED timing envelope (hb 10 ms / timeout 100 ms; same
+    # rationale as bench.py --perkey): python daemons GIL-starved by a
+    # write-heavy flood flap leaders at PROC_SPEC's 10 ms election
+    # timeout, which would measure timer tightness, not the overload
+    # gates.  At this envelope a leadership lost under saturation is
+    # attributable to CONTROL STARVATION — exactly what the admission
+    # plane's control-priority rule must prevent.
+    spec = ClusterSpec(hb_period=0.010, hb_timeout=0.100,
+                       elect_low=0.150, elect_high=0.400)
+
+    def sweep(pc):
+        tot = {"shed_total": 0, "admitted": 0}
+        for i in range(3):
+            st = pc.status(i, timeout=1.0) or {}
+            ov = st.get("overload") or {}
+            tot["shed_total"] += ov.get("shed_total", 0) or 0
+            tot["admitted"] += ov.get("admitted", 0) or 0
+        return tot
+
+    with tempfile.TemporaryDirectory(prefix="apus-ovl-smoke") as td:
+        with ProcCluster(3, workdir=td, spec=spec) as pc:
+            lead0 = pc.leader_idx(timeout=30.0)
+            term0 = (pc.status(lead0, timeout=2.0) or {}).get("term")
+            peers = [p for p in pc.spec.peers if p]
+            flood = OpenLoopConfig(
+                peers=peers, connections=64, rate=1500.0,
+                duration=3.0, seed=9417, nkeys=512, theta=0.0,
+                get_fraction=0.3, value_size=64, slo_ms=0.0,
+                grace=15.0, burst_every=0.5, burst_size=256)
+            frep, fstats = run_open_loop(flood)
+            sv = sweep(pc)
+            with ApusClient(peers, timeout=10.0) as c:
+                c.put(b"ovs", b"post-flood")   # cluster still writable
+            lead1 = pc.leader_idx(timeout=10.0)
+            term1 = (pc.status(lead1, timeout=2.0) or {}).get("term")
+            gentle = OpenLoopConfig(
+                peers=peers, connections=16, rate=150.0, duration=2.0,
+                seed=9418, nkeys=256, theta=0.0, get_fraction=0.8,
+                value_size=64, slo_ms=0.0, grace=15.0)
+            grep_, gstats = run_open_loop(gentle)
+    print(f"overload_smoke: flood ops={frep.ops} sheds={frep.sheds} "
+          f"errors={frep.errors} censored={frep.censored} | server "
+          f"admitted={sv['admitted']} shed_total={sv['shed_total']} | "
+          f"leader {lead0}@t{term0} -> {lead1}@t{term1} | recovery "
+          f"ops={grep_.ops} sheds={grep_.sheds} errors={grep_.errors} "
+          f"censored={grep_.censored}")
+    if frep.sheds == 0 or sv["shed_total"] == 0:
+        print("overload_smoke: FAIL — flood produced no typed sheds "
+              "(gates never saturated)", file=sys.stderr)
+        return 1
+    if frep.censored or frep.errors:
+        print(f"overload_smoke: FAIL — {frep.errors} errors / "
+              f"{frep.censored} censored under flood (unserved load "
+              f"must be a TYPED shed)", file=sys.stderr)
+        return 1
+    if (lead1, term1) != (lead0, term0):
+        print(f"overload_smoke: FAIL — saturation cost a leadership "
+              f"({lead0}@t{term0} -> {lead1}@t{term1}); control "
+              f"traffic must bypass the overload gates",
+              file=sys.stderr)
+        return 1
+    if grep_.censored or grep_.errors:
+        print(f"overload_smoke: FAIL — recovery run not clean "
+              f"({grep_.errors} errors / {grep_.censored} censored)",
+              file=sys.stderr)
+        return 1
+    print("overload_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

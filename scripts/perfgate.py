@@ -50,6 +50,7 @@ FACTORS = {
     "unsampled_obs_check_ns": 3.0,
     "hist_observe_ns": 3.0,
     "native_ingest_op_p50_us": 3.0,
+    "native_ingest_armed_p50_us": 3.0,
     "lease_get_serve_p99_us": 3.0,
 }
 UNITS = {
@@ -60,6 +61,7 @@ UNITS = {
     "unsampled_obs_check_ns": "ns",
     "hist_observe_ns": "ns",
     "native_ingest_op_p50_us": "us",
+    "native_ingest_armed_p50_us": "us",
     "lease_get_serve_p99_us": "us",
 }
 
@@ -268,7 +270,8 @@ def _measure_obs_fast_path(n: int = 300_000) -> tuple[float, float]:
 
 
 def _measure_native_ingest(repeats: int = 3, iters: int = 30,
-                           window: int = 64) -> "float | None":
+                           window: int = 64,
+                           armed: bool = False) -> "float | None":
     """Per-op p50 of the NATIVE data plane's fully-native path
     (ISSUE 13): `window`-deep bursts of dedup-hit writes through a
     socketpair-adopted connection — frame parse, epdb-cache lookup,
@@ -286,6 +289,12 @@ def _measure_native_ingest(repeats: int = 3, iters: int = 30,
 
     plane = ext.Plane()
     plane.start()
+    if armed and hasattr(plane, "set_overload"):
+        # Arm the admission plane (ISSUE 17) with a budget far above
+        # the burst window so nothing sheds: this variant banks the
+        # count-and-check overhead of native admission sitting ON the
+        # measured ingest path, not the shed branch itself.
+        plane.set_overload(1 << 20, 50)
     a, b = socket.socketpair()
     try:
         assert plane.adopt(b.detach(), b"")
@@ -381,6 +390,9 @@ def measure(fast: bool = False) -> dict:
     native = _measure_native_ingest()
     if native is not None:
         out["native_ingest_op_p50_us"] = native
+        armed = _measure_native_ingest(armed=True)
+        if armed is not None:
+            out["native_ingest_armed_p50_us"] = armed
     out["lease_get_serve_p99_us"] = _measure_lease_get_p99()
     if not fast:
         out["depth1_window_wall_p50_us"] = _measure_depth1_window()

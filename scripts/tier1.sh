@@ -121,6 +121,15 @@ if [ "$smoke" -eq 1 ]; then
         echo "SLO harness smoke FAILED (rc=$slrc)" >&2
         exit "$slrc"
     fi
+    echo "== overload smoke (shrunk admission budgets, saturating"
+    echo "   flood: typed sheds observed, zero censored, leadership"
+    echo "   held, clean recovery) =="
+    env JAX_PLATFORMS=cpu python scripts/overload_smoke.py
+    ovrc=$?
+    if [ "$ovrc" -ne 0 ]; then
+        echo "overload smoke FAILED (rc=$ovrc)" >&2
+        exit "$ovrc"
+    fi
     echo "== txn checker unit slice (planted dirty-read / lost-update /"
     echo "   fractured-read histories REJECTED, clean txn history"
     echo "   ACCEPTED) =="

@@ -102,6 +102,14 @@ def pytest_configure(config):
         "selectable with -m load")
     config.addinivalue_line(
         "markers",
+        "overload: overload control plane (runtime/overload.py + the "
+        "admission gates in parallel/net.py and native/dataplane.cpp) "
+        "— typed shed wire format, FIFO-prefix admission, strict "
+        "control priority, client retry budget/breaker, native shed "
+        "byte-equivalence, live shed-before-admission exactly-once; "
+        "selectable with -m overload")
+    config.addinivalue_line(
+        "markers",
         "serve: protocol-aware app serving surface (runtime/serve.py) "
         "— RESP + memcached-text GET/SET mapped onto the replicated "
         "KVS via the group router and follower leases, with the "
